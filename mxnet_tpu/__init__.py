@@ -1,0 +1,27 @@
+"""mxnet_tpu — a TPU-native deep-learning framework with MXNet v1.0 capabilities.
+
+A ground-up rebuild of the Apache MXNet v1.0 feature surface (reference:
+/root/reference) designed for TPU: every operator lowers to XLA via JAX,
+graphs compile whole (the XLA compiler replaces the NNVM GraphExecutor's
+memory planner/scheduler), autograd rides jax.vjp, and distributed training
+uses XLA collectives over an ICI device mesh (`KVStore('tpu_sync')`) instead
+of NCCL/ps-lite.
+
+Public surface mirrors `python/mxnet/__init__.py` in the reference:
+  mx.nd, mx.sym, mx.mod, mx.gluon, mx.kv, mx.io, mx.autograd, mx.metric,
+  mx.optimizer, mx.initializer, mx.context (cpu/gpu/tpu), mx.random, ...
+"""
+
+__version__ = "1.0.0.tpu0"
+
+from . import base
+from .base import MXNetError
+from . import context
+from .context import Context, current_context, cpu, gpu, tpu
+from . import engine
+from . import ops  # registers all operators
+from . import ndarray
+from . import ndarray as nd
+from .ndarray import NDArray
+from . import random
+from . import autograd

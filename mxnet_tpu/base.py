@@ -1,0 +1,180 @@
+"""Core shared definitions: errors, dtype tables, registries, small utils.
+
+Reference parity: plays the role of `python/mxnet/base.py` plus the
+dmlc-core capabilities mxnet consumed (`dmlc::Parameter` declarative config,
+`dmlc::Registry`, env-var access — SURVEY.md §2.1 "empty-submodule
+capabilities").  No ctypes FFI is needed: the "C API" boundary of the
+reference (src/c_api/) is replaced by JAX/XLA python-native calls.
+"""
+from __future__ import annotations
+
+import os
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as _np
+
+
+class MXNetError(RuntimeError):
+    """Error raised by the framework (parity: mxnet.base.MXNetError)."""
+
+
+# ---------------------------------------------------------------------------
+# dtype tables (parity: python/mxnet/base.py _DTYPE_NP_TO_MX / _DTYPE_MX_TO_NP)
+# TPU-native addition: bfloat16 is first-class (the MXU native dtype).
+# ---------------------------------------------------------------------------
+try:
+    import ml_dtypes as _mld
+    bfloat16 = _np.dtype(_mld.bfloat16)
+except ImportError:  # pragma: no cover
+    bfloat16 = None
+
+_DTYPE_NP_TO_MX: Dict[Any, int] = {
+    None: -1,
+    _np.dtype(_np.float32): 0,
+    _np.dtype(_np.float64): 1,
+    _np.dtype(_np.float16): 2,
+    _np.dtype(_np.uint8): 3,
+    _np.dtype(_np.int32): 4,
+    _np.dtype(_np.int8): 5,
+    _np.dtype(_np.int64): 6,
+    _np.dtype(_np.bool_): 7,
+}
+if bfloat16 is not None:
+    _DTYPE_NP_TO_MX[bfloat16] = 12
+
+_DTYPE_MX_TO_NP: Dict[int, Any] = {v: k for k, v in _DTYPE_NP_TO_MX.items()}
+
+_STORAGE_TYPE_STR_TO_ID = {"undefined": -1, "default": 0, "row_sparse": 1, "csr": 2}
+_STORAGE_TYPE_ID_TO_STR = {v: k for k, v in _STORAGE_TYPE_STR_TO_ID.items()}
+
+
+def np_dtype(dtype) -> _np.dtype:
+    """Canonicalize a user-supplied dtype (str/np.dtype/type) to np.dtype."""
+    if dtype is None:
+        return _np.dtype(_np.float32)
+    if isinstance(dtype, str) and dtype == "bfloat16":
+        if bfloat16 is None:
+            raise MXNetError("bfloat16 requires ml_dtypes")
+        return bfloat16
+    return _np.dtype(dtype)
+
+
+def getenv(name: str, default):
+    """Typed env lookup (parity: dmlc::GetEnv). MXNET_* envs keep their names."""
+    val = os.environ.get(name)
+    if val is None:
+        return default
+    ty = type(default)
+    if ty is bool:
+        return val not in ("0", "false", "False", "")
+    return ty(val)
+
+
+# ---------------------------------------------------------------------------
+# Generic registry (parity: dmlc::Registry / python/mxnet/registry.py)
+# ---------------------------------------------------------------------------
+class Registry:
+    """Name → object registry with alias support."""
+
+    def __init__(self, kind: str):
+        self.kind = kind
+        self._map: Dict[str, Any] = {}
+
+    def register(self, obj=None, name: Optional[str] = None):
+        def _do(o):
+            key = (name or getattr(o, "__name__", None) or o.name).lower()
+            self._map[key] = o
+            return o
+        return _do(obj) if obj is not None else _do
+
+    def get(self, name: str):
+        key = name.lower()
+        if key not in self._map:
+            raise MXNetError(
+                f"{self.kind} '{name}' is not registered; known: {sorted(self._map)}")
+        return self._map[key]
+
+    def find(self, name: str):
+        return self._map.get(name.lower())
+
+    def create(self, name_or_obj, *args, **kwargs):
+        if isinstance(name_or_obj, str):
+            return self.get(name_or_obj)(*args, **kwargs)
+        return name_or_obj
+
+    def list(self) -> List[str]:
+        return sorted(self._map)
+
+
+# ---------------------------------------------------------------------------
+# Declarative op/iterator parameter schema
+# (parity: dmlc::Parameter<T> — DMLC_DECLARE_PARAMETER structs that every
+#  reference op uses, e.g. src/kvstore/gradient_compression.h:43-48)
+# ---------------------------------------------------------------------------
+@dataclass
+class Arg:
+    name: str
+    type: Callable = float
+    default: Any = None
+    required: bool = False
+    doc: str = ""
+
+
+class ParamSchema:
+    """Validates/normalizes kwargs for an op into a canonical hashable tuple."""
+
+    def __init__(self, args: List[Arg]):
+        self.args = {a.name: a for a in args}
+
+    @staticmethod
+    def _canon(ty, v):
+        if v is None:
+            return None
+        if ty in (tuple, "shape"):
+            if isinstance(v, str):
+                v = eval(v, {"__builtins__": {}})  # "(2, 2)" from string configs
+            if isinstance(v, (int, _np.integer)):
+                return (int(v),)
+            return tuple(int(x) for x in v)
+        if ty is bool:
+            if isinstance(v, str):
+                return v.lower() in ("1", "true", "yes")
+            return bool(v)
+        if ty is int:
+            return int(v)
+        if ty is float:
+            return float(v)
+        if ty is str:
+            return str(v)
+        return ty(v)
+
+    def normalize(self, kwargs: Dict[str, Any]) -> Tuple[Tuple[str, Any], ...]:
+        out = {}
+        for k, v in kwargs.items():
+            if k not in self.args:
+                raise MXNetError(f"unknown argument '{k}'; expected {sorted(self.args)}")
+            out[k] = self._canon(self.args[k].type, v)
+        for a in self.args.values():
+            if a.name not in out:
+                if a.required:
+                    raise MXNetError(f"required argument '{a.name}' missing")
+                out[a.name] = self._canon(a.type, a.default) if a.default is not None else a.default
+        return tuple(sorted(out.items()))
+
+
+class _ThreadLocalStack(threading.local):
+    """Per-thread stack used by with-scopes (Context, AttrScope, NameManager)."""
+
+    def __init__(self):
+        self.stack: List[Any] = []
+
+    def top(self):
+        return self.stack[-1] if self.stack else None
+
+    def push(self, v):
+        self.stack.append(v)
+
+    def pop(self):
+        return self.stack.pop()

@@ -1,0 +1,88 @@
+"""Dependency engine facade over PJRT async dispatch.
+
+Reference parity: `src/engine/` (SURVEY.md §2.1) — the reference hand-built an
+async dataflow scheduler (ThreadedEnginePerDevice, ThreadedVar read/write
+queues, OprBlock wait counters) because CUDA kernel launches needed explicit
+ordering across streams.  On TPU, PJRT *is* that engine: every jax op enqueues
+asynchronously and returns a future-backed Array; data dependencies order
+execution; `Array.block_until_ready()` is WaitToRead.  This module keeps the
+reference's user-visible Engine API (WaitForVar/WaitForAll, bulking, naive
+mode) as a thin layer so code written against `mx.engine` semantics runs
+unmodified.
+
+Engine types (parity: src/engine/engine.cc:32-48, MXNET_ENGINE_TYPE):
+  - 'ThreadedEnginePerDevice' / 'ThreadedEnginePooled': PJRT async dispatch
+    (the default; names retained for compatibility).
+  - 'NaiveEngine': synchronous debugging mode — every op blocks until done,
+    serializing execution exactly like the reference's NaiveEngine
+    (src/engine/naive_engine.cc:36).
+"""
+from __future__ import annotations
+
+import contextlib
+
+import jax
+
+from .base import getenv
+
+_engine_type = getenv("MXNET_ENGINE_TYPE", "ThreadedEnginePerDevice")
+_bulk_size = 0
+
+
+def engine_type() -> str:
+    return _engine_type
+
+
+def set_engine_type(name: str) -> None:
+    global _engine_type
+    _engine_type = name
+
+
+def is_naive() -> bool:
+    return _engine_type == "NaiveEngine"
+
+
+def maybe_sync(arrays) -> None:
+    """In NaiveEngine mode, block on the given jax arrays (debug serialization)."""
+    if _engine_type == "NaiveEngine":
+        for a in arrays:
+            if hasattr(a, "block_until_ready"):
+                a.block_until_ready()
+
+
+def wait_for_var(array) -> None:
+    """Parity: Engine::WaitForVar — block until this buffer is computed."""
+    if hasattr(array, "block_until_ready"):
+        array.block_until_ready()
+
+
+def wait_for_all() -> None:
+    """Parity: Engine::WaitForAll / mx.nd.waitall.
+
+    PJRT has no global barrier; jax.effects_barrier() drains pending effects
+    and live arrays synchronize on access, so this blocks host-side work.
+    """
+    try:
+        jax.effects_barrier()
+    except Exception:
+        pass
+
+
+def set_bulk_size(size: int) -> int:
+    """Parity: Engine::set_bulk_size (include/mxnet/engine.h:283).
+
+    On TPU, op bulking = XLA fusion under jit; this knob is retained for API
+    compatibility and returns the previous value.
+    """
+    global _bulk_size
+    old, _bulk_size = _bulk_size, size
+    return old
+
+
+@contextlib.contextmanager
+def bulk(size: int):
+    old = set_bulk_size(size)
+    try:
+        yield
+    finally:
+        set_bulk_size(old)

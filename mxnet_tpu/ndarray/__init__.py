@@ -1,0 +1,24 @@
+"""`mx.nd` namespace: NDArray + one function per registered operator.
+
+Parity: `python/mxnet/ndarray/__init__.py` — flat op functions plus
+`random`, `linalg`, `sparse` sub-namespaces.
+"""
+from .ndarray import (NDArray, array, zeros, ones, full, empty, arange, eye,
+                      concatenate, moveaxis, waitall, save, load, from_numpy,
+                      from_dlpack)
+from . import register
+from .register import invoke, _gen
+
+# hoist every generated op function into this namespace: mx.nd.<op>(...)
+_g = globals()
+for _name in dir(_gen):
+    if not _name.startswith("__"):
+        _g[_name] = getattr(_gen, _name)
+
+from . import random
+from . import linalg
+from . import sparse
+from .sparse import CSRNDArray, RowSparseNDArray
+
+onehot_encode = _gen.one_hot
+imdecode = None  # provided by mxnet_tpu.image

@@ -1,0 +1,75 @@
+"""`mx.nd.random` namespace (parity: python/mxnet/ndarray/random.py)."""
+from __future__ import annotations
+
+from . import register
+from .register import invoke
+from .ndarray import NDArray
+
+
+def _sample(opname, tensor_params, kwargs, positional):
+    """Dispatch to scalar-parameter _random_* or tensor-parameter _sample_*."""
+    inputs = [v for v in positional if isinstance(v, NDArray)]
+    if inputs:
+        kw = {k: v for k, v in kwargs.items() if k not in tensor_params}
+        return invoke("_sample" + opname, inputs, kw, out=kwargs.get("out"))
+    kw = dict(kwargs)
+    for name, val in zip(tensor_params, positional):
+        kw[name] = val
+    out = kw.pop("out", None)
+    return invoke("_random" + opname, [], kw, out=out)
+
+
+def uniform(low=0, high=1, shape=(), dtype="float32", ctx=None, out=None, **kw):
+    return _sample("_uniform", ("low", "high"),
+                   dict(shape=shape, dtype=dtype, ctx=ctx, out=out), (low, high))
+
+
+def normal(loc=0, scale=1, shape=(), dtype="float32", ctx=None, out=None, **kw):
+    if isinstance(loc, NDArray):
+        return invoke("_sample_normal", [loc, scale], dict(shape=shape, dtype=dtype))
+    return invoke("_random_normal", [],
+                  dict(loc=loc, scale=scale, shape=shape, dtype=dtype, ctx=ctx), out=out)
+
+
+def randn(*shape, loc=0, scale=1, dtype="float32", ctx=None, **kw):
+    return normal(loc, scale, shape or (1,), dtype=dtype, ctx=ctx)
+
+
+def gamma(alpha=1, beta=1, shape=(), dtype="float32", ctx=None, out=None, **kw):
+    return invoke("_random_gamma", [],
+                  dict(alpha=alpha, beta=beta, shape=shape, dtype=dtype, ctx=ctx), out=out)
+
+
+def exponential(lam=1, shape=(), dtype="float32", ctx=None, out=None, **kw):
+    return invoke("_random_exponential", [],
+                  dict(lam=lam, shape=shape, dtype=dtype, ctx=ctx), out=out)
+
+
+def poisson(lam=1, shape=(), dtype="float32", ctx=None, out=None, **kw):
+    return invoke("_random_poisson", [],
+                  dict(lam=lam, shape=shape, dtype=dtype, ctx=ctx), out=out)
+
+
+def negative_binomial(k=1, p=1, shape=(), dtype="float32", ctx=None, out=None, **kw):
+    return invoke("_random_negative_binomial", [],
+                  dict(k=k, p=p, shape=shape, dtype=dtype, ctx=ctx), out=out)
+
+
+def generalized_negative_binomial(mu=1, alpha=1, shape=(), dtype="float32",
+                                  ctx=None, out=None, **kw):
+    return invoke("_random_generalized_negative_binomial", [],
+                  dict(mu=mu, alpha=alpha, shape=shape, dtype=dtype, ctx=ctx), out=out)
+
+
+def randint(low, high, shape=(), dtype="int32", ctx=None, out=None, **kw):
+    return invoke("_random_randint", [],
+                  dict(low=low, high=high, shape=shape, dtype=dtype, ctx=ctx), out=out)
+
+
+def multinomial(data, shape=(), get_prob=False, dtype="int32", **kw):
+    return invoke("_sample_multinomial", [data],
+                  dict(shape=shape, get_prob=get_prob, dtype=dtype))
+
+
+def shuffle(data, **kw):
+    return invoke("_shuffle", [data], {})

@@ -1,0 +1,168 @@
+"""Sparse NDArrays: row_sparse + csr (parity: python/mxnet/ndarray/sparse.py,
+include/mxnet/ndarray.h:61-63, src/operator/tensor/cast_storage / dot sparse).
+
+XLA has no first-class sparsity (SURVEY.md §7 risks), so these keep the
+reference's *API and storage layout* (indices/values, indptr/indices/data)
+while compute lowers to dense-segment gather/scatter — correct semantics,
+documented perf cliff.  row_sparse is the path gluon sparse embeddings and
+kvstore row_sparse_pull use.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as _np
+
+from ..base import MXNetError, np_dtype
+from ..context import current_context
+from .ndarray import NDArray, array, zeros
+
+
+class BaseSparseNDArray(NDArray):
+    __slots__ = ()
+
+
+class RowSparseNDArray(BaseSparseNDArray):
+    """shape (N, ...) with only rows `indices` stored in `data`."""
+
+    __slots__ = ("_indices", "_values", "_shape")
+
+    def __init__(self, indices, values, shape, ctx=None):
+        self._indices = jnp.asarray(indices, jnp.int64)
+        self._values = jnp.asarray(values)
+        self._shape = tuple(shape)
+        dense = jnp.zeros(shape, self._values.dtype).at[self._indices].set(self._values)
+        super().__init__(dense, ctx or current_context())
+
+    @property
+    def stype(self):
+        return "row_sparse"
+
+    @property
+    def indices(self) -> NDArray:
+        return NDArray(self._indices, self._ctx)
+
+    @property
+    def data(self) -> NDArray:
+        return NDArray(self._values, self._ctx)
+
+    def tostype(self, stype):
+        if stype == "row_sparse":
+            return self
+        if stype == "default":
+            return NDArray(self._data, self._ctx)
+        raise MXNetError(f"cannot convert row_sparse to {stype}")
+
+    def retain(self, indices):
+        idx = jnp.asarray(indices.asnumpy() if isinstance(indices, NDArray) else indices,
+                          jnp.int64)
+        vals = jnp.take(self._data, idx, axis=0)
+        return RowSparseNDArray(idx, vals, self._shape, self._ctx)
+
+    def __repr__(self):
+        return (f"\n<RowSparseNDArray {'x'.join(map(str, self._shape))} "
+                f"({len(self._indices)} rows) @{self._ctx}>")
+
+
+class CSRNDArray(BaseSparseNDArray):
+    __slots__ = ("_indptr", "_indices_c", "_values", "_shape")
+
+    def __init__(self, data, indptr, indices, shape, ctx=None):
+        self._indptr = jnp.asarray(indptr, jnp.int64)
+        self._indices_c = jnp.asarray(indices, jnp.int64)
+        self._values = jnp.asarray(data)
+        self._shape = tuple(shape)
+        dense = _np.zeros(shape, _np.asarray(self._values).dtype)
+        ip = _np.asarray(self._indptr)
+        ic = _np.asarray(self._indices_c)
+        vv = _np.asarray(self._values)
+        for r in range(shape[0]):
+            dense[r, ic[ip[r]:ip[r + 1]]] = vv[ip[r]:ip[r + 1]]
+        super().__init__(jnp.asarray(dense), ctx or current_context())
+
+    @property
+    def stype(self):
+        return "csr"
+
+    @property
+    def indptr(self) -> NDArray:
+        return NDArray(self._indptr, self._ctx)
+
+    @property
+    def indices(self) -> NDArray:
+        return NDArray(self._indices_c, self._ctx)
+
+    @property
+    def data(self) -> NDArray:
+        return NDArray(self._values, self._ctx)
+
+    def tostype(self, stype):
+        if stype == "csr":
+            return self
+        if stype == "default":
+            return NDArray(self._data, self._ctx)
+        raise MXNetError(f"cannot convert csr to {stype}")
+
+    def __repr__(self):
+        return (f"\n<CSRNDArray {'x'.join(map(str, self._shape))} "
+                f"({len(self._values)} nnz) @{self._ctx}>")
+
+
+def row_sparse_array(arg1, shape=None, ctx=None, dtype=None):
+    """Create RowSparseNDArray from (data, indices) tuple or dense source."""
+    if isinstance(arg1, tuple) and len(arg1) == 2:
+        values, indices = arg1
+        values = _np.asarray(values.asnumpy() if isinstance(values, NDArray) else values)
+        indices = _np.asarray(indices.asnumpy() if isinstance(indices, NDArray) else indices)
+        if dtype is not None:
+            values = values.astype(np_dtype(dtype))
+        return RowSparseNDArray(indices, values, shape, ctx)
+    dense = _np.asarray(arg1.asnumpy() if isinstance(arg1, NDArray) else arg1)
+    nz = _np.where(_np.any(dense.reshape(dense.shape[0], -1) != 0, axis=1))[0]
+    return RowSparseNDArray(nz, dense[nz], dense.shape, ctx)
+
+
+def csr_matrix(arg1, shape=None, ctx=None, dtype=None):
+    if isinstance(arg1, tuple) and len(arg1) == 3:
+        data, indices, indptr = arg1
+        return CSRNDArray(_np.asarray(data), _np.asarray(indptr),
+                          _np.asarray(indices), shape, ctx)
+    dense = _np.asarray(arg1.asnumpy() if isinstance(arg1, NDArray) else arg1)
+    if dtype is not None:
+        dense = dense.astype(np_dtype(dtype))
+    import numpy as np
+    indptr = [0]
+    indices = []
+    data = []
+    for r in range(dense.shape[0]):
+        cols = np.where(dense[r] != 0)[0]
+        indices.extend(cols.tolist())
+        data.extend(dense[r, cols].tolist())
+        indptr.append(len(indices))
+    return CSRNDArray(np.asarray(data, dense.dtype), np.asarray(indptr),
+                      np.asarray(indices), dense.shape, ctx)
+
+
+def cast_storage(arr: NDArray, stype: str):
+    """Parity: src/operator/tensor/cast_storage.cc."""
+    if stype == "default":
+        return NDArray(arr._data, arr._ctx)
+    if stype == "row_sparse":
+        return row_sparse_array(arr)
+    if stype == "csr":
+        return csr_matrix(arr)
+    raise MXNetError(f"unknown stype {stype}")
+
+
+def zeros_sparse(stype, shape, ctx=None, dtype=None):
+    if stype == "row_sparse":
+        return RowSparseNDArray(_np.zeros((0,), _np.int64),
+                                _np.zeros((0,) + tuple(shape[1:]), np_dtype(dtype)),
+                                shape, ctx)
+    if stype == "csr":
+        return CSRNDArray(_np.zeros((0,), np_dtype(dtype)),
+                          _np.zeros((shape[0] + 1,), _np.int64),
+                          _np.zeros((0,), _np.int64), shape, ctx)
+    return zeros(shape, ctx=ctx, dtype=dtype)
+
+
+zeros = zeros_sparse

@@ -1,0 +1,191 @@
+"""Elementwise operators (unary, binary broadcast, scalar variants).
+
+Reference parity: `src/operator/tensor/elemwise_unary_op*.cc`,
+`elemwise_binary_{op,broadcast_op}*.cc`, `elemwise_scalar_op*.cc`, and the
+mshadow functor zoo (`src/operator/mshadow_op.h:53-69`).  On TPU each of
+these is one XLA HLO; fusion with neighbors is automatic under jit.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import jax.scipy.special as jsp
+
+from ..base import Arg
+from .registry import register
+
+# ---------------------------------------------------------------------------
+# Unary ops (parity: elemwise_unary_op.cc registrations)
+# ---------------------------------------------------------------------------
+_F32 = jnp.float32
+
+
+def _softrelu(x):
+    return jnp.logaddexp(x, 0.0)
+
+
+def _softsign(x):
+    return x / (1 + jnp.abs(x))
+
+
+_UNARY = {
+    "relu": lambda x: jnp.maximum(x, 0),
+    "sigmoid": jax.nn.sigmoid,
+    "softsign": _softsign,
+    "negative": jnp.negative,
+    "reciprocal": lambda x: 1.0 / x,
+    "abs": jnp.abs,
+    "sign": jnp.sign,
+    "round": jnp.round,
+    "rint": jnp.rint,
+    "ceil": jnp.ceil,
+    "floor": jnp.floor,
+    "trunc": jnp.trunc,
+    "fix": jnp.fix,
+    "square": jnp.square,
+    "sqrt": jnp.sqrt,
+    "rsqrt": lambda x: 1.0 / jnp.sqrt(x),
+    "cbrt": jnp.cbrt,
+    "rcbrt": lambda x: 1.0 / jnp.cbrt(x),
+    "exp": jnp.exp,
+    "log": jnp.log,
+    "log10": jnp.log10,
+    "log2": jnp.log2,
+    "log1p": jnp.log1p,
+    "expm1": jnp.expm1,
+    "gamma": lambda x: jnp.exp(jsp.gammaln(x)),
+    "gammaln": jsp.gammaln,
+    "erf": jsp.erf,
+    "erfinv": jsp.erfinv,
+    "sin": jnp.sin,
+    "cos": jnp.cos,
+    "tan": jnp.tan,
+    "arcsin": jnp.arcsin,
+    "arccos": jnp.arccos,
+    "arctan": jnp.arctan,
+    "degrees": jnp.degrees,
+    "radians": jnp.radians,
+    "sinh": jnp.sinh,
+    "cosh": jnp.cosh,
+    "tanh": jnp.tanh,
+    "arcsinh": jnp.arcsinh,
+    "arccosh": jnp.arccosh,
+    "arctanh": jnp.arctanh,
+    "logical_not": lambda x: (x == 0).astype(x.dtype if jnp.issubdtype(x.dtype, jnp.floating) else _F32),
+}
+
+for _name, _f in _UNARY.items():
+    register(_name, input_names=("data",))(
+        (lambda f: lambda p, x: f(x))(_f))
+
+register("softrelu", input_names=("data",))(lambda p, x: _softrelu(x))
+
+
+@register("_copy", input_names=("data",), aliases=("identity",))
+def _copy(p, x):
+    return x
+
+
+@register("BlockGrad", input_names=("data",), aliases=("stop_gradient",))
+def _block_grad(p, x):
+    """Parity: src/operator/tensor/elemwise_unary_op.cc BlockGrad."""
+    return jax.lax.stop_gradient(x)
+
+
+@register("make_loss", input_names=("data",))
+def _make_loss_op(p, x):
+    return x
+
+
+@register("clip", input_names=("data",),
+          args=[Arg("a_min", float, required=True), Arg("a_max", float, required=True)])
+def _clip(p, x):
+    return jnp.clip(x, p["a_min"], p["a_max"])
+
+
+@register("Cast", input_names=("data",), aliases=("cast",),
+          args=[Arg("dtype", str, required=True)])
+def _cast(p, x):
+    from ..base import np_dtype
+    return x.astype(np_dtype(p["dtype"]))
+
+
+# ---------------------------------------------------------------------------
+# Binary broadcast + same-shape elemwise (parity: elemwise_binary_broadcast_op)
+# ---------------------------------------------------------------------------
+def _bool_out(f):
+    return lambda a, b: f(a, b).astype(jnp.result_type(a, b))
+
+
+_BINARY = {
+    "add": jnp.add,
+    "sub": jnp.subtract,
+    "mul": jnp.multiply,
+    "div": jnp.divide,
+    "mod": jnp.mod,
+    "power": jnp.power,
+    "maximum": jnp.maximum,
+    "minimum": jnp.minimum,
+    "hypot": jnp.hypot,
+    "equal": _bool_out(jnp.equal),
+    "not_equal": _bool_out(jnp.not_equal),
+    "greater": _bool_out(jnp.greater),
+    "greater_equal": _bool_out(jnp.greater_equal),
+    "lesser": _bool_out(jnp.less),
+    "lesser_equal": _bool_out(jnp.less_equal),
+    "logical_and": _bool_out(lambda a, b: (a != 0) & (b != 0)),
+    "logical_or": _bool_out(lambda a, b: (a != 0) | (b != 0)),
+    "logical_xor": _bool_out(lambda a, b: (a != 0) ^ (b != 0)),
+}
+
+_ELEMWISE_ALIAS = {"add": ("elemwise_add", "_plus"), "sub": ("elemwise_sub", "_minus"),
+                   "mul": ("elemwise_mul",), "div": ("elemwise_div",)}
+
+for _name, _f in _BINARY.items():
+    register("broadcast_" + _name, input_names=("lhs", "rhs"),
+             aliases=_ELEMWISE_ALIAS.get(_name, ()))(
+        (lambda f: lambda p, a, b: f(a, b))(_f))
+
+# scalar variants (parity: *_scalar ops, used by NDArray __add__ etc.)
+_SCALAR = {
+    "_plus_scalar": lambda x, s: x + s,
+    "_minus_scalar": lambda x, s: x - s,
+    "_rminus_scalar": lambda x, s: s - x,
+    "_mul_scalar": lambda x, s: x * s,
+    "_div_scalar": lambda x, s: x / s,
+    "_rdiv_scalar": lambda x, s: s / x,
+    "_mod_scalar": lambda x, s: jnp.mod(x, s),
+    "_rmod_scalar": lambda x, s: jnp.mod(jnp.full_like(x, s), x) if False else jnp.mod(s, x),
+    "_power_scalar": lambda x, s: jnp.power(x, s),
+    "_rpower_scalar": lambda x, s: jnp.power(s, x),
+    "_maximum_scalar": lambda x, s: jnp.maximum(x, s),
+    "_minimum_scalar": lambda x, s: jnp.minimum(x, s),
+    "_hypot_scalar": lambda x, s: jnp.hypot(x, jnp.asarray(s, x.dtype)),
+    "_equal_scalar": lambda x, s: (x == s).astype(x.dtype),
+    "_not_equal_scalar": lambda x, s: (x != s).astype(x.dtype),
+    "_greater_scalar": lambda x, s: (x > s).astype(x.dtype),
+    "_greater_equal_scalar": lambda x, s: (x >= s).astype(x.dtype),
+    "_lesser_scalar": lambda x, s: (x < s).astype(x.dtype),
+    "_lesser_equal_scalar": lambda x, s: (x <= s).astype(x.dtype),
+}
+
+for _name, _f in _SCALAR.items():
+    register(_name, input_names=("data",), args=[Arg("scalar", float, required=True)])(
+        (lambda f: lambda p, x: f(x, p["scalar"]))(_f))
+
+
+@register("add_n", input_names=("args",), variadic=True,
+          aliases=("ElementWiseSum", "_sum"))
+def _add_n(p, *xs):
+    """Parity: src/operator/tensor/elemwise_sum.cc."""
+    out = xs[0]
+    for x in xs[1:]:
+        out = out + x
+    return out
+
+
+@register("smooth_l1", input_names=("data",), args=[Arg("scalar", float, 1.0)])
+def _smooth_l1(p, x):
+    s2 = p["scalar"] ** 2
+    absx = jnp.abs(x)
+    return jnp.where(absx < 1.0 / s2, 0.5 * s2 * jnp.square(x), absx - 0.5 / s2)

@@ -1,0 +1,49 @@
+"""Creation operators (parity: src/operator/tensor/init_op.cc)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..base import Arg, np_dtype
+from .registry import register
+
+_CREATE_ARGS = [Arg("shape", "shape", ()), Arg("dtype", str, "float32"),
+                Arg("ctx", str, None)]
+
+
+@register("_zeros", input_names=(), args=list(_CREATE_ARGS), differentiable=False)
+def _zeros(p):
+    return jnp.zeros(p["shape"], np_dtype(p["dtype"]))
+
+
+@register("_ones", input_names=(), args=list(_CREATE_ARGS), differentiable=False)
+def _ones(p):
+    return jnp.ones(p["shape"], np_dtype(p["dtype"]))
+
+
+@register("_full", input_names=(),
+          args=_CREATE_ARGS + [Arg("value", float, required=True)],
+          differentiable=False)
+def _full(p):
+    return jnp.full(p["shape"], p["value"], np_dtype(p["dtype"]))
+
+
+@register("_arange", input_names=(),
+          args=[Arg("start", float, 0.0), Arg("stop", float, None),
+                Arg("step", float, 1.0), Arg("repeat", int, 1),
+                Arg("dtype", str, "float32"), Arg("ctx", str, None),
+                Arg("infer_range", bool, False)],
+          differentiable=False)
+def _arange(p):
+    out = jnp.arange(p["start"], p.get("stop"), p["step"], np_dtype(p["dtype"]))
+    if p["repeat"] > 1:
+        out = jnp.repeat(out, p["repeat"])
+    return out
+
+
+@register("_eye", input_names=(),
+          args=[Arg("N", int, required=True), Arg("M", int, 0), Arg("k", int, 0),
+                Arg("dtype", str, "float32"), Arg("ctx", str, None)],
+          differentiable=False)
+def _eye(p):
+    m = p["M"] or p["N"]
+    return jnp.eye(p["N"], m, k=p["k"], dtype=np_dtype(p["dtype"]))

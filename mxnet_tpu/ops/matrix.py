@@ -1,0 +1,401 @@
+"""Shape/layout/indexing/linear-algebra operators.
+
+Reference parity: `src/operator/tensor/matrix_op*.cc` (Reshape with MXNet's
+special codes, transpose, slice family, Concat, stack, split, tile, repeat,
+reverse, dot/batch_dot), `src/operator/tensor/indexing_op.cc` (take,
+Embedding, one_hot, pick, gather_nd, scatter_nd), `src/operator/tensor/
+control_flow_op.cc` (where), `src/operator/swapaxis.cc`, `src/operator/pad.cc`,
+`src/operator/crop.cc`, `src/operator/slice_channel.cc`.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as _np
+
+from ..base import Arg, MXNetError
+from .registry import register
+
+
+def infer_reshape(shape, src_shape):
+    """MXNet Reshape special codes (parity: matrix_op-inl.h ReshapeParam):
+    0 = copy this dim; -1 = infer; -2 = copy all remaining dims;
+    -3 = merge next two src dims; -4 = split one src dim by the next two
+    target entries."""
+    src = list(src_shape)
+    out = []
+    i = 0  # position in src
+    j = 0  # position in shape spec
+    spec = list(shape)
+    while j < len(spec):
+        s = spec[j]
+        if s > 0:
+            out.append(s)
+            i += 1
+        elif s == 0:
+            out.append(src[i])
+            i += 1
+        elif s == -1:
+            out.append(-1)
+            i += 1
+        elif s == -2:
+            out.extend(src[i:])
+            i = len(src)
+        elif s == -3:
+            out.append(src[i] * src[i + 1])
+            i += 2
+        elif s == -4:
+            d1, d2 = spec[j + 1], spec[j + 2]
+            if d1 == -1:
+                d1 = src[i] // d2
+            if d2 == -1:
+                d2 = src[i] // d1
+            out.extend([d1, d2])
+            j += 2
+            i += 1
+        else:
+            raise MXNetError(f"bad reshape code {s}")
+        j += 1
+    if out.count(-1) > 1:
+        raise MXNetError("only one -1 allowed in reshape")
+    if -1 in out:
+        known = 1
+        for d in out:
+            if d != -1:
+                known *= d
+        total = 1
+        for d in src:
+            total *= d
+        out[out.index(-1)] = total // max(known, 1)
+    return tuple(out)
+
+
+@register("Reshape", input_names=("data",), aliases=("reshape",),
+          args=[Arg("shape", "shape", ()), Arg("reverse", bool, False)])
+def _reshape(p, x):
+    return jnp.reshape(x, infer_reshape(p["shape"], x.shape))
+
+
+@register("Flatten", input_names=("data",), aliases=("flatten",))
+def _flatten(p, x):
+    return jnp.reshape(x, (x.shape[0], -1))
+
+
+@register("transpose", input_names=("data",), args=[Arg("axes", "shape", ())])
+def _transpose(p, x):
+    axes = p["axes"] or None
+    return jnp.transpose(x, axes)
+
+
+@register("expand_dims", input_names=("data",), args=[Arg("axis", int, required=True)])
+def _expand_dims(p, x):
+    return jnp.expand_dims(x, p["axis"])
+
+
+@register("squeeze", input_names=("data",), args=[Arg("axis", "shape", None)])
+def _squeeze(p, x):
+    ax = p.get("axis")
+    return jnp.squeeze(x, axis=tuple(a % x.ndim for a in ax) if ax else None)
+
+
+@register("SwapAxis", input_names=("data",), aliases=("swapaxes",),
+          args=[Arg("dim1", int, 0), Arg("dim2", int, 0)])
+def _swapaxes(p, x):
+    return jnp.swapaxes(x, p["dim1"], p["dim2"])
+
+
+def _canon_slice(begin, end, step, shape):
+    """Normalize MXNet slice params (None/negative entries) to concrete starts/stops."""
+    ndim = len(shape)
+    step = step or (1,) * len(begin)
+    starts, stops, strides = [], [], []
+    for ax in range(ndim):
+        if ax < len(begin):
+            b = begin[ax]
+            e = end[ax] if ax < len(end) else None
+            s = step[ax] if ax < len(step) else 1
+        else:
+            b, e, s = None, None, 1
+        s = 1 if s in (None, 0) else s
+        sl = slice(b, e, s).indices(shape[ax])
+        starts.append(sl[0]); stops.append(sl[1]); strides.append(sl[2])
+    return starts, stops, strides
+
+
+@register("slice", input_names=("data",), aliases=("crop",),
+          args=[Arg("begin", "shape", required=True), Arg("end", "shape", required=True),
+                Arg("step", "shape", None)])
+def _slice(p, x):
+    starts, stops, strides = _canon_slice(p["begin"], p["end"], p.get("step"), x.shape)
+    return x[tuple(slice(b, e, s) for b, e, s in zip(starts, stops, strides))]
+
+
+@register("slice_axis", input_names=("data",),
+          args=[Arg("axis", int, required=True), Arg("begin", int, required=True),
+                Arg("end", int, None)])
+def _slice_axis(p, x):
+    ax = p["axis"] % x.ndim
+    idx = [slice(None)] * x.ndim
+    idx[ax] = slice(p["begin"], p["end"])
+    return x[tuple(idx)]
+
+
+@register("slice_like", input_names=("data", "shape_like"),
+          args=[Arg("axes", "shape", ())])
+def _slice_like(p, x, y):
+    axes = p["axes"] or tuple(range(min(x.ndim, y.ndim)))
+    idx = [slice(None)] * x.ndim
+    for a in axes:
+        idx[a % x.ndim] = slice(0, y.shape[a % x.ndim])
+    return x[tuple(idx)]
+
+
+@register("Concat", input_names=("args",), variadic=True, aliases=("concat",),
+          args=[Arg("dim", int, 1), Arg("num_args", int, 0)])
+def _concat(p, *xs):
+    return jnp.concatenate(xs, axis=p["dim"])
+
+
+@register("stack", input_names=("args",), variadic=True,
+          args=[Arg("axis", int, 0), Arg("num_args", int, 0)])
+def _stack(p, *xs):
+    return jnp.stack(xs, axis=p["axis"])
+
+
+@register("SliceChannel", input_names=("data",), aliases=("split",),
+          args=[Arg("num_outputs", int, required=True), Arg("axis", int, 1),
+                Arg("squeeze_axis", bool, False)],
+          num_outputs=-1)
+def _slice_channel(p, x):
+    parts = jnp.split(x, p["num_outputs"], axis=p["axis"])
+    if p["squeeze_axis"]:
+        parts = [jnp.squeeze(t, axis=p["axis"]) for t in parts]
+    return tuple(parts)
+
+
+@register("tile", input_names=("data",), args=[Arg("reps", "shape", required=True)])
+def _tile(p, x):
+    return jnp.tile(x, p["reps"])
+
+
+@register("repeat", input_names=("data",),
+          args=[Arg("repeats", int, required=True), Arg("axis", int, None)])
+def _repeat(p, x):
+    return jnp.repeat(x, p["repeats"], axis=p.get("axis"))
+
+
+@register("reverse", input_names=("data",), aliases=("flip",),
+          args=[Arg("axis", "shape", required=True)])
+def _reverse(p, x):
+    return jnp.flip(x, axis=p["axis"])
+
+
+@register("Pad", input_names=("data",), aliases=("pad",),
+          args=[Arg("mode", str, "constant"), Arg("pad_width", "shape", required=True),
+                Arg("constant_value", float, 0.0)])
+def _pad(p, x):
+    pw = p["pad_width"]
+    pairs = [(pw[2 * i], pw[2 * i + 1]) for i in range(len(pw) // 2)]
+    mode = {"constant": "constant", "edge": "edge", "reflect": "reflect"}[p["mode"]]
+    if mode == "constant":
+        return jnp.pad(x, pairs, mode="constant", constant_values=p["constant_value"])
+    return jnp.pad(x, pairs, mode=mode)
+
+
+@register("broadcast_to", input_names=("data",), args=[Arg("shape", "shape", required=True)])
+def _broadcast_to(p, x):
+    tgt = tuple(s if s != 0 else x.shape[i] for i, s in enumerate(p["shape"]))
+    return jnp.broadcast_to(x, tgt)
+
+
+@register("broadcast_axis", input_names=("data",), aliases=("broadcast_axes",),
+          args=[Arg("axis", "shape", ()), Arg("size", "shape", ())])
+def _broadcast_axis(p, x):
+    tgt = list(x.shape)
+    for a, s in zip(p["axis"], p["size"]):
+        tgt[a % x.ndim] = s
+    return jnp.broadcast_to(x, tuple(tgt))
+
+
+@register("broadcast_like", input_names=("lhs", "rhs"))
+def _broadcast_like(p, x, y):
+    return jnp.broadcast_to(x, y.shape)
+
+
+@register("zeros_like", input_names=("data",))
+def _zeros_like(p, x):
+    return jnp.zeros_like(x)
+
+
+@register("ones_like", input_names=("data",))
+def _ones_like(p, x):
+    return jnp.ones_like(x)
+
+
+@register("where", input_names=("condition", "x", "y"))
+def _where(p, c, x, y):
+    return jnp.where(c != 0 if c.dtype != jnp.bool_ else c, x, y)
+
+
+# ---------------------------------------------------------------------------
+# dot / batch_dot — straight to the MXU
+# ---------------------------------------------------------------------------
+@register("dot", input_names=("lhs", "rhs"),
+          args=[Arg("transpose_a", bool, False), Arg("transpose_b", bool, False)])
+def _dot(p, a, b):
+    """Parity: src/operator/tensor/dot-inl.h (dense path).
+
+    MXNet dot on >2-D: reshapes lhs to (prod(shape[:-1]), shape[-1]) matrix
+    semantics; we use tensordot over last/first axes which matches the
+    reference's documented behavior for ndim>2."""
+    if p["transpose_a"]:
+        a = jnp.moveaxis(a, -1, 0) if a.ndim > 2 else a.T
+    if p["transpose_b"]:
+        b = jnp.moveaxis(b, 0, -1) if b.ndim > 2 else b.T
+    if a.ndim <= 2 and b.ndim <= 2:
+        return jnp.matmul(a, b)
+    return jnp.tensordot(a, b, axes=1)
+
+
+@register("batch_dot", input_names=("lhs", "rhs"),
+          args=[Arg("transpose_a", bool, False), Arg("transpose_b", bool, False)])
+def _batch_dot(p, a, b):
+    if p["transpose_a"]:
+        a = jnp.swapaxes(a, -1, -2)
+    if p["transpose_b"]:
+        b = jnp.swapaxes(b, -1, -2)
+    return jnp.matmul(a, b)
+
+
+# ---------------------------------------------------------------------------
+# Indexing (parity: src/operator/tensor/indexing_op.cc)
+# ---------------------------------------------------------------------------
+@register("take", input_names=("a", "indices"),
+          args=[Arg("axis", int, 0), Arg("mode", str, "clip")])
+def _take(p, a, idx):
+    mode = {"clip": "clip", "wrap": "wrap", "raise": "clip"}[p["mode"]]
+    return jnp.take(a, idx.astype(jnp.int32), axis=p["axis"], mode=mode)
+
+
+@register("batch_take", input_names=("a", "indices"))
+def _batch_take(p, a, idx):
+    return jnp.take_along_axis(a, idx.astype(jnp.int32)[:, None], axis=1)[:, 0]
+
+
+@register("Embedding", input_names=("data", "weight"),
+          args=[Arg("input_dim", int, required=True), Arg("output_dim", int, required=True),
+                Arg("dtype", str, "float32"), Arg("sparse_grad", bool, False)])
+def _embedding(p, data, weight):
+    """Embedding lookup; grad wrt weight is a scatter-add via jax.vjp
+    (parity: indexing_op.h EmbeddingOpForward/Backward)."""
+    return jnp.take(weight, data.astype(jnp.int32), axis=0, mode="clip")
+
+
+@register("one_hot", input_names=("indices",),
+          args=[Arg("depth", int, required=True), Arg("on_value", float, 1.0),
+                Arg("off_value", float, 0.0), Arg("dtype", str, "float32")],
+          differentiable=False)
+def _one_hot(p, idx):
+    from ..base import np_dtype
+    oh = jax.nn.one_hot(idx.astype(jnp.int32), p["depth"])
+    out = oh * (p["on_value"] - p["off_value"]) + p["off_value"]
+    return out.astype(np_dtype(p["dtype"]))
+
+
+@register("pick", input_names=("data", "index"),
+          args=[Arg("axis", int, -1), Arg("keepdims", bool, False),
+                Arg("mode", str, "clip")])
+def _pick(p, x, idx):
+    ax = p["axis"] % x.ndim
+    idxe = jnp.expand_dims(idx.astype(jnp.int32), ax)
+    out = jnp.take_along_axis(x, jnp.clip(idxe, 0, x.shape[ax] - 1), axis=ax)
+    return out if p["keepdims"] else jnp.squeeze(out, axis=ax)
+
+
+@register("gather_nd", input_names=("data", "indices"))
+def _gather_nd(p, data, indices):
+    idx = indices.astype(jnp.int32)
+    m = idx.shape[0]
+    return data[tuple(idx[i] for i in range(m))]
+
+
+@register("scatter_nd", input_names=("data", "indices"),
+          args=[Arg("shape", "shape", required=True)])
+def _scatter_nd(p, data, indices):
+    idx = indices.astype(jnp.int32)
+    out = jnp.zeros(p["shape"], data.dtype)
+    return out.at[tuple(idx[i] for i in range(idx.shape[0]))].add(data)
+
+
+# ---------------------------------------------------------------------------
+# Linear algebra (parity: src/operator/tensor/la_op.cc — subset)
+# ---------------------------------------------------------------------------
+@register("linalg_gemm", input_names=("A", "B", "C"),
+          args=[Arg("transpose_a", bool, False), Arg("transpose_b", bool, False),
+                Arg("alpha", float, 1.0), Arg("beta", float, 1.0)])
+def _linalg_gemm(p, a, b, c):
+    if p["transpose_a"]:
+        a = jnp.swapaxes(a, -1, -2)
+    if p["transpose_b"]:
+        b = jnp.swapaxes(b, -1, -2)
+    return p["alpha"] * jnp.matmul(a, b) + p["beta"] * c
+
+
+@register("linalg_gemm2", input_names=("A", "B"),
+          args=[Arg("transpose_a", bool, False), Arg("transpose_b", bool, False),
+                Arg("alpha", float, 1.0)])
+def _linalg_gemm2(p, a, b):
+    if p["transpose_a"]:
+        a = jnp.swapaxes(a, -1, -2)
+    if p["transpose_b"]:
+        b = jnp.swapaxes(b, -1, -2)
+    return p["alpha"] * jnp.matmul(a, b)
+
+
+@register("linalg_potrf", input_names=("A",))
+def _linalg_potrf(p, a):
+    return jnp.linalg.cholesky(a)
+
+
+@register("linalg_potri", input_names=("A",))
+def _linalg_potri(p, a):
+    inv = jax.scipy.linalg.cho_solve((a, True), jnp.broadcast_to(
+        jnp.eye(a.shape[-1], dtype=a.dtype), a.shape))
+    return inv
+
+
+@register("linalg_trsm", input_names=("A", "B"),
+          args=[Arg("transpose", bool, False), Arg("rightside", bool, False),
+                Arg("alpha", float, 1.0), Arg("lower", bool, True)])
+def _linalg_trsm(p, a, b):
+    tri = jax.scipy.linalg.solve_triangular
+    if p["rightside"]:
+        out = tri(jnp.swapaxes(a, -1, -2), jnp.swapaxes(b, -1, -2),
+                  lower=not p["lower"], trans=1 if p["transpose"] else 0)
+        out = jnp.swapaxes(out, -1, -2)
+    else:
+        out = tri(a, b, lower=p["lower"], trans=1 if p["transpose"] else 0)
+    return p["alpha"] * out
+
+
+@register("linalg_trmm", input_names=("A", "B"),
+          args=[Arg("transpose", bool, False), Arg("rightside", bool, False),
+                Arg("alpha", float, 1.0), Arg("lower", bool, True)])
+def _linalg_trmm(p, a, b):
+    tril = jnp.tril(a) if p["lower"] else jnp.triu(a)
+    if p["transpose"]:
+        tril = jnp.swapaxes(tril, -1, -2)
+    out = jnp.matmul(b, tril) if p["rightside"] else jnp.matmul(tril, b)
+    return p["alpha"] * out
+
+
+@register("linalg_sumlogdiag", input_names=("A",))
+def _linalg_sumlogdiag(p, a):
+    return jnp.sum(jnp.log(jnp.diagonal(a, axis1=-2, axis2=-1)), axis=-1)
+
+
+@register("linalg_syrk", input_names=("A",),
+          args=[Arg("transpose", bool, False), Arg("alpha", float, 1.0)])
+def _linalg_syrk(p, a):
+    at = jnp.swapaxes(a, -1, -2)
+    return p["alpha"] * (jnp.matmul(at, a) if p["transpose"] else jnp.matmul(a, at))

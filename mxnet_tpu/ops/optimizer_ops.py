@@ -1,0 +1,116 @@
+"""Fused optimizer-update operators (parity: src/operator/optimizer_op.cc:39-287).
+
+Each op mutates its weight (and state) inputs in place at the NDArray layer;
+under jit the whole update fuses into one XLA kernel with donated buffers —
+the TPU analog of the reference's fused CUDA update kernels.  `mp_*` variants
+keep float32 master weights for low-precision training (the precedent for
+bf16-on-TPU training).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..base import Arg
+from .registry import register
+
+_COMMON = [Arg("lr", float, required=True), Arg("wd", float, 0.0),
+           Arg("rescale_grad", float, 1.0), Arg("clip_gradient", float, -1.0)]
+
+
+def _prep_grad(p, grad, dtype=None):
+    g = grad * p["rescale_grad"]
+    if p["clip_gradient"] > 0:
+        g = jnp.clip(g, -p["clip_gradient"], p["clip_gradient"])
+    return g.astype(dtype) if dtype is not None else g
+
+
+@register("sgd_update", input_names=("weight", "grad"), args=list(_COMMON),
+          mutates_input=0, differentiable=False)
+def _sgd_update(p, weight, grad):
+    g = _prep_grad(p, grad, weight.dtype)
+    return weight - p["lr"] * (g + p["wd"] * weight)
+
+
+@register("sgd_mom_update", input_names=("weight", "grad", "mom"),
+          args=_COMMON + [Arg("momentum", float, 0.0)],
+          mutates_input=0, num_outputs=1, aux_inputs=[2], differentiable=False)
+def _sgd_mom_update(p, weight, grad, mom):
+    g = _prep_grad(p, grad, weight.dtype)
+    new_mom = p["momentum"] * mom - p["lr"] * (g + p["wd"] * weight)
+    return weight + new_mom, new_mom
+
+
+@register("mp_sgd_update", input_names=("weight", "grad", "weight32"),
+          args=list(_COMMON), mutates_input=0, aux_inputs=[2], differentiable=False)
+def _mp_sgd_update(p, weight, grad, weight32):
+    """fp16/bf16 weights with fp32 master copy (parity: optimizer_op.cc:111)."""
+    g = _prep_grad(p, grad.astype(jnp.float32))
+    new_w32 = weight32 - p["lr"] * (g + p["wd"] * weight32)
+    return new_w32.astype(weight.dtype), new_w32
+
+
+@register("mp_sgd_mom_update", input_names=("weight", "grad", "mom", "weight32"),
+          args=_COMMON + [Arg("momentum", float, 0.0)],
+          mutates_input=0, aux_inputs=[2, 3], differentiable=False)
+def _mp_sgd_mom_update(p, weight, grad, mom, weight32):
+    g = _prep_grad(p, grad.astype(jnp.float32))
+    new_mom = p["momentum"] * mom - p["lr"] * (g + p["wd"] * weight32)
+    new_w32 = weight32 + new_mom
+    return new_w32.astype(weight.dtype), new_mom, new_w32
+
+
+@register("adam_update", input_names=("weight", "grad", "mean", "var"),
+          args=_COMMON + [Arg("beta1", float, 0.9), Arg("beta2", float, 0.999),
+                          Arg("epsilon", float, 1e-8)],
+          mutates_input=0, aux_inputs=[2, 3], differentiable=False)
+def _adam_update(p, weight, grad, mean, var):
+    g = _prep_grad(p, grad, weight.dtype) + p["wd"] * weight
+    new_mean = p["beta1"] * mean + (1 - p["beta1"]) * g
+    new_var = p["beta2"] * var + (1 - p["beta2"]) * jnp.square(g)
+    out = weight - p["lr"] * new_mean / (jnp.sqrt(new_var) + p["epsilon"])
+    return out, new_mean, new_var
+
+
+@register("rmsprop_update", input_names=("weight", "grad", "n"),
+          args=_COMMON + [Arg("gamma1", float, 0.95), Arg("epsilon", float, 1e-8),
+                          Arg("clip_weights", float, -1.0)],
+          mutates_input=0, aux_inputs=[2], differentiable=False)
+def _rmsprop_update(p, weight, grad, n):
+    g = _prep_grad(p, grad, weight.dtype) + p["wd"] * weight
+    new_n = (1 - p["gamma1"]) * jnp.square(g) + p["gamma1"] * n
+    out = weight - p["lr"] * g / jnp.sqrt(new_n + p["epsilon"])
+    if p["clip_weights"] > 0:
+        out = jnp.clip(out, -p["clip_weights"], p["clip_weights"])
+    return out, new_n
+
+
+@register("rmspropalex_update", input_names=("weight", "grad", "n", "g", "delta"),
+          args=_COMMON + [Arg("gamma1", float, 0.95), Arg("gamma2", float, 0.9),
+                          Arg("epsilon", float, 1e-8), Arg("clip_weights", float, -1.0)],
+          mutates_input=0, aux_inputs=[2, 3, 4], differentiable=False)
+def _rmspropalex_update(p, weight, grad, n, gbar, delta):
+    g = _prep_grad(p, grad, weight.dtype) + p["wd"] * weight
+    new_n = (1 - p["gamma1"]) * jnp.square(g) + p["gamma1"] * n
+    new_g = (1 - p["gamma1"]) * g + p["gamma1"] * gbar
+    new_delta = p["gamma2"] * delta - p["lr"] * g / jnp.sqrt(
+        new_n - jnp.square(new_g) + p["epsilon"])
+    out = weight + new_delta
+    if p["clip_weights"] > 0:
+        out = jnp.clip(out, -p["clip_weights"], p["clip_weights"])
+    return out, new_n, new_g, new_delta
+
+
+@register("ftrl_update", input_names=("weight", "grad", "z", "n"),
+          args=_COMMON + [Arg("lamda1", float, 0.01), Arg("beta", float, 1.0)],
+          mutates_input=0, aux_inputs=[2, 3], differentiable=False)
+def _ftrl_update(p, weight, grad, z, n):
+    g = _prep_grad(p, grad, weight.dtype)
+    new_n = n + jnp.square(g)
+    sigma = (jnp.sqrt(new_n) - jnp.sqrt(n)) / p["lr"]
+    new_z = z + g - sigma * weight
+    out = jnp.where(
+        jnp.abs(new_z) <= p["lamda1"],
+        jnp.zeros_like(weight),
+        (jnp.sign(new_z) * p["lamda1"] - new_z) /
+        ((p["beta"] + jnp.sqrt(new_n)) / p["lr"] + p["wd"]))
+    return out, new_z, new_n

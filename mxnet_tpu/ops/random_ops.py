@@ -1,0 +1,136 @@
+"""Random sampling operators (parity: src/operator/random/).
+
+The reference gives each op a per-device PRNG via ResourceRequest::kRandom
+(include/mxnet/resource.h:37); here each sampling op receives an explicit
+jax PRNG key (appended input, split from the framework-global key stream in
+`mxnet_tpu.random`) — functional, reproducible, trace-safe.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..base import Arg, np_dtype
+from .registry import register
+
+_SHAPE_ARGS = [Arg("shape", "shape", ()), Arg("dtype", str, "float32"),
+               Arg("ctx", str, None)]
+
+
+def _shp(p):
+    return p["shape"] or ()
+
+
+@register("_random_uniform", input_names=(), needs_rng=True, differentiable=False,
+          args=_SHAPE_ARGS + [Arg("low", float, 0.0), Arg("high", float, 1.0)],
+          aliases=("uniform", "random_uniform"))
+def _uniform(p, key):
+    return jax.random.uniform(key, _shp(p), np_dtype(p["dtype"]), p["low"], p["high"])
+
+
+@register("_random_normal", input_names=(), needs_rng=True, differentiable=False,
+          args=_SHAPE_ARGS + [Arg("loc", float, 0.0), Arg("scale", float, 1.0)],
+          aliases=("normal", "random_normal"))
+def _normal(p, key):
+    return p["loc"] + p["scale"] * jax.random.normal(key, _shp(p), np_dtype(p["dtype"]))
+
+
+@register("_random_gamma", input_names=(), needs_rng=True, differentiable=False,
+          args=_SHAPE_ARGS + [Arg("alpha", float, 1.0), Arg("beta", float, 1.0)],
+          aliases=("random_gamma",))
+def _gamma(p, key):
+    return p["beta"] * jax.random.gamma(key, p["alpha"], _shp(p), np_dtype(p["dtype"]))
+
+
+@register("_random_exponential", input_names=(), needs_rng=True, differentiable=False,
+          args=_SHAPE_ARGS + [Arg("lam", float, 1.0)],
+          aliases=("random_exponential",))
+def _exponential(p, key):
+    return jax.random.exponential(key, _shp(p), np_dtype(p["dtype"])) / p["lam"]
+
+
+@register("_random_poisson", input_names=(), needs_rng=True, differentiable=False,
+          args=_SHAPE_ARGS + [Arg("lam", float, 1.0)],
+          aliases=("random_poisson",))
+def _poisson(p, key):
+    return jax.random.poisson(key, p["lam"], _shp(p)).astype(np_dtype(p["dtype"]))
+
+
+@register("_random_negative_binomial", input_names=(), needs_rng=True,
+          differentiable=False,
+          args=_SHAPE_ARGS + [Arg("k", int, 1), Arg("p", float, 1.0)],
+          aliases=("random_negative_binomial",))
+def _neg_binomial(p, key):
+    k1, k2 = jax.random.split(key)
+    lam = jax.random.gamma(k1, p["k"], _shp(p)) * (1 - p["p"]) / p["p"]
+    return jax.random.poisson(k2, lam, _shp(p)).astype(np_dtype(p["dtype"]))
+
+
+@register("_random_generalized_negative_binomial", input_names=(), needs_rng=True,
+          differentiable=False,
+          args=_SHAPE_ARGS + [Arg("mu", float, 1.0), Arg("alpha", float, 1.0)],
+          aliases=("random_generalized_negative_binomial",))
+def _gen_neg_binomial(p, key):
+    k1, k2 = jax.random.split(key)
+    a = 1.0 / max(p["alpha"], 1e-12)
+    lam = jax.random.gamma(k1, a, _shp(p)) * p["mu"] / a
+    return jax.random.poisson(k2, lam, _shp(p)).astype(np_dtype(p["dtype"]))
+
+
+@register("_random_randint", input_names=(), needs_rng=True, differentiable=False,
+          args=[Arg("low", int, 0), Arg("high", int, required=True),
+                Arg("shape", "shape", ()), Arg("dtype", str, "int32"),
+                Arg("ctx", str, None)],
+          aliases=("random_randint",))
+def _randint(p, key):
+    return jax.random.randint(key, _shp(p), p["low"], p["high"],
+                              np_dtype(p["dtype"]))
+
+
+@register("_sample_multinomial", input_names=("data",), needs_rng=True,
+          differentiable=False,
+          args=[Arg("shape", "shape", ()), Arg("get_prob", bool, False),
+                Arg("dtype", str, "int32")],
+          aliases=("sample_multinomial",))
+def _multinomial(p, data, key):
+    n = 1
+    for d in (p["shape"] or (1,)):
+        n *= d
+    logits = jnp.log(jnp.maximum(data, 1e-30))
+    if data.ndim == 1:
+        out = jax.random.categorical(key, logits, shape=(n,))
+        out = out.reshape(p["shape"] or ())
+    else:
+        out = jax.random.categorical(key, logits[:, None, :], axis=-1,
+                                     shape=(data.shape[0], n))
+        out = out.reshape((data.shape[0],) + (p["shape"] or ()))
+    return out.astype(np_dtype(p["dtype"]))
+
+
+@register("_shuffle", input_names=("data",), needs_rng=True, differentiable=False,
+          aliases=("shuffle",))
+def _shuffle(p, data, key):
+    return jax.random.permutation(key, data, axis=0)
+
+
+# sample_* ops: per-element distribution parameters as tensor inputs
+@register("_sample_uniform", input_names=("low", "high"), needs_rng=True,
+          differentiable=False,
+          args=[Arg("shape", "shape", ()), Arg("dtype", str, "float32")],
+          aliases=("sample_uniform",))
+def _sample_uniform(p, low, high, key):
+    shp = low.shape + (p["shape"] or ())
+    u = jax.random.uniform(key, shp, np_dtype(p["dtype"]))
+    bs = low.shape + (1,) * len(p["shape"] or ())
+    return low.reshape(bs) + u * (high - low).reshape(bs)
+
+
+@register("_sample_normal", input_names=("mu", "sigma"), needs_rng=True,
+          differentiable=False,
+          args=[Arg("shape", "shape", ()), Arg("dtype", str, "float32")],
+          aliases=("sample_normal",))
+def _sample_normal(p, mu, sigma, key):
+    shp = mu.shape + (p["shape"] or ())
+    z = jax.random.normal(key, shp, np_dtype(p["dtype"]))
+    bs = mu.shape + (1,) * len(p["shape"] or ())
+    return mu.reshape(bs) + z * sigma.reshape(bs)

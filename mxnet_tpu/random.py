@@ -1,0 +1,42 @@
+"""Framework-global PRNG key stream (parity: python/mxnet/random.py + the
+per-device ResourceManager kRandom resource, src/resource.cc:85-147).
+
+Functional JAX keys replace stateful per-device generators: `seed(n)` resets
+the root key; every eager random op consumes one split.  Graph executors fold
+a per-run key by node id instead (trace-safe).
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+
+_state = threading.local()
+_DEFAULT_SEED = 0
+
+
+def _get():
+    if not hasattr(_state, "key"):
+        _state.key = jax.random.PRNGKey(_DEFAULT_SEED)
+    return _state.key
+
+
+def seed(seed_state: int) -> None:
+    """Seed the framework RNG (parity: mx.random.seed / MXRandomSeed)."""
+    _state.key = jax.random.PRNGKey(int(seed_state))
+
+
+def next_key():
+    key = _get()
+    _state.key, sub = jax.random.split(key)
+    return sub
+
+
+# nd-level sampling functions are attached in ndarray.random (autogen);
+# keep module-level aliases for mx.random.uniform(...) etc.
+def __getattr__(name):
+    from . import ndarray
+    fn = getattr(ndarray.random, name, None)
+    if fn is None:
+        raise AttributeError(name)
+    return fn
