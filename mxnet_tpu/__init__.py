@@ -25,3 +25,11 @@ from . import ndarray as nd
 from .ndarray import NDArray
 from . import random
 from . import autograd
+from . import attribute
+from .attribute import AttrScope
+from . import name
+from .name import NameManager
+from . import symbol
+from . import symbol as sym
+from .symbol import Symbol
+from . import executor

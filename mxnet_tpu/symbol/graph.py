@@ -1,0 +1,320 @@
+"""Graph plan + pure-JAX interpreter + shape/type inference.
+
+Reference parity: this module replaces the nnvm pass machinery the
+GraphExecutor drove (`src/executor/graph_executor.cc`): InferShape/InferType
+(:597) become incremental `jax.eval_shape` over the plan; PlanMemory /
+AttachOpExecs / op bulking are all subsumed by tracing `run()` under one
+`jax.jit` (XLA plans memory and fuses).  Parameter-shape hooks reproduce the
+reference ops' InferShape for auto-created weights (e.g. FC weight from
+num_hidden × flattened data — src/operator/nn/fully_connected-inl.h).
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as _np
+
+from ..base import MXNetError, np_dtype
+from ..ops import registry as _reg
+from ..ops.sequence import rnn_param_size, _GATES
+from .symbol import Symbol, _Node, _truthy
+
+
+def _prod(xs):
+    out = 1
+    for x in xs:
+        out *= x
+    return out
+
+
+# op name -> fn(params, in_shapes) -> {input_index: shape} for unknown-var fill
+def _fc_hook(p, shp):
+    d = shp[0]
+    red = _prod(d[1:]) if p.get("flatten", True) else d[-1]
+    out = {1: (p["num_hidden"], red)}
+    if not p.get("no_bias"):
+        out[2] = (p["num_hidden"],)
+    return out
+
+
+def _conv_hook(p, shp):
+    d = shp[0]
+    out = {1: (p["num_filter"], d[1] // p.get("num_group", 1)) + tuple(p["kernel"])}
+    if not p.get("no_bias"):
+        out[2] = (p["num_filter"],)
+    return out
+
+
+def _deconv_hook(p, shp):
+    d = shp[0]
+    out = {1: (d[1], p["num_filter"] // p.get("num_group", 1)) + tuple(p["kernel"])}
+    if not p.get("no_bias"):
+        out[2] = (p["num_filter"],)
+    return out
+
+
+def _bn_hook(p, shp):
+    c = shp[0][p.get("axis", 1) % len(shp[0])]
+    return {1: (c,), 2: (c,), 3: (c,), 4: (c,)}
+
+
+def _in_hook(p, shp):
+    c = shp[0][1]
+    return {1: (c,), 2: (c,)}
+
+
+def _ln_hook(p, shp):
+    c = shp[0][p.get("axis", -1) % len(shp[0])]
+    return {1: (c,), 2: (c,)}
+
+
+def _emb_hook(p, shp):
+    return {1: (p["input_dim"], p["output_dim"])}
+
+
+def _rnn_hook(p, shp):
+    T, B, I = shp[0]
+    L, H = p["num_layers"], p["state_size"]
+    d = 2 if p.get("bidirectional") else 1
+    out = {1: (rnn_param_size(L, I, H, bool(p.get("bidirectional")), p["mode"]),),
+           2: (L * d, B, H)}
+    if p["mode"] == "lstm":
+        out[3] = (L * d, B, H)
+    return out
+
+
+def _prelu_hook(p, shp):
+    if p.get("act_type") == "prelu" and len(shp) > 1:
+        return {1: (shp[0][1] if len(shp[0]) > 1 else shp[0][0],)}
+    return {}
+
+
+PARAM_SHAPE_HOOKS: Dict[str, Callable] = {
+    "FullyConnected": _fc_hook,
+    "Convolution": _conv_hook,
+    "Deconvolution": _deconv_hook,
+    "BatchNorm": _bn_hook,
+    "InstanceNorm": _in_hook,
+    "LayerNorm": _ln_hook,
+    "Embedding": _emb_hook,
+    "RNN": _rnn_hook,
+    "LeakyReLU": _prelu_hook,
+}
+
+
+class _Step:
+    __slots__ = ("node", "op", "params", "in_refs", "out_base", "aux_var_names")
+
+    def __init__(self, node, op, params, in_refs, out_base, aux_var_names):
+        self.node = node
+        self.op = op
+        self.params = params      # normalized dict (without __is_train__)
+        self.in_refs = in_refs    # list of ('var', name) | ('val', (step_idx, out_idx))
+        self.out_base = out_base  # index into the flat value table
+        self.aux_var_names = aux_var_names  # input-aux-slot -> var name (or None)
+
+
+class GraphPlan:
+    """Topologically-ordered executable plan for a Symbol."""
+
+    def __init__(self, symbol: Symbol):
+        self.symbol = symbol
+        nodes = symbol._topo()
+        self.arg_names = symbol.list_arguments()
+        self.aux_names = symbol.list_auxiliary_states()
+        self.input_names = set(self.arg_names) | set(self.aux_names)
+        node_out: Dict[int, Any] = {}
+        self.steps: List[_Step] = []
+        for n in nodes:
+            if n.is_var:
+                node_out[id(n)] = ("var", n.name)
+                continue
+            op = _reg.get_op(n.op)
+            params = dict(op.normalize(_canon_params(op, n, len(n.inputs))))
+            in_refs = []
+            for src, oi in n.inputs:
+                ref = node_out[id(src)]
+                if ref[0] == "var":
+                    in_refs.append(ref)
+                else:
+                    in_refs.append(("val", (ref[1], oi)))
+            aux_map = {}
+            for pos, ai in enumerate(op.aux_inputs):
+                if ai < len(n.inputs) and n.inputs[ai][0].is_var:
+                    aux_map[pos] = n.inputs[ai][0].name
+            step_idx = len(self.steps)
+            self.steps.append(_Step(n, op, params, in_refs, step_idx, aux_map))
+            node_out[id(n)] = ("step", step_idx)
+        # map output entries
+        self.out_refs = []
+        for node, oi in symbol._entries:
+            ref = node_out[id(node)]
+            if ref[0] == "var":
+                self.out_refs.append(("var", node.name))
+            else:
+                self.out_refs.append(("val", (ref[1], oi)))
+
+    # -- execution (pure; call under jit) -----------------------------------
+    def run(self, arg_values: Dict[str, Any], aux_values: Dict[str, Any],
+            key, is_train: bool):
+        """Execute the graph. Returns (outputs, new_aux_values)."""
+        values: List[Tuple] = [None] * len(self.steps)
+        new_aux = dict(aux_values)
+
+        def resolve(ref):
+            if ref[0] == "var":
+                nm = ref[1]
+                if nm in arg_values:
+                    return arg_values[nm]
+                if nm in new_aux:
+                    return new_aux[nm]
+                raise MXNetError(f"unbound variable '{nm}'")
+            si, oi = ref[1]
+            return values[si][oi]
+
+        for si, step in enumerate(self.steps):
+            ins = [resolve(r) for r in step.in_refs]
+            p = dict(step.params)
+            if step.op.takes_is_train:
+                p["__is_train__"] = is_train
+            if step.op.needs_rng:
+                ins.append(jax.random.fold_in(key, si))
+            out = step.op.fn(p, *ins)
+            out = out if isinstance(out, tuple) else (out,)
+            n_vis = len(out) - len(step.op.aux_inputs)
+            values[si] = out[:n_vis]
+            for pos, nm in step.aux_var_names.items():
+                new_aux[nm] = out[n_vis + pos]
+        outputs = [resolve(r) for r in self.out_refs]
+        return outputs, new_aux
+
+
+def _canon_params(op, node, n_inputs):
+    p = {}
+    for k, v in node.params.items():
+        if k in op.schema.args:
+            p[k] = v
+    if op.variadic and "num_args" in op.schema.args:
+        p["num_args"] = n_inputs
+    return p
+
+
+# ---------------------------------------------------------------------------
+# shape / type inference
+# ---------------------------------------------------------------------------
+def _node_eval_shape(op, params, in_structs):
+    p = dict(params)
+    if op.takes_is_train:
+        p["__is_train__"] = False
+    args = list(in_structs)
+    if op.needs_rng:
+        args.append(jax.random.PRNGKey(0))
+
+    def f(*ins):
+        out = op.fn(p, *ins)
+        return out if isinstance(out, tuple) else (out,)
+
+    return jax.eval_shape(f, *args)
+
+
+def infer_shapes_types(symbol: Symbol, known_shapes: Dict[str, tuple],
+                       known_types: Dict[str, Any], partial: bool = False):
+    """Returns ({input_name: (shape, dtype)}, [(shape, dtype) per output])."""
+    plan = GraphPlan(symbol)
+    info: Dict[str, Optional[jax.ShapeDtypeStruct]] = {}
+    for nm in plan.input_names:
+        shp = known_shapes.get(nm)
+        node_attr_shape = None
+        dt = known_types.get(nm, _np.float32)
+        if shp is None:
+            # __shape__ attr hint on the variable
+            for n in symbol._topo():
+                if n.is_var and n.name == nm and "__shape__" in n.attrs:
+                    node_attr_shape = eval(n.attrs["__shape__"], {"__builtins__": {}})
+            shp = node_attr_shape
+        if shp is not None:
+            info[nm] = jax.ShapeDtypeStruct(tuple(shp), np_dtype(dt))
+        else:
+            info[nm] = None
+
+    step_out: List[Optional[tuple]] = [None] * len(plan.steps)
+
+    def ref_struct(ref):
+        if ref[0] == "var":
+            return info.get(ref[1])
+        si, oi = ref[1]
+        return step_out[si][oi] if step_out[si] is not None else None
+
+    for si, step in enumerate(plan.steps):
+        structs = [ref_struct(r) for r in step.in_refs]
+        if any(s is None for s in structs):
+            hook = PARAM_SHAPE_HOOKS.get(step.op.name)
+            if hook is not None and structs[0] is not None:
+                fills = hook(step.params, [s.shape if s else None for s in structs])
+                for idx, shp in fills.items():
+                    if idx < len(structs) and structs[idx] is None:
+                        ref = step.in_refs[idx]
+                        if ref[0] == "var":
+                            st = jax.ShapeDtypeStruct(tuple(int(x) for x in shp),
+                                                      structs[0].dtype)
+                            info[ref[1]] = st
+                            structs[idx] = st
+        if any(s is None for s in structs):
+            if partial:
+                continue
+            missing = [step.in_refs[i] for i, s in enumerate(structs) if s is None]
+            raise MXNetError(
+                f"infer_shape: cannot infer input(s) {missing} of node "
+                f"'{step.node.name}' ({step.op.name}); provide their shapes")
+        try:
+            outs = _node_eval_shape(step.op, step.params, structs)
+        except Exception as e:  # shape error inside op
+            raise MXNetError(f"infer_shape failed at node '{step.node.name}' "
+                             f"({step.op.name}): {e}") from None
+        n_vis = len(outs) - len(step.op.aux_inputs)
+        step_out[si] = tuple(outs[:n_vis])
+
+    out_structs = []
+    for ref in plan.out_refs:
+        out_structs.append(ref_struct(ref))
+    return plan, info, out_structs
+
+
+def infer_shape(symbol: Symbol, partial: bool, *args, **kwargs):
+    known = {}
+    arg_names = symbol.list_arguments()
+    if args:
+        for nm, shp in zip(arg_names, args):
+            if shp is not None:
+                known[nm] = shp
+    known.update({k: v for k, v in kwargs.items() if v is not None})
+    try:
+        plan, info, outs = infer_shapes_types(symbol, known, {}, partial=partial)
+    except MXNetError:
+        if partial:
+            return None, None, None
+        raise
+    arg_shapes = [tuple(info[n].shape) if info.get(n) else None for n in arg_names]
+    aux_shapes = [tuple(info[n].shape) if info.get(n) else None
+                  for n in symbol.list_auxiliary_states()]
+    out_shapes = [tuple(o.shape) if o else None for o in outs]
+    return arg_shapes, out_shapes, aux_shapes
+
+
+def infer_type(symbol: Symbol, *args, **kwargs):
+    known_t = {}
+    arg_names = symbol.list_arguments()
+    if args:
+        for nm, dt in zip(arg_names, args):
+            if dt is not None:
+                known_t[nm] = dt
+    known_t.update({k: v for k, v in kwargs.items() if v is not None})
+    # types propagate trivially (float32 default); full propagation would need
+    # shapes — return declared/default types
+    arg_types = [np_dtype(known_t.get(n, _np.float32)) for n in arg_names]
+    aux_types = [np_dtype(known_t.get(n, _np.float32))
+                 for n in symbol.list_auxiliary_states()]
+    out_types = [np_dtype(_np.float32)] * len(symbol._entries)
+    return arg_types, out_types, aux_types

@@ -1,0 +1,2 @@
+"""Shape/type inference entry points (implementation in graph.py)."""
+from .graph import infer_shape, infer_type, infer_shapes_types, GraphPlan

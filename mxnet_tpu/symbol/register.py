@@ -1,0 +1,114 @@
+"""Symbol op functions: `sym.<op>(...)` autogen from the shared op registry.
+
+Parity: `python/mxnet/symbol/register.py` codegen.  Auto-creates missing
+input variables (`fc1_weight`, `bn_moving_mean`, ...) exactly like the
+reference's symbol composition, including aux-state tagging.
+"""
+from __future__ import annotations
+
+import sys
+import types
+
+import numpy as _np
+
+from ..base import MXNetError
+from ..attribute import current_attrs
+from ..name import NameManager
+from ..ops import registry as _reg
+from .symbol import Symbol, Variable, _Node, _truthy
+
+
+def _auto_input_names(op, params):
+    """Which declared inputs this node needs, given params."""
+    names = list(op.input_names)
+    p = dict(params)
+    if op.name in ("FullyConnected", "Convolution", "Deconvolution"):
+        if _truthy(p.get("no_bias")):
+            names.remove("bias")
+    if op.name == "RNN" and p.get("mode") != "lstm":
+        names = [n for n in names if n != "state_cell"]
+    return names
+
+
+def invoke_symbol(op_name: str, sym_inputs, kwargs, name=None, attr=None) -> Symbol:
+    op = _reg.get_op(op_name)
+    kwargs = dict(kwargs)
+    kwargs.pop("ctx", None)
+    name = name or kwargs.pop("name", None)
+    attr = attr or kwargs.pop("attr", None)
+    kwargs.pop("num_args", None)
+
+    # split kwargs into symbol inputs vs op params
+    named_inputs = {}
+    params = {}
+    for k, v in list(kwargs.items()):
+        if isinstance(v, Symbol):
+            named_inputs[k] = v
+        elif v is not None:
+            if k == "dtype" and not isinstance(v, str):
+                v = _np.dtype(v).name
+            params[k] = v
+
+    hint = op_name.lower().lstrip("_")
+    node_name = NameManager.current().get(name, hint)
+    attrs = current_attrs(attr)
+
+    if op.variadic:
+        inputs = [s._entries[0] for s in sym_inputs]
+        # variadic ops with optional extras (LeakyReLU prelu gamma)
+        if op.name == "LeakyReLU" and params.get("act_type") == "prelu" \
+                and len(inputs) == 1 and "gamma" not in named_inputs:
+            gv = Variable(f"{node_name}_gamma")
+            inputs.append(gv._entries[0])
+        for k in ("gamma", "sequence_length"):
+            if k in named_inputs:
+                inputs.append(named_inputs[k]._entries[0])
+        if any(a.name == "num_args" for a in op.schema.args.values()):
+            params["num_args"] = len(inputs)
+    else:
+        needed = _auto_input_names(op, params)
+        pos = list(sym_inputs)
+        entries = {}
+        for i, nm in enumerate(needed):
+            if nm in named_inputs:
+                entries[nm] = named_inputs[nm]._entries[0]
+            elif pos:
+                entries[nm] = pos.pop(0)._entries[0]
+            else:
+                entries[nm] = Variable(f"{node_name}_{nm}")._entries[0]
+        inputs = [entries[nm] for nm in needed]
+
+    node = _Node(op_name, node_name, params=params, inputs=inputs, attrs=attrs)
+    n_out = node.num_outputs()
+    return Symbol([(node, i) for i in range(n_out)]) if n_out > 1 else \
+        Symbol([(node, 0)])
+
+
+def _make_sym_func(op_name: str):
+    op = _reg.get_op(op_name)
+
+    def fn(*args, **kwargs):
+        sym_inputs = []
+        rest = list(args)
+        while rest and isinstance(rest[0], Symbol):
+            sym_inputs.append(rest.pop(0))
+        if rest:
+            names = [a for a in op.schema.args]
+            taken = [n for n in names if n not in kwargs]
+            for v, n in zip(rest, taken):
+                kwargs[n] = v
+        return invoke_symbol(op_name, sym_inputs, kwargs)
+
+    fn.__name__ = op_name
+    fn.__doc__ = op.docstring or f"Symbolic wrapper for operator '{op_name}'."
+    return fn
+
+
+def populate(module) -> None:
+    for name in list(_reg.OP_REGISTRY) + list(_reg.OP_ALIASES):
+        setattr(module, name, _make_sym_func(name))
+
+
+_gen = types.ModuleType("mxnet_tpu.symbol._gen")
+populate(_gen)
+sys.modules["mxnet_tpu.symbol._gen"] = _gen
