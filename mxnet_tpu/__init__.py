@@ -33,3 +33,19 @@ from . import symbol
 from . import symbol as sym
 from .symbol import Symbol
 from . import executor
+
+from . import initializer
+from .initializer import init
+from . import optimizer
+from .optimizer import Optimizer
+from . import lr_scheduler
+from . import metric
+from . import callback
+from . import io
+from . import recordio
+from . import kvstore as kv
+from .kvstore import KVStore
+from . import model
+from . import module
+from . import module as mod
+from . import parallel

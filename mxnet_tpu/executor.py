@@ -36,7 +36,8 @@ class Executor:
     def __init__(self, symbol, ctx, args: Dict[str, NDArray],
                  args_grad: Dict[str, NDArray], grad_req: Dict[str, str],
                  aux_states: Dict[str, NDArray], group2ctx=None,
-                 shared_exec: Optional["Executor"] = None):
+                 shared_exec: Optional["Executor"] = None,
+                 mesh=None, data_shard_args=()):
         self._symbol = symbol
         self._ctx = ctx if isinstance(ctx, Context) else Context(ctx)
         self.arg_dict = dict(args)
@@ -54,6 +55,11 @@ class Executor:
         self._outputs_cache: Optional[List[NDArray]] = None
         self._snapshot = None  # (arg_vals, aux_vals, key) of last forward
         self._remat = bool(getenv("MXNET_BACKWARD_DO_MIRROR", 0))
+        # SPMD data parallelism: batch args sharded on 'dp' over the mesh,
+        # params replicated; XLA all-reduces gradients over ICI.  This is the
+        # TPU redesign of DataParallelExecutorGroup (SURVEY.md §2.3).
+        self._mesh = mesh
+        self._data_shard_args = set(data_shard_args)
 
     # -- compiled entry points ---------------------------------------------
     @property
@@ -103,6 +109,15 @@ class Executor:
                 raise MXNetError(f"unknown forward argument {k}")
         arg_vals = {k: v._data for k, v in self.arg_dict.items()}
         aux_vals = {k: v._data for k, v in self.aux_dict.items()}
+        if self._mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            axis = self._mesh.axis_names[0]
+            shard = NamedSharding(self._mesh, P(axis))
+            repl = NamedSharding(self._mesh, P())
+            arg_vals = {k: jax.device_put(v, shard if k in self._data_shard_args
+                                          and v.ndim >= 1 else repl)
+                        for k, v in arg_vals.items()}
+            aux_vals = {k: jax.device_put(v, repl) for k, v in aux_vals.items()}
         return arg_vals, aux_vals, _random.next_key()
 
     def forward(self, is_train: bool = False, **kwargs) -> List[NDArray]:

@@ -1,0 +1,408 @@
+"""Data iterators (parity: python/mxnet/io.py + src/io/ C++ iterators).
+
+DataIter/DataBatch/DataDesc, NDArrayIter, ResizeIter, PrefetchingIter (the
+reference's dmlc::ThreadedIter double-buffering, here a background thread
+that overlaps host data prep with device compute), MNISTIter (idx files),
+CSVIter, ImageRecordIter (recordio-backed, see mxnet_tpu.recordio).
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+import threading
+import queue as _queue
+from collections import namedtuple
+from typing import List, Optional
+
+import numpy as _np
+
+from .base import MXNetError, getenv, np_dtype
+from . import ndarray as nd
+from .ndarray import NDArray
+
+DataDesc = namedtuple("DataDesc", ["name", "shape", "dtype", "layout"])
+DataDesc.__new__.__defaults__ = (_np.float32, "NCHW")
+
+
+class DataBatch:
+    """One batch (parity: io.py DataBatch)."""
+
+    def __init__(self, data, label=None, pad=None, index=None,
+                 bucket_key=None, provide_data=None, provide_label=None):
+        if data is not None and not isinstance(data, (list, tuple)):
+            data = [data]
+        if label is not None and not isinstance(label, (list, tuple)):
+            label = [label]
+        self.data = data
+        self.label = label
+        self.pad = pad
+        self.index = index
+        self.bucket_key = bucket_key
+        self.provide_data = provide_data
+        self.provide_label = provide_label
+
+    def __str__(self):
+        data_shapes = [d.shape for d in self.data]
+        label_shapes = [l.shape for l in self.label] if self.label else None
+        return f"{self.__class__.__name__}: data shapes: {data_shapes} " \
+               f"label shapes: {label_shapes}"
+
+
+class DataIter:
+    """Base iterator (parity: io.py DataIter)."""
+
+    def __init__(self, batch_size=0):
+        self.batch_size = batch_size
+
+    def __iter__(self):
+        return self
+
+    def reset(self):
+        pass
+
+    def next(self) -> DataBatch:
+        if self.iter_next():
+            return DataBatch(data=self.getdata(), label=self.getlabel(),
+                             pad=self.getpad(), index=self.getindex())
+        raise StopIteration
+
+    def __next__(self):
+        return self.next()
+
+    def iter_next(self) -> bool:
+        raise NotImplementedError
+
+    def getdata(self):
+        raise NotImplementedError
+
+    def getlabel(self):
+        raise NotImplementedError
+
+    def getindex(self):
+        return None
+
+    def getpad(self):
+        raise NotImplementedError
+
+
+class NDArrayIter(DataIter):
+    """Iterate over in-memory arrays (parity: io.py:545)."""
+
+    def __init__(self, data, label=None, batch_size=1, shuffle=False,
+                 last_batch_handle="pad", data_name="data",
+                 label_name="softmax_label"):
+        super().__init__(batch_size)
+        self.data = _init_data(data, allow_empty=False, default_name=data_name)
+        self.label = _init_data(label, allow_empty=True, default_name=label_name)
+        self.idx = _np.arange(self.data[0][1].shape[0])
+        if shuffle:
+            _np.random.shuffle(self.idx)
+        self.shuffle = shuffle
+        self.last_batch_handle = last_batch_handle
+        self.num_data = self.idx.shape[0]
+        if last_batch_handle == "discard":
+            self.num_data = (self.num_data // batch_size) * batch_size
+        assert self.num_data >= batch_size, \
+            "batch_size must be smaller than data size"
+        self.cursor = -batch_size
+        self.batch_size = batch_size
+
+    @property
+    def provide_data(self) -> List[DataDesc]:
+        return [DataDesc(k, (self.batch_size,) + v.shape[1:], v.dtype)
+                for k, v in self.data]
+
+    @property
+    def provide_label(self) -> List[DataDesc]:
+        return [DataDesc(k, (self.batch_size,) + v.shape[1:], v.dtype)
+                for k, v in self.label]
+
+    def hard_reset(self):
+        self.cursor = -self.batch_size
+
+    def reset(self):
+        if self.shuffle:
+            _np.random.shuffle(self.idx)
+        if self.last_batch_handle == "roll_over" and \
+                self.cursor > self.num_data:
+            self.cursor = -self.batch_size + (self.cursor % self.num_data) % \
+                self.batch_size
+        else:
+            self.cursor = -self.batch_size
+
+    def iter_next(self):
+        self.cursor += self.batch_size
+        return self.cursor < self.num_data
+
+    def next(self):
+        if self.iter_next():
+            return DataBatch(data=self.getdata(), label=self.getlabel(),
+                             pad=self.getpad(), index=None,
+                             provide_data=self.provide_data,
+                             provide_label=self.provide_label)
+        raise StopIteration
+
+    def _getdata(self, data_source):
+        assert self.cursor < self.num_data, "DataIter needs reset."
+        out = []
+        for _, src in data_source:
+            if self.cursor + self.batch_size <= self.num_data:
+                sel = self.idx[self.cursor:self.cursor + self.batch_size]
+            else:
+                pad = self.batch_size - self.num_data + self.cursor
+                sel = _np.concatenate([self.idx[self.cursor:], self.idx[:pad]])
+            out.append(nd.array(src[sel], dtype=src.dtype))
+        return out
+
+    def getdata(self):
+        return self._getdata(self.data)
+
+    def getlabel(self):
+        return self._getdata(self.label)
+
+    def getpad(self):
+        if self.last_batch_handle == "pad" and \
+                self.cursor + self.batch_size > self.num_data:
+            return self.cursor + self.batch_size - self.num_data
+        return 0
+
+
+def _init_data(data, allow_empty, default_name):
+    assert data is not None or allow_empty
+    if data is None:
+        data = []
+    if isinstance(data, (_np.ndarray, NDArray)):
+        data = [data]
+    if isinstance(data, list):
+        if not allow_empty:
+            assert len(data) > 0
+        if len(data) == 1:
+            data = {default_name: data[0]}
+        else:
+            data = {f"_{i}_{default_name}": d for i, d in enumerate(data)}
+    if not isinstance(data, dict):
+        raise MXNetError("Input must be NDArray, numpy.ndarray, list or dict")
+    out = []
+    for k, v in data.items():
+        if isinstance(v, NDArray):
+            v = v.asnumpy()
+        out.append((k, _np.asarray(v)))
+    return out
+
+
+class ResizeIter(DataIter):
+    """Resize another iterator to `size` batches per epoch (parity: io.py)."""
+
+    def __init__(self, data_iter, size, reset_internal=True):
+        super().__init__(data_iter.batch_size)
+        self.data_iter = data_iter
+        self.size = size
+        self.reset_internal = reset_internal
+        self.cur = 0
+        self.current_batch = None
+        self.provide_data = data_iter.provide_data
+        self.provide_label = data_iter.provide_label
+        if hasattr(data_iter, "default_bucket_key"):
+            self.default_bucket_key = data_iter.default_bucket_key
+
+    def reset(self):
+        self.cur = 0
+        if self.reset_internal:
+            self.data_iter.reset()
+
+    def iter_next(self):
+        if self.cur == self.size:
+            return False
+        try:
+            self.current_batch = self.data_iter.next()
+        except StopIteration:
+            self.data_iter.reset()
+            self.current_batch = self.data_iter.next()
+        self.cur += 1
+        return True
+
+    def getdata(self):
+        return self.current_batch.data
+
+    def getlabel(self):
+        return self.current_batch.label
+
+    def getindex(self):
+        return self.current_batch.index
+
+    def getpad(self):
+        return self.current_batch.pad
+
+
+class PrefetchingIter(DataIter):
+    """Background-thread prefetch (parity: io.py PrefetchingIter /
+    src/io/iter_prefetcher.h double-buffering on dmlc::ThreadedIter)."""
+
+    def __init__(self, iters, rename_data=None, rename_label=None, depth=2):
+        if not isinstance(iters, list):
+            iters = [iters]
+        assert len(iters) == 1, "composite prefetch of multiple iters: pass one"
+        self.iter = iters[0]
+        super().__init__(self.iter.batch_size)
+        self.rename_data = rename_data
+        self.rename_label = rename_label
+        self._queue: _queue.Queue = _queue.Queue(maxsize=depth)
+        self._stop = threading.Event()
+        self._thread = None
+        self._start()
+
+    @property
+    def provide_data(self):
+        if self.rename_data is None:
+            return self.iter.provide_data
+        return [DataDesc(self.rename_data[0].get(d.name, d.name), d.shape,
+                         d.dtype) for d in self.iter.provide_data]
+
+    @property
+    def provide_label(self):
+        if self.rename_label is None:
+            return self.iter.provide_label
+        return [DataDesc(self.rename_label[0].get(d.name, d.name), d.shape,
+                         d.dtype) for d in self.iter.provide_label]
+
+    def _worker(self):
+        while not self._stop.is_set():
+            try:
+                batch = self.iter.next()
+            except StopIteration:
+                self._queue.put(None)
+                return
+            self._queue.put(batch)
+
+    def _start(self):
+        self._thread = threading.Thread(target=self._worker, daemon=True)
+        self._thread.start()
+
+    def reset(self):
+        self._stop.set()
+        try:
+            while True:
+                self._queue.get_nowait()
+        except _queue.Empty:
+            pass
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        self.iter.reset()
+        self._stop = threading.Event()
+        self._queue = _queue.Queue(maxsize=2)
+        self._start()
+
+    def next(self):
+        batch = self._queue.get()
+        if batch is None:
+            raise StopIteration
+        return batch
+
+    def iter_next(self):
+        raise NotImplementedError
+
+    def __del__(self):
+        self._stop.set()
+
+
+class MNISTIter(DataIter):
+    """MNIST idx-format reader (parity: src/io/iter_mnist.cc:260)."""
+
+    def __init__(self, image="train-images-idx3-ubyte", label="train-labels-idx1-ubyte",
+                 batch_size=128, shuffle=True, flat=False, silent=False,
+                 seed=0, input_shape=None, **kwargs):
+        super().__init__(batch_size)
+        imgs = _read_idx(image)
+        labels = _read_idx(label)
+        if flat:
+            imgs = imgs.reshape(imgs.shape[0], -1)
+        else:
+            imgs = imgs.reshape(imgs.shape[0], 1, imgs.shape[1], imgs.shape[2])
+        imgs = imgs.astype(_np.float32) / 255.0
+        self._inner = NDArrayIter(imgs, labels.astype(_np.float32),
+                                  batch_size=batch_size, shuffle=shuffle,
+                                  last_batch_handle="discard")
+
+    @property
+    def provide_data(self):
+        return self._inner.provide_data
+
+    @property
+    def provide_label(self):
+        return self._inner.provide_label
+
+    def reset(self):
+        self._inner.reset()
+
+    def next(self):
+        return self._inner.next()
+
+
+def _read_idx(path):
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rb") as f:
+        data = f.read()
+    zeros, dtype_code, ndim = data[0], data[2], data[3]
+    dims = struct.unpack(">" + "I" * ndim, data[4:4 + 4 * ndim])
+    dt = {8: _np.uint8, 9: _np.int8, 11: _np.int16, 12: _np.int32,
+          13: _np.float32, 14: _np.float64}[dtype_code]
+    arr = _np.frombuffer(data, dt, offset=4 + 4 * ndim)
+    return arr.reshape(dims)
+
+
+class CSVIter(DataIter):
+    """CSV reader (parity: src/io/iter_csv.cc:151)."""
+
+    def __init__(self, data_csv, data_shape, label_csv=None, label_shape=(1,),
+                 batch_size=1, round_batch=True, **kwargs):
+        super().__init__(batch_size)
+        data = _np.loadtxt(data_csv, delimiter=",", dtype=_np.float32, ndmin=2)
+        data = data.reshape((-1,) + tuple(data_shape))
+        label = None
+        if label_csv is not None:
+            label = _np.loadtxt(label_csv, delimiter=",", dtype=_np.float32, ndmin=2)
+            label = label.reshape((-1,) + tuple(label_shape))
+            if label.shape[-1] == 1:
+                label = label.reshape(label.shape[0])
+        else:
+            label = _np.zeros((data.shape[0],), _np.float32)
+        self._inner = NDArrayIter(data, label, batch_size=batch_size,
+                                  last_batch_handle="pad" if round_batch else "discard")
+
+    @property
+    def provide_data(self):
+        return self._inner.provide_data
+
+    @property
+    def provide_label(self):
+        return self._inner.provide_label
+
+    def reset(self):
+        self._inner.reset()
+
+    def next(self):
+        return self._inner.next()
+
+
+def ImageRecordIter(path_imgrec, data_shape, batch_size, label_width=1,
+                    shuffle=False, mean_r=0., mean_g=0., mean_b=0., std_r=1.,
+                    std_g=1., std_b=1., rand_crop=False, rand_mirror=False,
+                    preprocess_threads=4, prefetch_buffer=4, **kwargs):
+    """RecordIO-backed image iterator (parity: src/io/iter_image_recordio_2.cc).
+
+    Decodes JPEG/pack payloads from a .rec file and yields augmented NCHW
+    batches; heavy decode runs in the prefetch thread.
+    """
+    from .image import ImageRecordIterPy
+    it = ImageRecordIterPy(path_imgrec=path_imgrec, data_shape=tuple(data_shape),
+                           batch_size=batch_size, label_width=label_width,
+                           shuffle=shuffle,
+                           mean=(mean_r, mean_g, mean_b),
+                           std=(std_r, std_g, std_b),
+                           rand_crop=rand_crop, rand_mirror=rand_mirror,
+                           **kwargs)
+    return PrefetchingIter(it, depth=int(prefetch_buffer))
+
+
+MXDataIter = DataIter  # the C++-backed iter class name, kept for API parity

@@ -1,0 +1,192 @@
+"""KVStore: the distributed key-value parameter store.
+
+Reference parity: `include/mxnet/kvstore.h:47`, `src/kvstore/` (local comm
+tree-reduce, NCCL collectives, ps-lite dist_sync/dist_async — SURVEY.md §2.3)
+and `python/mxnet/kvstore.py`.
+
+TPU-native design (SURVEY.md §2.3 "TPU-native equivalent"):
+  - 'local' / 'device': in-process aggregation across per-device copies —
+    push reduces (sum) the listed values, pull broadcasts; XLA executes the
+    reduce as one fused kernel.  (replaces CommCPU/CommDevice, comm.h:102,484)
+  - 'tpu_sync' (also accepted: 'nccl', 'dist_sync', 'dist_device_sync'):
+    synchronous data parallelism over the ICI mesh.  Within one process,
+    device-parallel gradients are averaged by XLA all-reduce (jnp sum over
+    stacked device shards → compiler collective); across processes
+    (multi-host pods), push/pull lower to `jax.lax.psum` inside a
+    `shard_map` over the global mesh — see `mxnet_tpu.parallel`.  rank =
+    jax.process_index(), num_workers = jax.process_count().
+  - 'dist_async' has no ICI analog (parameter-server asynchrony); it is
+    accepted and runs synchronously (documented divergence).
+  - gradient compression (2-bit ps-lite path) is unnecessary on ICI;
+    `set_gradient_compression` validates args and records the setting.
+"""
+from __future__ import annotations
+
+import pickle
+from typing import Dict, List, Optional
+
+import jax
+
+from .base import MXNetError
+from . import ndarray as nd
+from .ndarray import NDArray
+from . import optimizer as opt
+
+
+def _key_list(key):
+    if isinstance(key, (int, str)):
+        return [key], False
+    return list(key), True
+
+
+def _val_list(value):
+    if isinstance(value, NDArray):
+        return [[value]]
+    if isinstance(value, (list, tuple)):
+        if value and isinstance(value[0], NDArray):
+            return [list(value)]
+        return [list(v) if isinstance(v, (list, tuple)) else [v] for v in value]
+    raise MXNetError("invalid kvstore value")
+
+
+class KVStore:
+    def __init__(self, kv_type: str = "local"):
+        self.type = kv_type
+        self._store: Dict = {}
+        self._updater = None
+        self._update_on_kvstore = True
+        self._compression_params = None
+        self._optimizer = None
+
+    # -- identity -----------------------------------------------------------
+    @property
+    def rank(self) -> int:
+        return jax.process_index() if self.type.startswith(("dist", "tpu")) else 0
+
+    @property
+    def num_workers(self) -> int:
+        return jax.process_count() if self.type.startswith(("dist", "tpu")) else 1
+
+    # -- core ops -----------------------------------------------------------
+    def init(self, key, value) -> None:
+        keys, _ = _key_list(key)
+        vals = _val_list(value)
+        for k, vlist in zip(keys, vals):
+            self._store[k] = vlist[0].copy()
+
+    def push(self, key, value, priority: int = 0) -> None:
+        """Aggregate `value` (list = per-device copies) into the store.
+        If an optimizer is set (update_on_kvstore), applies the update."""
+        keys, _ = _key_list(key)
+        vals = _val_list(value)
+        for k, vlist in zip(keys, vals):
+            merged = vlist[0]
+            for v in vlist[1:]:
+                merged = merged + v
+            merged = self._allreduce(merged)
+            if self._updater is not None:
+                if k not in self._store:
+                    raise MXNetError(f"key {k} has not been inited")
+                self._updater(_updater_key(k), merged, self._store[k])
+            else:
+                # parity: kvstore_local.h:191 — assign, not accumulate
+                self._store[k] = merged.copy()
+
+    def pull(self, key, out=None, priority: int = 0) -> None:
+        keys, _ = _key_list(key)
+        outs = _val_list(out)
+        for k, olist in zip(keys, outs):
+            if k not in self._store:
+                raise MXNetError(f"key {k} not initialized")
+            src = self._store[k]
+            for o in olist:
+                src.copyto(o)
+
+    def row_sparse_pull(self, key, out=None, priority=0, row_ids=None) -> None:
+        """Pull only the rows in row_ids (parity: KVStore::PullRowSparse)."""
+        keys, _ = _key_list(key)
+        outs = _val_list(out)
+        rids = row_ids if isinstance(row_ids, (list, tuple)) else [row_ids]
+        for k, olist in zip(keys, outs):
+            src = self._store[k]
+            for o, rid in zip(olist, rids * len(olist)):
+                idx = rid.asnumpy().astype("int64").ravel()
+                rows = src.asnumpy()[idx]
+                from .ndarray.sparse import RowSparseNDArray
+                res = RowSparseNDArray(idx, rows, src.shape, src.context)
+                o._set_data(res._data)
+
+    # -- allreduce across processes (multi-host pods) ------------------------
+    def _allreduce(self, merged: NDArray) -> NDArray:
+        if self.num_workers <= 1 or self.type == "local":
+            return merged
+        from .parallel import collectives
+        return collectives.allreduce_hosts(merged)
+
+    # -- optimizer plumbing --------------------------------------------------
+    def set_optimizer(self, optimizer: "opt.Optimizer") -> None:
+        """Run this optimizer on push (parity: server-side optimizer —
+        kvstore_dist_server.h ApplyUpdates; here updates run worker-side,
+        sharded by XLA, since there are no server processes on ICI)."""
+        self._optimizer = optimizer
+        self._set_updater(opt.get_updater(optimizer))
+
+    def _set_updater(self, updater) -> None:
+        self._updater = updater
+
+    def set_gradient_compression(self, compression_params: Dict) -> None:
+        if "type" not in compression_params:
+            raise MXNetError("compression_params requires 'type'")
+        if compression_params["type"] not in ("2bit",):
+            raise MXNetError("unsupported compression type")
+        # ICI is high-bandwidth; recorded but not applied (documented)
+        self._compression_params = dict(compression_params)
+
+    # -- cluster control ------------------------------------------------------
+    def barrier(self) -> None:
+        """Global barrier (parity: KVStore::Barrier)."""
+        if self.num_workers > 1:
+            from .parallel import collectives
+            collectives.host_barrier()
+
+    def _barrier(self):
+        self.barrier()
+
+    def num_dead_node(self, node_id: int = 0, timeout_sec: int = 60) -> int:
+        """Parity: kvstore.h:338 — PJRT surfaces device failure as errors, so
+        a live call implies zero dead nodes."""
+        return 0
+
+    def _send_command_to_servers(self, head, body) -> None:
+        pass  # no server processes in the TPU design
+
+    def save_optimizer_states(self, fname: str, dump_optimizer=False) -> None:
+        if self._updater is None:
+            raise MXNetError("no optimizer set")
+        with open(fname, "wb") as f:
+            f.write(self._updater.get_states(dump_optimizer))
+
+    def load_optimizer_states(self, fname: str) -> None:
+        if self._updater is None:
+            raise MXNetError("no optimizer set")
+        with open(fname, "rb") as f:
+            self._updater.set_states(f.read())
+
+
+def _updater_key(k):
+    try:
+        return int(k)
+    except (TypeError, ValueError):
+        return k
+
+
+_TYPES = ("local", "device", "local_allreduce_cpu", "local_allreduce_device",
+          "nccl", "tpu_sync", "dist", "dist_sync", "dist_async",
+          "dist_device_sync", "dist_sync_device")
+
+
+def create(name: str = "local") -> KVStore:
+    """Create a KVStore (parity: kvstore.cc:38 KVStore::Create)."""
+    if not isinstance(name, str) or name not in _TYPES:
+        raise MXNetError(f"unknown kvstore type {name}; known: {_TYPES}")
+    return KVStore(name)

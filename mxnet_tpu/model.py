@@ -1,0 +1,67 @@
+"""Model checkpoint helpers + BatchEndParam (parity: python/mxnet/model.py).
+
+Checkpoint format parity (model.py:366,396): `prefix-symbol.json` (graph
+JSON) + `prefix-%04d.params` (NDArray map with `arg:`/`aux:` key prefixes,
+stored via mx.nd.save).  The deprecated FeedForward API is represented by
+Module (the reference itself forwards users there).
+"""
+from __future__ import annotations
+
+from collections import namedtuple
+from typing import Dict, Tuple
+
+from .base import MXNetError
+from . import ndarray as nd
+from . import symbol as sym
+
+BatchEndParam = namedtuple("BatchEndParams",
+                           ["epoch", "nbatch", "eval_metric", "locals"])
+
+
+def save_checkpoint(prefix: str, epoch: int, symbol, arg_params: Dict,
+                    aux_params: Dict) -> None:
+    """Parity: model.save_checkpoint — prefix-symbol.json + prefix-%04d.params."""
+    if symbol is not None:
+        symbol.save(f"{prefix}-symbol.json")
+    save_dict = {f"arg:{k}": v for k, v in arg_params.items()}
+    save_dict.update({f"aux:{k}": v for k, v in aux_params.items()})
+    nd.save(f"{prefix}-{epoch:04d}.params", save_dict)
+
+
+def load_checkpoint(prefix: str, epoch: int):
+    """Parity: model.load_checkpoint → (symbol, arg_params, aux_params)."""
+    symbol = sym.load(f"{prefix}-symbol.json")
+    save_dict = nd.load(f"{prefix}-{epoch:04d}.params")
+    arg_params, aux_params = {}, {}
+    for k, v in save_dict.items():
+        tp, _, name = k.partition(":")
+        if tp == "arg":
+            arg_params[name] = v
+        elif tp == "aux":
+            aux_params[name] = v
+    return symbol, arg_params, aux_params
+
+
+def _create_kvstore(kvstore, num_device: int, arg_params):
+    """Parity: model.py:_create_kvstore — returns (kv, update_on_kvstore)."""
+    from . import kvstore as kvs
+    update_on_kvstore = True
+    if kvstore is None:
+        kv = None
+    elif isinstance(kvstore, kvs.KVStore):
+        kv = kvstore
+    elif isinstance(kvstore, str):
+        if num_device == 1 and "dist" not in kvstore and "tpu" not in kvstore:
+            kv = None
+        else:
+            kv = kvs.create(kvstore)
+            if kvstore == "local":
+                max_size = max(arg.size for arg in arg_params.values()) \
+                    if arg_params else 0
+                if max_size > 1024 * 1024 * 16:
+                    update_on_kvstore = False
+    else:
+        raise MXNetError("kvstore must be KVStore, str or None")
+    if kv is None:
+        update_on_kvstore = False
+    return kv, update_on_kvstore

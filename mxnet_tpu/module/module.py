@@ -1,0 +1,437 @@
+"""Module: intermediate-level training API over one compiled executor.
+
+Reference parity: `python/mxnet/module/module.py:39` (bind/init_params/
+init_optimizer/forward/backward/update + kvstore wiring, model.py:97-138).
+
+TPU redesign of the multi-device path: where the reference's
+DataParallelExecutorGroup (`executor_group.py:128`) sliced each batch across
+per-GPU executors and pushed gradients through KVStore reduce, a Module bound
+with several contexts builds ONE executor over a `jax.sharding.Mesh` of those
+devices — batch sharded on 'dp', parameters replicated, gradient all-reduce
+inserted by XLA over ICI.  KVStore('tpu_sync') then applies the optimizer to
+the replicated gradients (update_on_kvstore semantics preserved).
+"""
+from __future__ import annotations
+
+import logging
+from typing import Dict, List, Optional
+
+from ..base import MXNetError
+from ..context import Context, cpu
+from ..initializer import InitDesc, Uniform
+from .. import ndarray as nd
+from ..io import DataDesc
+from .. import optimizer as opt
+from ..model import _create_kvstore, load_checkpoint, save_checkpoint
+from .base_module import BaseModule, _check_input_names
+
+
+class Module(BaseModule):
+    def __init__(self, symbol, data_names=("data",), label_names=("softmax_label",),
+                 logger=logging, context=cpu(), work_load_list=None,
+                 fixed_param_names=None, state_names=None, group2ctxs=None,
+                 compression_params=None):
+        super().__init__(logger=logger)
+        if isinstance(context, Context):
+            context = [context]
+        self._context = context
+        self._work_load_list = work_load_list
+        self._group2ctxs = group2ctxs
+        self._symbol = symbol
+        data_names = list(data_names) if data_names is not None else []
+        label_names = list(label_names) if label_names is not None else []
+        state_names = list(state_names) if state_names is not None else []
+        fixed_param_names = list(fixed_param_names) if fixed_param_names \
+            is not None else []
+        _check_input_names(symbol, data_names, "data", True)
+        _check_input_names(symbol, label_names, "label", False)
+        _check_input_names(symbol, state_names, "state", True)
+        _check_input_names(symbol, fixed_param_names, "fixed_param", True)
+        arg_names = symbol.list_arguments()
+        input_names = data_names + label_names + state_names
+        self._param_names = [x for x in arg_names if x not in input_names]
+        self._fixed_param_names = fixed_param_names
+        self._aux_names = symbol.list_auxiliary_states()
+        self._data_names = data_names
+        self._label_names = label_names
+        self._state_names = state_names
+        self._output_names = symbol.list_outputs()
+        self._arg_params = None
+        self._aux_params = None
+        self._params_dirty = False
+        self._compression_params = compression_params
+        self._optimizer = None
+        self._kvstore = None
+        self._update_on_kvstore = None
+        self._updater = None
+        self._preload_opt_states = None
+        self._exec = None
+        self._data_shapes = None
+        self._label_shapes = None
+        self._grad_req = "write"
+
+    @staticmethod
+    def load(prefix, epoch, load_optimizer_states=False, **kwargs):
+        sym, args, auxs = load_checkpoint(prefix, epoch)
+        mod = Module(symbol=sym, **kwargs)
+        mod._arg_params = args
+        mod._aux_params = auxs
+        mod.params_initialized = True
+        if load_optimizer_states:
+            mod._preload_opt_states = f"{prefix}-{epoch:04d}.states"
+        return mod
+
+    def save_checkpoint(self, prefix, epoch, save_optimizer_states=False):
+        self._sync_params_from_devices()
+        save_checkpoint(prefix, epoch, self.symbol, *self.get_params())
+        if save_optimizer_states:
+            self.save_optimizer_states(f"{prefix}-{epoch:04d}.states")
+
+    # -- shapes ---------------------------------------------------------------
+    @property
+    def data_names(self):
+        return self._data_names
+
+    @property
+    def label_names(self):
+        return self._label_names
+
+    @property
+    def output_names(self):
+        return self._output_names
+
+    @property
+    def data_shapes(self):
+        assert self.binded
+        return self._data_shapes
+
+    @property
+    def label_shapes(self):
+        assert self.binded
+        return self._label_shapes
+
+    @property
+    def output_shapes(self):
+        assert self.binded
+        return [(n, tuple(o.shape)) for n, o in
+                zip(self._output_names, self._exec.outputs)] \
+            if self._exec._outputs_cache is not None else \
+            list(zip(self._output_names, self._infer_output_shapes()))
+
+    def _infer_output_shapes(self):
+        shapes = {d.name: d.shape for d in self._data_shapes}
+        if self._label_shapes:
+            shapes.update({d.name: d.shape for d in self._label_shapes})
+        _, out_shapes, _ = self._symbol.infer_shape(**shapes)
+        return out_shapes
+
+    # -- params ---------------------------------------------------------------
+    def get_params(self):
+        assert self.params_initialized
+        if self._params_dirty:
+            self._sync_params_from_devices()
+        return (self._arg_params, self._aux_params)
+
+    def init_params(self, initializer=Uniform(0.01), arg_params=None,
+                    aux_params=None, allow_missing=False, force_init=False,
+                    allow_extra=False):
+        if self.params_initialized and not force_init:
+            return
+        assert self.binded, "call bind before initializing the parameters"
+        if self._arg_params is None:
+            self._arg_params = {name: nd.zeros(arr.shape, dtype=arr.dtype)
+                                for name, arr in self._exec.arg_dict.items()
+                                if name in self._param_names}
+        if self._aux_params is None:
+            self._aux_params = {name: nd.zeros(arr.shape, dtype=arr.dtype)
+                                for name, arr in self._exec.aux_dict.items()}
+        attrs = self._symbol.attr_dict()
+
+        def _impl(name, arr, cache):
+            if cache is not None and name in cache:
+                cache_arr = cache[name]
+                if cache_arr is not arr:
+                    cache_arr.copyto(arr)
+            elif not allow_missing and cache is not None:
+                raise RuntimeError(f"{name} is not presented")
+            elif initializer is not None:
+                initializer(InitDesc(name, attrs.get(name)), arr)
+
+        for name in self._param_names:
+            arr = self._arg_params[name]
+            _impl(name, arr, arg_params)
+        for name, arr in sorted(self._aux_params.items()):
+            _impl(name, arr, aux_params)
+        self.params_initialized = True
+        self._params_dirty = False
+        self._exec.copy_params_from(self._arg_params, self._aux_params,
+                                    allow_extra_params=True)
+
+    def set_params(self, arg_params, aux_params, allow_missing=False,
+                   force_init=True, allow_extra=False):
+        if not allow_missing:
+            self.init_params(initializer=None, arg_params=arg_params,
+                             aux_params=aux_params, allow_missing=allow_missing,
+                             force_init=force_init, allow_extra=allow_extra)
+            return
+        if self.params_initialized and not force_init:
+            return
+        self._exec.copy_params_from(arg_params, aux_params,
+                                    allow_extra_params=True)
+        self.params_initialized = True
+        self._params_dirty = False
+
+    def _sync_params_from_devices(self):
+        for name in self._param_names:
+            self._exec.arg_dict[name].copyto(self._arg_params[name])
+        for name, arr in self._exec.aux_dict.items():
+            arr.copyto(self._aux_params[name])
+        self._params_dirty = False
+
+    # -- bind -----------------------------------------------------------------
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             inputs_need_grad=False, force_rebind=False, shared_module=None,
+             grad_req="write"):
+        if force_rebind:
+            self._exec = None
+            self.binded = False
+        if self.binded:
+            self.logger.warning("Already bound, ignoring bind()")
+            return
+        self.for_training = for_training
+        self.inputs_need_grad = inputs_need_grad
+        self._grad_req = grad_req
+        assert not for_training or label_shapes is not None or \
+            not self._label_names
+
+        self._data_shapes = [d if isinstance(d, DataDesc) else DataDesc(*d)
+                             for d in data_shapes]
+        self._label_shapes = [d if isinstance(d, DataDesc) else DataDesc(*d)
+                              for d in label_shapes] if label_shapes else []
+
+        shapes = {d.name: tuple(d.shape) for d in self._data_shapes}
+        shapes.update({d.name: tuple(d.shape) for d in self._label_shapes})
+        types = {d.name: d.dtype for d in
+                 self._data_shapes + self._label_shapes}
+
+        arg_shapes, _, aux_shapes = self._symbol.infer_shape(**shapes)
+        arg_types, _, aux_types = self._symbol.infer_type(**types)
+        arg_names = self._symbol.list_arguments()
+        ctx0 = self._context[0]
+
+        mesh = None
+        data_shard_args = ()
+        if len(self._context) > 1:
+            from ..parallel.mesh import make_mesh
+            devs = [c.jax_device() for c in self._context]
+            mesh = make_mesh(dp=len(devs), devices=devs)
+            data_shard_args = tuple(self._data_names) + tuple(self._label_names)
+
+        args, grads, reqs = {}, {}, {}
+        shared_args = shared_module._exec.arg_dict if shared_module else {}
+        shared_aux = shared_module._exec.aux_dict if shared_module else {}
+        for name, shp, dt in zip(arg_names, arg_shapes, arg_types):
+            if name in shared_args and tuple(shared_args[name].shape) == tuple(shp):
+                args[name] = shared_args[name]
+            else:
+                args[name] = nd.zeros(shp, ctx=ctx0, dtype=dt)
+            is_input = name in self._data_names or name in self._label_names \
+                or name in self._state_names
+            if not for_training:
+                reqs[name] = "null"
+            elif is_input:
+                if name in self._data_names and inputs_need_grad:
+                    reqs[name] = "write"
+                else:
+                    reqs[name] = "null"
+            elif name in self._fixed_param_names:
+                reqs[name] = "null"
+            else:
+                reqs[name] = grad_req if isinstance(grad_req, str) else \
+                    grad_req.get(name, "write")
+            if reqs[name] != "null":
+                grads[name] = nd.zeros(shp, ctx=ctx0, dtype=dt)
+        aux = {}
+        for name, shp, dt in zip(self._aux_names, aux_shapes, aux_types):
+            if name in shared_aux and tuple(shared_aux[name].shape) == tuple(shp):
+                aux[name] = shared_aux[name]
+            else:
+                aux[name] = nd.zeros(shp, ctx=ctx0, dtype=dt)
+
+        from ..executor import Executor
+        group2ctx = None
+        if self._group2ctxs:
+            group2ctx = self._group2ctxs if isinstance(self._group2ctxs, dict) \
+                else self._group2ctxs[0]
+        self._exec = Executor(self._symbol, ctx0, args, grads, reqs, aux,
+                              group2ctx=group2ctx,
+                              shared_exec=shared_module._exec if shared_module
+                              else None,
+                              mesh=mesh, data_shard_args=data_shard_args)
+        self.binded = True
+        if shared_module is not None and shared_module.params_initialized:
+            self.set_params(*shared_module.get_params())
+
+    # -- optimizer ------------------------------------------------------------
+    def init_optimizer(self, kvstore="local", optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.01),),
+                       force_init=False):
+        assert self.binded and self.params_initialized
+        if self.optimizer_initialized and not force_init:
+            self.logger.warning("optimizer already initialized, ignoring...")
+            return
+        if self._params_dirty:
+            self._sync_params_from_devices()
+        kvstore, update_on_kvstore = _create_kvstore(
+            kvstore, len(self._context), dict(zip(self._param_names,
+                                                  [self._exec.arg_dict[n]
+                                                   for n in self._param_names])))
+        batch_size = self._data_shapes[0].shape[0]
+        if kvstore and "dist" in kvstore.type and "_sync" in kvstore.type:
+            batch_size *= kvstore.num_workers
+        rescale_grad = 1.0 / batch_size
+
+        idx2name = {i: n for i, n in enumerate(self._param_names)}
+        if isinstance(optimizer, str):
+            optimizer_params = dict(optimizer_params)
+            if "rescale_grad" not in optimizer_params:
+                optimizer_params["rescale_grad"] = rescale_grad
+            optimizer = opt.create(optimizer, sym=self.symbol,
+                                   param_idx2name=idx2name, **optimizer_params)
+        else:
+            assert isinstance(optimizer, opt.Optimizer)
+            if optimizer.rescale_grad != rescale_grad:
+                self.logger.warning(
+                    "Optimizer created manually outside Module but rescale_grad "
+                    f"is not normalized to 1.0/batch_size/num_workers ({rescale_grad} "
+                    f"vs. {optimizer.rescale_grad}). Is this intended?")
+            if not optimizer.idx2name:
+                optimizer.idx2name = idx2name.copy()
+
+        self._optimizer = optimizer
+        self._kvstore = kvstore
+        self._update_on_kvstore = update_on_kvstore
+        self._updater = None
+        if kvstore:
+            if self._compression_params:
+                kvstore.set_gradient_compression(self._compression_params)
+            for i, name in enumerate(self._param_names):
+                kvstore.init(name, self._arg_params[name])
+            if update_on_kvstore:
+                kvstore.set_optimizer(self._optimizer)
+        if not update_on_kvstore:
+            self._updater = opt.get_updater(optimizer)
+        self.optimizer_initialized = True
+        if self._preload_opt_states is not None:
+            self.load_optimizer_states(self._preload_opt_states)
+            self._preload_opt_states = None
+
+    # -- compute --------------------------------------------------------------
+    def _set_batch(self, data_batch, is_train):
+        for name, arr in zip(self._data_names, data_batch.data):
+            tgt = self._exec.arg_dict[name]
+            if tuple(tgt.shape) != tuple(arr.shape):
+                # shape change (e.g. last partial batch): XLA re-specializes
+                self._exec.arg_dict[name] = arr.astype(tgt.dtype) \
+                    if not isinstance(arr, nd.NDArray) else arr
+            else:
+                tgt._set_data((arr._data if isinstance(arr, nd.NDArray)
+                               else nd.array(arr)._data).astype(tgt.dtype))
+        if is_train and data_batch.label:
+            for name, arr in zip(self._label_names, data_batch.label):
+                if name not in self._exec.arg_dict:
+                    continue
+                tgt = self._exec.arg_dict[name]
+                tgt._set_data((arr._data if isinstance(arr, nd.NDArray)
+                               else nd.array(arr)._data).astype(tgt.dtype))
+
+    def forward(self, data_batch, is_train=None):
+        assert self.binded and self.params_initialized
+        if is_train is None:
+            is_train = self.for_training
+        self._set_batch(data_batch, is_train or bool(data_batch.label))
+        self._exec.forward(is_train=is_train)
+
+    def backward(self, out_grads=None):
+        assert self.binded and self.params_initialized
+        self._exec.backward(out_grads=out_grads)
+
+    def forward_backward(self, data_batch):
+        """Fused single-compiled-call training step (TPU hot path)."""
+        assert self.binded and self.params_initialized
+        self._set_batch(data_batch, True)
+        self._exec.forward_backward()
+
+    def update(self):
+        """Parity: _update_params_on_kvstore / _update_params (model.py:97-138)."""
+        assert self.binded and self.params_initialized and \
+            self.optimizer_initialized
+        self._params_dirty = True
+        if self._kvstore is not None:
+            for i, name in enumerate(self._param_names):
+                if name not in self._exec.grad_dict:
+                    continue
+                grad = self._exec.grad_dict[name]
+                self._kvstore.push(name, [grad])
+                if self._update_on_kvstore:
+                    self._kvstore.pull(name, out=[self._exec.arg_dict[name]])
+                else:
+                    agg = nd.zeros(grad.shape, dtype=grad.dtype)
+                    self._kvstore.pull(name, out=[agg])
+                    self._updater(i, agg, self._exec.arg_dict[name])
+        else:
+            for i, name in enumerate(self._param_names):
+                if name in self._exec.grad_dict:
+                    self._updater(i, self._exec.grad_dict[name],
+                                  self._exec.arg_dict[name])
+
+    def get_outputs(self, merge_multi_context=True):
+        assert self.binded and self.params_initialized
+        return self._exec.outputs
+
+    def get_input_grads(self, merge_multi_context=True):
+        assert self.binded and self.params_initialized and self.inputs_need_grad
+        return [self._exec.grad_dict[n] for n in self._data_names]
+
+    def update_metric(self, eval_metric, labels):
+        eval_metric.update_dict(
+            dict(zip(self._label_names, labels or [])),
+            dict(zip(self._output_names, self.get_outputs())))
+
+    def install_monitor(self, mon):
+        assert self.binded
+        mon.install(self._exec)
+
+    def save_optimizer_states(self, fname):
+        assert self.optimizer_initialized
+        if self._update_on_kvstore:
+            self._kvstore.save_optimizer_states(fname)
+        else:
+            with open(fname, "wb") as fout:
+                fout.write(self._updater.get_states())
+
+    def load_optimizer_states(self, fname):
+        assert self.optimizer_initialized
+        if self._update_on_kvstore:
+            self._kvstore.load_optimizer_states(fname)
+        else:
+            with open(fname, "rb") as f:
+                self._updater.set_states(f.read())
+
+    def reshape(self, data_shapes, label_shapes=None):
+        assert self.binded
+        self._data_shapes = [d if isinstance(d, DataDesc) else DataDesc(*d)
+                             for d in data_shapes]
+        self._label_shapes = [d if isinstance(d, DataDesc) else DataDesc(*d)
+                              for d in label_shapes] if label_shapes else []
+        shapes = {d.name: tuple(d.shape) for d in
+                  self._data_shapes + self._label_shapes}
+        self._exec = self._exec.reshape(**shapes)
+
+    def borrow_optimizer(self, shared_module):
+        assert shared_module.optimizer_initialized
+        self._optimizer = shared_module._optimizer
+        self._kvstore = shared_module._kvstore
+        self._update_on_kvstore = shared_module._update_on_kvstore
+        self._updater = shared_module._updater
+        self.optimizer_initialized = True

@@ -22,3 +22,40 @@ from .sparse import CSRNDArray, RowSparseNDArray
 
 onehot_encode = _gen.one_hot
 imdecode = None  # provided by mxnet_tpu.image
+
+
+def maximum(lhs, rhs, **kw):
+    """Elementwise max of arrays/scalars (parity: nd.maximum)."""
+    if isinstance(lhs, NDArray) and isinstance(rhs, NDArray):
+        return _gen.broadcast_maximum(lhs, rhs)
+    if isinstance(lhs, NDArray):
+        return _gen._maximum_scalar(lhs, scalar=float(rhs))
+    return _gen._maximum_scalar(rhs, scalar=float(lhs))
+
+
+def minimum(lhs, rhs, **kw):
+    if isinstance(lhs, NDArray) and isinstance(rhs, NDArray):
+        return _gen.broadcast_minimum(lhs, rhs)
+    if isinstance(lhs, NDArray):
+        return _gen._minimum_scalar(lhs, scalar=float(rhs))
+    return _gen._minimum_scalar(rhs, scalar=float(lhs))
+
+
+def add(l, r):
+    return l + r
+
+
+def subtract(l, r):
+    return l - r
+
+
+def multiply(l, r):
+    return l * r
+
+
+def divide(l, r):
+    return l / r
+
+
+def power(l, r):
+    return l ** r

@@ -458,6 +458,15 @@ class NDArray:
     def __repr__(self):
         return f"\n{self.asnumpy()}\n<NDArray {'x'.join(map(str, self.shape))} @{self._ctx}>"
 
+    # pickling (used by Updater.get_states / multiprocessing DataLoader)
+    def __reduce__(self):
+        return (_rebuild_ndarray, (self.asnumpy(), self._ctx.device_type,
+                                   self._ctx.device_id))
+
+
+def _rebuild_ndarray(np_data, dev_type, dev_id):
+    return array(np_data, ctx=Context(dev_type, dev_id), dtype=np_data.dtype)
+
 
 # ---------------------------------------------------------------------------
 # creation helpers (parity: python/mxnet/ndarray/utils.py + ndarray.py)

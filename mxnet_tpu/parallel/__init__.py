@@ -1,0 +1,19 @@
+"""Parallelism toolkit: device meshes, XLA collectives, SPMD training step,
+sequence parallelism (ring attention / Ulysses all-to-all).
+
+This package is the TPU-native replacement for the reference's entire
+communication stack (`src/kvstore/comm.h`, `kvstore_nccl.h`, ps-lite —
+SURVEY.md §2.3): instead of hand-written tree-reduce/NCCL calls, shardings
+are annotated on a `jax.sharding.Mesh` and XLA inserts all-reduce /
+reduce-scatter / all-gather / ppermute collectives that ride ICI.
+
+It also provides what the reference *lacks* (SURVEY.md §5 long-context):
+ring attention and Ulysses sequence parallelism over the mesh.
+"""
+from . import mesh
+from .mesh import make_mesh, device_mesh, MeshConfig
+from . import collectives
+from . import data_parallel
+from .data_parallel import shard_batch, replicate, DataParallelStep
+from . import sequence_parallel
+from .sequence_parallel import ring_attention, ulysses_attention

@@ -1,0 +1,62 @@
+"""SPMD data parallelism: the TPU redesign of DataParallelExecutorGroup.
+
+The reference (python/mxnet/module/executor_group.py:128) slices each batch
+across per-device executors and reduces gradients through KVStore.  On TPU
+the idiomatic form is ONE jitted step over a mesh: batch sharded on 'dp',
+params replicated; XLA inserts the gradient all-reduce (this is what
+`KVStore('tpu_sync')` means operationally).  Module uses these helpers when
+bound with multiple contexts.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..base import MXNetError
+
+
+def shard_batch(mesh: Mesh, x, axis_name: str = "dp"):
+    """Place a host array onto the mesh, sharded along dim 0."""
+    spec = P(axis_name) if x.ndim >= 1 else P()
+    return jax.device_put(x, NamedSharding(mesh, spec))
+
+
+def replicate(mesh: Mesh, x):
+    return jax.device_put(x, NamedSharding(mesh, P()))
+
+
+class DataParallelStep:
+    """Compile a training/inference step SPMD over a dp mesh.
+
+    fn(args: dict, aux: dict, key, is_train) -> (outputs, new_aux[, grads])
+    data_names are sharded on 'dp'; everything else replicated.  Gradients
+    come out replicated (XLA all-reduduces them over ICI).
+    """
+
+    def __init__(self, mesh: Mesh, fn: Callable, data_names, axis_name="dp"):
+        self.mesh = mesh
+        self.axis_name = axis_name
+        self.data_names = set(data_names)
+        self._fn = fn
+        self._jit = None
+
+    def _shardings(self, arg_names):
+        shard = NamedSharding(self.mesh, P(self.axis_name))
+        repl = NamedSharding(self.mesh, P())
+        return {n: (shard if n in self.data_names else repl) for n in arg_names}
+
+    def __call__(self, args: Dict, aux: Dict, key, *rest):
+        if self._jit is None:
+            in_sh = (self._shardings(args.keys()),
+                     {n: NamedSharding(self.mesh, P()) for n in aux},
+                     NamedSharding(self.mesh, P()))
+            self._jit = jax.jit(self._fn, in_shardings=in_sh + (None,) * len(rest)
+                                if rest else in_sh)
+        placed_args = {n: (shard_batch(self.mesh, v, self.axis_name)
+                           if n in self.data_names else replicate(self.mesh, v))
+                       for n, v in args.items()}
+        placed_aux = {n: replicate(self.mesh, v) for n, v in aux.items()}
+        return self._jit(placed_args, placed_aux, key, *rest)

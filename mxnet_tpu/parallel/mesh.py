@@ -1,0 +1,68 @@
+"""Device-mesh construction (dp/tp/pp/sp/ep axes) over TPU ICI.
+
+The mesh is the TPU analog of the reference's device list + ps-lite node
+groups: rank = linear index in the mesh, num_workers = mesh size.  Axis
+ordering follows the scaling-book recipe: fastest-varying axes (tp/sp) map
+to the innermost ICI dimension.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as _np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..base import MXNetError
+
+
+@dataclass
+class MeshConfig:
+    dp: int = 1   # data parallel
+    tp: int = 1   # tensor parallel
+    pp: int = 1   # pipeline parallel
+    sp: int = 1   # sequence/context parallel
+    ep: int = 1   # expert parallel
+
+    def axes(self) -> Dict[str, int]:
+        return {k: v for k, v in
+                [("dp", self.dp), ("pp", self.pp), ("ep", self.ep),
+                 ("sp", self.sp), ("tp", self.tp)] if v > 1} or {"dp": 1}
+
+
+def make_mesh(config: Optional[MeshConfig] = None, devices=None,
+              **axis_sizes) -> Mesh:
+    """Build a Mesh. `make_mesh(dp=4, tp=2)` or `make_mesh(MeshConfig(...))`.
+
+    Axis order puts dp outermost and tp innermost so tensor-parallel
+    collectives ride the fastest ICI links.
+    """
+    if config is None:
+        config = MeshConfig(**axis_sizes) if axis_sizes else MeshConfig()
+    axes = config.axes()
+    devices = list(devices if devices is not None else jax.devices())
+    need = 1
+    for v in axes.values():
+        need *= v
+    if need > len(devices):
+        raise MXNetError(f"mesh needs {need} devices, have {len(devices)}")
+    devices = devices[:need]
+    arr = _np.array(devices).reshape(tuple(axes.values()))
+    return Mesh(arr, tuple(axes.keys()))
+
+
+def device_mesh(n_devices: Optional[int] = None, axis: str = "dp") -> Mesh:
+    """1-D mesh over the first n devices (the KVStore('tpu_sync') default)."""
+    devices = jax.devices()
+    if n_devices is not None:
+        devices = devices[:n_devices]
+    return Mesh(_np.array(devices), (axis,))
+
+
+def sharding(mesh: Mesh, *spec) -> NamedSharding:
+    return NamedSharding(mesh, P(*spec))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())

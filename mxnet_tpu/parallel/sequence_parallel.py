@@ -1,0 +1,133 @@
+"""Sequence/context parallelism: ring attention + Ulysses all-to-all.
+
+The reference has NO long-context parallelism (SURVEY.md §5 — 2017 codebase,
+attention absent); this module provides it as the new first-class capability:
+  - ring_attention: K/V blocks rotate around the mesh axis via
+    `lax.ppermute` while each device keeps its Q shard; softmax is computed
+    online (flash-style max/sum accumulators), so sequence length scales with
+    the number of devices at O(block²) memory per device.
+  - ulysses_attention: `lax.all_to_all` re-shards from sequence-parallel to
+    head-parallel, runs dense local attention, and re-shards back.
+
+Both are traceable and compose with jit/shard_map over a Mesh('sp') axis —
+collectives ride ICI.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.experimental.shard_map import shard_map
+
+from ..base import MXNetError
+
+NEG_INF = -1e30
+
+
+def _block_attn(q, k, v, scale, mask):
+    """One attention block: returns (unnormalized_out, row_sum, row_max).
+    q: (B,H,Tq,D) k/v: (B,H,Tk,D); mask broadcastable to (B,H,Tq,Tk)."""
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    if mask is not None:
+        s = jnp.where(mask, s, NEG_INF)
+    m = jnp.max(s, axis=-1)
+    p = jnp.exp(s - m[..., None])
+    l = jnp.sum(p, axis=-1)
+    o = jnp.einsum("bhqk,bhkd->bhqd", p, v)
+    return o, l, m
+
+
+def ring_attention(q, k, v, axis_name: str = "sp", causal: bool = False,
+                   scale: Optional[float] = None):
+    """Ring attention over sequence-sharded q/k/v (call inside shard_map).
+
+    Shapes per device: (batch, heads, seq_local, head_dim).  The global
+    sequence is the concatenation over the mesh axis in axis-index order.
+    """
+    n = lax.psum(1, axis_name)
+    my = lax.axis_index(axis_name)
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+    Tq = q.shape[2]
+    Tk = k.shape[2]
+    B, H = q.shape[0], q.shape[1]
+    acc_o = jnp.zeros(q.shape, jnp.float32)
+    acc_l = jnp.zeros((B, H, Tq), jnp.float32)
+    acc_m = jnp.full((B, H, Tq), NEG_INF, jnp.float32)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def body(i, carry):
+        acc_o, acc_l, acc_m, k_cur, v_cur = carry
+        src = (my - i) % n  # shard index of k_cur/v_cur
+        if causal:
+            q_pos = my * Tq + jnp.arange(Tq)
+            k_pos = src * Tk + jnp.arange(Tk)
+            mask = q_pos[:, None] >= k_pos[None, :]
+            mask = mask[None, None]
+        else:
+            mask = None
+        o, l, m = _block_attn(q.astype(jnp.float32), k_cur.astype(jnp.float32),
+                              v_cur.astype(jnp.float32), scale, mask)
+        m_new = jnp.maximum(acc_m, m)
+        corr_old = jnp.exp(acc_m - m_new)
+        corr_new = jnp.exp(m - m_new)
+        acc_o = acc_o * corr_old[..., None] + o * corr_new[..., None]
+        acc_l = acc_l * corr_old + l * corr_new
+        k_nxt = lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = lax.ppermute(v_cur, axis_name, perm)
+        return acc_o, acc_l, m_new, k_nxt, v_nxt
+
+    acc_o, acc_l, acc_m, _, _ = lax.fori_loop(
+        0, n, body, (acc_o, acc_l, acc_m, k, v))
+    out = acc_o / jnp.maximum(acc_l[..., None], 1e-30)
+    return out.astype(q.dtype)
+
+
+def ring_attention_sharded(q, k, v, mesh: Mesh, axis_name: str = "sp",
+                           causal: bool = False):
+    """Convenience wrapper: shard (B,H,T,D) arrays on T and run the ring."""
+    spec = P(None, None, axis_name, None)
+    fn = shard_map(
+        functools.partial(ring_attention, axis_name=axis_name, causal=causal),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec, check_rep=False)
+    return fn(q, k, v)
+
+
+def ulysses_attention(q, k, v, axis_name: str = "sp", causal: bool = False,
+                      scale: Optional[float] = None):
+    """Ulysses sequence parallelism (call inside shard_map).
+
+    Input: (B, H, T_local, D) sequence-sharded.  all_to_all → (B, H/n,
+    T_global, D) head-sharded, dense attention locally, all_to_all back.
+    Requires heads % mesh_axis_size == 0.
+    """
+    n = lax.psum(1, axis_name)
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+    # (B,H,Tl,D) -> (B,H/n,Tg,D): split heads, concat sequence
+    qg = lax.all_to_all(q, axis_name, split_axis=1, concat_axis=2, tiled=True)
+    kg = lax.all_to_all(k, axis_name, split_axis=1, concat_axis=2, tiled=True)
+    vg = lax.all_to_all(v, axis_name, split_axis=1, concat_axis=2, tiled=True)
+    Tg = qg.shape[2]
+    mask = None
+    if causal:
+        pos = jnp.arange(Tg)
+        mask = (pos[:, None] >= pos[None, :])[None, None]
+    o, l, m = _block_attn(qg.astype(jnp.float32), kg.astype(jnp.float32),
+                          vg.astype(jnp.float32), scale, mask)
+    o = o / jnp.maximum(l[..., None], 1e-30)
+    # back to sequence-sharded full heads
+    out = lax.all_to_all(o.astype(q.dtype), axis_name, split_axis=2,
+                         concat_axis=1, tiled=True)
+    return out
+
+
+def ulysses_attention_sharded(q, k, v, mesh: Mesh, axis_name: str = "sp",
+                              causal: bool = False):
+    spec = P(None, None, axis_name, None)
+    fn = shard_map(
+        functools.partial(ulysses_attention, axis_name=axis_name, causal=causal),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec, check_rep=False)
+    return fn(q, k, v)

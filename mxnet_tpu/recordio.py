@@ -1,0 +1,229 @@
+"""RecordIO: the reference's binary record container, bit-compatible.
+
+Parity: `python/mxnet/recordio.py` + dmlc-core recordio (consumed by
+src/io/iter_image_recordio*.cc).  Format: each record is
+  [kMagic=0xced7230a u32][lrec u32: cflag(2^29 field)|length][data][pad to 4B]
+IRHeader packs (flag u32, label f32, id u64, id2 u64) little-endian — files
+written by the reference's `tools/im2rec` load here unchanged.
+"""
+from __future__ import annotations
+
+import os
+import struct
+from collections import namedtuple
+
+import numpy as _np
+
+from .base import MXNetError
+
+_KMAGIC = 0xced7230a
+IRHeader = namedtuple("HEADER", ["flag", "label", "id", "id2"])
+_IR_FORMAT = "IfQQ"
+_IR_SIZE = struct.calcsize(_IR_FORMAT)
+
+
+class MXRecordIO:
+    """Sequential .rec reader/writer (parity: recordio.MXRecordIO)."""
+
+    def __init__(self, uri, flag):
+        self.uri = uri
+        self.flag = flag
+        self.record = None
+        self.is_open = False
+        self.open()
+
+    def open(self):
+        if self.flag == "w":
+            self.record = open(self.uri, "wb")
+            self.writable = True
+        elif self.flag == "r":
+            self.record = open(self.uri, "rb")
+            self.writable = False
+        else:
+            raise MXNetError("Invalid flag %s" % self.flag)
+        self.is_open = True
+
+    def close(self):
+        if self.is_open:
+            self.record.close()
+            self.is_open = False
+
+    def __del__(self):
+        self.close()
+
+    def __getstate__(self):
+        d = dict(self.__dict__)
+        d["is_open"] = False
+        d.pop("record", None)
+        return d
+
+    def __setstate__(self, d):
+        self.__dict__.update(d)
+        if d.get("was_open"):
+            self.open()
+
+    def reset(self):
+        self.close()
+        self.open()
+
+    def write(self, buf: bytes):
+        assert self.writable
+        data = struct.pack("<II", _KMAGIC, len(buf)) + buf
+        pad = (4 - (len(buf) % 4)) % 4
+        data += b"\x00" * pad
+        self.record.write(data)
+
+    def read(self):
+        assert not self.writable
+        header = self.record.read(8)
+        if len(header) < 8:
+            return None
+        magic, lrec = struct.unpack("<II", header)
+        if magic != _KMAGIC:
+            raise MXNetError("invalid record magic")
+        length = lrec & ((1 << 29) - 1)
+        buf = self.record.read(length)
+        pad = (4 - (length % 4)) % 4
+        if pad:
+            self.record.read(pad)
+        return buf
+
+    def tell(self):
+        return self.record.tell()
+
+
+class MXIndexedRecordIO(MXRecordIO):
+    """Indexed .rec + .idx random access (parity: recordio.MXIndexedRecordIO)."""
+
+    def __init__(self, idx_path, uri, flag, key_type=int):
+        self.idx_path = idx_path
+        self.idx = {}
+        self.keys = []
+        self.key_type = key_type
+        self.fidx = None
+        super().__init__(uri, flag)
+
+    def open(self):
+        super().open()
+        self.idx = {}
+        self.keys = []
+        if self.flag == "r" and os.path.isfile(self.idx_path):
+            with open(self.idx_path) as fin:
+                for line in fin:
+                    parts = line.strip().split("\t")
+                    key = self.key_type(parts[0])
+                    self.idx[key] = int(parts[1])
+                    self.keys.append(key)
+        elif self.flag == "w":
+            self.fidx = open(self.idx_path, "w")
+
+    def close(self):
+        if self.fidx is not None and not self.fidx.closed:
+            self.fidx.close()
+        super().close()
+
+    def seek(self, idx):
+        assert not self.writable
+        self.record.seek(self.idx[idx])
+
+    def read_idx(self, idx):
+        self.seek(idx)
+        return self.read()
+
+    def write_idx(self, idx, buf):
+        key = self.key_type(idx)
+        pos = self.tell()
+        self.write(buf)
+        self.fidx.write(f"{key}\t{pos}\n")
+        self.idx[key] = pos
+        self.keys.append(key)
+
+
+def pack(header: IRHeader, s: bytes) -> bytes:
+    """Pack a header + payload (parity: recordio.pack)."""
+    header = IRHeader(*header)
+    if isinstance(header.label, (list, tuple, _np.ndarray)):
+        label = _np.asarray(header.label, dtype=_np.float32)
+        header = header._replace(flag=label.size, label=0)
+        s = label.tobytes() + s
+    return struct.pack(_IR_FORMAT, *header) + s
+
+
+def unpack(s: bytes):
+    """Unpack to (header, payload) (parity: recordio.unpack)."""
+    header = IRHeader(*struct.unpack(_IR_FORMAT, s[:_IR_SIZE]))
+    s = s[_IR_SIZE:]
+    if header.flag > 0:
+        label = _np.frombuffer(s[:header.flag * 4], _np.float32)
+        header = header._replace(label=label)
+        s = s[header.flag * 4:]
+    return header, s
+
+
+def unpack_img(s, iscolor=-1):
+    """Unpack to (header, image ndarray) — decodes jpeg/png payloads."""
+    header, s = unpack(s)
+    img = _imdecode_bytes(s, iscolor)
+    return header, img
+
+
+def pack_img(header, img, quality=95, img_fmt=".jpg"):
+    encoded = _imencode(img, quality, img_fmt)
+    return pack(header, encoded)
+
+
+def _imdecode_bytes(buf: bytes, iscolor=-1):
+    try:
+        import cv2
+        return cv2.imdecode(_np.frombuffer(buf, _np.uint8), iscolor)
+    except ImportError:
+        pass
+    try:
+        from PIL import Image
+        import io as _io
+        img = _np.asarray(Image.open(_io.BytesIO(buf)))
+        if img.ndim == 3:
+            img = img[:, :, ::-1]  # RGB->BGR to match cv2 convention
+        return img
+    except ImportError:
+        # raw numpy payload fallback (pack_img with ".npy")
+        import io as _io
+        try:
+            return _np.load(_io.BytesIO(buf), allow_pickle=False)
+        except Exception:
+            raise MXNetError("no image decoder available (cv2/PIL missing) "
+                             "and payload is not .npy")
+
+
+def _imencode(img, quality=95, img_fmt=".jpg"):
+    if img_fmt == ".npy":
+        import io as _io
+        b = _io.BytesIO()
+        _np.save(b, _np.asarray(img), allow_pickle=False)
+        return b.getvalue()
+    try:
+        import cv2
+        if img_fmt in (".jpg", ".jpeg"):
+            ret, buf = cv2.imencode(img_fmt, img,
+                                    [cv2.IMWRITE_JPEG_QUALITY, quality])
+        else:
+            ret, buf = cv2.imencode(img_fmt, img)
+        assert ret
+        return buf.tobytes()
+    except ImportError:
+        pass
+    try:
+        from PIL import Image
+        import io as _io
+        b = _io.BytesIO()
+        arr = _np.asarray(img)
+        if arr.ndim == 3:
+            arr = arr[:, :, ::-1]
+        Image.fromarray(arr).save(b, format="JPEG" if "jp" in img_fmt else "PNG",
+                                  quality=quality)
+        return b.getvalue()
+    except ImportError:
+        import io as _io
+        b = _io.BytesIO()
+        _np.save(b, _np.asarray(img), allow_pickle=False)
+        return b.getvalue()
