@@ -49,3 +49,4 @@ from . import model
 from . import module
 from . import module as mod
 from . import parallel
+from . import gluon

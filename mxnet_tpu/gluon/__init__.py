@@ -1,0 +1,10 @@
+"""`mx.gluon` namespace (parity: python/mxnet/gluon/__init__.py)."""
+from .parameter import Parameter, Constant, ParameterDict, DeferredInitializationError
+from .block import Block, HybridBlock, SymbolBlock, CachedOp
+from .trainer import Trainer
+from . import nn
+from . import rnn
+from . import loss
+from . import data
+from . import utils
+from . import model_zoo

@@ -1,0 +1,451 @@
+"""gluon Block / HybridBlock / SymbolBlock (parity: python/mxnet/gluon/block.py).
+
+hybridize() parity with the TPU twist: `_build_cache` traces hybrid_forward
+with Symbol placeholders into a graph (block.py:381-384 in the reference) and
+compiles it whole through `jax.jit` (the CachedOp below) — XLA fuses the
+entire block into one executable, the reason hybridize exists.  Eager mode
+runs the same hybrid_forward with `F = mx.nd` and records on the autograd
+tape.
+"""
+from __future__ import annotations
+
+import copy
+import threading
+from collections import OrderedDict
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as _np
+
+from ..base import MXNetError
+from ..context import cpu
+from .. import ndarray as nd
+from ..ndarray import NDArray
+from .. import symbol as sym_mod
+from ..symbol import Symbol
+from ..symbol.graph import GraphPlan, infer_shapes_types
+from .. import autograd
+from .parameter import Parameter, ParameterDict, DeferredInitializationError
+
+
+class _BlockScope:
+    _current = threading.local()
+
+    def __init__(self, block):
+        self._block = block
+        self._counter = {}
+        self._old_scope = None
+        self._name_scope = None
+
+    @staticmethod
+    def create(prefix, params, hint):
+        current = getattr(_BlockScope._current, "value", None)
+        if current is None:
+            if prefix is None:
+                from ..name import NameManager
+                prefix = NameManager.current().get(None, hint) + "_"
+            if params is None:
+                params = ParameterDict(prefix)
+            else:
+                params = ParameterDict(params.prefix, params)
+            return prefix, params
+        if prefix is None:
+            count = current._counter.get(hint, 0)
+            prefix = f"{hint}{count}_"
+            current._counter[hint] = count + 1
+        if params is None:
+            parent = current._block.params
+            params = ParameterDict(parent.prefix + prefix, parent._shared)
+        else:
+            params = ParameterDict(params.prefix, params)
+        return current._block.prefix + prefix, params
+
+    def __enter__(self):
+        if self._block._empty_prefix:
+            return self
+        self._old_scope = getattr(_BlockScope._current, "value", None)
+        _BlockScope._current.value = self
+        from ..name import NameManager, Prefix
+        self._name_scope = Prefix(self._block.prefix)
+        self._name_scope.__enter__()
+        return self
+
+    def __exit__(self, ptype, value, trace):
+        if self._block._empty_prefix:
+            return
+        self._name_scope.__exit__(ptype, value, trace)
+        self._name_scope = None
+        _BlockScope._current.value = self._old_scope
+
+
+def _flatten(args, inout_str="input"):
+    if isinstance(args, NDArray) or isinstance(args, Symbol):
+        return [args], int(0)
+    if args is None:
+        return [None], None
+    assert isinstance(args, (list, tuple)), \
+        f"{inout_str} must be (nested) list of Symbol or NDArray, got {args}"
+    flat = []
+    fmts = []
+    for i in args:
+        arg, fmt = _flatten(i, inout_str)
+        flat.extend(arg)
+        fmts.append(fmt)
+    return flat, fmts
+
+
+def _regroup(args, fmt):
+    if isinstance(fmt, int):
+        if fmt == 0:
+            return args[0], args[1:]
+        return args[:fmt], args[fmt:]
+    if fmt is None:
+        return None, args[1:]
+    assert isinstance(fmt, (list, tuple))
+    ret = []
+    for i in fmt:
+        res, args = _regroup(args, i)
+        ret.append(res)
+    return ret, args
+
+
+class Block:
+    """Base building block (parity: gluon/block.py:121)."""
+
+    def __init__(self, prefix=None, params=None):
+        self._empty_prefix = prefix == ""
+        self._prefix, self._params = _BlockScope.create(
+            prefix, params, self._alias())
+        self._name = self._prefix[:-1] if self._prefix.endswith("_") \
+            else self._prefix
+        self._scope = _BlockScope(self)
+        self._children: List["Block"] = []
+
+    def __repr__(self):
+        s = "{name}(\n{modstr}\n)"
+        modstr = "\n".join(f"  ({i}): {repr(b)}"
+                           for i, b in enumerate(self._children))
+        return s.format(name=self.__class__.__name__, modstr=modstr)
+
+    def __setattr__(self, name, value):
+        if isinstance(value, Block):
+            self.register_child(value)
+        super().__setattr__(name, value)
+
+    def _alias(self):
+        return self.__class__.__name__.lower()
+
+    @property
+    def prefix(self):
+        return self._prefix
+
+    @property
+    def name(self):
+        return self._name
+
+    def name_scope(self):
+        return self._scope
+
+    @property
+    def params(self) -> ParameterDict:
+        return self._params
+
+    def collect_params(self, select=None) -> ParameterDict:
+        ret = ParameterDict(self._params.prefix)
+        if select is None:
+            ret.update(self.params)
+        else:
+            import re
+            pattern = re.compile(select)
+            ret.update({name: value for name, value in self.params.items()
+                        if pattern.match(name)})
+        for cld in self._children:
+            ret.update(cld.collect_params(select=select))
+        return ret
+
+    def save_params(self, filename):
+        self.collect_params().save(filename, strip_prefix=self.prefix)
+
+    def load_params(self, filename, ctx=None, allow_missing=False,
+                    ignore_extra=False):
+        self.collect_params().load(filename, ctx, allow_missing, ignore_extra,
+                                   self.prefix)
+
+    def register_child(self, block):
+        self._children.append(block)
+
+    def initialize(self, init=None, ctx=None, verbose=False,
+                   force_reinit=False):
+        from .. import initializer
+        self.collect_params().initialize(init or initializer.Uniform(),
+                                         ctx, verbose, force_reinit)
+
+    def hybridize(self, active=True, **kwargs):
+        for cld in self._children:
+            cld.hybridize(active, **kwargs)
+
+    def cast(self, dtype):
+        for child in self._children:
+            child.cast(dtype)
+        for _, param in self.params.items():
+            param.cast(dtype)
+
+    def __call__(self, *args):
+        return self.forward(*args)
+
+    def forward(self, *args):
+        raise NotImplementedError
+
+
+class CachedOp:
+    """Compiled graph closure (parity: Imperative::CachedOp,
+    src/imperative/cached_op.cc).
+
+    Both directions are jitted: forward is one XLA executable; the backward
+    stored on the autograd tape is a second executable computing the vjp
+    (forward recomputed inside the compiled program, fused by XLA) — the
+    TPU analog of CachedOp's cached forward/backward graphs
+    (cached_op.cc:179,227).
+    """
+
+    def __init__(self, symbol: Symbol):
+        self.symbol = symbol
+        self.plan = GraphPlan(symbol)
+        self._fwd = jax.jit(
+            lambda args, aux, key, t: self.plan.run(args, aux, key, t),
+            static_argnums=(3,))
+        self._bwd_cache = {}
+
+    def _run_all(self, names, vals_list, aux_vals, key, is_train):
+        d = dict(zip(names, vals_list))
+        outs, new_aux = self.plan.run(d, aux_vals, key, is_train)
+        return tuple(outs) + tuple(new_aux[k] for k in sorted(new_aux))
+
+    def _get_bwd(self, names):
+        key_ = tuple(names)
+        if key_ not in self._bwd_cache:
+            plan = self.plan
+
+            def bwd(primals, cots, aux_vals, key, is_train):
+                def run(*vals):
+                    d = dict(zip(key_, vals))
+                    outs, new_aux = plan.run(d, aux_vals, key, is_train)
+                    return tuple(outs) + tuple(new_aux[k] for k in sorted(new_aux))
+
+                _, vjp_fn = jax.vjp(run, *primals)
+                return vjp_fn(cots)
+
+            self._bwd_cache[key_] = jax.jit(bwd, static_argnums=(4,))
+        return self._bwd_cache[key_]
+
+    def __call__(self, arg_arrays: Dict[str, NDArray],
+                 aux_arrays: Dict[str, NDArray], ctx):
+        from .. import random as _random
+        is_train = autograd.is_training()
+        arg_vals = {k: v._data for k, v in arg_arrays.items()}
+        aux_vals = {k: v._data for k, v in aux_arrays.items()}
+        key = _random.next_key()
+        outs, new_aux = self._fwd(arg_vals, aux_vals, key, is_train)
+        out_nds = [NDArray(o, ctx) for o in outs]
+        if autograd.is_recording():
+            names = list(arg_vals.keys())
+            primals = tuple(arg_vals[n] for n in names)
+            bwd_jit = self._get_bwd(names)
+            aux_snapshot = dict(aux_vals)
+            raw_outs = tuple(outs) + tuple(new_aux[k] for k in sorted(new_aux))
+
+            def vjp_fn(cots):
+                return bwd_jit(primals, tuple(cots), aux_snapshot, key, is_train)
+
+            autograd._record(None, [arg_arrays[n] for n in names], out_nds,
+                             vjp_fn, raw_outs)
+        for k, v in new_aux.items():
+            aux_arrays[k]._set_data(v)
+        return out_nds
+
+
+class HybridBlock(Block):
+    """Parity: gluon/block.py:321."""
+
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._reg_params: Dict[str, Parameter] = {}
+        self._cached_graph = ()
+        self._cached_op = None
+        self._active = False
+        self._flags = {}
+
+    def __setattr__(self, name, value):
+        if isinstance(value, Parameter):
+            self._reg_params[name] = value
+        if isinstance(value, HybridBlock):
+            self._clear_cached_op()
+        super().__setattr__(name, value)
+
+    def register_child(self, block):
+        if not isinstance(block, HybridBlock):
+            raise ValueError(
+                "Children of HybridBlock must also be HybridBlock, but "
+                f"{str(block)} has type {str(type(block))}.")
+        super().register_child(block)
+        self._clear_cached_op()
+
+    def hybridize(self, active=True, **kwargs):
+        self._active = active
+        self._flags = kwargs
+        self._clear_cached_op()
+        super().hybridize(active, **kwargs)
+
+    def cast(self, dtype):
+        self._clear_cached_op()
+        super().cast(dtype)
+
+    def _clear_cached_op(self):
+        self._cached_graph = ()
+        self._cached_op = None
+
+    def _get_graph(self, *args):
+        if not self._cached_graph:
+            flat_args, self._in_format = _flatten(args)
+            inputs = [sym_mod.Variable(f"data{i}") if len(flat_args) > 1
+                      else sym_mod.Variable("data")
+                      for i in range(len(flat_args))]
+            grouped, _ = _regroup(inputs, self._in_format)
+            params = {name: p.var() for name, p in self._reg_params.items()}
+            with self.name_scope():
+                out = self.hybrid_forward(sym_mod, grouped, **params) \
+                    if not isinstance(grouped, list) else \
+                    self.hybrid_forward(sym_mod, *grouped, **params)
+            flat_out, self._out_format = _flatten(out, "output")
+            self._cached_graph = (inputs, sym_mod.Group(flat_out))
+        return self._cached_graph
+
+    def infer_shape(self, *args):
+        self._infer_attrs("shape", *args)
+
+    def _infer_attrs(self, attr, *args):
+        inputs, out = self._get_graph(*args)
+        flat_args, _ = _flatten(args)
+        shapes = {i.name: a.shape for i, a in zip(inputs, flat_args)}
+        plan, info, _ = infer_shapes_types(out, shapes, {}, partial=False)
+        all_params = {p.name: p for p in self._all_params()}
+        for name, struct in info.items():
+            if name in all_params and struct is not None:
+                all_params[name].shape = tuple(struct.shape)
+
+    def _all_params(self):
+        out = list(self.collect_params().values())
+        return out
+
+    def _build_cache(self, *args):
+        inputs, out = self._get_graph(*args)
+        self._cached_op = CachedOp(out)
+        # map graph input names → (is_param, source)
+        params = {p.name: p for p in self._all_params()}
+        self._cached_input_names = [i.name for i in inputs]
+        self._cached_params = {
+            n: params[n] for n in out.list_inputs() if n in params}
+        self._cached_aux = set(out.list_auxiliary_states())
+
+    def _call_cached_op(self, *args):
+        if self._cached_op is None:
+            self._build_cache(*args)
+        flat_args, fmt = _flatten(args)
+        arg_dict = {}
+        aux_dict = {}
+        for name, arr in zip(self._cached_input_names, flat_args):
+            arg_dict[name] = arr
+        for name, p in self._cached_params.items():
+            if name in self._cached_aux:
+                aux_dict[name] = p.data()
+            else:
+                arg_dict[name] = p.data()
+        ctx = flat_args[0].context if flat_args else cpu()
+        out = self._cached_op(arg_dict, aux_dict, ctx)
+        ret, _ = _regroup(out, self._out_format)
+        return ret
+
+    def forward(self, x, *args):
+        if isinstance(x, NDArray):
+            if self._active:
+                try:
+                    return self._call_cached_op(x, *args)
+                except DeferredInitializationError:
+                    self._deferred_infer_shape(x, *args)
+                    for p in self.collect_params().values():
+                        p._finish_deferred_init()
+                    return self._call_cached_op(x, *args)
+            try:
+                params = {name: p.data() for name, p in self._reg_params.items()}
+            except DeferredInitializationError:
+                self._deferred_infer_shape(x, *args)
+                for _, p in self._reg_params.items():
+                    p._finish_deferred_init()
+                params = {name: p.data() for name, p in self._reg_params.items()}
+            return self.hybrid_forward(nd, x, *args, **params)
+        assert isinstance(x, Symbol), \
+            f"HybridBlock requires the first argument to forward be either " \
+            f"Symbol or NDArray, but got {type(x)}"
+        params = {name: p.var() for name, p in self._reg_params.items()}
+        with self.name_scope():
+            return self.hybrid_forward(sym_mod, x, *args, **params)
+
+    def _deferred_infer_shape(self, *args):
+        try:
+            self.infer_shape(*args)
+        except Exception as e:
+            raise ValueError(
+                f"Deferred initialization failed because shape cannot be "
+                f"inferred: {e}")
+
+    def hybrid_forward(self, F, x, *args, **kwargs):
+        raise NotImplementedError
+
+
+class SymbolBlock(HybridBlock):
+    """Wrap a Symbol as a Block (parity: gluon/block.py:542)."""
+
+    def __init__(self, outputs, inputs, params=None):
+        super().__init__(prefix=None, params=params)
+        self._prefix = ""
+        self._params = ParameterDict("", params)
+        if isinstance(inputs, Symbol) and len(inputs) == 1:
+            inputs = [inputs]
+        if isinstance(outputs, (list, tuple)) and len(outputs) == 1:
+            outputs = outputs[0]
+        if isinstance(outputs, (list, tuple)):
+            outputs = sym_mod.Group(outputs)
+        syms = inputs
+        input_names = {i.name for i in syms}
+        for name in outputs.list_arguments():
+            if name not in input_names:
+                self.params.get(name, allow_deferred_init=True)
+        for name in outputs.list_auxiliary_states():
+            if name not in input_names:
+                self.params.get(name, grad_req="null",
+                                allow_deferred_init=True)
+        self._cached_graph = (syms, outputs)
+        self._reg_params = {}
+
+    def forward(self, x, *args):
+        if isinstance(x, NDArray):
+            return self._call_cached_op(x, *args)
+        assert isinstance(x, Symbol)
+        ret = copy.copy(self._cached_graph[1])
+        ret._compose(**{self._cached_graph[0][0].name: x})
+        return ret
+
+    def _build_cache(self, *args):
+        inputs, out = self._cached_graph
+        flat_args, self._in_format = _flatten(args)
+        self._out_format = int(0) if len(out) == 1 else [int(0)] * len(out)
+        self._cached_op = CachedOp(out)
+        params = {p.name: p for p in self.params.values()}
+        self._cached_input_names = [i.name for i in inputs]
+        self._cached_params = {
+            n: params[n] for n in out.list_inputs() if n in params}
+        self._cached_aux = set(out.list_auxiliary_states())
+
+    def hybrid_forward(self, F, x, *args, **kwargs):
+        raise NotImplementedError

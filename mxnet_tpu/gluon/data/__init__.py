@@ -1,0 +1,5 @@
+"""gluon.data namespace (parity: python/mxnet/gluon/data/__init__.py)."""
+from .dataset import Dataset, SimpleDataset, ArrayDataset, RecordFileDataset
+from .sampler import Sampler, SequentialSampler, RandomSampler, BatchSampler
+from .dataloader import DataLoader
+from . import vision

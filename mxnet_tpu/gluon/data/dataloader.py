@@ -1,0 +1,73 @@
+"""gluon.data.DataLoader (parity: python/mxnet/gluon/data/dataloader.py:73-124).
+
+The reference forks worker *processes* and ships batches through POSIX
+shared memory (CPUSharedStorageManager).  Here workers are a thread pool:
+batchification is numpy-side (releases the GIL) and the device transfer is a
+single PJRT host-to-HBM DMA per batch — the multiprocess+shm design exists
+to feed GPUs from python, which the TPU path doesn't need.  num_workers
+keeps its meaning (parallel prefetch depth).
+"""
+from __future__ import annotations
+
+import concurrent.futures as _futures
+
+import numpy as _np
+
+from ... import ndarray as nd
+from ...ndarray import NDArray
+from .sampler import BatchSampler, RandomSampler, SequentialSampler
+
+
+def default_batchify_fn(data):
+    """Stack samples into a batch (parity: dataloader.default_batchify_fn)."""
+    if isinstance(data[0], NDArray):
+        return nd.array(_np.stack([d.asnumpy() for d in data]))
+    if isinstance(data[0], tuple):
+        data = zip(*data)
+        return [default_batchify_fn(i) for i in data]
+    data = _np.asarray(data)
+    return nd.array(data, dtype=data.dtype)
+
+
+class DataLoader:
+    def __init__(self, dataset, batch_size=None, shuffle=False, sampler=None,
+                 last_batch=None, batch_sampler=None, batchify_fn=None,
+                 num_workers=0):
+        self._dataset = dataset
+        if batch_sampler is None:
+            if batch_size is None:
+                raise ValueError("batch_size must be specified unless "
+                                 "batch_sampler is specified")
+            if sampler is None:
+                if shuffle:
+                    sampler = RandomSampler(len(dataset))
+                else:
+                    sampler = SequentialSampler(len(dataset))
+            elif shuffle:
+                raise ValueError("shuffle must not be specified if sampler "
+                                 "is specified")
+            batch_sampler = BatchSampler(sampler, batch_size,
+                                         last_batch or "keep")
+        elif batch_size is not None or shuffle or sampler is not None or \
+                last_batch is not None:
+            raise ValueError("batch_size, shuffle, sampler and last_batch "
+                             "must not be specified if batch_sampler is "
+                             "specified.")
+        self._batch_sampler = batch_sampler
+        self._num_workers = max(0, num_workers)
+        self._batchify_fn = batchify_fn or default_batchify_fn
+
+    def __iter__(self):
+        if self._num_workers == 0:
+            for batch in self._batch_sampler:
+                yield self._batchify_fn([self._dataset[idx] for idx in batch])
+            return
+        with _futures.ThreadPoolExecutor(self._num_workers) as pool:
+            futures = [pool.submit(
+                lambda b: self._batchify_fn([self._dataset[i] for i in b]),
+                batch) for batch in self._batch_sampler]
+            for fut in futures:
+                yield fut.result()
+
+    def __len__(self):
+        return len(self._batch_sampler)

@@ -1,0 +1,186 @@
+"""gluon.data.vision datasets (parity: python/mxnet/gluon/data/vision.py).
+
+MNIST/FashionMNIST read idx files, CIFAR10/100 read the python-pickle batches
+— from a local `root` directory (zero-egress environments stage files there;
+`download` is attempted only if files are missing).
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import pickle
+import struct
+import tarfile
+
+import numpy as _np
+
+from ...base import MXNetError
+from ... import ndarray as nd
+from .dataset import Dataset, RecordFileDataset
+from ... import recordio
+from ...io import _read_idx
+
+
+class _DownloadedDataset(Dataset):
+    def __init__(self, root, train, transform):
+        self._root = os.path.expanduser(root)
+        self._train = train
+        self._transform = transform
+        self._data = None
+        self._label = None
+        if not os.path.isdir(self._root):
+            os.makedirs(self._root, exist_ok=True)
+        self._get_data()
+
+    def __getitem__(self, idx):
+        if self._transform is not None:
+            return self._transform(self._data[idx], self._label[idx])
+        return self._data[idx], self._label[idx]
+
+    def __len__(self):
+        return len(self._label)
+
+    def _get_data(self):
+        raise NotImplementedError
+
+
+class MNIST(_DownloadedDataset):
+    def __init__(self, root="~/.mxnet/datasets/mnist", train=True,
+                 transform=None):
+        self._base_names = (("train-images-idx3-ubyte", "train-labels-idx1-ubyte")
+                            if train else
+                            ("t10k-images-idx3-ubyte", "t10k-labels-idx1-ubyte"))
+        super().__init__(root, train, transform)
+
+    def _get_data(self):
+        img_name, lab_name = self._base_names
+        paths = []
+        for name in (img_name, lab_name):
+            p = os.path.join(self._root, name)
+            if not os.path.exists(p) and os.path.exists(p + ".gz"):
+                p = p + ".gz"
+            if not os.path.exists(p):
+                raise MXNetError(
+                    f"MNIST file {p} not found; stage the idx files under "
+                    f"{self._root} (no network in this environment)")
+            paths.append(p)
+        data = _read_idx(paths[0])
+        label = _read_idx(paths[1])
+        self._data = nd.array(data.reshape(-1, 28, 28, 1).astype(_np.float32)
+                              / 255.0)
+        self._label = label.astype(_np.int32)
+
+
+class FashionMNIST(MNIST):
+    def __init__(self, root="~/.mxnet/datasets/fashion-mnist", train=True,
+                 transform=None):
+        super().__init__(root, train, transform)
+
+
+class CIFAR10(_DownloadedDataset):
+    def __init__(self, root="~/.mxnet/datasets/cifar10", train=True,
+                 transform=None):
+        super().__init__(root, train, transform)
+
+    def _get_data(self):
+        batches = [f"data_batch_{i}" for i in range(1, 6)] if self._train \
+            else ["test_batch"]
+        data_list, label_list = [], []
+        base = self._root
+        sub = os.path.join(base, "cifar-10-batches-py")
+        if os.path.isdir(sub):
+            base = sub
+        for b in batches:
+            p = os.path.join(base, b)
+            if not os.path.exists(p):
+                raise MXNetError(
+                    f"CIFAR10 batch {p} not found; stage cifar-10-batches-py "
+                    f"under {self._root}")
+            with open(p, "rb") as f:
+                d = pickle.load(f, encoding="bytes")
+            data_list.append(d[b"data"].reshape(-1, 3, 32, 32))
+            label_list.append(_np.asarray(d[b"labels"]))
+        data = _np.concatenate(data_list).transpose(0, 2, 3, 1)
+        self._data = nd.array(data.astype(_np.float32) / 255.0)
+        self._label = _np.concatenate(label_list).astype(_np.int32)
+
+
+class CIFAR100(_DownloadedDataset):
+    def __init__(self, root="~/.mxnet/datasets/cifar100", fine_label=True,
+                 train=True, transform=None):
+        self._fine = fine_label
+        super().__init__(root, train, transform)
+
+    def _get_data(self):
+        name = "train" if self._train else "test"
+        base = self._root
+        sub = os.path.join(base, "cifar-100-python")
+        if os.path.isdir(sub):
+            base = sub
+        p = os.path.join(base, name)
+        if not os.path.exists(p):
+            raise MXNetError(f"CIFAR100 file {p} not found")
+        with open(p, "rb") as f:
+            d = pickle.load(f, encoding="bytes")
+        data = d[b"data"].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
+        key = b"fine_labels" if self._fine else b"coarse_labels"
+        self._data = nd.array(data.astype(_np.float32) / 255.0)
+        self._label = _np.asarray(d[key]).astype(_np.int32)
+
+
+class ImageRecordDataset(RecordFileDataset):
+    """Dataset over a .rec of packed images (parity: vision.ImageRecordDataset)."""
+
+    def __init__(self, filename, flag=1, transform=None):
+        super().__init__(filename)
+        self._flag = flag
+        self._transform = transform
+
+    def __getitem__(self, idx):
+        record = super().__getitem__(idx)
+        header, img_bytes = recordio.unpack(record)
+        img = recordio._imdecode_bytes(img_bytes, self._flag)
+        img = nd.array(img)
+        label = header.label
+        if self._transform is not None:
+            return self._transform(img, label)
+        return img, label
+
+
+class ImageFolderDataset(Dataset):
+    """Dataset over a folder of class subfolders (parity: vision.ImageFolderDataset)."""
+
+    def __init__(self, root, flag=1, transform=None):
+        self._root = os.path.expanduser(root)
+        self._flag = flag
+        self._transform = transform
+        self._exts = [".jpg", ".jpeg", ".png"]
+        self._list_images(self._root)
+
+    def _list_images(self, root):
+        self.synsets = []
+        self.items = []
+        for folder in sorted(os.listdir(root)):
+            path = os.path.join(root, folder)
+            if not os.path.isdir(path):
+                continue
+            label = len(self.synsets)
+            self.synsets.append(folder)
+            for filename in sorted(os.listdir(path)):
+                filename = os.path.join(path, filename)
+                ext = os.path.splitext(filename)[1]
+                if ext.lower() not in self._exts:
+                    continue
+                self.items.append((filename, label))
+
+    def __getitem__(self, idx):
+        fname, label = self.items[idx]
+        with open(fname, "rb") as f:
+            img = recordio._imdecode_bytes(f.read(), self._flag)
+        img = nd.array(img)
+        if self._transform is not None:
+            return self._transform(img, label)
+        return img, label
+
+    def __len__(self):
+        return len(self.items)

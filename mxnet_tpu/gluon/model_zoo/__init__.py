@@ -1,0 +1,3 @@
+"""gluon.model_zoo (parity: python/mxnet/gluon/model_zoo/__init__.py)."""
+from . import vision
+from .vision import get_model

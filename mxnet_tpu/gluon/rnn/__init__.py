@@ -1,0 +1,5 @@
+"""gluon.rnn namespace (parity: python/mxnet/gluon/rnn/__init__.py)."""
+from .rnn_cell import (RecurrentCell, HybridRecurrentCell, RNNCell, LSTMCell,
+                       GRUCell, SequentialRNNCell, DropoutCell, ModifierCell,
+                       ResidualCell, ZoneoutCell, BidirectionalCell)
+from .rnn_layer import RNN, LSTM, GRU

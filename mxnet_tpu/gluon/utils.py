@@ -1,0 +1,97 @@
+"""gluon.utils (parity: python/mxnet/gluon/utils.py): split_and_load,
+clip_global_norm, check_sha1, download (gated: zero-egress environments)."""
+from __future__ import annotations
+
+import hashlib
+import math
+import os
+
+import numpy as _np
+
+from ..base import MXNetError
+from .. import ndarray as nd
+from ..ndarray import NDArray
+
+
+def split_data(data, num_slice, batch_axis=0, even_split=True):
+    size = data.shape[batch_axis]
+    if size < num_slice:
+        raise ValueError(
+            f"Too many slices for data with shape {data.shape}. Arguments are "
+            f"num_slice={num_slice} and batch_axis={batch_axis}.")
+    if even_split and size % num_slice != 0:
+        raise ValueError(
+            f"data with shape {data.shape} cannot be evenly split into "
+            f"{num_slice} slices along axis {batch_axis}.")
+    step = size // num_slice
+    if batch_axis == 0:
+        slices = [data[i * step:(i + 1) * step] if i < num_slice - 1 else
+                  data[i * step:size] for i in range(num_slice)]
+    else:
+        slices = [nd.slice_axis(data, batch_axis, i * step, (i + 1) * step)
+                  for i in range(num_slice)]
+    return slices
+
+
+def split_and_load(data, ctx_list, batch_axis=0, even_split=True):
+    if not isinstance(data, NDArray):
+        data = nd.array(data, ctx=ctx_list[0])
+    if len(ctx_list) == 1:
+        return [data.as_in_context(ctx_list[0])]
+    slices = split_data(data, len(ctx_list), batch_axis, even_split)
+    return [i.as_in_context(ctx) for i, ctx in zip(slices, ctx_list)]
+
+
+def clip_global_norm(arrays, max_norm):
+    """Rescale arrays so that the sum of their 2-norms is <= max_norm."""
+    assert len(arrays) > 0
+    total_norm = 0.0
+    for arr in arrays:
+        total_norm += float((arr.reshape((-1,)) ** 2).sum().asscalar())
+    total_norm = math.sqrt(total_norm)
+    if math.isnan(total_norm) or math.isinf(total_norm):
+        import warnings
+        warnings.warn("nan or inf is detected. Clipping results will be "
+                      "undefined.", stacklevel=2)
+    scale = max_norm / (total_norm + 1e-8)
+    if scale < 1.0:
+        for arr in arrays:
+            arr *= scale
+    return total_norm
+
+
+def check_sha1(filename, sha1_hash):
+    sha1 = hashlib.sha1()
+    with open(filename, "rb") as f:
+        while True:
+            data = f.read(1048576)
+            if not data:
+                break
+            sha1.update(data)
+    return sha1.hexdigest() == sha1_hash
+
+
+def download(url, path=None, overwrite=False, sha1_hash=None):
+    """Download a file (requires network; raises in zero-egress environments
+    with a pointer to pre-staged files)."""
+    if path is None:
+        fname = url.split("/")[-1]
+    elif os.path.isdir(path):
+        fname = os.path.join(path, url.split("/")[-1])
+    else:
+        fname = path
+    if not overwrite and os.path.exists(fname) and (
+            not sha1_hash or check_sha1(fname, sha1_hash)):
+        return fname
+    try:
+        import urllib.request
+        print(f"Downloading {fname} from {url}...")
+        urllib.request.urlretrieve(url, fname)
+    except Exception as e:
+        raise MXNetError(
+            f"download of {url} failed ({e}); in offline environments stage "
+            f"the file at {fname} manually") from None
+    if sha1_hash and not check_sha1(fname, sha1_hash):
+        raise UserWarning(f"File {fname} is downloaded but the content hash "
+                          "does not match.")
+    return fname

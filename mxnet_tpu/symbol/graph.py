@@ -234,8 +234,11 @@ def infer_shapes_types(symbol: Symbol, known_shapes: Dict[str, tuple],
                 if n.is_var and n.name == nm and "__shape__" in n.attrs:
                     node_attr_shape = eval(n.attrs["__shape__"], {"__builtins__": {}})
             shp = node_attr_shape
+        if shp is not None and any(int(d) == 0 for d in shp):
+            shp = None  # 0-dims mean "unknown" (deferred-init parameters)
         if shp is not None:
-            info[nm] = jax.ShapeDtypeStruct(tuple(shp), np_dtype(dt))
+            info[nm] = jax.ShapeDtypeStruct(tuple(int(d) for d in shp),
+                                            np_dtype(dt))
         else:
             info[nm] = None
 
