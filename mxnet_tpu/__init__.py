@@ -50,3 +50,12 @@ from . import module
 from . import module as mod
 from . import parallel
 from . import gluon
+from . import profiler
+from . import monitor
+from .monitor import Monitor
+from . import visualization as viz
+from . import test_utils
+from . import rnn
+from . import image
+from . import rtc
+from . import contrib

@@ -15,6 +15,12 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as _np
 
+import jax as _jax
+
+# float64 NDArrays are part of the reference API surface (mx.nd.array keeps
+# numpy float64); TPU code paths stay f32/bf16 — x64 only widens CPU-side use.
+_jax.config.update("jax_enable_x64", True)
+
 
 class MXNetError(RuntimeError):
     """Error raised by the framework (parity: mxnet.base.MXNetError)."""

@@ -1,0 +1,5 @@
+"""mx.contrib (parity: python/mxnet/contrib/) — contrib ops + bridges."""
+from . import ndarray
+from . import symbol
+from . import autograd
+from . import tensorboard

@@ -1,0 +1,9 @@
+"""Old-style contrib autograd API (parity: python/mxnet/contrib/autograd.py)."""
+from ..autograd import (record as train_section, pause as test_section,
+                        set_recording, is_recording, mark_variables,
+                        backward, grad)
+
+
+def compute_gradient(outputs):
+    backward(outputs)
+    return [o.grad for o in outputs]

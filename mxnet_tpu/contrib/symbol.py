@@ -1,0 +1,13 @@
+"""mx.contrib.symbol: contrib op wrappers producing Symbols."""
+from ..symbol.register import _gen as _g
+
+ctc_loss = _g.ctc_loss
+CTCLoss = _g.CTCLoss
+fft = _g.fft
+ifft = _g.ifft
+quantize = _g._contrib_quantize
+dequantize = _g._contrib_dequantize
+count_sketch = _g._contrib_count_sketch
+MultiBoxPrior = _g.MultiBoxPrior
+MultiBoxTarget = _g.MultiBoxTarget
+MultiBoxDetection = _g.MultiBoxDetection

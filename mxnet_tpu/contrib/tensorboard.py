@@ -1,0 +1,29 @@
+"""TensorBoard bridge (parity: python/mxnet/contrib/tensorboard.py)."""
+from __future__ import annotations
+
+
+class LogMetricsCallback:
+    """Log metrics to a TensorBoard event file at batch end."""
+
+    def __init__(self, logging_dir, prefix=None):
+        self.prefix = prefix
+        try:
+            from tensorboardX import SummaryWriter
+            self.summary_writer = SummaryWriter(logging_dir)
+        except ImportError:
+            try:
+                from torch.utils.tensorboard import SummaryWriter
+                self.summary_writer = SummaryWriter(logging_dir)
+            except ImportError:
+                raise ImportError(
+                    "tensorboard writer not available; install tensorboardX "
+                    "or use torch's SummaryWriter")
+
+    def __call__(self, param):
+        if param.eval_metric is None:
+            return
+        name_value = param.eval_metric.get_name_value()
+        for name, value in name_value:
+            if self.prefix is not None:
+                name = f"{self.prefix}-{name}"
+            self.summary_writer.add_scalar(name, value)

@@ -1,0 +1,365 @@
+"""Image IO + augmentation (parity: python/mxnet/image/image.py + the C++
+augmenters in src/io/image_aug_default.cc).
+
+Pure-python host-side pipeline: decode (cv2/PIL, gated), resize, crop,
+mirror, color jitter; `ImageIter`/`ImageRecordIterPy` feed NCHW float
+batches.  Heavy decode runs in the prefetch thread (io.PrefetchingIter).
+"""
+from __future__ import annotations
+
+import os
+import random as _pyrandom
+from typing import List, Optional
+
+import numpy as _np
+
+from .base import MXNetError
+from . import ndarray as nd
+from .ndarray import NDArray
+from . import io as _io
+from . import recordio
+
+
+def imdecode(buf, flag=1, to_rgb=True, out=None):
+    """Decode image bytes → HWC NDArray (parity: mx.image.imdecode)."""
+    img = recordio._imdecode_bytes(bytes(buf), flag)
+    if img is None:
+        raise MXNetError("image decode failed")
+    if to_rgb and img.ndim == 3:
+        img = img[:, :, ::-1]
+    return nd.array(_np.ascontiguousarray(img))
+
+
+def imread(filename, flag=1, to_rgb=True):
+    with open(filename, "rb") as f:
+        return imdecode(f.read(), flag, to_rgb)
+
+
+def _resize_np(src: _np.ndarray, w, h):
+    try:
+        import cv2
+        return cv2.resize(src, (w, h), interpolation=cv2.INTER_LINEAR)
+    except ImportError:
+        pass
+    # jax bilinear fallback
+    import jax
+    out = jax.image.resize(src.astype(_np.float32),
+                           (h, w) + src.shape[2:], method="bilinear")
+    return _np.asarray(out).astype(src.dtype)
+
+
+def imresize(src, w, h, interp=1):
+    arr = src.asnumpy() if isinstance(src, NDArray) else _np.asarray(src)
+    return nd.array(_resize_np(arr, w, h))
+
+
+def resize_short(src, size, interp=2):
+    """Resize shorter edge to `size` (parity: image.resize_short)."""
+    arr = src.asnumpy() if isinstance(src, NDArray) else _np.asarray(src)
+    h, w = arr.shape[:2]
+    if h > w:
+        new_h, new_w = size * h // w, size
+    else:
+        new_h, new_w = size, size * w // h
+    return nd.array(_resize_np(arr, new_w, new_h))
+
+
+def fixed_crop(src, x0, y0, w, h, size=None, interp=2):
+    arr = src.asnumpy() if isinstance(src, NDArray) else _np.asarray(src)
+    out = arr[y0:y0 + h, x0:x0 + w]
+    if size is not None and (w, h) != size:
+        out = _resize_np(out, size[0], size[1])
+    return nd.array(out)
+
+
+def random_crop(src, size, interp=2):
+    h, w = src.shape[:2]
+    new_w, new_h = size
+    x0 = _pyrandom.randint(0, max(0, w - new_w))
+    y0 = _pyrandom.randint(0, max(0, h - new_h))
+    out = fixed_crop(src, x0, y0, min(new_w, w), min(new_h, h), size)
+    return out, (x0, y0, new_w, new_h)
+
+
+def center_crop(src, size, interp=2):
+    h, w = src.shape[:2]
+    new_w, new_h = size
+    x0 = max(0, (w - new_w) // 2)
+    y0 = max(0, (h - new_h) // 2)
+    out = fixed_crop(src, x0, y0, min(new_w, w), min(new_h, h), size)
+    return out, (x0, y0, new_w, new_h)
+
+
+def color_normalize(src, mean, std=None):
+    if mean is not None:
+        src = src - (mean if isinstance(mean, NDArray) else nd.array(mean))
+    if std is not None:
+        src = src / (std if isinstance(std, NDArray) else nd.array(std))
+    return src
+
+
+class Augmenter:
+    """Base augmenter (parity: image.Augmenter)."""
+
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+
+    def dumps(self):
+        import json
+        return json.dumps([self.__class__.__name__.lower(), self._kwargs])
+
+    def __call__(self, src):
+        raise NotImplementedError
+
+
+class ResizeAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size = size
+
+    def __call__(self, src):
+        return [resize_short(src, self.size)]
+
+
+class ForceResizeAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size = size
+
+    def __call__(self, src):
+        return [imresize(src, self.size[0], self.size[1])]
+
+
+class RandomCropAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size = size
+
+    def __call__(self, src):
+        return [random_crop(src, self.size)[0]]
+
+
+class CenterCropAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size = size
+
+    def __call__(self, src):
+        return [center_crop(src, self.size)[0]]
+
+
+class HorizontalFlipAug(Augmenter):
+    def __init__(self, p):
+        super().__init__(p=p)
+        self.p = p
+
+    def __call__(self, src):
+        if _pyrandom.random() < self.p:
+            return [nd.array(src.asnumpy()[:, ::-1].copy())]
+        return [src]
+
+
+class CastAug(Augmenter):
+    def __call__(self, src):
+        return [src.astype(_np.float32)]
+
+
+class BrightnessJitterAug(Augmenter):
+    def __init__(self, brightness):
+        super().__init__(brightness=brightness)
+        self.brightness = brightness
+
+    def __call__(self, src):
+        alpha = 1.0 + _pyrandom.uniform(-self.brightness, self.brightness)
+        return [src * alpha]
+
+
+class ContrastJitterAug(Augmenter):
+    def __init__(self, contrast):
+        super().__init__(contrast=contrast)
+        self.contrast = contrast
+
+    def __call__(self, src):
+        alpha = 1.0 + _pyrandom.uniform(-self.contrast, self.contrast)
+        coef = _np.array([[[0.299, 0.587, 0.114]]])
+        gray = (src.asnumpy() * coef).sum() * (3.0 / src.size)
+        return [src * alpha + gray * (1.0 - alpha)]
+
+
+class ColorNormalizeAug(Augmenter):
+    def __init__(self, mean, std):
+        super().__init__(mean=mean, std=std)
+        self.mean = nd.array(mean) if mean is not None else None
+        self.std = nd.array(std) if std is not None else None
+
+    def __call__(self, src):
+        return [color_normalize(src, self.mean, self.std)]
+
+
+def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
+                    rand_mirror=False, mean=None, std=None, brightness=0,
+                    contrast=0, saturation=0, hue=0, pca_noise=0, rand_gray=0,
+                    inter_method=2):
+    """Parity: image.CreateAugmenter."""
+    auglist: List[Augmenter] = []
+    if resize > 0:
+        auglist.append(ResizeAug(resize, inter_method))
+    crop_size = (data_shape[2], data_shape[1])
+    if rand_crop:
+        auglist.append(RandomCropAug(crop_size, inter_method))
+    else:
+        auglist.append(CenterCropAug(crop_size, inter_method))
+    if rand_mirror:
+        auglist.append(HorizontalFlipAug(0.5))
+    auglist.append(CastAug())
+    if brightness:
+        auglist.append(BrightnessJitterAug(brightness))
+    if contrast:
+        auglist.append(ContrastJitterAug(contrast))
+    if mean is True:
+        mean = _np.array([123.68, 116.28, 103.53])
+    if std is True:
+        std = _np.array([58.395, 57.12, 57.375])
+    if mean is not None or std is not None:
+        auglist.append(ColorNormalizeAug(mean, std))
+    return auglist
+
+
+class ImageIter(_io.DataIter):
+    """Pure-python image iterator (parity: python/mxnet/image/image.py
+    ImageIter): reads .rec or .lst+images, applies augmenters, yields NCHW."""
+
+    def __init__(self, batch_size, data_shape, label_width=1,
+                 path_imgrec=None, path_imglist=None, path_root=None,
+                 path_imgidx=None, shuffle=False, part_index=0, num_parts=1,
+                 aug_list=None, imglist=None, data_name="data",
+                 label_name="softmax_label", **kwargs):
+        super().__init__(batch_size)
+        assert path_imgrec or path_imglist or isinstance(imglist, list)
+        if path_imgrec:
+            if path_imgidx:
+                self.imgrec = recordio.MXIndexedRecordIO(path_imgidx,
+                                                         path_imgrec, "r")
+                self.imgidx = list(self.imgrec.keys)
+            else:
+                self.imgrec = recordio.MXRecordIO(path_imgrec, "r")
+                self.imgidx = None
+        else:
+            self.imgrec = None
+        self.imglist = None
+        self.path_root = path_root
+        if path_imglist:
+            imglist_d = {}
+            imgkeys = []
+            with open(path_imglist) as fin:
+                for line in fin:
+                    line = line.strip().split("\t")
+                    label = _np.array(line[1:-1], dtype=_np.float32)
+                    key = int(line[0])
+                    imglist_d[key] = (label, line[-1])
+                    imgkeys.append(key)
+            self.imglist = imglist_d
+            self.seq = imgkeys
+        elif isinstance(imglist, list):
+            imglist_d = {}
+            imgkeys = []
+            for i, img in enumerate(imglist):
+                key = str(i)
+                label = _np.array(img[0], dtype=_np.float32) \
+                    if not isinstance(img[0], _np.ndarray) else img[0]
+                imglist_d[key] = (label, img[1])
+                imgkeys.append(key)
+            self.imglist = imglist_d
+            self.seq = imgkeys
+        elif self.imgidx is not None:
+            self.seq = self.imgidx
+        else:
+            self.seq = None
+        assert len(data_shape) == 3 and data_shape[0] == 3 or data_shape[0] == 1
+        self.provide_data = [_io.DataDesc(data_name,
+                                          (batch_size,) + tuple(data_shape))]
+        if label_width > 1:
+            self.provide_label = [_io.DataDesc(label_name,
+                                               (batch_size, label_width))]
+        else:
+            self.provide_label = [_io.DataDesc(label_name, (batch_size,))]
+        self.batch_size = batch_size
+        self.data_shape = tuple(data_shape)
+        self.label_width = label_width
+        self.shuffle = shuffle
+        self.num_parts = num_parts
+        self.part_index = part_index
+        if aug_list is None:
+            self.auglist = CreateAugmenter(data_shape, **kwargs)
+        else:
+            self.auglist = aug_list
+        self.cur = 0
+        self.reset()
+
+    def reset(self):
+        if self.shuffle and self.seq is not None:
+            _np.random.shuffle(self.seq)
+        if self.imgrec is not None:
+            self.imgrec.reset()
+        self.cur = 0
+
+    def next_sample(self):
+        if self.seq is not None:
+            if self.cur >= len(self.seq):
+                raise StopIteration
+            idx = self.seq[self.cur]
+            self.cur += 1
+            if self.imgrec is not None:
+                s = self.imgrec.read_idx(idx)
+                header, img = recordio.unpack(s)
+                return header.label, img
+            label, fname = self.imglist[idx]
+            with open(os.path.join(self.path_root or "", fname), "rb") as f:
+                img = f.read()
+            return label, img
+        s = self.imgrec.read()
+        if s is None:
+            raise StopIteration
+        header, img = recordio.unpack(s)
+        return header.label, img
+
+    def next(self):
+        batch_size = self.batch_size
+        c, h, w = self.data_shape
+        batch_data = _np.zeros((batch_size, h, w, c), dtype=_np.float32)
+        batch_label = _np.zeros((batch_size,) + (
+            (self.label_width,) if self.label_width > 1 else ()),
+            dtype=_np.float32)
+        i = 0
+        while i < batch_size:
+            label, s = self.next_sample()
+            data = imdecode(s)
+            for aug in self.auglist:
+                data = aug(data)[0]
+            arr = data.asnumpy() if isinstance(data, NDArray) else data
+            if arr.ndim == 2:
+                arr = arr[:, :, None]
+            batch_data[i] = arr[:h, :w, :c]
+            batch_label[i] = label if _np.ndim(label) else float(label)
+            i += 1
+        data_nchw = _np.transpose(batch_data, (0, 3, 1, 2))
+        return _io.DataBatch([nd.array(data_nchw)], [nd.array(batch_label)],
+                             batch_size - i,
+                             provide_data=self.provide_data,
+                             provide_label=self.provide_label)
+
+
+class ImageRecordIterPy(ImageIter):
+    """Backend for io.ImageRecordIter (parity: iter_image_recordio_2.cc)."""
+
+    def __init__(self, path_imgrec, data_shape, batch_size, label_width=1,
+                 shuffle=False, mean=(0, 0, 0), std=(1, 1, 1), rand_crop=False,
+                 rand_mirror=False, **kwargs):
+        mean_arr = _np.array(mean) if any(mean) else None
+        std_arr = _np.array(std) if any(s != 1 for s in std) else None
+        aug = CreateAugmenter(data_shape, rand_crop=rand_crop,
+                              rand_mirror=rand_mirror, mean=mean_arr,
+                              std=std_arr)
+        super().__init__(batch_size, data_shape, label_width,
+                         path_imgrec=path_imgrec, shuffle=shuffle,
+                         aug_list=aug)

@@ -482,10 +482,10 @@ def array(source_array, ctx=None, dtype=None, **kw) -> NDArray:
     else:
         src = _np.asarray(source_array)
     if dtype is None:
-        dtype = src.dtype if src.dtype != _np.float64 or isinstance(
-            source_array, _np.ndarray) else _np.float32
-        if not isinstance(source_array, (_np.ndarray, NDArray)):
-            dtype = _np.float32 if src.dtype.kind == "f" else src.dtype
+        # parity: mx.nd.array keeps numpy/NDArray dtype, defaults python
+        # lists/scalars to float32 (python/mxnet/ndarray/utils.py)
+        dtype = src.dtype if isinstance(source_array, (_np.ndarray, NDArray)) \
+            else _np.float32
     return _place(jnp.asarray(src.astype(np_dtype(dtype))), ctx)
 
 
@@ -557,9 +557,9 @@ def save(fname: str, data) -> None:
         payload = {f"__mx_list_{i:06d}": v.asnumpy() for i, v in enumerate(data)}
     else:
         raise MXNetError("save expects NDArray, list, or dict")
-    _np.savez(fname if fname.endswith(".npz") else fname, **payload)
     import os
-    if os.path.exists(fname + ".npz") and not os.path.exists(fname):
+    _np.savez(fname, **payload)  # numpy appends .npz when missing
+    if not fname.endswith(".npz"):
         os.replace(fname + ".npz", fname)
 
 

@@ -15,3 +15,5 @@ from . import init_ops
 from . import random_ops
 from . import optimizer_ops
 from . import sequence
+from . import vision
+from . import contrib

@@ -1,0 +1,284 @@
+"""Contrib operators (parity: src/operator/contrib/ — SURVEY.md §2.2).
+
+ctc_loss (optax XLA), fft/ifft (cuFFT → jnp.fft), quantize/dequantize,
+count_sketch, MultiBoxPrior/Target/Detection (SSD detection ops — the
+reference's hand-written CUDA kernels become vectorized jax; non-max
+suppression uses a fixed-iteration lax loop, XLA-compilable).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..base import Arg, MXNetError
+from .registry import register
+
+
+@register("_contrib_ctc_loss", input_names=("data", "label"),
+          aliases=("ctc_loss", "CTCLoss"),
+          args=[Arg("use_data_lengths", bool, False),
+                Arg("use_label_lengths", bool, False),
+                Arg("blank_label", str, "first")])
+def _ctc_loss(p, data, label):
+    """Parity: contrib/ctc_loss.cc.  data: (T, N, C) activations (pre-softmax),
+    label: (N, L) padded with 0/-1."""
+    import optax
+    T, N, C = data.shape
+    logits = jnp.transpose(data, (1, 0, 2))  # (N,T,C)
+    labels = label.astype(jnp.int32)
+    if p["blank_label"] == "first":
+        # optax uses blank_id; shift labels down by one (0 is blank in mxnet)
+        lab = labels - 1
+        blank = 0
+        lab_valid = labels > 0
+        lab = jnp.where(lab_valid, labels, 0)
+        loss = optax.ctc_loss(logits, jnp.zeros((N, T)), lab,
+                              (~lab_valid).astype(jnp.float32), blank_id=0)
+    else:
+        lab_valid = labels >= 0
+        lab = jnp.where(lab_valid, labels, 0)
+        loss = optax.ctc_loss(logits, jnp.zeros((N, T)), lab,
+                              (~lab_valid).astype(jnp.float32), blank_id=C - 1)
+    return loss
+
+
+@register("_contrib_fft", input_names=("data",), aliases=("fft",),
+          args=[Arg("compute_size", int, 128)])
+def _fft(p, x):
+    """Parity: contrib/fft.cc — output interleaves real/imag on last dim."""
+    out = jnp.fft.fft(x, axis=-1)
+    return jnp.stack([out.real, out.imag], axis=-1).reshape(
+        x.shape[:-1] + (2 * x.shape[-1],)).astype(x.dtype)
+
+
+@register("_contrib_ifft", input_names=("data",), aliases=("ifft",),
+          args=[Arg("compute_size", int, 128)])
+def _ifft(p, x):
+    n = x.shape[-1] // 2
+    comp = x.reshape(x.shape[:-1] + (n, 2))
+    z = comp[..., 0] + 1j * comp[..., 1]
+    return jnp.fft.ifft(z, axis=-1).real.astype(x.dtype) * n
+
+
+@register("_contrib_quantize", input_names=("data", "min_range", "max_range"),
+          num_outputs=3, differentiable=False,
+          args=[Arg("out_type", str, "uint8")])
+def _quantize(p, data, min_range, max_range):
+    """Parity: contrib/quantize.cc — affine quantization to uint8/int8."""
+    if p["out_type"] == "uint8":
+        qmin, qmax, dt = 0.0, 255.0, jnp.uint8
+    else:
+        qmin, qmax, dt = -127.0, 127.0, jnp.int8
+    scale = (qmax - qmin) / jnp.maximum(max_range - min_range, 1e-8)
+    q = jnp.clip(jnp.round((data - min_range) * scale + qmin), qmin, qmax)
+    return q.astype(dt), min_range, max_range
+
+
+@register("_contrib_dequantize", input_names=("data", "min_range", "max_range"),
+          differentiable=False, args=[Arg("out_type", str, "float32")])
+def _dequantize(p, data, min_range, max_range):
+    if data.dtype == jnp.uint8:
+        qmin, qmax = 0.0, 255.0
+    else:
+        qmin, qmax = -127.0, 127.0
+    scale = (max_range - min_range) / (qmax - qmin)
+    return (data.astype(jnp.float32) - qmin) * scale + min_range
+
+
+@register("_contrib_count_sketch", input_names=("data", "h", "s"),
+          args=[Arg("out_dim", int, required=True),
+                Arg("processing_batch_size", int, 32)])
+def _count_sketch(p, data, h, s):
+    """Parity: contrib/count_sketch.cc — random-projection sketch."""
+    n, d = data.shape
+    out_dim = p["out_dim"]
+    hh = h.reshape(-1).astype(jnp.int32)[:d]
+    ss = s.reshape(-1)[:d]
+    vals = data * ss[None, :]
+    out = jnp.zeros((n, out_dim), data.dtype)
+    return out.at[:, hh].add(vals)
+
+
+# ---------------------------------------------------------------------------
+# SSD multibox ops (parity: src/operator/contrib/multibox_*.cc)
+# ---------------------------------------------------------------------------
+@register("_contrib_MultiBoxPrior", input_names=("data",),
+          aliases=("MultiBoxPrior",), differentiable=False,
+          args=[Arg("sizes", "shape", (1.0,)), Arg("ratios", "shape", (1.0,)),
+                Arg("clip", bool, False), Arg("steps", "shape", (-1.0, -1.0)),
+                Arg("offsets", "shape", (0.5, 0.5))])
+def _multibox_prior(p, data):
+    """Anchor generation (parity: multibox_prior.cc).  data: (N,C,H,W) →
+    (1, H*W*num_anchors, 4) corner-format anchors in [0,1]."""
+    H, W = data.shape[2], data.shape[3]
+    sizes = [float(s) for s in p["sizes"]]
+    ratios = [float(r) for r in p["ratios"]]
+    step_y, step_x = p["steps"]
+    step_y = 1.0 / H if step_y <= 0 else step_y
+    step_x = 1.0 / W if step_x <= 0 else step_x
+    off_y, off_x = p["offsets"]
+    cy = (jnp.arange(H) + off_y) * step_y
+    cx = (jnp.arange(W) + off_x) * step_x
+    cyx = jnp.stack(jnp.meshgrid(cy, cx, indexing="ij"), -1).reshape(-1, 2)
+    whs = []
+    # mxnet convention: sizes[0] with each ratio? No — (size,1.0) for each
+    # size + (sizes[0], ratio) for each extra ratio → len(sizes)+len(ratios)-1
+    for s in sizes:
+        whs.append((s * (H / W) ** 0.5 if False else s, s))
+    base = sizes[0]
+    for r in ratios[1:]:
+        whs.append((base * (r ** 0.5), base / (r ** 0.5)))
+    whs = jnp.asarray(whs)  # (A, 2) = (w, h)
+    A = whs.shape[0]
+    centers = jnp.repeat(cyx, A, axis=0)  # (H*W*A, 2) [cy, cx]
+    wh = jnp.tile(whs, (H * W, 1))
+    xmin = centers[:, 1] - wh[:, 0] / 2
+    ymin = centers[:, 0] - wh[:, 1] / 2
+    xmax = centers[:, 1] + wh[:, 0] / 2
+    ymax = centers[:, 0] + wh[:, 1] / 2
+    out = jnp.stack([xmin, ymin, xmax, ymax], axis=-1)
+    if p["clip"]:
+        out = jnp.clip(out, 0.0, 1.0)
+    return out[None]
+
+
+def _iou_corner(a, b):
+    """IoU between (...,4) corner boxes a and b."""
+    ix1 = jnp.maximum(a[..., 0], b[..., 0])
+    iy1 = jnp.maximum(a[..., 1], b[..., 1])
+    ix2 = jnp.minimum(a[..., 2], b[..., 2])
+    iy2 = jnp.minimum(a[..., 3], b[..., 3])
+    iw = jnp.maximum(ix2 - ix1, 0)
+    ih = jnp.maximum(iy2 - iy1, 0)
+    inter = iw * ih
+    area_a = jnp.maximum(a[..., 2] - a[..., 0], 0) * \
+        jnp.maximum(a[..., 3] - a[..., 1], 0)
+    area_b = jnp.maximum(b[..., 2] - b[..., 0], 0) * \
+        jnp.maximum(b[..., 3] - b[..., 1], 0)
+    return inter / jnp.maximum(area_a + area_b - inter, 1e-10)
+
+
+@register("_contrib_MultiBoxTarget",
+          input_names=("anchor", "label", "cls_pred"),
+          aliases=("MultiBoxTarget",), num_outputs=3, differentiable=False,
+          args=[Arg("overlap_threshold", float, 0.5),
+                Arg("ignore_label", float, -1.0),
+                Arg("negative_mining_ratio", float, -1.0),
+                Arg("negative_mining_thresh", float, 0.5),
+                Arg("minimum_negative_samples", int, 0),
+                Arg("variances", "shape", (0.1, 0.1, 0.2, 0.2))])
+def _multibox_target(p, anchor, label, cls_pred):
+    """Anchor→GT matching + regression targets (parity: multibox_target.cc).
+
+    anchor: (1,A,4); label: (N,M,5) [cls,x1,y1,x2,y2] (cls<0 = pad);
+    cls_pred: (N, num_cls+1, A).  Returns (loc_target (N,A*4),
+    loc_mask (N,A*4), cls_target (N,A))."""
+    anchors = anchor[0]  # (A,4)
+    A = anchors.shape[0]
+    vx, vy, vw, vh = p["variances"]
+    thresh = p["overlap_threshold"]
+
+    def per_sample(lab):
+        valid = lab[:, 0] >= 0  # (M,)
+        gt = lab[:, 1:5]
+        ious = _iou_corner(anchors[:, None, :], gt[None, :, :])  # (A,M)
+        ious = jnp.where(valid[None, :], ious, -1.0)
+        best_gt = jnp.argmax(ious, axis=1)           # (A,)
+        best_iou = jnp.max(ious, axis=1)
+        matched = best_iou > thresh
+        # ensure every valid gt owns its argmax anchor
+        best_anchor = jnp.argmax(ious, axis=0)       # (M,)
+        forced = jnp.zeros(A, bool).at[best_anchor].set(valid)
+        forced_gt = jnp.zeros(A, jnp.int32).at[best_anchor].set(
+            jnp.arange(gt.shape[0], dtype=jnp.int32))
+        use_gt = jnp.where(forced, forced_gt, best_gt)
+        matched = matched | forced
+        g = gt[use_gt]
+        # encode (corner→center) with variances
+        aw = anchors[:, 2] - anchors[:, 0]
+        ah = anchors[:, 3] - anchors[:, 1]
+        acx = (anchors[:, 0] + anchors[:, 2]) / 2
+        acy = (anchors[:, 1] + anchors[:, 3]) / 2
+        gw = jnp.maximum(g[:, 2] - g[:, 0], 1e-8)
+        gh = jnp.maximum(g[:, 3] - g[:, 1], 1e-8)
+        gcx = (g[:, 0] + g[:, 2]) / 2
+        gcy = (g[:, 1] + g[:, 3]) / 2
+        tx = (gcx - acx) / jnp.maximum(aw, 1e-8) / vx
+        ty = (gcy - acy) / jnp.maximum(ah, 1e-8) / vy
+        tw = jnp.log(gw / jnp.maximum(aw, 1e-8)) / vw
+        th = jnp.log(gh / jnp.maximum(ah, 1e-8)) / vh
+        loc_t = jnp.stack([tx, ty, tw, th], axis=-1)  # (A,4)
+        loc_t = jnp.where(matched[:, None], loc_t, 0.0).reshape(-1)
+        loc_m = jnp.where(matched[:, None],
+                          jnp.ones((A, 4)), 0.0).reshape(-1)
+        cls_t = jnp.where(matched, lab[use_gt, 0] + 1, 0.0)
+        return loc_t, loc_m, cls_t
+
+    loc_t, loc_m, cls_t = jax.vmap(per_sample)(label)
+    return loc_t, loc_m, cls_t
+
+
+@register("_contrib_MultiBoxDetection",
+          input_names=("cls_prob", "loc_pred", "anchor"),
+          aliases=("MultiBoxDetection",), differentiable=False,
+          args=[Arg("clip", bool, True), Arg("threshold", float, 0.01),
+                Arg("background_id", int, 0), Arg("nms_threshold", float, 0.5),
+                Arg("force_suppress", bool, False),
+                Arg("variances", "shape", (0.1, 0.1, 0.2, 0.2)),
+                Arg("nms_topk", int, -1)])
+def _multibox_detection(p, cls_prob, loc_pred, anchor):
+    """Decode + NMS (parity: multibox_detection.cc).  Returns
+    (N, A, 6) rows [cls_id, score, x1, y1, x2, y2]; suppressed rows cls=-1."""
+    anchors = anchor[0]
+    A = anchors.shape[0]
+    vx, vy, vw, vh = p["variances"]
+    aw = anchors[:, 2] - anchors[:, 0]
+    ah = anchors[:, 3] - anchors[:, 1]
+    acx = (anchors[:, 0] + anchors[:, 2]) / 2
+    acy = (anchors[:, 1] + anchors[:, 3]) / 2
+
+    def per_sample(probs, locs):
+        loc = locs.reshape(A, 4)
+        cx = loc[:, 0] * vx * aw + acx
+        cy = loc[:, 1] * vy * ah + acy
+        w = jnp.exp(loc[:, 2] * vw) * aw
+        h = jnp.exp(loc[:, 3] * vh) * ah
+        boxes = jnp.stack([cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2], -1)
+        if p["clip"]:
+            boxes = jnp.clip(boxes, 0.0, 1.0)
+        # class scores, excluding background
+        scores = probs[1:] if p["background_id"] == 0 else \
+            jnp.concatenate([probs[:p["background_id"]],
+                             probs[p["background_id"] + 1:]])
+        cls_id = jnp.argmax(scores, axis=0).astype(jnp.float32)  # (A,)
+        score = jnp.max(scores, axis=0)
+        keep = score > p["threshold"]
+        cls_id = jnp.where(keep, cls_id, -1.0)
+        # greedy NMS, fixed iterations over score-sorted order
+        order = jnp.argsort(-score)
+        boxes_s = boxes[order]
+        cls_s = cls_id[order]
+        score_s = score[order]
+        alive = cls_s >= 0
+
+        def body(i, alive):
+            box_i = boxes_s[i]
+            cls_i = cls_s[i]
+            this_alive = alive[i]
+            ious = _iou_corner(box_i[None], boxes_s)
+            same = (cls_s == cls_i) | bool(p["force_suppress"])
+            sup = (ious > p["nms_threshold"]) & same & \
+                (jnp.arange(A) > i) & this_alive
+            return alive & ~sup
+
+        alive = lax.fori_loop(0, A, body, alive)
+        out = jnp.concatenate(
+            [jnp.where(alive, cls_s, -1.0)[:, None], score_s[:, None],
+             boxes_s], axis=1)
+        return out
+
+    return jax.vmap(per_sample)(cls_prob, loc_pred.reshape(
+        cls_prob.shape[0], -1))

@@ -41,7 +41,7 @@ _UNARY = {
     "ceil": jnp.ceil,
     "floor": jnp.floor,
     "trunc": jnp.trunc,
-    "fix": jnp.fix,
+    "fix": jnp.trunc,
     "square": jnp.square,
     "sqrt": jnp.sqrt,
     "rsqrt": lambda x: 1.0 / jnp.sqrt(x),

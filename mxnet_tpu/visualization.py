@@ -1,0 +1,74 @@
+"""Network visualization (parity: python/mxnet/visualization.py:47,192)."""
+from __future__ import annotations
+
+import json
+
+from .base import MXNetError
+
+
+def print_summary(symbol, shape=None, line_length=120, positions=(.44, .64, .74, 1.)):
+    """Textual layer summary (parity: visualization.print_summary)."""
+    if shape is not None:
+        arg_shapes, out_shapes, aux_shapes = symbol.infer_shape(**shape)
+        shape_dict = dict(zip(symbol.list_arguments(), arg_shapes))
+    else:
+        shape_dict = {}
+    nodes = symbol._topo()
+    header = ["Layer (type)", "Output Shape", "Param #", "Previous Layer"]
+    positions = [int(line_length * p) for p in positions]
+
+    def print_row(fields, positions):
+        line = ""
+        for i, field in enumerate(fields):
+            line += str(field)
+            line = line[:positions[i]]
+            line += " " * (positions[i] - len(line))
+        print(line)
+
+    print("_" * line_length)
+    print_row(header, positions)
+    print("=" * line_length)
+    total_params = 0
+    for node in nodes:
+        if node.is_var:
+            continue
+        op_name = f"{node.name}({node.op})"
+        params = 0
+        for src, _ in node.inputs:
+            if src.is_var and src.name in shape_dict:
+                import numpy as np
+                if src.name != "data" and not src.name.endswith("label"):
+                    params += int(np.prod(shape_dict[src.name]))
+        total_params += params
+        prev = ",".join(s.name for s, _ in node.inputs)
+        print_row([op_name, "", params, prev[:40]], positions)
+    print("=" * line_length)
+    print(f"Total params: {total_params}")
+    print("_" * line_length)
+    return total_params
+
+
+def plot_network(symbol, title="plot", save_format="pdf", shape=None,
+                 node_attrs=None, hide_weights=True):
+    """Graphviz plot (parity: visualization.plot_network); requires the
+    optional graphviz package."""
+    try:
+        from graphviz import Digraph
+    except ImportError:
+        raise MXNetError("plot_network requires the graphviz python package")
+    node_attrs = node_attrs or {}
+    dot = Digraph(name=title)
+    nodes = symbol._topo()
+    for node in nodes:
+        if node.is_var:
+            if not hide_weights or node.name in ("data",) or \
+                    node.name.endswith("label"):
+                dot.node(node.name, node.name, shape="oval")
+            continue
+        dot.node(node.name, f"{node.name}\n{node.op}", shape="box")
+        for src, _ in node.inputs:
+            if src.is_var and hide_weights and src.name not in ("data",) \
+                    and not src.name.endswith("label"):
+                continue
+            dot.edge(src.name, node.name)
+    return dot

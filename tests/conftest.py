@@ -1,0 +1,22 @@
+"""Test harness config: 8 virtual CPU devices so multi-chip sharding
+(mesh DP, ring attention, group2ctx placement) is exercised without TPUs
+— the strategy SURVEY.md §4 prescribes (reference ran multi-*CPU*-context
+tests for device-placement logic, tests/python/unittest/test_multi_device_exec.py)."""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = \
+        flags + " --xla_force_host_platform_device_count=8"
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def _seed():
+    np.random.seed(0)
+    import mxnet_tpu as mx
+    mx.random.seed(0)
+    yield

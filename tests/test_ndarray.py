@@ -1,0 +1,170 @@
+"""NDArray tests (parity model: tests/python/unittest/test_ndarray.py)."""
+import os
+import pickle
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_creation():
+    a = nd.zeros((3, 4))
+    assert a.shape == (3, 4) and a.dtype == np.float32
+    b = nd.ones((2,), dtype="int32")
+    assert b.asnumpy().tolist() == [1, 1]
+    c = nd.full((2, 2), 7)
+    assert (c.asnumpy() == 7).all()
+    d = nd.array([[1, 2], [3, 4]])
+    assert d.dtype == np.float32
+    e = nd.array(np.arange(4, dtype=np.float64))
+    assert e.dtype == np.float64
+    assert nd.arange(0, 10, 2).shape == (5,)
+    assert nd.eye(3).asnumpy()[1, 1] == 1
+
+
+def test_arithmetic():
+    a = nd.array([[1.0, 2.0], [3.0, 4.0]])
+    b = nd.array([[2.0, 2.0], [2.0, 2.0]])
+    assert_almost_equal((a + b).asnumpy(), a.asnumpy() + 2)
+    assert_almost_equal((a - b).asnumpy(), a.asnumpy() - 2)
+    assert_almost_equal((a * 3).asnumpy(), a.asnumpy() * 3)
+    assert_almost_equal((3 * a).asnumpy(), a.asnumpy() * 3)
+    assert_almost_equal((a / b).asnumpy(), a.asnumpy() / 2)
+    assert_almost_equal((2 / a).asnumpy(), 2 / a.asnumpy())
+    assert_almost_equal((a ** 2).asnumpy(), a.asnumpy() ** 2)
+    assert_almost_equal((-a).asnumpy(), -a.asnumpy())
+    assert_almost_equal(abs(-a).asnumpy(), a.asnumpy())
+    assert_almost_equal((a == 2).asnumpy(), (a.asnumpy() == 2).astype("f"))
+    assert_almost_equal((a > 2).asnumpy(), (a.asnumpy() > 2).astype("f"))
+
+
+def test_inplace():
+    a = nd.ones((2, 2))
+    a += 1
+    assert (a.asnumpy() == 2).all()
+    a *= 3
+    assert (a.asnumpy() == 6).all()
+    a /= 2
+    assert (a.asnumpy() == 3).all()
+    a -= 1
+    assert (a.asnumpy() == 2).all()
+
+
+def test_indexing():
+    a = nd.array(np.arange(24).reshape(2, 3, 4))
+    assert a[1].shape == (3, 4)
+    assert a[1, 2].shape == (4,)
+    assert a[:, 1:3].shape == (2, 2, 4)
+    assert float(a[1, 2, 3].asscalar()) == 23
+    a[0] = 0
+    assert (a.asnumpy()[0] == 0).all()
+    a[:, 0, 0] = 9
+    assert (a.asnumpy()[:, 0, 0] == 9).all()
+    b = nd.array([0.0, 1.0, 2.0])
+    b[:] = 5
+    assert (b.asnumpy() == 5).all()
+
+
+def test_reshape_codes():
+    # MXNet special reshape codes (matrix_op-inl.h)
+    a = nd.zeros((2, 3, 4))
+    assert a.reshape((4, -1)).shape == (4, 6)
+    assert a.reshape((0, -1)).shape == (2, 12)
+    assert a.reshape((-2,)).shape == (2, 3, 4)
+    assert a.reshape((-3, 4)).shape == (6, 4)
+    assert a.reshape((0, -4, 1, 3, 0)).shape == (2, 1, 3, 4)
+    assert a.reshape((2, -4, -1, 3, 4)).shape == (2, 1, 3, 4)
+
+
+def test_views_and_methods():
+    a = nd.array(np.random.randn(4, 5).astype("f"))
+    assert a.T.shape == (5, 4)
+    assert a.flatten().shape == (4, 5)
+    assert a.expand_dims(0).shape == (1, 4, 5)
+    assert_almost_equal(a.sum().asnumpy(), a.asnumpy().sum(), rtol=1e-5)
+    assert_almost_equal(a.mean(axis=1).asnumpy(), a.asnumpy().mean(axis=1),
+                        rtol=1e-5)
+    assert_almost_equal(a.max(axis=0).asnumpy(), a.asnumpy().max(axis=0))
+    assert int(a.argmax().asscalar()) == a.asnumpy().argmax()
+    assert_almost_equal(a.clip(-0.5, 0.5).asnumpy(),
+                        np.clip(a.asnumpy(), -0.5, 0.5))
+
+
+def test_dtype_cast():
+    a = nd.ones((2, 2))
+    b = a.astype("float16")
+    assert b.dtype == np.float16
+    c = a.astype(np.int32)
+    assert c.dtype == np.int32
+    d = nd.cast(a, dtype="float64")
+    assert d.dtype == np.float64
+
+
+def test_save_load(tmp_path):
+    fname = str(tmp_path / "nd.save")
+    a = nd.array(np.random.rand(3, 3).astype("f"))
+    b = nd.arange(0, 5)
+    nd.save(fname, [a, b])
+    la, lb = nd.load(fname)
+    assert_almost_equal(a.asnumpy(), la.asnumpy())
+    assert_almost_equal(b.asnumpy(), lb.asnumpy())
+    nd.save(fname, {"a": a, "b": b})
+    d = nd.load(fname)
+    assert set(d.keys()) == {"a", "b"}
+    assert_almost_equal(d["a"].asnumpy(), a.asnumpy())
+
+
+def test_pickle():
+    a = nd.array(np.random.rand(2, 3).astype("f"))
+    b = pickle.loads(pickle.dumps(a))
+    assert_almost_equal(a.asnumpy(), b.asnumpy())
+
+
+def test_copy_semantics():
+    a = nd.ones((2, 2))
+    b = a.copy()
+    b += 1
+    assert (a.asnumpy() == 1).all()
+    c = nd.zeros((2, 2))
+    a.copyto(c)
+    assert (c.asnumpy() == 1).all()
+    d = a.as_in_context(mx.cpu(0))
+    assert d.context == mx.cpu(0)
+
+
+def test_waitall_and_sync():
+    a = nd.ones((10, 10))
+    b = nd.dot(a, a)
+    b.wait_to_read()
+    nd.waitall()
+    assert (b.asnumpy() == 10).all()
+
+
+def test_concat_stack_split():
+    a, b = nd.ones((2, 3)), nd.zeros((2, 3))
+    c = nd.concatenate([a, b], axis=0)
+    assert c.shape == (4, 3)
+    s = nd.stack(a, b, axis=0)
+    assert s.shape == (2, 2, 3)
+    parts = nd.split(c, num_outputs=2, axis=0)
+    assert parts[0].shape == (2, 3)
+    assert (parts[0].asnumpy() == 1).all()
+
+
+def test_broadcast_ops():
+    a = nd.ones((2, 1, 3))
+    b = nd.ones((1, 4, 3)) * 2
+    assert (a + b).shape == (2, 4, 3)
+    assert nd.broadcast_to(nd.ones((1, 3)), (5, 3)).shape == (5, 3)
+    assert nd.maximum(a, b).shape == (2, 4, 3)
+    assert nd.maximum(a, 5.0).asnumpy().max() == 5.0
+
+
+def test_iteration():
+    a = nd.array(np.arange(6).reshape(3, 2))
+    rows = [r.asnumpy() for r in a]
+    assert len(rows) == 3 and rows[2].tolist() == [4.0, 5.0]
+    assert len(a) == 3

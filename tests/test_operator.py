@@ -1,0 +1,377 @@
+"""Operator tests (parity model: tests/python/unittest/test_operator.py —
+numpy-reference forward checks + finite-difference gradient checks)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, sym
+from mxnet_tpu.test_utils import (assert_almost_equal, check_numeric_gradient,
+                                  check_symbolic_forward,
+                                  check_symbolic_backward)
+
+
+def test_unary_ops_vs_numpy():
+    x = np.random.uniform(0.5, 2.0, (3, 4)).astype("f")
+    a = nd.array(x)
+    cases = {
+        "relu": np.maximum(x, 0), "sigmoid": 1 / (1 + np.exp(-x)),
+        "exp": np.exp(x), "log": np.log(x), "sqrt": np.sqrt(x),
+        "square": x * x, "abs": np.abs(x), "tanh": np.tanh(x),
+        "floor": np.floor(x), "ceil": np.ceil(x), "sign": np.sign(x),
+        "rsqrt": 1 / np.sqrt(x), "log1p": np.log1p(x),
+        "expm1": np.expm1(x), "sin": np.sin(x), "cos": np.cos(x),
+        "arctan": np.arctan(x), "sinh": np.sinh(x),
+    }
+    for name, expect in cases.items():
+        out = getattr(nd, name)(a)
+        assert_almost_equal(out.asnumpy(), expect, rtol=1e-4, atol=1e-5)
+
+
+def test_binary_broadcast():
+    x = np.random.rand(2, 3, 1).astype("f") + 0.5
+    y = np.random.rand(1, 3, 4).astype("f") + 0.5
+    a, b = nd.array(x), nd.array(y)
+    assert_almost_equal(nd.broadcast_add(a, b).asnumpy(), x + y, rtol=1e-5)
+    assert_almost_equal(nd.broadcast_mul(a, b).asnumpy(), x * y, rtol=1e-5)
+    assert_almost_equal(nd.broadcast_div(a, b).asnumpy(), x / y, rtol=1e-4)
+    assert_almost_equal(nd.broadcast_power(a, b).asnumpy(), x ** y, rtol=1e-4)
+    assert_almost_equal(nd.broadcast_hypot(a, b).asnumpy(), np.hypot(x, y),
+                        rtol=1e-4)
+
+
+def test_reductions():
+    x = np.random.randn(2, 3, 4).astype("f")
+    a = nd.array(x)
+    assert_almost_equal(nd.sum(a, axis=1).asnumpy(), x.sum(1), rtol=1e-5)
+    assert_almost_equal(nd.sum(a, axis=(0, 2)).asnumpy(), x.sum((0, 2)),
+                        rtol=1e-4)
+    assert_almost_equal(nd.sum(a, axis=1, keepdims=True).asnumpy(),
+                        x.sum(1, keepdims=True), rtol=1e-5)
+    assert_almost_equal(nd.sum(a, axis=1, exclude=True).asnumpy(),
+                        x.sum((0, 2)), rtol=1e-4)
+    assert_almost_equal(nd.mean(a).asnumpy(), x.mean(), rtol=1e-5)
+    assert_almost_equal(nd.prod(a, axis=2).asnumpy(), x.prod(2), rtol=1e-4)
+    assert_almost_equal(nd.norm(a).asnumpy(), np.linalg.norm(x.ravel()),
+                        rtol=1e-5)
+    assert_almost_equal(nd.argmax(a, axis=1).asnumpy(), x.argmax(1))
+    assert_almost_equal(nd.argmin(a, axis=2).asnumpy(), x.argmin(2))
+
+
+def test_dot():
+    x = np.random.randn(4, 5).astype("f")
+    y = np.random.randn(5, 3).astype("f")
+    assert_almost_equal(nd.dot(nd.array(x), nd.array(y)).asnumpy(), x @ y,
+                        rtol=1e-4)
+    assert_almost_equal(
+        nd.dot(nd.array(x), nd.array(y.T), transpose_b=True).asnumpy(),
+        x @ y, rtol=1e-4)
+    assert_almost_equal(
+        nd.dot(nd.array(x.T), nd.array(y), transpose_a=True).asnumpy(),
+        x @ y, rtol=1e-4)
+    bx = np.random.randn(2, 4, 5).astype("f")
+    by = np.random.randn(2, 5, 3).astype("f")
+    assert_almost_equal(nd.batch_dot(nd.array(bx), nd.array(by)).asnumpy(),
+                        bx @ by, rtol=1e-4)
+
+
+def test_matrix_ops():
+    x = np.arange(24).reshape(2, 3, 4).astype("f")
+    a = nd.array(x)
+    assert_almost_equal(nd.transpose(a, axes=(2, 0, 1)).asnumpy(),
+                        x.transpose(2, 0, 1))
+    assert_almost_equal(nd.swapaxes(a, dim1=0, dim2=2).asnumpy(),
+                        x.swapaxes(0, 2))
+    assert_almost_equal(nd.flip(a, axis=1).asnumpy(), x[:, ::-1])
+    assert_almost_equal(nd.tile(a, reps=(1, 2, 1)).asnumpy(),
+                        np.tile(x, (1, 2, 1)))
+    assert_almost_equal(nd.repeat(a, repeats=2, axis=1).asnumpy(),
+                        np.repeat(x, 2, 1))
+    assert_almost_equal(
+        nd.slice(a, begin=(0, 1, 0), end=(2, 3, 4), step=(1, 1, 2)).asnumpy(),
+        x[0:2, 1:3, 0:4:2])
+    assert_almost_equal(nd.slice_axis(a, axis=2, begin=1, end=3).asnumpy(),
+                        x[:, :, 1:3])
+    assert_almost_equal(nd.reverse(a, axis=(0,)).asnumpy(), x[::-1])
+    assert_almost_equal(
+        nd.pad(nd.array(x[None]), mode="constant",
+               pad_width=(0, 0, 0, 0, 1, 1, 2, 2), constant_value=1).asnumpy(),
+        np.pad(x[None], ((0, 0), (0, 0), (1, 1), (2, 2)), constant_values=1))
+
+
+def test_where_take_onehot_pick():
+    cond = nd.array([[1.0, 0.0], [0.0, 1.0]])
+    a = nd.ones((2, 2))
+    b = nd.zeros((2, 2))
+    assert_almost_equal(nd.where(cond, a, b).asnumpy(), np.eye(2))
+    w = np.random.randn(10, 4).astype("f")
+    idx = np.array([1, 3, 5])
+    assert_almost_equal(nd.take(nd.array(w), nd.array(idx)).asnumpy(), w[idx])
+    assert_almost_equal(
+        nd.Embedding(nd.array(idx), nd.array(w), input_dim=10,
+                     output_dim=4).asnumpy(), w[idx])
+    oh = nd.one_hot(nd.array([0, 2]), depth=3).asnumpy()
+    assert_almost_equal(oh, np.eye(3)[[0, 2]])
+    data = np.random.randn(3, 4).astype("f")
+    pk = nd.pick(nd.array(data), nd.array([0, 1, 2]), axis=1).asnumpy()
+    assert_almost_equal(pk, data[np.arange(3), [0, 1, 2]])
+
+
+def test_ordering():
+    x = np.random.randn(4, 5).astype("f")
+    a = nd.array(x)
+    assert_almost_equal(nd.sort(a, axis=1).asnumpy(), np.sort(x, 1))
+    assert_almost_equal(nd.argsort(a, axis=1).asnumpy(), np.argsort(x, 1))
+    tk = nd.topk(a, axis=1, k=2, ret_typ="value").asnumpy()
+    expect = -np.sort(-x, axis=1)[:, :2]
+    assert_almost_equal(tk, expect)
+
+
+def test_fully_connected():
+    x = np.random.randn(4, 10).astype("f")
+    w = np.random.randn(6, 10).astype("f")
+    b = np.random.randn(6).astype("f")
+    out = nd.FullyConnected(nd.array(x), nd.array(w), nd.array(b),
+                            num_hidden=6)
+    assert_almost_equal(out.asnumpy(), x @ w.T + b, rtol=1e-4)
+    out2 = nd.FullyConnected(nd.array(x), nd.array(w), no_bias=True,
+                             num_hidden=6)
+    assert_almost_equal(out2.asnumpy(), x @ w.T, rtol=1e-4)
+
+
+def test_convolution_vs_naive():
+    x = np.random.randn(2, 3, 7, 7).astype("f")
+    w = np.random.randn(4, 3, 3, 3).astype("f")
+    b = np.zeros(4, "f")
+    out = nd.Convolution(nd.array(x), nd.array(w), nd.array(b),
+                         kernel=(3, 3), num_filter=4, stride=(2, 2),
+                         pad=(1, 1)).asnumpy()
+    # naive reference
+    xp = np.pad(x, ((0, 0), (0, 0), (1, 1), (1, 1)))
+    expect = np.zeros_like(out)
+    for n in range(2):
+        for f in range(4):
+            for i in range(out.shape[2]):
+                for j in range(out.shape[3]):
+                    patch = xp[n, :, i * 2:i * 2 + 3, j * 2:j * 2 + 3]
+                    expect[n, f, i, j] = (patch * w[f]).sum()
+    assert_almost_equal(out, expect, rtol=1e-3, atol=1e-4)
+
+
+def test_pooling():
+    x = np.random.randn(1, 2, 6, 6).astype("f")
+    out = nd.Pooling(nd.array(x), kernel=(2, 2), stride=(2, 2),
+                     pool_type="max").asnumpy()
+    expect = x.reshape(1, 2, 3, 2, 3, 2).max(axis=(3, 5))
+    assert_almost_equal(out, expect)
+    out_avg = nd.Pooling(nd.array(x), kernel=(2, 2), stride=(2, 2),
+                         pool_type="avg").asnumpy()
+    expect_avg = x.reshape(1, 2, 3, 2, 3, 2).mean(axis=(3, 5))
+    assert_almost_equal(out_avg, expect_avg, rtol=1e-5)
+    gmax = nd.Pooling(nd.array(x), global_pool=True, pool_type="max").asnumpy()
+    assert_almost_equal(gmax, x.max(axis=(2, 3), keepdims=True))
+
+
+def test_batchnorm_train_and_infer():
+    x = np.random.randn(8, 3, 4, 4).astype("f")
+    gamma, beta = np.ones(3, "f"), np.zeros(3, "f")
+    mm, mv = np.zeros(3, "f"), np.ones(3, "f")
+    mm_nd, mv_nd = nd.array(mm), nd.array(mv)
+    with mx.autograd.train_mode():
+        outs = nd.BatchNorm(nd.array(x), nd.array(gamma), nd.array(beta),
+                            mm_nd, mv_nd, fix_gamma=False, momentum=0.9)
+    out = outs[0].asnumpy()
+    mean = x.mean(axis=(0, 2, 3))
+    var = x.var(axis=(0, 2, 3))
+    expect = (x - mean[None, :, None, None]) / np.sqrt(
+        var[None, :, None, None] + 1e-3)
+    assert_almost_equal(out, expect, rtol=1e-2, atol=1e-3)
+    # moving stats updated in place
+    assert_almost_equal(mm_nd.asnumpy(), 0.9 * mm + 0.1 * mean, rtol=1e-4,
+                        atol=1e-5)
+    assert_almost_equal(mv_nd.asnumpy(), 0.9 * mv + 0.1 * var, rtol=1e-4,
+                        atol=1e-5)
+    # inference mode uses moving stats
+    outs_inf = nd.BatchNorm(nd.array(x), nd.array(gamma), nd.array(beta),
+                            mm_nd, mv_nd, fix_gamma=False)
+    expect_inf = (x - mm_nd.asnumpy()[None, :, None, None]) / np.sqrt(
+        mv_nd.asnumpy()[None, :, None, None] + 1e-3)
+    assert_almost_equal(outs_inf[0].asnumpy(), expect_inf, rtol=1e-2,
+                        atol=1e-3)
+
+
+def test_softmax_family():
+    x = np.random.randn(4, 5).astype("f")
+    sm = nd.softmax(nd.array(x)).asnumpy()
+    e = np.exp(x - x.max(1, keepdims=True))
+    assert_almost_equal(sm, e / e.sum(1, keepdims=True), rtol=1e-5)
+    lsm = nd.log_softmax(nd.array(x)).asnumpy()
+    assert_almost_equal(lsm, np.log(e / e.sum(1, keepdims=True)), rtol=1e-4,
+                        atol=1e-5)
+
+
+def test_softmax_output_gradient():
+    # SoftmaxOutput backward = (p - onehot) * grad_scale (reference semantics)
+    data = sym.Variable("data")
+    label = sym.Variable("softmax_label")
+    out = sym.SoftmaxOutput(data, label, grad_scale=2.0)
+    x = np.random.randn(4, 3).astype("f")
+    y = np.array([0, 1, 2, 1], "f")
+    exe = out.bind(mx.cpu(), {"data": nd.array(x), "softmax_label": nd.array(y)},
+                   args_grad={"data": nd.zeros((4, 3))},
+                   grad_req={"data": "write", "softmax_label": "null"})
+    exe.forward(is_train=True)
+    exe.backward()
+    p = exe.outputs[0].asnumpy()
+    onehot = np.eye(3)[y.astype(int)]
+    assert_almost_equal(exe.grad_dict["data"].asnumpy(), (p - onehot) * 2.0,
+                        rtol=1e-4, atol=1e-5)
+
+
+def test_regression_outputs():
+    data = sym.Variable("data")
+    label = sym.Variable("label")
+    out = sym.LinearRegressionOutput(data, label)
+    x = np.random.randn(4, 3).astype("f")
+    y = np.random.randn(4, 3).astype("f")
+    exe = out.bind(mx.cpu(), {"data": nd.array(x), "label": nd.array(y)},
+                   args_grad={"data": nd.zeros((4, 3))},
+                   grad_req={"data": "write", "label": "null"})
+    exe.forward(is_train=True)
+    assert_almost_equal(exe.outputs[0].asnumpy(), x)
+    exe.backward()
+    # grad = (out - label) * grad_scale / num_output  (regression_output-inl.h:95)
+    assert_almost_equal(exe.grad_dict["data"].asnumpy(), (x - y) / 3.0,
+                        rtol=1e-4, atol=1e-5)
+
+
+def test_numeric_gradient_fc():
+    data = sym.Variable("data")
+    fc = sym.FullyConnected(data, name="fc", num_hidden=4)
+    check_numeric_gradient(
+        fc, {"data": np.random.randn(3, 5).astype("f"),
+             "fc_weight": np.random.randn(4, 5).astype("f"),
+             "fc_bias": np.random.randn(4).astype("f")},
+        numeric_eps=1e-3, rtol=5e-2, atol=1e-2)
+
+
+def test_numeric_gradient_elemwise():
+    a = sym.Variable("a")
+    b = sym.Variable("b")
+    out = a * b + sym.tanh(a)
+    check_numeric_gradient(
+        out, {"a": np.random.rand(3, 3).astype("f") + 0.5,
+              "b": np.random.rand(3, 3).astype("f") + 0.5},
+        numeric_eps=1e-3, rtol=5e-2, atol=1e-2)
+
+
+def test_symbolic_forward_backward_helpers():
+    a = sym.Variable("a")
+    out = sym.square(a)
+    x = np.random.rand(3, 2).astype("f")
+    check_symbolic_forward(out, {"a": x}, [x ** 2])
+    check_symbolic_backward(out, {"a": x}, [np.ones_like(x)], [2 * x],
+                            rtol=1e-4, atol=1e-5)
+    # grad_req='add' semantics
+    check_symbolic_backward(out, {"a": x}, [np.ones_like(x)], [2 * x],
+                            grad_req="add", rtol=1e-4, atol=1e-5)
+
+
+def test_sequence_ops():
+    x = np.random.randn(4, 3, 2).astype("f")  # (seq, batch, feat)
+    lens = np.array([2, 4, 1], "f")
+    last = nd.SequenceLast(nd.array(x), nd.array(lens),
+                           use_sequence_length=True).asnumpy()
+    expect = np.stack([x[1, 0], x[3, 1], x[0, 2]])
+    assert_almost_equal(last, expect)
+    masked = nd.SequenceMask(nd.array(x), nd.array(lens),
+                             use_sequence_length=True, value=-1).asnumpy()
+    assert (masked[3, 0] == -1).all() and (masked[1, 0] == x[1, 0]).all()
+    rev = nd.SequenceReverse(nd.array(x), nd.array(lens),
+                             use_sequence_length=True).asnumpy()
+    assert_almost_equal(rev[0, 0], x[1, 0])
+    assert_almost_equal(rev[2, 2], x[2, 2])
+
+
+def test_rnn_op_lstm_shapes_and_scan():
+    T, B, I, H, L = 5, 2, 3, 4, 2
+    from mxnet_tpu.ops.sequence import rnn_param_size
+    psize = rnn_param_size(L, I, H, False, "lstm")
+    params = nd.random.normal(0, 0.1, (psize,))
+    x = nd.random.normal(0, 1, (T, B, I))
+    h0 = nd.zeros((L, B, H))
+    c0 = nd.zeros((L, B, H))
+    outs = nd.RNN(x, params, h0, c0, state_size=H, num_layers=L, mode="lstm",
+                  state_outputs=True)
+    assert outs[0].shape == (T, B, H)
+    assert outs[1].shape == (L, B, H)
+    assert outs[2].shape == (L, B, H)
+    # bidirectional
+    psize = rnn_param_size(1, I, H, True, "gru")
+    params = nd.random.normal(0, 0.1, (psize,))
+    outs = nd.RNN(x, params, nd.zeros((2, B, H)), state_size=H, num_layers=1,
+                  bidirectional=True, mode="gru", state_outputs=True)
+    assert outs[0].shape == (T, B, 2 * H)
+
+
+def test_linalg_ops():
+    a = np.random.randn(3, 3).astype("f")
+    spd = a @ a.T + 3 * np.eye(3, dtype="f")
+    chol = nd.linalg_potrf(nd.array(spd)).asnumpy()
+    assert_almost_equal(chol @ chol.T, spd, rtol=1e-3, atol=1e-4)
+    x = np.random.randn(3, 4).astype("f")
+    y = np.random.randn(4, 5).astype("f")
+    c = np.random.randn(3, 5).astype("f")
+    out = nd.linalg.gemm(nd.array(x), nd.array(y), nd.array(c), alpha=2.0,
+                         beta=0.5).asnumpy()
+    assert_almost_equal(out, 2 * (x @ y) + 0.5 * c, rtol=1e-4)
+
+
+def test_random_ops():
+    u = nd.random.uniform(0, 1, (1000,))
+    assert 0 <= u.asnumpy().min() and u.asnumpy().max() <= 1
+    n = nd.random.normal(0, 1, (2000,))
+    assert abs(n.asnumpy().mean()) < 0.15
+    p = nd.random.poisson(3.0, (500,))
+    assert abs(p.asnumpy().mean() - 3.0) < 0.5
+    r = nd.random.randint(0, 10, (100,))
+    assert r.dtype == np.int32 and r.asnumpy().max() < 10
+    # seeded reproducibility
+    mx.random.seed(7)
+    a = nd.random.uniform(0, 1, (5,)).asnumpy()
+    mx.random.seed(7)
+    b = nd.random.uniform(0, 1, (5,)).asnumpy()
+    assert_almost_equal(a, b)
+
+
+def test_dropout_modes():
+    x = nd.ones((100, 100))
+    with mx.autograd.train_mode():
+        y = nd.Dropout(x, p=0.5)
+    frac = (y.asnumpy() == 0).mean()
+    assert 0.3 < frac < 0.7
+    y_test = nd.Dropout(x, p=0.5)  # predict mode: identity
+    assert (y_test.asnumpy() == 1).all()
+
+
+def test_leaky_relu_variants():
+    x = np.array([-2.0, -0.5, 0.5, 2.0], "f")
+    out = nd.LeakyReLU(nd.array(x), act_type="leaky", slope=0.1).asnumpy()
+    assert_almost_equal(out, np.where(x > 0, x, 0.1 * x), rtol=1e-5)
+    elu = nd.LeakyReLU(nd.array(x), act_type="elu", slope=1.0).asnumpy()
+    assert_almost_equal(elu, np.where(x > 0, x, np.expm1(x)), rtol=1e-4)
+
+
+def test_upsampling_nearest():
+    x = np.arange(4).reshape(1, 1, 2, 2).astype("f")
+    out = nd.UpSampling(nd.array(x), scale=2, sample_type="nearest").asnumpy()
+    assert out.shape == (1, 1, 4, 4)
+    assert (out[0, 0, :2, :2] == x[0, 0, 0, 0]).all()
+
+
+def test_block_grad_and_make_loss():
+    x = nd.array([1.0, 2.0])
+    x.attach_grad()
+    with mx.autograd.record():
+        y = nd.BlockGrad(x) * 3 + x
+    y.backward()
+    assert_almost_equal(x.grad.asnumpy(), np.ones(2))
