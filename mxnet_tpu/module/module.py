@@ -258,6 +258,17 @@ class Module(BaseModule):
             else:
                 aux[name] = nd.zeros(shp, ctx=ctx0, dtype=dt)
 
+        if mesh is not None:
+            # keep params/grads/aux replicated over the mesh so optimizer
+            # updates and kvstore pulls stay SPMD-consistent
+            import jax
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            repl = NamedSharding(mesh, P())
+            for d in (args, grads, aux):
+                for k, v in d.items():
+                    if k not in data_shard_args:
+                        v._set_data(jax.device_put(v._data, repl))
+
         from ..executor import Executor
         group2ctx = None
         if self._group2ctxs:
@@ -316,7 +327,9 @@ class Module(BaseModule):
             if self._compression_params:
                 kvstore.set_gradient_compression(self._compression_params)
             for i, name in enumerate(self._param_names):
-                kvstore.init(name, self._arg_params[name])
+                # init from the executor's (possibly mesh-replicated) buffers
+                # so kvstore-side updates stay SPMD-consistent
+                kvstore.init(name, self._exec.arg_dict[name])
             if update_on_kvstore:
                 kvstore.set_optimizer(self._optimizer)
         if not update_on_kvstore:

@@ -138,8 +138,13 @@ class NDArray:
     def copyto(self, other: Union["NDArray", Context]) -> "NDArray":
         if isinstance(other, Context):
             return NDArray(jax.device_put(self._data, other.jax_device()), other)
+        # preserve the target's sharding (mesh-replicated params stay
+        # replicated through kvstore pulls / set_params)
+        tgt_sharding = getattr(other._data, "sharding", None)
+        placement = tgt_sharding if tgt_sharding is not None else \
+            other._ctx.jax_device()
         other._set_data(jax.device_put(self._data.astype(other.dtype),
-                                       other._ctx.jax_device()))
+                                       placement))
         return other
 
     def as_in_context(self, ctx: Context) -> "NDArray":

@@ -91,7 +91,30 @@ def _prelu_hook(p, shp):
     return {}
 
 
+def _softmax_output_hook(p, shp):
+    d = shp[0]
+    if p.get("multi_output"):
+        return {1: (d[0],) + tuple(d[2:])}
+    if p.get("preserve_shape"):
+        return {1: tuple(d[:-1])}
+    return {1: (d[0],)}
+
+
+def _regression_hook(p, shp):
+    return {1: tuple(shp[0])}
+
+
+def _ce_hook(p, shp):
+    return {1: (shp[0][0],)}
+
+
 PARAM_SHAPE_HOOKS: Dict[str, Callable] = {
+    "SoftmaxOutput": _softmax_output_hook,
+    "LinearRegressionOutput": _regression_hook,
+    "LogisticRegressionOutput": _regression_hook,
+    "MAERegressionOutput": _regression_hook,
+    "SVMOutput": _ce_hook,
+    "softmax_cross_entropy": _ce_hook,
     "FullyConnected": _fc_hook,
     "Convolution": _conv_hook,
     "Deconvolution": _deconv_hook,

@@ -1,14 +1,23 @@
 """Test harness config: 8 virtual CPU devices so multi-chip sharding
 (mesh DP, ring attention, group2ctx placement) is exercised without TPUs
 — the strategy SURVEY.md §4 prescribes (reference ran multi-*CPU*-context
-tests for device-placement logic, tests/python/unittest/test_multi_device_exec.py)."""
+tests for device-placement logic, tests/python/unittest/test_multi_device_exec.py).
+
+Note: the axon TPU plugin on this host registers its backend regardless of
+JAX_PLATFORMS; we therefore pin jax's *default device* to CPU instead of
+trying to hide the TPU platform."""
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = \
         flags + " --xla_force_host_platform_device_count=8"
+
+import jax
+
+_cpus = jax.devices("cpu")
+assert len(_cpus) >= 8, _cpus
+jax.config.update("jax_default_device", _cpus[0])
 
 import numpy as np
 import pytest

@@ -1,0 +1,111 @@
+"""Executor tests (parity model: tests/python/unittest/test_executor.py +
+test_multi_device_exec.py/test_model_parallel.py — ctx_group placement over
+multiple CPU contexts)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, sym
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_bind_forward_backward():
+    a = sym.Variable("a")
+    b = sym.Variable("b")
+    out = a * b
+    x = np.random.rand(3, 3).astype("f")
+    y = np.random.rand(3, 3).astype("f")
+    exe = out.bind(mx.cpu(), {"a": nd.array(x), "b": nd.array(y)},
+                   args_grad={"a": nd.zeros((3, 3)), "b": nd.zeros((3, 3))})
+    exe.forward(is_train=True)
+    assert_almost_equal(exe.outputs[0].asnumpy(), x * y, rtol=1e-5)
+    og = np.random.rand(3, 3).astype("f")
+    exe.backward(nd.array(og))
+    assert_almost_equal(exe.grad_dict["a"].asnumpy(), og * y, rtol=1e-5)
+    assert_almost_equal(exe.grad_dict["b"].asnumpy(), og * x, rtol=1e-5)
+
+
+def test_simple_bind():
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data, name="fc", num_hidden=4)
+    exe = net.simple_bind(mx.cpu(), data=(2, 6))
+    assert exe.arg_dict["fc_weight"].shape == (4, 6)
+    exe.arg_dict["data"][:] = 1
+    exe.arg_dict["fc_weight"][:] = 1
+    exe.arg_dict["fc_bias"][:] = 0
+    out = exe.forward()[0]
+    assert (out.asnumpy() == 6).all()
+
+
+def test_forward_kwargs_update():
+    a = sym.Variable("a")
+    out = a * 2
+    exe = out.bind(mx.cpu(), {"a": nd.ones((2,))})
+    r1 = exe.forward()[0].asnumpy()
+    r2 = exe.forward(a=nd.array([5.0, 5.0]))[0].asnumpy()
+    assert (r1 == 2).all() and (r2 == 10).all()
+
+
+def test_grad_req_add_executor():
+    a = sym.Variable("a")
+    out = a * a
+    grad = nd.ones((2,))
+    exe = out.bind(mx.cpu(), {"a": nd.array([1.0, 2.0])},
+                   args_grad={"a": grad}, grad_req="add")
+    for _ in range(2):
+        exe.forward(is_train=True)
+        exe.backward(nd.ones((2,)))
+    # initial ones + 2 * (2a)
+    assert_almost_equal(grad.asnumpy(), 1 + 2 * 2 * np.array([1.0, 2.0]))
+
+
+def test_reshape_executor():
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data, name="fc", num_hidden=4)
+    exe = net.simple_bind(mx.cpu(), data=(2, 6))
+    exe.arg_dict["fc_weight"][:] = 1
+    exe2 = exe.reshape(data=(5, 6))
+    assert exe2.arg_dict["data"].shape == (5, 6)
+    # params shared with original executor
+    assert exe2.arg_dict["fc_weight"] is exe.arg_dict["fc_weight"]
+
+
+def test_fused_forward_backward():
+    a = sym.Variable("a")
+    out = sym.sum(a * a)
+    exe = out.bind(mx.cpu(), {"a": nd.array([1.0, 2.0, 3.0])},
+                   args_grad={"a": nd.zeros((3,))})
+    outs = exe.forward_backward()
+    assert_almost_equal(outs[0].asnumpy(), 14.0, rtol=1e-6)
+    assert_almost_equal(exe.grad_dict["a"].asnumpy(), [2.0, 4.0, 6.0])
+
+
+def test_monitor_callback():
+    seen = []
+    a = sym.Variable("a")
+    out = a + 1
+    exe = out.bind(mx.cpu(), {"a": nd.ones((2,))})
+    exe.set_monitor_callback(lambda name, arr: seen.append(name))
+    exe.forward()
+    assert seen and seen[0].endswith("output")
+
+
+def test_group2ctx_model_parallel():
+    """Device-placement model parallelism over multiple CPU contexts
+    (parity: test_model_parallel.py — group2ctx spanning cpu(0)/cpu(1))."""
+    with mx.AttrScope(ctx_group="dev1"):
+        a = sym.Variable("a")
+        h = a * 2
+    with mx.AttrScope(ctx_group="dev2"):
+        out = h + 1
+    exe = out.bind(mx.cpu(0), {"a": nd.ones((4,))},
+                   group2ctx={"dev1": mx.cpu(0), "dev2": mx.cpu(1)})
+    res = exe.forward()[0]
+    assert (res.asnumpy() == 3).all()
+
+
+def test_outputs_before_forward_raises():
+    a = sym.Variable("a")
+    exe = (a * 1).bind(mx.cpu(), {"a": nd.ones((1,))})
+    with pytest.raises(mx.MXNetError):
+        _ = exe.outputs

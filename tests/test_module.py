@@ -1,0 +1,161 @@
+"""Module tests (parity model: tests/python/unittest/test_module.py +
+tests/python/train/test_mlp.py convergence)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, sym
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def _toy_data(n=400, d=10, classes=3, seed=0):
+    rs = np.random.RandomState(seed)
+    x = rs.randn(n, d).astype("f")
+    w = rs.randn(d, classes)
+    y = (x @ w).argmax(axis=1).astype("f")
+    return x, y
+
+
+def _mlp(classes=3):
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data, name="fc1", num_hidden=32)
+    net = sym.Activation(net, act_type="relu")
+    net = sym.FullyConnected(net, name="fc2", num_hidden=classes)
+    return sym.SoftmaxOutput(net, name="softmax")
+
+
+def test_module_fit_convergence():
+    x, y = _toy_data()
+    train = mx.io.NDArrayIter(x, y, batch_size=50, shuffle=True)
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    mod.fit(train, num_epoch=6, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.5})
+    score = mod.score(mx.io.NDArrayIter(x, y, batch_size=50), "acc")
+    assert score[0][1] > 0.9, score
+
+
+def test_module_predict():
+    x, y = _toy_data(100)
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    it = mx.io.NDArrayIter(x, y, batch_size=25)
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params()
+    out = mod.predict(it)
+    assert out.shape == (100, 3)
+
+
+def test_module_checkpoint(tmp_path):
+    x, y = _toy_data(100)
+    train = mx.io.NDArrayIter(x, y, batch_size=25)
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    mod.fit(train, num_epoch=1, optimizer="sgd")
+    prefix = str(tmp_path / "model")
+    mod.save_checkpoint(prefix, 1)
+    mod2 = mx.mod.Module.load(prefix, 1)
+    mod2.bind(data_shapes=train.provide_data,
+              label_shapes=train.provide_label)
+    mod2.init_params()
+    a1, _ = mod.get_params()
+    a2, _ = mod2.get_params()
+    for k in a1:
+        assert_almost_equal(a1[k].asnumpy(), a2[k].asnumpy())
+
+
+def test_module_get_set_params():
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    it_shapes = [("data", (10, 10))]
+    mod.bind(data_shapes=it_shapes, label_shapes=[("softmax_label", (10,))])
+    mod.init_params(mx.init.Xavier())
+    arg, aux = mod.get_params()
+    arg["fc1_weight"][:] = 5
+    mod.set_params(arg, aux)
+    arg2, _ = mod.get_params()
+    assert (arg2["fc1_weight"].asnumpy() == 5).all()
+
+
+def test_module_multi_device_dp():
+    """Data parallelism over multiple CPU contexts → ONE mesh-sharded
+    executor (the TPU redesign of DataParallelExecutorGroup)."""
+    x, y = _toy_data(n=160)
+    train = mx.io.NDArrayIter(x, y, batch_size=40)
+    ctxs = [mx.cpu(i) for i in range(4)]
+    mod = mx.mod.Module(_mlp(), context=ctxs)
+    mod.fit(train, num_epoch=4, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.5}, kvstore="local")
+    score = mod.score(mx.io.NDArrayIter(x, y, batch_size=40), "acc")
+    assert score[0][1] > 0.85, score
+
+
+def test_module_dp_matches_single_device():
+    x, y = _toy_data(n=80)
+    it = mx.io.NDArrayIter(x, y, batch_size=80)
+    batch = next(iter(it))
+
+    def grads_with(ctxs):
+        mod = mx.mod.Module(_mlp(), context=ctxs)
+        mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+        mod.init_params(mx.init.Xavier())
+        arg, aux = mod.get_params()
+        return mod, arg
+
+    m1, arg1 = grads_with(mx.cpu())
+    m2, _ = grads_with([mx.cpu(i) for i in range(4)])
+    m2.set_params(arg1, {})
+    m1.forward_backward(batch)
+    m2.forward_backward(batch)
+    g1 = m1._exec.grad_dict["fc1_weight"].asnumpy()
+    g2 = m2._exec.grad_dict["fc1_weight"].asnumpy()
+    assert_almost_equal(g1, g2, rtol=1e-4, atol=1e-5)
+
+
+def test_bucketing_module():
+    """Parity model: tests/python/train/test_bucketing.py (shape-bucketed
+    executors sharing parameters)."""
+    buckets = [4, 8]
+
+    def sym_gen(seq_len):
+        data = sym.Variable("data")
+        label = sym.Variable("softmax_label")
+        net = sym.FullyConnected(data, name="fc", num_hidden=4, flatten=True)
+        net = sym.SoftmaxOutput(net, label, name="softmax")
+        return net, ("data",), ("softmax_label",)
+
+    mod = mx.mod.BucketingModule(sym_gen, default_bucket_key=8,
+                                 context=mx.cpu())
+    batch8 = mx.io.DataBatch([nd.ones((2, 8))], [nd.zeros((2,))],
+                             bucket_key=8,
+                             provide_data=[mx.io.DataDesc("data", (2, 8))],
+                             provide_label=[mx.io.DataDesc("softmax_label",
+                                                           (2,))])
+    mod.bind(batch8.provide_data, batch8.provide_label)
+    mod.init_params()
+    mod.init_optimizer(optimizer="sgd", kvstore=None)
+    mod.forward_backward(batch8)
+    mod.update()
+    # smaller bucket needs a fresh executor but shares fc weight? shapes
+    # differ per bucket for FC over flatten — use same-shaped feature dim
+    batch8b = mx.io.DataBatch([nd.ones((2, 8)) * 2], [nd.zeros((2,))],
+                              bucket_key=8,
+                              provide_data=[mx.io.DataDesc("data", (2, 8))],
+                              provide_label=[mx.io.DataDesc("softmax_label",
+                                                            (2,))])
+    mod.forward(batch8b, is_train=False)
+    assert mod.get_outputs()[0].shape == (2, 4)
+
+
+def test_sequential_module():
+    x, y = _toy_data(100)
+    net1 = sym.FullyConnected(sym.Variable("data"), name="fc1", num_hidden=16)
+    net1 = sym.Activation(net1, act_type="relu")
+    net2 = sym.FullyConnected(sym.Variable("data"), name="fc2", num_hidden=3)
+    net2 = sym.SoftmaxOutput(net2, name="softmax")
+    mod = mx.mod.SequentialModule()
+    mod.add(mx.mod.Module(net1, label_names=None))
+    mod.add(mx.mod.Module(net2), take_labels=True, auto_wiring=True)
+    it = mx.io.NDArrayIter(x, y, batch_size=25)
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params()
+    mod.init_optimizer(kvstore=None)
+    batch = next(iter(it))
+    mod.forward(batch)
+    assert mod.get_outputs()[0].shape == (25, 3)

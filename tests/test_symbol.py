@@ -1,0 +1,128 @@
+"""Symbol tests (parity model: tests/python/unittest/test_symbol.py +
+test_infer_shape.py + test_attr.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import sym
+
+
+def _mlp():
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data, name="fc1", num_hidden=10)
+    net = sym.Activation(net, act_type="relu")
+    net = sym.FullyConnected(net, name="fc2", num_hidden=3)
+    return sym.SoftmaxOutput(net, name="softmax")
+
+
+def test_compose_and_lists():
+    net = _mlp()
+    assert net.list_arguments() == ["data", "fc1_weight", "fc1_bias",
+                                    "fc2_weight", "fc2_bias", "softmax_label"]
+    assert net.list_outputs() == ["softmax_output"]
+    assert net.name == "softmax"
+
+
+def test_infer_shape():
+    net = _mlp()
+    arg_shapes, out_shapes, aux_shapes = net.infer_shape(data=(8, 100))
+    assert arg_shapes[1] == (10, 100)
+    assert arg_shapes[3] == (3, 10)
+    assert out_shapes == [(8, 3)]
+    assert aux_shapes == []
+
+
+def test_infer_shape_partial():
+    data = sym.Variable("data")
+    fc = sym.FullyConnected(data, name="fc", num_hidden=5)
+    arg_shapes, out_shapes, _ = fc.infer_shape_partial()
+    assert out_shapes is None or out_shapes == [None]
+
+
+def test_conv_bn_infer():
+    data = sym.Variable("data")
+    net = sym.Convolution(data, name="conv", kernel=(3, 3), num_filter=8,
+                          pad=(1, 1))
+    net = sym.BatchNorm(net, name="bn")
+    net = sym.Pooling(net, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    arg_shapes, out_shapes, aux_shapes = net.infer_shape(data=(2, 3, 8, 8))
+    assert arg_shapes[1] == (8, 3, 3, 3)     # conv weight
+    assert out_shapes == [(2, 8, 4, 4)]
+    # BatchNorm moving stats are auxiliary, not arguments
+    assert net.list_auxiliary_states() == ["bn_moving_mean", "bn_moving_var"]
+    assert aux_shapes == [(8,), (8,)]
+
+
+def test_group_and_getitem():
+    a = sym.Variable("a")
+    b = sym.Variable("b")
+    g = sym.Group([a * 2, b + 1])
+    assert len(g) == 2
+    assert len(g.list_outputs()) == 2
+    first = g[0]
+    assert len(first) == 1
+
+
+def test_get_internals():
+    net = _mlp()
+    internals = net.get_internals()
+    names = internals.list_outputs()
+    assert "fc1_output" in names
+    fc1 = internals["fc1_output"]
+    assert fc1.list_arguments() == ["data", "fc1_weight", "fc1_bias"]
+
+
+def test_json_roundtrip():
+    net = _mlp()
+    js = net.tojson()
+    net2 = sym.load_json(js)
+    assert net2.list_arguments() == net.list_arguments()
+    assert net2.list_outputs() == net.list_outputs()
+    a1, o1, _ = net.infer_shape(data=(4, 20))
+    a2, o2, _ = net2.infer_shape(data=(4, 20))
+    assert o1 == o2 and a1 == a2
+
+
+def test_save_load_file(tmp_path):
+    net = _mlp()
+    f = str(tmp_path / "net.json")
+    net.save(f)
+    net2 = sym.load(f)
+    assert net2.list_arguments() == net.list_arguments()
+
+
+def test_attr_scope():
+    with mx.AttrScope(ctx_group="dev1", lr_mult="0.5"):
+        v = sym.Variable("w")
+    assert v.attr("ctx_group") == "dev1"
+    assert v.attr("lr_mult") == "0.5"
+    data = sym.Variable("data")
+    with mx.AttrScope(ctx_group="dev2"):
+        fc = sym.FullyConnected(data, name="fc", num_hidden=3)
+    assert fc.attr("ctx_group") == "dev2"
+
+
+def test_variable_composition():
+    lhs = sym.Variable("lhs")
+    rhs = sym.Variable("rhs")
+    net = sym.FullyConnected(lhs, name="fc1", num_hidden=10)
+    composed = net(lhs=rhs)
+    assert "rhs" in composed.list_arguments()
+    assert "lhs" not in composed.list_arguments()
+
+
+def test_symbol_arithmetic_eval():
+    a = sym.Variable("a")
+    b = sym.Variable("b")
+    c = 2 * a + b ** 2 - 1
+    from mxnet_tpu import nd
+    out = c.eval(a=nd.array([1.0, 2.0]), b=nd.array([3.0, 1.0]))[0]
+    np.testing.assert_allclose(out.asnumpy(), [10.0, 4.0])
+
+
+def test_name_uniqueness():
+    data = sym.Variable("data")
+    with mx.name.NameManager():
+        f1 = sym.FullyConnected(data, num_hidden=2)
+        f2 = sym.FullyConnected(data, num_hidden=2)
+    assert f1.name != f2.name
