@@ -220,14 +220,20 @@ class Executor:
     # -- utilities ----------------------------------------------------------
     def copy_params_from(self, arg_params, aux_params=None,
                          allow_extra_params: bool = False) -> None:
+        def _assign(tgt: NDArray, v):
+            # preserve the target's sharding (mesh-replicated stay replicated)
+            sh = getattr(tgt._data, "sharding", None)
+            data = v._data.astype(tgt.dtype)
+            tgt._set_data(jax.device_put(data, sh) if sh is not None else data)
+
         for k, v in (arg_params or {}).items():
             if k in self.arg_dict:
-                self.arg_dict[k]._set_data(v._data.astype(self.arg_dict[k].dtype))
+                _assign(self.arg_dict[k], v)
             elif not allow_extra_params:
                 raise MXNetError(f"unknown argument {k}")
         for k, v in (aux_params or {}).items():
             if k in self.aux_dict:
-                self.aux_dict[k]._set_data(v._data.astype(self.aux_dict[k].dtype))
+                _assign(self.aux_dict[k], v)
             elif not allow_extra_params:
                 raise MXNetError(f"unknown aux state {k}")
 
