@@ -80,8 +80,9 @@ def test_module_multi_device_dp():
     train = mx.io.NDArrayIter(x, y, batch_size=40)
     ctxs = [mx.cpu(i) for i in range(4)]
     mod = mx.mod.Module(_mlp(), context=ctxs)
-    mod.fit(train, num_epoch=4, optimizer="sgd",
-            optimizer_params={"learning_rate": 0.5}, kvstore="local")
+    mod.fit(train, num_epoch=10, initializer=mx.init.Xavier(),
+            optimizer="sgd", optimizer_params={"learning_rate": 0.5},
+            kvstore="local")
     score = mod.score(mx.io.NDArrayIter(x, y, batch_size=40), "acc")
     assert score[0][1] > 0.85, score
 
