@@ -1,0 +1,89 @@
+"""Driver benchmark: ResNet-50 training throughput (img/s) on one chip.
+
+Baseline (BASELINE.md): reference MXNet trains ResNet-50/ImageNet at
+109 img/s on 1x K80 @ BS=32 (example/image-classification/README.md:147).
+
+This runs the flagship gluon model-zoo ResNet-50 v1 through the Symbol
+graph interpreter as ONE jitted training step (forward, softmax CE, vjp,
+SGD update, BN running-stat update) in mixed precision: bf16 compute on
+the MXU, fp32 master weights (reference precedent: mp_sgd_update,
+src/operator/optimizer_op.cc:111-128).
+
+Prints one JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+import json
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+
+BASELINE_IMG_S = 109.0  # 1x K80, BS=32
+BATCH = 256
+STEPS = 10
+
+
+def build():
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon.model_zoo import vision
+    from mxnet_tpu.symbol.graph import GraphPlan
+
+    net = vision.resnet50_v1()
+    out = net(mx.sym.Variable("data"))
+    plan = GraphPlan(out)
+
+    arg_shapes, _, aux_shapes = out.infer_shape(data=(BATCH, 3, 224, 224))
+    rs = np.random.RandomState(0)
+    params = {}
+    for name, shp in zip(out.list_arguments(), arg_shapes):
+        if name == "data":
+            continue
+        params[name] = jnp.asarray(rs.normal(0, 0.05, shp).astype(np.float32))
+    aux = {}
+    for name, shp in zip(out.list_auxiliary_states(), aux_shapes):
+        one = name.endswith("running_var") or name.endswith("gamma")
+        aux[name] = (jnp.ones if one else jnp.zeros)(shp, jnp.float32)
+    key = jax.random.PRNGKey(0)
+
+    def train_step(ps, auxs, x, y):
+        def loss_fn(ps32):
+            d = {k: v.astype(jnp.bfloat16) for k, v in ps32.items()}
+            d["data"] = x.astype(jnp.bfloat16)
+            outs, new_aux = plan.run(d, auxs, key, True)
+            logits = outs[0].astype(jnp.float32)
+            logp = jax.nn.log_softmax(logits, axis=-1)
+            nll = -jnp.mean(jnp.take_along_axis(logp, y[:, None], -1))
+            return nll, new_aux
+
+        (loss, new_aux), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(ps)
+        new_ps = jax.tree_util.tree_map(
+            lambda w, g: w - 0.05 * g.astype(jnp.float32), ps, grads)
+        return loss, new_ps, new_aux
+
+    x = jnp.asarray(rs.normal(0, 1, (BATCH, 3, 224, 224)).astype(np.float32))
+    y = jnp.asarray(rs.randint(0, 1000, (BATCH,)).astype(np.int32))
+    return jax.jit(train_step, donate_argnums=(0, 1)), params, aux, x, y
+
+
+def main():
+    step, params, aux, x, y = build()
+    loss, params, aux = step(params, aux, x, y)  # compile + warmup
+    float(loss)  # host fetch: block_until_ready is a no-op under axon
+    t0 = time.perf_counter()
+    for _ in range(STEPS):
+        loss, params, aux = step(params, aux, x, y)
+    float(loss)
+    dt = time.perf_counter() - t0
+    img_s = BATCH * STEPS / dt
+    print(json.dumps({
+        "metric": "resnet50_train_throughput",
+        "value": round(img_s, 2),
+        "unit": "img/s",
+        "vs_baseline": round(img_s / BASELINE_IMG_S, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -11,6 +11,7 @@ SVMOutput).  Conv/matmul map directly onto the MXU via
 from __future__ import annotations
 
 import functools
+import itertools as _itertools
 
 import jax
 import jax.numpy as jnp
@@ -78,10 +79,10 @@ def _convolution(p, data, weight, bias=None):
         rhs_dilation=_tup(p["dilate"], n),
         dimension_numbers=dn,
         feature_group_count=p["num_group"],
-        preferred_element_type=jnp.float32 if data.dtype == jnp.bfloat16 else None,
+        # no preferred_element_type upcast: the MXU accumulates bf16
+        # operands in f32 natively, and requesting f32 output breaks the
+        # conv transpose rule (f32 cotangent x bf16 weight).
     )
-    if out.dtype != data.dtype:
-        out = out.astype(data.dtype)
     if not p["no_bias"]:
         out = out + bias.reshape((1, -1) + (1,) * n)
     return out
@@ -161,9 +162,32 @@ def _pooling(p, x):
     strides = (1, 1) + stride
     padding = ((0, 0), (0, 0)) + tuple(lo_hi)
     if p["pool_type"] == "max":
-        init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
-        return lax.reduce_window(x, jnp.asarray(init, x.dtype), lax.max,
-                                 window, strides, padding)
+        # Patch-stack max instead of lax.reduce_window(max): the
+        # select_and_gather_add gradient packs values into 64-bit pairs,
+        # which the TPU backend rejects under jax_enable_x64; static
+        # strided slices + reduce_max differentiate cleanly and XLA
+        # fuses them.
+        init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) \
+            else jnp.iinfo(x.dtype).min
+        vol = 1
+        for ki in k:
+            vol *= ki
+        if vol > 64:
+            # large kernels (SPP-style): patch-stack would emit vol slices
+            # and a vol-times-output buffer; fall back to reduce_window
+            # (grad unsupported on TPU+x64, but these never appear in
+            # trained backbones)
+            return lax.reduce_window(x, jnp.asarray(init, x.dtype), lax.max,
+                                     window, strides, padding)
+        xp = jnp.pad(x, padding, constant_values=jnp.asarray(init, x.dtype))
+        out_sz = [(xp.shape[2 + i] - k[i]) // stride[i] + 1 for i in range(n)]
+        parts = []
+        for offs in _itertools.product(*[range(ki) for ki in k]):
+            idx = (slice(None), slice(None)) + tuple(
+                slice(offs[i], offs[i] + (out_sz[i] - 1) * stride[i] + 1,
+                      stride[i]) for i in range(n))
+            parts.append(xp[idx])
+        return jnp.max(jnp.stack(parts), axis=0)
     summed = lax.reduce_window(x, jnp.asarray(0, x.dtype), lax.add,
                                window, strides, padding)
     if p["pool_type"] == "sum":

@@ -92,7 +92,7 @@ def ring_attention_sharded(q, k, v, mesh: Mesh, axis_name: str = "sp",
     spec = P(None, None, axis_name, None)
     fn = shard_map(
         functools.partial(ring_attention, axis_name=axis_name, causal=causal),
-        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec, check_rep=False)
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec, check_vma=False)
     return fn(q, k, v)
 
 
@@ -129,5 +129,5 @@ def ulysses_attention_sharded(q, k, v, mesh: Mesh, axis_name: str = "sp",
     spec = P(None, None, axis_name, None)
     fn = shard_map(
         functools.partial(ulysses_attention, axis_name=axis_name, causal=causal),
-        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec, check_rep=False)
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec, check_vma=False)
     return fn(q, k, v)
