@@ -1,0 +1,21 @@
+# Native host runtime (engine / storage pool / recordio / batch loader).
+# `make native` -> mxnet_tpu/_native/libmxtpu_runtime.so
+CXX ?= g++
+CXXFLAGS ?= -O2 -std=c++17 -fPIC -Wall -pthread -fvisibility=hidden
+SRCS := src/runtime/storage.cc src/runtime/engine.cc \
+        src/runtime/recordio.cc src/runtime/prefetch.cc
+LIB := mxnet_tpu/_native/libmxtpu_runtime.so
+
+.PHONY: native test clean
+
+native: $(LIB)
+
+$(LIB): $(SRCS) src/runtime/mxt_runtime.h
+	@mkdir -p mxnet_tpu/_native
+	$(CXX) $(CXXFLAGS) -shared -o $@ $(SRCS)
+
+test: native
+	python -m pytest tests/ -x -q
+
+clean:
+	rm -f $(LIB)

@@ -1,0 +1,105 @@
+"""ctypes bindings for the native host runtime (src/runtime/).
+
+Loads libmxtpu_runtime.so, building it with `make native` on first import
+if g++ is available; every consumer (engine, recordio, io) degrades to the
+pure-python path when `lib()` returns None, so the package works without a
+toolchain.
+"""
+from __future__ import annotations
+
+import atexit
+import ctypes
+import os
+import subprocess
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_ROOT = os.path.dirname(os.path.dirname(_HERE))
+_LIB_PATH = os.path.join(_HERE, "libmxtpu_runtime.so")
+
+_lib = None
+_tried = False
+
+
+def _build():
+    mk = os.path.join(_ROOT, "Makefile")
+    if not os.path.exists(mk):
+        return False
+    try:
+        subprocess.run(["make", "-C", _ROOT, "native"], check=True,
+                       capture_output=True, timeout=120)
+        return os.path.exists(_LIB_PATH)
+    except Exception:
+        return False
+
+
+def _bind(l):
+    u64, i32, vp, cp = (ctypes.c_uint64, ctypes.c_int, ctypes.c_void_p,
+                        ctypes.c_char_p)
+    l.MXTStorageAlloc.restype = vp
+    l.MXTStorageAlloc.argtypes = [ctypes.c_size_t]
+    l.MXTStorageFree.argtypes = [vp, ctypes.c_size_t]
+    l.MXTStoragePoolStats.argtypes = [ctypes.POINTER(u64)] * 4
+    l.MXTEngineStart.argtypes = [i32]
+    l.MXTEngineNewVar.restype = u64
+    l.MXTEngineDeleteVar.argtypes = [u64]
+    l.MXTEnginePushAsync.argtypes = [
+        ctypes.CFUNCTYPE(None, vp), vp,
+        ctypes.POINTER(u64), i32, ctypes.POINTER(u64), i32, i32]
+    l.MXTEngineWaitForVar.argtypes = [u64]
+    l.MXTEngineNumWorkers.restype = i32
+    l.MXTEngineNumPushed.restype = u64
+    l.MXTRecordIOWriterCreate.restype = vp
+    l.MXTRecordIOWriterCreate.argtypes = [cp]
+    l.MXTRecordIOWriterWrite.argtypes = [vp, ctypes.c_char_p, u64]
+    l.MXTRecordIOWriterWrite.restype = i32
+    l.MXTRecordIOWriterTell.restype = u64
+    l.MXTRecordIOWriterTell.argtypes = [vp]
+    l.MXTRecordIOWriterClose.argtypes = [vp]
+    l.MXTRecordIOReaderCreate.restype = vp
+    l.MXTRecordIOReaderCreate.argtypes = [cp]
+    l.MXTRecordIOReaderNext.argtypes = [vp, ctypes.POINTER(vp),
+                                        ctypes.POINTER(u64)]
+    l.MXTRecordIOReaderNext.restype = i32
+    l.MXTRecordIOReaderSeek.argtypes = [vp, u64]
+    l.MXTRecordIOReaderTell.restype = u64
+    l.MXTRecordIOReaderTell.argtypes = [vp]
+    l.MXTRecordIOReaderClose.argtypes = [vp]
+    l.MXTBatchLoaderCreate.restype = vp
+    l.MXTBatchLoaderCreate.argtypes = [cp, i32, u64, i32, i32, i32, u64]
+    l.MXTBatchLoaderNext.argtypes = [vp, ctypes.POINTER(vp),
+                                     ctypes.POINTER(vp)]
+    l.MXTBatchLoaderNext.restype = i32
+    l.MXTBatchLoaderReset.argtypes = [vp]
+    l.MXTBatchLoaderNumSamples.restype = u64
+    l.MXTBatchLoaderNumSamples.argtypes = [vp]
+    l.MXTBatchLoaderFree.argtypes = [vp]
+    l.MXTGetLastError.restype = cp
+    return l
+
+
+def lib():
+    """The loaded native library, or None when unavailable."""
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    _tried = True
+    if os.environ.get("MXNET_TPU_NO_NATIVE"):
+        return None
+    if not os.path.exists(_LIB_PATH) and not _build():
+        return None
+    try:
+        _lib = _bind(ctypes.CDLL(_LIB_PATH))
+    except OSError:
+        _lib = None
+    if _lib is not None:
+        # drain queued host-engine ops BEFORE interpreter finalization: the
+        # C++ static destructor would otherwise run ctypes trampolines on a
+        # dead interpreter
+        atexit.register(_lib.MXTEngineWaitAll)
+    return _lib
+
+
+def lib_if_loaded():
+    """The native library only if already loaded — never triggers a build.
+    Use from sync primitives (waitall) that must not stall on `make`."""
+    return _lib

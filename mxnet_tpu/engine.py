@@ -66,6 +66,10 @@ def wait_for_all() -> None:
         jax.effects_barrier()
     except Exception:
         pass
+    from ._native import lib_if_loaded
+    l = lib_if_loaded()  # never trigger a native build inside a barrier
+    if l is not None:
+        l.MXTEngineWaitAll()
 
 
 def set_bulk_size(size: int) -> int:
@@ -86,3 +90,75 @@ def bulk(size: int):
         yield
     finally:
         set_bulk_size(old)
+
+
+# ---------------------------------------------------------------------------
+# Native host engine (src/runtime/engine.cc): async scheduling for HOST work
+# (IO, checkpoint writes, metric sinks) with the reference's read/write var
+# discipline.  Device compute stays on PJRT; this orders what PJRT can't see.
+# ---------------------------------------------------------------------------
+_native_keepalive = []
+
+
+def _native():
+    from ._native import lib
+    return lib()
+
+
+def native_available() -> bool:
+    return _native() is not None
+
+
+class HostVar:
+    """Engine variable (parity: Engine::NewVariable, engine.h:134)."""
+
+    def __init__(self):
+        l = _native()
+        self._lib = l
+        self.handle = l.MXTEngineNewVar() if l is not None else None
+
+    def __del__(self):
+        if getattr(self, "handle", None) is not None:
+            self._lib.MXTEngineDeleteVar(self.handle)
+            self.handle = None
+
+
+def push_host(fn, read_vars=(), write_vars=(), priority=0) -> None:
+    """Parity: Engine::PushAsync for host callbacks.
+
+    fn() runs on a native worker thread once all deps clear; concurrent
+    reads, exclusive writes, push order preserved per var.  Without the
+    native lib (or in NaiveEngine mode) fn runs synchronously.
+    """
+    l = _native()
+    if l is None or is_naive():
+        fn()
+        return
+    import ctypes
+
+    cb_type = ctypes.CFUNCTYPE(None, ctypes.c_void_p)
+
+    def trampoline(_):
+        try:
+            fn()
+        finally:
+            _native_keepalive.remove(cb)
+
+    cb = cb_type(trampoline)
+    _native_keepalive.append(cb)
+    n_r, n_w = len(read_vars), len(write_vars)
+    rv = (ctypes.c_uint64 * max(n_r, 1))(*[v.handle for v in read_vars])
+    wv = (ctypes.c_uint64 * max(n_w, 1))(*[v.handle for v in write_vars])
+    l.MXTEnginePushAsync(cb, None, rv, n_r, wv, n_w, priority)
+
+
+def wait_for_host_var(var: HostVar) -> None:
+    l = _native()
+    if l is not None and var.handle is not None:
+        l.MXTEngineWaitForVar(var.handle)
+
+
+def wait_host_all() -> None:
+    l = _native()
+    if l is not None:
+        l.MXTEngineWaitAll()

@@ -405,4 +405,162 @@ def ImageRecordIter(path_imgrec, data_shape, batch_size, label_width=1,
     return PrefetchingIter(it, depth=int(prefetch_buffer))
 
 
+class TensorRecordIter(DataIter):
+    """Native threaded batch loader over raw-tensor .rec files.
+
+    The TPU-native fast path for the input pipeline: the C++ runtime
+    (src/runtime/prefetch.cc — parity src/io/iter_prefetcher.h +
+    iter_batchloader.h) reads IRHeader records, assembles batches into
+    pooled host buffers off the GIL, and this iterator wraps them as
+    DataBatch.  Records must carry raw `data_shape`-sized payloads of
+    `dtype` (e.g. written by tools/im2rec.py --raw or io.save_tensor_rec).
+    Falls back to a pure-python reader when the native lib is unbuilt.
+    """
+
+    def __init__(self, path_imgrec, data_shape, batch_size, label_width=1,
+                 shuffle=False, seed=0, prefetch_buffer=2, dtype="uint8",
+                 data_name="data", label_name="softmax_label",
+                 round_batch=True):
+        super().__init__(batch_size)
+        import ctypes as _ct
+        self._ct = _ct
+        self.data_shape = tuple(data_shape)
+        self.label_width = label_width
+        self.dtype = _np.dtype(dtype)
+        self.data_name = data_name
+        self.label_name = label_name
+        self.round_batch = round_batch
+        self._sample_nbytes = int(_np.prod(self.data_shape)) * self.dtype.itemsize
+        if not os.path.isfile(path_imgrec):
+            raise MXNetError(f"record file not found: {path_imgrec}")
+        from ._native import lib as _native_lib
+        self._lib = _native_lib()
+        self._h = None
+        if self._lib is not None:
+            self._h = self._lib.MXTBatchLoaderCreate(
+                path_imgrec.encode(), batch_size, self._sample_nbytes,
+                label_width, int(prefetch_buffer), int(bool(shuffle)),
+                int(seed))
+            if self._h is None:
+                # don't silently fall back to eagerly slurping the whole
+                # file into python memory when the native path *should*
+                # have worked
+                raise MXNetError(
+                    "native batch loader failed on %s: %s" %
+                    (path_imgrec, self._lib.MXTGetLastError().decode()))
+        if self._h is None:
+            # pure-python fallback
+            from .recordio import MXRecordIO, unpack
+            self._py_records = []
+            rio = MXRecordIO(path_imgrec, "r")
+            while True:
+                buf = rio.read()
+                if buf is None:
+                    break
+                self._py_records.append(unpack(buf))
+            rio.close()
+            self._py_pos = 0
+            self._shuffle = bool(shuffle)
+            self._rs = _np.random.RandomState(seed)
+            if self._shuffle:
+                self._order = self._rs.permutation(len(self._py_records))
+            else:
+                self._order = _np.arange(len(self._py_records))
+
+    @property
+    def provide_data(self):
+        return [DataDesc(self.data_name, (self.batch_size,) + self.data_shape,
+                         self.dtype)]
+
+    @property
+    def provide_label(self):
+        shp = (self.batch_size,) if self.label_width == 1 else \
+            (self.batch_size, self.label_width)
+        return [DataDesc(self.label_name, shp, _np.float32)]
+
+    def reset(self):
+        if self._h is not None:
+            self._lib.MXTBatchLoaderReset(self._h)
+        else:
+            self._py_pos = 0
+            if self._shuffle:
+                self._order = self._rs.permutation(len(self._py_records))
+
+    def _wrap(self, data_np, label_np, n):
+        from . import ndarray as nd
+        pad = self.batch_size - n
+        if pad and self.round_batch:
+            data_np = _np.concatenate([data_np, data_np[:pad]] if n >= pad
+                                      else [data_np] * (self.batch_size // max(n, 1) + 1))[:self.batch_size]
+            label_np = _np.concatenate([label_np, label_np[:pad]] if n >= pad
+                                       else [label_np] * (self.batch_size // max(n, 1) + 1))[:self.batch_size]
+        rows = self.batch_size if self.round_batch else n
+        pad = pad if self.round_batch else 0
+        data_np = data_np[:rows]
+        if self.label_width == 1:
+            label_np = label_np.reshape(-1)[:rows]
+        else:
+            label_np = label_np.reshape(-1, self.label_width)[:rows]
+        return DataBatch(data=[nd.array(data_np)], label=[nd.array(label_np)],
+                         pad=pad, index=None,
+                         provide_data=self.provide_data,
+                         provide_label=self.provide_label)
+
+    def next(self):
+        if self._h is not None:
+            ct = self._ct
+            data_p = ct.c_void_p()
+            label_p = ct.c_void_p()
+            n = self._lib.MXTBatchLoaderNext(self._h, data_p, label_p)
+            if n < 0:
+                raise MXNetError("native batch loader: %s" %
+                                 self._lib.MXTGetLastError().decode())
+            if n == 0:
+                raise StopIteration
+            nb = self.batch_size * self._sample_nbytes
+            raw = ct.cast(data_p, ct.POINTER(ct.c_uint8 * nb)).contents
+            data_np = _np.frombuffer(raw, self.dtype,
+                                     count=self.batch_size *
+                                     int(_np.prod(self.data_shape)))
+            data_np = data_np.reshape((self.batch_size,) + self.data_shape)[:n].copy()
+            lw = max(self.label_width, 1)
+            lraw = ct.cast(label_p,
+                           ct.POINTER(ct.c_float * (self.batch_size * lw))).contents
+            label_np = _np.frombuffer(lraw, _np.float32)[:n * lw].copy()
+            return self._wrap(data_np, label_np, n)
+        # python fallback
+        if self._py_pos >= len(self._order):
+            raise StopIteration
+        idxs = self._order[self._py_pos:self._py_pos + self.batch_size]
+        self._py_pos += self.batch_size
+        datas, labels = [], []
+        for i in idxs:
+            hdr, payload = self._py_records[i]
+            arr = _np.frombuffer(payload, self.dtype,
+                                 count=int(_np.prod(self.data_shape)))
+            datas.append(arr.reshape(self.data_shape))
+            src = _np.atleast_1d(_np.asarray(hdr.label, _np.float32))
+            lw = max(self.label_width, 1)
+            lab = _np.zeros((lw,), _np.float32)  # zero-pad like the native
+            lab[:min(src.size, lw)] = src[:lw]   # parser (prefetch.cc)
+            labels.append(lab)
+        return self._wrap(_np.stack(datas), _np.concatenate(labels), len(idxs))
+
+    def __del__(self):
+        if getattr(self, "_h", None) is not None:
+            self._lib.MXTBatchLoaderFree(self._h)
+            self._h = None
+
+
+def save_tensor_rec(path, data, labels):
+    """Write arrays as raw-tensor records consumable by TensorRecordIter."""
+    from .recordio import MXRecordIO, IRHeader, pack
+    w = MXRecordIO(path, "w")
+    for i, (x, y) in enumerate(zip(data, labels)):
+        y = _np.atleast_1d(_np.asarray(y, _np.float32))
+        label = y if y.size > 1 else float(y[0])
+        w.write(pack(IRHeader(0, label, i, 0), _np.ascontiguousarray(x).tobytes()))
+    w.close()
+
+
 MXDataIter = DataIter  # the C++-backed iter class name, kept for API parity

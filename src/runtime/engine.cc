@@ -1,0 +1,280 @@
+/*
+ * engine.cc — async dependency engine (host side).
+ *
+ * Parity: src/engine/threaded_engine.{h,cc} + threaded_engine_perdevice.cc.
+ * The reference serializes EVERY operator through this discipline; on TPU
+ * the XLA/PJRT stream already orders device compute, so this engine
+ * schedules the host work around it (record readers, checkpoint writes,
+ * metric sinks, custom host ops) with the same semantics:
+ *
+ *   - ops are pushed with const (read) and mutable (write) var lists;
+ *   - a var admits concurrent readers OR one writer, in push order
+ *     (ThreadedVar's VersionedVarBlock queue, threaded_engine.h:99-217);
+ *   - completion releases deps and wakes queued ops (OnComplete,
+ *     threaded_engine.cc:396);
+ *   - WaitForVar pushes a read barrier; WaitForAll drains everything.
+ *
+ * Worker count: MXNET_CPU_WORKER_NTHREADS (default: hardware/2, >=2).
+ */
+#include "mxt_runtime.h"
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdlib>
+#include <deque>
+#include <memory>
+#include <mutex>
+#include <thread>
+#include <vector>
+
+namespace {
+
+struct Opr;
+
+struct Var {
+  std::mutex m;
+  // waiting ops in push order; bool = wants write access
+  std::deque<std::pair<Opr *, bool>> q;
+  int active_reads = 0;
+  bool active_write = false;
+  bool to_delete = false;
+};
+
+struct Opr {
+  MXTFn fn;
+  void *arg;
+  std::vector<std::pair<Var *, bool>> deps;  // (var, is_write)
+  std::atomic<int> wait{1};
+  int priority = 0;
+};
+
+class Engine {
+ public:
+  static Engine &get() {
+    static Engine e;
+    return e;
+  }
+
+  void start(int num_workers) {
+    std::lock_guard<std::mutex> lk(start_m_);
+    if (!workers_.empty()) return;
+    if (num_workers <= 0) {
+      const char *env = std::getenv("MXNET_CPU_WORKER_NTHREADS");
+      num_workers = env ? std::atoi(env)
+                        : (int)std::thread::hardware_concurrency() / 2;
+      if (num_workers < 2) num_workers = 2;
+    }
+    shutdown_ = false;
+    for (int i = 0; i < num_workers; ++i)
+      workers_.emplace_back([this] { worker_loop(); });
+  }
+
+  MXTVarHandle new_var() { return reinterpret_cast<MXTVarHandle>(new Var()); }
+
+  void delete_var(MXTVarHandle h) {
+    // deferred: deleted once its queue drains via a write op
+    Var *v = reinterpret_cast<Var *>(h);
+    auto *opr = new Opr();
+    opr->fn = [](void *arg) { delete reinterpret_cast<Var *>(arg); };
+    opr->arg = v;
+    // write-dep so it runs after all pending users; var not released after
+    opr->deps = {};  // manual: we enqueue on v but never release it
+    push_delete(v, opr);
+  }
+
+  void push(MXTFn fn, void *arg, const MXTVarHandle *rv, int nr,
+            const MXTVarHandle *wv, int nw, int priority) {
+    start(0);
+    auto *opr = new Opr();
+    opr->fn = fn;
+    opr->arg = arg;
+    opr->priority = priority;
+    opr->deps.reserve(nr + nw);
+    for (int i = 0; i < nr; ++i)
+      opr->deps.emplace_back(reinterpret_cast<Var *>(rv[i]), false);
+    for (int i = 0; i < nw; ++i)
+      opr->deps.emplace_back(reinterpret_cast<Var *>(wv[i]), true);
+    pending_.fetch_add(1);
+    pushed_.fetch_add(1);
+    for (auto &d : opr->deps) {
+      Var *v = d.first;
+      bool w = d.second;
+      std::lock_guard<std::mutex> lk(v->m);
+      bool grant = v->q.empty() && !v->active_write &&
+                   (!w || v->active_reads == 0);
+      if (grant) {
+        if (w)
+          v->active_write = true;
+        else
+          ++v->active_reads;
+      } else {
+        // bump wait BEFORE the op becomes visible in the queue: a release
+        // on another thread may grant it the moment the lock drops
+        opr->wait.fetch_add(1);
+        v->q.emplace_back(opr, w);
+      }
+    }
+    complete_one(opr);  // consume the initial sentinel count
+  }
+
+  void wait_for_var(MXTVarHandle h) {
+    struct Sync {
+      std::mutex m;
+      std::condition_variable cv;
+      bool done = false;
+    } s;
+    MXTFn fn = [](void *arg) {
+      auto *s = reinterpret_cast<Sync *>(arg);
+      std::lock_guard<std::mutex> lk(s->m);
+      s->done = true;
+      s->cv.notify_all();
+    };
+    push(fn, &s, &h, 1, nullptr, 0, 1);
+    std::unique_lock<std::mutex> lk(s.m);
+    s.cv.wait(lk, [&] { return s.done; });
+  }
+
+  void wait_all() {
+    std::unique_lock<std::mutex> lk(all_m_);
+    all_cv_.wait(lk, [this] { return pending_.load() == 0; });
+  }
+
+  int num_workers() {
+    std::lock_guard<std::mutex> lk(start_m_);
+    return (int)workers_.size();
+  }
+
+  uint64_t num_pushed() { return pushed_.load(); }
+
+  ~Engine() {
+    {
+      std::lock_guard<std::mutex> lk(q_m_);
+      shutdown_ = true;
+      q_cv_.notify_all();
+    }
+    for (auto &t : workers_) t.join();
+  }
+
+ private:
+  void push_delete(Var *v, Opr *opr) {
+    start(0);
+    pending_.fetch_add(1);
+    std::unique_lock<std::mutex> lk(v->m);
+    bool grant = v->q.empty() && !v->active_write && v->active_reads == 0;
+    v->to_delete = true;
+    if (grant) {
+      lk.unlock();
+      dispatch(opr);
+    } else {
+      v->q.emplace_back(opr, true);
+      opr->wait.fetch_add(1);
+      lk.unlock();
+      complete_one(opr);
+    }
+  }
+
+  void complete_one(Opr *opr) {
+    if (opr->wait.fetch_sub(1) == 1) dispatch(opr);
+  }
+
+  void dispatch(Opr *opr) {
+    std::lock_guard<std::mutex> lk(q_m_);
+    if (opr->priority)
+      hi_.push_back(opr);
+    else
+      lo_.push_back(opr);
+    q_cv_.notify_one();
+  }
+
+  void worker_loop() {
+    for (;;) {
+      Opr *opr = nullptr;
+      {
+        std::unique_lock<std::mutex> lk(q_m_);
+        q_cv_.wait(lk, [this] {
+          return shutdown_ || !hi_.empty() || !lo_.empty();
+        });
+        if (shutdown_ && hi_.empty() && lo_.empty()) return;
+        if (!hi_.empty()) {
+          opr = hi_.front();
+          hi_.pop_front();
+        } else {
+          opr = lo_.front();
+          lo_.pop_front();
+        }
+      }
+      opr->fn(opr->arg);
+      on_complete(opr);
+    }
+  }
+
+  void on_complete(Opr *opr) {
+    for (auto &d : opr->deps) release(d.first, d.second);
+    delete opr;
+    if (pending_.fetch_sub(1) == 1) {
+      std::lock_guard<std::mutex> lk(all_m_);
+      all_cv_.notify_all();
+    }
+  }
+
+  void release(Var *v, bool was_write) {
+    std::vector<Opr *> to_notify;
+    bool del = false;
+    {
+      std::lock_guard<std::mutex> lk(v->m);
+      if (was_write)
+        v->active_write = false;
+      else
+        --v->active_reads;
+      // grant from queue head preserving push order
+      while (!v->q.empty()) {
+        auto [o, w] = v->q.front();
+        if (w) {
+          if (v->active_reads == 0 && !v->active_write) {
+            v->q.pop_front();
+            v->active_write = true;
+            to_notify.push_back(o);
+          }
+          break;  // writer is exclusive; nothing after it may start
+        }
+        if (v->active_write) break;
+        v->q.pop_front();
+        ++v->active_reads;
+        to_notify.push_back(o);
+      }
+      del = v->to_delete && v->q.empty() && v->active_reads == 0 &&
+            !v->active_write;
+      (void)del;  // deletion handled by the delete-op itself
+    }
+    for (Opr *o : to_notify) complete_one(o);
+  }
+
+  std::mutex start_m_;
+  std::vector<std::thread> workers_;
+  std::mutex q_m_;
+  std::condition_variable q_cv_;
+  std::deque<Opr *> hi_, lo_;
+  bool shutdown_ = false;
+  std::atomic<uint64_t> pending_{0};
+  std::atomic<uint64_t> pushed_{0};
+  std::mutex all_m_;
+  std::condition_variable all_cv_;
+};
+
+}  // namespace
+
+extern "C" {
+
+void MXTEngineStart(int num_workers) { Engine::get().start(num_workers); }
+MXTVarHandle MXTEngineNewVar(void) { return Engine::get().new_var(); }
+void MXTEngineDeleteVar(MXTVarHandle v) { Engine::get().delete_var(v); }
+void MXTEnginePushAsync(MXTFn fn, void *arg, const MXTVarHandle *rv, int nr,
+                        const MXTVarHandle *wv, int nw, int priority) {
+  Engine::get().push(fn, arg, rv, nr, wv, nw, priority);
+}
+void MXTEngineWaitForVar(MXTVarHandle v) { Engine::get().wait_for_var(v); }
+void MXTEngineWaitAll(void) { Engine::get().wait_all(); }
+int MXTEngineNumWorkers(void) { return Engine::get().num_workers(); }
+uint64_t MXTEngineNumPushed(void) { return Engine::get().num_pushed(); }
+
+}  // extern "C"

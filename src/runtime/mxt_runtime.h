@@ -1,0 +1,94 @@
+/*
+ * mxt_runtime.h — C ABI for the mxnet_tpu native host runtime.
+ *
+ * TPU-native equivalents of the reference's native runtime components
+ * (SURVEY.md §2.1): the XLA compiler + PJRT own device-side scheduling and
+ * memory, so the native layer's job is the HOST side — async dependency
+ * scheduling for IO/checkpoint/pipeline work, pooled host staging buffers,
+ * recordio container codec, and a threaded, double-buffered batch loader
+ * that feeds the device without touching the GIL.
+ *
+ * Reference parity:
+ *   engine   — src/engine/threaded_engine.{h,cc} (ThreadedVar read/write
+ *              dependency discipline, worker pools, WaitForVar/WaitForAll)
+ *   storage  — src/storage/pooled_storage_manager.h (size-bucketed reuse)
+ *   recordio — dmlc-core recordio framing consumed by src/io/
+ *   loader   — src/io/iter_prefetcher.h + iter_batchloader.h (double
+ *              buffered ThreadedIter prefetch, batch assembly)
+ */
+#ifndef MXT_RUNTIME_H_
+#define MXT_RUNTIME_H_
+
+#include <stddef.h>
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+#define MXT_API __attribute__((visibility("default")))
+
+/* ---------------- storage: pooled host allocator ---------------- */
+MXT_API void *MXTStorageAlloc(size_t size);
+MXT_API void MXTStorageFree(void *ptr, size_t size);
+MXT_API void MXTStorageDirectFree(void *ptr, size_t size);
+MXT_API void MXTStoragePoolStats(uint64_t *cached_bytes, uint64_t *live_bytes,
+                                 uint64_t *hit, uint64_t *miss);
+MXT_API void MXTStoragePoolClear(void);
+
+/* ---------------- dependency engine ---------------- */
+typedef void (*MXTFn)(void *arg);
+typedef uint64_t MXTVarHandle;
+
+/* start worker pool (idempotent); num_workers<=0 -> hardware default */
+MXT_API void MXTEngineStart(int num_workers);
+MXT_API MXTVarHandle MXTEngineNewVar(void);
+MXT_API void MXTEngineDeleteVar(MXTVarHandle var);
+/* push fn(arg) with read/write var dependencies; priority!=0 -> front */
+MXT_API void MXTEnginePushAsync(MXTFn fn, void *arg,
+                                const MXTVarHandle *read_vars, int n_read,
+                                const MXTVarHandle *write_vars, int n_write,
+                                int priority);
+MXT_API void MXTEngineWaitForVar(MXTVarHandle var);
+MXT_API void MXTEngineWaitAll(void);
+MXT_API int MXTEngineNumWorkers(void);
+MXT_API uint64_t MXTEngineNumPushed(void);
+
+/* ---------------- recordio ---------------- */
+MXT_API void *MXTRecordIOWriterCreate(const char *path);
+MXT_API int MXTRecordIOWriterWrite(void *h, const void *data, uint64_t len);
+MXT_API uint64_t MXTRecordIOWriterTell(void *h);
+MXT_API void MXTRecordIOWriterClose(void *h);
+
+MXT_API void *MXTRecordIOReaderCreate(const char *path);
+/* returns 1 and sets *data / *len on success (valid until next call), 0 at
+ * eof, -1 on corrupt stream */
+MXT_API int MXTRecordIOReaderNext(void *h, const void **data, uint64_t *len);
+MXT_API void MXTRecordIOReaderSeek(void *h, uint64_t pos);
+MXT_API uint64_t MXTRecordIOReaderTell(void *h);
+MXT_API void MXTRecordIOReaderClose(void *h);
+
+/* ---------------- threaded batch loader ---------------- */
+/* Records are IRHeader(flag,label,id,id2) [+ flag*f32 labels] + raw payload
+ * of exactly sample_nbytes bytes.  Batches are assembled into pooled host
+ * buffers by a background producer thread; `depth` batches are kept in
+ * flight (ThreadedIter double-buffering).  shuffle uses an in-memory offset
+ * index built on create. */
+MXT_API void *MXTBatchLoaderCreate(const char *rec_path, int batch_size,
+                                   uint64_t sample_nbytes, int label_width,
+                                   int depth, int shuffle, uint64_t seed);
+/* Blocks for the next batch. Returns n in [1,batch_size] and pointers valid
+ * until the following Next/Reset/Free; 0 at epoch end; -1 on error. */
+MXT_API int MXTBatchLoaderNext(void *h, const uint8_t **data,
+                               const float **labels);
+MXT_API void MXTBatchLoaderReset(void *h);
+MXT_API uint64_t MXTBatchLoaderNumSamples(void *h);
+MXT_API void MXTBatchLoaderFree(void *h);
+
+MXT_API const char *MXTGetLastError(void);
+MXT_API void MXTSetLastError(const char *msg);
+
+#ifdef __cplusplus
+}
+#endif
+#endif /* MXT_RUNTIME_H_ */

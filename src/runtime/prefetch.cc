@@ -1,0 +1,291 @@
+/*
+ * prefetch.cc — threaded, double-buffered batch loader.
+ *
+ * Parity: src/io/iter_prefetcher.h (dmlc::ThreadedIter double-buffer) +
+ * iter_batchloader.h (batch assembly).  A background producer thread reads
+ * IRHeader records from a .rec file, copies fixed-size payloads into pooled
+ * batch buffers, and hands completed batches to the consumer through a
+ * bounded queue — the host-side input pipeline runs entirely off the GIL,
+ * which is what keeps the TPU from starving (SURVEY.md §7 risk list:
+ * "input pipeline that doesn't starve").
+ *
+ * Record layout (recordio.py pack()): IRHeader{u32 flag, f32 label, u64 id,
+ * u64 id2}, then flag*f32 extra labels if flag>0, then the raw payload.
+ */
+#include "mxt_runtime.h"
+
+#include <algorithm>
+#include <atomic>
+#include <condition_variable>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <random>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+#pragma pack(push, 1)
+struct IRHeader {
+  uint32_t flag;
+  float label;
+  uint64_t id;
+  uint64_t id2;
+};
+#pragma pack(pop)
+
+struct Batch {
+  uint8_t *data = nullptr;
+  float *labels = nullptr;
+  int n = 0;
+  uint64_t data_cap = 0, label_cap = 0;
+};
+
+struct Loader {
+  std::string path;
+  int batch_size;
+  uint64_t sample_nbytes;
+  int label_width;
+  int depth;
+  bool shuffle;
+  uint64_t seed;
+  uint64_t epoch = 0;
+
+  std::vector<uint64_t> offsets;  // record start offsets (for shuffle)
+
+  std::thread producer;
+  std::mutex m;
+  std::condition_variable cv_prod, cv_cons;
+  std::deque<Batch> ready;
+  std::vector<Batch> recycle;
+  Batch current{};
+  bool has_current = false;
+  bool eof = false;       // producer finished the epoch
+  bool stop = false;      // shutdown
+  std::string error;
+
+  Batch alloc_batch() {
+    Batch b;
+    b.data_cap = (uint64_t)batch_size * sample_nbytes;
+    b.label_cap = (uint64_t)batch_size * std::max(label_width, 1);
+    b.data = (uint8_t *)MXTStorageAlloc(b.data_cap);
+    b.labels = (float *)MXTStorageAlloc(b.label_cap * sizeof(float));
+    return b;
+  }
+
+  void free_batch(Batch &b) {
+    if (b.data) MXTStorageFree(b.data, b.data_cap);
+    if (b.labels) MXTStorageFree(b.labels, b.label_cap * sizeof(float));
+    b = Batch{};
+  }
+
+  bool scan_index() {
+    void *r = MXTRecordIOReaderCreate(path.c_str());
+    if (!r) return false;
+    offsets.clear();
+    const void *data;
+    uint64_t len;
+    uint64_t pos = 0;
+    int rc;
+    while ((rc = MXTRecordIOReaderNext(r, &data, &len)) == 1) {
+      offsets.push_back(pos);
+      pos = MXTRecordIOReaderTell(r);
+    }
+    MXTRecordIOReaderClose(r);
+    return rc == 0;
+  }
+
+  void run() {
+    void *r = MXTRecordIOReaderCreate(path.c_str());
+    if (!r) {
+      fail("open failed: " + path);
+      return;
+    }
+    std::vector<uint64_t> order(offsets.size());
+    for (size_t i = 0; i < order.size(); ++i) order[i] = i;
+    if (shuffle) {
+      std::mt19937_64 rng(seed + epoch);
+      std::shuffle(order.begin(), order.end(), rng);
+    }
+    size_t i = 0;
+    while (i < order.size()) {
+      Batch b;
+      {
+        std::unique_lock<std::mutex> lk(m);
+        cv_prod.wait(lk, [this] { return stop || !recycle.empty() ||
+                                         (int)ready.size() < depth; });
+        if (stop) break;
+        if (!recycle.empty()) {
+          b = recycle.back();
+          recycle.pop_back();
+        }
+      }
+      if (!b.data) b = alloc_batch();
+      int n = 0;
+      for (; n < batch_size && i < order.size(); ++i) {
+        if (shuffle) MXTRecordIOReaderSeek(r, offsets[order[i]]);
+        const void *data;
+        uint64_t len;
+        int rc = MXTRecordIOReaderNext(r, &data, &len);
+        if (rc != 1) {
+          fail("read failed mid-epoch");
+          MXTRecordIOReaderClose(r);
+          free_batch(b);
+          return;
+        }
+        if (!parse(b, n, (const uint8_t *)data, len)) {
+          MXTRecordIOReaderClose(r);
+          free_batch(b);
+          return;
+        }
+        ++n;
+      }
+      b.n = n;
+      {
+        std::lock_guard<std::mutex> lk(m);
+        if (stop) {
+          recycle.push_back(b);
+          break;
+        }
+        ready.push_back(b);
+        cv_cons.notify_one();
+      }
+    }
+    MXTRecordIOReaderClose(r);
+    std::lock_guard<std::mutex> lk(m);
+    eof = true;
+    cv_cons.notify_all();
+  }
+
+  bool parse(Batch &b, int slot, const uint8_t *rec, uint64_t len) {
+    if (len < sizeof(IRHeader)) return fail("record shorter than IRHeader");
+    IRHeader h;
+    std::memcpy(&h, rec, sizeof(h));
+    rec += sizeof(h);
+    len -= sizeof(h);
+    int lw = std::max(label_width, 1);
+    float *dst = b.labels + (uint64_t)slot * lw;
+    if (h.flag > 0) {
+      if (len < (uint64_t)h.flag * 4) return fail("label vector truncated");
+      uint32_t take = std::min<uint32_t>(h.flag, (uint32_t)lw);
+      std::memcpy(dst, rec, take * 4);
+      for (uint32_t j = take; j < (uint32_t)lw; ++j) dst[j] = 0.f;
+      rec += (uint64_t)h.flag * 4;
+      len -= (uint64_t)h.flag * 4;
+    } else {
+      dst[0] = h.label;
+      for (int j = 1; j < lw; ++j) dst[j] = 0.f;
+    }
+    if (len != sample_nbytes)
+      return fail("payload size mismatch: got " + std::to_string(len) +
+                  " want " + std::to_string(sample_nbytes));
+    std::memcpy(b.data + (uint64_t)slot * sample_nbytes, rec, sample_nbytes);
+    return true;
+  }
+
+  bool fail(const std::string &msg) {
+    std::lock_guard<std::mutex> lk(m);
+    error = msg;
+    eof = true;
+    cv_cons.notify_all();
+    return false;
+  }
+
+  void start_epoch() {
+    eof = false;
+    error.clear();
+    producer = std::thread([this] { run(); });
+  }
+
+  void join_producer() {
+    {
+      std::lock_guard<std::mutex> lk(m);
+      stop = true;
+      cv_prod.notify_all();
+    }
+    if (producer.joinable()) producer.join();
+    stop = false;
+  }
+
+  ~Loader() {
+    join_producer();
+    for (auto &b : recycle) free_batch(b);
+    for (auto &b : ready) free_batch(b);
+    if (has_current) free_batch(current);
+  }
+};
+
+
+}  // namespace
+
+extern "C" {
+
+void *MXTBatchLoaderCreate(const char *rec_path, int batch_size,
+                           uint64_t sample_nbytes, int label_width,
+                           int depth, int shuffle, uint64_t seed) {
+  auto *l = new Loader();
+  l->path = rec_path;
+  l->batch_size = batch_size;
+  l->sample_nbytes = sample_nbytes;
+  l->label_width = label_width;
+  l->depth = depth < 1 ? 2 : depth;
+  l->shuffle = shuffle != 0;
+  l->seed = seed;
+  if (!l->scan_index() || l->offsets.empty()) {
+    delete l;
+    return nullptr;
+  }
+  l->start_epoch();
+  return l;
+}
+
+int MXTBatchLoaderNext(void *h, const uint8_t **data, const float **labels) {
+  auto *l = reinterpret_cast<Loader *>(h);
+  // recycle the batch handed out last call
+  {
+    std::lock_guard<std::mutex> lk(l->m);
+    if (l->has_current) {
+      l->recycle.push_back(l->current);
+      l->has_current = false;
+      l->cv_prod.notify_one();
+    }
+  }
+  std::unique_lock<std::mutex> lk(l->m);
+  l->cv_cons.wait(lk, [l] { return !l->ready.empty() || l->eof; });
+  if (!l->error.empty()) {
+    MXTSetLastError(l->error.c_str());
+    return -1;
+  }
+  if (l->ready.empty()) return 0;  // epoch end
+  l->current = l->ready.front();
+  l->ready.pop_front();
+  l->has_current = true;
+  l->cv_prod.notify_one();
+  *data = l->current.data;
+  *labels = l->current.labels;
+  return l->current.n;
+}
+
+void MXTBatchLoaderReset(void *h) {
+  auto *l = reinterpret_cast<Loader *>(h);
+  l->join_producer();
+  std::lock_guard<std::mutex> lk(l->m);
+  for (auto &b : l->ready) l->recycle.push_back(b);
+  l->ready.clear();
+  if (l->has_current) {
+    l->recycle.push_back(l->current);
+    l->has_current = false;
+  }
+  ++l->epoch;
+  l->start_epoch();
+}
+
+uint64_t MXTBatchLoaderNumSamples(void *h) {
+  return reinterpret_cast<Loader *>(h)->offsets.size();
+}
+
+void MXTBatchLoaderFree(void *h) { delete reinterpret_cast<Loader *>(h); }
+
+}  // extern "C"

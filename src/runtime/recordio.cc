@@ -1,0 +1,126 @@
+/*
+ * recordio.cc — dmlc recordio container codec.
+ *
+ * Bit-compatible with the reference's record framing (dmlc-core recordio
+ * consumed by src/io/iter_image_recordio*.cc and python/mxnet/recordio.py):
+ *   [kMagic=0xced7230a u32][lrec u32: cflag<<29 | length][payload][pad->4B]
+ * Continuation flags are written as 0 (single-chunk records), matching what
+ * the python writer produces; the reader tolerates and reassembles
+ * multi-chunk records.
+ */
+#include "mxt_runtime.h"
+
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace {
+
+constexpr uint32_t kMagic = 0xced7230a;
+
+thread_local std::string g_last_error;
+
+struct Writer {
+  FILE *f;
+};
+
+struct Reader {
+  FILE *f;
+  std::vector<char> buf;
+};
+
+}  // namespace
+
+extern "C" {
+
+const char *MXTGetLastError(void) { return g_last_error.c_str(); }
+void MXTSetLastError(const char *msg) { g_last_error = msg ? msg : ""; }
+
+void *MXTRecordIOWriterCreate(const char *path) {
+  FILE *f = std::fopen(path, "wb");
+  if (!f) {
+    g_last_error = std::string("cannot open for write: ") + path;
+    return nullptr;
+  }
+  return new Writer{f};
+}
+
+int MXTRecordIOWriterWrite(void *h, const void *data, uint64_t len) {
+  auto *w = reinterpret_cast<Writer *>(h);
+  uint32_t hdr[2] = {kMagic, (uint32_t)(len & ((1u << 29) - 1))};
+  if (std::fwrite(hdr, 4, 2, w->f) != 2) return -1;
+  if (len && std::fwrite(data, 1, len, w->f) != len) return -1;
+  static const char zeros[4] = {0, 0, 0, 0};
+  size_t pad = (4 - (len % 4)) % 4;
+  if (pad && std::fwrite(zeros, 1, pad, w->f) != pad) return -1;
+  return 0;
+}
+
+uint64_t MXTRecordIOWriterTell(void *h) {
+  return (uint64_t)std::ftell(reinterpret_cast<Writer *>(h)->f);
+}
+
+void MXTRecordIOWriterClose(void *h) {
+  auto *w = reinterpret_cast<Writer *>(h);
+  if (w) {
+    std::fclose(w->f);
+    delete w;
+  }
+}
+
+void *MXTRecordIOReaderCreate(const char *path) {
+  FILE *f = std::fopen(path, "rb");
+  if (!f) {
+    g_last_error = std::string("cannot open for read: ") + path;
+    return nullptr;
+  }
+  return new Reader{f, {}};
+}
+
+int MXTRecordIOReaderNext(void *h, const void **data, uint64_t *len) {
+  auto *r = reinterpret_cast<Reader *>(h);
+  r->buf.clear();
+  for (;;) {
+    uint32_t hdr[2];
+    size_t got = std::fread(hdr, 4, 2, r->f);
+    if (got == 0) return r->buf.empty() ? 0 : -1;
+    if (got != 2 || hdr[0] != kMagic) {
+      g_last_error = "corrupt record header";
+      return -1;
+    }
+    uint32_t cflag = hdr[1] >> 29;
+    uint32_t length = hdr[1] & ((1u << 29) - 1);
+    size_t off = r->buf.size();
+    r->buf.resize(off + length);
+    if (length && std::fread(r->buf.data() + off, 1, length, r->f) != length) {
+      g_last_error = "truncated record payload";
+      return -1;
+    }
+    size_t pad = (4 - (length % 4)) % 4;
+    if (pad) std::fseek(r->f, (long)pad, SEEK_CUR);
+    // cflag: 0 whole, 1 begin, 2 middle, 3 end (dmlc recordio chunking)
+    if (cflag == 0 || cflag == 3) break;
+  }
+  *data = r->buf.data();
+  *len = r->buf.size();
+  return 1;
+}
+
+void MXTRecordIOReaderSeek(void *h, uint64_t pos) {
+  std::fseek(reinterpret_cast<Reader *>(h)->f, (long)pos, SEEK_SET);
+}
+
+uint64_t MXTRecordIOReaderTell(void *h) {
+  return (uint64_t)std::ftell(reinterpret_cast<Reader *>(h)->f);
+}
+
+void MXTRecordIOReaderClose(void *h) {
+  auto *r = reinterpret_cast<Reader *>(h);
+  if (r) {
+    std::fclose(r->f);
+    delete r;
+  }
+}
+
+}  // extern "C"

@@ -1,0 +1,118 @@
+/*
+ * storage.cc — size-bucketed pooled host allocator.
+ *
+ * TPU-native reading of src/storage/pooled_storage_manager.h: HBM is owned
+ * by PJRT/XLA, so the pool manages host STAGING buffers (batch assembly,
+ * checkpoint serialization).  Freed buffers are cached in power-of-two
+ * buckets and reused; the cache is capped by MXNET_CPU_MEM_POOL_MB
+ * (default 1024), evicting largest-first beyond the cap.
+ */
+#include "mxt_runtime.h"
+
+#include <cstdlib>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <vector>
+
+namespace {
+
+struct Pool {
+  std::mutex m;
+  // bucket (rounded size) -> free buffers
+  std::map<size_t, std::vector<void *>> free_list;
+  uint64_t cached_bytes = 0;
+  uint64_t live_bytes = 0;
+  uint64_t hits = 0;
+  uint64_t misses = 0;
+  uint64_t cap_bytes = 0;
+
+  Pool() {
+    const char *env = std::getenv("MXNET_CPU_MEM_POOL_MB");
+    uint64_t mb = env ? std::strtoull(env, nullptr, 10) : 1024;
+    cap_bytes = mb << 20;
+  }
+
+  static size_t round_size(size_t size) {
+    size_t r = 64;
+    while (r < size) r <<= 1;
+    return r;
+  }
+
+  void *alloc(size_t size) {
+    size_t bucket = round_size(size);
+    {
+      std::lock_guard<std::mutex> lk(m);
+      auto it = free_list.find(bucket);
+      if (it != free_list.end() && !it->second.empty()) {
+        void *p = it->second.back();
+        it->second.pop_back();
+        cached_bytes -= bucket;
+        live_bytes += bucket;
+        ++hits;
+        return p;
+      }
+      ++misses;
+      live_bytes += bucket;
+    }
+    void *p = nullptr;
+    if (posix_memalign(&p, 64, bucket) != 0) return nullptr;
+    return p;
+  }
+
+  void free(void *ptr, size_t size) {
+    if (!ptr) return;
+    size_t bucket = round_size(size);
+    std::lock_guard<std::mutex> lk(m);
+    live_bytes -= bucket < live_bytes ? bucket : live_bytes;
+    if (cached_bytes + bucket <= cap_bytes) {
+      free_list[bucket].push_back(ptr);
+      cached_bytes += bucket;
+      return;
+    }
+    std::free(ptr);
+  }
+
+  void direct_free(void *ptr, size_t size) {
+    if (!ptr) return;
+    size_t bucket = round_size(size);
+    std::lock_guard<std::mutex> lk(m);
+    live_bytes -= bucket < live_bytes ? bucket : live_bytes;
+    std::free(ptr);
+  }
+
+  void clear() {
+    std::lock_guard<std::mutex> lk(m);
+    for (auto &kv : free_list)
+      for (void *p : kv.second) std::free(p);
+    free_list.clear();
+    cached_bytes = 0;
+  }
+};
+
+Pool &pool() {
+  static Pool p;
+  return p;
+}
+
+}  // namespace
+
+extern "C" {
+
+void *MXTStorageAlloc(size_t size) { return pool().alloc(size); }
+void MXTStorageFree(void *ptr, size_t size) { pool().free(ptr, size); }
+void MXTStorageDirectFree(void *ptr, size_t size) {
+  pool().direct_free(ptr, size);
+}
+void MXTStoragePoolStats(uint64_t *cached, uint64_t *live, uint64_t *hit,
+                         uint64_t *miss) {
+  Pool &p = pool();
+  std::lock_guard<std::mutex> lk(p.m);
+  if (cached) *cached = p.cached_bytes;
+  if (live) *live = p.live_bytes;
+  if (hit) *hit = p.hits;
+  if (miss) *miss = p.misses;
+}
+void MXTStoragePoolClear(void) { pool().clear(); }
+
+}  // extern "C"

@@ -1,0 +1,199 @@
+"""Native host runtime tests (src/runtime/): storage pool, dependency
+engine semantics (parity model: tests/cpp/engine/threaded_engine_test.cc),
+recordio interop, threaded batch loader (parity model: test_io.py)."""
+import ctypes
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import engine, io as mio, recordio as rio
+from mxnet_tpu._native import lib
+
+pytestmark = pytest.mark.skipif(lib() is None, reason="native lib unbuilt")
+
+
+def test_storage_pool_reuse():
+    l = lib()
+    p = l.MXTStorageAlloc(1 << 16)
+    l.MXTStorageFree(p, 1 << 16)
+    p2 = l.MXTStorageAlloc(1 << 16)
+    cached, live, hit, miss = (ctypes.c_uint64() for _ in range(4))
+    l.MXTStoragePoolStats(cached, live, hit, miss)
+    assert hit.value >= 1
+    l.MXTStorageFree(p2, 1 << 16)
+
+
+def test_engine_write_read_ordering():
+    results = []
+    v = engine.HostVar()
+    engine.push_host(lambda: (time.sleep(0.05), results.append("w1")),
+                     write_vars=[v])
+    engine.push_host(lambda: (time.sleep(0.01), results.append("r")),
+                     read_vars=[v])
+    engine.push_host(lambda: results.append("w2"), write_vars=[v])
+    engine.wait_host_all()
+    assert results == ["w1", "r", "w2"]
+
+
+def test_engine_concurrent_reads():
+    v = engine.HostVar()
+    barrier = threading.Barrier(2, timeout=5)
+    done = []
+
+    def reader():
+        barrier.wait()  # both readers must be in flight at once
+        done.append(1)
+
+    engine.push_host(reader, read_vars=[v])
+    engine.push_host(reader, read_vars=[v])
+    engine.wait_host_all()
+    assert len(done) == 2
+
+
+def test_engine_wait_for_var():
+    v = engine.HostVar()
+    out = []
+    engine.push_host(lambda: (time.sleep(0.05), out.append(1)),
+                     write_vars=[v])
+    engine.wait_for_host_var(v)
+    assert out == [1]
+
+
+def test_engine_stress_counter():
+    # many ops writing one var must fully serialize
+    v = engine.HostVar()
+    state = {"x": 0}
+
+    def bump():
+        cur = state["x"]
+        time.sleep(0.0001)
+        state["x"] = cur + 1
+
+    for _ in range(200):
+        engine.push_host(bump, write_vars=[v])
+    engine.wait_host_all()
+    assert state["x"] == 200
+
+
+def test_recordio_native_python_interop(tmp_path):
+    l = lib()
+    path = str(tmp_path / "x.rec")
+    w = l.MXTRecordIOWriterCreate(path.encode())
+    payloads = [bytes([i]) * (i * 7 + 1) for i in range(20)]
+    for p in payloads:
+        assert l.MXTRecordIOWriterWrite(w, p, len(p)) == 0
+    l.MXTRecordIOWriterClose(w)
+    r = rio.MXRecordIO(path, "r")
+    for p in payloads:
+        assert r.read() == p
+    assert r.read() is None
+    r.close()
+    # and python-written read by native
+    path2 = str(tmp_path / "y.rec")
+    w2 = rio.MXRecordIO(path2, "w")
+    for p in payloads:
+        w2.write(p)
+    w2.close()
+    rd = l.MXTRecordIOReaderCreate(path2.encode())
+    data, ln = ctypes.c_void_p(), ctypes.c_uint64()
+    for p in payloads:
+        assert l.MXTRecordIOReaderNext(rd, data, ln) == 1
+        got = ctypes.string_at(data, ln.value)
+        assert got == p
+    assert l.MXTRecordIOReaderNext(rd, data, ln) == 0
+    l.MXTRecordIOReaderClose(rd)
+
+
+def _write_rec(path, n=10, shape=(3, 4, 4), label_width=1):
+    rs = np.random.RandomState(7)
+    data = rs.randint(0, 255, (n,) + shape).astype(np.uint8)
+    if label_width == 1:
+        labels = np.arange(n, dtype=np.float32)
+    else:
+        labels = rs.rand(n, label_width).astype(np.float32)
+    mio.save_tensor_rec(path, data, labels)
+    return data, labels
+
+
+def test_tensor_record_iter_roundtrip(tmp_path):
+    path = str(tmp_path / "d.rec")
+    data, labels = _write_rec(path, n=10)
+    it = mio.TensorRecordIter(path, data_shape=(3, 4, 4), batch_size=4)
+    assert it._h is not None  # native path active
+    seen_x, seen_y = [], []
+    for batch in it:
+        n = batch.data[0].shape[0] - batch.pad
+        seen_x.append(batch.data[0].asnumpy()[:n])
+        seen_y.append(batch.label[0].asnumpy()[:n])
+    x = np.concatenate(seen_x)
+    y = np.concatenate(seen_y)
+    assert np.array_equal(x, data)
+    assert np.array_equal(y, labels)
+    # reset replays the epoch
+    it.reset()
+    b0 = next(iter(it))
+    assert np.array_equal(b0.data[0].asnumpy(), data[:4])
+
+
+def test_tensor_record_iter_shuffle_and_pad(tmp_path):
+    path = str(tmp_path / "d.rec")
+    data, labels = _write_rec(path, n=10)
+    it = mio.TensorRecordIter(path, data_shape=(3, 4, 4), batch_size=4,
+                              shuffle=True, seed=3)
+    ys = []
+    pads = []
+    for batch in it:
+        ys.append(batch.label[0].asnumpy())
+        pads.append(batch.pad)
+    got = np.concatenate([y[:4 - p] if p else y for y, p in zip(ys, pads)])
+    assert sorted(got.tolist()) == labels.tolist()  # permutation
+    assert got.tolist() != labels.tolist()  # actually shuffled
+    assert pads[-1] == 2  # 10 % 4
+
+    # second epoch shuffles differently
+    it.reset()
+    got2 = np.concatenate([b.label[0].asnumpy()[:4 - b.pad] if b.pad
+                           else b.label[0].asnumpy() for b in it])
+    assert sorted(got2.tolist()) == labels.tolist()
+
+
+def test_tensor_record_iter_label_width(tmp_path):
+    path = str(tmp_path / "d.rec")
+    data, labels = _write_rec(path, n=6, label_width=3)
+    it = mio.TensorRecordIter(path, data_shape=(3, 4, 4), batch_size=3,
+                              label_width=3)
+    batch = next(iter(it))
+    assert batch.label[0].shape == (3, 3)
+    assert np.allclose(batch.label[0].asnumpy(), labels[:3])
+
+
+def test_tensor_record_iter_python_fallback(tmp_path, monkeypatch):
+    path = str(tmp_path / "d.rec")
+    data, labels = _write_rec(path, n=8)
+    monkeypatch.setattr("mxnet_tpu.io.TensorRecordIter.__init__",
+                        _fallback_init, raising=True)
+    it = mio.TensorRecordIter(path, data_shape=(3, 4, 4), batch_size=4)
+    assert it._h is None
+    x = np.concatenate([b.data[0].asnumpy() for b in it])
+    assert np.array_equal(x, data)
+
+
+def _fallback_init(self, path_imgrec, data_shape, batch_size, **kw):
+    import os
+    os.environ["MXNET_TPU_NO_NATIVE"] = "1"
+    try:
+        import mxnet_tpu._native as nat
+        saved_lib, saved_tried = nat._lib, nat._tried
+        nat._lib, nat._tried = None, True
+        mio.TensorRecordIter.__orig_init__(self, path_imgrec,
+                                           data_shape=data_shape,
+                                           batch_size=batch_size, **kw)
+        nat._lib, nat._tried = saved_lib, saved_tried
+    finally:
+        del os.environ["MXNET_TPU_NO_NATIVE"]
+
+
+mio.TensorRecordIter.__orig_init__ = mio.TensorRecordIter.__init__
