@@ -430,7 +430,13 @@ class SymbolBlock(HybridBlock):
 
     def forward(self, x, *args):
         if isinstance(x, NDArray):
-            return self._call_cached_op(x, *args)
+            try:
+                return self._call_cached_op(x, *args)
+            except DeferredInitializationError:
+                self._deferred_infer_shape(x, *args)
+                for p in self.collect_params().values():
+                    p._finish_deferred_init()
+                return self._call_cached_op(x, *args)
         assert isinstance(x, Symbol)
         ret = copy.copy(self._cached_graph[1])
         ret._compose(**{self._cached_graph[0][0].name: x})

@@ -88,7 +88,7 @@ class RecurrentCell(HybridBlock):
         inputs, axis, F, batch_size = _format_sequence(length, inputs, layout,
                                                        False)
         begin_state = begin_state if begin_state is not None else \
-            self.begin_state(batch_size)
+            self.begin_state(batch_size=batch_size)
         states = begin_state
         outputs = []
         for i in range(length):
@@ -405,7 +405,7 @@ class BidirectionalCell(HybridRecurrentCell):
         inputs, axis, F, batch_size = _format_sequence(length, inputs, layout,
                                                        False)
         begin_state = begin_state if begin_state is not None else \
-            self.begin_state(batch_size)
+            self.begin_state(batch_size=batch_size)
         states = begin_state
         l_cell, r_cell = self._children
         l_outputs, l_states = l_cell.unroll(
@@ -418,6 +418,9 @@ class BidirectionalCell(HybridRecurrentCell):
             layout=layout, merge_outputs=False)
         outputs = [F.Concat(l_o, r_o, dim=1)
                    for l_o, r_o in zip(l_outputs, reversed(r_outputs))]
+        if merge_outputs:
+            outputs = F.Concat(*[F.expand_dims(o, axis=axis)
+                                 for o in outputs], dim=axis)
         states = l_states + r_states
         return outputs, states
 

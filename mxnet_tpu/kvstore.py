@@ -17,20 +17,90 @@ TPU-native design (SURVEY.md §2.3 "TPU-native equivalent"):
     jax.process_index(), num_workers = jax.process_count().
   - 'dist_async' has no ICI analog (parameter-server asynchrony); it is
     accepted and runs synchronously (documented divergence).
-  - gradient compression (2-bit ps-lite path) is unnecessary on ICI;
-    `set_gradient_compression` validates args and records the setting.
+  - gradient compression: the reference's 2-bit stochastic quantization
+    with error feedback (`src/kvstore/gradient_compression.h:37-134`) is
+    implemented here as jit-compiled XLA ops (quantize/pack into uint8,
+    4 codes/byte; per-key residual carries the quantization error forward).
+    On ICI it is off by default (bandwidth makes it unnecessary); when
+    enabled via `set_gradient_compression` it is applied on the push path —
+    the useful case is DCN-connected multi-slice training.
 """
 from __future__ import annotations
 
+import functools
 import pickle
 from typing import Dict, List, Optional
 
 import jax
+import jax.numpy as jnp
 
 from .base import MXNetError
 from . import ndarray as nd
 from .ndarray import NDArray
 from . import optimizer as opt
+
+
+@functools.partial(jax.jit, static_argnames=("threshold",))
+def _quantize_2bit(arr, residual, threshold):
+    """2-bit quantization with error feedback.
+
+    Parity: GradientCompression::Quantize2Bit
+    (`src/kvstore/gradient_compression.h:111`, kernel in
+    gradient_compression-inl.h): r = grad + residual; elements >= +T map to
+    +T (code 1), <= -T map to -T (code 2), else 0 (code 0); the residual
+    keeps r - quantized so the error feeds the next step.  Codes are packed
+    four-per-byte (the reference packs 16 per float32 — same 2 bits/elt).
+    """
+    r = arr.astype(jnp.float32) + residual
+    pos = r >= threshold
+    neg = r <= -threshold
+    out = jnp.where(pos, threshold, jnp.where(neg, -threshold, 0.0))
+    new_residual = r - out
+    codes = jnp.where(pos, 1, jnp.where(neg, 2, 0)).astype(jnp.uint8).ravel()
+    n = codes.shape[0]
+    pad = (-n) % 4
+    codes = jnp.pad(codes, (0, pad)).reshape(-1, 4)
+    packed = (codes[:, 0] | (codes[:, 1] << 2) | (codes[:, 2] << 4)
+              | (codes[:, 3] << 6))
+    return packed, new_residual
+
+
+@functools.partial(jax.jit, static_argnames=("threshold", "size"))
+def _dequantize_2bit(packed, threshold, size):
+    """Parity: GradientCompression::Dequantize2Bit."""
+    codes = jnp.stack([packed & 3, (packed >> 2) & 3, (packed >> 4) & 3,
+                       (packed >> 6) & 3], axis=1).ravel()[:size]
+    return jnp.where(codes == 1, threshold,
+                     jnp.where(codes == 2, -threshold, 0.0))
+
+
+class GradientCompression:
+    """Parity: `src/kvstore/gradient_compression.h:37` — holds type +
+    threshold; quantize/dequantize as XLA-compiled kernels."""
+
+    def __init__(self, type="2bit", threshold=0.5):
+        if type != "2bit":
+            raise MXNetError("Unknown type for gradient compression " + type)
+        if threshold <= 0:
+            raise MXNetError("threshold must be greater than 0")
+        self.type = type
+        self.threshold = float(threshold)
+
+    def quantize(self, grad: NDArray, residual):
+        """Returns (packed uint8 NDArray — 4 elements/byte, new residual)."""
+        packed, new_res = _quantize_2bit(grad.handle, residual,
+                                         self.threshold)
+        return NDArray(packed, grad.context), new_res
+
+    def dequantize(self, packed: NDArray, shape) -> NDArray:
+        size = 1
+        for s in shape:
+            size *= s
+        vals = _dequantize_2bit(packed.handle, self.threshold, size)
+        return NDArray(vals.reshape(shape), packed.context)
+
+    def get_params(self):
+        return {"type": self.type, "threshold": self.threshold}
 
 
 def _key_list(key):
@@ -56,6 +126,8 @@ class KVStore:
         self._updater = None
         self._update_on_kvstore = True
         self._compression_params = None
+        self._gc: Optional[GradientCompression] = None
+        self._residuals: Dict = {}
         self._optimizer = None
 
     # -- identity -----------------------------------------------------------
@@ -80,6 +152,12 @@ class KVStore:
         keys, _ = _key_list(key)
         vals = _val_list(value)
         for k, vlist in zip(keys, vals):
+            if self._gc is not None:
+                # parity: kvstore_dist.h PushCompressed — each worker's
+                # communicated gradient is quantized against its own
+                # residual; the receiver sums dequantized values.
+                vlist = [self._compress(k, i, v)
+                         for i, v in enumerate(vlist)]
             merged = vlist[0]
             for v in vlist[1:]:
                 merged = merged + v
@@ -135,12 +213,20 @@ class KVStore:
         self._updater = updater
 
     def set_gradient_compression(self, compression_params: Dict) -> None:
+        """Parity: python/mxnet/kvstore.py:363 set_gradient_compression."""
         if "type" not in compression_params:
             raise MXNetError("compression_params requires 'type'")
-        if compression_params["type"] not in ("2bit",):
-            raise MXNetError("unsupported compression type")
-        # ICI is high-bandwidth; recorded but not applied (documented)
-        self._compression_params = dict(compression_params)
+        self._gc = GradientCompression(**compression_params)
+        self._compression_params = self._gc.get_params()
+        self._residuals = {}
+
+    def _compress(self, k, slot, v: NDArray) -> NDArray:
+        res = self._residuals.get((k, slot))
+        if res is None:
+            res = jnp.zeros(v.size, dtype=jnp.float32)
+        packed, new_res = self._gc.quantize(v.reshape((-1,)), res)
+        self._residuals[(k, slot)] = new_res
+        return self._gc.dequantize(packed, v.shape)
 
     # -- cluster control ------------------------------------------------------
     def barrier(self) -> None:

@@ -15,6 +15,19 @@ if "host_platform_device_count" not in flags:
 
 import jax
 
+# Tests never touch the real chip; deregister the axon TPU backend so a
+# slow/unreachable tunnel can't hang CPU-only test runs (the axon hook
+# otherwise creates the TPU client on any backends() call).
+try:
+    from jax._src import xla_bridge as _xb
+    _xb._backend_factories.pop("axon", None)
+except Exception:
+    pass
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+if os.environ.get("JAX_PLATFORMS") == "axon":
+    os.environ["JAX_PLATFORMS"] = "cpu"
+jax.config.update("jax_platforms", "cpu")
+
 _cpus = jax.devices("cpu")
 assert len(_cpus) >= 8, _cpus
 jax.config.update("jax_default_device", _cpus[0])

@@ -1,0 +1,145 @@
+"""Data IO tests (parity model: tests/python/unittest/test_io.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_ndarray_iter_basic():
+    x = np.arange(40).reshape(10, 4).astype("f")
+    y = np.arange(10).astype("f")
+    it = mx.io.NDArrayIter(x, y, batch_size=5)
+    batches = list(it)
+    assert len(batches) == 2
+    assert batches[0].data[0].shape == (5, 4)
+    assert_almost_equal(batches[0].data[0].asnumpy(), x[:5])
+    assert_almost_equal(batches[1].label[0].asnumpy(), y[5:])
+
+
+def test_ndarray_iter_pad():
+    x = np.arange(14).reshape(7, 2).astype("f")
+    it = mx.io.NDArrayIter(x, batch_size=3, last_batch_handle="pad")
+    batches = list(it)
+    assert len(batches) == 3
+    assert batches[-1].pad == 2
+    # padded batch wraps around to the start
+    assert_almost_equal(batches[-1].data[0].asnumpy()[1:], x[:2])
+
+
+def test_ndarray_iter_discard():
+    x = np.arange(14).reshape(7, 2).astype("f")
+    it = mx.io.NDArrayIter(x, batch_size=3, last_batch_handle="discard")
+    assert len(list(it)) == 2
+
+
+def test_ndarray_iter_reset_shuffle():
+    x = np.arange(20).reshape(10, 2).astype("f")
+    it = mx.io.NDArrayIter(x, batch_size=5, shuffle=True)
+    a = np.concatenate([b.data[0].asnumpy() for b in it])
+    it.reset()
+    b = np.concatenate([b.data[0].asnumpy() for b in it])
+    # same elements, (almost surely) different order across epochs
+    assert sorted(a.ravel()) == sorted(b.ravel())
+
+
+def test_ndarray_iter_dict_data():
+    it = mx.io.NDArrayIter({"a": np.zeros((6, 2)), "b": np.ones((6, 3))},
+                           batch_size=2)
+    names = [d.name for d in it.provide_data]
+    assert sorted(names) == ["a", "b"]
+    batch = next(iter(it))
+    assert len(batch.data) == 2
+
+
+def test_resize_iter():
+    x = np.zeros((10, 2), "f")
+    it = mx.io.ResizeIter(mx.io.NDArrayIter(x, batch_size=2), 3)
+    assert len(list(it)) == 3
+    it.reset()
+    assert len(list(it)) == 3
+
+
+def test_prefetching_iter():
+    x = np.arange(24).reshape(12, 2).astype("f")
+    base = mx.io.NDArrayIter(x, batch_size=4)
+    it = mx.io.PrefetchingIter(base)
+    got = np.concatenate([b.data[0].asnumpy() for b in it])
+    assert_almost_equal(got, x)
+    it.reset()
+    assert len(list(it)) == 3
+
+
+def test_csv_iter(tmp_path):
+    data = np.random.rand(8, 3).astype("f")
+    labels = np.arange(8).astype("f")
+    dpath, lpath = str(tmp_path / "d.csv"), str(tmp_path / "l.csv")
+    np.savetxt(dpath, data, delimiter=",")
+    np.savetxt(lpath, labels, delimiter=",")
+    it = mx.io.CSVIter(data_csv=dpath, data_shape=(3,), label_csv=lpath,
+                       batch_size=4)
+    batches = list(it)
+    assert len(batches) == 2
+    assert_almost_equal(batches[0].data[0].asnumpy(), data[:4],
+                        rtol=1e-5, atol=1e-6)
+
+
+def test_recordio_roundtrip(tmp_path):
+    from mxnet_tpu import recordio
+    fname = str(tmp_path / "x.rec")
+    w = recordio.MXRecordIO(fname, "w")
+    payloads = [bytes(range(i, i + 10)) for i in range(5)]
+    for p in payloads:
+        w.write(p)
+    w.close()
+    r = recordio.MXRecordIO(fname, "r")
+    for p in payloads:
+        assert r.read() == p
+    assert r.read() is None
+    r.close()
+
+
+def test_indexed_recordio(tmp_path):
+    from mxnet_tpu import recordio
+    rec = recordio.MXIndexedRecordIO(str(tmp_path / "x.idx"),
+                                     str(tmp_path / "x.rec"), "w")
+    for i in range(10):
+        rec.write_idx(i, f"record{i}".encode())
+    rec.close()
+    rec = recordio.MXIndexedRecordIO(str(tmp_path / "x.idx"),
+                                     str(tmp_path / "x.rec"), "r")
+    assert rec.read_idx(7) == b"record7"
+    assert rec.read_idx(2) == b"record2"
+    rec.close()
+
+
+def test_recordio_pack_unpack():
+    from mxnet_tpu import recordio
+    header = recordio.IRHeader(0, 3.0, 7, 0)
+    s = recordio.pack(header, b"payload")
+    h2, content = recordio.unpack(s)
+    assert h2.label == 3.0 and h2.id == 7
+    assert content == b"payload"
+
+
+def test_tensor_record_iter(tmp_path):
+    data = np.random.rand(16, 3, 4, 4).astype("f")
+    labels = np.arange(16).astype("f")
+    path = str(tmp_path / "t.rec")
+    mx.io.save_tensor_rec(path, data, labels)
+    it = mx.io.TensorRecordIter(path, data_shape=(3, 4, 4), batch_size=4,
+                                dtype="float32")
+    got_d, got_l = [], []
+    for b in it:
+        got_d.append(b.data[0].asnumpy())
+        got_l.append(b.label[0].asnumpy())
+    assert_almost_equal(np.concatenate(got_d), data, rtol=1e-5, atol=1e-6)
+    assert_almost_equal(np.concatenate(got_l), labels)
+
+
+def test_data_desc_provide():
+    x = np.zeros((6, 2, 3), "f")
+    it = mx.io.NDArrayIter(x, batch_size=3)
+    d = it.provide_data[0]
+    assert d.shape == (3, 2, 3)

@@ -1,0 +1,183 @@
+"""KVStore tests (parity model: tests/python/unittest/test_kvstore.py and
+the 2-bit compression math from tests/nightly/dist_sync_kvstore.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.test_utils import assert_almost_equal
+
+SHAPE = (4, 4)
+KEYS = [5, 7, 11]
+
+
+def init_kv(kv_type="local"):
+    kv = mx.kv.create(kv_type)
+    kv.init(3, nd.zeros(SHAPE))
+    kv.init(KEYS, [nd.zeros(SHAPE)] * len(KEYS))
+    return kv
+
+
+def test_kv_basic_push_pull():
+    kv = init_kv()
+    kv.push(3, nd.ones(SHAPE) * 4)
+    out = nd.empty(SHAPE)
+    kv.pull(3, out=out)
+    assert_almost_equal(out.asnumpy(), np.full(SHAPE, 4.0))
+
+
+def test_kv_aggregation():
+    kv = init_kv()
+    num_devs = 4
+    vals = [nd.ones(SHAPE) for _ in range(num_devs)]
+    kv.push(3, vals)
+    out = nd.empty(SHAPE)
+    kv.pull(3, out=out)
+    assert_almost_equal(out.asnumpy(), np.full(SHAPE, float(num_devs)))
+
+
+def test_kv_list_push_pull():
+    kv = init_kv()
+    kv.push(KEYS, [[nd.ones(SHAPE) * 2] * 3] * len(KEYS))
+    outs = [nd.empty(SHAPE) for _ in KEYS]
+    kv.pull(KEYS, out=outs)
+    for o in outs:
+        assert_almost_equal(o.asnumpy(), np.full(SHAPE, 6.0))
+
+
+def test_kv_str_keys():
+    kv = mx.kv.create()
+    kv.init("weight", nd.ones(SHAPE))
+    out = nd.empty(SHAPE)
+    kv.pull("weight", out=out)
+    assert_almost_equal(out.asnumpy(), np.ones(SHAPE))
+
+
+def test_kv_updater():
+    kv = init_kv()
+    updates = []
+
+    def updater(key, grad, weight):
+        updates.append(key)
+        weight += grad * 2
+
+    kv._set_updater(updater)
+    kv.push(3, nd.ones(SHAPE))
+    out = nd.empty(SHAPE)
+    kv.pull(3, out=out)
+    assert_almost_equal(out.asnumpy(), np.full(SHAPE, 2.0))
+    assert updates == [3]
+
+
+def test_kv_set_optimizer():
+    kv = init_kv()
+    kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.1))
+    kv.push(3, nd.ones(SHAPE))
+    out = nd.empty(SHAPE)
+    kv.pull(3, out=out)
+    # w = 0 - 0.1 * grad(=1) = -0.1
+    assert_almost_equal(out.asnumpy(), np.full(SHAPE, -0.1),
+                        rtol=1e-5, atol=1e-6)
+
+
+def test_kv_row_sparse_pull():
+    kv = mx.kv.create()
+    w = np.arange(20).reshape(10, 2).astype("f")
+    kv.init(9, nd.array(w))
+    from mxnet_tpu.ndarray import sparse
+    out = sparse.zeros_sparse("row_sparse", (10, 2))
+    kv.row_sparse_pull(9, out=out, row_ids=nd.array([1, 4]))
+    got = out.asnumpy()
+    assert_almost_equal(got[1], w[1])
+    assert_almost_equal(got[4], w[4])
+    assert_almost_equal(got[0], np.zeros(2))
+
+
+def test_kv_invalid_type():
+    with pytest.raises(mx.base.MXNetError):
+        mx.kv.create("bogus")
+
+
+def test_kv_rank_size():
+    kv = mx.kv.create("tpu_sync")
+    assert kv.rank == 0
+    assert kv.num_workers == 1
+    kv.barrier()  # no-op single process
+
+
+def test_kv_optimizer_states(tmp_path):
+    kv = init_kv()
+    kv.set_optimizer(mx.optimizer.Adam())
+    kv.push(3, nd.ones(SHAPE))
+    fname = str(tmp_path / "opt.states")
+    kv.save_optimizer_states(fname)
+    kv.load_optimizer_states(fname)
+
+
+# ------------------------------------------------------- 2-bit compression
+
+def compute_expected_2bit_quantization(grad, residual, threshold):
+    """Expected quantization math, mirrored from the reference nightly
+    test (tests/nightly/dist_sync_kvstore.py)."""
+    out = np.zeros_like(grad)
+    r = grad + residual
+    out[r >= threshold] = threshold
+    out[r <= -threshold] = -threshold
+    new_residual = r - out
+    return out, new_residual
+
+
+def test_gradient_compression_math():
+    from mxnet_tpu.kvstore import GradientCompression
+    import jax.numpy as jnp
+    gc = GradientCompression("2bit", threshold=0.5)
+    rs = np.random.RandomState(3)
+    grad = rs.randn(5, 7).astype("f")
+    residual = np.zeros((5, 7), "f")
+    for _ in range(3):
+        expected, exp_res = compute_expected_2bit_quantization(
+            grad, residual, 0.5)
+        packed, new_res = gc.quantize(
+            nd.array(grad).reshape((-1,)), jnp.asarray(residual.ravel()))
+        deq = gc.dequantize(packed, grad.shape)
+        assert_almost_equal(deq.asnumpy(), expected, rtol=1e-5, atol=1e-6)
+        residual = np.asarray(new_res).reshape(grad.shape)
+        assert_almost_equal(residual, exp_res, rtol=1e-5, atol=1e-6)
+        grad = rs.randn(5, 7).astype("f")
+
+
+def test_gradient_compression_wire_size():
+    from mxnet_tpu.kvstore import GradientCompression
+    import jax.numpy as jnp
+    gc = GradientCompression("2bit", threshold=0.5)
+    g = nd.array(np.random.randn(1024).astype("f"))
+    packed, _ = gc.quantize(g, jnp.zeros(1024))
+    # 2 bits/element → 4 elements per byte
+    assert packed.shape == (256,)
+    assert packed.dtype == np.uint8
+
+
+def test_kv_push_with_compression():
+    kv = mx.kv.create("dist_sync")
+    kv.init(3, nd.zeros(SHAPE))
+    kv.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+    grad = np.full(SHAPE, 0.7, "f")
+    kv.push(3, nd.array(grad))
+    out = nd.empty(SHAPE)
+    kv.pull(3, out=out)
+    # 0.7 >= 0.5 → quantized to 0.5 everywhere
+    assert_almost_equal(out.asnumpy(), np.full(SHAPE, 0.5))
+    # error feedback: residual 0.2 carries into next push of 0.4 → 0.6 ≥ T
+    kv.push(3, nd.array(np.full(SHAPE, 0.4, "f")))
+    kv.pull(3, out=out)
+    assert_almost_equal(out.asnumpy(), np.full(SHAPE, 0.5))
+
+
+def test_gradient_compression_invalid():
+    kv = mx.kv.create("dist_sync")
+    with pytest.raises(mx.base.MXNetError):
+        kv.set_gradient_compression({"threshold": 1})
+    with pytest.raises(mx.base.MXNetError):
+        kv.set_gradient_compression({"type": "4bit"})
+    with pytest.raises(mx.base.MXNetError):
+        kv.set_gradient_compression({"type": "2bit", "threshold": -1})
