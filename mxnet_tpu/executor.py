@@ -46,6 +46,8 @@ class Executor:
         self.aux_dict = dict(aux_states or {})
         self.group2ctx = group2ctx
         self._plan = GraphPlan(symbol)
+        self._plan.specialize_init_shapes(
+            {n: a.shape for n, a in self.arg_dict.items() if a is not None})
         # bucketing / reshape: share the compiled-function cache so XLA
         # executables are reused across executors of the same symbol family
         self._jit_cache = shared_exec._jit_cache if shared_exec is not None else {}
@@ -61,18 +63,32 @@ class Executor:
         self._mesh = mesh
         self._data_shard_args = set(data_shard_args)
 
+    @property
+    def _plan_key(self):
+        """Cache key for shared _jit_cache entries: same symbol + same
+        init-shape specialization → same executable family (reshape of the
+        same symbol reuses jax's per-shape cache; distinct bucket symbols
+        or begin-state specializations get their own closures)."""
+        ov = getattr(self._plan, "init_overrides", {})
+        # the symbol object itself (identity hash) — kept alive by the
+        # cache entry, so ids can't be recycled across dead symbols
+        return (self._symbol,
+                tuple(sorted((si, tuple(p.get("shape", ())))
+                             for si, p in ov.items())))
+
     # -- compiled entry points ---------------------------------------------
     @property
     def _fwd(self):
-        if "fwd" not in self._jit_cache:
+        key = ("fwd", self._plan_key)
+        if key not in self._jit_cache:
             plan = self._plan
-            self._jit_cache["fwd"] = jax.jit(
+            self._jit_cache[key] = jax.jit(
                 lambda a, x, k, t: plan.run(a, x, k, t), static_argnums=(3,))
-        return self._jit_cache["fwd"]
+        return self._jit_cache[key]
 
     @property
     def _fwd_bwd(self):
-        key = ("fwd_bwd", tuple(self._grad_names))
+        key = ("fwd_bwd", self._plan_key, tuple(self._grad_names))
         if key not in self._jit_cache:
             plan = self._plan
             grad_names = list(self._grad_names)

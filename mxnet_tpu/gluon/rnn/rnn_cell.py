@@ -91,14 +91,30 @@ class RecurrentCell(HybridBlock):
             self.begin_state(batch_size=batch_size)
         states = begin_state
         outputs = []
+        all_states = []
         for i in range(length):
             output, states = self(inputs[i], states)
             outputs.append(output)
+            if valid_length is not None:
+                all_states.append(states)
         if valid_length is not None:
-            outputs = [F.SequenceMask(F.stack(*[F.expand_dims(o, 0)
-                                                for o in outputs]) if False
-                                      else o, use_sequence_length=False)
-                       for o in outputs]
+            # each sample's returned state is the one at its last valid
+            # step (parity: unroll's F.SequenceLast over per-step states),
+            # and outputs past valid_length are zero-masked
+            states = [F.SequenceLast(
+                F.Concat(*[F.expand_dims(s[i], axis=0) for s in all_states],
+                         dim=0),
+                valid_length, use_sequence_length=True, axis=0)
+                for i in range(len(states))]
+            merged = F.Concat(*[F.expand_dims(o, axis=axis)
+                                for o in outputs], dim=axis)
+            merged = F.SequenceMask(merged, valid_length,
+                                    use_sequence_length=True, axis=axis)
+            if merge_outputs:
+                return merged, states
+            outputs = list(F.SliceChannel(merged, num_outputs=length,
+                                          axis=axis, squeeze_axis=True))
+            return outputs, states
         if merge_outputs:
             outputs = F.Concat(*[F.expand_dims(o, axis=axis) for o in outputs],
                                dim=axis)
@@ -408,16 +424,30 @@ class BidirectionalCell(HybridRecurrentCell):
             self.begin_state(batch_size=batch_size)
         states = begin_state
         l_cell, r_cell = self._children
+
+        def _rev(seq):
+            """Time-reverse a list of per-step (N, C) frames; with
+            valid_length, reverse only within each sample's valid span
+            (SequenceReverse semantics — padded tail stays in place)."""
+            if valid_length is None:
+                return list(reversed(seq))
+            stacked = F.Concat(*[F.expand_dims(o, axis=0) for o in seq],
+                               dim=0)  # TNC
+            rev = F.SequenceReverse(stacked, valid_length,
+                                    use_sequence_length=True)
+            return list(F.SliceChannel(rev, num_outputs=length, axis=0,
+                                       squeeze_axis=True))
+
         l_outputs, l_states = l_cell.unroll(
             length, inputs=inputs,
             begin_state=states[:len(l_cell.state_info())],
-            layout=layout, merge_outputs=False)
+            layout=layout, merge_outputs=False, valid_length=valid_length)
         r_outputs, r_states = r_cell.unroll(
-            length, inputs=list(reversed(inputs)),
+            length, inputs=_rev(inputs),
             begin_state=states[len(l_cell.state_info()):],
-            layout=layout, merge_outputs=False)
+            layout=layout, merge_outputs=False, valid_length=valid_length)
         outputs = [F.Concat(l_o, r_o, dim=1)
-                   for l_o, r_o in zip(l_outputs, reversed(r_outputs))]
+                   for l_o, r_o in zip(l_outputs, _rev(r_outputs))]
         if merge_outputs:
             outputs = F.Concat(*[F.expand_dims(o, axis=axis)
                                  for o in outputs], dim=axis)

@@ -152,15 +152,14 @@ class KVStore:
         keys, _ = _key_list(key)
         vals = _val_list(value)
         for k, vlist in zip(keys, vals):
-            if self._gc is not None:
-                # parity: kvstore_dist.h PushCompressed — each worker's
-                # communicated gradient is quantized against its own
-                # residual; the receiver sums dequantized values.
-                vlist = [self._compress(k, i, v)
-                         for i, v in enumerate(vlist)]
             merged = vlist[0]
             for v in vlist[1:]:
                 merged = merged + v
+            if self._gc is not None:
+                # parity: kvstore_dist.h PushCompressed — the worker's
+                # locally-reduced gradient is quantized on the
+                # worker→server (DCN) leg only, after device aggregation
+                merged = self._compress(k, merged)
             merged = self._allreduce(merged)
             if self._updater is not None:
                 if k not in self._store:
@@ -213,19 +212,31 @@ class KVStore:
         self._updater = updater
 
     def set_gradient_compression(self, compression_params: Dict) -> None:
-        """Parity: python/mxnet/kvstore.py:363 set_gradient_compression."""
+        """Parity: python/mxnet/kvstore.py:363 set_gradient_compression —
+        like the reference, only dist kvstores support compression (the
+        worker→server leg is what it shrinks)."""
         if "type" not in compression_params:
             raise MXNetError("compression_params requires 'type'")
-        self._gc = GradientCompression(**compression_params)
+        if not ("device" in self.type or "dist" in self.type
+                or self.type.startswith(("tpu", "nccl"))):
+            # parity: kvstore.py set_gradient_compression — supported for
+            # 'device' and 'dist' kvstores, rejected for CPU-local
+            raise MXNetError(
+                "gradient compression is not supported on kvstore type "
+                f"'{self.type}' (supported: device/dist/tpu_sync/nccl)")
+        try:
+            self._gc = GradientCompression(**compression_params)
+        except TypeError as e:
+            raise MXNetError(f"invalid compression_params: {e}") from None
         self._compression_params = self._gc.get_params()
         self._residuals = {}
 
-    def _compress(self, k, slot, v: NDArray) -> NDArray:
-        res = self._residuals.get((k, slot))
+    def _compress(self, k, v: NDArray) -> NDArray:
+        res = self._residuals.get(k)
         if res is None:
             res = jnp.zeros(v.size, dtype=jnp.float32)
         packed, new_res = self._gc.quantize(v.reshape((-1,)), res)
-        self._residuals[(k, slot)] = new_res
+        self._residuals[k] = new_res
         return self._gc.dequantize(packed, v.shape)
 
     # -- cluster control ------------------------------------------------------

@@ -179,6 +179,26 @@ class GraphPlan:
             else:
                 self.out_refs.append(("val", (ref[1], oi)))
 
+    def specialize_init_shapes(self, known_shapes: Dict[str, tuple]) -> None:
+        """Resolve 0-dims in init-op shape params (rnn begin_state) against
+        the bound arg shapes — the bind-time leg of the candidate
+        substitution in infer_shapes_types."""
+        if not known_shapes or not any(
+                s.op.name in ("_zeros", "_ones", "_full")
+                and s.params.get("shape") is not None
+                and any(int(d) == 0 for d in s.params["shape"])
+                for s in self.steps):
+            return
+        try:
+            plan2, _, _ = infer_shapes_types(
+                self.symbol, {k: tuple(v) for k, v in known_shapes.items()
+                              if v is not None}, {})
+        except MXNetError:
+            return
+        self.init_overrides = getattr(plan2, "init_overrides", {})
+        for si, p in self.init_overrides.items():
+            self.steps[si].params.update(p)
+
     # -- execution (pure; call under jit) -----------------------------------
     def run(self, arg_values: Dict[str, Any], aux_values: Dict[str, Any],
             key, is_train: bool):
@@ -244,9 +264,20 @@ def _node_eval_shape(op, params, in_structs):
 
 def infer_shapes_types(symbol: Symbol, known_shapes: Dict[str, tuple],
                        known_types: Dict[str, Any], partial: bool = False):
-    """Returns ({input_name: (shape, dtype)}, [(shape, dtype) per output])."""
+    """Returns ({input_name: (shape, dtype)}, [(shape, dtype) per output]).
+
+    Variables carrying a partial `__shape__` hint with 0-dims (the
+    reference's "unknown dim" convention — e.g. RNN begin_state (0, H),
+    rnn_cell.py state_info) are resolved by candidate substitution: try
+    each dim appearing in the known input shapes for the 0s; a wrong
+    candidate fails loudly at the first binary-op shape check, the right
+    one completes inference.  This replaces nnvm's bidirectional
+    InferShape pass for the begin-state case without a full constraint
+    solver.
+    """
     plan = GraphPlan(symbol)
     info: Dict[str, Optional[jax.ShapeDtypeStruct]] = {}
+    partial_hints: Dict[str, tuple] = {}
     for nm in plan.input_names:
         shp = known_shapes.get(nm)
         node_attr_shape = None
@@ -258,12 +289,58 @@ def infer_shapes_types(symbol: Symbol, known_shapes: Dict[str, tuple],
                     node_attr_shape = eval(n.attrs["__shape__"], {"__builtins__": {}})
             shp = node_attr_shape
         if shp is not None and any(int(d) == 0 for d in shp):
-            shp = None  # 0-dims mean "unknown" (deferred-init parameters)
+            partial_hints[nm] = tuple(int(d) for d in shp)
+            shp = None  # 0-dims mean "unknown" until substitution
         if shp is not None:
             info[nm] = jax.ShapeDtypeStruct(tuple(int(d) for d in shp),
                                             np_dtype(dt))
         else:
             info[nm] = None
+
+    # init ops (_zeros/_ones, e.g. rnn begin_state) with 0-dims in their
+    # static shape param are likewise unknown-until-substitution
+    partial_steps: Dict[int, tuple] = {}
+    for si, step in enumerate(plan.steps):
+        shp = step.params.get("shape")
+        if step.op.name in ("_zeros", "_ones", "_full") and shp is not None \
+                and any(int(d) == 0 for d in shp):
+            partial_steps[si] = tuple(int(d) for d in shp)
+
+    if (partial_hints or partial_steps) and known_shapes:
+        candidates: List[int] = []
+        for s in known_shapes.values():
+            for d in s:
+                if int(d) > 0 and int(d) not in candidates:
+                    candidates.append(int(d))
+        # 1 broadcasts against everything, so it can never "fail loudly";
+        # try it only after every stricter candidate has been rejected
+        if 1 in candidates:
+            candidates.remove(1)
+            candidates.append(1)
+        for c in candidates:
+            trial = dict(info)
+            for nm, hint in partial_hints.items():
+                if trial.get(nm) is None:
+                    filled = tuple(c if d == 0 else d for d in hint)
+                    trial[nm] = jax.ShapeDtypeStruct(
+                        filled, np_dtype(known_types.get(nm, _np.float32)))
+            overrides = {si: {"shape": tuple(c if d == 0 else d for d in hint)}
+                         for si, hint in partial_steps.items()}
+            try:
+                res = _infer_forward(plan, symbol, trial, partial=False,
+                                     param_overrides=overrides)
+            except MXNetError:
+                continue
+            # record + apply the winning substitution so executors running
+            # this plan materialize correctly-sized begin-states
+            plan.init_overrides = overrides
+            for si, p in overrides.items():
+                plan.steps[si].params.update(p)
+            return res
+    return _infer_forward(plan, symbol, info, partial=partial)
+
+
+def _infer_forward(plan, symbol, info, partial, param_overrides=None):
 
     step_out: List[Optional[tuple]] = [None] * len(plan.steps)
 
@@ -295,7 +372,10 @@ def infer_shapes_types(symbol: Symbol, known_shapes: Dict[str, tuple],
                 f"infer_shape: cannot infer input(s) {missing} of node "
                 f"'{step.node.name}' ({step.op.name}); provide their shapes")
         try:
-            outs = _node_eval_shape(step.op, step.params, structs)
+            p = step.params
+            if param_overrides and si in param_overrides:
+                p = {**p, **param_overrides[si]}
+            outs = _node_eval_shape(step.op, p, structs)
         except Exception as e:  # shape error inside op
             raise MXNetError(f"infer_shape failed at node '{step.node.name}' "
                              f"({step.op.name}): {e}") from None

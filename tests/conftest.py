@@ -3,9 +3,10 @@
 — the strategy SURVEY.md §4 prescribes (reference ran multi-*CPU*-context
 tests for device-placement logic, tests/python/unittest/test_multi_device_exec.py).
 
-Note: the axon TPU plugin on this host registers its backend regardless of
-JAX_PLATFORMS; we therefore pin jax's *default device* to CPU instead of
-trying to hide the TPU platform."""
+The axon TPU plugin on this host registers its backend in sitecustomize
+for every python process; tests never touch the chip, so we deregister
+the factory and force the cpu platform — otherwise a slow/unreachable
+TPU tunnel hangs CPU-only test runs at the first backends() call."""
 import os
 
 flags = os.environ.get("XLA_FLAGS", "")
@@ -13,20 +14,12 @@ if "host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = \
         flags + " --xla_force_host_platform_device_count=8"
 
+os.environ["JAX_PLATFORMS"] = "cpu"
+import sys
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from __graft_entry__ import _cpu_only_guard
+_cpu_only_guard()
 import jax
-
-# Tests never touch the real chip; deregister the axon TPU backend so a
-# slow/unreachable tunnel can't hang CPU-only test runs (the axon hook
-# otherwise creates the TPU client on any backends() call).
-try:
-    from jax._src import xla_bridge as _xb
-    _xb._backend_factories.pop("axon", None)
-except Exception:
-    pass
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-if os.environ.get("JAX_PLATFORMS") == "axon":
-    os.environ["JAX_PLATFORMS"] = "cpu"
-jax.config.update("jax_platforms", "cpu")
 
 _cpus = jax.devices("cpu")
 assert len(_cpus) >= 8, _cpus

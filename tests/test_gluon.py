@@ -451,3 +451,34 @@ def test_symbol_block():
     net.initialize()
     y = net(nd.ones((2, 3)))
     assert y.shape == (2, 4)
+
+
+def test_unroll_valid_length():
+    """Outputs past valid_length are zero-masked and returned states come
+    from each sample's last valid step (SequenceLast parity)."""
+    cell = rnn.LSTMCell(4, input_size=3)
+    cell.initialize()
+    x = nd.random.uniform(shape=(2, 5, 3))
+    vl = nd.array([2, 5])
+    out, states = cell.unroll(5, x, layout="NTC", merge_outputs=True,
+                              valid_length=vl)
+    o = out.asnumpy()
+    assert (o[0, 2:] == 0).all()       # masked past t=2 for sample 0
+    assert (o[0, :2] != 0).any()
+    # sample 0's state == state after running only 2 steps
+    out2, states2 = cell.unroll(2, nd.array(x.asnumpy()[:, :2]),
+                                layout="NTC", merge_outputs=True)
+    assert_almost_equal(states[0].asnumpy()[0], states2[0].asnumpy()[0],
+                        rtol=1e-5, atol=1e-6)
+
+
+def test_bidirectional_valid_length():
+    cell = rnn.BidirectionalCell(rnn.LSTMCell(4, input_size=3),
+                                 rnn.LSTMCell(4, input_size=3))
+    cell.initialize()
+    x = nd.random.uniform(shape=(2, 5, 3))
+    out, _ = cell.unroll(5, x, layout="NTC", merge_outputs=True,
+                         valid_length=nd.array([3, 5]))
+    o = out.asnumpy()
+    assert o.shape == (2, 5, 8)
+    assert (o[0, 3:] == 0).all()

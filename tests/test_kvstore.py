@@ -181,3 +181,21 @@ def test_gradient_compression_invalid():
         kv.set_gradient_compression({"type": "4bit"})
     with pytest.raises(mx.base.MXNetError):
         kv.set_gradient_compression({"type": "2bit", "threshold": -1})
+    with pytest.raises(mx.base.MXNetError):
+        kv.set_gradient_compression({"type": "2bit", "bogus": 1})
+    # parity: compression only on dist kvstores
+    with pytest.raises(mx.base.MXNetError):
+        mx.kv.create("local").set_gradient_compression({"type": "2bit"})
+
+
+def test_kv_compression_after_device_aggregation():
+    """Quantization applies to the locally-reduced gradient (worker->server
+    leg), not per device copy."""
+    kv = mx.kv.create("dist_sync")
+    kv.init(3, nd.zeros(SHAPE))
+    kv.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+    # two device copies of 0.3 merge to 0.6 >= T -> one quantized 0.5
+    kv.push(3, [nd.full(SHAPE, 0.3), nd.full(SHAPE, 0.3)])
+    out = nd.empty(SHAPE)
+    kv.pull(3, out=out)
+    assert_almost_equal(out.asnumpy(), np.full(SHAPE, 0.5))

@@ -1,0 +1,143 @@
+"""RNN toolkit + bucketing tests (parity model: tests/python/unittest/
+test_rnn.py + tests/python/train/test_bucketing.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, sym
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_symbolic_lstm_cell_unroll():
+    cell = mx.rnn.LSTMCell(num_hidden=8, prefix="lstm_")
+    data = sym.Variable("data")
+    outputs, states = cell.unroll(3, data, layout="NTC", merge_outputs=True)
+    args = outputs.list_arguments()
+    assert "lstm_i2h_weight" in args
+    shapes, out_shapes, _ = outputs.infer_shape(data=(2, 3, 4))
+    assert out_shapes[0] == (2, 3, 8)
+
+
+def test_symbolic_gru_rnn_cells():
+    for cell_t, nh in [(mx.rnn.GRUCell, 6), (mx.rnn.RNNCell, 5)]:
+        cell = cell_t(num_hidden=nh)
+        outputs, _ = cell.unroll(4, sym.Variable("data"), layout="NTC",
+                                 merge_outputs=True)
+        _, out_shapes, _ = outputs.infer_shape(data=(3, 4, 7))
+        assert out_shapes[0] == (3, 4, nh)
+
+
+def test_fused_rnn_cell_unfuse():
+    fused = mx.rnn.FusedRNNCell(num_hidden=8, num_layers=2, mode="lstm",
+                                prefix="f_")
+    stacked = fused.unfuse()
+    outputs, _ = stacked.unroll(3, sym.Variable("data"), layout="NTC",
+                                merge_outputs=True)
+    _, out_shapes, _ = outputs.infer_shape(data=(2, 3, 4))
+    assert out_shapes[0] == (2, 3, 8)
+
+
+def test_sequential_stack():
+    stack = mx.rnn.SequentialRNNCell()
+    stack.add(mx.rnn.LSTMCell(num_hidden=8, prefix="l0_"))
+    stack.add(mx.rnn.LSTMCell(num_hidden=4, prefix="l1_"))
+    outputs, states = stack.unroll(5, sym.Variable("data"), layout="NTC",
+                                   merge_outputs=True)
+    _, out_shapes, _ = outputs.infer_shape(data=(2, 5, 6))
+    assert out_shapes[0] == (2, 5, 4)
+
+
+def test_bidirectional_symbolic():
+    cell = mx.rnn.BidirectionalCell(
+        mx.rnn.LSTMCell(num_hidden=4, prefix="l_"),
+        mx.rnn.LSTMCell(num_hidden=4, prefix="r_"))
+    outputs, _ = cell.unroll(3, sym.Variable("data"), layout="NTC",
+                             merge_outputs=True)
+    _, out_shapes, _ = outputs.infer_shape(data=(2, 3, 5))
+    assert out_shapes[0] == (2, 3, 8)
+
+
+def test_encode_sentences():
+    sents = [["the", "cat"], ["the", "dog", "ran"]]
+    enc, vocab = mx.rnn.encode_sentences(sents, invalid_label=0, start_label=1)
+    assert len(enc) == 2
+    assert len(enc[1]) == 3
+    assert vocab["the"] == enc[0][0] == enc[1][0]
+
+
+def test_bucket_sentence_iter():
+    rs = np.random.RandomState(0)
+    sents = [list(rs.randint(1, 20, size=n))
+             for n in rs.randint(3, 15, size=50)]
+    it = mx.rnn.BucketSentenceIter(sents, batch_size=4, buckets=[5, 10, 15],
+                                   invalid_label=0)
+    seen = 0
+    for batch in it:
+        key = batch.bucket_key
+        assert batch.data[0].shape[1] == key
+        assert batch.data[0].shape[0] == 4
+        seen += 1
+    assert seen > 0
+    it.reset()
+    assert len(list(it)) == seen
+
+
+def test_bucketing_module_train():
+    """BucketingModule trains a small LM-shaped problem across buckets
+    (parity: tests/python/train/test_bucketing.py, shrunk)."""
+    rs = np.random.RandomState(0)
+    vocab = 20
+    sents = [list(rs.randint(1, vocab, size=n))
+             for n in rs.randint(4, 10, size=120)]
+    buckets = [5, 10]
+    it = mx.rnn.BucketSentenceIter(sents, batch_size=8, buckets=buckets,
+                                   invalid_label=0)
+
+    def sym_gen(seq_len):
+        data = sym.Variable("data")
+        label = sym.Variable("softmax_label")
+        embed = sym.Embedding(data, input_dim=vocab, output_dim=8,
+                              name="embed")
+        cell = mx.rnn.LSTMCell(num_hidden=16, prefix="lstm_")
+        outputs, _ = cell.unroll(seq_len, embed, layout="NTC",
+                                 merge_outputs=True)
+        pred = sym.Reshape(outputs, shape=(-1, 16))
+        pred = sym.FullyConnected(pred, num_hidden=vocab, name="pred")
+        label = sym.Reshape(label, shape=(-1,))
+        pred = sym.SoftmaxOutput(pred, label, name="softmax")
+        return pred, ("data",), ("softmax_label",)
+
+    mod = mx.mod.BucketingModule(sym_gen, default_bucket_key=max(buckets),
+                                 context=mx.cpu())
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params(mx.init.Xavier())
+    mod.init_optimizer(optimizer="adam", optimizer_params={"learning_rate": 0.01})
+    metric = mx.metric.Perplexity(ignore_label=None)
+    first = last = None
+    for epoch in range(3):
+        it.reset()
+        metric.reset()
+        for batch in it:
+            mod.forward_backward(batch)
+            mod.update()
+            mod.update_metric(metric, batch.label)
+        val = metric.get()[1]
+        if first is None:
+            first = val
+        last = val
+    assert last < first, (first, last)
+
+
+def test_rnn_cell_params_save_load(tmp_path):
+    cell = mx.rnn.LSTMCell(num_hidden=4, prefix="lstm_")
+    outputs, _ = cell.unroll(2, sym.Variable("data"), layout="NTC",
+                             merge_outputs=True)
+    arg_shapes, _, _ = outputs.infer_shape(data=(1, 2, 3))
+    args = {name: mx.nd.random.uniform(shape=shape)
+            for name, shape in zip(outputs.list_arguments(), arg_shapes)
+            if name != "data"}
+    unpacked = cell.unpack_weights(args)
+    assert "lstm_i2h_i_weight" in unpacked
+    repacked = cell.pack_weights(unpacked)
+    for k in args:
+        assert_almost_equal(args[k].asnumpy(), repacked[k].asnumpy())

@@ -1,0 +1,116 @@
+"""Sparse NDArray tests (parity model: tests/python/unittest/
+test_sparse_ndarray.py, test_sparse_operator.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.ndarray import sparse
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_row_sparse_create():
+    vals = np.arange(6).reshape(2, 3).astype("f")
+    rs = sparse.row_sparse_array((vals, [1, 3]), shape=(5, 3))
+    assert rs.stype == "row_sparse"
+    dense = rs.asnumpy()
+    assert_almost_equal(dense[1], vals[0])
+    assert_almost_equal(dense[3], vals[1])
+    assert_almost_equal(dense[0], np.zeros(3))
+
+
+def test_row_sparse_from_dense():
+    d = np.zeros((4, 2), "f")
+    d[2] = [1, 2]
+    rs = sparse.cast_storage(nd.array(d), "row_sparse")
+    assert rs.stype == "row_sparse"
+    idx = rs.indices.asnumpy()
+    assert 2 in idx
+    assert_almost_equal(rs.asnumpy(), d)
+
+
+def test_row_sparse_retain():
+    vals = np.arange(8).reshape(4, 2).astype("f")
+    rs = sparse.row_sparse_array((vals, [0, 2, 4, 6]), shape=(8, 2))
+    kept = rs.retain(nd.array([2, 6]))
+    assert_almost_equal(kept.asnumpy()[2], vals[1])
+    assert_almost_equal(kept.asnumpy()[6], vals[3])
+    assert_almost_equal(kept.asnumpy()[0], np.zeros(2))
+
+
+def test_csr_create():
+    # [[0, 1], [2, 0], [0, 0]]
+    csr = sparse.csr_matrix(([1.0, 2.0], [1, 0], [0, 1, 2, 2]),
+                            shape=(3, 2))
+    assert csr.stype == "csr"
+    dense = csr.asnumpy()
+    expected = np.array([[0, 1], [2, 0], [0, 0]], "f")
+    assert_almost_equal(dense, expected)
+    assert_almost_equal(csr.indptr.asnumpy(), np.array([0, 1, 2, 2]))
+
+
+def test_csr_from_dense():
+    d = np.array([[1, 0, 2], [0, 0, 3]], "f")
+    csr = sparse.cast_storage(nd.array(d), "csr")
+    assert csr.stype == "csr"
+    assert_almost_equal(csr.asnumpy(), d)
+    assert_almost_equal(csr.data.asnumpy(), np.array([1, 2, 3], "f"))
+    assert_almost_equal(csr.indices.asnumpy(), np.array([0, 2, 2]))
+
+
+def test_cast_storage_roundtrip():
+    d = np.random.rand(5, 4).astype("f")
+    d[d < 0.5] = 0
+    for stype in ("row_sparse", "csr"):
+        sp = sparse.cast_storage(nd.array(d), stype)
+        back = sp.tostype("default")
+        assert back.stype == "default"
+        assert_almost_equal(back.asnumpy(), d)
+
+
+def test_sparse_zeros():
+    for stype in ("row_sparse", "csr"):
+        z = sparse.zeros_sparse(stype, (3, 4))
+        assert z.stype == stype
+        assert_almost_equal(z.asnumpy(), np.zeros((3, 4)))
+
+
+def test_sparse_elemwise_add():
+    """Sparse arrays participate in dense arithmetic (storage fallback —
+    parity: executor storage-fallback semantics)."""
+    vals = np.ones((1, 3), "f")
+    rs = sparse.row_sparse_array((vals, [1]), shape=(3, 3))
+    out = rs + nd.ones((3, 3))
+    got = out.asnumpy()
+    assert_almost_equal(got[1], np.full(3, 2.0))
+    assert_almost_equal(got[0], np.ones(3))
+
+
+def test_sparse_dot():
+    """dot(csr, dense) — parity: src/operator/tensor/dot-inl.h sparse dot."""
+    d = np.array([[1, 0, 2], [0, 3, 0]], "f")
+    csr = sparse.cast_storage(nd.array(d), "csr")
+    rhs = np.random.rand(3, 4).astype("f")
+    out = nd.dot(csr, nd.array(rhs))
+    assert_almost_equal(out.asnumpy(), d @ rhs, rtol=1e-5, atol=1e-6)
+
+
+def test_row_sparse_optimizer_update():
+    """sgd_update with row_sparse grad touches only the live rows
+    (parity: src/operator/optimizer_op.cc row-sparse variants)."""
+    opt = mx.optimizer.SGD(learning_rate=1.0)
+    w = nd.array(np.ones((4, 2), "f"))
+    grad = sparse.row_sparse_array((np.ones((1, 2), "f"), [2]), shape=(4, 2))
+    opt.update(0, w, grad, opt.create_state(0, w))
+    got = w.asnumpy()
+    assert_almost_equal(got[2], np.zeros(2))   # updated row
+    assert_almost_equal(got[0], np.ones(2))    # untouched rows
+
+
+def test_sparse_save_load(tmp_path):
+    vals = np.arange(4).reshape(2, 2).astype("f")
+    rs = sparse.row_sparse_array((vals, [0, 3]), shape=(4, 2))
+    fname = str(tmp_path / "sparse.nd")
+    nd.save(fname, {"w": rs})
+    loaded = nd.load(fname)["w"]
+    assert_almost_equal(loaded.asnumpy(), rs.asnumpy())
