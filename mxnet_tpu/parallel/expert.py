@@ -1,0 +1,85 @@
+"""Expert parallelism: switch-style MoE with all_to_all token dispatch.
+
+Absent from the reference (SURVEY.md §2.3: EP ❌); provided here as a
+first-class capability.  One (or more) experts live on each slice of the
+'ep' mesh axis; tokens are routed top-1 to experts, packed into fixed
+capacity slots (static shapes — XLA-friendly), exchanged with
+`lax.all_to_all` over ICI, transformed by the local expert, and combined
+back weighted by the gate probability.  The load-balancing auxiliary loss
+follows the Switch Transformer formulation.
+"""
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+from jax import shard_map
+
+
+def switch_moe(x, gate_w, expert_fn: Callable, expert_params,
+               axis_name: str = "ep", capacity_factor: float = 2.0):
+    """Top-1 MoE layer (call inside shard_map).
+
+    x: (T, D) local tokens; gate_w: (D, E) router weights (replicated),
+    E == axis size; expert_params: THIS device's expert weights.
+    Returns (y: (T, D), aux_loss: scalar load-balancing loss).
+    """
+    n = lax.psum(1, axis_name)
+    T, D = x.shape
+    logits = x @ gate_w                       # (T, E)
+    probs = jax.nn.softmax(logits, axis=-1)
+    eidx = jnp.argmax(probs, axis=-1)         # (T,)
+    gate = jnp.take_along_axis(probs, eidx[:, None], axis=-1)[:, 0]
+
+    E = probs.shape[-1]
+    C = max(1, int(capacity_factor * T / E))
+    onehot = jax.nn.one_hot(eidx, E, dtype=x.dtype)          # (T, E)
+    # position of each token within its expert's queue
+    pos = (jnp.cumsum(onehot, axis=0) - 1.0) * onehot        # (T, E)
+    keep = (pos < C).astype(x.dtype) * onehot
+    slot = jax.nn.one_hot(pos.sum(-1).astype(jnp.int32), C,
+                          dtype=x.dtype)                     # (T, C)
+    dispatch = keep[:, :, None] * slot[:, None, :]           # (T, E, C)
+
+    # pack: (E, C, D) — expert e's capacity slots filled with local tokens
+    packed = jnp.einsum("td,tec->ecd", x, dispatch)
+    # exchange: row e goes to device e; afterwards axis 0 indexes the
+    # SOURCE device and every row holds tokens for MY expert
+    recv = lax.all_to_all(packed, axis_name, split_axis=0, concat_axis=0,
+                          tiled=True)                        # (E, C, D)
+    out = expert_fn(expert_params, recv.reshape(-1, D)).reshape(recv.shape)
+    # return each processed token to its owner
+    back = lax.all_to_all(out, axis_name, split_axis=0, concat_axis=0,
+                          tiled=True)                        # (E, C, D)
+    combine = dispatch * gate[:, None, None]
+    y = jnp.einsum("ecd,tec->td", back, combine)
+
+    # Switch load-balance loss: E * Σ_e (fraction routed to e)(mean prob e)
+    frac = jnp.mean(onehot, axis=0)
+    mean_p = jnp.mean(probs, axis=0)
+    aux = E * jnp.sum(frac * mean_p)
+    return y, aux
+
+
+def switch_moe_sharded(x, gate_w, expert_fn: Callable, stacked_expert_params,
+                       mesh: Mesh, axis_name: str = "ep",
+                       capacity_factor: float = 2.0):
+    """Wrapper: tokens sharded on 'ep' (data-parallel over the same axis),
+    expert weights stacked on a leading axis of size mesh.shape[axis_name]."""
+
+    def per_device(xs, gw, params):
+        squeezed = jax.tree_util.tree_map(lambda a: a[0], params)
+        y, aux = switch_moe(xs, gw, expert_fn, squeezed, axis_name,
+                            capacity_factor)
+        return y, lax.pmean(aux, axis_name)
+
+    fn = shard_map(
+        per_device, mesh=mesh,
+        in_specs=(P(axis_name), P(),
+                  jax.tree_util.tree_map(lambda _: P(axis_name),
+                                         stacked_expert_params)),
+        out_specs=(P(axis_name), P()), check_vma=False)
+    return fn(x, gate_w, stacked_expert_params)

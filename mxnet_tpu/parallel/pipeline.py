@@ -1,0 +1,85 @@
+"""Pipeline parallelism: GPipe-style microbatching over a 'pp' mesh axis.
+
+The reference's closest analog is manual layer placement with
+`_CrossDeviceCopy` inserts (group2ctx model parallelism,
+`src/executor/graph_executor.cc:411`) — activations hop devices but stages
+run serially.  This module provides true pipelining as a first-class
+capability: stage weights live sharded on the 'pp' axis (one stage per
+mesh slice), activations advance stage-to-stage with `lax.ppermute`, and
+microbatches fill the pipeline so all stages compute concurrently after
+warm-up (bubble = (S-1)/(M+S-1)).
+
+SPMD formulation (scaling-book recipe): ONE traced program for all
+devices; `lax.axis_index('pp')` selects per-device behavior; XLA lowers
+the ppermute to ICI neighbor exchanges.
+"""
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+from jax import shard_map
+
+
+def gpipe(stage_fn: Callable, stage_params, x, n_microbatches: int,
+          axis_name: str = "pp"):
+    """Run a pipeline of `axis_size` identical-signature stages (call
+    inside shard_map).
+
+    stage_fn(params, h) -> h      one stage's computation
+    stage_params                  THIS device's stage weights (pytree)
+    x: (B, ...) local batch; B % n_microbatches == 0.  Activations keep
+    shape (B/M, ...) across stages.
+
+    Returns the last stage's outputs for the full batch, replicated to
+    every pp rank (psum of the masked accumulation).
+    """
+    n = lax.psum(1, axis_name)
+    stage = lax.axis_index(axis_name)
+    M = n_microbatches
+    assert x.shape[0] % M == 0, (x.shape, M)
+    micro = x.reshape((M, x.shape[0] // M) + x.shape[1:])
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    outputs = jnp.zeros(micro.shape, x.dtype)
+    state = jnp.zeros(micro.shape[1:], x.dtype)
+
+    def body(t, carry):
+        state, outputs = carry
+        # stage 0 ingests microbatch t (clamped during drain); others take
+        # the activation handed over by the previous stage
+        inp = jnp.where(stage == 0, micro[jnp.minimum(t, M - 1)], state)
+        out = stage_fn(stage_params, inp)
+        # the last stage finishes microbatch t-(n-1); park invalid writes
+        # out of bounds (mode="drop")
+        mb = t - (n - 1)
+        w_idx = jnp.where((stage == n - 1) & (mb >= 0), jnp.maximum(mb, 0), M)
+        outputs = outputs.at[w_idx].set(out, mode="drop")
+        state = lax.ppermute(out, axis_name, perm)
+        return state, outputs
+
+    state, outputs = lax.fori_loop(0, M + n - 1, body, (state, outputs),
+                                   unroll=True)
+    # only the last stage holds real outputs; replicate across the axis
+    outputs = jnp.where(stage == n - 1, outputs, 0)
+    outputs = lax.psum(outputs, axis_name)
+    return outputs.reshape(x.shape)
+
+
+def gpipe_sharded(stage_fn: Callable, stacked_params, x, mesh: Mesh,
+                  n_microbatches: int, axis_name: str = "pp"):
+    """Convenience wrapper: `stacked_params` leaves have a leading axis of
+    size mesh.shape[axis_name] (one slice per stage); x is replicated."""
+
+    def per_device(params, xs):
+        squeezed = jax.tree_util.tree_map(lambda a: a[0], params)
+        return gpipe(stage_fn, squeezed, xs, n_microbatches, axis_name)
+
+    fn = shard_map(
+        per_device, mesh=mesh,
+        in_specs=(jax.tree_util.tree_map(lambda _: P(axis_name), stacked_params),
+                  P()),
+        out_specs=P(), check_vma=False)
+    return fn(stacked_params, x)

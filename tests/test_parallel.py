@@ -1,0 +1,265 @@
+"""Parallelism tests on the 8-virtual-CPU-device mesh (the SURVEY.md §4
+multi-device-without-hardware strategy).  Covers the full strategy matrix:
+dp (collectives), sp (ring + Ulysses attention), pp (GPipe), ep (MoE)."""
+import functools
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from mxnet_tpu import parallel
+from mxnet_tpu.parallel import mesh as mesh_mod
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def cpu_mesh(shape, names):
+    devs = np.array(jax.devices("cpu")[:int(np.prod(shape))]).reshape(shape)
+    return Mesh(devs, names)
+
+
+def dense_attention(q, k, v, causal=False):
+    scale = q.shape[-1] ** -0.5
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    if causal:
+        T = q.shape[2]
+        mask = jnp.arange(T)[:, None] >= jnp.arange(T)[None, :]
+        s = jnp.where(mask[None, None], s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v)
+
+
+# ------------------------------------------------------------ sequence (sp)
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_attention_matches_dense(causal):
+    rs = np.random.RandomState(0)
+    B, H, T, D = 2, 4, 32, 8
+    q, k, v = (jnp.asarray(rs.normal(0, 1, (B, H, T, D)).astype("f"))
+               for _ in range(3))
+    ref = dense_attention(q, k, v, causal)
+    m = cpu_mesh((8,), ("sp",))
+    out = parallel.sequence_parallel.ring_attention_sharded(
+        q, k, v, m, causal=causal)
+    assert_almost_equal(np.asarray(out), np.asarray(ref),
+                        rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ulysses_attention_matches_dense(causal):
+    rs = np.random.RandomState(1)
+    B, H, T, D = 2, 8, 32, 4  # H divisible by axis size
+    q, k, v = (jnp.asarray(rs.normal(0, 1, (B, H, T, D)).astype("f"))
+               for _ in range(3))
+    ref = dense_attention(q, k, v, causal)
+    m = cpu_mesh((4,), ("sp",))
+    out = parallel.sequence_parallel.ulysses_attention_sharded(
+        q, k, v, m, causal=causal)
+    assert_almost_equal(np.asarray(out), np.asarray(ref),
+                        rtol=1e-4, atol=1e-5)
+
+
+def test_ring_attention_grads_finite():
+    rs = np.random.RandomState(2)
+    B, H, T, D = 1, 2, 16, 4
+    q, k, v = (jnp.asarray(rs.normal(0, 1, (B, H, T, D)).astype("f"))
+               for _ in range(3))
+    m = cpu_mesh((4,), ("sp",))
+
+    def loss(q, k, v):
+        return jnp.sum(parallel.sequence_parallel.ring_attention_sharded(
+            q, k, v, m, causal=True) ** 2)
+
+    g = jax.grad(loss)(q, k, v)
+    assert np.isfinite(np.asarray(g)).all()
+    # grads match dense attention's
+    g_ref = jax.grad(lambda a, b, c: jnp.sum(
+        dense_attention(a, b, c, True) ** 2))(q, k, v)
+    assert_almost_equal(np.asarray(g), np.asarray(g_ref),
+                        rtol=1e-3, atol=1e-4)
+
+
+# ------------------------------------------------------------ pipeline (pp)
+
+def test_gpipe_matches_sequential():
+    rs = np.random.RandomState(3)
+    S, B, D = 4, 8, 16
+    ws = jnp.asarray(rs.normal(0, 0.5, (S, D, D)).astype("f"))
+    bs = jnp.asarray(rs.normal(0, 0.1, (S, D)).astype("f"))
+    x = jnp.asarray(rs.normal(0, 1, (B, D)).astype("f"))
+
+    def stage_fn(params, h):
+        w, b = params
+        return jnp.tanh(h @ w + b)
+
+    # sequential reference
+    ref = x
+    for i in range(S):
+        ref = stage_fn((ws[i], bs[i]), ref)
+
+    m = cpu_mesh((S,), ("pp",))
+    out = parallel.gpipe_sharded(stage_fn, (ws, bs), x, m, n_microbatches=2)
+    assert_almost_equal(np.asarray(out), np.asarray(ref),
+                        rtol=1e-5, atol=1e-6)
+
+
+def test_gpipe_microbatch_counts():
+    rs = np.random.RandomState(4)
+    S, B, D = 2, 12, 8
+    ws = jnp.asarray(rs.normal(0, 0.5, (S, D, D)).astype("f"))
+    x = jnp.asarray(rs.normal(0, 1, (B, D)).astype("f"))
+
+    def stage_fn(w, h):
+        return jax.nn.relu(h @ w)
+
+    ref = jax.nn.relu(jax.nn.relu(x @ ws[0]) @ ws[1])
+    m = cpu_mesh((S,), ("pp",))
+    for M in (1, 2, 3, 6):
+        out = parallel.gpipe_sharded(stage_fn, ws, x, m, n_microbatches=M)
+        assert_almost_equal(np.asarray(out), np.asarray(ref),
+                            rtol=1e-5, atol=1e-6)
+
+
+def test_gpipe_differentiable():
+    rs = np.random.RandomState(5)
+    S, B, D = 2, 4, 8
+    ws = jnp.asarray(rs.normal(0, 0.5, (S, D, D)).astype("f"))
+    x = jnp.asarray(rs.normal(0, 1, (B, D)).astype("f"))
+    m = cpu_mesh((S,), ("pp",))
+
+    def stage_fn(w, h):
+        return jnp.tanh(h @ w)
+
+    def loss(ws):
+        return jnp.sum(parallel.gpipe_sharded(stage_fn, ws, x, m, 2) ** 2)
+
+    def ref_loss(ws):
+        h = x
+        for i in range(S):
+            h = stage_fn(ws[i], h)
+        return jnp.sum(h ** 2)
+
+    g = jax.grad(loss)(ws)
+    g_ref = jax.grad(ref_loss)(ws)
+    assert_almost_equal(np.asarray(g), np.asarray(g_ref),
+                        rtol=1e-4, atol=1e-5)
+
+
+# -------------------------------------------------------------- expert (ep)
+
+def test_switch_moe_routes_correctly():
+    """With ample capacity, every token gets exactly its top-1 expert's
+    transform scaled by the gate probability."""
+    rs = np.random.RandomState(6)
+    E, T, D = 4, 32, 8
+    x = jnp.asarray(rs.normal(0, 1, (T, D)).astype("f"))
+    gate_w = jnp.asarray(rs.normal(0, 1, (D, E)).astype("f"))
+    # expert e multiplies by (e+1)
+    expert_w = jnp.asarray(
+        np.stack([np.eye(D, dtype="f") * (e + 1) for e in range(E)]))
+
+    def expert_fn(w, h):
+        return h @ w
+
+    m = cpu_mesh((E,), ("ep",))
+    y, aux = parallel.switch_moe_sharded(
+        x, gate_w, expert_fn, expert_w, m, capacity_factor=float(E))
+    probs = jax.nn.softmax(x @ gate_w, axis=-1)
+    eidx = np.asarray(jnp.argmax(probs, -1))
+    gate = np.asarray(jnp.max(probs, -1))
+    expected = np.asarray(x) * (eidx + 1)[:, None] * gate[:, None]
+    assert_almost_equal(np.asarray(y), expected, rtol=1e-4, atol=1e-5)
+    assert float(aux) >= 1.0 - 1e-3  # switch aux loss lower bound is 1
+
+
+def test_switch_moe_capacity_drops():
+    """Over-capacity tokens are dropped (output 0) — static shapes, no
+    dynamic allocation."""
+    E, T, D = 2, 8, 4
+    # force all tokens to expert 0
+    x = jnp.ones((T, D), jnp.float32)
+    gate_w = jnp.zeros((D, E), jnp.float32)
+    gate_w = gate_w.at[:, 0].set(1.0)
+
+    def expert_fn(w, h):
+        return h
+
+    expert_w = jnp.zeros((E, 1), jnp.float32)
+    m = cpu_mesh((E,), ("ep",))
+    y, _ = parallel.switch_moe_sharded(x, gate_w, expert_fn, expert_w, m,
+                                       capacity_factor=0.5)
+    got = np.asarray(y)
+    # capacity = 0.5 * (T/E tokens per device) / E = 1 slot/device => per
+    # device: 1 kept token (nonzero), rest dropped
+    nonzero_rows = (np.abs(got).sum(-1) > 1e-6).sum()
+    assert nonzero_rows == 2, got
+
+
+# ---------------------------------------------------------------- dp/mesh
+
+def test_make_mesh_axes():
+    m = mesh_mod.make_mesh(dp=2, tp=2, devices=jax.devices("cpu")[:4])
+    assert m.axis_names == ("dp", "tp")
+    assert m.shape["dp"] == 2 and m.shape["tp"] == 2
+
+
+def test_make_mesh_too_many():
+    import mxnet_tpu.base as base
+    with pytest.raises(base.MXNetError):
+        mesh_mod.make_mesh(dp=64, devices=jax.devices("cpu"))
+
+
+def test_shard_batch_and_psum():
+    m = cpu_mesh((8,), ("dp",))
+    x = jnp.arange(16.0).reshape(16, 1)
+    sharded = parallel.shard_batch(m, x)
+    assert sharded.sharding.spec == P("dp")
+
+    fn = shard_map(lambda a: jax.lax.psum(jnp.sum(a), "dp"),
+                   mesh=m, in_specs=P("dp"), out_specs=P(),
+                   check_vma=False)
+    total = fn(sharded)
+    assert float(total) == float(x.sum())
+
+
+def test_reduce_scatter_allgather():
+    m = cpu_mesh((4,), ("x",))
+
+    def f(a):
+        rs = parallel.collectives.reduce_scatter(a, "x")
+        return parallel.collectives.all_gather(rs, "x")
+
+    fn = shard_map(f, mesh=m, in_specs=P(), out_specs=P(),
+                   check_vma=False)
+    x = jnp.arange(16.0).reshape(4, 4)
+    out = fn(x)
+    # replicated input: psum_scatter gives each device 4x its row, and
+    # all_gather reassembles 4*x
+    assert_almost_equal(np.asarray(out), 4 * np.asarray(x),
+                        rtol=1e-5, atol=1e-5)
+
+
+def test_dp_gradients_match_single_device():
+    """SPMD dp step produces the same grads as a single-device step
+    (the KVStore('tpu_sync') correctness property)."""
+    rs = np.random.RandomState(7)
+    B, D = 16, 8
+    x = jnp.asarray(rs.normal(0, 1, (B, D)).astype("f"))
+    y = jnp.asarray(rs.normal(0, 1, (B, 1)).astype("f"))
+    w = jnp.asarray(rs.normal(0, 1, (D, 1)).astype("f"))
+
+    def loss(w, x, y):
+        return jnp.mean((x @ w - y) ** 2)
+
+    g_single = jax.grad(loss)(w, x, y)
+
+    m = cpu_mesh((8,), ("dp",))
+    xs = parallel.shard_batch(m, x)
+    ys = parallel.shard_batch(m, y)
+    wr = parallel.replicate(m, w)
+    g_spmd = jax.jit(jax.grad(loss))(wr, xs, ys)
+    assert_almost_equal(np.asarray(g_spmd), np.asarray(g_single),
+                        rtol=1e-5, atol=1e-6)
