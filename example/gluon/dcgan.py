@@ -1,0 +1,127 @@
+#!/usr/bin/env python
+"""DCGAN (behavioral parity: example/gluon/dcgan.py — generator /
+discriminator ConvTranspose/Conv stacks, alternating adversarial updates).
+
+    python example/gluon/dcgan.py --epochs 1 --ndf 16 --ngf 16
+Trains on synthetic image blobs when no dataset is available.
+"""
+import argparse
+import logging
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, autograd, nd
+from mxnet_tpu.gluon import nn
+
+logging.basicConfig(level=logging.INFO)
+
+
+def build_generator(ngf, nc=3):
+    netG = nn.HybridSequential(prefix="gen_")
+    with netG.name_scope():
+        # latent z -> 4x4
+        netG.add(nn.Conv2DTranspose(ngf * 4, 4, 1, 0, use_bias=False))
+        netG.add(nn.BatchNorm())
+        netG.add(nn.Activation("relu"))
+        # 4x4 -> 8x8
+        netG.add(nn.Conv2DTranspose(ngf * 2, 4, 2, 1, use_bias=False))
+        netG.add(nn.BatchNorm())
+        netG.add(nn.Activation("relu"))
+        # 8x8 -> 16x16
+        netG.add(nn.Conv2DTranspose(ngf, 4, 2, 1, use_bias=False))
+        netG.add(nn.BatchNorm())
+        netG.add(nn.Activation("relu"))
+        # 16x16 -> 32x32
+        netG.add(nn.Conv2DTranspose(nc, 4, 2, 1, use_bias=False))
+        netG.add(nn.Activation("tanh"))
+    return netG
+
+
+def build_discriminator(ndf):
+    netD = nn.HybridSequential(prefix="disc_")
+    with netD.name_scope():
+        netD.add(nn.Conv2D(ndf, 4, 2, 1, use_bias=False))
+        netD.add(nn.LeakyReLU(0.2))
+        netD.add(nn.Conv2D(ndf * 2, 4, 2, 1, use_bias=False))
+        netD.add(nn.BatchNorm())
+        netD.add(nn.LeakyReLU(0.2))
+        netD.add(nn.Conv2D(ndf * 4, 4, 2, 1, use_bias=False))
+        netD.add(nn.BatchNorm())
+        netD.add(nn.LeakyReLU(0.2))
+        netD.add(nn.Conv2D(1, 4, 1, 0, use_bias=False))
+    return netD
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--epochs", type=int, default=1)
+    p.add_argument("--batch-size", type=int, default=16)
+    p.add_argument("--nz", type=int, default=64, help="latent size")
+    p.add_argument("--ngf", type=int, default=32)
+    p.add_argument("--ndf", type=int, default=32)
+    p.add_argument("--lr", type=float, default=0.0002)
+    p.add_argument("--beta1", type=float, default=0.5)
+    p.add_argument("--num-examples", type=int, default=128)
+    args = p.parse_args()
+
+    rs = np.random.RandomState(0)
+    real_images = np.tanh(rs.normal(0, 1, (args.num_examples, 3, 32, 32))
+                          ).astype("f")
+    loader = gluon.data.DataLoader(
+        gluon.data.ArrayDataset(real_images),
+        batch_size=args.batch_size, shuffle=True, last_batch="discard")
+
+    netG = build_generator(args.ngf)
+    netD = build_discriminator(args.ndf)
+    netG.initialize(mx.init.Normal(0.02))
+    netD.initialize(mx.init.Normal(0.02))
+    trainerG = gluon.Trainer(netG.collect_params(), "adam",
+                             {"learning_rate": args.lr, "beta1": args.beta1})
+    trainerD = gluon.Trainer(netD.collect_params(), "adam",
+                             {"learning_rate": args.lr, "beta1": args.beta1})
+    loss_fn = gluon.loss.SigmoidBinaryCrossEntropyLoss()
+
+    for epoch in range(args.epochs):
+        tic = time.time()
+        errD_total = errG_total = 0.0
+        nb = 0
+        for data in loader:
+            bs = data.shape[0]
+            real_label = nd.ones((bs,))
+            fake_label = nd.zeros((bs,))
+            z = nd.random.normal(shape=(bs, args.nz, 1, 1))
+
+            # update D: maximize log(D(x)) + log(1 - D(G(z)))
+            fake = netG(z)
+            with autograd.record():
+                out_real = netD(data).reshape((-1,))
+                errD_real = loss_fn(out_real, real_label)
+                out_fake = netD(fake.detach()).reshape((-1,))
+                errD_fake = loss_fn(out_fake, fake_label)
+                errD = errD_real + errD_fake
+            errD.backward()
+            trainerD.step(bs)
+
+            # update G: maximize log(D(G(z)))
+            with autograd.record():
+                out = netD(netG(z)).reshape((-1,))
+                errG = loss_fn(out, real_label)
+            errG.backward()
+            trainerG.step(bs)
+
+            errD_total += float(errD.asnumpy().mean())
+            errG_total += float(errG.asnumpy().mean())
+            nb += 1
+        logging.info("Epoch[%d] lossD=%.3f lossG=%.3f time=%.1fs", epoch,
+                     errD_total / nb, errG_total / nb, time.time() - tic)
+
+
+if __name__ == "__main__":
+    main()

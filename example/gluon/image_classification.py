@@ -1,0 +1,89 @@
+#!/usr/bin/env python
+"""Gluon imperative/hybrid image classification (behavioral parity:
+example/gluon/image_classification.py — model-zoo nets, Trainer, autograd).
+
+    python example/gluon/image_classification.py --model resnet18_v1 \
+        --dataset synthetic --epochs 2 [--hybridize]
+"""
+import argparse
+import logging
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, autograd, nd
+from mxnet_tpu.gluon.model_zoo import vision
+
+logging.basicConfig(level=logging.INFO)
+
+
+def get_data(args):
+    rs = np.random.RandomState(0)
+    shape = (args.num_examples, 3, args.image_size, args.image_size)
+    means = rs.uniform(-1, 1, (args.num_classes, 3, 1, 1)).astype("f")
+    y = rs.randint(0, args.num_classes, args.num_examples)
+    x = (means[y] + rs.normal(0, 0.5, shape)).astype("f")
+    split = int(0.9 * args.num_examples)
+    train = gluon.data.DataLoader(
+        gluon.data.ArrayDataset(x[:split], y[:split].astype("f")),
+        batch_size=args.batch_size, shuffle=True, last_batch="discard")
+    val = gluon.data.DataLoader(
+        gluon.data.ArrayDataset(x[split:], y[split:].astype("f")),
+        batch_size=args.batch_size)
+    return train, val
+
+
+def evaluate(net, loader):
+    metric = mx.metric.Accuracy()
+    for data, label in loader:
+        metric.update([label], [net(data)])
+    return metric.get()[1]
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--model", type=str, default="resnet18_v1")
+    p.add_argument("--dataset", type=str, default="synthetic")
+    p.add_argument("--epochs", type=int, default=3)
+    p.add_argument("--batch-size", type=int, default=32)
+    p.add_argument("--lr", type=float, default=0.01)
+    p.add_argument("--num-classes", type=int, default=10)
+    p.add_argument("--num-examples", type=int, default=640)
+    p.add_argument("--image-size", type=int, default=32)
+    p.add_argument("--hybridize", action="store_true")
+    args = p.parse_args()
+
+    net = getattr(vision, args.model)(classes=args.num_classes)
+    net.initialize(mx.init.Xavier(magnitude=2))
+    if args.hybridize:
+        net.hybridize()
+
+    train, val = get_data(args)
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": args.lr})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    metric = mx.metric.Accuracy()
+
+    for epoch in range(args.epochs):
+        metric.reset()
+        tic = time.time()
+        for data, label in train:
+            with autograd.record():
+                out = net(data)
+                loss = loss_fn(out, label)
+            loss.backward()
+            trainer.step(data.shape[0])
+            metric.update([label], [out])
+        logging.info("Epoch[%d] train-acc=%.3f time=%.1fs", epoch,
+                     metric.get()[1], time.time() - tic)
+    logging.info("val-acc=%.3f", evaluate(net, val))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,120 @@
+#!/usr/bin/env python
+"""Gluon LSTM word language model (behavioral parity:
+example/gluon/word_language_model/train.py — embedding + LSTM + tied-ish
+decoder trained with truncated BPTT).
+
+    python example/gluon/word_language_model.py --epochs 2
+Runs on a synthetic markov corpus when no data file is given.
+"""
+import argparse
+import logging
+import math
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, autograd, nd
+from mxnet_tpu.gluon import nn, rnn
+
+logging.basicConfig(level=logging.INFO)
+
+
+class RNNModel(gluon.Block):
+    def __init__(self, vocab_size, num_embed, num_hidden, num_layers,
+                 dropout=0.2, **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.drop = nn.Dropout(dropout)
+            self.encoder = nn.Embedding(vocab_size, num_embed)
+            self.rnn = rnn.LSTM(num_hidden, num_layers, dropout=dropout,
+                                input_size=num_embed)
+            self.decoder = nn.Dense(vocab_size, in_units=num_hidden)
+            self.num_hidden = num_hidden
+
+    def forward(self, inputs, hidden):
+        emb = self.drop(self.encoder(inputs))
+        output, hidden = self.rnn(emb, hidden)
+        output = self.drop(output)
+        decoded = self.decoder(output.reshape((-1, self.num_hidden)))
+        return decoded, hidden
+
+    def begin_state(self, *args, **kwargs):
+        return self.rnn.begin_state(*args, **kwargs)
+
+
+def batchify(data, batch_size):
+    n = len(data) // batch_size
+    data = np.asarray(data[:n * batch_size]).reshape(batch_size, n).T
+    return nd.array(data)
+
+
+def synthetic_tokens(n=40000, vocab=100, seed=0):
+    rs = np.random.RandomState(seed)
+    trans = rs.randint(0, vocab, (vocab,))
+    toks = [int(rs.randint(0, vocab))]
+    for _ in range(n - 1):
+        toks.append(int(trans[toks[-1]]) if rs.rand() < 0.9
+                    else int(rs.randint(0, vocab)))
+    return toks, vocab
+
+
+def detach(hidden):
+    return [h.detach() for h in hidden] if isinstance(hidden, (list, tuple)) \
+        else hidden.detach()
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--epochs", type=int, default=2)
+    p.add_argument("--batch-size", type=int, default=32)
+    p.add_argument("--bptt", type=int, default=35)
+    p.add_argument("--num-embed", type=int, default=64)
+    p.add_argument("--num-hidden", type=int, default=128)
+    p.add_argument("--num-layers", type=int, default=2)
+    p.add_argument("--lr", type=float, default=1.0)
+    p.add_argument("--clip", type=float, default=0.25)
+    args = p.parse_args()
+
+    tokens, vocab_size = synthetic_tokens()
+    data = batchify(tokens, args.batch_size)
+
+    model = RNNModel(vocab_size, args.num_embed, args.num_hidden,
+                     args.num_layers)
+    model.initialize(mx.init.Xavier())
+    trainer = gluon.Trainer(model.collect_params(), "sgd",
+                            {"learning_rate": args.lr, "momentum": 0,
+                             "wd": 0})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    for epoch in range(args.epochs):
+        total_L, n_batch = 0.0, 0
+        hidden = model.begin_state(batch_size=args.batch_size)
+        tic = time.time()
+        for i in range(0, data.shape[0] - 1 - args.bptt, args.bptt):
+            x = data[i:i + args.bptt]
+            y = data[i + 1:i + 1 + args.bptt].reshape((-1,))
+            hidden = detach(hidden)
+            with autograd.record():
+                output, hidden = model(x, hidden)
+                L = loss_fn(output, y)
+            L.backward()
+            grads = [p.grad() for p in model.collect_params().values()
+                     if p.grad_req != "null"]
+            gluon.utils.clip_global_norm(
+                grads, args.clip * args.bptt * args.batch_size)
+            trainer.step(args.bptt * args.batch_size)
+            total_L += float(L.asnumpy().mean())
+            n_batch += 1
+        ppl = math.exp(total_L / max(n_batch, 1))
+        logging.info("Epoch[%d] perplexity=%.1f time=%.1fs", epoch, ppl,
+                     time.time() - tic)
+
+
+if __name__ == "__main__":
+    main()

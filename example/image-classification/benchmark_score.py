@@ -1,0 +1,65 @@
+#!/usr/bin/env python
+"""Inference throughput across the model zoo (behavioral parity:
+example/image-classification/benchmark_score.py — img/s per network per
+batch size).
+
+    python benchmark_score.py [--networks resnet-50,mobilenet] [--batch-sizes 1,32]
+"""
+import argparse
+import logging
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import mxnet_tpu as mx
+from mxnet_tpu.gluon.model_zoo import vision
+
+logging.basicConfig(level=logging.INFO)
+
+ZOO = {
+    "alexnet": vision.alexnet,
+    "vgg-11": vision.vgg11,
+    "resnet-18": lambda **kw: vision.resnet18_v1(**kw),
+    "resnet-50": lambda **kw: vision.resnet50_v1(**kw),
+    "resnet-152": lambda **kw: vision.resnet152_v1(**kw),
+    "squeezenet": vision.squeezenet1_0,
+    "mobilenet": lambda **kw: vision.mobilenet1_0(**kw),
+    "densenet-121": vision.densenet121,
+    "inception-v3": vision.inception_v3,
+}
+
+
+def score(network, batch_size, image_shape=(3, 224, 224), repeats=10):
+    if network == "inception-v3":
+        image_shape = (3, 299, 299)
+    net = ZOO[network](classes=1000)
+    net.initialize()
+    net.hybridize()
+    data = mx.nd.random.uniform(shape=(batch_size,) + image_shape)
+    out = net(data)       # build + compile
+    out.wait_to_read()
+    tic = time.time()
+    for _ in range(repeats):
+        out = net(data)
+    out.wait_to_read()
+    return batch_size * repeats / (time.time() - tic)
+
+
+if __name__ == "__main__":
+    p = argparse.ArgumentParser()
+    p.add_argument("--networks", type=str,
+                   default="resnet-18,resnet-50,mobilenet")
+    p.add_argument("--batch-sizes", type=str, default="1,32")
+    p.add_argument("--repeats", type=int, default=10)
+    args = p.parse_args()
+    for network in args.networks.split(","):
+        for bs in (int(x) for x in args.batch_sizes.split(",")):
+            img_s = score(network, bs, repeats=args.repeats)
+            logging.info("network: %s batch: %d  %.1f img/s",
+                         network, bs, img_s)

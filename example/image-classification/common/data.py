@@ -1,0 +1,146 @@
+"""Data providers for the image-classification examples (behavioral
+parity: example/image-classification/common/data.py — rec-file iterators
+with augmentation flags, plus a synthetic generator for I/O-free
+benchmarking on hosts without datasets)."""
+import argparse
+import os
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def add_data_args(parser):
+    data = parser.add_argument_group("Data", "the input images")
+    data.add_argument("--data-train", type=str, help="the training data")
+    data.add_argument("--data-val", type=str, help="the validation data")
+    data.add_argument("--rgb-mean", type=str, default="123.68,116.779,103.939",
+                      help="a tuple of size 3 for the mean rgb")
+    data.add_argument("--pad-size", type=int, default=0,
+                      help="padding the input image")
+    data.add_argument("--image-shape", type=str,
+                      help="the image shape feed into the network, e.g. (3,224,224)")
+    data.add_argument("--num-classes", type=int, help="the number of classes")
+    data.add_argument("--num-examples", type=int, help="the number of training examples")
+    data.add_argument("--data-nthreads", type=int, default=4,
+                      help="number of threads for data decoding")
+    data.add_argument("--benchmark", type=int, default=0,
+                      help="if 1, run synthetic data for benchmark")
+    return data
+
+
+def add_data_aug_args(parser):
+    aug = parser.add_argument_group("Image augmentations", "augmentation flags")
+    aug.add_argument("--random-crop", type=int, default=1,
+                     help="if or not randomly crop the image")
+    aug.add_argument("--random-mirror", type=int, default=1,
+                     help="if or not randomly flip horizontally")
+    aug.add_argument("--max-random-h", type=int, default=0, help="max hue change")
+    aug.add_argument("--max-random-s", type=int, default=0,
+                     help="max saturation change")
+    aug.add_argument("--max-random-l", type=int, default=0,
+                     help="max lightness change")
+    aug.add_argument("--max-random-aspect-ratio", type=float, default=0,
+                     help="max aspect-ratio change")
+    aug.add_argument("--max-random-rotate-angle", type=int, default=0,
+                     help="max rotation angle")
+    aug.add_argument("--max-random-shear-ratio", type=float, default=0,
+                     help="max shear ratio")
+    aug.add_argument("--max-random-scale", type=float, default=1,
+                     help="max scale ratio")
+    aug.add_argument("--min-random-scale", type=float, default=1,
+                     help="min scale ratio")
+    return aug
+
+
+class SyntheticDataIter(mx.io.DataIter):
+    """Device-feedable random data (parity: benchmark mode in the
+    reference's common/data.py SyntheticDataIter)."""
+
+    def __init__(self, num_classes, data_shape, max_iter, dtype="float32"):
+        super().__init__(data_shape[0])
+        self.batch_size = data_shape[0]
+        self.cur_iter = 0
+        self.max_iter = max_iter
+        self.dtype = dtype
+        rs = np.random.RandomState(0)
+        label = rs.randint(0, num_classes, (self.batch_size,))
+        data = rs.uniform(-1, 1, data_shape)
+        self.data = mx.nd.array(data, dtype=dtype)
+        self.label = mx.nd.array(label, dtype="float32")
+
+    @property
+    def provide_data(self):
+        return [mx.io.DataDesc("data", self.data.shape, self.dtype)]
+
+    @property
+    def provide_label(self):
+        return [mx.io.DataDesc("softmax_label", (self.batch_size,), "float32")]
+
+    def next(self):
+        self.cur_iter += 1
+        if self.cur_iter > self.max_iter:
+            raise StopIteration
+        return mx.io.DataBatch(data=[self.data], label=[self.label], pad=0,
+                               index=None, provide_data=self.provide_data,
+                               provide_label=self.provide_label)
+
+    def reset(self):
+        self.cur_iter = 0
+
+
+def get_rec_iter(args, kv=None):
+    """RecordIO-backed train/val iterators; falls back to synthetic data
+    when --benchmark or when no --data-train is given."""
+    image_shape = tuple(int(x) for x in args.image_shape.split(","))
+    if args.benchmark or not args.data_train:
+        data_shape = (args.batch_size,) + image_shape
+        epoch_size = max(int(args.num_examples / args.batch_size), 1)
+        train = SyntheticDataIter(args.num_classes, data_shape, epoch_size,
+                                  args.dtype)
+        return train, None
+    rank, nworker = (kv.rank, kv.num_workers) if kv else (0, 1)
+    rgb_mean = [float(x) for x in args.rgb_mean.split(",")]
+    train = mx.io.ImageRecordIter(
+        path_imgrec=args.data_train, data_shape=image_shape,
+        batch_size=args.batch_size, shuffle=True,
+        mean_r=rgb_mean[0], mean_g=rgb_mean[1], mean_b=rgb_mean[2],
+        rand_crop=bool(args.random_crop), rand_mirror=bool(args.random_mirror),
+        preprocess_threads=args.data_nthreads,
+        num_parts=nworker, part_index=rank)
+    if not args.data_val:
+        return train, None
+    val = mx.io.ImageRecordIter(
+        path_imgrec=args.data_val, data_shape=image_shape,
+        batch_size=args.batch_size, shuffle=False,
+        mean_r=rgb_mean[0], mean_g=rgb_mean[1], mean_b=rgb_mean[2],
+        preprocess_threads=args.data_nthreads,
+        num_parts=nworker, part_index=rank)
+    return train, val
+
+
+def get_mnist_iter(args, kv=None):
+    """MNIST iterators; synthesizes MNIST-shaped data when the idx files
+    are absent (zero-egress hosts)."""
+    data_dir = getattr(args, "data_dir", "data/mnist")
+    img = os.path.join(data_dir, "train-images-idx3-ubyte")
+    if os.path.exists(img):
+        train = mx.io.MNISTIter(image=img,
+                                label=os.path.join(data_dir, "train-labels-idx1-ubyte"),
+                                batch_size=args.batch_size, shuffle=True)
+        val = mx.io.MNISTIter(image=os.path.join(data_dir, "t10k-images-idx3-ubyte"),
+                              label=os.path.join(data_dir, "t10k-labels-idx1-ubyte"),
+                              batch_size=args.batch_size)
+        return train, val
+    rs = np.random.RandomState(42)
+    n = min(args.num_examples, 2000)
+    # separable synthetic digits: class mean + noise
+    means = rs.uniform(0, 0.6, (10, 1, 28, 28))
+    labels = rs.randint(0, 10, n)
+    x = (means[labels] + rs.normal(0, 0.2, (n, 1, 28, 28))).astype("f")
+    y = labels.astype("f")
+    split = int(0.9 * n)
+    train = mx.io.NDArrayIter(x[:split], y[:split], args.batch_size,
+                              shuffle=True)
+    val = mx.io.NDArrayIter(x[split:], y[split:], args.batch_size)
+    return train, val

@@ -1,0 +1,56 @@
+#!/usr/bin/env python
+"""Fine-tune a checkpointed model on a new dataset (behavioral parity:
+example/image-classification/fine-tune.py — replace the last FC, optionally
+freeze lower layers via fixed_param_names).
+
+    python fine-tune.py --pretrained-model model-prefix --load-epoch 10 \
+        --num-classes 37 --data-train pets.rec
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import mxnet_tpu as mx
+from common import fit as fit_mod
+from common import data as data_mod
+
+
+def get_fine_tune_model(symbol, arg_params, num_classes,
+                        layer_name="flatten0"):
+    """Cut the graph at `layer_name`, attach a fresh classifier head, and
+    drop the old head's weights."""
+    all_layers = symbol.get_internals()
+    net = all_layers[layer_name + "_output"]
+    net = mx.sym.FullyConnected(net, num_hidden=num_classes, name="fc_new")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    new_args = {k: v for k, v in arg_params.items()
+                if not k.startswith("fc_new")}
+    return net, new_args
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser(
+        description="fine-tune a pretrained model",
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+    fit_mod.add_fit_args(parser)
+    data_mod.add_data_args(parser)
+    data_mod.add_data_aug_args(parser)
+    parser.add_argument("--pretrained-model", type=str, required=True,
+                        help="checkpoint prefix of the pretrained model")
+    parser.add_argument("--layer-before-fullc", type=str, default="flatten0",
+                        help="the name of the layer before the last fc")
+    parser.set_defaults(image_shape="3,224,224", num_epochs=30, lr=0.01,
+                        batch_size=32, num_examples=10000, num_classes=2)
+    args = parser.parse_args()
+
+    sym, arg_params, aux_params = mx.model.load_checkpoint(
+        args.pretrained_model, args.load_epoch or 0)
+    sym, arg_params = get_fine_tune_model(sym, arg_params, args.num_classes,
+                                          args.layer_before_fullc)
+    args.load_epoch = None  # params come from the surgery, not the resume path
+    fit_mod.fit(args, sym, data_mod.get_rec_iter,
+                arg_params=arg_params, aux_params=aux_params)

@@ -1,0 +1,13 @@
+"""Multi-layer perceptron (parity: symbols/mlp.py)."""
+import mxnet_tpu as mx
+
+
+def get_symbol(num_classes=10, **kwargs):
+    data = mx.sym.Variable("data")
+    data = mx.sym.Flatten(data)
+    fc1 = mx.sym.FullyConnected(data, name="fc1", num_hidden=128)
+    act1 = mx.sym.Activation(fc1, name="relu1", act_type="relu")
+    fc2 = mx.sym.FullyConnected(act1, name="fc2", num_hidden=64)
+    act2 = mx.sym.Activation(fc2, name="relu2", act_type="relu")
+    fc3 = mx.sym.FullyConnected(act2, name="fc3", num_hidden=num_classes)
+    return mx.sym.SoftmaxOutput(fc3, name="softmax")

@@ -1,0 +1,123 @@
+"""ResNet v1/v2 symbols (parity target: symbols/resnet.py — the
+pre-activation (v2) residual design from 'Identity Mappings in Deep
+Residual Networks').  TPU notes: BN+ReLU+conv chains fuse under XLA; the
+graph is built NCHW and lowered to the conv op's TPU-preferred layout."""
+import mxnet_tpu as mx
+
+
+def residual_unit(data, num_filter, stride, dim_match, name,
+                  bottle_neck=True, bn_mom=0.9):
+    if bottle_neck:
+        bn1 = mx.sym.BatchNorm(data, fix_gamma=False, eps=2e-5,
+                               momentum=bn_mom, name=name + "_bn1")
+        act1 = mx.sym.Activation(bn1, act_type="relu", name=name + "_relu1")
+        conv1 = mx.sym.Convolution(act1, num_filter=int(num_filter * 0.25),
+                                   kernel=(1, 1), stride=(1, 1), pad=(0, 0),
+                                   no_bias=True, name=name + "_conv1")
+        bn2 = mx.sym.BatchNorm(conv1, fix_gamma=False, eps=2e-5,
+                               momentum=bn_mom, name=name + "_bn2")
+        act2 = mx.sym.Activation(bn2, act_type="relu", name=name + "_relu2")
+        conv2 = mx.sym.Convolution(act2, num_filter=int(num_filter * 0.25),
+                                   kernel=(3, 3), stride=stride, pad=(1, 1),
+                                   no_bias=True, name=name + "_conv2")
+        bn3 = mx.sym.BatchNorm(conv2, fix_gamma=False, eps=2e-5,
+                               momentum=bn_mom, name=name + "_bn3")
+        act3 = mx.sym.Activation(bn3, act_type="relu", name=name + "_relu3")
+        conv3 = mx.sym.Convolution(act3, num_filter=num_filter, kernel=(1, 1),
+                                   stride=(1, 1), pad=(0, 0), no_bias=True,
+                                   name=name + "_conv3")
+        shortcut = data if dim_match else mx.sym.Convolution(
+            act1, num_filter=num_filter, kernel=(1, 1), stride=stride,
+            no_bias=True, name=name + "_sc")
+        return conv3 + shortcut
+    bn1 = mx.sym.BatchNorm(data, fix_gamma=False, eps=2e-5, momentum=bn_mom,
+                           name=name + "_bn1")
+    act1 = mx.sym.Activation(bn1, act_type="relu", name=name + "_relu1")
+    conv1 = mx.sym.Convolution(act1, num_filter=num_filter, kernel=(3, 3),
+                               stride=stride, pad=(1, 1), no_bias=True,
+                               name=name + "_conv1")
+    bn2 = mx.sym.BatchNorm(conv1, fix_gamma=False, eps=2e-5, momentum=bn_mom,
+                           name=name + "_bn2")
+    act2 = mx.sym.Activation(bn2, act_type="relu", name=name + "_relu2")
+    conv2 = mx.sym.Convolution(act2, num_filter=num_filter, kernel=(3, 3),
+                               stride=(1, 1), pad=(1, 1), no_bias=True,
+                               name=name + "_conv2")
+    shortcut = data if dim_match else mx.sym.Convolution(
+        act1, num_filter=num_filter, kernel=(1, 1), stride=stride,
+        no_bias=True, name=name + "_sc")
+    return conv2 + shortcut
+
+
+def resnet(units, num_stages, filter_list, num_classes, image_shape,
+           bottle_neck=True, bn_mom=0.9):
+    data = mx.sym.Variable("data")
+    data = mx.sym.identity(data, name="id")
+    (nchannel, height, width) = image_shape
+    body = mx.sym.BatchNorm(data, fix_gamma=True, eps=2e-5, momentum=bn_mom,
+                            name="bn_data")
+    if height <= 32:  # cifar-style stem
+        body = mx.sym.Convolution(body, num_filter=filter_list[0],
+                                  kernel=(3, 3), stride=(1, 1), pad=(1, 1),
+                                  no_bias=True, name="conv0")
+    else:  # imagenet stem
+        body = mx.sym.Convolution(body, num_filter=filter_list[0],
+                                  kernel=(7, 7), stride=(2, 2), pad=(3, 3),
+                                  no_bias=True, name="conv0")
+        body = mx.sym.BatchNorm(body, fix_gamma=False, eps=2e-5,
+                                momentum=bn_mom, name="bn0")
+        body = mx.sym.Activation(body, act_type="relu", name="relu0")
+        body = mx.sym.Pooling(body, kernel=(3, 3), stride=(2, 2), pad=(1, 1),
+                              pool_type="max")
+    for i in range(num_stages):
+        stride = (1, 1) if i == 0 and height > 32 else \
+            ((1, 1) if i == 0 else (2, 2))
+        body = residual_unit(body, filter_list[i + 1], stride, False,
+                             name=f"stage{i + 1}_unit1",
+                             bottle_neck=bottle_neck, bn_mom=bn_mom)
+        for j in range(units[i] - 1):
+            body = residual_unit(body, filter_list[i + 1], (1, 1), True,
+                                 name=f"stage{i + 1}_unit{j + 2}",
+                                 bottle_neck=bottle_neck, bn_mom=bn_mom)
+    bn1 = mx.sym.BatchNorm(body, fix_gamma=False, eps=2e-5, momentum=bn_mom,
+                           name="bn1")
+    relu1 = mx.sym.Activation(bn1, act_type="relu", name="relu1")
+    pool1 = mx.sym.Pooling(relu1, global_pool=True, kernel=(7, 7),
+                           pool_type="avg", name="pool1")
+    flat = mx.sym.Flatten(pool1)
+    fc1 = mx.sym.FullyConnected(flat, num_hidden=num_classes, name="fc1")
+    return mx.sym.SoftmaxOutput(fc1, name="softmax")
+
+
+def get_symbol(num_classes, num_layers, image_shape, **kwargs):
+    image_shape = tuple(int(x) for x in image_shape.split(",")) \
+        if isinstance(image_shape, str) else tuple(image_shape)
+    (nchannel, height, width) = image_shape
+    if height <= 32:
+        num_stages = 3
+        if (num_layers - 2) % 9 == 0 and num_layers >= 164:
+            per_unit = [(num_layers - 2) // 9]
+            filter_list = [16, 64, 128, 256]
+            bottle_neck = True
+        elif (num_layers - 2) % 6 == 0 and num_layers < 164:
+            per_unit = [(num_layers - 2) // 6]
+            filter_list = [16, 16, 32, 64]
+            bottle_neck = False
+        else:
+            raise ValueError(f"no experiments done on num_layers {num_layers}")
+        units = per_unit * num_stages
+    else:
+        num_stages = 4
+        if num_layers >= 50:
+            filter_list = [64, 256, 512, 1024, 2048]
+            bottle_neck = True
+        else:
+            filter_list = [64, 64, 128, 256, 512]
+            bottle_neck = False
+        stage_units = {18: [2, 2, 2, 2], 34: [3, 4, 6, 3], 50: [3, 4, 6, 3],
+                       101: [3, 4, 23, 3], 152: [3, 8, 36, 3],
+                       200: [3, 24, 36, 3]}
+        if num_layers not in stage_units:
+            raise ValueError(f"no experiments done on num_layers {num_layers}")
+        units = stage_units[num_layers]
+    return resnet(units, num_stages, filter_list, num_classes, image_shape,
+                  bottle_neck)

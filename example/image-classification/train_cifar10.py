@@ -1,0 +1,42 @@
+#!/usr/bin/env python
+"""Train resnet on CIFAR-10 rec files (behavioral parity:
+example/image-classification/train_cifar10.py).
+
+    python train_cifar10.py --data-train cifar10_train.rec \
+        --data-val cifar10_val.rec --network resnet --num-layers 20
+Without --data-train it benchmarks on synthetic data.
+"""
+import argparse
+import importlib
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+from common import fit as fit_mod
+from common import data as data_mod
+
+
+def parse_args():
+    parser = argparse.ArgumentParser(
+        description="train cifar10",
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+    fit_mod.add_fit_args(parser)
+    data_mod.add_data_args(parser)
+    data_mod.add_data_aug_args(parser)
+    parser.set_defaults(network="resnet", num_layers=20, num_classes=10,
+                        num_examples=50000, image_shape="3,28,28",
+                        pad_size=4, batch_size=128, num_epochs=300,
+                        lr=0.05, lr_step_epochs="200,250")
+    return parser.parse_args()
+
+
+if __name__ == "__main__":
+    args = parse_args()
+    net_mod = importlib.import_module("symbols." + args.network)
+    sym = net_mod.get_symbol(num_classes=args.num_classes,
+                             num_layers=args.num_layers,
+                             image_shape=args.image_shape)
+    fit_mod.fit(args, sym, data_mod.get_rec_iter)

@@ -1,0 +1,42 @@
+#!/usr/bin/env python
+"""Train resnet/vgg/... on ImageNet rec files (behavioral parity:
+example/image-classification/train_imagenet.py).
+
+    python train_imagenet.py --data-train train.rec --network resnet \
+        --num-layers 50 --kv-store tpu_sync
+Without --data-train it benchmarks on synthetic data.
+"""
+import argparse
+import importlib
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+from common import fit as fit_mod
+from common import data as data_mod
+
+
+def parse_args():
+    parser = argparse.ArgumentParser(
+        description="train imagenet-1k",
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+    fit_mod.add_fit_args(parser)
+    data_mod.add_data_args(parser)
+    data_mod.add_data_aug_args(parser)
+    parser.set_defaults(network="resnet", num_layers=50, num_classes=1000,
+                        num_examples=1281167, image_shape="3,224,224",
+                        batch_size=128, num_epochs=90, lr=0.1,
+                        lr_step_epochs="30,60,80", dtype="bfloat16")
+    return parser.parse_args()
+
+
+if __name__ == "__main__":
+    args = parse_args()
+    net_mod = importlib.import_module("symbols." + args.network)
+    sym = net_mod.get_symbol(num_classes=args.num_classes,
+                             num_layers=args.num_layers,
+                             image_shape=args.image_shape)
+    fit_mod.fit(args, sym, data_mod.get_rec_iter)

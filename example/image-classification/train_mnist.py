@@ -1,0 +1,40 @@
+#!/usr/bin/env python
+"""Train mlp/lenet on MNIST (behavioral parity:
+example/image-classification/train_mnist.py).
+
+    python train_mnist.py --network mlp --num-epochs 5
+"""
+import argparse
+import importlib
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+from common import fit as fit_mod
+from common import data as data_mod
+
+
+def parse_args():
+    parser = argparse.ArgumentParser(
+        description="train mnist",
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+    parser.add_argument("--num-classes", type=int, default=10)
+    parser.add_argument("--num-examples", type=int, default=60000)
+    parser.add_argument("--add_stn", action="store_true")
+    parser.add_argument("--data-dir", type=str, default="data/mnist")
+    fit_mod.add_fit_args(parser)
+    parser.set_defaults(network="mlp", num_epochs=10, batch_size=64, lr=0.05,
+                        lr_step_epochs="10")
+    return parser.parse_args()
+
+
+if __name__ == "__main__":
+    args = parse_args()
+    net_mod = importlib.import_module("symbols." + args.network)
+    sym = net_mod.get_symbol(num_classes=args.num_classes,
+                             num_layers=getattr(args, "num_layers", None),
+                             image_shape="1,28,28")
+    fit_mod.fit(args, sym, data_mod.get_mnist_iter)

@@ -1,0 +1,108 @@
+#!/usr/bin/env python
+"""LSTM language model with BucketingModule (behavioral parity:
+example/rnn/lstm_bucketing.py — PTB with buckets [10,20,30,40,50,60]).
+
+Reads PTB-format text via --train-data/--valid-data; without files it
+generates a synthetic corpus so the pipeline runs on zero-egress hosts.
+"""
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import mxnet_tpu as mx
+
+parser = argparse.ArgumentParser(description="Train an LSTM LM with bucketing")
+parser.add_argument("--train-data", type=str, default="./data/ptb.train.txt")
+parser.add_argument("--valid-data", type=str, default="./data/ptb.valid.txt")
+parser.add_argument("--num-layers", type=int, default=2)
+parser.add_argument("--num-hidden", type=int, default=200)
+parser.add_argument("--num-embed", type=int, default=200)
+parser.add_argument("--batch-size", type=int, default=32)
+parser.add_argument("--num-epochs", type=int, default=5)
+parser.add_argument("--lr", type=float, default=0.01)
+parser.add_argument("--optimizer", type=str, default="adam")
+parser.add_argument("--disp-batches", type=int, default=50)
+parser.add_argument("--kv-store", type=str, default="local")
+
+BUCKETS = [10, 20, 30, 40, 50, 60]
+
+
+def tokenize_text(fname, vocab=None, invalid_label=-1, start_label=0):
+    with open(fname) as f:
+        lines = [line.split() for line in f]
+    return mx.rnn.encode_sentences(lines, vocab=vocab,
+                                   invalid_label=invalid_label,
+                                   start_label=start_label)
+
+
+def synthetic_corpus(n=2000, vocab_size=200, seed=0):
+    rs = np.random.RandomState(seed)
+    # order-1 markov chains are learnable by the LSTM
+    trans = rs.randint(1, vocab_size, (vocab_size,))
+    sents = []
+    for _ in range(n):
+        L = rs.randint(5, 40)
+        s = [int(rs.randint(1, vocab_size))]
+        for _ in range(L - 1):
+            s.append(int(trans[s[-1]]))
+        sents.append(s)
+    return sents, {i: i for i in range(vocab_size)}
+
+
+if __name__ == "__main__":
+    import logging
+    logging.basicConfig(level=logging.INFO,
+                        format="%(asctime)-15s %(message)s")
+    args = parser.parse_args()
+
+    if os.path.exists(args.train_data):
+        train_sent, vocab = tokenize_text(args.train_data, start_label=1)
+        val_sent, _ = tokenize_text(args.valid_data, vocab=vocab)
+    else:
+        print("no PTB files found; using a synthetic corpus")
+        corpus, vocab = synthetic_corpus()
+        split = int(0.9 * len(corpus))
+        train_sent, val_sent = corpus[:split], corpus[split:]
+
+    data_train = mx.rnn.BucketSentenceIter(train_sent, args.batch_size,
+                                           buckets=BUCKETS, invalid_label=0)
+    data_val = mx.rnn.BucketSentenceIter(val_sent, args.batch_size,
+                                         buckets=BUCKETS, invalid_label=0)
+    vocab_size = max(max(max(s) for s in train_sent if s) + 1, len(vocab) + 1)
+
+    stack = mx.rnn.SequentialRNNCell()
+    for i in range(args.num_layers):
+        stack.add(mx.rnn.LSTMCell(num_hidden=args.num_hidden,
+                                  prefix=f"lstm_l{i}_"))
+
+    def sym_gen(seq_len):
+        data = mx.sym.Variable("data")
+        label = mx.sym.Variable("softmax_label")
+        embed = mx.sym.Embedding(data, input_dim=vocab_size,
+                                 output_dim=args.num_embed, name="embed")
+        stack.reset()
+        outputs, states = stack.unroll(seq_len, inputs=embed,
+                                       merge_outputs=True)
+        pred = mx.sym.Reshape(outputs, shape=(-1, args.num_hidden))
+        pred = mx.sym.FullyConnected(pred, num_hidden=vocab_size, name="pred")
+        label = mx.sym.Reshape(label, shape=(-1,))
+        pred = mx.sym.SoftmaxOutput(pred, label, name="softmax")
+        return pred, ("data",), ("softmax_label",)
+
+    model = mx.mod.BucketingModule(sym_gen=sym_gen,
+                                   default_bucket_key=data_train.default_bucket_key,
+                                   context=mx.cpu())
+    model.fit(train_data=data_train, eval_data=data_val,
+              eval_metric=mx.metric.Perplexity(ignore_label=0),
+              kvstore=args.kv_store,
+              optimizer=args.optimizer,
+              optimizer_params={"learning_rate": args.lr},
+              initializer=mx.init.Xavier(factor_type="in", magnitude=2.34),
+              num_epoch=args.num_epochs,
+              batch_end_callback=mx.callback.Speedometer(args.batch_size,
+                                                         args.disp_batches))

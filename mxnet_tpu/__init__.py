@@ -52,6 +52,7 @@ from . import parallel
 from . import gluon
 from . import profiler
 from . import monitor
+from . import monitor as mon
 from .monitor import Monitor
 from . import visualization as viz
 from . import test_utils
@@ -59,3 +60,4 @@ from . import rnn
 from . import image
 from . import rtc
 from . import contrib
+from . import predictor

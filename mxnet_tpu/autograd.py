@@ -85,12 +85,19 @@ def predict_mode():
 # Tape
 # ---------------------------------------------------------------------------
 class _TapeEntry:
-    __slots__ = ("in_keys", "in_refs", "out_keys", "vjp_fn", "cot_zeros")
+    # out_refs keeps the output NDArrays alive for the tape's lifetime:
+    # keys are (id, version) and CPython recycles ids of collected
+    # objects, so dropping the refs would let unrelated later arrays
+    # alias a dead output's key (wrong-gradient corruption)
+    __slots__ = ("in_keys", "in_refs", "out_keys", "out_refs", "vjp_fn",
+                 "cot_zeros")
 
-    def __init__(self, in_keys, in_refs, out_keys, vjp_fn, cot_zeros):
+    def __init__(self, in_keys, in_refs, out_keys, out_refs, vjp_fn,
+                 cot_zeros):
         self.in_keys = in_keys
         self.in_refs = in_refs
         self.out_keys = out_keys
+        self.out_refs = out_refs
         self.vjp_fn = vjp_fn       # cotangents tuple -> input grads tuple
         self.cot_zeros = cot_zeros  # zero cotangent per forward output
 
@@ -111,6 +118,7 @@ def _record(op, inputs, outputs, vjp_fn, raw_outs) -> None:
         [_key(a) for a in nd_inputs],
         nd_inputs,
         [_key(o) for o in outputs],
+        list(outputs),
         vjp_fn,
         tuple(jnp.zeros(o.shape, o.dtype) for o in raw_outs)))
 
@@ -236,6 +244,6 @@ class Function:
 
             _state.tape.append(_TapeEntry(
                 [_key(a) for a in inputs], list(inputs),
-                [_key(o) for o in outs], vjp_fn,
+                [_key(o) for o in outs], list(outs), vjp_fn,
                 tuple(jnp.zeros(o.shape, o.dtype) for o in outs)))
         return outputs

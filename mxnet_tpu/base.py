@@ -144,6 +144,12 @@ class ParamSchema:
             if isinstance(v, (int, _np.integer)):
                 return (int(v),)
             return tuple(int(x) for x in v)
+        if ty == "floats":  # float tuple (anchor sizes/ratios, variances)
+            if isinstance(v, str):
+                v = eval(v, {"__builtins__": {}})
+            if isinstance(v, (int, float, _np.integer, _np.floating)):
+                return (float(v),)
+            return tuple(float(x) for x in v)
         if ty is bool:
             if isinstance(v, str):
                 return v.lower() in ("1", "true", "yes")

@@ -58,9 +58,8 @@ class Monitor:
         if self.sort:
             self.queue.sort(key=lambda x: x[1])
         for n, k, v_list in self.queue:
-            if isinstance(v_list, NDArray):
+            if not isinstance(v_list, list):
                 v_list = [v_list]
-            assert isinstance(v_list, list)
             s = ",".join(str(v.asscalar() if isinstance(v, NDArray) else v)
                          for v in v_list)
             res.append((n, k, s))

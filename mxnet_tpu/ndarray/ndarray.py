@@ -163,26 +163,53 @@ class NDArray:
         return sparse.cast_storage(self, stype)
 
     # -- shape views ---------------------------------------------------------
+    # under autograd.record() these dispatch through the registered ops so
+    # the tape sees them (reference parity: every view is an NNVM node);
+    # outside recording they stay raw jnp views (no registry overhead)
+    def _recording(self) -> bool:
+        from .. import autograd
+        return autograd.is_recording()
+
     def reshape(self, *shape, **kwargs) -> "NDArray":
         if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
             shape = tuple(shape[0])
         shape = kwargs.get("shape", shape)
+        if self._recording():
+            from . import _gen
+            return _gen.Reshape(self, shape=tuple(shape))
         from ..ops.matrix import infer_reshape
         return NDArray(jnp.reshape(self._data, infer_reshape(shape, self.shape)), self._ctx)
 
     def reshape_like(self, other) -> "NDArray":
+        # other.shape is literal here — MXNet special codes (0 = copy dim)
+        # apply only to user-passed reshape specs
+        if self._recording() and all(d > 0 for d in other.shape):
+            from . import _gen
+            return _gen.Reshape(self, shape=tuple(other.shape))
         return NDArray(jnp.reshape(self._data, other.shape), self._ctx)
 
     def expand_dims(self, axis) -> "NDArray":
+        if self._recording():
+            from . import _gen
+            return _gen.expand_dims(self, axis=axis)
         return NDArray(jnp.expand_dims(self._data, axis), self._ctx)
 
     def flatten(self) -> "NDArray":
+        if self._recording():
+            from . import _gen
+            return _gen.Flatten(self)
         return NDArray(jnp.reshape(self._data, (self.shape[0], -1)), self._ctx)
 
     def squeeze(self, axis=None) -> "NDArray":
+        if self._recording():
+            from . import _gen
+            return _gen.squeeze(self, axis=axis)
         return NDArray(jnp.squeeze(self._data, axis), self._ctx)
 
     def transpose(self, axes=None) -> "NDArray":
+        if self._recording():
+            from . import _gen
+            return _gen.transpose(self, axes=axes)
         return NDArray(jnp.transpose(self._data, axes), self._ctx)
 
     @property
@@ -190,6 +217,9 @@ class NDArray:
         return self.transpose()
 
     def broadcast_to(self, shape) -> "NDArray":
+        if self._recording():
+            from . import _gen
+            return _gen.broadcast_to(self, shape=tuple(shape))
         return NDArray(jnp.broadcast_to(self._data, shape), self._ctx)
 
     def split(self, num_outputs, axis=1, squeeze_axis=False):
@@ -199,12 +229,42 @@ class NDArray:
 
     # -- indexing ------------------------------------------------------------
     def __getitem__(self, key):
+        if self._recording():
+            routed = self._getitem_recorded(key)
+            if routed is not None:
+                return routed
         if isinstance(key, NDArray):
             key = key._data.astype(jnp.int32)
         elif isinstance(key, tuple):
             key = tuple(k._data.astype(jnp.int32) if isinstance(k, NDArray) else k
                         for k in key)
         return NDArray(self._data[key], self._ctx)
+
+    def _getitem_recorded(self, key):
+        """Route tape-visible indexing through registered ops (int / slice /
+        tuple-of-slices / integer-array); returns None for exotic keys
+        (boolean masks etc.), which stay raw views."""
+        from . import _gen
+        if isinstance(key, NDArray):
+            # wrap mode keeps numpy negative-index semantics (clip, the op
+            # default, would clamp -1 to 0)
+            return _gen.take(self, key, axis=0, mode="wrap")
+        if isinstance(key, int):
+            end = key + 1 if key != -1 else None
+            return _gen.slice_axis(self, axis=0, begin=key,
+                                   end=end).squeeze(axis=0)
+        if isinstance(key, slice):
+            if key.step in (None, 1):
+                b, e, _ = key.indices(self.shape[0])
+                return _gen.slice_axis(self, axis=0, begin=b, end=e)
+            return None
+        if isinstance(key, tuple) and all(
+                isinstance(k, slice) and k.step in (None, 1) for k in key):
+            idx = [k.indices(d) for k, d in zip(key, self.shape)]
+            begin = tuple(b for b, _, _ in idx)
+            end = tuple(e for _, e, _ in idx)
+            return _gen.slice(self, begin=begin, end=end)
+        return None
 
     def __setitem__(self, key, value):
         if isinstance(value, NDArray):

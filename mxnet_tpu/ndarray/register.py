@@ -13,11 +13,13 @@ import types
 from typing import Optional
 
 import jax
+import time as _time
 import numpy as _np
 
 from ..base import MXNetError, np_dtype
 from ..context import Context, current_context
 from ..ops import registry as _reg
+from .. import profiler as _profiler
 from .ndarray import NDArray, _place
 
 
@@ -60,10 +62,18 @@ def invoke(op_name: str, ndarray_inputs, kwargs, out=None):
 
     recording = autograd.is_recording() and op.differentiable and op.mutates_input is None
     vjp_fn = None
+    profiling = _profiler.is_running()
+    t0 = _time.perf_counter_ns() if profiling else 0
     if recording:
         outs, vjp_fn = _reg.make_vjp(op, params_t, raw)
     else:
         outs = _reg.apply_op(op, params_t, raw)
+    if profiling:
+        # parity: OprExecStat recorded around kernel exec
+        # (threaded_engine.h:324); async dispatch means this times
+        # trace+enqueue, with device detail in the xplane trace
+        t1 = _time.perf_counter_ns()
+        _profiler.record_event(op_name, t0 / 1e3, t1 / 1e3)
 
     out_ctx = (ndarray_inputs[0]._ctx if ndarray_inputs and
                isinstance(ndarray_inputs[0], NDArray) else (ctx or current_context()))

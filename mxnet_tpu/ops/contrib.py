@@ -107,9 +107,9 @@ def _count_sketch(p, data, h, s):
 # ---------------------------------------------------------------------------
 @register("_contrib_MultiBoxPrior", input_names=("data",),
           aliases=("MultiBoxPrior",), differentiable=False,
-          args=[Arg("sizes", "shape", (1.0,)), Arg("ratios", "shape", (1.0,)),
-                Arg("clip", bool, False), Arg("steps", "shape", (-1.0, -1.0)),
-                Arg("offsets", "shape", (0.5, 0.5))])
+          args=[Arg("sizes", "floats", (1.0,)), Arg("ratios", "floats", (1.0,)),
+                Arg("clip", bool, False), Arg("steps", "floats", (-1.0, -1.0)),
+                Arg("offsets", "floats", (0.5, 0.5))])
 def _multibox_prior(p, data):
     """Anchor generation (parity: multibox_prior.cc).  data: (N,C,H,W) →
     (1, H*W*num_anchors, 4) corner-format anchors in [0,1]."""
@@ -169,7 +169,7 @@ def _iou_corner(a, b):
                 Arg("negative_mining_ratio", float, -1.0),
                 Arg("negative_mining_thresh", float, 0.5),
                 Arg("minimum_negative_samples", int, 0),
-                Arg("variances", "shape", (0.1, 0.1, 0.2, 0.2))])
+                Arg("variances", "floats", (0.1, 0.1, 0.2, 0.2))])
 def _multibox_target(p, anchor, label, cls_pred):
     """Anchor→GT matching + regression targets (parity: multibox_target.cc).
 
@@ -227,7 +227,7 @@ def _multibox_target(p, anchor, label, cls_pred):
           args=[Arg("clip", bool, True), Arg("threshold", float, 0.01),
                 Arg("background_id", int, 0), Arg("nms_threshold", float, 0.5),
                 Arg("force_suppress", bool, False),
-                Arg("variances", "shape", (0.1, 0.1, 0.2, 0.2)),
+                Arg("variances", "floats", (0.1, 0.1, 0.2, 0.2)),
                 Arg("nms_topk", int, -1)])
 def _multibox_detection(p, cls_prob, loc_pred, anchor):
     """Decode + NMS (parity: multibox_detection.cc).  Returns

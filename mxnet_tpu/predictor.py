@@ -1,0 +1,124 @@
+"""Standalone inference predictor.
+
+Reference parity: the C predict API (`include/mxnet/c_predict_api.h:78-179`
+MXPredCreate/SetInput/Forward/GetOutput and `src/c_api/c_predict_api.cc`) —
+a deployment surface that loads a serialized symbol + params and runs
+forward-only.  TPU-native realization: the graph compiles once under
+`jax.jit` at the requested batch shape; repeated `forward()` calls hit the
+cached XLA executable (the amalgamation/mobile role is covered by AOT
+compilation through `jax.jit(...).lower(...).compile()`).
+"""
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as _np
+
+from .base import MXNetError
+from . import ndarray as nd
+from .ndarray import NDArray
+from . import symbol as sym_mod
+from .context import Context, cpu
+
+
+class Predictor:
+    """Parity: MXPredCreate → the handle; methods mirror the C calls."""
+
+    def __init__(self, symbol_json: str, param_bytes_or_file,
+                 input_shapes: Dict[str, tuple], dev=None,
+                 output_names: Optional[Sequence[str]] = None):
+        symbol = sym_mod.load_json(symbol_json)
+        if output_names:
+            internals = symbol.get_internals()
+            symbol = sym_mod.Group([internals[n] for n in output_names])
+        self._symbol = symbol
+        self._ctx = dev or cpu()
+        if isinstance(param_bytes_or_file, (bytes, bytearray)):
+            # MXPredCreate takes the param blob by pointer; accept bytes
+            import os as _os
+            import tempfile
+            with tempfile.NamedTemporaryFile(suffix=".params",
+                                             delete=False) as f:
+                f.write(param_bytes_or_file)
+                tmp_name = f.name
+            try:
+                params = nd.load(tmp_name)
+            finally:
+                _os.unlink(tmp_name)
+        else:
+            params = nd.load(param_bytes_or_file)
+        arg_params = {}
+        aux_params = {}
+        for k, v in params.items():
+            if k.startswith("arg:"):
+                arg_params[k[4:]] = v
+            elif k.startswith("aux:"):
+                aux_params[k[4:]] = v
+            else:
+                arg_params[k] = v
+
+        arg_names = symbol.list_arguments()
+        self._input_names = [n for n in arg_names if n not in arg_params]
+        args = dict(arg_params)
+        for name, shp in input_shapes.items():
+            args[name] = nd.zeros(shp, ctx=self._ctx)
+        missing = [n for n in self._input_names if n not in input_shapes]
+        if missing:
+            # label inputs of training symbols (SoftmaxOutput et al.) get
+            # inferred zero placeholders — c_predict_api binds only the
+            # data inputs (c_predict_api.cc creates aux zero arrays)
+            arg_shapes, _, _ = symbol.infer_shape_partial(**input_shapes)
+            inferred = dict(zip(arg_names, arg_shapes or []))
+            for name in missing:
+                shp = inferred.get(name)
+                if shp is None:
+                    raise MXNetError(
+                        f"input '{name}' requires a shape (MXPredCreate "
+                        f"input_shapes parity)")
+                args[name] = nd.zeros(shp, ctx=self._ctx)
+        self._exec = symbol.bind(
+            self._ctx, args=args, args_grad=None, grad_req="null",
+            aux_states=aux_params)
+        self._outputs: List[NDArray] = []
+
+    # -- C-api-shaped methods ------------------------------------------------
+    def set_input(self, name: str, data) -> None:
+        """MXPredSetInput."""
+        if name not in self._input_names:
+            raise MXNetError(f"unknown input '{name}'; inputs: "
+                             f"{self._input_names}")
+        arr = data if isinstance(data, NDArray) else nd.array(data)
+        self._exec.arg_dict[name]._set_data(arr._data.astype(
+            self._exec.arg_dict[name].dtype))
+
+    def forward(self) -> None:
+        """MXPredForward."""
+        self._outputs = self._exec.forward(is_train=False)
+
+    def get_output(self, index: int = 0) -> _np.ndarray:
+        """MXPredGetOutput — returns host numpy (the C API memcpy)."""
+        if not self._outputs:
+            raise MXNetError("call forward() before get_output()")
+        return self._outputs[index].asnumpy()
+
+    @property
+    def num_outputs(self) -> int:
+        return len(self._symbol.list_outputs())
+
+    def reshape(self, new_input_shapes: Dict[str, tuple]) -> "Predictor":
+        """MXPredReshape: new executor at the new shapes, params shared."""
+        for name, shp in new_input_shapes.items():
+            self._exec.arg_dict[name] = nd.zeros(shp, ctx=self._ctx)
+        self._exec = self._symbol.bind(
+            self._ctx, args=self._exec.arg_dict, args_grad=None,
+            grad_req="null", aux_states=self._exec.aux_dict)
+        return self
+
+
+def create(symbol_file: str, param_file: str,
+           input_shapes: Dict[str, tuple], dev=None) -> Predictor:
+    """Parity: MXPredCreate from files (prefix-symbol.json + prefix.params)."""
+    with open(symbol_file) as f:
+        symbol_json = f.read()
+    return Predictor(symbol_json, param_file, input_shapes, dev)

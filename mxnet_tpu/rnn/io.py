@@ -59,7 +59,9 @@ class BucketSentenceIter(DataIter):
             buff = _np.full((buckets[buck],), invalid_label, dtype=dtype)
             buff[:len(sent)] = sent
             self.data[buck].append(buff)
-        self.data = [_np.asarray(i, dtype=dtype) for i in self.data]
+        # reshape keeps empty buckets 2-D so reset()'s label shift works
+        self.data = [_np.asarray(i, dtype=dtype).reshape(-1, b)
+                     for i, b in zip(self.data, buckets)]
         self.batch_size = batch_size
         self.buckets = buckets
         self.data_name = data_name

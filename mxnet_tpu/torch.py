@@ -1,0 +1,67 @@
+"""Torch function bridge (parity: python/mxnet/torch.py + plugin/torch —
+the reference exposed Torch7 tensor math on NDArrays).
+
+Modernized: wraps `torch` (CPU build) callables so they consume/produce
+`mxnet_tpu.NDArray` via zero-copy-ish numpy interchange.  Device math
+belongs in the native op set; this bridge is the escape hatch for running
+torch-only routines inside an mxnet_tpu program, mirroring how the torch
+plugin let MXNet users borrow Torch ops.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any
+
+import numpy as _np
+
+from .base import MXNetError
+from .ndarray import NDArray, array
+
+
+def _to_torch(x):
+    import torch as _t
+    if isinstance(x, NDArray):
+        return _t.from_numpy(_np.ascontiguousarray(x.asnumpy()))
+    if isinstance(x, (list, tuple)):
+        return type(x)(_to_torch(v) for v in x)
+    return x
+
+
+def _from_torch(x, ctx=None):
+    import torch as _t
+    if isinstance(x, _t.Tensor):
+        return array(x.detach().cpu().numpy(), ctx=ctx)
+    if isinstance(x, (list, tuple)):
+        return type(x)(_from_torch(v, ctx) for v in x)
+    return x
+
+
+def wrap(fn) -> Any:
+    """Wrap a torch callable to take/return NDArrays.
+
+        relu = mx.torch.wrap(torch.nn.functional.relu)
+        y = relu(mx.nd.array([-1.0, 2.0]))
+    """
+    @functools.wraps(fn)
+    def wrapped(*args, **kwargs):
+        ctx = next((a.context for a in args if isinstance(a, NDArray)), None)
+        t_args = [_to_torch(a) for a in args]
+        t_kwargs = {k: _to_torch(v) for k, v in kwargs.items()}
+        out = fn(*t_args, **t_kwargs)
+        return _from_torch(out, ctx)
+
+    return wrapped
+
+
+def __getattr__(name):
+    """mx.torch.<fn> resolves torch.<fn> lazily and wraps it."""
+    if name.startswith("__"):  # keep hasattr/introspection contracts intact
+        raise AttributeError(name)
+    try:
+        import torch as _t
+    except ImportError as e:  # torch absent: bridge degrades gracefully
+        raise AttributeError(f"{name} (torch is not available: {e})") from None
+    target = getattr(_t, name, None)
+    if target is None or not callable(target):
+        raise AttributeError(name)
+    return wrap(target)

@@ -143,3 +143,42 @@ def test_dropout_identity_grad():
         y = nd.Dropout(x, p=0.5)
     y.backward()
     assert_almost_equal(x.grad.asnumpy(), np.ones((10, 10)))
+
+
+def test_tape_key_recycling_stress():
+    """Gradients stay correct when many intermediate NDArrays are garbage
+    collected mid-record (CPython id reuse must not alias tape keys)."""
+    import gc
+    x = nd.array(np.ones((4, 4), "f"))
+    x.attach_grad()
+    with autograd.record():
+        acc = x * 1.0
+        for i in range(50):
+            tmp = acc * 2.0
+            acc = tmp * 0.5 + x * 0.0
+            del tmp
+            if i % 7 == 0:
+                gc.collect()
+        loss = acc.sum()
+    loss.backward()
+    assert_almost_equal(x.grad.asnumpy(), np.ones((4, 4), "f"),
+                        rtol=1e-5, atol=1e-6)
+
+
+def test_view_ops_recorded():
+    """reshape/transpose/slice participate in the tape."""
+    x = nd.array(np.arange(12, dtype="f").reshape(3, 4))
+    x.attach_grad()
+    with autograd.record():
+        y = x.reshape((4, 3)).transpose()
+        loss = (y[0:2] * 2).sum() + x[1].sum() + x[:, 0:2].sum()
+    loss.backward()
+    import jax
+    import jax.numpy as jnp
+
+    def f(a):
+        yy = jnp.transpose(a.reshape(4, 3))
+        return (yy[0:2] * 2).sum() + a[1].sum() + a[:, 0:2].sum()
+
+    g_ref = np.asarray(jax.grad(f)(jnp.asarray(x.asnumpy())))
+    assert_almost_equal(x.grad.asnumpy(), g_ref, rtol=1e-5, atol=1e-6)

@@ -1,0 +1,161 @@
+"""Auxiliary-subsystem tests (parity model: test_profiler.py, test_attr.py,
+test_infer_shape.py, test_viz, monitor in the reference suite)."""
+import json
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, sym
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+# ---------------------------------------------------------------- profiler
+
+def test_profiler_chrome_trace(tmp_path):
+    fname = str(tmp_path / "profile.json")
+    mx.profiler.profiler_set_config(mode="all", filename=fname)
+    mx.profiler.profiler_set_state("run")
+    a = nd.random.uniform(shape=(64, 64))
+    b = nd.dot(a, a)
+    b.wait_to_read()
+    mx.profiler.profiler_set_state("stop")
+    mx.profiler.dump_profile()
+    with open(fname) as f:
+        trace = json.load(f)
+    events = trace["traceEvents"] if isinstance(trace, dict) else trace
+    assert len(events) > 0
+    assert any("name" in e for e in events)
+
+
+def test_profiler_pause_resume(tmp_path):
+    fname = str(tmp_path / "p.json")
+    mx.profiler.profiler_set_config(filename=fname)
+    mx.profiler.profiler_set_state("run")
+    mx.profiler.pause()
+    mx.profiler.resume()
+    mx.profiler.profiler_set_state("stop")
+
+
+# -------------------------------------------------------------- attributes
+
+def test_attr_scope_ctx_group():
+    with mx.AttrScope(ctx_group="dev1"):
+        data = sym.Variable("data")
+        fc = sym.FullyConnected(data, num_hidden=4, name="fc")
+    assert fc.attr("ctx_group") == "dev1"
+
+
+def test_attr_scope_nesting():
+    with mx.AttrScope(group="a", other="x"):
+        with mx.AttrScope(group="b"):
+            v = sym.Variable("v")
+        w = sym.Variable("w")
+    assert v.attr("group") == "b"
+    assert v.attr("other") == "x"
+    assert w.attr("group") == "a"
+
+
+def test_symbol_attr_set_get():
+    data = sym.Variable("data", shape=(3, 4))
+    data._set_attr(foo="bar")
+    assert data.attr("foo") == "bar"
+    assert data.list_attr()["foo"] == "bar"
+
+
+def test_attr_dict():
+    with mx.AttrScope(group="g"):
+        data = sym.Variable("data")
+        fc = sym.FullyConnected(data, num_hidden=2, name="fc")
+    d = fc.attr_dict()
+    assert d["fc"]["group"] == "g"
+
+
+# ------------------------------------------------------------- infer_shape
+
+def test_infer_shape_mlp():
+    data = sym.Variable("data")
+    fc = sym.FullyConnected(data, num_hidden=32, name="fc")
+    out = sym.SoftmaxOutput(fc, name="softmax")
+    arg_shapes, out_shapes, _ = out.infer_shape(data=(100, 50))
+    names = out.list_arguments()
+    d = dict(zip(names, arg_shapes))
+    assert d["fc_weight"] == (32, 50)
+    assert d["fc_bias"] == (32,)
+    assert out_shapes[0] == (100, 32)
+
+
+def test_infer_shape_partial():
+    data = sym.Variable("data")
+    prev = sym.Variable("prev")
+    fc1 = sym.FullyConnected(data, name="fc1", num_hidden=64)
+    fc2 = sym.FullyConnected(prev, name="fc2", num_hidden=64)
+    out = fc1 + fc2
+    # partial: only data known — fc1 side resolves, fc2 side stays unknown
+    arg_shapes, out_shapes, _ = out.infer_shape_partial(data=(10, 4))
+    d = dict(zip(out.list_arguments(), arg_shapes))
+    assert d["fc1_weight"] == (64, 4)
+    assert d["fc2_weight"] is None or d["fc2_weight"] == ()
+
+
+def test_infer_shape_conv_chain():
+    data = sym.Variable("data")
+    c1 = sym.Convolution(data, num_filter=8, kernel=(3, 3), pad=(1, 1))
+    p1 = sym.Pooling(c1, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    c2 = sym.Convolution(p1, num_filter=16, kernel=(3, 3))
+    _, out_shapes, _ = c2.infer_shape(data=(2, 3, 32, 32))
+    assert out_shapes[0] == (2, 16, 14, 14)
+
+
+def test_infer_shape_mismatch_raises():
+    data = sym.Variable("data")
+    fc = sym.FullyConnected(data, num_hidden=4, weight=sym.Variable("w"))
+    with pytest.raises(mx.base.MXNetError):
+        fc.infer_shape(data=(10, 5), w=(4, 99))
+
+
+# ----------------------------------------------------------------- monitor
+
+def test_monitor_taps_outputs():
+    stats = []
+    mon = mx.mon.Monitor(1, stat_func=lambda x: x.asnumpy().mean(),
+                         pattern=".*fc.*")
+    x = np.random.RandomState(0).randn(20, 4).astype("f")
+    y = np.zeros(20, "f")
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data, num_hidden=3, name="fc")
+    net = sym.SoftmaxOutput(net, name="softmax")
+    mod = mx.mod.Module(net, context=mx.cpu())
+    it = mx.io.NDArrayIter(x, y, batch_size=10)
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params()
+    mod.install_monitor(mon)
+    mon.tic()
+    mod.forward(next(iter(it)))
+    res = mon.toc()
+    assert len(res) > 0
+    assert any("fc" in name for _, name, _ in res)
+
+
+# ------------------------------------------------------------ visualization
+
+def test_print_summary(capsys):
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data, num_hidden=8, name="fc1")
+    net = sym.Activation(net, act_type="relu")
+    net = sym.FullyConnected(net, num_hidden=2, name="fc2")
+    net = sym.SoftmaxOutput(net, name="softmax")
+    mx.viz.print_summary(net, shape={"data": (1, 16)})
+    out = capsys.readouterr().out
+    assert "fc1" in out and "Total params" in out
+
+
+def test_plot_network_graphviz_or_skip():
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data, num_hidden=2, name="fc")
+    try:
+        dot = mx.viz.plot_network(net, shape={"data": (1, 4)})
+    except (ImportError, mx.base.MXNetError):
+        pytest.skip("graphviz not available")
+    assert dot is not None

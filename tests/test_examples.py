@@ -1,0 +1,196 @@
+"""Smoke tests for the example scripts and deployment surfaces (parity
+model: the reference CI runs example trainings; tests/python/train tier)."""
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.test_utils import assert_almost_equal
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+ENV = {**os.environ,
+       "JAX_PLATFORMS": "cpu",
+       "XLA_FLAGS": os.environ.get("XLA_FLAGS", "") +
+       " --xla_force_host_platform_device_count=8",
+       "PYTHONPATH": REPO}
+
+
+def run_example(rel, *args, timeout=420):
+    path = os.path.join(REPO, rel)
+    proc = subprocess.run([sys.executable, path, *args], env=ENV,
+                          cwd=os.path.dirname(path), capture_output=True,
+                          text=True, timeout=timeout)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    return proc.stdout + proc.stderr
+
+
+def test_train_mnist_mlp():
+    out = run_example("example/image-classification/train_mnist.py",
+                      "--num-epochs", "2", "--num-examples", "2000")
+    assert "Validation-accuracy" in out
+
+
+def test_train_cifar10_synthetic_resnet():
+    out = run_example("example/image-classification/train_cifar10.py",
+                      "--num-epochs", "1", "--num-examples", "256",
+                      "--batch-size", "64", "--num-layers", "8",
+                      "--benchmark", "1")
+    assert "Epoch[0]" in out
+
+
+def test_lstm_bucketing_example():
+    out = run_example("example/rnn/lstm_bucketing.py",
+                      "--num-epochs", "1", "--num-hidden", "32",
+                      "--num-embed", "32", "--num-layers", "1")
+    assert "perplexity" in out.lower() or "Epoch[0]" in out
+
+
+def test_gluon_image_classification_example():
+    out = run_example("example/gluon/image_classification.py",
+                      "--epochs", "1", "--num-examples", "128",
+                      "--model", "squeezenet1_0", "--image-size", "64")
+    assert "val-acc" in out
+
+
+def test_model_parallel_example():
+    out = run_example("example/model-parallel/model_parallel_mlp.py")
+    assert "accuracy" in out
+
+
+def test_im2rec_raw_roundtrip(tmp_path):
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import importlib
+    im2rec = importlib.import_module("im2rec")
+    # build a tiny image tree
+    rs = np.random.RandomState(0)
+    for cls in ("cat", "dog"):
+        d = tmp_path / "imgs" / cls
+        d.mkdir(parents=True)
+        for i in range(3):
+            arr = rs.randint(0, 255, (8, 8, 3)).astype("u1")
+            from mxnet_tpu.recordio import _imencode
+            (d / f"{i}.png").write_bytes(_imencode(arr, img_fmt=".png"))
+    items = im2rec.list_images(str(tmp_path / "imgs"))
+    assert len(items) == 6
+    labels = {lbl for _, lbl, _ in items}
+    assert labels == {0, 1}
+    prefix = str(tmp_path / "pack")
+    im2rec.write_list(prefix, items)
+    im2rec.pack(prefix, str(tmp_path / "imgs"), raw=True)
+    # raw records load through TensorRecordIter
+    it = mx.io.TensorRecordIter(prefix + ".rec", data_shape=(8, 8, 3),
+                                batch_size=2, dtype="uint8")
+    batch = next(iter(it))
+    assert batch.data[0].shape == (2, 8, 8, 3)
+
+
+def test_parse_log(tmp_path):
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import importlib
+    parse_log = importlib.import_module("parse_log")
+    log = tmp_path / "t.log"
+    log.write_text(
+        "INFO Epoch[0] Train-accuracy=0.5\n"
+        "INFO Epoch[0] Time cost=1.5\n"
+        "INFO Epoch[0] Validation-accuracy=0.4\n"
+        "INFO Epoch[1] Train-accuracy=0.8\n")
+    rows = parse_log.parse(str(log))
+    assert rows[0]["train_acc"] == 0.5
+    assert rows[0]["val_acc"] == 0.4
+    assert rows[1]["train_acc"] == 0.8
+
+
+def test_bandwidth_harness():
+    sys.path.insert(0, os.path.join(REPO, "tools", "bandwidth"))
+    import importlib
+    measure = importlib.import_module("measure")
+    gbps = measure.run("local", size_mb=1, num_keys=2, repeats=2)
+    assert gbps > 0
+
+
+def test_predictor_roundtrip(tmp_path):
+    """c_predict_api parity: save a trained module, reload through the
+    Predictor, logits must match."""
+    from mxnet_tpu import predictor
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=8, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=3, name="fc2")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+
+    x = np.random.RandomState(0).randn(20, 6).astype("f")
+    y = np.zeros(20, "f")
+    mod = mx.mod.Module(net, context=mx.cpu())
+    it = mx.io.NDArrayIter(x, y, batch_size=10)
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params()
+    ref = mod.predict(it).asnumpy()
+
+    prefix = str(tmp_path / "model")
+    arg_params, aux_params = mod.get_params()
+    mx.model.save_checkpoint(prefix, 0, net, arg_params, aux_params)
+
+    pred = predictor.create(prefix + "-symbol.json",
+                            prefix + "-0000.params",
+                            {"data": (10, 6)})
+    pred.set_input("data", x[:10])
+    pred.forward()
+    out = pred.get_output(0)
+    assert_almost_equal(out, ref[:10], rtol=1e-4, atol=1e-5)
+
+
+def test_launch_local(tmp_path):
+    """tools/launch.py forks N workers with the rank env contract."""
+    script = tmp_path / "worker.py"
+    # write per-rank files to avoid interleaved-stdout flakiness
+    script.write_text(
+        "import os, pathlib\n"
+        "rank = os.environ['MXT_PROC_ID']\n"
+        "pathlib.Path(f'rank{rank}.txt').write_text(\n"
+        "    f\"{rank} of {os.environ['MXT_NUM_PROC']}\")\n")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "launch.py"),
+         "-n", "2", sys.executable, str(script)],
+        env=ENV, cwd=str(tmp_path), capture_output=True, text=True,
+        timeout=120)
+    assert proc.returncode == 0, proc.stderr
+    assert (tmp_path / "rank0.txt").read_text() == "0 of 2"
+    assert (tmp_path / "rank1.txt").read_text() == "1 of 2"
+
+
+def test_dcgan_example():
+    out = run_example("example/gluon/dcgan.py", "--epochs", "1",
+                      "--num-examples", "32", "--batch-size", "16",
+                      "--ngf", "8", "--ndf", "8")
+    assert "lossD" in out
+
+
+def test_word_lm_example():
+    out = run_example("example/gluon/word_language_model.py", "--epochs", "1",
+                      "--num-hidden", "16", "--num-embed", "16",
+                      "--num-layers", "1", "--bptt", "10", timeout=420)
+    assert "perplexity" in out
+
+
+def test_ssd_example():
+    out = run_example("example/ssd/train_ssd.py", "--epochs", "1",
+                      "--batches-per-epoch", "4", "--batch-size", "8")
+    assert "detections kept" in out
+
+
+def test_torch_bridge():
+    pytest.importorskip("torch")
+    from mxnet_tpu import torch as mxt
+    x = nd.array(np.array([-1.0, 0.5, 2.0], "f"))
+    y = mxt.relu(x)
+    assert isinstance(y, nd.NDArray)
+    assert_almost_equal(y.asnumpy(), np.array([0.0, 0.5, 2.0], "f"))
+    import torch as t
+    mm = mxt.wrap(t.mm)
+    a = nd.array(np.eye(3, dtype="f") * 2)
+    out = mm(a, a)
+    assert_almost_equal(out.asnumpy(), np.eye(3, dtype="f") * 4)

@@ -375,3 +375,17 @@ def test_block_grad_and_make_loss():
         y = nd.BlockGrad(x) * 3 + x
     y.backward()
     assert_almost_equal(x.grad.asnumpy(), np.ones(2))
+
+
+def test_multibox_float_params():
+    """Float tuple params (sizes/ratios/variances) survive canonization —
+    regression: 'shape'-typed coercion truncated 0.2 -> 0."""
+    from mxnet_tpu import nd
+    feat = nd.random.uniform(shape=(1, 4, 4, 4))
+    anc = nd.MultiBoxPrior(feat, sizes=(0.2, 0.35), ratios=(1.0, 2.0, 0.5),
+                           clip=True)
+    a = anc.asnumpy()[0]
+    assert a.shape == (4 * 4 * 4, 4)
+    w = a[:, 2] - a[:, 0]
+    assert (w > 0.05).all()  # sizes kept as floats, not truncated to 0
+    assert np.unique(np.round(w, 3)).size >= 3  # distinct anchor widths

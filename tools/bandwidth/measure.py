@@ -1,0 +1,59 @@
+#!/usr/bin/env python
+"""KVStore communication bandwidth harness (behavioral parity:
+tools/bandwidth/measure.py — GB/s of push+pull per kvstore type).
+
+    python tools/bandwidth/measure.py --kv-store local --size-mb 64
+On a mesh this measures the XLA all-reduce path that KVStore('tpu_sync')
+push/pull lowers to.
+"""
+import argparse
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def run(kv_type="local", size_mb=64, num_keys=8, repeats=10, num_devs=1):
+    kv = mx.kv.create(kv_type)
+    elems = int(size_mb * 1e6 / 4 / num_keys)
+    shapes = [(elems,)] * num_keys
+    keys = list(range(num_keys))
+    vals = [[nd.ones(s) for _ in range(num_devs)] for s in shapes]
+    outs = [[nd.empty(s) for _ in range(num_devs)] for s in shapes]
+    for k, s in zip(keys, shapes):
+        kv.init(k, nd.zeros(s))
+    # warmup
+    kv.push(keys, vals)
+    kv.pull(keys, out=outs)
+    for o in outs:
+        o[0].wait_to_read()
+    tic = time.time()
+    for _ in range(repeats):
+        kv.push(keys, vals)
+        kv.pull(keys, out=outs)
+    for o in outs:
+        o[0].wait_to_read()
+    dt = time.time() - tic
+    moved = 2 * size_mb * repeats * max(num_devs, 1) / 1e3  # GB pushed+pulled
+    print(f"kvstore={kv_type} size={size_mb}MB devs={num_devs} "
+          f"{moved / dt:.2f} GB/s ({dt / repeats * 1e3:.1f} ms/iter)")
+    return moved / dt
+
+
+if __name__ == "__main__":
+    p = argparse.ArgumentParser()
+    p.add_argument("--kv-store", type=str, default="local")
+    p.add_argument("--size-mb", type=float, default=64)
+    p.add_argument("--num-keys", type=int, default=8)
+    p.add_argument("--repeats", type=int, default=10)
+    p.add_argument("--num-devs", type=int, default=1)
+    args = p.parse_args()
+    run(args.kv_store, args.size_mb, args.num_keys, args.repeats,
+        args.num_devs)

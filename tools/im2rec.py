@@ -1,0 +1,119 @@
+#!/usr/bin/env python
+"""Pack a dataset into .rec/.idx recordio files (behavioral parity:
+tools/im2rec.py — list generation + image packing).
+
+Two modes:
+  list:  python tools/im2rec.py --list prefix image_root
+         writes prefix.lst as "index\\tlabel\\trelpath" (labels from
+         subdirectory order, like the reference's --recursive).
+  pack:  python tools/im2rec.py prefix image_root [--resize N] [--quality Q]
+         reads prefix.lst and writes prefix.rec/prefix.idx.  JPEG encoding
+         uses the image module's codec; with --raw, arrays are stored
+         uncompressed for TensorRecordIter's zero-decode fast path.
+"""
+import argparse
+import os
+import random
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
+import mxnet_tpu as mx
+from mxnet_tpu import recordio
+
+
+IMG_EXTS = (".jpg", ".jpeg", ".png", ".bmp")
+
+
+def list_images(root):
+    cat = {}
+    items = []
+    i = 0
+    for path, dirs, files in sorted(os.walk(root, followlinks=True)):
+        dirs.sort()
+        files.sort()
+        for fname in files:
+            if os.path.splitext(fname)[1].lower() not in IMG_EXTS:
+                continue
+            label_dir = os.path.relpath(path, root).split(os.sep)[0]
+            if label_dir not in cat:
+                cat[label_dir] = len(cat)
+            rel = os.path.relpath(os.path.join(path, fname), root)
+            items.append((i, cat[label_dir], rel))
+            i += 1
+    return items
+
+
+def write_list(prefix, items, shuffle=False, train_ratio=1.0):
+    if shuffle:
+        random.shuffle(items)
+    n_train = int(len(items) * train_ratio)
+    chunks = [(prefix + ".lst", items[:n_train])]
+    if train_ratio < 1.0:
+        chunks.append((prefix + "_val.lst", items[n_train:]))
+    for fname, chunk in chunks:
+        with open(fname, "w") as f:
+            for i, label, rel in chunk:
+                f.write(f"{i}\t{label}\t{rel}\n")
+
+
+def read_list(lst_path):
+    with open(lst_path) as f:
+        for line in f:
+            parts = line.strip().split("\t")
+            if len(parts) < 3:
+                continue
+            yield int(parts[0]), [float(x) for x in parts[1:-1]], parts[-1]
+
+
+def pack(prefix, root, resize=0, quality=95, raw=False, color=1):
+    from mxnet_tpu import image as mx_image
+    rec = recordio.MXIndexedRecordIO(prefix + ".idx", prefix + ".rec", "w")
+    count = 0
+    for idx, labels, rel in read_list(prefix + ".lst"):
+        path = os.path.join(root, rel)
+        try:
+            img = mx_image.imread(path, to_rgb=True)
+        except Exception as e:
+            print(f"skip unreadable {path}: {e}")
+            continue
+        if resize:
+            img = mx_image.resize_short(img, resize)
+        img = img.asnumpy() if hasattr(img, "asnumpy") else img
+        label = labels[0] if len(labels) == 1 else np.asarray(labels, "f")
+        header = recordio.IRHeader(0, label, idx, 0)
+        if raw:
+            payload = np.ascontiguousarray(img, dtype=np.uint8).tobytes()
+            s = recordio.pack(header, payload)
+        else:
+            s = recordio.pack_img(header, img, quality=quality)
+        rec.write_idx(idx, s)
+        count += 1
+        if count % 1000 == 0:
+            print(f"packed {count} images")
+    rec.close()
+    print(f"wrote {count} records to {prefix}.rec")
+
+
+if __name__ == "__main__":
+    p = argparse.ArgumentParser(description="make image record files")
+    p.add_argument("prefix", help="prefix of .lst/.rec files")
+    p.add_argument("root", help="image root folder")
+    p.add_argument("--list", action="store_true",
+                   help="make the .lst instead of packing")
+    p.add_argument("--shuffle", action="store_true")
+    p.add_argument("--train-ratio", type=float, default=1.0)
+    p.add_argument("--resize", type=int, default=0,
+                   help="resize shorter edge to this")
+    p.add_argument("--quality", type=int, default=95)
+    p.add_argument("--raw", action="store_true",
+                   help="store raw uint8 tensors (TensorRecordIter fast path)")
+    args = p.parse_args()
+    if args.list:
+        write_list(args.prefix, list_images(args.root), args.shuffle,
+                   args.train_ratio)
+    else:
+        pack(args.prefix, args.root, args.resize, args.quality, args.raw)

@@ -1,0 +1,63 @@
+#!/usr/bin/env python
+"""Multi-process SPMD job launcher (behavioral parity: tools/launch.py +
+dmlc_tracker — but redesigned for jax.distributed instead of ps-lite).
+
+The reference spawned scheduler + server + worker processes wired over
+ZMQ.  On TPU pods there are no servers: every process is an SPMD worker
+that joins a `jax.distributed` cluster (coordinator = process 0) and the
+collectives ride ICI/DCN.  This launcher covers the reference's
+`--launcher local` development mode by forking N workers on one host;
+real pods launch one process per host through the TPU runtime, with the
+same env contract (MXT_COORDINATOR, MXT_NUM_PROC, MXT_PROC_ID).
+
+    python tools/launch.py -n 4 python train.py --kv-store dist_sync
+"""
+import argparse
+import os
+import signal
+import subprocess
+import sys
+
+
+def main():
+    p = argparse.ArgumentParser(description="launch an SPMD training job")
+    p.add_argument("-n", "--num-workers", type=int, required=True,
+                   help="number of worker processes")
+    p.add_argument("--launcher", type=str, default="local",
+                   choices=["local"],
+                   help="local = fork on this host (dev mode); pods launch "
+                        "per-host processes through the TPU runtime")
+    p.add_argument("--coordinator", type=str, default="127.0.0.1:8431",
+                   help="jax.distributed coordinator address")
+    p.add_argument("command", nargs=argparse.REMAINDER,
+                   help="the command to launch")
+    args = p.parse_args()
+    if not args.command:
+        p.error("no command given")
+
+    procs = []
+    try:
+        for rank in range(args.num_workers):
+            env = dict(os.environ)
+            env["MXT_COORDINATOR"] = args.coordinator
+            env["MXT_NUM_PROC"] = str(args.num_workers)
+            env["MXT_PROC_ID"] = str(rank)
+            # reference-compatible aliases (fit.py logs rank from kvstore)
+            env["DMLC_ROLE"] = "worker"
+            env["DMLC_NUM_WORKER"] = str(args.num_workers)
+            procs.append(subprocess.Popen(args.command, env=env))
+        code = 0
+        for proc in procs:
+            proc.wait()
+            code = code or proc.returncode
+        sys.exit(code)
+    except KeyboardInterrupt:
+        for proc in procs:
+            proc.send_signal(signal.SIGINT)
+        for proc in procs:
+            proc.wait()
+        raise
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,48 @@
+#!/usr/bin/env python
+"""Parse training logs into a table (behavioral parity: tools/parse_log.py).
+
+    python tools/parse_log.py train.log [--format markdown|csv]
+Extracts per-epoch train/validation accuracy and time cost from the
+`fit.py` log format ("Epoch[N] Train-accuracy=..", "Validation-accuracy=..",
+"Time cost=..").
+"""
+import argparse
+import re
+import sys
+
+
+def parse(fname):
+    rows = {}
+    patterns = {
+        "train_acc": re.compile(r"Epoch\[(\d+)\].*Train-accuracy=([\d.]+)"),
+        "val_acc": re.compile(r"Epoch\[(\d+)\].*Validation-accuracy=([\d.]+)"),
+        "time": re.compile(r"Epoch\[(\d+)\].*Time cost=([\d.]+)"),
+    }
+    with open(fname) as f:
+        for line in f:
+            for key, pat in patterns.items():
+                m = pat.search(line)
+                if m:
+                    epoch = int(m.group(1))
+                    rows.setdefault(epoch, {})[key] = float(m.group(2))
+    return rows
+
+
+if __name__ == "__main__":
+    p = argparse.ArgumentParser()
+    p.add_argument("logfile")
+    p.add_argument("--format", default="markdown",
+                   choices=["markdown", "csv"])
+    args = p.parse_args()
+    rows = parse(args.logfile)
+    if args.format == "markdown":
+        print("| epoch | train-accuracy | valid-accuracy | time |")
+        print("| --- | --- | --- | --- |")
+        fmt = "| {} | {} | {} | {} |"
+    else:
+        print("epoch,train-accuracy,valid-accuracy,time")
+        fmt = "{},{},{},{}"
+    for epoch in sorted(rows):
+        r = rows[epoch]
+        print(fmt.format(epoch, r.get("train_acc", ""),
+                         r.get("val_acc", ""), r.get("time", "")))
