@@ -1,0 +1,76 @@
+#!/usr/bin/env python
+"""Matrix factorization recommender (behavioral parity:
+example/recommenders + example/model-parallel/matrix_factorization —
+user/item embeddings trained with an L2 rating loss).
+
+    python example/recommenders/matrix_factorization.py --epochs 5
+Generates a synthetic low-rank rating matrix when no dataset is given.
+"""
+import argparse
+import logging
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import mxnet_tpu as mx
+
+logging.basicConfig(level=logging.INFO)
+
+
+def build_net(num_users, num_items, factor_size):
+    user = mx.sym.Variable("user")
+    item = mx.sym.Variable("item")
+    score = mx.sym.Variable("score_label")
+    u = mx.sym.Embedding(user, input_dim=num_users, output_dim=factor_size,
+                         name="user_embed")
+    v = mx.sym.Embedding(item, input_dim=num_items, output_dim=factor_size,
+                         name="item_embed")
+    pred = mx.sym.sum(u * v, axis=1)
+    return mx.sym.LinearRegressionOutput(pred, score, name="score")
+
+
+def synthetic_ratings(num_users, num_items, rank, n, seed=0):
+    rs = np.random.RandomState(seed)
+    U = rs.normal(0, 1, (num_users, rank)).astype("f")
+    V = rs.normal(0, 1, (num_items, rank)).astype("f")
+    users = rs.randint(0, num_users, n)
+    items = rs.randint(0, num_items, n)
+    ratings = (U[users] * V[items]).sum(1) + rs.normal(0, 0.05, n)
+    return users.astype("f"), items.astype("f"), ratings.astype("f")
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--epochs", type=int, default=5)
+    p.add_argument("--batch-size", type=int, default=256)
+    p.add_argument("--factor-size", type=int, default=8)
+    p.add_argument("--num-users", type=int, default=500)
+    p.add_argument("--num-items", type=int, default=300)
+    p.add_argument("--num-samples", type=int, default=20000)
+    p.add_argument("--lr", type=float, default=0.02)
+    args = p.parse_args()
+
+    users, items, ratings = synthetic_ratings(
+        args.num_users, args.num_items, args.factor_size, args.num_samples)
+    it = mx.io.NDArrayIter({"user": users, "item": items},
+                           {"score_label": ratings},
+                           batch_size=args.batch_size, shuffle=True,
+                           label_name="score_label")
+    net = build_net(args.num_users, args.num_items, args.factor_size)
+    mod = mx.mod.Module(net, data_names=("user", "item"),
+                        label_names=("score_label",), context=mx.cpu())
+    mod.fit(it, num_epoch=args.epochs, optimizer="adam",
+            optimizer_params={"learning_rate": args.lr},
+            initializer=mx.init.Normal(0.1),
+            eval_metric="rmse",
+            batch_end_callback=mx.callback.Speedometer(args.batch_size, 40))
+    score = mod.score(it, "rmse")
+    logging.info("final RMSE: %.4f", score[0][1])
+
+
+if __name__ == "__main__":
+    main()

@@ -61,3 +61,4 @@ from . import image
 from . import rtc
 from . import contrib
 from . import predictor
+from . import export

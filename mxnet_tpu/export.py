@@ -1,0 +1,120 @@
+"""Ahead-of-time model export (parity role: `amalgamation/` + the predict
+C API deployment story — `include/mxnet/c_predict_api.h`).
+
+The reference shipped models to phones by amalgamating the runtime into one
+C file and loading symbol JSON + params.  The TPU-native deployment artifact
+is a serialized StableHLO program: `export_model` traces a bound model
+(symbol + params) once and serializes it with `jax.export`; `load_model`
+deserializes and runs it on any host with jax — no framework code needed at
+serving time.  Together with `mxnet_tpu.predictor` this covers both of the
+reference's deployment surfaces.
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, Optional, Sequence
+
+import numpy as _np
+
+from .base import MXNetError
+from . import ndarray as nd
+from .ndarray import NDArray
+from . import symbol as sym_mod
+
+
+def export_model(symbol, arg_params: Dict, aux_params: Dict,
+                 input_shapes: Dict[str, tuple], path: str,
+                 input_dtypes: Optional[Dict[str, str]] = None) -> None:
+    """Serialize symbol+params into `path` (a directory):
+    `program.shlo` (StableHLO bytes), `params.nd`, `meta.json`."""
+    import jax
+    import jax.numpy as jnp
+    from jax import export as jexport
+    from .symbol.graph import GraphPlan
+
+    plan = GraphPlan(symbol)
+    plan.specialize_init_shapes(dict(input_shapes))
+    params = {k: (v._data if isinstance(v, NDArray) else jnp.asarray(v))
+              for k, v in arg_params.items()}
+    auxs = {k: (v._data if isinstance(v, NDArray) else jnp.asarray(v))
+            for k, v in aux_params.items()}
+    input_names = sorted(input_shapes)
+    key = jax.random.PRNGKey(0)
+
+    def fn(*inputs):
+        d = dict(params)
+        d.update(dict(zip(input_names, inputs)))
+        outs, _ = plan.run(d, auxs, key, False)
+        return tuple(outs)
+
+    dtypes = input_dtypes or {}
+    args = [jax.ShapeDtypeStruct(tuple(input_shapes[n]),
+                                 _np.dtype(dtypes.get(n, "float32")))
+            for n in input_names]
+    exported = jexport.export(jax.jit(fn))(*args)
+    os.makedirs(path, exist_ok=True)
+    with open(os.path.join(path, "program.shlo"), "wb") as f:
+        f.write(exported.serialize())
+    nd.save(os.path.join(path, "params.nd"),
+            {f"arg:{k}": NDArray(v) for k, v in params.items()} |
+            {f"aux:{k}": NDArray(v) for k, v in auxs.items()})
+    with open(os.path.join(path, "meta.json"), "w") as f:
+        json.dump({"input_names": input_names,
+                   "input_shapes": {k: list(v) for k, v in input_shapes.items()},
+                   "outputs": symbol.list_outputs()}, f)
+    symbol.save(os.path.join(path, "symbol.json"))
+
+
+class ExportedModel:
+    """Runs a serialized program; params are baked into the export."""
+
+    def __init__(self, path: str):
+        from jax import export as jexport
+        with open(os.path.join(path, "program.shlo"), "rb") as f:
+            self._exported = jexport.deserialize(f.read())
+        with open(os.path.join(path, "meta.json")) as f:
+            self.meta = json.load(f)
+        self.input_names = self.meta["input_names"]
+
+    def __call__(self, *inputs, **named):
+        import jax.numpy as jnp
+        if named:
+            if inputs:
+                raise MXNetError(
+                    "pass inputs either positionally (in input_names order) "
+                    "or all by name, not both")
+            missing = [n for n in self.input_names if n not in named]
+            if missing:
+                raise MXNetError(f"missing inputs {missing}; expected "
+                                 f"{self.input_names}")
+            inputs = [named[n] for n in self.input_names]
+        vals = [a._data if isinstance(a, NDArray) else jnp.asarray(a)
+                for a in inputs]
+        outs = self._exported.call(*vals)
+        return [NDArray(o) for o in outs]
+
+
+def load_model(path: str) -> ExportedModel:
+    return ExportedModel(path)
+
+
+def export_checkpoint(prefix: str, epoch: int,
+                      input_shapes: Dict[str, tuple], path: str) -> None:
+    """Convenience: export straight from a Module checkpoint
+    (prefix-symbol.json + prefix-%04d.params)."""
+    from . import model as model_mod
+    symbol, arg_params, aux_params = model_mod.load_checkpoint(prefix, epoch)
+    # label inputs aren't serving inputs: bind them as zero constants
+    arg_names = symbol.list_arguments()
+    missing = [n for n in arg_names
+               if n not in arg_params and n not in input_shapes]
+    if missing:
+        arg_shapes, _, _ = symbol.infer_shape_partial(**input_shapes)
+        inferred = dict(zip(arg_names, arg_shapes or []))
+        for name in missing:
+            shp = inferred.get(name)
+            if shp is None:
+                raise MXNetError(f"cannot infer shape for input '{name}'")
+            arg_params[name] = nd.zeros(shp)
+    export_model(symbol, arg_params, aux_params, input_shapes, path)

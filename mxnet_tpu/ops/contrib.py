@@ -282,3 +282,227 @@ def _multibox_detection(p, cls_prob, loc_pred, anchor):
 
     return jax.vmap(per_sample)(cls_prob, loc_pred.reshape(
         cls_prob.shape[0], -1))
+
+
+# ---------------------------------------------------------------------------
+# RPN proposals (parity: src/operator/contrib/proposal.cc / multi_proposal.cc)
+# ---------------------------------------------------------------------------
+def _gen_base_anchors(scales, ratios, base_size):
+    """Anchors centered at (base/2, base/2), corner format, in pixels."""
+    anchors = []
+    cx = cy = (base_size - 1) / 2.0
+    area = float(base_size * base_size)
+    for r in ratios:
+        w = round((area / r) ** 0.5)
+        h = round(w * r)
+        for s in scales:
+            ws, hs = w * s, h * s
+            anchors.append([cx - (ws - 1) / 2, cy - (hs - 1) / 2,
+                            cx + (ws - 1) / 2, cy + (hs - 1) / 2])
+    return jnp.asarray(anchors, jnp.float32)
+
+
+@register("_contrib_Proposal", input_names=("cls_prob", "bbox_pred", "im_info"),
+          aliases=("Proposal", "_contrib_MultiProposal", "MultiProposal"),
+          differentiable=False,
+          args=[Arg("rpn_pre_nms_top_n", int, 6000),
+                Arg("rpn_post_nms_top_n", int, 300),
+                Arg("threshold", float, 0.7),
+                Arg("rpn_min_size", int, 16),
+                Arg("scales", "floats", (4.0, 8.0, 16.0, 32.0)),
+                Arg("ratios", "floats", (0.5, 1.0, 2.0)),
+                Arg("feature_stride", int, 16),
+                Arg("output_score", bool, False),
+                Arg("iou_loss", bool, False)])
+def _proposal(p, cls_prob, bbox_pred, im_info):
+    """RPN proposal generation (parity: proposal.cc behavior): decode
+    per-anchor bbox deltas, clip to image, filter small boxes, NMS, take
+    top-k.  Static shapes: output (N * post_nms_top_n, 5) rois
+    [batch_idx, x1, y1, x2, y2], padded by repeating the best roi."""
+    N, _, H, W = cls_prob.shape
+    stride = p["feature_stride"]
+    base = _gen_base_anchors(p["scales"], p["ratios"], stride)  # (A,4)
+    A = base.shape[0]
+    shift_x = jnp.arange(W) * stride
+    shift_y = jnp.arange(H) * stride
+    sx, sy = jnp.meshgrid(shift_x, shift_y)  # (H,W)
+    shifts = jnp.stack([sx, sy, sx, sy], axis=-1).reshape(-1, 4)
+    anchors = (base[None, :, :] + shifts[:, None, :]).reshape(-1, 4)  # (H*W*A,4)
+    K = anchors.shape[0]
+    pre_n = min(p["rpn_pre_nms_top_n"], K)
+    post_n = p["rpn_post_nms_top_n"]
+
+    def per_image(scores_hw, deltas_hw, info):
+        # scores: (2A,H,W) → fg scores (A,H,W) → (H*W*A,)
+        fg = scores_hw[A:].transpose(1, 2, 0).reshape(-1)
+        d = deltas_hw.transpose(1, 2, 0).reshape(-1, 4)  # (H*W*A,4)
+        aw = anchors[:, 2] - anchors[:, 0] + 1
+        ah = anchors[:, 3] - anchors[:, 1] + 1
+        acx = anchors[:, 0] + 0.5 * (aw - 1)
+        acy = anchors[:, 1] + 0.5 * (ah - 1)
+        cx = d[:, 0] * aw + acx
+        cy = d[:, 1] * ah + acy
+        w = jnp.exp(jnp.clip(d[:, 2], -10, 10)) * aw
+        h = jnp.exp(jnp.clip(d[:, 3], -10, 10)) * ah
+        x1 = jnp.clip(cx - 0.5 * (w - 1), 0, info[1] - 1)
+        y1 = jnp.clip(cy - 0.5 * (h - 1), 0, info[0] - 1)
+        x2 = jnp.clip(cx + 0.5 * (w - 1), 0, info[1] - 1)
+        y2 = jnp.clip(cy + 0.5 * (h - 1), 0, info[0] - 1)
+        boxes = jnp.stack([x1, y1, x2, y2], axis=-1)
+        min_size = p["rpn_min_size"] * info[2]
+        valid = ((x2 - x1 + 1) >= min_size) & ((y2 - y1 + 1) >= min_size)
+        fg = jnp.where(valid, fg, -1.0)
+        order = jnp.argsort(-fg)[:pre_n]
+        boxes_s = boxes[order]
+        score_s = fg[order]
+        alive = score_s > -1.0
+
+        def iou_pixel(a, b):
+            # proposal.cc integer-pixel convention: width = x2 - x1 + 1
+            ix1 = jnp.maximum(a[..., 0], b[..., 0])
+            iy1 = jnp.maximum(a[..., 1], b[..., 1])
+            ix2 = jnp.minimum(a[..., 2], b[..., 2])
+            iy2 = jnp.minimum(a[..., 3], b[..., 3])
+            inter = jnp.maximum(ix2 - ix1 + 1, 0) * \
+                jnp.maximum(iy2 - iy1 + 1, 0)
+            area_a = (a[..., 2] - a[..., 0] + 1) * (a[..., 3] - a[..., 1] + 1)
+            area_b = (b[..., 2] - b[..., 0] + 1) * (b[..., 3] - b[..., 1] + 1)
+            return inter / jnp.maximum(area_a + area_b - inter, 1e-10)
+
+        def body(i, alive):
+            ious = iou_pixel(boxes_s[i][None], boxes_s)
+            sup = (ious > p["threshold"]) & (jnp.arange(pre_n) > i) & alive[i]
+            return alive & ~sup
+
+        alive = lax.fori_loop(0, pre_n, body, alive)
+        rank = jnp.where(alive, jnp.arange(pre_n), pre_n)
+        keep = jnp.argsort(rank)[:post_n]
+        kept_boxes = boxes_s[keep]
+        kept_scores = jnp.where(alive[keep], score_s[keep], 0.0)
+        # pad slots past the kept count with the top roi (reference pads too)
+        pad_mask = (jnp.arange(post_n) < alive.sum())[:, None]
+        kept_boxes = jnp.where(pad_mask, kept_boxes, kept_boxes[0])
+        return kept_boxes, kept_scores
+
+    boxes, scores = jax.vmap(per_image)(cls_prob, bbox_pred, im_info)
+    batch_idx = jnp.repeat(jnp.arange(N, dtype=jnp.float32), post_n)
+    rois = jnp.concatenate([batch_idx[:, None],
+                            boxes.reshape(-1, 4)], axis=1)
+    if p["output_score"]:
+        return rois, scores.reshape(-1, 1)
+    return rois
+
+
+# ---------------------------------------------------------------------------
+# Deformable ops (parity: src/operator/contrib/deformable_convolution.cc,
+# deformable_psroi_pooling.cc) — bilinear sampling via map_coordinates
+# ---------------------------------------------------------------------------
+@register("_contrib_DeformableConvolution",
+          input_names=("data", "offset", "weight", "bias"),
+          aliases=("DeformableConvolution",),
+          args=[Arg("kernel", "shape", required=True),
+                Arg("stride", "shape", (1, 1)), Arg("dilate", "shape", (1, 1)),
+                Arg("pad", "shape", (0, 0)), Arg("num_filter", int, required=True),
+                Arg("num_group", int, 1), Arg("num_deformable_group", int, 1),
+                Arg("no_bias", bool, False)])
+def _deformable_conv(p, data, offset, weight, bias=None):
+    """Deformable conv v1: per-position sampling offsets bend the kernel
+    grid; bilinear-sampled columns contract with the weight on the MXU."""
+    kh, kw = p["kernel"]
+    sh, sw = p["stride"] or (1, 1)
+    dh, dw = p["dilate"] or (1, 1)
+    ph, pw = p["pad"] or (0, 0)
+    N, C, H, W = data.shape
+    G = p["num_deformable_group"]
+    Ho = (H + 2 * ph - dh * (kh - 1) - 1) // sh + 1
+    Wo = (W + 2 * pw - dw * (kw - 1) - 1) // sw + 1
+
+    def sample_image(img, off):
+        # img: (C,H,W); off: (2*G*kh*kw, Ho, Wo) with the reference's
+        # interleaved layout: channel 2*(i*kw+j) = y, 2*(i*kw+j)+1 = x
+        # (deformable_im2col convention)
+        off = off.reshape(G, kh * kw, 2, Ho, Wo)
+        from jax.scipy.ndimage import map_coordinates
+
+        def sample_channel(ch_img, oy, ox):
+            yy = (jnp.arange(Ho)[None, None, :, None] * sh - ph +
+                  jnp.arange(kh)[:, None, None, None] * dh + oy)
+            xx = (jnp.arange(Wo)[None, None, None, :] * sw - pw +
+                  jnp.arange(kw)[None, :, None, None] * dw + ox)
+            samp = map_coordinates(ch_img, [yy.reshape(-1), xx.reshape(-1)],
+                                   order=1, mode="constant", cval=0.0)
+            return samp.reshape(kh, kw, Ho, Wo)
+
+        per_g = C // G
+        groups = []
+        for g in range(G):  # G is small; channels within a group vmap
+            oy = off[g, :, 0].reshape(kh, kw, Ho, Wo)
+            ox = off[g, :, 1].reshape(kh, kw, Ho, Wo)
+            block = img[g * per_g:(g + 1) * per_g]
+            groups.append(jax.vmap(sample_channel, in_axes=(0, None, None))(
+                block, oy, ox))
+        return jnp.concatenate(groups)  # (C,kh,kw,Ho,Wo)
+
+    cols = jax.vmap(sample_image)(data, offset)  # (N,C,kh,kw,Ho,Wo)
+    ng = p["num_group"]
+    Cg = C // ng
+    Fg = p["num_filter"] // ng
+    cols = cols.reshape(N, ng, Cg, kh, kw, Ho, Wo)
+    wgt = weight.reshape(ng, Fg, Cg, kh, kw)
+    out = jnp.einsum("ngcijhw,gfcij->ngfhw", cols, wgt)
+    out = out.reshape(N, p["num_filter"], Ho, Wo)
+    if not p["no_bias"] and bias is not None:
+        out = out + bias[None, :, None, None]
+    return out
+
+
+@register("_contrib_PSROIPooling", input_names=("data", "rois"),
+          aliases=("PSROIPooling",),
+          args=[Arg("spatial_scale", float, required=True),
+                Arg("output_dim", int, required=True),
+                Arg("pooled_size", int, required=True),
+                Arg("group_size", int, 0)])
+def _psroi_pooling(p, data, rois):
+    """Position-sensitive ROI pooling (R-FCN): score-map channel
+    (ctop*gs+gh)*gs+gw selected per output cell (gh/gw = the cell's group),
+    average-pooled within each bin; differentiable through the bilinear
+    sampling (the reference implements an explicit backward)."""
+    k = p["pooled_size"]
+    D = p["output_dim"]
+    gs = p["group_size"] or k
+    scale = p["spatial_scale"]
+    N, C, H, W = data.shape
+
+    def per_roi(roi):
+        b = roi[0].astype(jnp.int32)
+        x1, y1, x2, y2 = roi[1] * scale, roi[2] * scale, \
+            roi[3] * scale, roi[4] * scale
+        rw = jnp.maximum(x2 - x1, 0.1)
+        rh = jnp.maximum(y2 - y1, 0.1)
+        bin_w, bin_h = rw / k, rh / k
+        S = 4  # samples per bin edge
+        ys = y1 + (jnp.arange(k)[:, None] + (jnp.arange(S)[None, :] + 0.5) / S) * bin_h
+        xs = x1 + (jnp.arange(k)[:, None] + (jnp.arange(S)[None, :] + 0.5) / S) * bin_w
+        yy = jnp.clip(ys, 0, H - 1)
+        xx = jnp.clip(xs, 0, W - 1)
+        from jax.scipy.ndimage import map_coordinates
+        img = data[b]  # (C,H,W)
+
+        def pool_channel(d):
+            # channel for output d, cell (i,j): group (gh,gw) = bucketed
+            # cell position; ch = (d*gs + gh)*gs + gw (psroi_pooling.cc)
+            def cell(i, j):
+                gh = i * gs // k
+                gw = j * gs // k
+                ch = (d * gs + gh) * gs + gw
+                grid_y = jnp.repeat(yy[i], S)
+                grid_x = jnp.tile(xx[j], S)
+                vals = map_coordinates(img[ch], [grid_y, grid_x], order=1,
+                                       mode="nearest")
+                return vals.mean()
+            return jnp.stack([jnp.stack([cell(i, j) for j in range(k)])
+                              for i in range(k)])
+
+        return jnp.stack([pool_channel(d) for d in range(D)])  # (D,k,k)
+
+    return jax.vmap(per_roi)(rois)

@@ -194,3 +194,47 @@ def test_torch_bridge():
     a = nd.array(np.eye(3, dtype="f") * 2)
     out = mm(a, a)
     assert_almost_equal(out.asnumpy(), np.eye(3, dtype="f") * 4)
+
+
+def test_aot_export_roundtrip(tmp_path):
+    """amalgamation-analog deployment: serialize StableHLO, reload, logits
+    match the live module."""
+    from mxnet_tpu import export as mexport
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=4, name="fc")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    x = np.random.RandomState(0).randn(5, 3).astype("f")
+    mod = mx.mod.Module(net, context=mx.cpu())
+    it = mx.io.NDArrayIter(x, np.zeros(5, "f"), batch_size=5)
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params()
+    ref = mod.predict(it).asnumpy()
+    arg_params, aux_params = mod.get_params()
+    prefix = str(tmp_path / "m")
+    mx.model.save_checkpoint(prefix, 0, net, arg_params, aux_params)
+    mexport.export_checkpoint(prefix, 0, {"data": (5, 3)},
+                              str(tmp_path / "aot"))
+    m = mexport.load_model(str(tmp_path / "aot"))
+    out = m(x)[0].asnumpy()
+    assert_almost_equal(out, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_rtc_pallas_module():
+    """RTC analog: runtime-compile a user kernel from source."""
+    mod = mx.rtc.PallasModule("""
+import jax.numpy as jnp
+
+def axpy(a, x, y):
+    return a * x + y
+""")
+    k = mod.get_kernel("axpy")
+    out = k.launch([nd.array([2.0]), nd.array([3.0]), nd.array([1.0])])
+    assert_almost_equal(out.asnumpy(), np.array([7.0], "f"))
+    with pytest.raises(mx.base.MXNetError):
+        mx.rtc.PallasModule("__global__ void k() {}")
+
+
+def test_matrix_factorization_example():
+    out = run_example("example/recommenders/matrix_factorization.py",
+                      "--epochs", "2", "--num-samples", "4000")
+    assert "final RMSE" in out

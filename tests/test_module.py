@@ -160,3 +160,26 @@ def test_sequential_module():
     batch = next(iter(it))
     mod.forward(batch)
     assert mod.get_outputs()[0].shape == (25, 3)
+
+
+def test_low_precision_training_converges():
+    """Mixed-precision training (parity model: tests/python/train/
+    test_dtype.py): the network computes in float16 via Cast layers (the
+    reference's fp16 pattern; bfloat16 on real TPU) with fp32 master
+    weights (multi_precision SGD)."""
+    x, y = _toy_data(300, 8, 2)
+    data = sym.Variable("data")
+    net = sym.Cast(data, dtype="float16")
+    net = sym.FullyConnected(net, num_hidden=16, name="fc1")
+    net = sym.Activation(net, act_type="relu")
+    net = sym.FullyConnected(net, num_hidden=2, name="fc2")
+    net = sym.Cast(net, dtype="float32")
+    net = sym.SoftmaxOutput(net, name="softmax")
+    train = mx.io.NDArrayIter(x.astype("float16"), y, batch_size=50,
+                              shuffle=True)
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.fit(train, num_epoch=8, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.3, "momentum": 0.9,
+                              "multi_precision": True})
+    score = mod.score(mx.io.NDArrayIter(x, y, batch_size=50), "acc")
+    assert score[0][1] > 0.85, score

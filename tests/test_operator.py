@@ -389,3 +389,101 @@ def test_multibox_float_params():
     w = a[:, 2] - a[:, 0]
     assert (w > 0.05).all()  # sizes kept as floats, not truncated to 0
     assert np.unique(np.round(w, 3)).size >= 3  # distinct anchor widths
+
+
+def test_proposal_op():
+    """RPN proposals (parity: contrib/proposal.cc): fixed-shape roi output,
+    boxes clipped to the image."""
+    cls = nd.random.uniform(shape=(2, 24, 8, 8))
+    bbox = nd.random.normal(shape=(2, 48, 8, 8)) * 0.1
+    im_info = nd.array([[128, 128, 1.0], [128, 128, 1.0]])
+    rois = nd.Proposal(cls, bbox, im_info, rpn_pre_nms_top_n=200,
+                       rpn_post_nms_top_n=50, feature_stride=16)
+    assert rois.shape == (100, 5)
+    r = rois.asnumpy()
+    assert (r[:50, 0] == 0).all() and (r[50:, 0] == 1).all()
+    assert (r[:, 1:] >= 0).all()
+    assert (r[:, 3] <= 127).all() and (r[:, 4] <= 127).all()
+    # x2 >= x1, y2 >= y1
+    assert (r[:, 3] >= r[:, 1]).all() and (r[:, 4] >= r[:, 2]).all()
+
+
+def test_deformable_convolution_zero_offset():
+    """Zero offsets reduce deformable conv to standard conv."""
+    data = nd.random.uniform(shape=(1, 4, 8, 8))
+    w = nd.random.normal(shape=(6, 4, 3, 3)) * 0.1
+    b = nd.zeros((6,))
+    off = nd.zeros((1, 18, 8, 8))
+    out = nd.DeformableConvolution(data, off, w, b, kernel=(3, 3),
+                                   pad=(1, 1), num_filter=6)
+    ref = nd.Convolution(data, w, b, kernel=(3, 3), pad=(1, 1), num_filter=6)
+    assert_almost_equal(out.asnumpy(), ref.asnumpy(), rtol=1e-4, atol=1e-5)
+
+
+def test_deformable_convolution_interleaved_offsets():
+    """Offset channels follow the reference deformable_im2col layout:
+    channel 2*(i*kw+j) = y-offset, 2*(i*kw+j)+1 = x-offset of tap (i,j)."""
+    rs = np.random.RandomState(1)
+    data = nd.array(rs.rand(1, 1, 6, 6).astype("f"))
+    # weight that selects ONLY kernel tap (0,0) of a 3x3 kernel
+    w_np = np.zeros((1, 1, 3, 3), "f")
+    w_np[0, 0, 0, 0] = 1.0
+    w = nd.array(w_np)
+    b = nd.zeros((1,))
+    off = nd.zeros((1, 18, 6, 6))
+    # x-offset of tap (0,0) lives in channel 1
+    off[:, 1, :, :] = 1.0
+    out = nd.DeformableConvolution(data, off, w, b, kernel=(3, 3),
+                                   pad=(1, 1), num_filter=1)
+    # tap (0,0) samples (h-1, w-1); +1 x-offset moves it to (h-1, w)
+    d = data.asnumpy()[0, 0]
+    expected = np.zeros_like(d)
+    expected[1:, :] = d[:-1, :]
+    assert_almost_equal(out.asnumpy()[0, 0], expected, rtol=1e-4, atol=1e-5)
+
+
+def test_deformable_convolution_shift_offset():
+    """A +1-pixel x-offset equals shifting the input left by one."""
+    rs = np.random.RandomState(0)
+    data_np = rs.rand(1, 1, 6, 8).astype("f")
+    w = nd.array(np.ones((1, 1, 1, 1), "f"))
+    b = nd.zeros((1,))
+    off = nd.zeros((1, 2, 6, 8))
+    off[:, 1, :, :] = 1.0  # x offset +1 for the single kernel element
+    out = nd.DeformableConvolution(nd.array(data_np), off, w, b,
+                                   kernel=(1, 1), num_filter=1)
+    assert_almost_equal(out.asnumpy()[0, 0, :, :-1], data_np[0, 0, :, 1:],
+                        rtol=1e-5, atol=1e-6)
+
+
+def test_psroi_pooling():
+    """Constant score maps pool to the map's constant per output cell."""
+    k, D = 2, 3
+    maps = np.zeros((1, D * k * k, 8, 8), "f")
+    for ch in range(D * k * k):
+        maps[0, ch] = ch
+    rois = nd.array([[0, 0, 0, 16, 16]])
+    out = nd.PSROIPooling(nd.array(maps), rois, spatial_scale=0.5,
+                          output_dim=D, pooled_size=k)
+    got = out.asnumpy()[0]
+    for d in range(D):
+        for i in range(k):
+            for j in range(k):
+                assert abs(got[d, i, j] - (d * k * k + i * k + j)) < 1e-4
+
+
+def test_psroi_pooling_group_size():
+    """group_size < pooled_size buckets cells into score-map groups
+    (psroi_pooling.cc channel formula (d*gs+gh)*gs+gw)."""
+    k, gs, D = 4, 2, 1
+    maps = np.zeros((1, D * gs * gs, 8, 8), "f")
+    for ch in range(D * gs * gs):
+        maps[0, ch] = ch
+    rois = nd.array([[0, 0, 0, 16, 16]])
+    out = nd.PSROIPooling(nd.array(maps), rois, spatial_scale=0.5,
+                          output_dim=D, pooled_size=k, group_size=gs)
+    got = out.asnumpy()[0, 0]
+    for i in range(k):
+        for j in range(k):
+            expected = (i * gs // k) * gs + (j * gs // k)
+            assert abs(got[i, j] - expected) < 1e-4, (i, j, got[i, j])
