@@ -321,3 +321,45 @@ class HybridLambda(HybridBlock):
 
     def __repr__(self):
         return f"{self.__class__.__name__}({self._func_name})"
+
+
+class MultiHeadAttention(HybridBlock):
+    """Multi-head self-attention over the Pallas flash kernel.
+
+    New TPU-first capability (the 2017 reference predates attention): the
+    score matrix never materializes (ops/flash_attention.py), so sequence
+    length is bounded by HBM activations, not O(T^2) scores; shard the
+    sequence with parallel.sequence_parallel for multi-chip contexts.
+
+    Inputs (N, T, E); `units` must divide by `num_heads`.
+    """
+
+    def __init__(self, units, num_heads, causal=False, use_bias=True,
+                 weight_initializer=None, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        if units % num_heads:
+            raise ValueError(f"units {units} not divisible by heads {num_heads}")
+        self._units = units
+        self._heads = num_heads
+        self._causal = causal
+        with self.name_scope():
+            self.qkv = Dense(3 * units, flatten=False, use_bias=use_bias,
+                             weight_initializer=weight_initializer,
+                             prefix="qkv_")
+            self.out_proj = Dense(units, flatten=False, use_bias=use_bias,
+                                  weight_initializer=weight_initializer,
+                                  prefix="out_")
+
+    def hybrid_forward(self, F, x):
+        H = self._heads
+        Dh = self._units // H
+        qkv = self.qkv(x)                                   # (N, T, 3E)
+        qkv = F.reshape(qkv, shape=(0, 0, 3 * H, Dh))
+        qkv = F.transpose(qkv, axes=(0, 2, 1, 3))           # (N, 3H, T, Dh)
+        q = F.slice_axis(qkv, axis=1, begin=0, end=H)
+        k = F.slice_axis(qkv, axis=1, begin=H, end=2 * H)
+        v = F.slice_axis(qkv, axis=1, begin=2 * H, end=3 * H)
+        o = F.flash_attention(q, k, v, causal=self._causal)  # (N, H, T, Dh)
+        o = F.transpose(o, axes=(0, 2, 1, 3))
+        o = F.reshape(o, shape=(0, 0, -1))                   # (N, T, E)
+        return self.out_proj(o)

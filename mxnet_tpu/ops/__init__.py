@@ -17,3 +17,4 @@ from . import optimizer_ops
 from . import sequence
 from . import vision
 from . import contrib
+from . import flash_attention

@@ -1,0 +1,189 @@
+"""Flash attention as a Pallas TPU kernel.
+
+The hot-op showcase for the Pallas path (`/opt/skills/guides/pallas_guide.md`):
+blocked online-softmax attention that never materializes the (T, T) score
+matrix.  The grid is (batch*heads, q_blocks, k_blocks) with the k dimension
+sequential: each program sees one (blk_q, D) query block and one (blk_k, D)
+key/value block in VMEM, carrying running max/sum/accumulator scratch across
+k steps — VMEM usage is O(blk·D), independent of sequence length.  Composes
+with `parallel.sequence_parallel.ring_attention`, which rotates K/V shards
+across chips while this kernel handles the on-chip block math.
+
+Backward is a custom VJP that recomputes scores blockwise (lax.map over
+q-blocks): peak extra memory O(blk_q · Tk) per (batch, head) — linear in
+sequence length, the standard flash recompute trade.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from ..base import Arg
+from .registry import register
+
+NEG_INF = -1e30
+
+
+def _fa_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
+               scale, causal, blk_q, blk_k):
+    """Grid (BH, nq, nk); nk is sequential — scratch carries the online
+    softmax state across k steps."""
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    q = q_ref[...].astype(jnp.float32) * scale           # (blk_q, D)
+    k = k_ref[...].astype(jnp.float32)                   # (blk_k, D)
+    v = v_ref[...].astype(jnp.float32)
+    s = q @ k.T                                          # (blk_q, blk_k)
+    if causal:
+        q_pos = qi * blk_q + jax.lax.broadcasted_iota(
+            jnp.int32, (blk_q, blk_k), 0)
+        k_pos = ki * blk_k + jax.lax.broadcasted_iota(
+            jnp.int32, (blk_q, blk_k), 1)
+        s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+
+    m_prev = m_ref[:, 0]                                 # (blk_q,)
+    l_prev = l_ref[:, 0]
+    m_blk = jnp.max(s, axis=-1)
+    m_new = jnp.maximum(m_prev, m_blk)
+    p = jnp.exp(s - m_new[:, None])
+    corr = jnp.exp(m_prev - m_new)
+    acc_ref[...] = acc_ref[...] * corr[:, None] + p @ v
+    l_new = l_prev * corr + jnp.sum(p, axis=-1)
+    m_ref[...] = jnp.broadcast_to(m_new[:, None], m_ref.shape)
+    l_ref[...] = jnp.broadcast_to(l_new[:, None], l_ref.shape)
+
+    @pl.when(ki == nk - 1)
+    def _finish():
+        o_ref[...] = (acc_ref[...] /
+                      jnp.maximum(l_ref[:, 0], 1e-30)[:, None]
+                      ).astype(o_ref.dtype)
+
+
+def _dense_reference(q, k, v, scale, causal):
+    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    if causal:
+        Tq, Tk = q.shape[2], k.shape[2]
+        mask = jnp.arange(Tq)[:, None] >= jnp.arange(Tk)[None, :]
+        s = jnp.where(mask[None, None], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32)
+                      ).astype(q.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_attention(q, k, v, scale, causal, blk_q, blk_k):
+    B, H, Tq, D = q.shape
+    Tk = k.shape[2]
+    if Tq % blk_q or Tk % blk_k:
+        return _dense_reference(q, k, v, scale, causal)
+    from jax.experimental.pallas import tpu as pltpu
+    qr = q.reshape(B * H, Tq, D)
+    kr = k.reshape(B * H, Tk, D)
+    vr = v.reshape(B * H, Tk, D)
+    kernel = functools.partial(_fa_kernel, scale=scale, causal=causal,
+                               blk_q=blk_q, blk_k=blk_k)
+    out = pl.pallas_call(
+        kernel,
+        grid=(B * H, Tq // blk_q, Tk // blk_k),
+        in_specs=[
+            pl.BlockSpec((None, blk_q, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((None, blk_k, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((None, blk_k, D), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, blk_q, D), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((B * H, Tq, D), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((blk_q, D), jnp.float32),    # acc
+            pltpu.VMEM((blk_q, 128), jnp.float32),  # running max (lane-bcast)
+            pltpu.VMEM((blk_q, 128), jnp.float32),  # running sum
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=jax.default_backend() != "tpu",
+    )(qr, kr, vr)
+    return out.reshape(B, H, Tq, D)
+
+
+def _fa_fwd(q, k, v, scale, causal, blk_q, blk_k):
+    o = _flash_attention(q, k, v, scale, causal, blk_q, blk_k)
+    return o, (q, k, v, o)
+
+
+def _fa_bwd(scale, causal, blk_q, blk_k, res, g):
+    """Blockwise recompute backward: lax.map over q blocks keeps peak
+    score memory at O(blk_q · Tk) per (batch, head).
+
+    Flash backward identities (FlashAttention paper, §B):
+      P = softmax(S);  D_i = rowsum(dO ∘ O)
+      dV = Pᵀ dO;  dS = P ∘ (dO Vᵀ − D_i);  dQ = dS K · scale;  dK = dSᵀ Q · scale
+    """
+    q, k, v, o = res
+    B, H, Tq, D = q.shape
+    Tk = k.shape[2]
+    blk = blk_q if Tq % blk_q == 0 else Tq
+    nq = Tq // blk
+
+    qf = q.astype(jnp.float32)
+    kf = k.astype(jnp.float32)
+    vf = v.astype(jnp.float32)
+    of = o.astype(jnp.float32)
+    gf = g.astype(jnp.float32)
+
+    def per_head(q1, k1, v1, o1, g1):
+        # (Tq,D),(Tk,D),... for one (batch,head)
+        delta = jnp.sum(g1 * o1, axis=-1)                     # (Tq,)
+
+        def q_block(i):
+            qs = jax.lax.dynamic_slice_in_dim(q1, i * blk, blk)
+            gs = jax.lax.dynamic_slice_in_dim(g1, i * blk, blk)
+            ds = jax.lax.dynamic_slice_in_dim(delta, i * blk, blk)
+            s = qs @ k1.T * scale                             # (blk, Tk)
+            if causal:
+                q_pos = i * blk + jnp.arange(blk)
+                mask = q_pos[:, None] >= jnp.arange(Tk)[None, :]
+                s = jnp.where(mask, s, NEG_INF)
+            p = jax.nn.softmax(s, axis=-1)
+            dp = gs @ v1.T                                    # (blk, Tk)
+            dsoft = p * (dp - ds[:, None])
+            dq = dsoft @ k1 * scale                           # (blk, D)
+            dk = dsoft.T @ qs * scale                         # (Tk, D)
+            dv = p.T @ gs                                     # (Tk, D)
+            return dq, dk, dv
+
+        dqs, dks, dvs = jax.lax.map(q_block, jnp.arange(nq))
+        return dqs.reshape(Tq, D), dks.sum(0), dvs.sum(0)
+
+    flat = lambda a: a.reshape(B * H, a.shape[2], a.shape[3])
+    dq, dk, dv = jax.vmap(per_head)(flat(qf), flat(kf), flat(vf),
+                                    flat(of), flat(gf))
+    return (dq.reshape(q.shape).astype(q.dtype),
+            dk.reshape(k.shape).astype(k.dtype),
+            dv.reshape(v.shape).astype(v.dtype))
+
+
+_flash_attention.defvjp(_fa_fwd, _fa_bwd)
+
+
+@register("_contrib_flash_attention", input_names=("q", "k", "v"),
+          aliases=("flash_attention",),
+          args=[Arg("causal", bool, False), Arg("scale", float, -1.0),
+                Arg("block_q", int, 128), Arg("block_k", int, 128)])
+def _flash_attention_op(p, q, k, v):
+    """Memory-efficient attention: q/k/v (B, H, T, D) → (B, H, T, D)."""
+    scale = p["scale"] if p["scale"] > 0 else q.shape[-1] ** -0.5
+    blk_q = min(p["block_q"], q.shape[2])
+    blk_k = min(p["block_k"], k.shape[2])
+    return _flash_attention(q, k, v, float(scale), bool(p["causal"]),
+                            int(blk_q), int(blk_k))
