@@ -22,6 +22,31 @@ import jax as _jax
 _jax.config.update("jax_enable_x64", True)
 
 
+def _apply_cpu_only_guard():
+    """When the user forces CPU (JAX_PLATFORMS=cpu), deregister any TPU
+    plugin backend factory: some plugins (axon) register in sitecustomize
+    and contact the device tunnel on the first backends() call even for
+    CPU-only runs — an unreachable tunnel would hang examples/tools/tests.
+    tests/conftest.py and __graft_entry__ route through the same guard."""
+    platforms = [x.strip() for x in
+                 os.environ.get("JAX_PLATFORMS", "").split(",") if x.strip()]
+    if platforms != ["cpu"]:
+        return False
+    try:
+        from jax._src import xla_bridge as _xb
+        _xb._backend_factories.pop("axon", None)
+    except Exception:
+        pass
+    try:
+        _jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass
+    return True
+
+
+_apply_cpu_only_guard()
+
+
 class MXNetError(RuntimeError):
     """Error raised by the framework (parity: mxnet.base.MXNetError)."""
 

@@ -71,10 +71,21 @@ class Trainer:
         self._optimizer.set_learning_rate(lr)
 
     def step(self, batch_size, ignore_stale_grad=False):
-        """Apply one optimization step with grads scaled by 1/batch_size."""
+        """Apply one optimization step with grads scaled by 1/batch_size.
+
+        TPU hot path: all parameters update in O(1) XLA dispatches via
+        KVStore.pushpull / FusedUpdater.update_all (replaces the reference's
+        per-parameter kvstore push loop, gluon/trainer.py:191-226)."""
         if not self._kv_initialized:
             self._init_kvstore()
         self._optimizer.rescale_grad = self._scale / batch_size
+        live = [(i, p) for i, p in enumerate(self._params)
+                if p.grad_req != "null"]
+        if self._kv is not None and self._update_on_kvstore:
+            self._kv.pushpull([i for i, _ in live],
+                              [p.list_grad() for _, p in live],
+                              out=[p.list_data() for _, p in live])
+            return
         self._allreduce_grads()
         self._update(ignore_stale_grad)
 
@@ -93,15 +104,29 @@ class Trainer:
                     self._kv.pull(i, param.list_grad())
 
     def _update(self, ignore_stale_grad=False):
-        for i, param in enumerate(self._params):
-            if param.grad_req == "null":
-                continue
-            if self._update_on_kvstore and self._kv is not None:
+        from ..optimizer import FusedUpdater
+        live = [(i, p) for i, p in enumerate(self._params)
+                if p.grad_req != "null"]
+        if self._update_on_kvstore and self._kv is not None:
+            for i, param in live:
                 self._kv.pull(i, out=param.list_data())
-                continue
-            for upd, arr, grad in zip(self._updaters, param.list_data(),
-                                      param.list_grad()):
-                upd(i, grad, arr)
+            return
+        upd = self._updaters[0]
+        if isinstance(upd, FusedUpdater) and \
+                all(len(p.list_data()) == 1 for _, p in live):
+            upd.update_all([i for i, _ in live],
+                           [p.list_grad()[0] for _, p in live],
+                           [p.list_data()[0] for _, p in live])
+            return
+        # one updater per device copy (parity: reference trainer keeps
+        # len(contexts) updaters so every replica is updated)
+        ncopies = max((len(p.list_data()) for _, p in live), default=1)
+        while len(self._updaters) < ncopies:
+            self._updaters.append(opt.get_updater(self._optimizer))
+        for i, param in live:
+            for u, arr, grad in zip(self._updaters, param.list_data(),
+                                    param.list_grad()):
+                u(i, grad, arr)
 
     def update(self, batch_size, ignore_stale_grad=False):
         if not self._kv_initialized:

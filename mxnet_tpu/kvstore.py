@@ -40,9 +40,9 @@ from .ndarray import NDArray
 from . import optimizer as opt
 
 
-@functools.partial(jax.jit, static_argnames=("threshold",))
-def _quantize_2bit(arr, residual, threshold):
-    """2-bit quantization with error feedback.
+def _quantize_2bit_impl(arr, residual, threshold):
+    """2-bit quantization with error feedback (pure; traceable inside any
+    outer jit — the fused pushpull path inlines it).
 
     Parity: GradientCompression::Quantize2Bit
     (`src/kvstore/gradient_compression.h:111`, kernel in
@@ -65,13 +65,18 @@ def _quantize_2bit(arr, residual, threshold):
     return packed, new_residual
 
 
-@functools.partial(jax.jit, static_argnames=("threshold", "size"))
-def _dequantize_2bit(packed, threshold, size):
-    """Parity: GradientCompression::Dequantize2Bit."""
+def _dequantize_2bit_impl(packed, threshold, size):
+    """Parity: GradientCompression::Dequantize2Bit (pure; traceable)."""
     codes = jnp.stack([packed & 3, (packed >> 2) & 3, (packed >> 4) & 3,
                        (packed >> 6) & 3], axis=1).ravel()[:size]
     return jnp.where(codes == 1, threshold,
                      jnp.where(codes == 2, -threshold, 0.0))
+
+
+_quantize_2bit = jax.jit(_quantize_2bit_impl,
+                         static_argnames=("threshold",))
+_dequantize_2bit = jax.jit(_dequantize_2bit_impl,
+                           static_argnames=("threshold", "size"))
 
 
 class GradientCompression:
@@ -128,6 +133,7 @@ class KVStore:
         self._compression_params = None
         self._gc: Optional[GradientCompression] = None
         self._residuals: Dict = {}
+        self._merge_cache: Dict = {}
         self._optimizer = None
 
     # -- identity -----------------------------------------------------------
@@ -168,6 +174,94 @@ class KVStore:
             else:
                 # parity: kvstore_local.h:191 — assign, not accumulate
                 self._store[k] = merged.copy()
+
+    def pushpull(self, key, value, out=None, priority: int = 0) -> None:
+        """Fused push+pull over MANY keys in O(1) XLA dispatches.
+
+        The TPU redesign of the reference's per-key engine pushes
+        (`_update_params_on_kvstore`, model.py:126): device-copy merge +
+        gradient compression trace into one jitted program, the optimizer
+        applies to every key via FusedUpdater.update_all (one more program),
+        and pull is a pointer hand-off.  Semantics are identical to
+        push(key, value); pull(key, out) — verified by tests/test_kvstore.py.
+        """
+        keys, _ = _key_list(key)
+        vals = _val_list(value)
+        for k in keys:
+            if k not in self._store:
+                raise MXNetError(f"key {k} has not been inited")
+        if any(len(v) > 1 for v in vals) or self._gc is not None:
+            merged = self._fused_merge(keys, vals)
+        else:
+            merged = [v[0]._data if isinstance(v[0], NDArray) else v[0]
+                      for v in vals]
+        if self.num_workers > 1 and self.type != "local":
+            from .parallel import collectives
+            merged = collectives.allreduce_hosts_many(merged)
+        if self._updater is not None:
+            if isinstance(self._updater, opt.FusedUpdater):
+                self._updater.update_all([_updater_key(k) for k in keys],
+                                         merged, [self._store[k] for k in keys])
+            else:
+                for k, m in zip(keys, merged):
+                    m = m if isinstance(m, NDArray) else \
+                        NDArray(m, self._store[k].context)
+                    self._updater(_updater_key(k), m, self._store[k])
+        else:
+            for k, m in zip(keys, merged):
+                m = m if isinstance(m, NDArray) else \
+                    NDArray(m, self._store[k].context)
+                self._store[k] = m.copy()
+        if out is not None:
+            outs = _val_list(out)
+            for k, olist in zip(keys, outs):
+                src = self._store[k]
+                for o in olist:
+                    if o is not src:
+                        src.copyto(o)
+
+    def _fused_merge(self, keys, vals) -> List:
+        """One jitted program: per-key device-copy sum (+2-bit compression
+        with error-feedback residuals).  Returns raw jax arrays."""
+        gc = self._gc
+        thr = gc.threshold if gc is not None else 0.0
+        vdata = [[v._data if isinstance(v, NDArray) else v for v in vl]
+                 for vl in vals]
+        res = []
+        if gc is not None:
+            for k, vl in zip(keys, vdata):
+                r = self._residuals.get(k)
+                if r is None:
+                    r = jnp.zeros(vl[0].size, dtype=jnp.float32)
+                res.append(r)
+        fkey = ("merge", tuple(keys), tuple(len(v) for v in vals),
+                thr, gc is not None)
+        fn = self._merge_cache.get(fkey)
+        if fn is None:
+            use_gc = gc is not None
+
+            def _m(vlists, residuals):
+                outs, new_res = [], []
+                for i, vl in enumerate(vlists):
+                    m = vl[0]
+                    for v in vl[1:]:
+                        m = m + v
+                    if use_gc:
+                        packed, nr = _quantize_2bit_impl(
+                            m.reshape(-1), residuals[i], thr)
+                        m = _dequantize_2bit_impl(packed, thr, m.size) \
+                            .reshape(m.shape).astype(m.dtype)
+                        new_res.append(nr)
+                    outs.append(m)
+                return outs, new_res
+
+            fn = jax.jit(_m, donate_argnums=(1,))
+            self._merge_cache[fkey] = fn
+        merged, new_res = fn(vdata, res)
+        if gc is not None:
+            for k, nr in zip(keys, new_res):
+                self._residuals[k] = nr
+        return merged
 
     def pull(self, key, out=None, priority: int = 0) -> None:
         keys, _ = _key_list(key)

@@ -376,27 +376,41 @@ class Module(BaseModule):
         self._exec.forward_backward()
 
     def update(self):
-        """Parity: _update_params_on_kvstore / _update_params (model.py:97-138)."""
+        """Parity: _update_params_on_kvstore / _update_params (model.py:97-138).
+
+        TPU hot path: the whole multi-parameter update runs in O(1) XLA
+        dispatches — KVStore.pushpull / FusedUpdater.update_all trace every
+        key into one compiled program (the engine-bulking analog,
+        graph_executor.cc:1350) instead of the reference's per-key engine
+        pushes."""
         assert self.binded and self.params_initialized and \
             self.optimizer_initialized
         self._params_dirty = True
+        live = [(i, n) for i, n in enumerate(self._param_names)
+                if n in self._exec.grad_dict]
+        names = [n for _, n in live]
+        grads = [self._exec.grad_dict[n] for n in names]
         if self._kvstore is not None:
-            for i, name in enumerate(self._param_names):
-                if name not in self._exec.grad_dict:
-                    continue
-                grad = self._exec.grad_dict[name]
-                self._kvstore.push(name, [grad])
-                if self._update_on_kvstore:
-                    self._kvstore.pull(name, out=[self._exec.arg_dict[name]])
-                else:
-                    agg = nd.zeros(grad.shape, dtype=grad.dtype)
-                    self._kvstore.pull(name, out=[agg])
-                    self._updater(i, agg, self._exec.arg_dict[name])
+            if self._update_on_kvstore:
+                self._kvstore.pushpull(
+                    names, [[g] for g in grads],
+                    out=[[self._exec.arg_dict[n]] for n in names])
+            else:
+                aggs = [nd.zeros(g.shape, dtype=g.dtype) for g in grads]
+                self._kvstore.pushpull(names, [[g] for g in grads],
+                                       out=[[a] for a in aggs])
+                self._update_local([i for i, _ in live], aggs, names)
         else:
-            for i, name in enumerate(self._param_names):
-                if name in self._exec.grad_dict:
-                    self._updater(i, self._exec.grad_dict[name],
-                                  self._exec.arg_dict[name])
+            self._update_local([i for i, _ in live], grads, names)
+
+    def _update_local(self, indices, grads, names):
+        from ..optimizer import FusedUpdater
+        weights = [self._exec.arg_dict[n] for n in names]
+        if isinstance(self._updater, FusedUpdater):
+            self._updater.update_all(indices, grads, weights)
+        else:
+            for i, g, w in zip(indices, grads, weights):
+                self._updater(i, g, w)
 
     def get_outputs(self, merge_multi_context=True):
         assert self.binded and self.params_initialized

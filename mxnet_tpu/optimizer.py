@@ -13,12 +13,20 @@ import pickle
 from typing import Any, Dict, Optional
 
 import numpy as _np
+import jax
+import jax.numpy as jnp
 
 from .base import MXNetError, Registry
 from . import ndarray as nd
 from .ndarray import NDArray
 
 _REG = Registry("optimizer")
+
+
+def _is_low_prec(dtype) -> bool:
+    """float16/bfloat16 weights get fp32 master copies under multi_precision
+    (parity: optimizer_op.cc mp_sgd_* — bf16 is the TPU-native low precision)."""
+    return _np.dtype(dtype).name in ("float16", "bfloat16")
 
 
 class Optimizer:
@@ -61,7 +69,7 @@ class Optimizer:
         return None
 
     def create_state_multi_precision(self, index, weight):
-        if self.multi_precision and weight.dtype in (_np.float16,):
+        if self.multi_precision and _is_low_prec(weight.dtype):
             w32 = weight.astype(_np.float32)
             return (self.create_state(index, w32), w32)
         return self.create_state(index, weight)
@@ -70,13 +78,59 @@ class Optimizer:
         raise NotImplementedError
 
     def update_multi_precision(self, index, weight, grad, state):
-        if self.multi_precision and weight.dtype in (_np.float16,):
+        if self.multi_precision and _is_low_prec(weight.dtype):
             inner, w32 = state
             g32 = grad.astype(_np.float32)
             self.update(index, w32, g32, inner)
             w32.copyto(weight)
         else:
             self.update(index, weight, grad, state)
+
+    # -- fused multi-tensor path ---------------------------------------------
+    # The TPU analog of the reference's engine op-bulking
+    # (src/executor/graph_executor.cc:1350): FusedUpdater traces fused_step
+    # for EVERY parameter into ONE jitted XLA program per training step, so
+    # Module.update / Trainer.step issue O(1) dispatches instead of O(#params).
+    fused = False  # subclasses with a pure fused_step set True
+    # True when fused_step itself implements the fp32-master path (SGD's
+    # mp_sgd_* kernels); otherwise _fused_step_mp wraps any fused_step with
+    # the generic master-weight recipe (parity: update_multi_precision).
+    fused_handles_mp = False
+
+    def fused_hyper_key(self):
+        """Static hyperparameters baked into the fused trace (cache key)."""
+        return (self.rescale_grad, self.clip_gradient)
+
+    def fused_step(self, index, weight, grad, state, lr, wd, t):
+        """Pure single-param step on jax values: returns (new_weight,
+        new_state).  `lr`/`wd` are traced f32 scalars, `t` the traced update
+        count (for bias correction); everything else is baked static."""
+        raise NotImplementedError
+
+    def _fused_step_mp(self, index, weight, grad, state, lr, wd, t):
+        """fused_step with generic multi-precision handling: low-precision
+        weights step their fp32 master copy and cast back (parity:
+        update_multi_precision)."""
+        if self.multi_precision and _is_low_prec(weight.dtype) \
+                and not self.fused_handles_mp:
+            inner, w32 = state
+            nw32, ninner = self.fused_step(index, w32,
+                                           grad.astype(jnp.float32), inner,
+                                           lr, wd, t)
+            return nw32.astype(weight.dtype), (ninner, nw32)
+        return self.fused_step(index, weight, grad, state, lr, wd, t)
+
+    def _clip(self, g):
+        if self.clip_gradient is not None:
+            return jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+        return g
+
+    def _fused_common(self, lr, wd, **extra):
+        p = {"lr": lr, "wd": wd, "rescale_grad": self.rescale_grad,
+             "clip_gradient": self.clip_gradient
+             if self.clip_gradient is not None else -1.0}
+        p.update(extra)
+        return p
 
     # -- lr/wd plumbing ------------------------------------------------------
     def set_learning_rate(self, lr):
@@ -144,48 +198,90 @@ create = Optimizer.create_optimizer
 class SGD(Optimizer):
     """SGD with momentum and multi-precision (parity: optimizer.py:435)."""
 
+    fused = True
+    fused_handles_mp = True
+
     def __init__(self, momentum=0.0, **kwargs):
         super().__init__(**kwargs)
         self.momentum = momentum
 
     def create_state(self, index, weight):
-        if self.multi_precision and weight.dtype in (_np.float16,):
+        if self.multi_precision and _is_low_prec(weight.dtype):
             return self.create_state_multi_precision(index, weight)
         if self.momentum == 0.0:
             return None
         return nd.zeros(weight.shape, ctx=weight.context, dtype=weight.dtype)
 
-    def update(self, index, weight, grad, state):
-        self._update_count(index)
-        lr, wd = self._get_lr(index), self._get_wd(index)
-        kw = self._common_kwargs()
-        if state is not None:
-            nd.sgd_mom_update(weight, grad, state, lr=lr, wd=wd,
-                              momentum=self.momentum, **kw)
-        else:
-            nd.sgd_update(weight, grad, lr=lr, wd=wd, **kw)
+    def fused_hyper_key(self):
+        return (self.rescale_grad, self.clip_gradient, self.momentum,
+                self.multi_precision)
 
-    def update_multi_precision(self, index, weight, grad, state):
+    def fused_step(self, index, weight, grad, state, lr, wd, t):
+        from .ops.registry import OP_REGISTRY as _K
+        p = self._fused_common(lr, wd, momentum=self.momentum)
+        if self.multi_precision and _is_low_prec(weight.dtype):
+            mom, w32 = state
+            if self.momentum != 0.0:
+                nw, nmom, nw32 = _K["mp_sgd_mom_update"].fn(
+                    p, weight, grad, mom, w32)
+                return nw, (nmom, nw32)
+            nw, nw32 = _K["mp_sgd_update"].fn(p, weight, grad, w32)
+            return nw, (None, nw32)
+        if self.momentum != 0.0:
+            nw, nmom = _K["sgd_mom_update"].fn(p, weight, grad, state)
+            return nw, nmom
+        return _K["sgd_update"].fn(p, weight, grad), None
+
+    def _update_impl(self, index, weight, grad, state, multi_precision):
+        """One count bump + one fused kernel (parity: optimizer.py SGD
+        _update_impl — update/update_multi_precision share it so num_update
+        advances exactly once per step)."""
         self._update_count(index)
         lr, wd = self._get_lr(index), self._get_wd(index)
         kw = self._common_kwargs()
-        if self.multi_precision and weight.dtype in (_np.float16,):
+        if multi_precision:
             inner, w32 = state
             if self.momentum != 0.0:
                 nd.mp_sgd_mom_update(weight, grad, inner, w32, lr=lr, wd=wd,
                                      momentum=self.momentum, **kw)
             else:
                 nd.mp_sgd_update(weight, grad, w32, lr=lr, wd=wd, **kw)
+        elif state is not None:
+            nd.sgd_mom_update(weight, grad, state, lr=lr, wd=wd,
+                              momentum=self.momentum, **kw)
         else:
-            self.update(index, weight, grad, state)
+            nd.sgd_update(weight, grad, lr=lr, wd=wd, **kw)
+
+    def update(self, index, weight, grad, state):
+        self._update_impl(index, weight, grad, state, False)
+
+    def update_multi_precision(self, index, weight, grad, state):
+        use_mp = self.multi_precision and _is_low_prec(weight.dtype)
+        self._update_impl(index, weight, grad, state, use_mp)
 
 
 @register
 class Adam(Optimizer):
+    fused = True
+
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
                  epsilon=1e-8, **kwargs):
         super().__init__(learning_rate=learning_rate, **kwargs)
         self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+
+    def fused_hyper_key(self):
+        return (self.rescale_grad, self.clip_gradient, self.beta1, self.beta2,
+                self.epsilon)
+
+    def fused_step(self, index, weight, grad, state, lr, wd, t):
+        from .ops.registry import OP_REGISTRY as _K
+        tf = t.astype(jnp.float32)
+        coef = jnp.sqrt(1.0 - self.beta2 ** tf) / (1.0 - self.beta1 ** tf)
+        p = self._fused_common(lr * coef, wd, beta1=self.beta1,
+                               beta2=self.beta2, epsilon=self.epsilon)
+        mean, var = state
+        nw, nm, nv = _K["adam_update"].fn(p, weight, grad, mean, var)
+        return nw, (nm, nv)
 
     def create_state(self, index, weight):
         return (nd.zeros(weight.shape, ctx=weight.context, dtype=weight.dtype),
@@ -204,6 +300,27 @@ class Adam(Optimizer):
 
 @register
 class RMSProp(Optimizer):
+    fused = True
+
+    def fused_hyper_key(self):
+        return (self.rescale_grad, self.clip_gradient, self.gamma1,
+                self.gamma2, self.epsilon, self.centered, self.clip_weights)
+
+    def fused_step(self, index, weight, grad, state, lr, wd, t):
+        from .ops.registry import OP_REGISTRY as _K
+        p = self._fused_common(
+            lr, wd, gamma1=self.gamma1, epsilon=self.epsilon,
+            clip_weights=self.clip_weights if self.clip_weights else -1.0)
+        if self.centered:
+            p["gamma2"] = self.gamma2
+            n, g, delta = state
+            nw, nn, ng, nd_ = _K["rmspropalex_update"].fn(
+                p, weight, grad, n, g, delta)
+            return nw, (nn, ng, nd_)
+        (n,) = state
+        nw, nn = _K["rmsprop_update"].fn(p, weight, grad, n)
+        return nw, (nn,)
+
     def __init__(self, learning_rate=0.001, gamma1=0.9, gamma2=0.9,
                  epsilon=1e-8, centered=False, clip_weights=None, **kwargs):
         super().__init__(learning_rate=learning_rate, **kwargs)
@@ -237,9 +354,21 @@ class RMSProp(Optimizer):
 
 @register
 class AdaGrad(Optimizer):
+    fused = True
+
     def __init__(self, eps=1e-7, **kwargs):
         super().__init__(**kwargs)
         self.float_stable_eps = eps
+
+    def fused_hyper_key(self):
+        return (self.rescale_grad, self.clip_gradient, self.float_stable_eps)
+
+    def fused_step(self, index, weight, grad, state, lr, wd, t):
+        g = self._clip(grad * self.rescale_grad)
+        hist = state + g * g
+        nw = weight - lr * (g / jnp.sqrt(hist + self.float_stable_eps)
+                            + wd * weight)
+        return nw, hist
 
     def create_state(self, index, weight):
         return nd.zeros(weight.shape, ctx=weight.context, dtype=weight.dtype)
@@ -257,9 +386,23 @@ class AdaGrad(Optimizer):
 
 @register
 class AdaDelta(Optimizer):
+    fused = True
+
     def __init__(self, rho=0.90, epsilon=1e-5, **kwargs):
         super().__init__(**kwargs)
         self.rho, self.epsilon = rho, epsilon
+
+    def fused_hyper_key(self):
+        return (self.rescale_grad, self.clip_gradient, self.rho, self.epsilon)
+
+    def fused_step(self, index, weight, grad, state, lr, wd, t):
+        g = self._clip(grad * self.rescale_grad)
+        acc_g, acc_delta = state
+        nacc_g = self.rho * acc_g + (1.0 - self.rho) * g * g
+        cd = jnp.sqrt(acc_delta + self.epsilon) / \
+            jnp.sqrt(nacc_g + self.epsilon) * g
+        nacc_d = self.rho * acc_delta + (1.0 - self.rho) * cd * cd
+        return weight - cd - wd * weight, (nacc_g, nacc_d)
 
     def create_state(self, index, weight):
         return (nd.zeros(weight.shape, ctx=weight.context),
@@ -282,9 +425,21 @@ class AdaDelta(Optimizer):
 
 @register
 class Ftrl(Optimizer):
+    fused = True
+
     def __init__(self, lamda1=0.01, learning_rate=0.1, beta=1, **kwargs):
         super().__init__(learning_rate=learning_rate, **kwargs)
         self.lamda1, self.beta = lamda1, beta
+
+    def fused_hyper_key(self):
+        return (self.rescale_grad, self.clip_gradient, self.lamda1, self.beta)
+
+    def fused_step(self, index, weight, grad, state, lr, wd, t):
+        from .ops.registry import OP_REGISTRY as _K
+        p = self._fused_common(lr, wd, lamda1=self.lamda1, beta=self.beta)
+        z, n = state
+        nw, nz, nn = _K["ftrl_update"].fn(p, weight, grad, z, n)
+        return nw, (nz, nn)
 
     def create_state(self, index, weight):
         return (nd.zeros(weight.shape, ctx=weight.context),
@@ -300,9 +455,22 @@ class Ftrl(Optimizer):
 
 @register
 class Adamax(Optimizer):
+    fused = True
+
     def __init__(self, learning_rate=0.002, beta1=0.9, beta2=0.999, **kwargs):
         super().__init__(learning_rate=learning_rate, **kwargs)
         self.beta1, self.beta2 = beta1, beta2
+
+    def fused_hyper_key(self):
+        return (self.rescale_grad, self.clip_gradient, self.beta1, self.beta2)
+
+    def fused_step(self, index, weight, grad, state, lr, wd, t):
+        g = self._clip(grad * self.rescale_grad + wd * weight)
+        m_t, u_t = state
+        nm = self.beta1 * m_t + (1.0 - self.beta1) * g
+        nu = jnp.maximum(self.beta2 * u_t, jnp.abs(g))
+        lr_t = lr / (1.0 - self.beta1 ** t.astype(jnp.float32))
+        return weight - lr_t * nm / nu, (nm, nu)
 
     def create_state(self, index, weight):
         return (nd.zeros(weight.shape, ctx=weight.context),
@@ -428,7 +596,7 @@ class Updater:
         self.states: Dict[Any, Any] = {}
         self.states_synced: Dict[Any, bool] = {}
 
-    def __call__(self, index, grad, weight):
+    def _ensure_state(self, index, weight):
         if index not in self.states:
             self.states[index] = self.optimizer.create_state_multi_precision(
                 index, weight)
@@ -437,6 +605,9 @@ class Updater:
             self.states[index] = self.sync_state_context(self.states[index],
                                                          weight.context)
             self.states_synced[index] = True
+
+    def __call__(self, index, grad, weight):
+        self._ensure_state(index, weight)
         self.optimizer.update_multi_precision(index, weight, grad,
                                               self.states[index])
 
@@ -470,8 +641,108 @@ def _to_np_state(state):
     return state
 
 
+class FusedUpdater(Updater):
+    """Multi-tensor updater: ONE jitted XLA program updates every parameter.
+
+    The TPU redesign of the reference's per-parameter engine pushes
+    (python/mxnet/model.py:126 `_update_params_on_kvstore` loops keys; the
+    engine bulks op segments, graph_executor.cc:1350).  Here the whole
+    grads→optimizer→params pass for all keys traces into a single compiled
+    call per step: Module.update / Trainer.step / KVStore.pushpull issue O(1)
+    dispatches regardless of parameter count.  Per-key `__call__` (inherited)
+    stays available and bit-identical for optimizers without a fused_step.
+    """
+
+    def __init__(self, optimizer: Optimizer):
+        super().__init__(optimizer)
+        self._fn_cache: Dict[Any, Any] = {}
+
+    @staticmethod
+    def _state_data(state):
+        if state is None:
+            return None
+        if isinstance(state, NDArray):
+            return state._data
+        if isinstance(state, (tuple, list)):
+            return tuple(FusedUpdater._state_data(s) for s in state)
+        return state
+
+    def _state_writeback(self, old, new):
+        if old is None:
+            return None
+        if isinstance(old, NDArray):
+            old._set_data(new)
+            return old
+        if isinstance(old, (tuple, list)):
+            return type(old)(self._state_writeback(o, n)
+                             for o, n in zip(old, new))
+        return new
+
+    def update_all(self, indices, grads, weights) -> None:
+        """Apply the optimizer to all (grad, weight) pairs in one dispatch.
+
+        grads: NDArray or raw jax arrays; weights: NDArrays (updated
+        in place via _set_data).  Falls back to the per-key path for
+        optimizers without fused_step.
+        """
+        opt_ = self.optimizer
+        if not getattr(opt_, "fused", False):
+            for i, g, w in zip(indices, grads, weights):
+                g = g if isinstance(g, NDArray) else NDArray(g, w.context)
+                self(i, g, w)
+            return
+        indices = list(indices)
+        for i, w in zip(indices, weights):
+            self._ensure_state(i, w)
+        for i in indices:
+            opt_._update_count(i)
+        lrs = jnp.asarray(_np.array([opt_._get_lr(i) for i in indices],
+                                    _np.float32))
+        wds = jnp.asarray(_np.array([opt_._get_wd(i) for i in indices],
+                                    _np.float32))
+        ts = jnp.asarray(_np.array(
+            [opt_._index_update_count[i] for i in indices], _np.int32))
+        wvals = [w._data for w in weights]
+        gvals = [g._data if isinstance(g, NDArray) else g for g in grads]
+        svals = [self._state_data(self.states[i]) for i in indices]
+
+        key = (type(opt_).__name__, opt_.fused_hyper_key(), tuple(indices))
+        fn = self._fn_cache.get(key)
+        if fn is None:
+            idx = list(indices)
+
+            def _cast_like(new, old):
+                # traced lr/wd are strong f32 — keep weights/states in their
+                # own dtype (the per-key path's weak python floats did this
+                # implicitly)
+                if new is None or old is None:
+                    return new
+                if isinstance(old, (tuple, list)):
+                    return type(old)(_cast_like(n, o)
+                                     for n, o in zip(new, old))
+                return new.astype(old.dtype) if hasattr(old, "dtype") else new
+
+            def _apply(wv, gv, sv, lrs, wds, ts):
+                nws, nss = [], []
+                for k in range(len(wv)):
+                    nw, ns = opt_._fused_step_mp(idx[k], wv[k], gv[k], sv[k],
+                                                 lrs[k], wds[k], ts[k])
+                    nws.append(_cast_like(nw, wv[k]))
+                    nss.append(_cast_like(ns, sv[k]))
+                return nws, nss
+
+            # donate states (owned exclusively by this updater); weights are
+            # not donated — executor snapshots may still alias their buffers
+            fn = jax.jit(_apply, donate_argnums=(2,))
+            self._fn_cache[key] = fn
+        nws, nss = fn(wvals, gvals, svals, lrs, wds, ts)
+        for k, i in enumerate(indices):
+            weights[k]._set_data(nws[k])
+            self.states[i] = self._state_writeback(self.states[i], nss[k])
+
+
 def get_updater(optimizer: Optimizer) -> Updater:
-    return Updater(optimizer)
+    return FusedUpdater(optimizer)
 
 
 # NDArray needs nd.maximum for Adamax — ensure generated fn exists

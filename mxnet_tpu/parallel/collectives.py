@@ -40,30 +40,70 @@ def all_to_all(x, axis_name: str, split_axis: int, concat_axis: int,
 
 
 # -- host-level (multi-process pods, DCN) -----------------------------------
-def allreduce_hosts(arr):
-    """Sum an NDArray across worker processes (KVStore multi-host push).
+_host_mesh_cache = {}
+_host_sum_cache = {}
 
-    Single-process: identity.  Multi-host: jax.make_array_from_... + psum
-    under pjit over the global mesh (DCN path).
+
+def host_mesh() -> Mesh:
+    """2-D (hosts, local) mesh: axis 'hosts' indexes processes, 'local' the
+    devices within each process.  This is the process-aware layout the
+    cross-host KVStore leg reduces over (replaces the ps-lite worker/server
+    topology, kvstore_dist.h:49)."""
+    import numpy as np
+    key = (jax.process_count(), len(jax.devices()))
+    m = _host_mesh_cache.get(key)
+    if m is None:
+        devs = sorted(jax.devices(), key=lambda d: (d.process_index, d.id))
+        per = len(devs) // jax.process_count()
+        m = Mesh(np.array(devs).reshape(jax.process_count(), per),
+                 ("hosts", "local"))
+        _host_mesh_cache[key] = m
+    return m
+
+
+def allreduce_hosts_many(arrs):
+    """Sum each array across worker processes in ONE compiled program.
+
+    Single-process: identity.  Multi-process: every process contributes its
+    local copy as one slice of a ('hosts'-sharded) global array; a jitted
+    sum over that axis lowers to an XLA all-reduce on the cross-host (DCN)
+    leg, and the result comes back fully replicated so every process reads
+    the same values.  (Replaces ps-lite ZPush/ZPull + server merge,
+    kvstore_dist_server.h:173-317, with sync-mode semantics.)
     """
     if jax.process_count() <= 1:
-        return arr
+        return list(arrs)
     from ..ndarray import NDArray
-    mesh = Mesh(jax.devices(), ("hosts",))
-    x = arr._data if isinstance(arr, NDArray) else arr
+    mesh = host_mesh()
+    shard = NamedSharding(mesh, P("hosts"))
+    repl = NamedSharding(mesh, P())
+    raw = [jnp.asarray(a._data if isinstance(a, NDArray) else a)
+           for a in arrs]
+    nproc = jax.process_count()
+    # device-native global-array assembly: each process's merged value is
+    # replicated to its local devices (D2D copies), then stitched as the
+    # process's slice of the 'hosts'-sharded axis — no host round trip
+    pidx = jax.process_index()
+    local_row = list(mesh.devices[pidx])
+    glob = []
+    for x in raw:
+        bufs = [jax.device_put(jnp.expand_dims(x, 0), d) for d in local_row]
+        glob.append(jax.make_array_from_single_device_arrays(
+            (nproc,) + tuple(x.shape), shard, bufs))
+    key = tuple((tuple(x.shape), str(x.dtype)) for x in raw)
+    fn = _host_sum_cache.get(key)
+    if fn is None:
+        fn = jax.jit(lambda gs: [jnp.sum(g, axis=0) for g in gs],
+                     out_shardings=repl)
+        _host_sum_cache[key] = fn
+    summed = fn(glob)
+    return [NDArray(s, a.context) if isinstance(a, NDArray) else s
+            for s, a in zip(summed, arrs)]
 
-    @jax.jit
-    def _sum(v):
-        return v
 
-    # replicate-and-sum across processes via global array construction
-    global_arr = jax.make_array_from_process_local_data(
-        NamedSharding(mesh, P("hosts")), jnp.expand_dims(x, 0))
-    summed = jax.jit(lambda g: jnp.sum(g, axis=0),
-                     out_shardings=NamedSharding(mesh, P()))(global_arr)
-    if isinstance(arr, NDArray):
-        return NDArray(summed, arr.context)
-    return summed
+def allreduce_hosts(arr):
+    """Sum one NDArray across worker processes (KVStore multi-host push)."""
+    return allreduce_hosts_many([arr])[0]
 
 
 def host_barrier():

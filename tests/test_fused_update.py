@@ -1,0 +1,207 @@
+"""Fused multi-tensor update path vs the per-key reference semantics.
+
+The fused path (FusedUpdater.update_all / KVStore.pushpull) must be
+numerically identical to the per-key Updater/push/pull loops it replaces
+(reference: _update_params_on_kvstore model.py:126, trainer.py:191-226).
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import ndarray as nd
+from mxnet_tpu import optimizer as opt
+from mxnet_tpu.optimizer import FusedUpdater, Updater
+
+
+def _rand_pairs(n=5, seed=0, dtype=np.float32):
+    rs = np.random.RandomState(seed)
+    ws, gs = [], []
+    for i in range(n):
+        shp = (3 + i, 4)
+        ws.append(nd.array(rs.normal(0, 1, shp).astype(dtype)))
+        gs.append(nd.array(rs.normal(0, 1, shp).astype(dtype)))
+    return ws, gs
+
+
+def _run_both(make_opt, steps=3, dtype=np.float32, rtol=1e-5, atol=1e-6):
+    ws_f, gs0 = _rand_pairs(dtype=dtype)
+    ws_p = [w.copy() for w in ws_f]
+    fused = FusedUpdater(make_opt())
+    perkey = Updater(make_opt())
+    rs = np.random.RandomState(7)
+    for s in range(steps):
+        gs = [nd.array(rs.normal(0, 1, w.shape).astype(dtype)) for w in ws_f]
+        fused.update_all(list(range(len(ws_f))), gs, ws_f)
+        for i, (g, w) in enumerate(zip(gs, ws_p)):
+            perkey(i, g, w)
+    for a, b in zip(ws_f, ws_p):
+        np.testing.assert_allclose(a.asnumpy().astype(np.float32),
+                                   b.asnumpy().astype(np.float32),
+                                   rtol=rtol, atol=atol)
+
+
+def test_fused_sgd_momentum():
+    _run_both(lambda: opt.SGD(learning_rate=0.1, momentum=0.9, wd=1e-4,
+                              rescale_grad=0.5))
+
+
+def test_fused_sgd_plain_clip():
+    _run_both(lambda: opt.SGD(learning_rate=0.05, clip_gradient=0.3))
+
+
+def test_fused_adam_bias_correction():
+    _run_both(lambda: opt.Adam(learning_rate=0.01, wd=1e-4))
+
+
+def test_fused_rmsprop():
+    _run_both(lambda: opt.RMSProp(learning_rate=0.01))
+
+
+def test_fused_rmsprop_centered():
+    _run_both(lambda: opt.RMSProp(learning_rate=0.01, centered=True))
+
+
+def test_fused_adagrad():
+    _run_both(lambda: opt.AdaGrad(learning_rate=0.05))
+
+
+def test_fused_adadelta():
+    _run_both(lambda: opt.AdaDelta())
+
+
+def test_fused_ftrl():
+    _run_both(lambda: opt.Ftrl())
+
+
+def test_fused_adamax():
+    _run_both(lambda: opt.Adamax())
+
+
+def test_fused_mp_sgd_bf16():
+    import jax.numpy as jnp
+    _run_both(lambda: opt.SGD(learning_rate=0.1, momentum=0.9,
+                              multi_precision=True),
+              dtype=jnp.bfloat16, rtol=2e-2, atol=2e-2)
+
+
+def test_fused_mp_adam_bf16():
+    """Generic multi-precision wrapper: non-SGD optimizers step the fp32
+    master and cast back (was a crash: tuple state fed to adam_update)."""
+    import jax.numpy as jnp
+    _run_both(lambda: opt.Adam(learning_rate=0.01, multi_precision=True),
+              dtype=jnp.bfloat16, rtol=2e-2, atol=2e-2)
+
+
+def test_fused_preserves_low_precision_dtype():
+    """Strong-f32 traced lr must not silently promote bf16 weights/states."""
+    import jax.numpy as jnp
+    rs = np.random.RandomState(0)
+    w = nd.array(rs.normal(0, 1, (4, 4)).astype(jnp.bfloat16))
+    g = nd.array(rs.normal(0, 1, (4, 4)).astype(jnp.bfloat16))
+    upd = FusedUpdater(opt.SGD(learning_rate=0.1, momentum=0.9))
+    upd.update_all([0], [g], [w])
+    assert np.dtype(w.dtype).name == "bfloat16", w.dtype
+    assert np.dtype(upd.states[0].dtype).name == "bfloat16"
+
+
+def test_fused_unsupported_falls_back():
+    # Nadam has host-side schedule state -> per-key fallback, same numbers
+    _run_both(lambda: opt.create("nadam"))
+
+
+def test_fused_lr_scheduler_tracks_steps():
+    from mxnet_tpu.lr_scheduler import FactorScheduler
+    _run_both(lambda: opt.SGD(learning_rate=0.1,
+                              lr_scheduler=FactorScheduler(step=2, factor=0.5)))
+
+
+# -- kvstore pushpull ---------------------------------------------------------
+def test_pushpull_matches_push_pull():
+    kv_a, kv_b = mx.kv.create("local"), mx.kv.create("local")
+    rs = np.random.RandomState(3)
+    keys = ["a", "b", "c"]
+    vals = [rs.normal(0, 1, (4, 3)).astype(np.float32) for _ in keys]
+    for kv in (kv_a, kv_b):
+        kv.set_optimizer(opt.SGD(learning_rate=0.1, momentum=0.9))
+        for k, v in zip(keys, vals):
+            kv.init(k, nd.array(v))
+    grads = [[nd.array(rs.normal(0, 1, (4, 3)).astype(np.float32))
+              for _ in range(3)] for _ in keys]
+    outs_a = [[nd.zeros((4, 3))] for _ in keys]
+    outs_b = [[nd.zeros((4, 3))] for _ in keys]
+    kv_a.pushpull(keys, [list(g) for g in grads], out=outs_a)
+    for k, g, o in zip(keys, grads, outs_b):
+        kv_b.push(k, list(g))
+        kv_b.pull(k, out=o)
+    for oa, ob in zip(outs_a, outs_b):
+        np.testing.assert_allclose(oa[0].asnumpy(), ob[0].asnumpy(),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_pushpull_compression_matches():
+    kv_a, kv_b = mx.kv.create("tpu_sync"), mx.kv.create("tpu_sync")
+    rs = np.random.RandomState(5)
+    keys = [9, 11]
+    vals = [rs.normal(0, 1, (6, 5)).astype(np.float32) for _ in keys]
+    for kv in (kv_a, kv_b):
+        kv.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+        for k, v in zip(keys, vals):
+            kv.init(k, nd.array(v))
+    for step in range(3):  # residual error-feedback must track identically
+        grads = [nd.array(rs.normal(0, 1, (6, 5)).astype(np.float32))
+                 for _ in keys]
+        outs_a = [[nd.zeros((6, 5))] for _ in keys]
+        outs_b = [[nd.zeros((6, 5))] for _ in keys]
+        kv_a.pushpull(keys, [[g] for g in grads], out=outs_a)
+        for k, g, o in zip(keys, grads, outs_b):
+            kv_b.push(k, [g])
+            kv_b.pull(k, out=o)
+        for oa, ob in zip(outs_a, outs_b):
+            np.testing.assert_allclose(oa[0].asnumpy(), ob[0].asnumpy(),
+                                       rtol=1e-5, atol=1e-6)
+
+
+def test_module_fit_fused_matches_perkey_sgd():
+    """Module.fit through the fused update equals a hand-rolled per-key
+    baseline on a small MLP."""
+    import mxnet_tpu.symbol as sym_mod
+    rs = np.random.RandomState(0)
+    X = rs.normal(0, 1, (64, 10)).astype(np.float32)
+    Y = (X.sum(axis=1) > 0).astype(np.float32)
+
+    def build():
+        data = mx.sym.Variable("data")
+        h = mx.sym.FullyConnected(data, num_hidden=8, name="fc1")
+        h = mx.sym.Activation(h, act_type="relu")
+        h = mx.sym.FullyConnected(h, num_hidden=2, name="fc2")
+        return mx.sym.SoftmaxOutput(h, name="softmax")
+
+    def train(use_fused):
+        net = build()
+        mod = mx.mod.Module(net, context=mx.cpu())
+        it = mx.io.NDArrayIter(X, Y, batch_size=32)
+        mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+        mod.init_params(mx.init.Xavier(rnd_type="uniform", magnitude=2.0,
+                                       ))
+        mod.init_optimizer(optimizer="sgd",
+                           optimizer_params={"learning_rate": 0.1,
+                                             "momentum": 0.9})
+        if not use_fused:
+            # downgrade to the per-key reference path
+            mod._updater = Updater(mod._updater.optimizer)
+        for _ in range(2):
+            it.reset()
+            for batch in it:
+                mod.forward_backward(batch)
+                mod.update()
+        return {k: v.asnumpy() for k, v in mod.get_params()[0].items()}
+
+    np.random.seed(42)
+    mx.random.seed(42)
+    a = train(True)
+    np.random.seed(42)
+    mx.random.seed(42)
+    b = train(False)
+    for k in a:
+        np.testing.assert_allclose(a[k], b[k], rtol=1e-5, atol=1e-6,
+                                   err_msg=k)
