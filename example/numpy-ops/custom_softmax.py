@@ -1,0 +1,105 @@
+#!/usr/bin/env python
+"""Train an MLP whose softmax loss layer is a user-defined python operator.
+
+Behavioral parity: example/numpy-ops/custom_softmax.py — the numpy
+forward/backward run as host callbacks inside the jitted training step
+(mx.operator.CustomOp over jax.pure_callback).
+
+    python custom_softmax.py --num-epochs 2
+"""
+import argparse
+import logging
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+import mxnet_tpu as mx
+
+
+class Softmax(mx.operator.CustomOp):
+    def forward(self, is_train, req, in_data, out_data, aux):
+        x = in_data[0].asnumpy()
+        y = np.exp(x - x.max(axis=1).reshape((x.shape[0], 1)))
+        y /= y.sum(axis=1).reshape((x.shape[0], 1))
+        self.assign(out_data[0], req[0], mx.nd.array(y))
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        l = in_data[1].asnumpy().ravel().astype(int)
+        y = out_data[0].asnumpy()
+        y[np.arange(l.shape[0]), l] -= 1.0
+        self.assign(in_grad[0], req[0], mx.nd.array(y))
+
+
+@mx.operator.register("softmax")
+class SoftmaxProp(mx.operator.CustomOpProp):
+    def __init__(self):
+        super().__init__(need_top_grad=False)
+
+    def list_arguments(self):
+        return ["data", "label"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def infer_shape(self, in_shape):
+        data_shape = in_shape[0]
+        label_shape = (in_shape[0][0],)
+        output_shape = in_shape[0]
+        return [data_shape, label_shape], [output_shape], []
+
+    def infer_type(self, in_type):
+        return in_type, [in_type[0]], []
+
+    def create_operator(self, ctx, shapes, dtypes):
+        return Softmax()
+
+
+def build_mlp():
+    data = mx.symbol.Variable("data")
+    fc1 = mx.symbol.FullyConnected(data=data, name="fc1", num_hidden=128)
+    act1 = mx.symbol.Activation(data=fc1, name="relu1", act_type="relu")
+    fc2 = mx.symbol.FullyConnected(data=act1, name="fc2", num_hidden=64)
+    act2 = mx.symbol.Activation(data=fc2, name="relu2", act_type="relu")
+    fc3 = mx.symbol.FullyConnected(data=act2, name="fc3", num_hidden=10)
+    return mx.symbol.Custom(data=fc3, name="softmax", op_type="softmax")
+
+
+_CENTERS = np.random.RandomState(1234).normal(0, 1, (10, 784))
+
+
+def synthetic_mnist(n=2048, seed=0):
+    rs = np.random.RandomState(seed)
+    y = rs.randint(0, 10, n)
+    x = _CENTERS[y] + rs.normal(0, 0.3, (n, 784))
+    return x.astype(np.float32), y.astype(np.float32)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--num-epochs", type=int, default=2)
+    ap.add_argument("--batch-size", type=int, default=100)
+    args = ap.parse_args()
+    logging.basicConfig(level=logging.DEBUG)
+
+    x, y = synthetic_mnist()
+    xv, yv = synthetic_mnist(512, seed=1)
+    train = mx.io.NDArrayIter(x, y, args.batch_size, shuffle=True)
+    val = mx.io.NDArrayIter(xv, yv, args.batch_size)
+
+    mod = mx.mod.Module(build_mlp(), label_names=("softmax_label",),
+                        context=mx.cpu())
+    mod.fit(train, eval_data=val,
+            optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9,
+                              "wd": 1e-5},
+            num_epoch=args.num_epochs,
+            batch_end_callback=mx.callback.Speedometer(args.batch_size, 10))
+    score = mod.score(val, mx.metric.Accuracy())
+    print("validation accuracy:", dict(score))
+
+
+if __name__ == "__main__":
+    main()

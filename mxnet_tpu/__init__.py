@@ -46,6 +46,7 @@ from . import recordio
 from . import kvstore as kv
 from .kvstore import KVStore
 from . import model
+from . import operator
 from . import module
 from . import module as mod
 from . import parallel

@@ -154,10 +154,16 @@ class Arg:
 
 
 class ParamSchema:
-    """Validates/normalizes kwargs for an op into a canonical hashable tuple."""
+    """Validates/normalizes kwargs for an op into a canonical hashable tuple.
 
-    def __init__(self, args: List[Arg]):
+    `open_schema=True` passes unknown kwargs through as strings — the
+    `Custom` op forwards them to the user's CustomOpProp constructor
+    (parity: custom.cc keeps all kwargs as char** for the python callback).
+    """
+
+    def __init__(self, args: List[Arg], open_schema: bool = False):
         self.args = {a.name: a for a in args}
+        self.open_schema = open_schema
 
     @staticmethod
     def _canon(ty, v):
@@ -191,6 +197,9 @@ class ParamSchema:
         out = {}
         for k, v in kwargs.items():
             if k not in self.args:
+                if self.open_schema:
+                    out[k] = str(v)
+                    continue
                 raise MXNetError(f"unknown argument '{k}'; expected {sorted(self.args)}")
             out[k] = self._canon(self.args[k].type, v)
         for a in self.args.values():

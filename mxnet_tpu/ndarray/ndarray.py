@@ -107,8 +107,14 @@ class NDArray:
     wait_to_write = wait_to_read
 
     def asnumpy(self) -> _np.ndarray:
-        """Copy to host numpy (the synchronization point, as in the reference)."""
-        return _np.asarray(self._data)
+        """Copy to host numpy (the synchronization point, as in the
+        reference).  Always a WRITABLE copy — jax device buffers surface as
+        read-only views, but the reference contract (NDArray::SyncCopyToCPU)
+        hands the caller an owned buffer (custom-op backwards mutate it)."""
+        out = _np.asarray(self._data)
+        if not out.flags.writeable:
+            out = out.copy()
+        return out
 
     def asscalar(self):
         if self.size != 1:

@@ -1,0 +1,107 @@
+"""mx.operator — user-defined operators in python.
+
+Reference parity: `python/mxnet/operator.py:418-598` — the CustomOp /
+CustomOpProp / register contract every `example/numpy-ops/` demo depends
+on, backed by `src/operator/custom/custom.cc:37-79` (frontend callback op).
+
+TPU-native realization: registered props feed the `Custom` operator
+(`mxnet_tpu/ops/custom.py`), whose forward/backward run the user's numpy
+code as `jax.pure_callback` host calls inside otherwise fully-jitted
+graphs; gradients wire through `jax.custom_vjp`.  Works in `mx.nd.Custom`,
+`mx.sym.Custom(... op_type=name)`, Module training, and autograd.
+"""
+from __future__ import annotations
+
+from .base import MXNetError
+from .ops.custom import CUSTOM_PROP_REGISTRY
+
+
+class CustomOp:
+    """Base class for operators implemented in python (parity:
+    operator.py:418)."""
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        """Compute out_data from in_data; use self.assign(dst, req, src)."""
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        """Compute in_grad; use self.assign(dst, req, src)."""
+
+    def assign(self, dst, req, src):
+        """Assign src into dst honoring the write request."""
+        if req == "null":
+            return
+        elif req in ("write", "inplace"):
+            dst[:] = src
+        elif req == "add":
+            dst[:] += src
+
+
+class CustomOpProp:
+    """Base class for custom-operator property classes (parity:
+    operator.py:464): declares arguments/outputs and shape/type inference,
+    and creates the CustomOp that does the math."""
+
+    def __init__(self, need_top_grad=True):
+        self.need_top_grad_ = need_top_grad
+
+    def infer_shape(self, in_shape):
+        return in_shape, (in_shape[0],) * len(self.list_outputs()), ()
+
+    def infer_type(self, in_type):
+        return in_type, [in_type[0]] * len(self.list_outputs()), \
+            [in_type[0]] * len(self.list_auxiliary_states())
+
+    def list_outputs(self):
+        return ["output"]
+
+    def list_arguments(self):
+        return ["data"]
+
+    def list_auxiliary_states(self):
+        return []
+
+    def declare_backward_dependency(self, out_grad, in_data, out_data):
+        deps = []
+        if self.need_top_grad_:
+            deps.extend(out_grad)
+        deps.extend(in_data)
+        deps.extend(out_data)
+        return deps
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        return CustomOp()
+
+
+def register(reg_name):
+    """Register a CustomOpProp subclass under `op_type=reg_name` (parity:
+    operator.py register)."""
+
+    def do_register(prop_cls):
+        if not issubclass(prop_cls, CustomOpProp):
+            raise MXNetError(
+                "can only register subclasses of CustomOpProp")
+        CUSTOM_PROP_REGISTRY[reg_name] = prop_cls
+        return prop_cls
+
+    return do_register
+
+
+def get_all_registered_operators():
+    return list(CUSTOM_PROP_REGISTRY)
+
+
+# -- legacy v0.x interfaces (parity: operator.py NativeOp/NDArrayOp) ---------
+class PythonOp:
+    """Deprecated v0.x base — superseded by CustomOp/CustomOpProp."""
+
+    def __init__(self, *a, **kw):
+        raise MXNetError("PythonOp is deprecated; use "
+                         "mx.operator.CustomOp + CustomOpProp + register")
+
+
+class NativeOp(PythonOp):
+    pass
+
+
+class NDArrayOp(PythonOp):
+    pass

@@ -18,3 +18,4 @@ from . import sequence
 from . import vision
 from . import contrib
 from . import flash_attention
+from . import custom

@@ -1,0 +1,178 @@
+"""The `Custom` operator: user-defined python ops in symbol/ndarray graphs.
+
+Reference parity: `src/operator/custom/custom.cc:37-79` (frontend-callback
+op dispatched via MXCallbackList) + the user API contract in
+`python/mxnet/operator.py:418-598` (CustomOp/CustomOpProp/register).
+
+TPU-native realization: the user's numpy-level forward/backward run as host
+callbacks through `jax.pure_callback`, so a Custom node embeds in fully
+jitted executor/CachedOp graphs (XLA inserts the host transfer; everything
+around it still fuses).  Gradients wire through `jax.custom_vjp` so
+autograd/vjp sees the user's backward.  This is the documented escape hatch
+— host callbacks cost a device→host→device round trip per step (SURVEY.md
+§7 "hard parts": warn on perf).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as _np
+
+from ..base import Arg, MXNetError, ParamSchema
+from .registry import OP_REGISTRY, Operator
+
+# op_type -> CustomOpProp subclass (filled by mxnet_tpu.operator.register)
+CUSTOM_PROP_REGISTRY: Dict[str, type] = {}
+
+# (params, shapes, dtypes) -> CustomOp instance.  The reference creates ONE
+# operator per bound node (custom.cc CreateOp) and forward/backward share
+# it — user ops stash intermediates on self in forward and read them in
+# backward.  Host callbacks here reuse the cached instance the same way.
+_OP_INSTANCE_CACHE: Dict = {}
+
+
+def _get_op_instance(prop, pt, shapes, dtypes):
+    key = (tuple(kv for kv in pt if kv[0] != "__is_train__"),
+           tuple(tuple(s) for s in shapes),
+           tuple(str(d) for d in dtypes))
+    inst = _OP_INSTANCE_CACHE.get(key)
+    if inst is None:
+        inst = prop.create_operator(None, list(shapes), list(dtypes))
+        _OP_INSTANCE_CACHE[key] = inst
+    return inst
+
+
+def _make_prop(pd):
+    op_type = pd.get("op_type")
+    cls = CUSTOM_PROP_REGISTRY.get(op_type)
+    if cls is None:
+        raise MXNetError(
+            f"Custom op_type '{op_type}' not registered; use "
+            "@mx.operator.register(name) on a CustomOpProp subclass")
+    kwargs = {k: v for k, v in pd.items()
+              if k not in ("op_type", "__is_train__")}
+    prop = cls(**kwargs)
+    if prop.list_auxiliary_states():
+        raise MXNetError(
+            "Custom ops with auxiliary states are not supported on the "
+            "TPU backend (declare them as regular arguments instead)")
+    return prop
+
+
+def _shapes_types(prop, ins):
+    in_shapes = [tuple(x.shape) for x in ins]
+    r = prop.infer_shape(list(in_shapes))
+    in_shapes2, out_shapes = list(r[0]), list(r[1])
+    in_types = [x.dtype for x in ins]
+    rt = prop.infer_type(list(in_types))
+    out_types = list(rt[1])
+    return in_shapes2, out_shapes, in_types, out_types
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
+def _custom_core(pt, *ins):
+    outs, _ = _custom_fwd(pt, *ins)
+    return outs
+
+
+def _run_forward(pt, ins):
+    pd = dict(pt)
+    prop = _make_prop(pd)
+    _, out_shapes, in_types, out_types = _shapes_types(prop, ins)
+    is_train = bool(pd.get("__is_train__"))
+    result = [jax.ShapeDtypeStruct(tuple(int(d) for d in s),
+                                   _np.dtype(t))
+              for s, t in zip(out_shapes, out_types)]
+
+    def host_fwd(*arrs):
+        from .. import ndarray as nd
+        op = _get_op_instance(prop, pt, [a.shape for a in arrs],
+                              [a.dtype for a in arrs])
+        in_nd = [nd.array(_np.asarray(a)) for a in arrs]
+        out_nd = [nd.zeros(tuple(int(d) for d in s), dtype=t)
+                  for s, t in zip(out_shapes, out_types)]
+        op.forward(is_train, ["write"] * len(out_nd), in_nd, out_nd, [])
+        return tuple(o.asnumpy().astype(r.dtype).reshape(r.shape)
+                     for o, r in zip(out_nd, result))
+
+    outs = jax.pure_callback(host_fwd, tuple(result), *ins)
+    return tuple(outs)
+
+
+def _custom_fwd(pt, *ins):
+    outs = _run_forward(pt, ins)
+    return outs, (ins, outs)
+
+
+def _custom_bwd(pt, res, gs):
+    ins, outs = res
+    pd = dict(pt)
+    prop = _make_prop(pd)
+    result = [jax.ShapeDtypeStruct(tuple(x.shape), _np.dtype(x.dtype))
+              for x in ins]
+
+    def host_bwd(*arrs):
+        from .. import ndarray as nd
+        n_in, n_out = len(ins), len(outs)
+        in_arrs = arrs[:n_in]
+        out_arrs = arrs[n_in:n_in + n_out]
+        grad_arrs = arrs[n_in + n_out:]
+        op = _get_op_instance(prop, pt, [a.shape for a in in_arrs],
+                              [a.dtype for a in in_arrs])
+        in_nd = [nd.array(_np.asarray(a)) for a in in_arrs]
+        out_nd = [nd.array(_np.asarray(a)) for a in out_arrs]
+        og_nd = [nd.array(_np.asarray(a)) for a in grad_arrs]
+        ig_nd = [nd.zeros(tuple(x.shape), dtype=x.dtype) for x in in_nd]
+        op.backward(["write"] * len(ig_nd), og_nd, in_nd, out_nd, ig_nd, [])
+        return tuple(g.asnumpy().astype(r.dtype).reshape(r.shape)
+                     for g, r in zip(ig_nd, result))
+
+    grads = jax.pure_callback(host_bwd, tuple(result), *ins, *outs, *gs)
+    return tuple(grads)
+
+
+_custom_core.defvjp(_custom_fwd, _custom_bwd)
+
+
+def _custom(p, *ins):
+    """Parity: src/operator/custom/custom.cc — dispatch to the registered
+    CustomOpProp's operator via host callback."""
+    return _custom_core(tuple(sorted(p.items())), *ins)
+
+
+def _custom_shape_hook(p, shapes):
+    """Fill unknown input shapes (e.g. the label variable) from the prop's
+    infer_shape — the reference relies on this for Custom loss layers
+    (custom_softmax.py infers label_shape from data_shape)."""
+    known = [tuple(s) if s is not None else () for s in shapes]
+    try:
+        prop = _make_prop(dict(p))
+        corrected = list(prop.infer_shape(list(known))[0])
+    except Exception:
+        return {}
+    return {i: tuple(int(d) for d in corrected[i])
+            for i in range(len(shapes))
+            if shapes[i] is None and i < len(corrected) and corrected[i]}
+
+
+def custom_num_outputs(params) -> int:
+    prop = _make_prop(dict(params))
+    return len(prop.list_outputs())
+
+
+# registered directly (open schema: user kwargs pass through as strings)
+_custom_op = Operator(
+    name="Custom",
+    fn=_custom,
+    input_names=["args"],
+    schema=ParamSchema([Arg("op_type", str, required=True)],
+                       open_schema=True),
+    num_outputs=-1,
+    variadic=True,
+    takes_is_train=True,
+    docstring=_custom.__doc__ or "",
+)
+OP_REGISTRY["Custom"] = _custom_op

@@ -108,7 +108,13 @@ def _ce_hook(p, shp):
     return {1: (shp[0][0],)}
 
 
+def _custom_hook(p, shp):
+    from ..ops.custom import _custom_shape_hook
+    return _custom_shape_hook(p, shp)
+
+
 PARAM_SHAPE_HOOKS: Dict[str, Callable] = {
+    "Custom": _custom_hook,
     "SoftmaxOutput": _softmax_output_hook,
     "LinearRegressionOutput": _regression_hook,
     "LogisticRegressionOutput": _regression_hook,

@@ -53,7 +53,33 @@ def invoke_symbol(op_name: str, sym_inputs, kwargs, name=None, attr=None) -> Sym
     node_name = NameManager.current().get(name, hint)
     attrs = current_attrs(attr)
 
-    if op.variadic:
+    if op.name == "Custom":
+        # compose by the prop's declared arguments, auto-creating missing
+        # ones as variables (parity: Custom loss layers get their
+        # `<name>_label` variable created exactly like SoftmaxOutput)
+        from ..ops.custom import _make_prop
+        prop = _make_prop(dict(params))
+        argnames = prop.list_arguments()
+        extra_named = [k for k in named_inputs if k not in argnames]
+        if extra_named:
+            raise MXNetError(
+                f"Custom({params.get('op_type')}): unknown symbol input(s) "
+                f"{extra_named}; declared arguments are {argnames}")
+        inputs = []
+        pos = list(sym_inputs)
+        for nm in argnames:
+            if nm in named_inputs:
+                inputs.append(named_inputs[nm]._entries[0])
+            elif pos:
+                inputs.append(pos.pop(0)._entries[0])
+            else:
+                inputs.append(Variable(f"{node_name}_{nm}")._entries[0])
+        if pos:
+            raise MXNetError(
+                f"Custom({params.get('op_type')}): {len(sym_inputs)} "
+                f"positional inputs but the prop declares only "
+                f"{len(argnames)} arguments {argnames}")
+    elif op.variadic:
         inputs = [s._entries[0] for s in sym_inputs]
         # variadic ops with optional extras (LeakyReLU prelu gamma)
         if op.name == "LeakyReLU" and params.get("act_type") == "prelu" \

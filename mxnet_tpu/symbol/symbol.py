@@ -43,6 +43,9 @@ class _Node:
         op = _reg.get_op(self.op)
         if self.op in ("SliceChannel", "split"):
             return int(dict(self.params).get("num_outputs", 1))
+        if self.op == "Custom":
+            from ..ops.custom import custom_num_outputs
+            return custom_num_outputs(dict(self.params))
         if op.name == "RNN":
             return 3 if _truthy(self.params.get("state_outputs")) else 1
         if op.name in ("BatchNorm", "LayerNorm"):

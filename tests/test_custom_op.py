@@ -1,0 +1,145 @@
+"""mx.operator Custom op API (parity: python/mxnet/operator.py:418-598,
+src/operator/custom/custom.cc; reference tests: test_operator.py
+test_custom_op)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, ndarray as nd
+
+
+@mx.operator.register("sqr_t")
+class SqrProp(mx.operator.CustomOpProp):
+    def __init__(self):
+        super().__init__(need_top_grad=True)
+
+    def create_operator(self, ctx, shapes, dtypes):
+        return Sqr()
+
+
+class Sqr(mx.operator.CustomOp):
+    def forward(self, is_train, req, in_data, out_data, aux):
+        self.assign(out_data[0], req[0], in_data[0] * in_data[0])
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        self.assign(in_grad[0], req[0], 2 * in_data[0] * out_grad[0])
+
+
+def test_custom_eager_forward():
+    x = nd.array(np.arange(6, dtype=np.float32).reshape(2, 3))
+    y = nd.Custom(x, op_type="sqr_t")
+    np.testing.assert_allclose(y.asnumpy(), x.asnumpy() ** 2)
+
+
+def test_custom_autograd_backward():
+    x = nd.array(np.array([[1.0, 2.0], [3.0, 4.0]], np.float32))
+    x.attach_grad()
+    with autograd.record():
+        y = nd.Custom(x, op_type="sqr_t")
+        loss = y.sum()
+    loss.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), 2 * x.asnumpy(),
+                               rtol=1e-5)
+
+
+def test_custom_op_state_shared_fwd_bwd():
+    """forward() may stash intermediates on self for backward() — the
+    reference shares one CustomOp instance per node (custom.cc CreateOp)."""
+    @mx.operator.register("stash_t")
+    class StashProp(mx.operator.CustomOpProp):
+        def create_operator(self, ctx, shapes, dtypes):
+            class Stash(mx.operator.CustomOp):
+                def forward(self, is_train, req, in_data, out_data, aux):
+                    self.saved = in_data[0].asnumpy() * 2.0
+                    self.assign(out_data[0], req[0], in_data[0])
+
+                def backward(self, req, out_grad, in_data, out_data,
+                             in_grad, aux):
+                    self.assign(in_grad[0], req[0],
+                                nd.array(self.saved) * out_grad[0])
+            return Stash()
+
+    x = nd.array(np.array([[1.0, 3.0]], np.float32))
+    x.attach_grad()
+    with autograd.record():
+        y = nd.Custom(x, op_type="stash_t")
+        y.sum().backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), 2 * x.asnumpy())
+
+
+def test_custom_rejects_extra_inputs():
+    a, b = mx.sym.Variable("a"), mx.sym.Variable("b")
+    with pytest.raises(mx.MXNetError):
+        mx.sym.Custom(a, b, op_type="sqr_t")  # prop declares only ['data']
+
+
+def test_custom_symbol_infer_shape():
+    @mx.operator.register("concat_half")
+    class HalfProp(mx.operator.CustomOpProp):
+        def list_arguments(self):
+            return ["a", "b"]
+
+        def infer_shape(self, in_shape):
+            return [in_shape[0], in_shape[0]], \
+                [(in_shape[0][0] * 2,) + tuple(in_shape[0][1:])], []
+
+        def create_operator(self, ctx, shapes, dtypes):
+            class C(mx.operator.CustomOp):
+                def forward(self, is_train, req, in_data, out_data, aux):
+                    self.assign(out_data[0], req[0],
+                                nd.concat(in_data[0], in_data[1], dim=0))
+            return C()
+
+    a = mx.sym.Variable("a")
+    b = mx.sym.Variable("b")
+    out = mx.sym.Custom(a, b, op_type="concat_half")
+    _, out_shapes, _ = out.infer_shape(a=(2, 3), b=(2, 3))
+    assert tuple(out_shapes[0]) == (4, 3)
+
+
+def test_custom_softmax_module_trains():
+    """The reference's example/numpy-ops/custom_softmax.py contract: a
+    Custom loss layer with need_top_grad=False trains under Module.fit and
+    the label variable's shape comes from the prop's infer_shape."""
+    @mx.operator.register("softmax_t")
+    class SoftmaxProp(mx.operator.CustomOpProp):
+        def __init__(self):
+            super().__init__(need_top_grad=False)
+
+        def list_arguments(self):
+            return ["data", "label"]
+
+        def infer_shape(self, in_shape):
+            return [in_shape[0], (in_shape[0][0],)], [in_shape[0]], []
+
+        def create_operator(self, ctx, shapes, dtypes):
+            class Softmax(mx.operator.CustomOp):
+                def forward(self, is_train, req, in_data, out_data, aux):
+                    x = in_data[0].asnumpy()
+                    y = np.exp(x - x.max(axis=1, keepdims=True))
+                    y /= y.sum(axis=1, keepdims=True)
+                    self.assign(out_data[0], req[0], nd.array(y))
+
+                def backward(self, req, out_grad, in_data, out_data,
+                             in_grad, aux):
+                    lab = in_data[1].asnumpy().ravel().astype(int)
+                    y = out_data[0].asnumpy().copy()
+                    y[np.arange(lab.shape[0]), lab] -= 1.0
+                    self.assign(in_grad[0], req[0], nd.array(y))
+            return Softmax()
+
+    rs = np.random.RandomState(0)
+    y = rs.randint(0, 4, 256)
+    centers = rs.normal(0, 1, (4, 16))
+    x = (centers[y] + rs.normal(0, 0.2, (256, 16))).astype(np.float32)
+
+    data = mx.sym.Variable("data")
+    fc = mx.sym.FullyConnected(data, num_hidden=4, name="fc")
+    out = mx.sym.Custom(fc, name="softmax", op_type="softmax_t")
+
+    it = mx.io.NDArrayIter(x, y.astype(np.float32), batch_size=64)
+    mod = mx.mod.Module(out, label_names=("softmax_label",), context=mx.cpu())
+    mod.fit(it, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.5}, num_epoch=4)
+    score = dict(mod.score(it, mx.metric.Accuracy()))
+    assert score["accuracy"] > 0.9, score

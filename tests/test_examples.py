@@ -34,6 +34,12 @@ def test_train_mnist_mlp():
     assert "Validation-accuracy" in out
 
 
+def test_custom_softmax_numpy_op_example():
+    out = run_example("example/numpy-ops/custom_softmax.py",
+                      "--num-epochs", "2")
+    assert "validation accuracy" in out
+
+
 def test_train_cifar10_synthetic_resnet():
     out = run_example("example/image-classification/train_cifar10.py",
                       "--num-epochs", "1", "--num-examples", "256",
