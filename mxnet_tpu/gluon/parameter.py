@@ -47,6 +47,10 @@ class Parameter:
         self.allow_deferred_init = allow_deferred_init
         self._differentiable = differentiable
         self._stype = stype
+        # row_sparse grad_stype: Trainer casts the dense autograd gradient
+        # to RowSparse before the update, so only touched rows step
+        # (parity: gluon sparse embeddings; documented dense-detour cliff)
+        self._grad_stype = grad_stype
 
     def __repr__(self):
         return f"Parameter {self.name} (shape={self.shape}, dtype={self.dtype})"

@@ -82,9 +82,23 @@ class Trainer:
         live = [(i, p) for i, p in enumerate(self._params)
                 if p.grad_req != "null"]
         if self._kv is not None and self._update_on_kvstore:
-            self._kv.pushpull([i for i, _ in live],
-                              [p.list_grad() for _, p in live],
-                              out=[p.list_data() for _, p in live])
+            # row-sparse grad_stype params go through the kvstore per-key
+            # sparse path (class-preserving push → lazy rsp optimizer on
+            # the store) so untouched rows never decay
+            rsp = [(i, p) for i, p in live
+                   if getattr(p, "_grad_stype", "default") == "row_sparse"]
+            if rsp:
+                from ..ndarray import sparse as _sp
+                for i, p in rsp:
+                    self._kv.pushpull(
+                        i, [_sp.cast_storage(g, "row_sparse")
+                            for g in p.list_grad()],
+                        out=p.list_data())
+            dense = [ip for ip in live if ip not in rsp]
+            if dense:
+                self._kv.pushpull([i for i, _ in dense],
+                                  [p.list_grad() for _, p in dense],
+                                  out=[p.list_data() for _, p in dense])
             return
         self._allreduce_grads()
         self._update(ignore_stale_grad)
@@ -112,17 +126,31 @@ class Trainer:
                 self._kv.pull(i, out=param.list_data())
             return
         upd = self._updaters[0]
+        # one updater per device copy (parity: reference trainer keeps
+        # len(contexts) updaters so every replica is updated)
+        ncopies = max((len(p.list_data()) for _, p in live), default=1)
+        while len(self._updaters) < ncopies:
+            self._updaters.append(opt.get_updater(self._optimizer))
+        # row-sparse grad_stype params take the lazy per-key sparse path
+        # (dense autograd grad → RowSparse cast → row-wise update); the
+        # rest go through the fused multi-tensor dispatch
+        rsp = [(i, p) for i, p in live
+               if getattr(p, "_grad_stype", "default") == "row_sparse"]
+        if rsp:
+            from ..ndarray import sparse as _sp
+            for i, param in rsp:
+                for u, arr, grad in zip(self._updaters, param.list_data(),
+                                        param.list_grad()):
+                    u(i, _sp.cast_storage(grad, "row_sparse"), arr)
+            live = [ip for ip in live if ip not in rsp]
+            if not live:
+                return
         if isinstance(upd, FusedUpdater) and \
                 all(len(p.list_data()) == 1 for _, p in live):
             upd.update_all([i for i, _ in live],
                            [p.list_grad()[0] for _, p in live],
                            [p.list_data()[0] for _, p in live])
             return
-        # one updater per device copy (parity: reference trainer keeps
-        # len(contexts) updaters so every replica is updated)
-        ncopies = max((len(p.list_data()) for _, p in live), default=1)
-        while len(self._updaters) < ncopies:
-            self._updaters.append(opt.get_updater(self._optimizer))
         for i, param in live:
             for u, arr, grad in zip(self._updaters, param.list_data(),
                                     param.list_grad()):

@@ -385,6 +385,110 @@ class CSVIter(DataIter):
         return self._inner.next()
 
 
+class LibSVMIter(DataIter):
+    """LibSVM text reader yielding CSR batches (parity:
+    src/io/iter_libsvm.cc:200 — `label index:value ...` lines; optional
+    separate label file; num_parts/part_index sharding for dist training)."""
+
+    def __init__(self, data_libsvm, data_shape, label_libsvm=None,
+                 label_shape=(1,), batch_size=1, round_batch=True,
+                 num_parts=1, part_index=0, **kwargs):
+        super().__init__(batch_size)
+        self._data_shape = tuple(int(d) for d in data_shape)
+        self._label_shape = tuple(int(d) for d in label_shape)
+        labels, rows = [], []
+        with open(data_libsvm) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                parts = line.split()
+                entries = []
+                start = 0
+                if ":" not in parts[0]:
+                    labels.append(float(parts[0]))
+                    start = 1
+                else:
+                    labels.append(0.0)
+                for tok in parts[start:]:
+                    i, _, v = tok.partition(":")
+                    entries.append((int(i), float(v)))
+                rows.append(entries)
+        if label_libsvm is not None:
+            labels = []
+            with open(label_libsvm) as f:
+                for line in f:
+                    line = line.strip()
+                    if line:
+                        labels.append([float(x) for x in line.split()])
+            labels = _np.asarray(labels, _np.float32)
+        else:
+            labels = _np.asarray(labels, _np.float32)
+        if labels.ndim > 1 and labels.shape[-1] == 1 and \
+                self._label_shape == (1,):
+            labels = labels.reshape(labels.shape[0])
+        # dist-training shard (parity: num_parts/part_index fields)
+        # sparse rows stay in (index, value) form — the dataset is never
+        # materialized dense (libsvm exists for very wide feature spaces);
+        # only each BATCH densifies, inside CSRNDArray
+        self._rows = rows[part_index::num_parts]
+        self._labels = labels[part_index::num_parts]
+        self._cursor = 0
+        self._round_batch = round_batch
+
+    @property
+    def provide_data(self):
+        return [DataDesc("data", (self.batch_size,) + self._data_shape,
+                         _np.float32)]
+
+    @property
+    def provide_label(self):
+        shp = (self.batch_size,) if self._label_shape == (1,) else \
+            (self.batch_size,) + self._label_shape
+        return [DataDesc("softmax_label", shp, _np.float32)]
+
+    def reset(self):
+        self._cursor = 0
+
+    def _batch_csr(self, row_idxs):
+        ncol = self._data_shape[-1]
+        data, indices, indptr = [], [], [0]
+        for r in row_idxs:
+            for i, v in self._rows[r]:
+                if i < ncol:
+                    indices.append(i)
+                    data.append(v)
+            indptr.append(len(indices))
+        from .ndarray.sparse import CSRNDArray
+        return CSRNDArray(_np.asarray(data, _np.float32),
+                          _np.asarray(indptr, _np.int64),
+                          _np.asarray(indices, _np.int64),
+                          (len(row_idxs), ncol))
+
+    def next(self):
+        n = len(self._rows)
+        if self._cursor >= n:
+            raise StopIteration
+        lo = self._cursor
+        hi = lo + self.batch_size
+        self._cursor = hi
+        pad = 0
+        if hi > n:
+            if not self._round_batch:
+                raise StopIteration
+            pad = hi - n
+            row_idxs = list(range(lo, n)) + list(range(pad))
+            lab = _np.concatenate([self._labels[lo:], self._labels[:pad]])
+        else:
+            row_idxs = list(range(lo, hi))
+            lab = self._labels[lo:hi]
+        data = self._batch_csr(row_idxs)
+        from .ndarray import array as _arr
+        return DataBatch(data=[data], label=[_arr(lab)], pad=pad,
+                         provide_data=self.provide_data,
+                         provide_label=self.provide_label)
+
+
 def ImageRecordIter(path_imgrec, data_shape, batch_size, label_width=1,
                     shuffle=False, mean_r=0., mean_g=0., mean_b=0., std_r=1.,
                     std_g=1., std_b=1., rand_crop=False, rand_mirror=False,

@@ -157,10 +157,24 @@ class KVStore:
         If an optimizer is set (update_on_kvstore), applies the update."""
         keys, _ = _key_list(key)
         vals = _val_list(value)
+        from .ndarray.sparse import RowSparseNDArray
         for k, vlist in zip(keys, vals):
-            merged = vlist[0]
-            for v in vlist[1:]:
-                merged = merged + v
+            if len(vlist) > 1 and all(isinstance(v, RowSparseNDArray)
+                                      for v in vlist):
+                # union-of-rows reduce keeps the result row-sparse so the
+                # updater stays on the lazy path (parity: comm.h rsp Reduce)
+                import numpy as _np
+                rows = _np.unique(_np.concatenate(
+                    [_np.asarray(v._indices) for v in vlist]))
+                dense = vlist[0]._data
+                for v in vlist[1:]:
+                    dense = dense + v._data
+                merged = RowSparseNDArray(rows, jnp.take(dense, rows, axis=0),
+                                          vlist[0].shape, vlist[0].context)
+            else:
+                merged = vlist[0]
+                for v in vlist[1:]:
+                    merged = merged + v
             if self._gc is not None:
                 # parity: kvstore_dist.h PushCompressed — the worker's
                 # locally-reduced gradient is quantized on the
@@ -190,6 +204,16 @@ class KVStore:
         for k in keys:
             if k not in self._store:
                 raise MXNetError(f"key {k} has not been inited")
+        from .ndarray.sparse import BaseSparseNDArray
+        if any(isinstance(v, BaseSparseNDArray) for vl in vals for v in vl):
+            # sparse values keep their storage class through the per-key
+            # path (row-sparse lazy updates; parity: kvstore_local.h rsp)
+            outs = _val_list(out) if out is not None else [None] * len(keys)
+            for k, vl, ol in zip(keys, vals, outs):
+                self.push(k, vl)
+                if ol is not None:
+                    self.pull(k, out=ol)
+            return
         if any(len(v) > 1 for v in vals) or self._gc is not None:
             merged = self._fused_merge(keys, vals)
         else:
@@ -278,13 +302,17 @@ class KVStore:
         keys, _ = _key_list(key)
         outs = _val_list(out)
         rids = row_ids if isinstance(row_ids, (list, tuple)) else [row_ids]
+        from .ndarray.sparse import RowSparseNDArray
         for k, olist in zip(keys, outs):
             src = self._store[k]
             for o, rid in zip(olist, rids * len(olist)):
                 idx = rid.asnumpy().astype("int64").ravel()
                 rows = src.asnumpy()[idx]
-                from .ndarray.sparse import RowSparseNDArray
                 res = RowSparseNDArray(idx, rows, src.shape, src.context)
+                if isinstance(o, RowSparseNDArray):
+                    o._indices = res._indices
+                    o._values = res._values
+                    o._shape = res._shape
                 o._set_data(res._data)
 
     # -- allreduce across processes (multi-host pods) ------------------------

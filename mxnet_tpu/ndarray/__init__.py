@@ -20,6 +20,10 @@ from . import linalg
 from . import sparse
 from .sparse import CSRNDArray, RowSparseNDArray
 
+# storage-class-aware forms shadow the value-level generated ops
+cast_storage = sparse.cast_storage
+sparse_retain = sparse.retain
+
 onehot_encode = _gen.one_hot
 imdecode = None  # provided by mxnet_tpu.image
 

@@ -144,6 +144,11 @@ def csr_matrix(arg1, shape=None, ctx=None, dtype=None):
 
 def cast_storage(arr: NDArray, stype: str):
     """Parity: src/operator/tensor/cast_storage.cc."""
+    cur = getattr(arr, "stype", "default")
+    if stype == cur:
+        # dense→default returns a fresh wrapper (callers may mutate it);
+        # same-stype sparse arrays pass through (treated as immutable)
+        return NDArray(arr._data, arr._ctx) if stype == "default" else arr
     if stype == "default":
         return NDArray(arr._data, arr._ctx)
     if stype == "row_sparse":
@@ -151,6 +156,24 @@ def cast_storage(arr: NDArray, stype: str):
     if stype == "csr":
         return csr_matrix(arr)
     raise MXNetError(f"unknown stype {stype}")
+
+
+def retain(data, indices):
+    """Keep only the listed rows (parity: sparse_retain-inl.h; module-level
+    twin of RowSparseNDArray.retain)."""
+    if isinstance(data, RowSparseNDArray):
+        return data.retain(indices)
+    from .register import _gen
+    idx = indices if isinstance(indices, NDArray) else array(indices)
+    return _gen.sparse_retain(data, idx)
+
+
+def dot(lhs, rhs, transpose_a=False, transpose_b=False):
+    """Sparse dot (parity: dot-inl.h CSR×dense forms) — dense-backed
+    lowering onto the MXU; storage classes accepted on either side."""
+    from .register import _gen
+    return _gen.dot(lhs, rhs, transpose_a=transpose_a,
+                    transpose_b=transpose_b)
 
 
 def zeros_sparse(stype, shape, ctx=None, dtype=None):

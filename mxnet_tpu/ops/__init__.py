@@ -19,3 +19,4 @@ from . import vision
 from . import contrib
 from . import flash_attention
 from . import custom
+from . import sparse_ops

@@ -27,11 +27,17 @@ from .registry import OP_REGISTRY, Operator
 # op_type -> CustomOpProp subclass (filled by mxnet_tpu.operator.register)
 CUSTOM_PROP_REGISTRY: Dict[str, type] = {}
 
-# (params, shapes, dtypes) -> CustomOp instance.  The reference creates ONE
-# operator per bound node (custom.cc CreateOp) and forward/backward share
-# it — user ops stash intermediates on self in forward and read them in
-# backward.  Host callbacks here reuse the cached instance the same way.
-_OP_INSTANCE_CACHE: Dict = {}
+# (params incl __node__, shapes, dtypes) -> CustomOp instance.  The
+# reference creates ONE operator per bound node (custom.cc CreateOp) and
+# forward/backward share it — user ops stash intermediates on self in
+# forward and read them in backward.  Symbol composition injects a unique
+# `__node__` param so two identically-configured graph nodes never share
+# state; eager nd.Custom calls (no node identity) share per signature.
+# LRU-bounded so bucketing/reshape churn can't grow it unboundedly.
+from collections import OrderedDict as _OrderedDict
+
+_OP_INSTANCE_CACHE: "_OrderedDict" = _OrderedDict()
+_OP_INSTANCE_CACHE_MAX = 256
 
 
 def _get_op_instance(prop, pt, shapes, dtypes):
@@ -42,6 +48,10 @@ def _get_op_instance(prop, pt, shapes, dtypes):
     if inst is None:
         inst = prop.create_operator(None, list(shapes), list(dtypes))
         _OP_INSTANCE_CACHE[key] = inst
+        while len(_OP_INSTANCE_CACHE) > _OP_INSTANCE_CACHE_MAX:
+            _OP_INSTANCE_CACHE.popitem(last=False)
+    else:
+        _OP_INSTANCE_CACHE.move_to_end(key)
     return inst
 
 
@@ -53,7 +63,7 @@ def _make_prop(pd):
             f"Custom op_type '{op_type}' not registered; use "
             "@mx.operator.register(name) on a CustomOpProp subclass")
     kwargs = {k: v for k, v in pd.items()
-              if k not in ("op_type", "__is_train__")}
+              if k != "op_type" and not k.startswith("__")}
     prop = cls(**kwargs)
     if prop.list_auxiliary_states():
         raise MXNetError(

@@ -1,0 +1,55 @@
+"""Sparse-storage operators with symbol-space presence.
+
+Reference parity: `src/operator/tensor/cast_storage-inl.h`,
+`sparse_retain-inl.h`, `square_sum-inl.h`, and the sparse forms of `dot`
+(`dot-inl.h`).  TPU-native stance (SURVEY.md §7): XLA has no first-class
+sparsity, so compute lowers to dense masks/gathers with the reference's
+*semantics* (which rows exist, what gradients flow) preserved; the
+NDArray layer re-wraps results in the right storage class.  This is the
+documented dense-compute fallback — correct everywhere, fast where the
+MXU wants it.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..base import Arg
+from .registry import register
+
+
+@register("cast_storage", input_names=("data",),
+          args=[Arg("stype", str, required=True)])
+def _cast_storage(p, x):
+    """Parity: cast_storage-inl.h — storage conversion.  Value-level
+    identity (storage class handled by the NDArray wrapper); present in
+    symbol graphs so reference models serialize/execute unchanged."""
+    return x
+
+
+@register("sparse_retain", input_names=("data", "indices"))
+def _sparse_retain(p, x, idx):
+    """Parity: sparse_retain-inl.h — keep only the listed rows.
+
+    Dense lowering: scatter a row mask and zero everything else; the
+    gradient flows only through retained rows (matching the reference's
+    backward which is itself a sparse_retain)."""
+    mask = jnp.zeros((x.shape[0],), jnp.bool_).at[
+        idx.astype(jnp.int32)].set(True)
+    bshape = (x.shape[0],) + (1,) * (x.ndim - 1)
+    return jnp.where(mask.reshape(bshape), x, jnp.zeros((), x.dtype))
+
+
+@register("_square_sum", input_names=("data",), aliases=("square_sum",),
+          args=[Arg("axis", "shape", None), Arg("keepdims", bool, False),
+                Arg("exclude", bool, False)])
+def _square_sum(p, x):
+    """Parity: square_sum-inl.h — fused sum(x**2) (rsp-optimized in the
+    reference; one fused XLA reduction here)."""
+    axis = p["axis"]
+    if axis is not None and len(axis) == 0:
+        axis = None
+    if axis is not None and p["exclude"]:
+        axis = tuple(i for i in range(x.ndim) if i not in
+                     tuple(a % x.ndim for a in axis))
+    return jnp.sum(jnp.square(x), axis=axis, keepdims=p["keepdims"])

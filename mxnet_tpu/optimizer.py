@@ -238,6 +238,39 @@ class SGD(Optimizer):
         advances exactly once per step)."""
         self._update_count(index)
         lr, wd = self._get_lr(index), self._get_wd(index)
+        from .ndarray.sparse import RowSparseNDArray
+        if isinstance(grad, RowSparseNDArray):
+            # row-sparse lazy update: ONLY rows present in the gradient
+            # step (incl. their wd term) — parity: optimizer_op.cc
+            # SGDUpdateRspRspImpl / SGDMomUpdateRspRspImpl (+ mp variants:
+            # the fp32 master rows step and cast back)
+            rows = grad._indices
+            g = grad._values.astype(jnp.float32) * self.rescale_grad
+            if self.clip_gradient is not None:
+                g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+            if multi_precision:
+                mom_state, w32 = state
+            else:
+                mom_state, w32 = state, weight
+            master = w32._data
+            wr = jnp.take(master, rows, axis=0).astype(jnp.float32)
+            if self.momentum != 0.0 and mom_state is not None:
+                mr = jnp.take(mom_state._data, rows, axis=0) \
+                    .astype(jnp.float32)
+                new_m = self.momentum * mr - lr * (g + wd * wr)
+                mom_state._set_data(mom_state._data.at[rows].set(
+                    new_m.astype(mom_state.dtype)))
+                delta = new_m
+            else:
+                delta = -lr * (g + wd * wr)
+            new_master = master.at[rows].add(delta.astype(master.dtype))
+            if multi_precision:
+                w32._set_data(new_master)
+                weight._set_data(weight._data.at[rows].set(
+                    jnp.take(new_master, rows, axis=0).astype(weight.dtype)))
+            else:
+                weight._set_data(new_master)
+            return
         kw = self._common_kwargs()
         if multi_precision:
             inner, w32 = state
@@ -292,8 +325,30 @@ class Adam(Optimizer):
         t = self._index_update_count[index]
         lr = self._get_lr(index) * math.sqrt(1.0 - self.beta2 ** t) / \
             (1.0 - self.beta1 ** t)
+        wd = self._get_wd(index)
         mean, var = state
-        nd.adam_update(weight, grad, mean, var, lr=lr, wd=self._get_wd(index),
+        from .ndarray.sparse import RowSparseNDArray
+        if isinstance(grad, RowSparseNDArray):
+            # lazy row-sparse Adam: only gradient rows step and only their
+            # mean/var slots advance (parity: optimizer_op.cc
+            # AdamUpdateRspRspRspImpl)
+            rows = grad._indices
+            g = grad._values.astype(jnp.float32) * self.rescale_grad
+            if self.clip_gradient is not None:
+                g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+            wr = jnp.take(weight._data, rows, axis=0).astype(jnp.float32)
+            g = g + wd * wr
+            mr = jnp.take(mean._data, rows, axis=0).astype(jnp.float32)
+            vr = jnp.take(var._data, rows, axis=0).astype(jnp.float32)
+            nm = self.beta1 * mr + (1 - self.beta1) * g
+            nv = self.beta2 * vr + (1 - self.beta2) * jnp.square(g)
+            step = lr * nm / (jnp.sqrt(nv) + self.epsilon)
+            mean._set_data(mean._data.at[rows].set(nm.astype(mean.dtype)))
+            var._set_data(var._data.at[rows].set(nv.astype(var.dtype)))
+            weight._set_data(weight._data.at[rows].add(
+                (-step).astype(weight.dtype)))
+            return
+        nd.adam_update(weight, grad, mean, var, lr=lr, wd=wd,
                        beta1=self.beta1, beta2=self.beta2, epsilon=self.epsilon,
                        **self._common_kwargs())
 

@@ -79,6 +79,9 @@ def invoke_symbol(op_name: str, sym_inputs, kwargs, name=None, attr=None) -> Sym
                 f"Custom({params.get('op_type')}): {len(sym_inputs)} "
                 f"positional inputs but the prop declares only "
                 f"{len(argnames)} arguments {argnames}")
+        # unique node tag → one CustomOp instance per graph node (the
+        # reference's one-operator-per-bound-node contract, custom.cc)
+        params["__node__"] = node_name
     elif op.variadic:
         inputs = [s._entries[0] for s in sym_inputs]
         # variadic ops with optional extras (LeakyReLU prelu gamma)

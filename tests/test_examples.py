@@ -40,6 +40,14 @@ def test_custom_softmax_numpy_op_example():
     assert "validation accuracy" in out
 
 
+def test_sparse_linear_classification_example():
+    out = run_example("example/sparse/linear_classification.py",
+                      "--num-epochs", "3")
+    line = [l for l in out.splitlines() if "final train accuracy" in l][0]
+    acc = float(line.rsplit(" ", 1)[-1])
+    assert acc > 0.7, out
+
+
 def test_train_cifar10_synthetic_resnet():
     out = run_example("example/image-classification/train_cifar10.py",
                       "--num-epochs", "1", "--num-examples", "256",

@@ -107,6 +107,130 @@ def test_row_sparse_optimizer_update():
     assert_almost_equal(got[0], np.ones(2))    # untouched rows
 
 
+def test_sparse_retain_op_and_symbol():
+    """sparse_retain as a registered op with symbol presence (parity:
+    sparse_retain-inl.h)."""
+    d = np.arange(12).reshape(4, 3).astype("f")
+    out = nd.sparse_retain(nd.array(d), nd.array(np.array([0, 2])))
+    exp = d.copy()
+    exp[[1, 3]] = 0
+    assert_almost_equal(out.asnumpy(), exp)
+    # symbol space
+    s = mx.sym.sparse_retain(mx.sym.Variable("a"), mx.sym.Variable("idx"))
+    _, shp, _ = s.infer_shape(a=(4, 3), idx=(2,))
+    assert tuple(shp[0]) == (4, 3)
+    # rsp input keeps its class
+    rsp = sparse.row_sparse_array(d)
+    r = nd.sparse_retain(rsp, nd.array(np.array([0, 2])))
+    assert r.stype == "row_sparse"
+    assert list(np.asarray(r.indices.asnumpy())) == [0, 2]
+
+
+def test_square_sum_op():
+    d = np.random.RandomState(0).rand(3, 4).astype("f")
+    out = nd.square_sum(nd.array(d), axis=(1,))
+    assert_almost_equal(out.asnumpy(), (d ** 2).sum(axis=1), rtol=1e-5)
+    s = mx.sym.square_sum(mx.sym.Variable("a"), axis=(1,), keepdims=True)
+    _, shp, _ = s.infer_shape(a=(3, 4))
+    assert tuple(shp[0]) == (3, 1)
+
+
+def test_cast_storage_op_symbol_space():
+    s = mx.sym.cast_storage(mx.sym.Variable("a"), stype="row_sparse")
+    _, shp, _ = s.infer_shape(a=(4, 3))
+    assert tuple(shp[0]) == (4, 3)
+    # nd-level returns the storage class
+    out = nd.cast_storage(nd.array(np.eye(3, dtype="f")), "csr")
+    assert out.stype == "csr"
+
+
+def test_rsp_sgd_lazy_wd_semantics():
+    """Lazy row-sparse SGD: weight decay applies ONLY to gradient rows
+    (parity: optimizer_op.cc SGDUpdateRspRspImpl)."""
+    opt = mx.optimizer.SGD(learning_rate=0.1, wd=0.1)
+    w = nd.array(np.ones((4, 2), "f"))
+    grad = sparse.row_sparse_array((np.ones((1, 2), "f"), [1]), shape=(4, 2))
+    opt.update(0, w, grad, None)
+    got = w.asnumpy()
+    assert_almost_equal(got[0], np.ones(2))  # untouched: no wd decay
+    assert_almost_equal(got[1], np.ones(2) - 0.1 * (1 + 0.1), rtol=1e-5)
+
+
+def test_rsp_adam_lazy():
+    opt = mx.optimizer.Adam(learning_rate=0.1)
+    w = nd.array(np.ones((4, 2), "f"))
+    state = opt.create_state(0, w)
+    grad = sparse.row_sparse_array((np.ones((2, 2), "f"), [0, 3]),
+                                   shape=(4, 2))
+    opt.update(0, w, grad, state)
+    got = w.asnumpy()
+    assert_almost_equal(got[1], np.ones(2))  # untouched row
+    assert got[0][0] < 1.0 and got[3][0] < 1.0  # stepped rows
+    # untouched mean/var slots stay zero
+    assert_almost_equal(state[0].asnumpy()[1], np.zeros(2))
+
+
+def test_kvstore_rsp_push_pull():
+    """Row-sparse kvstore flow (parity: kvstore_local.h rsp paths +
+    tests/nightly/dist_sync_kvstore.py rsp assertions, single-process)."""
+    kv = mx.kv.create("local")
+    w0 = np.zeros((6, 2), "f")
+    kv.init("w", nd.array(w0))
+    g1 = sparse.row_sparse_array((np.ones((2, 2), "f"), [1, 4]),
+                                 shape=(6, 2))
+    g2 = sparse.row_sparse_array((2 * np.ones((2, 2), "f"), [1, 5]),
+                                 shape=(6, 2))
+    kv.push("w", [g1, g2])  # union reduce: row1=3, row4=1, row5=2
+    out = nd.zeros((6, 2))
+    kv.pull("w", out=out)
+    exp = np.zeros((6, 2), "f")
+    exp[1] = 3
+    exp[4] = 1
+    exp[5] = 2
+    assert_almost_equal(out.asnumpy(), exp)
+    # row_sparse_pull into an rsp buffer carries indices
+    buf = sparse.zeros_sparse("row_sparse", (6, 2))
+    kv.row_sparse_pull("w", out=buf, row_ids=nd.array(np.array([1, 5])))
+    assert list(np.asarray(buf.indices.asnumpy())) == [1, 5]
+    assert_almost_equal(buf.data.asnumpy(), exp[[1, 5]])
+
+
+def test_gluon_sparse_grad_embedding():
+    """nn.Embedding(sparse_grad=True): only looked-up rows update
+    (parity: gluon sparse embedding contract)."""
+    from mxnet_tpu import gluon, autograd
+    from mxnet_tpu.gluon import nn
+    emb = nn.Embedding(10, 4, sparse_grad=True)
+    emb.initialize(mx.init.One())
+    tr = gluon.Trainer(emb.collect_params(), "sgd",
+                       {"learning_rate": 1.0, "wd": 0.5})
+    x = nd.array(np.array([1, 3], "f"))
+    with autograd.record():
+        y = emb(x)
+        loss = y.sum()
+    loss.backward()
+    tr.step(1)
+    w = list(emb.collect_params().values())[0].data().asnumpy()
+    assert_almost_equal(w[0], np.ones(4))  # untouched row: no wd decay
+    assert w[1][0] < 0.0 and w[3][0] < 0.0  # stepped rows (grad 1 + wd)
+
+
+def test_libsvm_iter(tmp_path):
+    p = tmp_path / "t.libsvm"
+    p.write_text("1 0:1.5 3:2.0\n0 1:1.0\n1 2:0.5 3:1.0\n")
+    it = mx.io.LibSVMIter(data_libsvm=str(p), data_shape=(4,), batch_size=2)
+    b = next(iter(it))
+    assert b.data[0].stype == "csr"
+    assert_almost_equal(b.data[0].asnumpy(),
+                        np.array([[1.5, 0, 0, 2.0], [0, 1.0, 0, 0]], "f"))
+    assert_almost_equal(b.label[0].asnumpy(), np.array([1.0, 0.0], "f"))
+    # padded wrap-around second batch
+    b2 = it.next()
+    assert b2.pad == 1
+    it.reset()
+    assert next(iter(it)).pad == 0
+
+
 def test_sparse_save_load(tmp_path):
     vals = np.arange(4).reshape(2, 2).astype("f")
     rs = sparse.row_sparse_array((vals, [0, 3]), shape=(4, 2))
