@@ -47,6 +47,32 @@ def _apply_cpu_only_guard():
 _apply_cpu_only_guard()
 
 
+def _maybe_init_distributed():
+    """Join the jax.distributed cluster when launched by tools/launch.py
+    (MXT_COORDINATOR / MXT_NUM_PROC / MXT_PROC_ID env contract — the
+    redesign of ps-lite's DMLC_* tracker env, SURVEY.md §2.3).  Must run
+    at import, before any backend is created."""
+    coord = os.environ.get("MXT_COORDINATOR")
+    nproc = int(os.environ.get("MXT_NUM_PROC", "1") or 1)
+    if not coord or nproc <= 1:
+        return
+    pid = int(os.environ.get("MXT_PROC_ID", "0") or 0)
+    try:
+        _jax.distributed.initialize(coord, nproc, pid)
+    except RuntimeError as e:
+        # tolerate ONLY double-init (e.g. the TPU pod runtime already
+        # joined); an unreachable coordinator must fail fast — swallowing
+        # it would silently degrade to un-synchronized workers
+        if "already initialized" in str(e).lower():
+            return
+        raise MXNetError(
+            f"jax.distributed.initialize(coordinator={coord}, "
+            f"num_processes={nproc}, process_id={pid}) failed: {e}") from e
+
+
+_maybe_init_distributed()
+
+
 class MXNetError(RuntimeError):
     """Error raised by the framework (parity: mxnet.base.MXNetError)."""
 

@@ -45,12 +45,13 @@ class Context:
         'cpu'/'cpu_pinned' resolve to host CPU devices.
         """
         if self.device_type in ("cpu", "cpu_pinned"):
-            devs = jax.devices("cpu") if _has_platform("cpu") else jax.devices()
+            devs = _local("cpu") if _has_platform("cpu") else _local(None)
             return devs[min(self.device_id, len(devs) - 1)]
         accels = _accelerators()
         if not accels:
             # graceful CPU fallback, mirroring mxnet's CPU-only builds
-            return jax.devices()[min(self.device_id, len(jax.devices()) - 1)]
+            devs = _local(None)
+            return devs[min(self.device_id, len(devs) - 1)]
         if self.device_id >= len(accels):
             raise MXNetError(
                 f"{self} out of range: {len(accels)} accelerator(s) visible")
@@ -87,10 +88,21 @@ def _has_platform(name: str) -> bool:
         return False
 
 
+def _local(platform):
+    """Process-local devices only: under multi-process jax.distributed,
+    jax.devices() lists GLOBAL devices and device 0 may live on another
+    host — contexts must resolve to addressable ones (parity: each ps-lite
+    worker owned its own GPUs)."""
+    devs = jax.local_devices() if platform is None else [
+        d for d in jax.local_devices() if d.platform == platform]
+    return devs if devs else (jax.devices() if platform is None
+                              else jax.devices(platform))
+
+
 def _accelerators():
     for plat in ("tpu", "gpu", "cuda", "rocm"):
         if _has_platform(plat):
-            return jax.devices(plat)
+            return _local(plat)
     return []
 
 

@@ -97,8 +97,12 @@ def allreduce_hosts_many(arrs):
                      out_shardings=repl)
         _host_sum_cache[key] = fn
     summed = fn(glob)
+    # fully-replicated result → hand back the process-LOCAL copy so later
+    # single-device ops (optimizer updates, pulls) never trigger
+    # cross-host transfers
+    local = [s.addressable_data(0) for s in summed]
     return [NDArray(s, a.context) if isinstance(a, NDArray) else s
-            for s, a in zip(summed, arrs)]
+            for s, a in zip(local, arrs)]
 
 
 def allreduce_hosts(arr):

@@ -1,0 +1,84 @@
+"""Multi-process dist_sync kvstore worker (parity:
+tests/nightly/dist_sync_kvstore.py:33-60 — push/pull math across workers,
+barrier, 2-bit compression on the cross-host leg, fused pushpull).
+
+Launched by tests/test_dist.py via tools/launch.py -n 2; each process joins
+the jax.distributed cluster (MXT_* env, consumed at mxnet_tpu import) and
+the kvstore's cross-host reduce rides the process-aware (hosts, local)
+mesh (mxnet_tpu/parallel/collectives.py allreduce_hosts_many).
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np
+import mxnet_tpu as mx
+from mxnet_tpu import ndarray as nd
+import jax
+
+
+def main():
+    rank = jax.process_index()
+    nw = jax.process_count()
+    assert nw == 2, f"expected 2 processes, got {nw}"
+
+    kv = mx.kv.create("dist_sync")
+    assert kv.rank == rank and kv.num_workers == 2
+
+    # -- push/pull sum across workers (dist_sync_kvstore.py test_sync_push_pull)
+    shape = (4, 3)
+    kv.init("w", nd.zeros(shape))
+    g = nd.array(np.full(shape, rank + 1.0, np.float32))
+    kv.push("w", [g])
+    out = nd.zeros(shape)
+    kv.pull("w", out=out)
+    np.testing.assert_allclose(out.asnumpy(), np.full(shape, 3.0), rtol=1e-6)
+
+    # -- barrier
+    kv.barrier()
+
+    # -- fused pushpull with a kvstore-side optimizer across hosts
+    kv2 = mx.kv.create("dist_sync")
+    kv2.set_optimizer(mx.optimizer.SGD(learning_rate=1.0, rescale_grad=1.0,
+                                       wd=0.0))
+    kv2.init(3, nd.zeros(shape))
+    outb = nd.zeros(shape)
+    kv2.pushpull(3, [g], out=[outb])
+    # w <- w - lr * (g_rank0 + g_rank1) = -(1+2)
+    np.testing.assert_allclose(outb.asnumpy(), np.full(shape, -3.0),
+                               rtol=1e-6)
+
+    # -- 2-bit compression with error feedback on the cross-host leg
+    # (dist_sync_kvstore.py compressed-gradient assertions)
+    kv3 = mx.kv.create("dist_sync")
+    kv3.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+    kv3.init("c", nd.zeros((8,)))
+
+    def quant(v, r, thr=0.5):
+        x = v + r
+        q = np.where(x >= thr, thr,
+                     np.where(x <= -thr, -thr, 0.0)).astype(np.float32)
+        return q, x - q
+
+    rs = np.random.RandomState(0)
+    grads = [rs.normal(0, 1, (2, 8)).astype(np.float32) for _ in range(3)]
+    residuals = [np.zeros(8, np.float32) for _ in range(2)]
+    for s in range(3):
+        kv3.push("c", [nd.array(grads[s][rank])])
+        o = nd.zeros((8,))
+        kv3.pull("c", out=o)
+        expected = np.zeros(8, np.float32)
+        for w in range(2):
+            q, residuals[w] = quant(grads[s][w], residuals[w])
+            expected += q
+        np.testing.assert_allclose(o.asnumpy(), expected, rtol=1e-6,
+                                   err_msg=f"step {s}")
+
+    kv3.barrier()
+    print(f"DIST_OK rank={rank}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

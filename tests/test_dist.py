@@ -1,0 +1,30 @@
+"""Multi-process distributed kvstore CI (parity model:
+tests/nightly/dist_sync_kvstore.py run via tools/launch.py -n 2
+--launcher local — real separate processes, cross-process collectives)."""
+import os
+import socket
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_dist_sync_kvstore_two_processes():
+    env = {**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONPATH": REPO}
+    env.pop("MXT_COORDINATOR", None)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "launch.py"),
+         "-n", "2", "--coordinator", f"127.0.0.1:{_free_port()}",
+         sys.executable, os.path.join(REPO, "tests", "dist_sync_kvstore.py")],
+        env=env, capture_output=True, text=True, timeout=420)
+    out = proc.stdout + proc.stderr
+    assert proc.returncode == 0, out
+    assert "DIST_OK rank=0" in out and "DIST_OK rank=1" in out, out
