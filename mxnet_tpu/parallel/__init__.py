@@ -18,6 +18,7 @@ from .data_parallel import shard_batch, replicate, DataParallelStep
 from . import sequence_parallel
 from .sequence_parallel import ring_attention, ulysses_attention
 from . import pipeline
-from .pipeline import gpipe, gpipe_sharded
+from .pipeline import (gpipe, gpipe_sharded, pipeline_1f1b,
+                       pipeline_train_step)
 from . import expert
 from .expert import switch_moe, switch_moe_sharded

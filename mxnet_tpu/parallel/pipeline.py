@@ -83,3 +83,141 @@ def gpipe_sharded(stage_fn: Callable, stacked_params, x, mesh: Mesh,
                   P()),
         out_specs=P(), check_vma=False)
     return fn(stacked_params, x)
+
+
+def pipeline_1f1b(stage_fn: Callable, stage_params, x, y, loss_fn: Callable,
+                  n_microbatches: int, n_stages: int, axis_name: str = "pp"):
+    """1F1B (PipeDream-flush) pipeline TRAINING step — call inside shard_map.
+
+    Unlike `gpipe` + outer AD (which keeps all M microbatch activations
+    live until the flush), 1F1B starts each microbatch's backward as soon
+    as the last stage finishes its forward, so only O(pipeline_depth)
+    activations are ever stashed — memory is bounded by 2S-1 microbatch
+    inputs regardless of M.  The backward recomputes the stage forward
+    from the stashed INPUT (rematerialization — the
+    `MXNET_BACKWARD_DO_MIRROR` trade, graph_executor.cc:282-305, applied
+    per stage), so the stash holds inputs only, not residuals.
+
+    Schedule (tick t, stage s, S stages, M microbatches):
+      forward  of microbatch m runs at t = m + s
+      backward of microbatch m runs at t = m + 2(S-1) - s + 1
+    so the activation cotangent computed by stage s+1 at tick T arrives at
+    stage s (ppermute down) exactly at its backward tick T+1, and the last
+    stage alternates F,B,F,B — the 1F1B steady state.  Total 2(M+S-1)
+    ticks.
+
+    stage_fn(params, h) -> h          one stage
+    loss_fn(out, y_mb) -> scalar      per-microbatch loss (last stage)
+    Returns (loss_sum_over_microbatches, param_grads) for THIS stage.
+    """
+    S = n_stages
+    M = n_microbatches
+    s = lax.axis_index(axis_name)
+    assert x.shape[0] % M == 0, (x.shape, M)
+    mb = x.shape[0] // M
+    micro = x.reshape((M, mb) + x.shape[1:])
+    ymicro = y.reshape((M, mb) + y.shape[1:])
+    cap = 2 * S - 1
+    up = [(i, (i + 1) % S) for i in range(S)]
+    down = [((i + 1) % S, i) for i in range(S)]
+
+    act_shape = (mb,) + x.shape[1:]
+    act_in0 = jnp.zeros(act_shape, x.dtype)
+    cot_in0 = jnp.zeros(act_shape, x.dtype)
+    stash0 = jnp.zeros((cap,) + act_shape, x.dtype)
+    grads0 = jax.tree_util.tree_map(
+        lambda p: jnp.zeros(p.shape, jnp.float32), stage_params)
+
+    def body(t, carry):
+        act_in, cot_in, stash, grads, loss_acc = carry
+        fwd_m = t - s
+        do_fwd = (fwd_m >= 0) & (fwd_m < M)
+        bwd_m = t - (2 * (S - 1) - s + 1)
+        do_bwd = (bwd_m >= 0) & (bwd_m < M)
+        fwd_idx = jnp.clip(fwd_m, 0, M - 1)
+        bwd_idx = jnp.clip(bwd_m, 0, M - 1)
+
+        # read the backward's stashed input BEFORE the forward overwrites
+        # its ring slot: stage 0's in-flight window is exactly `cap` ticks,
+        # so microbatch m+cap lands in m's slot on m's backward tick
+        h_st = stash[bwd_idx % cap]
+
+        # ---- forward tick: stage 0 ingests microbatch fwd_m, others take
+        # the activation handed over by the previous stage
+        h_in = jnp.where(s == 0, micro[fwd_idx], act_in)
+        out = stage_fn(stage_params, h_in)
+        stash = stash.at[jnp.where(do_fwd, fwd_m % cap, cap)].set(
+            h_in, mode="drop")
+
+        # ---- backward tick: recompute forward from the stashed input,
+        # seed the cotangent (last stage: from the loss; others: from the
+        # next stage's ppermute) and pull grads through the stage vjp
+        o2, vjp = jax.vjp(stage_fn, stage_params, h_st)
+        loss_m, loss_vjp = jax.vjp(lambda o: loss_fn(o, ymicro[bwd_idx]), o2)
+        seed = loss_vjp(jnp.ones((), loss_m.dtype))[0]
+        g_in = jnp.where(s == S - 1, seed.astype(cot_in.dtype), cot_in)
+        dp, dh = vjp(g_in)
+        # NaN-safe masking: a vjp evaluated on a zero-initialized stash may
+        # be non-finite (sqrt/log at 0) and 0*inf would poison the sum
+        grads = jax.tree_util.tree_map(
+            lambda a, b: a + jnp.where(do_bwd, b.astype(jnp.float32), 0.0),
+            grads, dp)
+        loss_acc = loss_acc + jnp.where(
+            do_bwd & (s == S - 1), loss_m.astype(jnp.float32), 0.0)
+
+        act_in = lax.ppermute(out, axis_name, up)
+        cot_in = lax.ppermute(dh, axis_name, down)
+        return act_in, cot_in, stash, grads, loss_acc
+
+    T = 2 * (M + S - 1)
+    carry = (act_in0, cot_in0, stash0, grads0, jnp.zeros((), jnp.float32))
+    _, _, _, grads, loss_acc = lax.fori_loop(0, T, body, carry)
+    loss = lax.psum(loss_acc, axis_name)  # lives on the last stage only
+    # grads accumulate in f32; hand back in param dtype so the two
+    # schedules are drop-in interchangeable (gpipe returns param dtype)
+    grads = jax.tree_util.tree_map(lambda g, p: g.astype(p.dtype),
+                                   grads, stage_params)
+    return loss, grads
+
+
+def pipeline_train_step(stage_fn: Callable, stacked_params, x, y,
+                        loss_fn: Callable, mesh: Mesh, n_microbatches: int,
+                        schedule: str = "1f1b", axis_name: str = "pp"):
+    """One pipeline-parallel training step over the mesh's `axis_name`.
+
+    schedule='gpipe': forward via the GPipe fill-drain loop, backward via
+    outer AD (all microbatch activations live — reference-style mirror
+    memory).  schedule='1f1b': bounded-memory 1F1B above.
+
+    Both return (loss, grads) where loss = SUM over microbatches of
+    loss_fn(out_mb, y_mb) and grads has the same stage-stacked layout as
+    `stacked_params` (leading axis = n_stages, sharded on the pp axis).
+    """
+    S = mesh.shape[axis_name]
+    M = n_microbatches
+    if schedule == "gpipe":
+        def total_loss(params):
+            out = gpipe_sharded(stage_fn, params, x, mesh, M, axis_name)
+            outs = out.reshape((M, out.shape[0] // M) + out.shape[1:])
+            ys = y.reshape((M, y.shape[0] // M) + y.shape[1:])
+            losses = jax.vmap(loss_fn)(outs, ys)
+            return jnp.sum(losses)
+
+        return jax.value_and_grad(total_loss)(stacked_params)
+    if schedule != "1f1b":
+        raise ValueError(f"unknown pipeline schedule '{schedule}'")
+
+    def per_device(params, xs, ys):
+        squeezed = jax.tree_util.tree_map(lambda a: a[0], params)
+        loss, grads = pipeline_1f1b(stage_fn, squeezed, xs, ys, loss_fn,
+                                    M, S, axis_name)
+        return loss, jax.tree_util.tree_map(lambda g: g[None], grads)
+
+    fn = shard_map(
+        per_device, mesh=mesh,
+        in_specs=(jax.tree_util.tree_map(lambda _: P(axis_name),
+                                         stacked_params), P(), P()),
+        out_specs=(P(), jax.tree_util.tree_map(lambda _: P(axis_name),
+                                               stacked_params)),
+        check_vma=False)
+    return fn(stacked_params, x, y)

@@ -123,6 +123,101 @@ def test_gpipe_microbatch_counts():
                             rtol=1e-5, atol=1e-6)
 
 
+def test_1f1b_matches_sequential_and_gpipe():
+    """1F1B training step: loss + per-stage grads equal sequential autodiff
+    and the GPipe schedule (bounded-memory schedule changes nothing
+    numerically)."""
+    rs = np.random.RandomState(11)
+    S, B, D = 4, 16, 8
+    M = 8
+    ws = jnp.asarray(rs.normal(0, 0.5, (S, D, D)).astype("f"))
+    bs = jnp.asarray(rs.normal(0, 0.1, (S, D)).astype("f"))
+    x = jnp.asarray(rs.normal(0, 1, (B, D)).astype("f"))
+    y = jnp.asarray(rs.normal(0, 1, (B, D)).astype("f"))
+
+    def stage_fn(params, h):
+        w, b = params
+        return jnp.tanh(h @ w + b)
+
+    def loss_fn(out, yy):
+        return jnp.mean((out - yy) ** 2)
+
+    # sequential reference: sum over microbatches of per-microbatch loss
+    def ref_loss(params):
+        total = 0.0
+        for m in range(M):
+            h = x[m * (B // M):(m + 1) * (B // M)]
+            for i in range(S):
+                h = stage_fn((params[0][i], params[1][i]), h)
+            total = total + loss_fn(h, y[m * (B // M):(m + 1) * (B // M)])
+        return total
+
+    ref_l, ref_g = jax.value_and_grad(ref_loss)((ws, bs))
+
+    m = cpu_mesh((S,), ("pp",))
+    for sched in ("1f1b", "gpipe"):
+        loss, grads = parallel.pipeline_train_step(
+            stage_fn, (ws, bs), x, y, loss_fn, m, M, schedule=sched)
+        assert_almost_equal(np.asarray(loss), np.asarray(ref_l),
+                            rtol=1e-5, atol=1e-6)
+        for g, rg in zip(grads, ref_g):
+            assert_almost_equal(np.asarray(g), np.asarray(rg),
+                                rtol=1e-4, atol=1e-5)
+
+
+def test_1f1b_nan_safe_masking():
+    """A stage vjp that is non-finite at the zero-initialized stash must
+    not poison masked (inactive-tick) gradient accumulation."""
+    rs = np.random.RandomState(13)
+    S, B, D = 2, 8, 4
+    ws = jnp.asarray(rs.normal(0, 0.5, (S, D, D)).astype("f"))
+    x = jnp.asarray(np.abs(rs.normal(1, 0.2, (B, D))).astype("f"))
+    y = jnp.asarray(rs.normal(0, 1, (B, D)).astype("f"))
+
+    def stage_fn(w, h):
+        return jnp.sqrt(jnp.abs(h)) @ w * 0.1 + 1.0  # d/dh infinite at 0
+
+    def loss_fn(out, yy):
+        return jnp.mean((out - yy) ** 2)
+
+    m = cpu_mesh((S,), ("pp",))
+    l1, g1 = parallel.pipeline_train_step(stage_fn, ws, x, y, loss_fn, m, 4,
+                                          schedule="1f1b")
+    l2, g2 = parallel.pipeline_train_step(stage_fn, ws, x, y, loss_fn, m, 4,
+                                          schedule="gpipe")
+    assert np.isfinite(np.asarray(g1)).all()
+    assert_almost_equal(np.asarray(l1), np.asarray(l2), rtol=1e-5, atol=1e-6)
+    assert_almost_equal(np.asarray(g1), np.asarray(g2), rtol=1e-4, atol=1e-5)
+    assert np.asarray(g1).dtype == np.asarray(ws).dtype
+
+
+def test_1f1b_microbatch_counts():
+    rs = np.random.RandomState(12)
+    S, B, D = 2, 12, 6
+    ws = jnp.asarray(rs.normal(0, 0.5, (S, D, D)).astype("f"))
+    x = jnp.asarray(rs.normal(0, 1, (B, D)).astype("f"))
+    y = jnp.asarray(rs.normal(0, 1, (B, D)).astype("f"))
+
+    def stage_fn(w, h):
+        return jnp.tanh(h @ w)
+
+    def loss_fn(out, yy):
+        return jnp.mean((out - yy) ** 2)
+
+    m = cpu_mesh((S,), ("pp",))
+    base = None
+    for M in (2, 3, 6):
+        loss, grads = parallel.pipeline_train_step(
+            stage_fn, ws, x, y, loss_fn, m, M, schedule="1f1b")
+        # total loss depends on microbatch granularity (sum of means);
+        # normalize to per-example for comparison
+        norm = float(np.asarray(loss)) / M
+        if base is None:
+            base = norm
+        else:
+            assert abs(norm - base) < 1e-5, (M, norm, base)
+
+
 def test_gpipe_differentiable():
     rs = np.random.RandomState(5)
     S, B, D = 2, 4, 8
