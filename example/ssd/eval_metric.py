@@ -1,0 +1,123 @@
+"""VOC mean-average-precision metric for detection (behavioral parity:
+example/ssd/evaluate/eval_metric.py MApMetric / VOC07MApMetric).
+
+update() consumes (labels, preds) where
+  labels: (B, M, 5+)  [cls, x1, y1, x2, y2, ...] padded with -1 rows
+  preds:  (B, N, 6)   MultiBoxDetection output [cls, score, x1, y1, x2, y2]
+"""
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+from mxnet_tpu import metric as _metric
+from mxnet_tpu.ndarray import NDArray
+
+
+class MApMetric(_metric.EvalMetric):
+    """Mean AP with configurable IOU threshold (parity: MApMetric)."""
+
+    def __init__(self, ovp_thresh=0.5, use_difficult=False, class_names=None,
+                 pred_idx=0):
+        super().__init__("mAP")
+        self.ovp_thresh = ovp_thresh
+        self.use_difficult = use_difficult
+        self.class_names = class_names
+        self.pred_idx = int(pred_idx)
+        self.reset()
+
+    def reset(self):
+        self.records = {}   # cls -> list of (score, tp)
+        self.counts = {}    # cls -> num gt boxes
+        self.num_inst = 0
+        self.sum_metric = 0.0
+
+    @staticmethod
+    def _iou(box, boxes):
+        ix1 = np.maximum(box[0], boxes[:, 0])
+        iy1 = np.maximum(box[1], boxes[:, 1])
+        ix2 = np.minimum(box[2], boxes[:, 2])
+        iy2 = np.minimum(box[3], boxes[:, 3])
+        iw = np.maximum(0, ix2 - ix1)
+        ih = np.maximum(0, iy2 - iy1)
+        inter = iw * ih
+        a1 = (box[2] - box[0]) * (box[3] - box[1])
+        a2 = (boxes[:, 2] - boxes[:, 0]) * (boxes[:, 3] - boxes[:, 1])
+        return inter / np.maximum(a1 + a2 - inter, 1e-12)
+
+    def update(self, labels, preds):
+        lab = labels[0] if isinstance(labels, (list, tuple)) else labels
+        prd = preds[self.pred_idx] if isinstance(preds, (list, tuple)) else preds
+        lab = lab.asnumpy() if isinstance(lab, NDArray) else np.asarray(lab)
+        prd = prd.asnumpy() if isinstance(prd, NDArray) else np.asarray(prd)
+        for b in range(lab.shape[0]):
+            gts = lab[b][lab[b][:, 0] >= 0]
+            dets = prd[b][prd[b][:, 0] >= 0]
+            matched = np.zeros(len(gts), bool)
+            for c in np.unique(gts[:, 0]).astype(int):
+                self.counts[c] = self.counts.get(c, 0) + int(
+                    (gts[:, 0] == c).sum())
+            order = np.argsort(-dets[:, 1]) if len(dets) else []
+            for di in order:
+                d = dets[di]
+                c = int(d[0])
+                self.records.setdefault(c, [])
+                cls_gt = np.where(gts[:, 0] == c)[0]
+                tp = 0
+                if len(cls_gt):
+                    ious = self._iou(d[2:6], gts[cls_gt, 1:5])
+                    best = int(np.argmax(ious))
+                    if ious[best] >= self.ovp_thresh and \
+                            not matched[cls_gt[best]]:
+                        matched[cls_gt[best]] = True
+                        tp = 1
+                self.records[c].append((float(d[1]), tp))
+
+    def _average_precision(self, rec, prec):
+        """All-points interpolated AP (parity: MApMetric)."""
+        mrec = np.concatenate(([0.0], rec, [1.0]))
+        mpre = np.concatenate(([0.0], prec, [0.0]))
+        for i in range(len(mpre) - 2, -1, -1):
+            mpre[i] = max(mpre[i], mpre[i + 1])
+        idx = np.where(mrec[1:] != mrec[:-1])[0]
+        return float(np.sum((mrec[idx + 1] - mrec[idx]) * mpre[idx + 1]))
+
+    def _ap_for_class(self, c):
+        n_gt = self.counts.get(c, 0)
+        if n_gt == 0:
+            return None
+        recs = sorted(self.records.get(c, []), key=lambda r: -r[0])
+        if not recs:
+            return 0.0
+        tps = np.cumsum([r[1] for r in recs])
+        rec = tps / n_gt
+        prec = tps / np.arange(1, len(tps) + 1)
+        return self._average_precision(rec, prec)
+
+    def get(self):
+        # class id = index into class_names (MultiBoxDetection emits ids);
+        # classes with no ground truth are excluded from the mean
+        by_id = {c: self._ap_for_class(c) for c in sorted(self.counts)}
+        aps = [v for v in by_id.values() if v is not None]
+        mAP = float(np.mean(aps)) if aps else 0.0
+        if self.class_names is None:
+            return ("mAP", mAP)
+        names, vals = [], []
+        for i, cname in enumerate(self.class_names):
+            if by_id.get(i) is not None:
+                names.append(f"{cname} AP")
+                vals.append(by_id[i])
+        return (names + ["mAP"], vals + [mAP])
+
+
+class VOC07MApMetric(MApMetric):
+    """AP by the VOC07 11-point method (parity: VOC07MApMetric)."""
+
+    def _average_precision(self, rec, prec):
+        ap = 0.0
+        for t in np.arange(0.0, 1.1, 0.1):
+            prec_at = prec[rec >= t]
+            ap += (float(np.max(prec_at)) if prec_at.size else 0.0) / 11.0
+        return ap

@@ -99,6 +99,49 @@ def synthetic_detection_batch(rs, batch, num_classes, size=32):
     return imgs, labels
 
 
+def make_rec_dataset(path, rs, n, num_classes, size=32):
+    """Pack a synthetic shapes dataset into a .rec file with detection
+    labels (format: [header_w=2, obj_w=5, (cls,x1,y1,x2,y2)*nobj] — the
+    ImageDetRecordIter wire format, tools/im2rec det-list convention)."""
+    from mxnet_tpu import recordio
+    writer = recordio.MXRecordIO(path, "w")
+    for i in range(n):
+        img = (rs.normal(0.1, 0.05, (size, size, 3)) * 255).clip(0, 255)
+        nobj = rs.randint(1, 3)
+        label = [2.0, 5.0]
+        for _ in range(nobj):
+            cls = rs.randint(num_classes)
+            w, h = rs.uniform(0.3, 0.5, 2)
+            x1 = rs.uniform(0, 1 - w)
+            y1 = rs.uniform(0, 1 - h)
+            xi1, yi1 = int(x1 * size), int(y1 * size)
+            xi2, yi2 = int((x1 + w) * size), int((y1 + h) * size)
+            img[yi1:yi2, xi1:xi2, cls % 3] = 200 + 20 * cls
+            label += [float(cls), x1, y1, x1 + w, y1 + h]
+        header = recordio.IRHeader(0, np.asarray(label, np.float32), i, 0)
+        writer.write(recordio.pack_img(header, img.astype(np.uint8),
+                                       quality=95, img_fmt=".png"))
+    writer.close()
+
+
+def train_from_batches(mod, batch_iter, epochs):
+    for epoch in range(epochs):
+        tot_cls = nb = 0
+        for batch in batch_iter():
+            mod.forward_backward(batch)
+            mod.update()
+            outs = mod.get_outputs()
+            cls_prob, cls_t = outs[0].asnumpy(), outs[2].asnumpy()
+            matched = cls_t > 0   # masked NLL of the matched anchors
+            if matched.any():
+                idx = np.where(matched)
+                probs = cls_prob[idx[0], cls_t[matched].astype(int), idx[1]]
+                tot_cls += float(-np.log(np.maximum(probs, 1e-8)).mean())
+            nb += 1
+        logging.info("Epoch[%d] cls-NLL(matched)=%.3f", epoch,
+                     tot_cls / max(nb, 1))
+
+
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("--epochs", type=int, default=2)
@@ -106,42 +149,72 @@ def main():
     p.add_argument("--num-classes", type=int, default=3)
     p.add_argument("--batches-per-epoch", type=int, default=20)
     p.add_argument("--lr", type=float, default=0.005)
+    p.add_argument("--data-source", choices=("rec", "synthetic"),
+                   default="rec",
+                   help="rec = pack a .rec file and train through "
+                        "ImageDetRecordIter (the reference pipeline); "
+                        "synthetic = in-memory batches")
+    p.add_argument("--rec-path", type=str, default="")
+    p.add_argument("--num-examples", type=int, default=320)
     args = p.parse_args()
 
     net = build_ssd(args.num_classes)
     rs = np.random.RandomState(0)
-    imgs, labels = synthetic_detection_batch(rs, args.batch_size,
-                                             args.num_classes)
+
+    if args.data_source == "rec":
+        import tempfile
+        rec_path = args.rec_path or os.path.join(tempfile.mkdtemp(),
+                                                 "ssd_train.rec")
+        if not os.path.exists(rec_path):
+            make_rec_dataset(rec_path, rs, args.num_examples,
+                             args.num_classes)
+        train_iter = mx.io.ImageDetRecordIter(
+            path_imgrec=rec_path, data_shape=(3, 32, 32),
+            batch_size=args.batch_size, shuffle=True,
+            rand_mirror_prob=0.5, label_pad_width=4,
+            mean_r=127, mean_g=127, mean_b=127,
+            std_r=60, std_g=60, std_b=60)
+        data_shape = train_iter.provide_data[0].shape
+        label_shape = train_iter.provide_label[0].shape
+    else:
+        imgs, labels = synthetic_detection_batch(rs, args.batch_size,
+                                                 args.num_classes)
+        data_shape, label_shape = imgs.shape, labels.shape
 
     mod = mx.mod.Module(net, data_names=("data",), label_names=("label",),
                         context=mx.cpu())
-    mod.bind(data_shapes=[("data", imgs.shape)],
-             label_shapes=[("label", labels.shape)])
+    mod.bind(data_shapes=[("data", data_shape)],
+             label_shapes=[("label", label_shape)])
     mod.init_params(mx.init.Xavier(magnitude=2))
     mod.init_optimizer(optimizer="adam",
                        optimizer_params={"learning_rate": args.lr})
 
-    for epoch in range(args.epochs):
-        tot_cls = 0.0
-        for _ in range(args.batches_per_epoch):
-            imgs, labels = synthetic_detection_batch(
-                rs, args.batch_size, args.num_classes)
-            batch = mx.io.DataBatch(data=[mx.nd.array(imgs)],
-                                    label=[mx.nd.array(labels)])
-            mod.forward_backward(batch)
-            mod.update()
-            outs = mod.get_outputs()
-            cls_prob, cls_t = outs[0].asnumpy(), outs[2].asnumpy()
-            # masked NLL of the matched anchors
-            matched = cls_t > 0
-            if matched.any():
-                idx = np.where(matched)
-                probs = cls_prob[idx[0], cls_t[matched].astype(int), idx[1]]
-                tot_cls += float(-np.log(np.maximum(probs, 1e-8)).mean())
-        logging.info("Epoch[%d] cls-NLL(matched)=%.3f", epoch,
-                     tot_cls / args.batches_per_epoch)
+    if args.data_source == "rec":
+        def batch_iter():
+            train_iter.reset()
+            return train_iter
+    else:
+        def batch_iter():
+            for _ in range(args.batches_per_epoch):
+                imgs, labels = synthetic_detection_batch(
+                    rs, args.batch_size, args.num_classes)
+                yield mx.io.DataBatch(data=[mx.nd.array(imgs)],
+                                      label=[mx.nd.array(labels)])
 
-    # inference pass: decoded detections
+    train_from_batches(mod, batch_iter, args.epochs)
+
+    # evaluation: decoded detections → VOC mAP (parity: example/ssd/evaluate.py)
+    from eval_metric import VOC07MApMetric
+    vmetric = VOC07MApMetric(ovp_thresh=0.4)
+    if args.data_source == "rec":
+        train_iter.reset()
+        for batch in train_iter:
+            mod.forward(batch, is_train=False)
+            det = mod.get_outputs()[3]
+            n = batch.data[0].shape[0] - batch.pad  # drop padded rows
+            vmetric.update([batch.label[0][:n]], [det[:n]])
+        name, value = vmetric.get()
+        logging.info("VOC07 %s=%.4f", name, value)
     outs = mod.get_outputs()
     det = outs[3].asnumpy()
     kept = (det[:, :, 0] >= 0).sum()

@@ -106,7 +106,10 @@ class Augmenter:
 
     def dumps(self):
         import json
-        return json.dumps([self.__class__.__name__.lower(), self._kwargs])
+        # numpy values (mean/std arrays) serialize via tolist/str fallback
+        return json.dumps([self.__class__.__name__.lower(), self._kwargs],
+                          default=lambda o: o.tolist()
+                          if hasattr(o, "tolist") else str(o))
 
     def __call__(self, src):
         raise NotImplementedError
@@ -184,6 +187,60 @@ class ContrastJitterAug(Augmenter):
         coef = _np.array([[[0.299, 0.587, 0.114]]])
         gray = (src.asnumpy() * coef).sum() * (3.0 / src.size)
         return [src * alpha + gray * (1.0 - alpha)]
+
+
+class SaturationJitterAug(Augmenter):
+    """Parity: image.py SaturationJitterAug — blend with per-pixel gray."""
+
+    def __init__(self, saturation):
+        super().__init__(saturation=saturation)
+        self.saturation = saturation
+
+    def __call__(self, src):
+        alpha = 1.0 + _pyrandom.uniform(-self.saturation, self.saturation)
+        arr = src.asnumpy() if isinstance(src, NDArray) else _np.asarray(src)
+        coef = _np.array([[[0.299, 0.587, 0.114]]])
+        gray = (arr * coef).sum(axis=2, keepdims=True)
+        return [nd.array(arr * alpha + gray * (1.0 - alpha))]
+
+
+class ColorJitterAug(Augmenter):
+    """Parity: image.py ColorJitterAug — random-order brightness/contrast/
+    saturation jitter."""
+
+    def __init__(self, brightness, contrast, saturation):
+        super().__init__(brightness=brightness, contrast=contrast,
+                         saturation=saturation)
+        self.ts = []
+        if brightness > 0:
+            self.ts.append(BrightnessJitterAug(brightness))
+        if contrast > 0:
+            self.ts.append(ContrastJitterAug(contrast))
+        if saturation > 0:
+            self.ts.append(SaturationJitterAug(saturation))
+
+    def __call__(self, src):
+        order = list(range(len(self.ts)))
+        _pyrandom.shuffle(order)
+        for i in order:
+            src = self.ts[i](src)[0]
+        return [src]
+
+
+class RandomGrayAug(Augmenter):
+    """Parity: image.py RandomGrayAug — convert to 3-channel gray w.p. p."""
+
+    def __init__(self, p):
+        super().__init__(p=p)
+        self.p = p
+
+    def __call__(self, src):
+        if _pyrandom.random() < self.p:
+            arr = src.asnumpy() if isinstance(src, NDArray) else _np.asarray(src)
+            coef = _np.array([[[0.299, 0.587, 0.114]]])
+            gray = (arr * coef).sum(axis=2, keepdims=True)
+            src = nd.array(_np.repeat(gray, 3, axis=2))
+        return [src]
 
 
 class ColorNormalizeAug(Augmenter):
@@ -363,3 +420,10 @@ class ImageRecordIterPy(ImageIter):
         super().__init__(batch_size, data_shape, label_width,
                          path_imgrec=path_imgrec, shuffle=shuffle,
                          aug_list=aug)
+
+
+# -- detection pipeline (parity: python/mxnet/image/detection.py namespace:
+# mx.image.ImageDetIter / CreateDetAugmenter / Det*Aug) --------------------
+from .detection import (DetAugmenter, DetBorrowAug, DetRandomSelectAug,  # noqa: E402,F401
+                        DetHorizontalFlipAug, DetRandomCropAug,
+                        DetRandomPadAug, CreateDetAugmenter, ImageDetIter)

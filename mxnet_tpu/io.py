@@ -247,7 +247,8 @@ class PrefetchingIter(DataIter):
         super().__init__(self.iter.batch_size)
         self.rename_data = rename_data
         self.rename_label = rename_label
-        self._queue: _queue.Queue = _queue.Queue(maxsize=depth)
+        self._depth = int(depth)
+        self._queue: _queue.Queue = _queue.Queue(maxsize=self._depth)
         self._stop = threading.Event()
         self._thread = None
         self._start()
@@ -273,6 +274,9 @@ class PrefetchingIter(DataIter):
             except StopIteration:
                 self._queue.put(None)
                 return
+            except BaseException as e:  # surface in the consumer thread
+                self._queue.put(e)
+                return
             self._queue.put(batch)
 
     def _start(self):
@@ -290,13 +294,15 @@ class PrefetchingIter(DataIter):
             self._thread.join(timeout=5)
         self.iter.reset()
         self._stop = threading.Event()
-        self._queue = _queue.Queue(maxsize=2)
+        self._queue = _queue.Queue(maxsize=self._depth)
         self._start()
 
     def next(self):
         batch = self._queue.get()
         if batch is None:
             raise StopIteration
+        if isinstance(batch, BaseException):
+            raise batch  # re-raise the worker's failure where the user is
         return batch
 
     def iter_next(self):
@@ -487,6 +493,15 @@ class LibSVMIter(DataIter):
         return DataBatch(data=[data], label=[_arr(lab)], pad=pad,
                          provide_data=self.provide_data,
                          provide_label=self.provide_label)
+
+
+def ImageDetRecordIter(path_imgrec, data_shape, batch_size, **kwargs):
+    """Detection recordio iterator (parity:
+    src/io/iter_image_det_recordio.cc:582) — det-aware augmenters, labels
+    (B, max_objs, obj_width) padded with -1; see mxnet_tpu.detection."""
+    from .detection import ImageDetRecordIter as _impl
+    return _impl(path_imgrec=path_imgrec, data_shape=data_shape,
+                 batch_size=batch_size, **kwargs)
 
 
 def ImageRecordIter(path_imgrec, data_shape, batch_size, label_width=1,

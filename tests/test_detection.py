@@ -1,0 +1,136 @@
+"""Detection pipeline tests (parity model: reference test_image.py
+ImageDetIter cases + example/ssd evaluate metrics)."""
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import recordio
+from mxnet_tpu.detection import (DetHorizontalFlipAug, DetRandomCropAug,
+                                 DetRandomPadAug, ImageDetIter,
+                                 CreateDetAugmenter)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _pack_rec(path, n=12, size=24, seed=0):
+    rs = np.random.RandomState(seed)
+    writer = recordio.MXRecordIO(path, "w")
+    for i in range(n):
+        img = (rs.rand(size, size, 3) * 255).astype(np.uint8)
+        nobj = rs.randint(1, 4)
+        label = [2.0, 5.0]
+        for _ in range(nobj):
+            cls = rs.randint(3)
+            w, h = rs.uniform(0.2, 0.4, 2)
+            x1, y1 = rs.uniform(0, 1 - w), rs.uniform(0, 1 - h)
+            label += [float(cls), x1, y1, x1 + w, y1 + h]
+        header = recordio.IRHeader(0, np.asarray(label, np.float32), i, 0)
+        writer.write(recordio.pack_img(header, img, img_fmt=".png"))
+    writer.close()
+    return path
+
+
+def test_det_label_parse_and_padding(tmp_path):
+    rec = _pack_rec(str(tmp_path / "d.rec"))
+    it = ImageDetIter(batch_size=4, data_shape=(3, 24, 24),
+                      path_imgrec=rec, aug_list=[])
+    # label shape inferred from the dataset's max object count
+    assert it.provide_label[0].shape[2] == 5
+    b = it.next()
+    lab = b.label[0].asnumpy()
+    assert lab.shape == (4,) + it.label_shape
+    # pad rows are -1; real rows have valid boxes
+    for r in lab.reshape(-1, 5):
+        if r[0] < 0:
+            assert (r == -1).all()
+        else:
+            assert r[3] > r[1] and r[4] > r[2]
+
+
+def test_det_label_pad_width_validation(tmp_path):
+    rec = _pack_rec(str(tmp_path / "d.rec"))
+    with pytest.raises(mx.MXNetError):
+        ImageDetIter(batch_size=4, data_shape=(3, 24, 24), path_imgrec=rec,
+                     aug_list=[], label_pad_width=1)  # < max objects
+
+
+def test_det_hflip_boxes():
+    label = np.array([[0, 0.1, 0.2, 0.4, 0.6]], np.float32)
+    img = np.arange(2 * 4 * 3, dtype=np.float32).reshape(2, 4, 3)
+    aug = DetHorizontalFlipAug(p=1.1)  # always flip
+    out, lab = aug(img, label)
+    np.testing.assert_allclose(lab[0], [0, 0.6, 0.2, 0.9, 0.6], rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(out), img[:, ::-1, :])
+
+
+def test_det_random_crop_keeps_valid_boxes():
+    np.random.seed(0)
+    label = np.array([[1, 0.4, 0.4, 0.6, 0.6]], np.float32)
+    img = np.random.rand(40, 40, 3).astype(np.float32)
+    aug = DetRandomCropAug(min_object_covered=0.5, area_range=(0.5, 1.0))
+    for _ in range(5):
+        out, lab = aug(img, label)
+        assert lab.shape[1] == 5 and len(lab) >= 1
+        assert (lab[:, 3] > lab[:, 1]).all() and (lab[:, 4] > lab[:, 2]).all()
+        assert (lab[:, 1:] >= 0).all() and (lab[:, 1:] <= 1).all()
+
+
+def test_det_random_pad_shrinks_boxes():
+    np.random.seed(1)
+    label = np.array([[0, 0.0, 0.0, 1.0, 1.0]], np.float32)
+    img = np.random.rand(20, 20, 3).astype(np.float32)
+    aug = DetRandomPadAug(area_range=(2.0, 2.5))
+    out, lab = aug(img, label)
+    assert out.shape[0] >= 20 and out.shape[1] >= 20
+    area = (lab[0, 3] - lab[0, 1]) * (lab[0, 4] - lab[0, 2])
+    assert area < 1.0  # boxes shrink relative to the padded canvas
+
+
+def test_image_det_record_iter_epochs(tmp_path):
+    rec = _pack_rec(str(tmp_path / "d.rec"), n=10)
+    it = mx.io.ImageDetRecordIter(path_imgrec=rec, data_shape=(3, 24, 24),
+                                  batch_size=5, rand_mirror_prob=0.5,
+                                  label_pad_width=4)
+    for _ in range(2):
+        n = 0
+        for b in it:
+            assert b.data[0].shape == (5, 3, 24, 24)
+            n += 1
+        assert n == 2
+        it.reset()
+
+
+def test_prefetch_propagates_worker_errors():
+    class Boom(mx.io.DataIter):
+        def __init__(self):
+            super().__init__(2)
+
+        def next(self):
+            raise ValueError("decode exploded")
+
+    it = mx.io.PrefetchingIter(Boom())
+    with pytest.raises(ValueError, match="decode exploded"):
+        it.next()
+
+
+def test_voc_map_metric():
+    sys.path.insert(0, os.path.join(REPO, "example", "ssd"))
+    from eval_metric import MApMetric, VOC07MApMetric
+    labels = np.array([[[0, 0.1, 0.1, 0.5, 0.5],
+                        [1, 0.6, 0.6, 0.9, 0.9]]], np.float32)
+    # perfect predictions -> mAP 1.0
+    preds = np.array([[[0, 0.9, 0.1, 0.1, 0.5, 0.5],
+                       [1, 0.8, 0.6, 0.6, 0.9, 0.9]]], np.float32)
+    for cls in (MApMetric, VOC07MApMetric):
+        m = cls(ovp_thresh=0.5)
+        m.update([mx.nd.array(labels)], [mx.nd.array(preds)])
+        assert abs(m.get()[1] - 1.0) < 1e-6, cls.__name__
+    # one wrong-located prediction for class 0 -> its AP drops
+    bad = np.array([[[0, 0.9, 0.6, 0.6, 0.9, 0.9],
+                     [1, 0.8, 0.6, 0.6, 0.9, 0.9]]], np.float32)
+    m = VOC07MApMetric(ovp_thresh=0.5)
+    m.update([mx.nd.array(labels)], [mx.nd.array(bad)])
+    assert m.get()[1] < 0.6

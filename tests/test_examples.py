@@ -191,7 +191,16 @@ def test_word_lm_example():
 
 
 def test_ssd_example():
+    # rec path: packs a det .rec, trains via ImageDetRecordIter, VOC mAP
     out = run_example("example/ssd/train_ssd.py", "--epochs", "1",
+                      "--num-examples", "64", "--batch-size", "8")
+    assert "detections kept" in out
+    assert "VOC07 mAP" in out
+
+
+def test_ssd_example_synthetic():
+    out = run_example("example/ssd/train_ssd.py", "--epochs", "1",
+                      "--data-source", "synthetic",
                       "--batches-per-epoch", "4", "--batch-size", "8")
     assert "detections kept" in out
 
