@@ -55,25 +55,35 @@ class MApMetric(_metric.EvalMetric):
         for b in range(lab.shape[0]):
             gts = lab[b][lab[b][:, 0] >= 0]
             dets = prd[b][prd[b][:, 0] >= 0]
+            # column 6 marks difficult objects (VOC): unless use_difficult,
+            # they don't count as GT and matches to them are ignored
+            # (parity: reference eval_metric.py gt_count/difficult logic)
+            difficult = gts[:, 5] > 0 if (
+                gts.shape[1] > 5 and not self.use_difficult) else \
+                np.zeros(len(gts), bool)
             matched = np.zeros(len(gts), bool)
-            for c in np.unique(gts[:, 0]).astype(int):
+            easy = gts[~difficult]
+            for c in np.unique(easy[:, 0]).astype(int):
                 self.counts[c] = self.counts.get(c, 0) + int(
-                    (gts[:, 0] == c).sum())
+                    (easy[:, 0] == c).sum())
             order = np.argsort(-dets[:, 1]) if len(dets) else []
             for di in order:
                 d = dets[di]
                 c = int(d[0])
                 self.records.setdefault(c, [])
                 cls_gt = np.where(gts[:, 0] == c)[0]
-                tp = 0
                 if len(cls_gt):
                     ious = self._iou(d[2:6], gts[cls_gt, 1:5])
                     best = int(np.argmax(ious))
-                    if ious[best] >= self.ovp_thresh and \
-                            not matched[cls_gt[best]]:
-                        matched[cls_gt[best]] = True
-                        tp = 1
-                self.records[c].append((float(d[1]), tp))
+                    gi = cls_gt[best]
+                    if ious[best] >= self.ovp_thresh:
+                        if difficult[gi]:
+                            continue  # neither TP nor FP
+                        if not matched[gi]:
+                            matched[gi] = True
+                            self.records[c].append((float(d[1]), 1))
+                            continue
+                self.records[c].append((float(d[1]), 0))
 
     def _average_precision(self, rec, prec):
         """All-points interpolated AP (parity: MApMetric)."""

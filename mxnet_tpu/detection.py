@@ -240,7 +240,8 @@ def CreateDetAugmenter(data_shape, resize=0, rand_crop=0, rand_pad=0,
         mean = _np.array([123.68, 116.28, 103.53])
     if std is True:
         std = _np.array([58.395, 57.12, 57.375])
-    if mean is not None and _np.any(_np.asarray(mean)):
+    if mean is not None or std is not None:
+        # reference gate (detection.py:618): normalize when EITHER is given
         auglist.append(DetBorrowAug(_img.ColorNormalizeAug(mean, std)))
     return auglist
 

@@ -304,6 +304,7 @@ class ImageIter(_io.DataIter):
         else:
             self.imgrec = None
         self.imglist = None
+        self._rec_offsets = None
         self.path_root = path_root
         if path_imglist:
             imglist_d = {}
@@ -330,6 +331,18 @@ class ImageIter(_io.DataIter):
             self.seq = imgkeys
         elif self.imgidx is not None:
             self.seq = self.imgidx
+        elif shuffle and self.imgrec is not None:
+            # no index file: scan the .rec once for record offsets so
+            # shuffle is real (the reference asserts path_imgidx instead;
+            # seekable python records make the index unnecessary)
+            self._rec_offsets = []
+            while True:
+                pos = self.imgrec.tell()
+                if self.imgrec.read() is None:
+                    break
+                self._rec_offsets.append(pos)
+            self.imgrec.reset()
+            self.seq = list(range(len(self._rec_offsets)))
         else:
             self.seq = None
         assert len(data_shape) == 3 and data_shape[0] == 3 or data_shape[0] == 1
@@ -367,7 +380,11 @@ class ImageIter(_io.DataIter):
             idx = self.seq[self.cur]
             self.cur += 1
             if self.imgrec is not None:
-                s = self.imgrec.read_idx(idx)
+                if self._rec_offsets is not None:
+                    self.imgrec.seek(self._rec_offsets[idx])
+                    s = self.imgrec.read()
+                else:
+                    s = self.imgrec.read_idx(idx)
                 header, img = recordio.unpack(s)
                 return header.label, img
             label, fname = self.imglist[idx]

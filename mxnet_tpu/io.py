@@ -276,7 +276,8 @@ class PrefetchingIter(DataIter):
                 return
             except BaseException as e:  # surface in the consumer thread
                 self._queue.put(e)
-                return
+                self._queue.put(None)  # then StopIteration: a consumer
+                return                 # that swallows the error won't hang
             self._queue.put(batch)
 
     def _start(self):

@@ -456,6 +456,105 @@ def _deformable_conv(p, data, offset, weight, bias=None):
     return out
 
 
+@register("khatri_rao", input_names=("args",), variadic=True)
+def _khatri_rao(p, *mats):
+    """Column-wise Khatri-Rao product (parity: src/operator/contrib/
+    krprod.h — per-column Kronecker products): inputs (r_i, k) with a
+    shared column count k → output (prod r_i, k)."""
+    out = mats[0]
+    for m in mats[1:]:
+        out = (out[:, None, :] * m[None, :, :]).reshape(-1, m.shape[1])
+    return out
+
+
+@register("_contrib_DeformablePSROIPooling",
+          input_names=("data", "rois", "trans"),
+          aliases=("DeformablePSROIPooling",),
+          args=[Arg("spatial_scale", float, required=True),
+                Arg("output_dim", int, required=True),
+                Arg("group_size", int, required=True),
+                Arg("pooled_size", int, required=True),
+                Arg("part_size", int, 0),
+                Arg("sample_per_part", int, 4),
+                Arg("trans_std", float, 0.0),
+                Arg("no_trans", bool, False)])
+def _deformable_psroi_pooling(p, data, rois, trans=None):
+    """Deformable position-sensitive ROI pooling (parity:
+    src/operator/contrib/deformable_psroi_pooling.cc): each pooled cell's
+    sampling window shifts by a learned per-part offset
+    trans[(cls*2[+1]), part_h, part_w] * trans_std * roi_size; samples
+    falling outside the image are excluded from the bin average (masked
+    mean).  Differentiable through the bilinear sampling and the offsets.
+    """
+    k = p["pooled_size"]
+    D = p["output_dim"]
+    gs = p["group_size"] or k
+    ps = p["part_size"] or k
+    S = p["sample_per_part"]
+    scale = p["spatial_scale"]
+    no_trans = p["no_trans"] or trans is None
+    tstd = p["trans_std"]
+    N, C, H, W = data.shape
+    ncls = 1 if no_trans else trans.shape[1] // 2
+    per_cls = D // ncls
+    from jax.scipy.ndimage import map_coordinates
+
+    def per_roi(roi, tr):
+        b = roi[0].astype(jnp.int32)
+        # reference rounds roi coords then offsets by half a pixel
+        x1 = jnp.round(roi[1]) * scale - 0.5
+        y1 = jnp.round(roi[2]) * scale - 0.5
+        x2 = (jnp.round(roi[3]) + 1.0) * scale - 0.5
+        y2 = (jnp.round(roi[4]) + 1.0) * scale - 0.5
+        rw = jnp.maximum(x2 - x1, 0.1)
+        rh = jnp.maximum(y2 - y1, 0.1)
+        bw, bh = rw / k, rh / k
+        sub_w, sub_h = bw / S, bh / S
+        img = data[b]
+
+        def pool_channel(d):
+            cls = d // per_cls
+
+            def cell(i, j):
+                if no_trans:
+                    dx = dy = 0.0
+                else:
+                    pi = i * ps // k
+                    pj = j * ps // k
+                    dx = tr[cls * 2, pi, pj] * tstd * rw
+                    dy = tr[cls * 2 + 1, pi, pj] * tstd * rh
+                ws = j * bw + x1 + dx
+                hs = i * bh + y1 + dy
+                # reference kernel samples at sub-bin LEFT edges
+                # (deformable_psroi_pooling.cu: w = wstart + iw*sub_bin)
+                sx = ws + jnp.arange(S) * sub_w
+                sy = hs + jnp.arange(S) * sub_h
+                gy = jnp.repeat(sy, S)
+                gx = jnp.tile(sx, S)
+                valid = ((gx > -0.5) & (gx < W - 0.5) &
+                         (gy > -0.5) & (gy < H - 0.5))
+                gh = i * gs // k
+                gw = j * gs // k
+                ch = (d * gs + gh) * gs + gw
+                vals = map_coordinates(img[ch],
+                                       [jnp.clip(gy, 0, H - 1),
+                                        jnp.clip(gx, 0, W - 1)],
+                                       order=1, mode="nearest")
+                cnt = jnp.maximum(valid.sum(), 1)
+                return jnp.where(valid, vals, 0.0).sum() / cnt
+
+            return jnp.stack([jnp.stack([cell(i, j) for j in range(k)])
+                              for i in range(k)])
+
+        return jnp.stack([pool_channel(d) for d in range(D)])
+
+    if no_trans:
+        tr0 = jnp.zeros((rois.shape[0], 2, ps, ps), data.dtype)
+    else:
+        tr0 = trans
+    return jax.vmap(per_roi)(rois, tr0)
+
+
 @register("_contrib_PSROIPooling", input_names=("data", "rois"),
           aliases=("PSROIPooling",),
           args=[Arg("spatial_scale", float, required=True),

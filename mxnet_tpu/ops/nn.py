@@ -56,6 +56,7 @@ def _tup(v, n, default=1):
 
 
 @register("Convolution", input_names=("data", "weight", "bias"),
+          aliases=("Convolution_v1",),
           args=[Arg("kernel", "shape", required=True), Arg("stride", "shape", ()),
                 Arg("dilate", "shape", ()), Arg("pad", "shape", ()),
                 Arg("num_filter", int, required=True), Arg("num_group", int, 1),

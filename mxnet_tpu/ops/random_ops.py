@@ -134,3 +134,68 @@ def _sample_normal(p, mu, sigma, key):
     z = jax.random.normal(key, shp, np_dtype(p["dtype"]))
     bs = mu.shape + (1,) * len(p["shape"] or ())
     return mu.reshape(bs) + z * sigma.reshape(bs)
+
+
+def _sample_bshape(p, param):
+    """(param_shape + sample_shape, param broadcast shape) — parity:
+    multisample_op.h: one batch of samples per distribution parameter."""
+    shp = param.shape + (p["shape"] or ())
+    return shp, param.shape + (1,) * len(p["shape"] or ())
+
+
+@register("_sample_gamma", input_names=("alpha", "beta"), needs_rng=True,
+          differentiable=False,
+          args=[Arg("shape", "shape", ()), Arg("dtype", str, "float32")],
+          aliases=("sample_gamma",))
+def _sample_gamma(p, alpha, beta, key):
+    """Parity: sample_op.cc _sample_gamma — per-element (alpha, beta)."""
+    shp, bs = _sample_bshape(p, alpha)
+    g = jax.random.gamma(key, alpha.reshape(bs), shp)
+    return (g * beta.reshape(bs)).astype(np_dtype(p["dtype"]))
+
+
+@register("_sample_exponential", input_names=("lam",), needs_rng=True,
+          differentiable=False,
+          args=[Arg("shape", "shape", ()), Arg("dtype", str, "float32")],
+          aliases=("sample_exponential",))
+def _sample_exponential(p, lam, key):
+    shp, bs = _sample_bshape(p, lam)
+    e = jax.random.exponential(key, shp)
+    return (e / lam.reshape(bs)).astype(np_dtype(p["dtype"]))
+
+
+@register("_sample_poisson", input_names=("lam",), needs_rng=True,
+          differentiable=False,
+          args=[Arg("shape", "shape", ()), Arg("dtype", str, "float32")],
+          aliases=("sample_poisson",))
+def _sample_poisson(p, lam, key):
+    shp, bs = _sample_bshape(p, lam)
+    s = jax.random.poisson(key, jnp.broadcast_to(lam.reshape(bs), shp))
+    return s.astype(np_dtype(p["dtype"]))
+
+
+@register("_sample_negative_binomial", input_names=("k", "p"), needs_rng=True,
+          differentiable=False,
+          args=[Arg("shape", "shape", ()), Arg("dtype", str, "float32")],
+          aliases=("sample_negative_binomial",))
+def _sample_negative_binomial(p, k, prob, key):
+    """NB(k, p) = Poisson(Gamma(k, (1-p)/p)) per element."""
+    shp, bs = _sample_bshape(p, k)
+    k1, k2 = jax.random.split(key)
+    lam = jax.random.gamma(k1, k.astype(jnp.float32).reshape(bs), shp) * \
+        ((1 - prob) / prob).reshape(bs)
+    return jax.random.poisson(k2, lam).astype(np_dtype(p["dtype"]))
+
+
+@register("_sample_generalized_negative_binomial", input_names=("mu", "alpha"),
+          needs_rng=True, differentiable=False,
+          args=[Arg("shape", "shape", ()), Arg("dtype", str, "float32")],
+          aliases=("sample_generalized_negative_binomial",))
+def _sample_gen_negative_binomial(p, mu, alpha, key):
+    """GNB(mu, alpha) = Poisson(Gamma(1/alpha, mu*alpha)) per element."""
+    shp, bs = _sample_bshape(p, mu)
+    k1, k2 = jax.random.split(key)
+    inv_a = (1.0 / jnp.maximum(alpha, 1e-12)).reshape(bs)
+    lam = jax.random.gamma(k1, jnp.broadcast_to(inv_a, shp)) * \
+        (mu * alpha).reshape(bs)
+    return jax.random.poisson(k2, lam).astype(np_dtype(p["dtype"]))

@@ -66,6 +66,16 @@ class MXRecordIO:
         self.close()
         self.open()
 
+    def tell(self) -> int:
+        """Current byte offset (start of the next record)."""
+        return self.record.tell()
+
+    def seek(self, pos: int) -> None:
+        """Jump to a record offset previously returned by tell() (read
+        mode) — enables shuffled access over plain .rec files."""
+        assert not self.writable
+        self.record.seek(pos)
+
     def write(self, buf: bytes):
         assert self.writable
         data = struct.pack("<II", _KMAGIC, len(buf)) + buf

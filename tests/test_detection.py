@@ -103,6 +103,38 @@ def test_image_det_record_iter_epochs(tmp_path):
         it.reset()
 
 
+def test_det_rec_shuffle_is_real(tmp_path):
+    """shuffle=True over a plain .rec must reorder records across epochs
+    (offset-index scan; the reference required a separate .idx file)."""
+    rec = _pack_rec(str(tmp_path / "d.rec"), n=16)
+    np.random.seed(3)
+    it = ImageDetIter(batch_size=16, data_shape=(3, 24, 24),
+                      path_imgrec=rec, aug_list=[], shuffle=True)
+    orders = []
+    for _ in range(3):
+        b = it.next()
+        # first box x1 of each image fingerprints the record order
+        orders.append(tuple(np.round(b.label[0].asnumpy()[:, 0, 1], 5)))
+        it.reset()
+    assert len(set(orders)) > 1, orders
+    assert sorted(orders[0]) == sorted(orders[1])  # same records
+
+
+def test_voc_map_difficult_objects():
+    sys.path.insert(0, os.path.join(REPO, "example", "ssd"))
+    from eval_metric import VOC07MApMetric
+    # one easy + one difficult gt (column 6 == 1); detector finds both
+    labels = np.array([[[0, 0.1, 0.1, 0.5, 0.5, 0],
+                        [0, 0.6, 0.6, 0.9, 0.9, 1]]], np.float32)
+    preds = np.array([[[0, 0.9, 0.1, 0.1, 0.5, 0.5],
+                       [0, 0.8, 0.6, 0.6, 0.9, 0.9]]], np.float32)
+    m = VOC07MApMetric(ovp_thresh=0.5)
+    m.update([mx.nd.array(labels)], [mx.nd.array(preds)])
+    # difficult gt excluded from denominator; its match is neither TP nor FP
+    assert abs(m.get()[1] - 1.0) < 1e-6
+    assert m.counts[0] == 1
+
+
 def test_prefetch_propagates_worker_errors():
     class Boom(mx.io.DataIter):
         def __init__(self):
@@ -113,6 +145,9 @@ def test_prefetch_propagates_worker_errors():
 
     it = mx.io.PrefetchingIter(Boom())
     with pytest.raises(ValueError, match="decode exploded"):
+        it.next()
+    # a consumer that swallowed the error must not hang: StopIteration next
+    with pytest.raises(StopIteration):
         it.next()
 
 

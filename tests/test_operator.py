@@ -487,3 +487,98 @@ def test_psroi_pooling_group_size():
         for j in range(k):
             expected = (i * gs // k) * gs + (j * gs // k)
             assert abs(got[i, j] - expected) < 1e-4, (i, j, got[i, j])
+
+
+# -- round-2 op gap closures (VERDICT missing #7) ---------------------------
+def test_sample_vector_param_samplers():
+    """Per-element distribution parameters (parity: sample_op.cc
+    _sample_gamma/exponential/poisson/negative_binomial/gnb)."""
+    mx.random.seed(7)
+    alpha = nd.array(np.array([1.0, 4.0], "f"))
+    beta = nd.array(np.array([1.0, 2.0], "f"))
+    g = nd.sample_gamma(alpha, beta, shape=(2000,))
+    assert g.shape == (2, 2000)
+    m = g.asnumpy().mean(axis=1)
+    assert abs(m[0] - 1.0) < 0.2 and abs(m[1] - 8.0) < 0.8  # mean=a*b
+
+    lam = nd.array(np.array([0.5, 4.0], "f"))
+    e = nd.sample_exponential(lam, shape=(2000,))
+    me = e.asnumpy().mean(axis=1)
+    assert abs(me[0] - 2.0) < 0.3 and abs(me[1] - 0.25) < 0.05
+
+    po = nd.sample_poisson(lam, shape=(2000,))
+    mp = po.asnumpy().mean(axis=1)
+    assert abs(mp[0] - 0.5) < 0.1 and abs(mp[1] - 4.0) < 0.3
+
+    k = nd.array(np.array([2.0, 8.0], "f"))
+    prob = nd.array(np.array([0.5, 0.5], "f"))
+    nb = nd.sample_negative_binomial(k, prob, shape=(3000,))
+    mnb = nb.asnumpy().mean(axis=1)   # mean = k(1-p)/p
+    assert abs(mnb[0] - 2.0) < 0.4 and abs(mnb[1] - 8.0) < 1.0
+
+    mu = nd.array(np.array([2.0, 5.0], "f"))
+    al = nd.array(np.array([0.2, 0.5], "f"))
+    gnb = nd.sample_generalized_negative_binomial(mu, al, shape=(3000,))
+    mg = gnb.asnumpy().mean(axis=1)   # mean = mu
+    assert abs(mg[0] - 2.0) < 0.4 and abs(mg[1] - 5.0) < 0.9
+
+
+def test_khatri_rao():
+    A = np.array([[1., 2.], [3., 4.]], "f")          # (2, 2)
+    B = np.array([[1., 0.], [0., 1.], [2., 3.]], "f")  # (3, 2)
+    out = nd.khatri_rao(nd.array(A), nd.array(B))
+    assert out.shape == (6, 2)
+    exp = np.stack([np.kron(A[:, j], B[:, j]) for j in range(2)], axis=1)
+    assert_almost_equal(out.asnumpy(), exp, rtol=1e-6)
+
+
+def test_deformable_psroi_pooling_matches_psroi_at_zero_offsets():
+    """With no_trans (zero offsets) the deformable op reduces to plain
+    position-sensitive pooling over the score maps."""
+    rs = np.random.RandomState(0)
+    k, D, gs = 2, 3, 2
+    C = D * gs * gs
+    data = nd.array(rs.rand(1, C, 8, 8).astype("f"))
+    rois = nd.array(np.array([[0, 0, 0, 7, 7]], "f"))
+    out = nd.DeformablePSROIPooling(data, rois, nd.zeros((1, 2, k, k)),
+                                    spatial_scale=1.0, output_dim=D,
+                                    group_size=gs, pooled_size=k,
+                                    no_trans=True)
+    assert out.shape == (1, D, k, k)
+    assert np.isfinite(out.asnumpy()).all()
+    # channel selection rule: output (d, i, j) pools channel (d*gs+gh)*gs+gw
+    d_np = data.asnumpy()[0]
+    got = out.asnumpy()[0]
+    for d in range(D):
+        for i in range(k):
+            ch = (d * gs + (i * gs // k)) * gs + 0
+            lo = d_np[ch].min() - 1e-5
+            hi = d_np[ch].max() + 1e-5
+            assert lo <= got[d, i, 0] <= hi
+
+
+def test_deformable_psroi_pooling_offsets_differentiable():
+    from mxnet_tpu import autograd
+    rs = np.random.RandomState(1)
+    k, D, gs = 2, 1, 1
+    data = nd.array(rs.rand(1, D * gs * gs, 8, 8).astype("f"))
+    trans = nd.array(rs.uniform(-0.1, 0.1, (1, 2, k, k)).astype("f"))
+    rois = nd.array(np.array([[0, 1, 1, 6, 6]], "f"))
+    trans.attach_grad()
+    with autograd.record():
+        out = nd.DeformablePSROIPooling(data, rois, trans,
+                                        spatial_scale=1.0, output_dim=D,
+                                        group_size=gs, pooled_size=k,
+                                        trans_std=0.5)
+        out.sum().backward()
+    g = trans.grad.asnumpy()
+    assert np.isfinite(g).all() and np.abs(g).sum() > 0
+
+
+def test_convolution_v1_alias():
+    x = nd.array(np.random.RandomState(0).rand(1, 2, 5, 5).astype("f"))
+    w = nd.array(np.random.RandomState(1).rand(3, 2, 3, 3).astype("f"))
+    b = nd.zeros((3,))
+    a = nd.Convolution(x, w, b, kernel=(3, 3), num_filter=3)
+    v1 = nd.Convolution_v1(x, w, b, kernel=(3, 3), num_filter=3)
+    assert_almost_equal(a.asnumpy(), v1.asnumpy(), rtol=1e-6)
