@@ -73,6 +73,23 @@ def _maybe_init_distributed():
 _maybe_init_distributed()
 
 
+def _init_crash_handler():
+    """Library init (parity: src/initialize.cc:33-50 — SIGSEGV backtrace
+    handler + dmlc logging init): a crash in any thread (native engine
+    workers included) dumps python tracebacks for every thread.  Disable
+    with MXNET_USE_SIGNAL_HANDLER=0."""
+    if os.environ.get("MXNET_USE_SIGNAL_HANDLER", "1") == "0":
+        return
+    import faulthandler
+    try:
+        faulthandler.enable(all_threads=True)
+    except Exception:
+        pass  # non-main-thread import or closed stderr
+
+
+_init_crash_handler()
+
+
 class MXNetError(RuntimeError):
     """Error raised by the framework (parity: mxnet.base.MXNetError)."""
 

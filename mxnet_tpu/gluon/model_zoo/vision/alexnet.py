@@ -37,6 +37,8 @@ class AlexNet(HybridBlock):
 
 
 def alexnet(pretrained=False, ctx=None, root=None, **kwargs):
+    net = AlexNet(**kwargs)
     if pretrained:
-        raise MXNetError("pretrained weights not bundled; load params explicitly")
-    return AlexNet(**kwargs)
+        from ..model_store import get_model_file
+        net.load_params(get_model_file("alexnet", root=root), ctx=ctx)
+    return net

@@ -90,10 +90,13 @@ densenet_spec = {121: (64, 32, [6, 12, 24, 16]),
 
 
 def get_densenet(num_layers, pretrained=False, ctx=None, root=None, **kwargs):
-    if pretrained:
-        raise MXNetError("pretrained weights not bundled; load params explicitly")
     num_init_features, growth_rate, block_config = densenet_spec[num_layers]
-    return DenseNet(num_init_features, growth_rate, block_config, **kwargs)
+    net = DenseNet(num_init_features, growth_rate, block_config, **kwargs)
+    if pretrained:
+        from ..model_store import get_model_file
+        net.load_params(get_model_file(f"densenet{num_layers}", root=root),
+                        ctx=ctx)
+    return net
 
 
 def densenet121(**kwargs):

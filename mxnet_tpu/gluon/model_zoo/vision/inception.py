@@ -149,6 +149,8 @@ class Inception3(HybridBlock):
 
 
 def inception_v3(pretrained=False, ctx=None, root=None, **kwargs):
+    net = Inception3(**kwargs)
     if pretrained:
-        raise MXNetError("pretrained weights not bundled; load params explicitly")
-    return Inception3(**kwargs)
+        from ..model_store import get_model_file
+        net.load_params(get_model_file("inceptionv3", root=root), ctx=ctx)
+    return net

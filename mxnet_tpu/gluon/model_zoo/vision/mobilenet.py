@@ -46,9 +46,14 @@ class MobileNet(HybridBlock):
 
 
 def get_mobilenet(multiplier, pretrained=False, ctx=None, root=None, **kwargs):
+    net = MobileNet(multiplier, **kwargs)
     if pretrained:
-        raise MXNetError("pretrained weights not bundled; load params explicitly")
-    return MobileNet(multiplier, **kwargs)
+        from ..model_store import get_model_file
+        version_suffix = f"{multiplier:.2f}".rstrip("0").rstrip(".") \
+            if multiplier != int(multiplier) else f"{multiplier:.1f}"
+        net.load_params(get_model_file(f"mobilenet{version_suffix}",
+                                       root=root), ctx=ctx)
+    return net
 
 
 def mobilenet1_0(**kwargs):

@@ -249,8 +249,9 @@ def get_resnet(version, num_layers, pretrained=False, ctx=None, root=None,
     block_class = resnet_block_versions[version - 1][block_type]
     net = resnet_class(block_class, layers, channels, **kwargs)
     if pretrained:
-        raise MXNetError("pretrained weights are not bundled in this "
-                         "zero-egress build; load params explicitly")
+        from ..model_store import get_model_file
+        net.load_params(get_model_file(f"resnet{num_layers}_v{version}",
+                                       root=root), ctx=ctx)
     return net
 
 

