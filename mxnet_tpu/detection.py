@@ -325,29 +325,36 @@ class ImageDetIter(_img.ImageIter):
                 self.provide_label[0].name,
                 (self.batch_size,) + tuple(label_shape))]
 
+    def _decode_augment_det(self, sample):
+        raw_label, s = sample
+        data = _img.imdecode(s)
+        arr = data.asnumpy() if isinstance(data, NDArray) else data
+        label = self._parse_label(raw_label)
+        for aug in self.auglist:
+            arr, label = aug(arr, label)
+            if isinstance(arr, NDArray):
+                arr = arr.asnumpy()
+        if arr.ndim == 2:
+            arr = arr[:, :, None]
+        return arr, label
+
     def next(self):
         batch_size = self.batch_size
         c, h, w = self.data_shape
         batch_data = _np.zeros((batch_size, h, w, c), _np.float32)
         batch_label = _np.full((batch_size,) + self.label_shape,
                                self.label_pad_value, _np.float32)
-        i = 0
-        while i < batch_size:
+        samples = []
+        while len(samples) < batch_size:
             try:
-                raw_label, s = self.next_sample()
+                samples.append(self.next_sample())
             except StopIteration:
-                if i == 0:
+                if not samples:
                     raise
                 break
-            data = _img.imdecode(s)
-            arr = data.asnumpy() if isinstance(data, NDArray) else data
-            label = self._parse_label(raw_label)
-            for aug in self.auglist:
-                arr, label = aug(arr, label)
-                if isinstance(arr, NDArray):
-                    arr = arr.asnumpy()
-            if arr.ndim == 2:
-                arr = arr[:, :, None]
+        results = self._map_pool(self._decode_augment_det, samples)
+        i = 0
+        for arr, label in results:
             batch_data[i] = arr[:h, :w, :c]
             n = min(label.shape[0], self.label_shape[0])
             batch_label[i, :n, :label.shape[1]] = label[:n]

@@ -290,8 +290,13 @@ class ImageIter(_io.DataIter):
                  path_imgrec=None, path_imglist=None, path_root=None,
                  path_imgidx=None, shuffle=False, part_index=0, num_parts=1,
                  aug_list=None, imglist=None, data_name="data",
-                 label_name="softmax_label", **kwargs):
+                 label_name="softmax_label", preprocess_threads=4, **kwargs):
         super().__init__(batch_size)
+        # decode+augment worker pool (parity: iter_image_recordio_2.cc
+        # OMP-parallel decode, :139-154): cv2 releases the GIL, so a thread
+        # pool gives real decode parallelism at ImageNet rates
+        self._n_workers = max(1, int(preprocess_threads))
+        self._pool = None
         assert path_imgrec or path_imglist or isinstance(imglist, list)
         if path_imgrec:
             if path_imgidx:
@@ -397,6 +402,24 @@ class ImageIter(_io.DataIter):
         header, img = recordio.unpack(s)
         return header.label, img
 
+    def _decode_augment(self, s):
+        data = imdecode(s)
+        for aug in self.auglist:
+            data = aug(data)[0]
+        arr = data.asnumpy() if isinstance(data, NDArray) else data
+        if arr.ndim == 2:
+            arr = arr[:, :, None]
+        return arr
+
+    def _map_pool(self, fn, items):
+        """Decode/augment a batch on the worker pool (order-preserving)."""
+        if self._n_workers <= 1 or len(items) <= 1:
+            return [fn(x) for x in items]
+        if self._pool is None:
+            from concurrent.futures import ThreadPoolExecutor
+            self._pool = ThreadPoolExecutor(max_workers=self._n_workers)
+        return list(self._pool.map(fn, items))
+
     def next(self):
         batch_size = self.batch_size
         c, h, w = self.data_shape
@@ -404,18 +427,14 @@ class ImageIter(_io.DataIter):
         batch_label = _np.zeros((batch_size,) + (
             (self.label_width,) if self.label_width > 1 else ()),
             dtype=_np.float32)
-        i = 0
-        while i < batch_size:
-            label, s = self.next_sample()
-            data = imdecode(s)
-            for aug in self.auglist:
-                data = aug(data)[0]
-            arr = data.asnumpy() if isinstance(data, NDArray) else data
-            if arr.ndim == 2:
-                arr = arr[:, :, None]
+        samples = []
+        while len(samples) < batch_size:
+            samples.append(self.next_sample())
+        arrs = self._map_pool(self._decode_augment, [s for _, s in samples])
+        for i, (arr, (label, _)) in enumerate(zip(arrs, samples)):
             batch_data[i] = arr[:h, :w, :c]
             batch_label[i] = label if _np.ndim(label) else float(label)
-            i += 1
+        i = batch_size  # full batch assembled (pad = batch_size - i = 0)
         data_nchw = _np.transpose(batch_data, (0, 3, 1, 2))
         return _io.DataBatch([nd.array(data_nchw)], [nd.array(batch_label)],
                              batch_size - i,

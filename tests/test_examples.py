@@ -118,6 +118,14 @@ def test_parse_log(tmp_path):
     assert rows[1]["train_acc"] == 0.8
 
 
+def test_bench_io_harness():
+    """Standalone input-pipeline benchmark (parallel decode pool)."""
+    out = run_example("tools/bench_io.py", "--num-images", "64",
+                      "--batch-size", "16", "--image-size", "64",
+                      "--threads", "4", "--epochs", "1")
+    assert "decode+augment throughput" in out
+
+
 def test_bandwidth_harness():
     sys.path.insert(0, os.path.join(REPO, "tools", "bandwidth"))
     import importlib

@@ -1,0 +1,76 @@
+#!/usr/bin/env python
+"""Standalone input-pipeline benchmark (parity model: the reference's
+`test_io`/`benchmark` harnesses + iter_image_recordio_2.cc OMP decode).
+
+Packs a synthetic JPEG .rec and measures sustained iterator throughput —
+the number to compare against the training step's img/s so the host
+pipeline provably keeps the chip fed.
+
+    python tools/bench_io.py --num-images 2048 --batch-size 256 \
+        --image-size 224 --threads 8
+"""
+import argparse
+import os
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def pack(path, n, size, seed=0):
+    from mxnet_tpu import recordio
+    rs = np.random.RandomState(seed)
+    w = recordio.MXRecordIO(path, "w")
+    img = (rs.rand(size, size, 3) * 255).astype(np.uint8)
+    for i in range(n):
+        # shift so records differ without regenerating noise each time
+        header = recordio.IRHeader(0, float(i % 1000), i, 0)
+        w.write(recordio.pack_img(header, np.roll(img, i, axis=0),
+                                  quality=85, img_fmt=".jpg"))
+    w.close()
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--num-images", type=int, default=2048)
+    ap.add_argument("--batch-size", type=int, default=256)
+    ap.add_argument("--image-size", type=int, default=224)
+    ap.add_argument("--threads", type=int, default=8)
+    ap.add_argument("--epochs", type=int, default=2)
+    ap.add_argument("--rec", type=str, default="")
+    args = ap.parse_args()
+
+    import mxnet_tpu as mx
+    rec = args.rec or os.path.join(tempfile.mkdtemp(), "bench.rec")
+    if not os.path.exists(rec):
+        t0 = time.perf_counter()
+        pack(rec, args.num_images, args.image_size)
+        print(f"packed {args.num_images} imgs in "
+              f"{time.perf_counter() - t0:.1f}s -> {rec}")
+
+    it = mx.io.ImageRecordIter(
+        path_imgrec=rec, data_shape=(3, args.image_size, args.image_size),
+        batch_size=args.batch_size, preprocess_threads=args.threads,
+        rand_mirror=True, mean_r=123.7, mean_g=116.3, mean_b=103.5,
+        std_r=58.4, std_g=57.1, std_b=57.4)
+    # warm epoch (thread pool spin-up, file cache)
+    n = 0
+    for b in it:
+        n += b.data[0].shape[0]
+    it.reset()
+    t0 = time.perf_counter()
+    total = 0
+    for _ in range(args.epochs):
+        for b in it:
+            total += b.data[0].shape[0]
+        it.reset()
+    dt = time.perf_counter() - t0
+    print(f"decode+augment throughput: {total / dt:.1f} img/s "
+          f"({args.threads} threads, {args.image_size}px)")
+
+
+if __name__ == "__main__":
+    main()
