@@ -408,10 +408,13 @@ def infer_shape(symbol: Symbol, partial: bool, *args, **kwargs):
         if partial:
             return None, None, None
         raise
-    arg_shapes = [tuple(info[n].shape) if info.get(n) else None for n in arg_names]
-    aux_shapes = [tuple(info[n].shape) if info.get(n) else None
+    # `is not None`, not truthiness: a scalar output's ShapeDtypeStruct
+    # raises on __len__ (loss graphs end in shape-() outputs)
+    arg_shapes = [tuple(info[n].shape) if info.get(n) is not None else None
+                  for n in arg_names]
+    aux_shapes = [tuple(info[n].shape) if info.get(n) is not None else None
                   for n in symbol.list_auxiliary_states()]
-    out_shapes = [tuple(o.shape) if o else None for o in outs]
+    out_shapes = [tuple(o.shape) if o is not None else None for o in outs]
     return arg_shapes, out_shapes, aux_shapes
 
 

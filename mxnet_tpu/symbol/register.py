@@ -23,7 +23,11 @@ def _auto_input_names(op, params):
     names = list(op.input_names)
     p = dict(params)
     if op.name in ("FullyConnected", "Convolution", "Deconvolution"):
-        if _truthy(p.get("no_bias")):
+        no_bias = p.get("no_bias")
+        if no_bias is None:
+            # schema default decides (Deconvolution defaults no_bias=True)
+            no_bias = op.schema.args["no_bias"].default
+        if _truthy(no_bias):
             names.remove("bias")
     if op.name == "RNN" and p.get("mode") != "lstm":
         names = [n for n in names if n != "state_cell"]

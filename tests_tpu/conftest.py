@@ -1,0 +1,52 @@
+"""TPU-vs-CPU consistency tier (VERDICT #4; reference pattern:
+tests/python/gpu/test_operator_gpu.py running check_consistency across
+[cpu, gpu] ctx lists, test_utils.py:1203).
+
+This suite needs BOTH backends in one process, so it lives outside
+tests/ (whose conftest deregisters the TPU plugin).  Run on a TPU host:
+
+    python -m pytest tests_tpu/ -q
+
+The whole session skips cleanly when no accelerator is reachable — the
+probe runs in a subprocess with a timeout so a wedged device tunnel can
+never hang collection.
+"""
+import os
+import subprocess
+import sys
+
+import pytest
+
+_ALIVE = None
+
+
+def tpu_alive() -> bool:
+    global _ALIVE
+    if os.environ.get("MXT_CONSISTENCY_SELFTEST"):
+        return True  # cpu-vs-cpu harness validation (no chip needed)
+    if _ALIVE is None:
+        try:
+            r = subprocess.run(
+                [sys.executable, "-c",
+                 "import jax; d=jax.devices(); "
+                 "assert d and d[0].platform not in ('cpu',)"],
+                capture_output=True, timeout=120)
+            _ALIVE = r.returncode == 0
+        except Exception:
+            _ALIVE = False
+    return _ALIVE
+
+
+def pytest_collection_modifyitems(config, items):
+    if not tpu_alive():
+        skip = pytest.mark.skip(reason="no accelerator reachable "
+                                       "(cpu-only host or dead tunnel)")
+        for item in items:
+            item.add_marker(skip)
+
+
+@pytest.fixture(autouse=True)
+def _seed():
+    import numpy as np
+    np.random.seed(0)
+    yield
