@@ -1,82 +1,119 @@
-"""Driver benchmark: ResNet-50 training throughput (img/s) on one chip.
+"""Driver benchmark: ResNet-50 training throughput (img/s) on one chip —
+measured THROUGH the framework's own training path.
 
 Baseline (BASELINE.md): reference MXNet trains ResNet-50/ImageNet at
 109 img/s on 1x K80 @ BS=32 (example/image-classification/README.md:147).
 
-This runs the flagship gluon model-zoo ResNet-50 v1 through the Symbol
-graph interpreter as ONE jitted training step (forward, softmax CE, vjp,
-SGD update, BN running-stat update) in mixed precision: bf16 compute on
-the MXU, fp32 master weights (reference precedent: mp_sgd_update,
-src/operator/optimizer_op.cc:111-128).
+Path under test (the exact stack a user runs):
+  gluon model-zoo ResNet-50 v1 symbol → Module.fit → fused one-dispatch
+  forward+backward executor (executor.py) → KVStore('tpu_sync') pushpull →
+  FusedUpdater multi-tensor sgd_mom step (optimizer.py).
+Mixed precision the reference way (mp_sgd_*, optimizer_op.cc:111-128):
+  bf16-resident weights/activations via dtype propagation from bf16 data,
+  fp32 master weights inside the optimizer state, BN scale/stats in fp32.
 
 Prints one JSON line: {"metric", "value", "unit", "vs_baseline"}.
 """
 import json
+import os
 import time
 
 import numpy as np
-import jax
-import jax.numpy as jnp
-
 
 BASELINE_IMG_S = 109.0  # 1x K80, BS=32
-BATCH = 256
-STEPS = 10
-
-
-def build():
-    import mxnet_tpu as mx
-    from mxnet_tpu.gluon.model_zoo import vision
-    from mxnet_tpu.symbol.graph import GraphPlan
-
-    net = vision.resnet50_v1()
-    out = net(mx.sym.Variable("data"))
-    plan = GraphPlan(out)
-
-    arg_shapes, _, aux_shapes = out.infer_shape(data=(BATCH, 3, 224, 224))
-    rs = np.random.RandomState(0)
-    params = {}
-    for name, shp in zip(out.list_arguments(), arg_shapes):
-        if name == "data":
-            continue
-        params[name] = jnp.asarray(rs.normal(0, 0.05, shp).astype(np.float32))
-    aux = {}
-    for name, shp in zip(out.list_auxiliary_states(), aux_shapes):
-        one = name.endswith("running_var") or name.endswith("gamma")
-        aux[name] = (jnp.ones if one else jnp.zeros)(shp, jnp.float32)
-    key = jax.random.PRNGKey(0)
-
-    def train_step(ps, auxs, x, y):
-        def loss_fn(ps32):
-            d = {k: v.astype(jnp.bfloat16) for k, v in ps32.items()}
-            d["data"] = x.astype(jnp.bfloat16)
-            outs, new_aux = plan.run(d, auxs, key, True)
-            logits = outs[0].astype(jnp.float32)
-            logp = jax.nn.log_softmax(logits, axis=-1)
-            nll = -jnp.mean(jnp.take_along_axis(logp, y[:, None], -1))
-            return nll, new_aux
-
-        (loss, new_aux), grads = jax.value_and_grad(
-            loss_fn, has_aux=True)(ps)
-        new_ps = jax.tree_util.tree_map(
-            lambda w, g: w - 0.05 * g.astype(jnp.float32), ps, grads)
-        return loss, new_ps, new_aux
-
-    x = jnp.asarray(rs.normal(0, 1, (BATCH, 3, 224, 224)).astype(np.float32))
-    y = jnp.asarray(rs.randint(0, 1000, (BATCH,)).astype(np.int32))
-    return jax.jit(train_step, donate_argnums=(0, 1)), params, aux, x, y
+# env overrides exist for CPU smoke-testing the bench path (CI); the
+# driver's TPU run uses the defaults
+BATCH = int(os.environ.get("MXT_BENCH_BATCH", 256))
+IMG = int(os.environ.get("MXT_BENCH_IMG", 224))
+BATCHES_PER_EPOCH = int(os.environ.get("MXT_BENCH_BATCHES", 8))
+LR = float(os.environ.get("MXT_BENCH_LR", 0.05))
+EPOCHS = 3  # epoch 0 compiles+warms; epochs 1..2 are timed
 
 
 def main():
-    step, params, aux, x, y = build()
-    loss, params, aux = step(params, aux, x, y)  # compile + warmup
-    float(loss)  # host fetch: block_until_ready is a no-op under axon
-    t0 = time.perf_counter()
-    for _ in range(STEPS):
-        loss, params, aux = step(params, aux, x, y)
-    float(loss)
-    dt = time.perf_counter() - t0
-    img_s = BATCH * STEPS / dt
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon.model_zoo import vision
+    from mxnet_tpu.io import DataDesc
+
+    net = vision.resnet50_v1()
+    out = net(mx.sym.Variable("data"))
+    out = mx.sym.SoftmaxOutput(out, name="softmax")
+
+    rs = np.random.RandomState(0)
+    n = BATCH * BATCHES_PER_EPOCH
+    # learnable synthetic data (class-correlated means) so the loss-sanity
+    # check below exercises real training, not just timing
+    labels = rs.randint(0, 1000, n).astype(np.float32)
+    data = rs.normal(0, 1, (n, 3, IMG, IMG)).astype(np.float32)
+    data[:, 0, :4, :4] += (labels / 500.0 - 1.0)[:, None, None]
+    # device-resident, bf16: the iterator slices on-device (input-pipeline
+    # throughput is benchmarked separately by tools/bench_io.py)
+    data_nd = mx.nd.array(data).astype("bfloat16")
+    label_nd = mx.nd.array(labels)
+    it = mx.io.NDArrayIter(data_nd, label_nd, batch_size=BATCH)
+
+    mod = mx.mod.Module(out, context=mx.tpu() if mx.context.num_tpus()
+                        else mx.cpu())
+    mod.bind(data_shapes=[DataDesc("data", (BATCH, 3, IMG, IMG),
+                                   np.dtype("bfloat16"))],
+             label_shapes=[DataDesc("softmax_label", (BATCH,), np.float32)])
+    mod.init_params(mx.init.Xavier(rnd_type="gaussian", factor_type="in",
+                                   magnitude=2))
+    mod.init_optimizer(kvstore="tpu_sync", optimizer="sgd",
+                       optimizer_params={"learning_rate": LR,
+                                         "momentum": 0.9, "wd": 1e-4,
+                                         "multi_precision": True})
+
+    epoch_times = []
+
+    def epoch_end(epoch, sym_, arg, aux):
+        # one-scalar sync: everything dispatched this epoch has retired,
+        # so the timestamp measures compute, not async dispatch
+        if metric._device_vals:
+            float(metric._device_vals[-1].asnumpy())
+        epoch_times.append(time.perf_counter())
+
+    class LossMetric(mx.metric.EvalMetric):
+        """Per-batch NLL kept ON DEVICE (a few tiny async ops, no host
+        fetch) so the timed epochs never sync; scalars materialize once
+        at the end."""
+
+        def __init__(self):
+            super().__init__("nll")
+            self._device_vals = []
+
+        def update(self, labels_, preds):
+            picked = mx.nd.pick(preds[0].astype(np.float32), labels_[0],
+                                axis=1)
+            nll = 0.0 - mx.nd.log(picked + 1e-8).mean()
+            self._device_vals.append(nll)
+            self.num_inst += 1
+
+        def materialize(self):
+            return [float(v.asnumpy()) for v in self._device_vals]
+
+        def get(self):
+            vals = self.materialize()
+            return ("nll", float(np.mean(vals)) if vals else float("nan"))
+
+    metric = LossMetric()
+    epoch_times.append(time.perf_counter())
+    # params/optimizer already initialized above — fit()'s own init calls
+    # are no-ops and the loop runs the fused fwd+bwd / pushpull hot path
+    mod.fit(it, num_epoch=EPOCHS, eval_metric=metric,
+            epoch_end_callback=epoch_end)
+    losses = metric.materialize()
+
+    # timed span: epochs 1..EPOCHS-1 (epoch 0 pays XLA compile)
+    dt = epoch_times[-1] - epoch_times[1]
+    img_s = BATCH * BATCHES_PER_EPOCH * (EPOCHS - 1) / dt
+
+    # loss sanity: finite, and the final epoch is not diverged — near
+    # chance level (ln 1000 ≈ 6.9) or better than where training started
+    assert np.isfinite(losses).all(), losses
+    final = float(np.mean(losses[-BATCHES_PER_EPOCH:]))
+    assert final < max(losses[0] * 1.2, np.log(1000.0) + 0.5), losses
+
     print(json.dumps({
         "metric": "resnet50_train_throughput",
         "value": round(img_s, 2),

@@ -145,14 +145,26 @@ class NDArrayIter(DataIter):
 
     def _getdata(self, data_source):
         assert self.cursor < self.num_data, "DataIter needs reset."
+        contiguous = self.cursor + self.batch_size <= self.num_data
+        if contiguous:
+            sel = self.idx[self.cursor:self.cursor + self.batch_size]
+        else:
+            pad = self.batch_size - self.num_data + self.cursor
+            sel = _np.concatenate([self.idx[self.cursor:], self.idx[:pad]])
         out = []
         for _, src in data_source:
-            if self.cursor + self.batch_size <= self.num_data:
-                sel = self.idx[self.cursor:self.cursor + self.batch_size]
+            if isinstance(src, NDArray):
+                # device-resident source: slice/gather ON DEVICE — no
+                # host round trip per batch (the TPU-native fast path the
+                # bench and user pipelines rely on)
+                if contiguous and not self.shuffle:
+                    out.append(src[self.cursor:self.cursor + self.batch_size])
+                else:
+                    from .ndarray.register import _gen
+                    out.append(_gen.take(src, nd.array(
+                        sel.astype(_np.int32))))
             else:
-                pad = self.batch_size - self.num_data + self.cursor
-                sel = _np.concatenate([self.idx[self.cursor:], self.idx[:pad]])
-            out.append(nd.array(src[sel], dtype=src.dtype))
+                out.append(nd.array(src[sel], dtype=src.dtype))
         return out
 
     def getdata(self):
@@ -185,9 +197,9 @@ def _init_data(data, allow_empty, default_name):
         raise MXNetError("Input must be NDArray, numpy.ndarray, list or dict")
     out = []
     for k, v in data.items():
-        if isinstance(v, NDArray):
-            v = v.asnumpy()
-        out.append((k, _np.asarray(v)))
+        # NDArray sources stay device-resident (sliced on device per
+        # batch); everything else becomes host numpy
+        out.append((k, v if isinstance(v, NDArray) else _np.asarray(v)))
     return out
 
 

@@ -270,19 +270,19 @@ def _batch_dot(p, a, b):
 # ---------------------------------------------------------------------------
 # Indexing (parity: src/operator/tensor/indexing_op.cc)
 # ---------------------------------------------------------------------------
-@register("take", input_names=("a", "indices"),
+@register("take", input_names=("a", "indices"), f32_inputs=(1,),
           args=[Arg("axis", int, 0), Arg("mode", str, "clip")])
 def _take(p, a, idx):
     mode = {"clip": "clip", "wrap": "wrap", "raise": "clip"}[p["mode"]]
     return jnp.take(a, idx.astype(jnp.int32), axis=p["axis"], mode=mode)
 
 
-@register("batch_take", input_names=("a", "indices"))
+@register("batch_take", input_names=("a", "indices"), f32_inputs=(1,))
 def _batch_take(p, a, idx):
     return jnp.take_along_axis(a, idx.astype(jnp.int32)[:, None], axis=1)[:, 0]
 
 
-@register("Embedding", input_names=("data", "weight"),
+@register("Embedding", input_names=("data", "weight"), f32_inputs=(0,),
           args=[Arg("input_dim", int, required=True), Arg("output_dim", int, required=True),
                 Arg("dtype", str, "float32"), Arg("sparse_grad", bool, False)])
 def _embedding(p, data, weight):
@@ -291,7 +291,7 @@ def _embedding(p, data, weight):
     return jnp.take(weight, data.astype(jnp.int32), axis=0, mode="clip")
 
 
-@register("one_hot", input_names=("indices",),
+@register("one_hot", input_names=("indices",), f32_inputs=(0,),
           args=[Arg("depth", int, required=True), Arg("on_value", float, 1.0),
                 Arg("off_value", float, 0.0), Arg("dtype", str, "float32")],
           differentiable=False)
@@ -302,7 +302,7 @@ def _one_hot(p, idx):
     return out.astype(np_dtype(p["dtype"]))
 
 
-@register("pick", input_names=("data", "index"),
+@register("pick", input_names=("data", "index"), f32_inputs=(1,),
           args=[Arg("axis", int, -1), Arg("keepdims", bool, False),
                 Arg("mode", str, "clip")])
 def _pick(p, x, idx):
@@ -312,7 +312,7 @@ def _pick(p, x, idx):
     return out if p["keepdims"] else jnp.squeeze(out, axis=ax)
 
 
-@register("gather_nd", input_names=("data", "indices"))
+@register("gather_nd", input_names=("data", "indices"), f32_inputs=(1,))
 def _gather_nd(p, data, indices):
     idx = indices.astype(jnp.int32)
     m = idx.shape[0]

@@ -209,7 +209,7 @@ def _pooling(p, x):
                 Arg("output_mean_var", bool, False), Arg("axis", int, 1),
                 Arg("cudnn_off", bool, False)],
           num_outputs=3, aux_inputs=[3, 4], takes_is_train=True,
-          aliases=("BatchNorm_v1",))
+          f32_inputs=(1, 2, 3, 4), aliases=("BatchNorm_v1",))
 def _batch_norm(p, x, gamma, beta, mov_mean, mov_var):
     """Parity: src/operator/nn/batch_norm.cc.
 
@@ -232,8 +232,12 @@ def _batch_norm(p, x, gamma, beta, mov_mean, mov_var):
         mean, var = mov_mean, mov_var
         new_mm, new_mv = mov_mean, mov_var
     inv_std = lax.rsqrt(var + p["eps"])
+    # scale/shift cast to the activation dtype so bf16 stays bf16 end to
+    # end (gamma/beta/moving stats themselves are f32, reference fp16 BN)
     out = (x - mean.reshape(bshape).astype(x.dtype)) * (
-        inv_std.reshape(bshape).astype(x.dtype)) * g.reshape(bshape) + beta.reshape(bshape)
+        inv_std.reshape(bshape).astype(x.dtype)) * \
+        g.reshape(bshape).astype(x.dtype) + \
+        beta.reshape(bshape).astype(x.dtype)
     return (out, mean.astype(x.dtype), var.astype(x.dtype),
             lax.stop_gradient(new_mm), lax.stop_gradient(new_mv))
 
@@ -412,6 +416,7 @@ _softmax_output_core.defvjp(_softmax_output_fwd, _softmax_output_bwd)
 
 
 @register("SoftmaxOutput", input_names=("data", "label"), aliases=("Softmax",),
+          f32_inputs=(1,),
           args=[Arg("grad_scale", float, 1.0), Arg("ignore_label", float, -1.0),
                 Arg("multi_output", bool, False), Arg("use_ignore", bool, False),
                 Arg("preserve_shape", bool, False), Arg("normalization", str, "null"),

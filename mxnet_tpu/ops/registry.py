@@ -51,6 +51,11 @@ class Operator:
     takes_is_train: bool = False    # receives '__is_train__' in params
     mutates_input: Optional[int] = None  # optimizer ops update this input in place
     differentiable: bool = True
+    # input positions that stay float32 under reduced-precision training
+    # (BN scale/stats — cuDNN contract the reference mirrors; class-id /
+    # index inputs where bf16's 8-bit mantissa corrupts ids > 256).
+    # infer_type consults this instead of a name-keyed side table.
+    f32_inputs: Tuple[int, ...] = ()
     # optional custom vjp: bwd(params, primals, out_grads) -> input grads
     docstring: str = ""
 

@@ -418,6 +418,19 @@ def infer_shape(symbol: Symbol, partial: bool, *args, **kwargs):
     return arg_shapes, out_shapes, aux_shapes
 
 
+def _f32_forced_vars(symbol: Symbol):
+    """Variables that stay f32 under reduced-precision training — declared
+    per-op in the registry (Operator.f32_inputs: BN scale/stats, class-id/
+    index inputs)."""
+    plan = GraphPlan(symbol)
+    forced = set()
+    for step in plan.steps:
+        for i in step.op.f32_inputs:
+            if i < len(step.in_refs) and step.in_refs[i][0] == "var":
+                forced.add(step.in_refs[i][1])
+    return forced
+
+
 def infer_type(symbol: Symbol, *args, **kwargs):
     known_t = {}
     arg_names = symbol.list_arguments()
@@ -426,10 +439,29 @@ def infer_type(symbol: Symbol, *args, **kwargs):
             if dt is not None:
                 known_t[nm] = dt
     known_t.update({k: v for k, v in kwargs.items() if v is not None})
-    # types propagate trivially (float32 default); full propagation would need
-    # shapes — return declared/default types
-    arg_types = [np_dtype(known_t.get(n, _np.float32)) for n in arg_names]
-    aux_types = [np_dtype(known_t.get(n, _np.float32))
-                 for n in symbol.list_auxiliary_states()]
-    out_types = [np_dtype(_np.float32)] * len(symbol._entries)
+    # reference-style propagation: unknown float params take the training
+    # dtype — fp16/bf16 data implies fp16/bf16 weights, exactly how
+    # reference fp16 training binds — except the registry's f32-forced
+    # inputs.  The training dtype = the first known float input in
+    # argument (topological) order that is NOT itself f32-forced (so a
+    # f32 label never wins the scan over bf16 data, whatever the names).
+    forced = _f32_forced_vars(symbol)
+    float_default = _np.float32
+    for nm in arg_names:
+        dt = known_t.get(nm)
+        if dt is None or nm in forced:
+            continue
+        # jnp.issubdtype: bf16/f16 are ml_dtypes, invisible to numpy's
+        # floating hierarchy
+        if jax.numpy.issubdtype(np_dtype(dt), jax.numpy.floating):
+            float_default = np_dtype(dt)
+            break
+    def var_t(n):
+        if n in known_t:
+            return np_dtype(known_t[n])
+        return _np.dtype(_np.float32) if n in forced else float_default
+
+    arg_types = [var_t(n) for n in arg_names]
+    aux_types = [var_t(n) for n in symbol.list_auxiliary_states()]
+    out_types = [np_dtype(float_default)] * len(symbol._entries)
     return arg_types, out_types, aux_types

@@ -118,6 +118,23 @@ def test_parse_log(tmp_path):
     assert rows[1]["train_acc"] == 0.8
 
 
+def test_bench_product_path_smoke():
+    """bench.py drives Module.fit + tpu_sync kvstore + fused updates; the
+    CPU smoke config checks the whole path wires up and the loss-sanity
+    assert passes."""
+    import json
+    env = {**ENV, "MXT_BENCH_BATCH": "8", "MXT_BENCH_IMG": "64",
+           "MXT_BENCH_BATCHES": "2", "MXT_BENCH_LR": "0.01"}
+    proc = subprocess.run([sys.executable, os.path.join(REPO, "bench.py")],
+                          env=env, capture_output=True, text=True,
+                          timeout=560)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    line = [l for l in proc.stdout.splitlines() if l.startswith("{")][-1]
+    rec = json.loads(line)
+    assert rec["metric"] == "resnet50_train_throughput"
+    assert rec["value"] > 0
+
+
 def test_bench_io_harness():
     """Standalone input-pipeline benchmark (parallel decode pool)."""
     out = run_example("tools/bench_io.py", "--num-images", "64",
