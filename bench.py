@@ -48,12 +48,12 @@ def main():
     data[:, 0, :4, :4] += (labels / 500.0 - 1.0)[:, None, None]
     # device-resident, bf16: the iterator slices on-device (input-pipeline
     # throughput is benchmarked separately by tools/bench_io.py)
-    data_nd = mx.nd.array(data).astype("bfloat16")
-    label_nd = mx.nd.array(labels)
+    ctx = mx.tpu() if mx.context.num_tpus() else mx.cpu()
+    data_nd = mx.nd.array(data, ctx=ctx).astype("bfloat16")
+    label_nd = mx.nd.array(labels, ctx=ctx)
     it = mx.io.NDArrayIter(data_nd, label_nd, batch_size=BATCH)
 
-    mod = mx.mod.Module(out, context=mx.tpu() if mx.context.num_tpus()
-                        else mx.cpu())
+    mod = mx.mod.Module(out, context=ctx)
     mod.bind(data_shapes=[DataDesc("data", (BATCH, 3, IMG, IMG),
                                    np.dtype("bfloat16"))],
              label_shapes=[DataDesc("softmax_label", (BATCH,), np.float32)])

@@ -1,0 +1,98 @@
+"""Phase-level timing of the Module.fit hot path on the real chip:
+forward_backward vs update vs metric, to find where the 100 img/s
+collapse comes from."""
+import os
+import time
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu.gluon.model_zoo import vision
+from mxnet_tpu.io import DataDesc
+
+BATCH = int(os.environ.get("B", 256))
+IMG = 224
+
+
+def sync(x):
+    float(x.asnumpy().ravel()[0] if hasattr(x, "asnumpy") else x)
+
+
+def main():
+    net = vision.resnet50_v1()
+    out = net(mx.sym.Variable("data"))
+    out = mx.sym.SoftmaxOutput(out, name="softmax")
+    ctx = mx.tpu() if mx.context.num_tpus() else mx.cpu()
+    rs = np.random.RandomState(0)
+    data = mx.nd.array(rs.normal(0, 1, (BATCH, 3, IMG, IMG)).astype("f"),
+                       ctx=ctx).astype("bfloat16")
+    label = mx.nd.array(rs.randint(0, 1000, BATCH).astype("f"), ctx=ctx)
+
+    mod = mx.mod.Module(out, context=ctx)
+    mod.bind(data_shapes=[DataDesc("data", (BATCH, 3, IMG, IMG),
+                                   np.dtype("bfloat16"))],
+             label_shapes=[DataDesc("softmax_label", (BATCH,), np.float32)])
+    mod.init_params(mx.init.Xavier(rnd_type="gaussian", factor_type="in",
+                                   magnitude=2))
+    mod.init_optimizer(kvstore="tpu_sync", optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.05,
+                                         "momentum": 0.9, "wd": 1e-4,
+                                         "multi_precision": True})
+
+    from mxnet_tpu.io import DataBatch
+    batch = DataBatch(data=[data], label=[label], pad=0, index=None)
+
+    # warm up (compile)
+    t = time.perf_counter()
+    mod.forward_backward(batch)
+    mod.update()
+    sync(mod.get_outputs()[0])
+    print(f"compile+first step: {time.perf_counter()-t:.1f}s", flush=True)
+
+    N = 8
+    # phase 1: forward_backward only
+    t = time.perf_counter()
+    for _ in range(N):
+        mod.forward_backward(batch)
+    sync(mod.get_outputs()[0])
+    fb = (time.perf_counter() - t) / N
+    print(f"forward_backward: {fb*1e3:.1f} ms/step "
+          f"({BATCH/fb:.0f} img/s)", flush=True)
+
+    # phase 2: fb + update
+    t = time.perf_counter()
+    for _ in range(N):
+        mod.forward_backward(batch)
+        mod.update()
+    sync(mod.get_outputs()[0])
+    sync(next(iter(mod._exec.arg_dict.values())))
+    fbu = (time.perf_counter() - t) / N
+    print(f"fb+update:        {fbu*1e3:.1f} ms/step "
+          f"({BATCH/fbu:.0f} img/s)", flush=True)
+
+    # phase 3: fb + update + metric (the bench's LossMetric ops)
+    t = time.perf_counter()
+    vals = []
+    for _ in range(N):
+        mod.forward_backward(batch)
+        mod.update()
+        preds = mod.get_outputs()[0]
+        picked = mx.nd.pick(preds.astype(np.float32), label, axis=1)
+        vals.append(0.0 - mx.nd.log(picked + 1e-8).mean())
+    sync(vals[-1])
+    fbm = (time.perf_counter() - t) / N
+    print(f"fb+update+metric: {fbm*1e3:.1f} ms/step "
+          f"({BATCH/fbm:.0f} img/s)", flush=True)
+
+    # phase 4: dispatch-count probe — how many device calls does update() do?
+    import jax
+    mod.forward_backward(batch)
+    t = time.perf_counter()
+    mod.update()
+    sync(next(iter(mod._exec.arg_dict.values())))
+    print(f"single update(): {(time.perf_counter()-t)*1e3:.1f} ms",
+          flush=True)
+
+
+if __name__ == "__main__":
+    main()

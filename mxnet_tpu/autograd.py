@@ -90,16 +90,19 @@ class _TapeEntry:
     # objects, so dropping the refs would let unrelated later arrays
     # alias a dead output's key (wrong-gradient corruption)
     __slots__ = ("in_keys", "in_refs", "out_keys", "out_refs", "vjp_fn",
-                 "cot_zeros")
+                 "cot_zeros", "in_idx")
 
     def __init__(self, in_keys, in_refs, out_keys, out_refs, vjp_fn,
-                 cot_zeros):
+                 cot_zeros, in_idx=None):
         self.in_keys = in_keys
         self.in_refs = in_refs
         self.out_keys = out_keys
         self.out_refs = out_refs
         self.vjp_fn = vjp_fn       # cotangents tuple -> input grads tuple
         self.cot_zeros = cot_zeros  # zero cotangent per forward output
+        # vjp-grad slot per tape input (optional tensor inputs may be None
+        # in the op call — their slots exist in the vjp but not on the tape)
+        self.in_idx = in_idx if in_idx is not None else list(range(len(in_keys)))
 
 
 def _key(arr) -> Tuple[int, int]:
@@ -113,14 +116,15 @@ def _record(op, inputs, outputs, vjp_fn, raw_outs) -> None:
     `raw_outs` is the full forward output tuple (visible + aux) whose
     shapes/dtypes define the cotangent structure for vjp_fn.
     """
-    nd_inputs = [a for a in inputs if hasattr(a, "_version")]
+    indexed = [(i, a) for i, a in enumerate(inputs) if hasattr(a, "_version")]
     _state.tape.append(_TapeEntry(
-        [_key(a) for a in nd_inputs],
-        nd_inputs,
+        [_key(a) for _, a in indexed],
+        [a for _, a in indexed],
         [_key(o) for o in outputs],
         list(outputs),
         vjp_fn,
-        tuple(jnp.zeros(o.shape, o.dtype) for o in raw_outs)))
+        tuple(jnp.zeros(o.shape, o.dtype) for o in raw_outs),
+        in_idx=[i for i, _ in indexed]))
 
 
 def _mark_variable(arr) -> None:
@@ -157,7 +161,8 @@ def backward(heads, head_grads=None, retain_graph: bool = False,
                 cots[j] = grad_map[k].astype(cots[j].dtype)
         in_grads = entry.vjp_fn(tuple(cots))
         for idx, k in enumerate(entry.in_keys):
-            g = _reg.zero_like_grad(in_grads[idx], entry.in_refs[idx]._data)
+            g = _reg.zero_like_grad(in_grads[entry.in_idx[idx]],
+                                    entry.in_refs[idx]._data)
             grad_map[k] = grad_map[k] + g if k in grad_map else g
 
     # write accumulated grads into attached .grad buffers

@@ -32,6 +32,15 @@ from .symbol.graph import GraphPlan
 from . import random as _random
 
 
+def _device_of(a):
+    """Single device an array lives on, or None if sharded/unknown."""
+    try:
+        devs = a.devices()
+        return next(iter(devs)) if len(devs) == 1 else None
+    except Exception:
+        return None
+
+
 class Executor:
     def __init__(self, symbol, ctx, args: Dict[str, NDArray],
                  args_grad: Dict[str, NDArray], grad_req: Dict[str, str],
@@ -116,11 +125,18 @@ class Executor:
 
     # -- public API ---------------------------------------------------------
     def _gather(self, kwargs):
+        dev = None if self._mesh is not None else self._ctx.jax_device()
         for k, v in kwargs.items():
             if k in self.arg_dict:
-                self.arg_dict[k]._set_data(
-                    (v._data if isinstance(v, NDArray) else jnp.asarray(v)
-                     ).astype(self.arg_dict[k].dtype))
+                val = (v._data if isinstance(v, NDArray)
+                       else jnp.asarray(v)).astype(self.arg_dict[k].dtype)
+                # batch data may arrive on another device (e.g. a CPU-side
+                # iterator feeding a TPU-bound executor) — move it to the
+                # executor's context, like the reference's load_data copyto
+                # (src/executor exec_group _load_general)
+                if dev is not None and _device_of(val) != dev:
+                    val = jax.device_put(val, dev)
+                self.arg_dict[k]._set_data(val)
             else:
                 raise MXNetError(f"unknown forward argument {k}")
         arg_vals = {k: v._data for k, v in self.arg_dict.items()}

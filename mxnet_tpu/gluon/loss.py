@@ -130,9 +130,10 @@ class KLDivLoss(Loss):
 
 
 class CTCLoss(Loss):
-    """Connectionist temporal classification loss (parity: gluon/loss.py CTC,
-    backed by src/operator/contrib/ctc_loss.cc in the reference; here optax's
-    XLA ctc_loss)."""
+    """Connectionist temporal classification loss (parity: gluon/loss.py:398,
+    backed by src/operator/contrib/ctc_loss.cc in the reference; here the
+    registered `_contrib_ctc_loss` op — optax's XLA ctc_loss — so gradients
+    flow through the autograd tape in both eager and symbol modes)."""
 
     def __init__(self, layout="NTC", label_layout="NT", weight=None, **kwargs):
         assert layout in ("NTC", "TNC")
@@ -144,30 +145,20 @@ class CTCLoss(Loss):
 
     def hybrid_forward(self, F, pred, label, pred_lengths=None,
                        label_lengths=None, sample_weight=None):
-        import jax.numpy as jnp
-        import optax
-        from ..ndarray import NDArray
-        from .. import ndarray as _nd
-        if _is_sym(pred):
-            raise MXNetError("CTCLoss requires eager (NDArray) mode")
-        if self._layout == "TNC":
-            pred = pred.swapaxes(0, 1)
+        if self._layout == "NTC":
+            pred = F.swapaxes(pred, 0, 1)  # op wants (T, N, C)
         if self._label_layout == "TN":
-            label = label.swapaxes(0, 1)
-        B, T, C = pred.shape
-        logits = pred._data
-        labels = label._data.astype(jnp.int32)
-        logit_pad = jnp.zeros((B, T))
+            label = F.swapaxes(label, 0, 1)
+        kw = {}
         if pred_lengths is not None:
-            steps = jnp.arange(T)[None, :]
-            logit_pad = (steps >= pred_lengths._data[:, None]).astype(jnp.float32)
-        lab_pad = (labels <= 0).astype(jnp.float32)
+            kw["data_lengths"] = pred_lengths
         if label_lengths is not None:
-            steps = jnp.arange(labels.shape[1])[None, :]
-            lab_pad = (steps >= label_lengths._data[:, None]).astype(jnp.float32)
-        loss = optax.ctc_loss(logits, logit_pad, labels, lab_pad, blank_id=C - 1)
-        out = NDArray(loss, pred.context)
-        return _apply_weighting(_nd, out, self._weight, sample_weight)
+            kw["label_lengths"] = label_lengths
+        loss = F.CTCLoss(pred, label,
+                         use_data_lengths=pred_lengths is not None,
+                         use_label_lengths=label_lengths is not None,
+                         blank_label="last", **kw)
+        return _apply_weighting(F, loss, self._weight, sample_weight)
 
 
 class HuberLoss(Loss):

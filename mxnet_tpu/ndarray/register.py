@@ -55,7 +55,11 @@ def invoke(op_name: str, ndarray_inputs, kwargs, out=None):
         params["__is_train__"] = autograd.is_training()
     params_t = tuple(sorted(params.items()))
 
-    raw = [a._data if isinstance(a, NDArray) else jax.numpy.asarray(a)
+    # None marks an omitted optional input: its slot still exists in the
+    # op fn / vjp signature (empty pytree through jit), keeping grad
+    # indices aligned for the inputs that are present
+    raw = [None if a is None else
+           (a._data if isinstance(a, NDArray) else jax.numpy.asarray(a))
            for a in ndarray_inputs]
     if op.needs_rng:
         raw.append(_random.next_key())
@@ -75,8 +79,10 @@ def invoke(op_name: str, ndarray_inputs, kwargs, out=None):
         t1 = _time.perf_counter_ns()
         _profiler.record_event(op_name, t0 / 1e3, t1 / 1e3)
 
-    out_ctx = (ndarray_inputs[0]._ctx if ndarray_inputs and
-               isinstance(ndarray_inputs[0], NDArray) else (ctx or current_context()))
+    first_nd = next((a for a in ndarray_inputs if isinstance(a, NDArray)),
+                    None)
+    out_ctx = first_nd._ctx if first_nd is not None else (
+        ctx or current_context())
 
     n_vis = len(outs) - len(op.aux_inputs)
     visible = outs[:n_vis]
@@ -130,6 +136,24 @@ def _make_nd_func(op_name: str):
             taken = [n for n in names if n not in kwargs]
             for v, n in zip(rest, taken):
                 kwargs[n] = v
+        # tensor inputs passed by keyword (e.g. optional lengths inputs):
+        # place them at their declared slot, padding skipped slots w/ None
+        if not op.variadic:
+            for i, n in enumerate(op.input_names):
+                if n in kwargs and (kwargs[n] is None or
+                                    isinstance(kwargs[n],
+                                               (NDArray, _np.ndarray,
+                                                list, tuple))):
+                    v = kwargs.pop(n)
+                    if v is not None and not isinstance(v, NDArray):
+                        from .ndarray import array as _array
+                        v = _array(v)
+                    while len(inputs) < i:
+                        inputs.append(None)
+                    if len(inputs) == i:
+                        inputs.append(v)
+                    else:
+                        inputs[i] = v
         return invoke(op_name, inputs, kwargs, out=out)
 
     fn.__name__ = op_name

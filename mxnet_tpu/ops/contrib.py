@@ -17,32 +17,38 @@ from ..base import Arg, MXNetError
 from .registry import register
 
 
-@register("_contrib_ctc_loss", input_names=("data", "label"),
+@register("_contrib_ctc_loss", input_names=("data", "label", "data_lengths",
+                                            "label_lengths"),
           aliases=("ctc_loss", "CTCLoss"),
           args=[Arg("use_data_lengths", bool, False),
                 Arg("use_label_lengths", bool, False),
                 Arg("blank_label", str, "first")])
-def _ctc_loss(p, data, label):
+def _ctc_loss(p, data, label, data_lengths=None, label_lengths=None):
     """Parity: contrib/ctc_loss.cc.  data: (T, N, C) activations (pre-softmax),
-    label: (N, L) padded with 0/-1."""
+    label: (N, L) padded with 0/-1; optional per-sequence lengths gated by
+    use_data_lengths / use_label_lengths (reference inputs 3 and 4)."""
     import optax
     T, N, C = data.shape
     logits = jnp.transpose(data, (1, 0, 2))  # (N,T,C)
     labels = label.astype(jnp.int32)
+    logit_pad = jnp.zeros((N, T), jnp.float32)
+    if p["use_data_lengths"] and data_lengths is not None:
+        steps = jnp.arange(T)[None, :]
+        logit_pad = (steps >= data_lengths[:, None]).astype(jnp.float32)
     if p["blank_label"] == "first":
-        # optax uses blank_id; shift labels down by one (0 is blank in mxnet)
-        lab = labels - 1
-        blank = 0
+        # mxnet 'first': channel 0 is blank, real labels are 1..C-1 —
+        # matches optax blank_id=0 with labels kept as-is
         lab_valid = labels > 0
-        lab = jnp.where(lab_valid, labels, 0)
-        loss = optax.ctc_loss(logits, jnp.zeros((N, T)), lab,
-                              (~lab_valid).astype(jnp.float32), blank_id=0)
+        blank = 0
     else:
         lab_valid = labels >= 0
-        lab = jnp.where(lab_valid, labels, 0)
-        loss = optax.ctc_loss(logits, jnp.zeros((N, T)), lab,
-                              (~lab_valid).astype(jnp.float32), blank_id=C - 1)
-    return loss
+        blank = C - 1
+    if p["use_label_lengths"] and label_lengths is not None:
+        steps = jnp.arange(labels.shape[1])[None, :]
+        lab_valid = steps < label_lengths[:, None].astype(jnp.int32)
+    lab = jnp.where(lab_valid, labels, 0)
+    return optax.ctc_loss(logits, logit_pad, lab,
+                          (~lab_valid).astype(jnp.float32), blank_id=blank)
 
 
 @register("_contrib_fft", input_names=("data",), aliases=("fft",),

@@ -21,4 +21,4 @@ from . import pipeline
 from .pipeline import (gpipe, gpipe_sharded, pipeline_1f1b,
                        pipeline_train_step)
 from . import expert
-from .expert import switch_moe, switch_moe_sharded
+from .expert import switch_moe, switch_moe_sharded, topk_moe

@@ -19,30 +19,37 @@ from jax.sharding import Mesh, PartitionSpec as P
 from jax import shard_map
 
 
-def switch_moe(x, gate_w, expert_fn: Callable, expert_params,
-               axis_name: str = "ep", capacity_factor: float = 2.0):
-    """Top-1 MoE layer (call inside shard_map).
+def topk_moe(x, gate_w, expert_fn: Callable, expert_params,
+             axis_name: str = "ep", capacity_factor: float = 2.0,
+             k: int = 1, normalize_gates: bool = True):
+    """Top-k MoE layer (call inside shard_map).  k=1 is Switch routing;
+    k=2 is the GShard formulation (gates renormalized over the selected
+    experts, first choices take capacity priority over second choices).
 
     x: (T, D) local tokens; gate_w: (D, E) router weights (replicated),
     E == axis size; expert_params: THIS device's expert weights.
     Returns (y: (T, D), aux_loss: scalar load-balancing loss).
     """
-    n = lax.psum(1, axis_name)
     T, D = x.shape
     logits = x @ gate_w                       # (T, E)
     probs = jax.nn.softmax(logits, axis=-1)
-    eidx = jnp.argmax(probs, axis=-1)         # (T,)
-    gate = jnp.take_along_axis(probs, eidx[:, None], axis=-1)[:, 0]
-
     E = probs.shape[-1]
+    gates, eidx = lax.top_k(probs, k)         # (T, k) each
+    if normalize_gates and k > 1:
+        gates = gates / jnp.maximum(jnp.sum(gates, -1, keepdims=True), 1e-9)
+
     C = max(1, int(capacity_factor * T / E))
-    onehot = jax.nn.one_hot(eidx, E, dtype=x.dtype)          # (T, E)
-    # position of each token within its expert's queue
-    pos = (jnp.cumsum(onehot, axis=0) - 1.0) * onehot        # (T, E)
+    onehot = jax.nn.one_hot(eidx, E, dtype=x.dtype)          # (T, k, E)
+    # queue position of every (token, choice) within its expert: count in
+    # choice-major order so ALL first choices outrank any second choice
+    flat = jnp.swapaxes(onehot, 0, 1).reshape(k * T, E)      # (k*T, E)
+    fpos = (jnp.cumsum(flat, axis=0) - 1.0) * flat
+    pos = jnp.swapaxes(fpos.reshape(k, T, E), 0, 1)          # (T, k, E)
     keep = (pos < C).astype(x.dtype) * onehot
     slot = jax.nn.one_hot(pos.sum(-1).astype(jnp.int32), C,
-                          dtype=x.dtype)                     # (T, C)
-    dispatch = keep[:, :, None] * slot[:, None, :]           # (T, E, C)
+                          dtype=x.dtype)                     # (T, k, C)
+    # (T, E, C): ≤1 slot per (token, choice); choices hit distinct experts
+    dispatch = jnp.einsum("tke,tkc->tec", keep, slot)
 
     # pack: (E, C, D) — expert e's capacity slots filled with local tokens
     packed = jnp.einsum("td,tec->ecd", x, dispatch)
@@ -54,26 +61,34 @@ def switch_moe(x, gate_w, expert_fn: Callable, expert_params,
     # return each processed token to its owner
     back = lax.all_to_all(out, axis_name, split_axis=0, concat_axis=0,
                           tiled=True)                        # (E, C, D)
-    combine = dispatch * gate[:, None, None]
+    combine = jnp.einsum("tke,tkc,tk->tec", keep, slot, gates)
     y = jnp.einsum("ecd,tec->td", back, combine)
 
-    # Switch load-balance loss: E * Σ_e (fraction routed to e)(mean prob e)
-    frac = jnp.mean(onehot, axis=0)
+    # Switch/GShard load-balance loss over FIRST choices:
+    # E * Σ_e (fraction routed to e)(mean prob e)
+    frac = jnp.mean(onehot[:, 0, :], axis=0)
     mean_p = jnp.mean(probs, axis=0)
     aux = E * jnp.sum(frac * mean_p)
     return y, aux
 
 
+def switch_moe(x, gate_w, expert_fn: Callable, expert_params,
+               axis_name: str = "ep", capacity_factor: float = 2.0):
+    """Top-1 (Switch) MoE — see `topk_moe`."""
+    return topk_moe(x, gate_w, expert_fn, expert_params, axis_name,
+                    capacity_factor, k=1)
+
+
 def switch_moe_sharded(x, gate_w, expert_fn: Callable, stacked_expert_params,
                        mesh: Mesh, axis_name: str = "ep",
-                       capacity_factor: float = 2.0):
+                       capacity_factor: float = 2.0, k: int = 1):
     """Wrapper: tokens sharded on 'ep' (data-parallel over the same axis),
     expert weights stacked on a leading axis of size mesh.shape[axis_name]."""
 
     def per_device(xs, gw, params):
         squeezed = jax.tree_util.tree_map(lambda a: a[0], params)
-        y, aux = switch_moe(xs, gw, expert_fn, squeezed, axis_name,
-                            capacity_factor)
+        y, aux = topk_moe(xs, gw, expert_fn, squeezed, axis_name,
+                          capacity_factor, k=k)
         return y, lax.pmean(aux, axis_name)
 
     fn = shard_map(

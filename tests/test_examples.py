@@ -286,3 +286,29 @@ def test_matrix_factorization_example():
     out = run_example("example/recommenders/matrix_factorization.py",
                       "--epochs", "2", "--num-samples", "4000")
     assert "final RMSE" in out
+
+
+def test_neural_style_example(tmp_path):
+    out = run_example("example/neural-style/nstyle.py",
+                      "--size", "64", "--max-num-epochs", "4",
+                      "--log-every", "2",
+                      "--output", str(tmp_path / "out.png"))
+    line = [l for l in out.splitlines() if "final loss" in l][0]
+    assert np.isfinite(float(line.rsplit(" ", 1)[-1]))
+
+
+def test_rcnn_end2end_example():
+    out = run_example("example/rcnn/train_end2end.py",
+                      "--num-epochs", "1", "--batches-per-epoch", "2")
+    line = [l for l in out.splitlines() if "final rpn_cls" in l][0]
+    vals = [float(v) for v in line.split()[2::2]]
+    assert all(np.isfinite(v) for v in vals), out
+
+
+def test_speech_ctc_example():
+    out = run_example("example/speech_recognition/train_speech.py",
+                      "--num-epochs", "3", "--num-utts", "32")
+    lines = [l for l in out.splitlines() if "ctc-loss=" in l]
+    first = float(lines[0].split("ctc-loss=")[1].split()[0])
+    last = float(lines[-1].split("ctc-loss=")[1].split()[0])
+    assert np.isfinite(last) and last <= first + 1.0, out
