@@ -70,27 +70,31 @@ def main():
         # one-scalar sync: everything dispatched this epoch has retired,
         # so the timestamp measures compute, not async dispatch
         if metric._device_vals:
-            float(metric._device_vals[-1].asnumpy())
+            float(np.asarray(metric._device_vals[-1]))
         epoch_times.append(time.perf_counter())
 
     class LossMetric(mx.metric.EvalMetric):
-        """Per-batch NLL kept ON DEVICE (a few tiny async ops, no host
-        fetch) so the timed epochs never sync; scalars materialize once
-        at the end."""
+        """Per-batch NLL kept ON DEVICE as ONE jitted dispatch (each eager
+        op is a device RPC on the tunneled chip), no host fetch, so the
+        timed epochs never sync; scalars materialize once at the end."""
 
         def __init__(self):
             super().__init__("nll")
             self._device_vals = []
+            import jax
+            import jax.numpy as jnp
+            self._nll = jax.jit(lambda p, l: -jnp.log(
+                jnp.take_along_axis(
+                    p.astype(jnp.float32),
+                    l.astype(jnp.int32)[:, None], axis=1) + 1e-8).mean())
 
         def update(self, labels_, preds):
-            picked = mx.nd.pick(preds[0].astype(np.float32), labels_[0],
-                                axis=1)
-            nll = 0.0 - mx.nd.log(picked + 1e-8).mean()
-            self._device_vals.append(nll)
+            self._device_vals.append(
+                self._nll(preds[0]._data, labels_[0]._data))
             self.num_inst += 1
 
         def materialize(self):
-            return [float(v.asnumpy()) for v in self._device_vals]
+            return [float(np.asarray(v)) for v in self._device_vals]
 
         def get(self):
             vals = self.materialize()

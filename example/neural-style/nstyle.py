@@ -55,6 +55,11 @@ class VGGFeatures(gluon.HybridBlock):
                     self.body.add(nn.MaxPool2D(2, 2, prefix=f"pool{b}_"))
                     self.taps.append(None)
 
+    @property
+    def tap_order(self):
+        """Tap names in network-traversal (emission) order."""
+        return [t for t in self.taps if t is not None]
+
     def hybrid_forward(self, F, x):
         outs = []
         for layer, tap in zip(self.body, self.taps):
@@ -133,10 +138,16 @@ def main():
     content = load_image(args.content_image, args.size).as_in_context(ctx)
     style = load_image(args.style_image, args.size).as_in_context(ctx)
 
+    # tap slots by name (emission order interleaves relu4_2 between the
+    # style taps)
+    order = net.tap_order
+    style_idx = [order.index(n) for n in STYLE_LAYERS]
+    content_idx = order.index(CONTENT_LAYER)
+
     # targets (no grad)
     feats = net(style)
-    style_grams = [gram(f) for f in feats[:len(STYLE_LAYERS)]]
-    content_target = net(content)[len(STYLE_LAYERS) - 1]  # relu4_2 slot
+    style_grams = [gram(feats[i]) for i in style_idx]
+    content_target = net(content)[content_idx]
 
     img = content.copy()
     img.attach_grad()
@@ -147,9 +158,9 @@ def main():
     for epoch in range(args.max_num_epochs):
         with autograd.record():
             outs = net(img)
-            sl = sum(((gram(f) - g) ** 2).sum()
-                     for f, g in zip(outs[:len(STYLE_LAYERS)], style_grams))
-            cl = ((outs[len(STYLE_LAYERS) - 1] - content_target) ** 2).sum()
+            sl = sum(((gram(outs[i]) - g) ** 2).sum()
+                     for i, g in zip(style_idx, style_grams))
+            cl = ((outs[content_idx] - content_target) ** 2).sum()
             loss = (args.style_weight * sl + args.content_weight * cl
                     + tv_loss(img, args.tv_weight))
         loss.backward()

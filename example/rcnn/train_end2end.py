@@ -229,10 +229,10 @@ def main():
             x = mx.nd.array(imgs, ctx=ctx)
 
             # host-side RPN targets
-            lab_np = np.stack([anchor_targets(anchors, gt[i], size)[0]
-                               for i in range(args.batch_size)])
-            tgt_np = np.stack([anchor_targets(anchors, gt[i], size)[1]
-                               for i in range(args.batch_size)])
+            pairs = [anchor_targets(anchors, gt[i], size)
+                     for i in range(args.batch_size)]
+            lab_np = np.stack([p[0] for p in pairs])
+            tgt_np = np.stack([p[1] for p in pairs])
             rpn_label = mx.nd.array(lab_np)
             rpn_tgt = mx.nd.array(tgt_np)
 

@@ -58,7 +58,7 @@ def make_utterances(rs, n, n_frames, n_phones, feat_dim, emb):
         prev = None
         while t < n_frames:
             ph = rs.randint(1, n_phones)
-            if ph == prev:
+            if ph == prev and n_phones > 2:  # 1 phone: repeats unavoidable
                 continue
             dur = rs.randint(3, 8)
             feats[i, t:t + dur] = emb[ph] + rs.normal(

@@ -65,6 +65,7 @@ class Executor:
         self._monitor = None
         self._outputs_cache: Optional[List[NDArray]] = None
         self._snapshot = None  # (arg_vals, aux_vals, key) of last forward
+        self._pending_grads = None  # grads held by a train-mode forward()
         self._remat = bool(getenv("MXNET_BACKWARD_DO_MIRROR", 0))
         # SPMD data parallelism: batch args sharded on 'dp' over the mesh,
         # params replicated; XLA all-reduces gradients over ICI.  This is the
@@ -155,18 +156,36 @@ class Executor:
     def forward(self, is_train: bool = False, **kwargs) -> List[NDArray]:
         arg_vals, aux_vals, key = self._gather(kwargs)
         self._snapshot = (arg_vals, aux_vals, key)
+        self._pending_grads = None
         if self.group2ctx:
             return self._forward_placed(arg_vals, aux_vals, key, is_train)
+        if is_train and self._grad_names:
+            # training forward: run the fused fwd+vjp program now and hold
+            # the grads for backward() — the reference's forward();
+            # backward() pattern then costs ONE compiled step, not a
+            # forward plus a recomputing vjp (default cotangents; a custom
+            # out_grads in backward() falls back to the snapshot replay)
+            ograds = [None] * len(self._plan.out_refs)
+            outs, new_aux, grads = self._fwd_bwd(arg_vals, aux_vals, key,
+                                                 ograds)
+            self._set_results(outs, new_aux)
+            self._pending_grads = grads
+            return self._outputs_cache
         outs, new_aux = self._fwd(arg_vals, aux_vals, key, is_train)
         self._set_results(outs, new_aux)
         return self._outputs_cache
 
     def backward(self, out_grads=None, is_train: bool = True) -> None:
-        """Gradient pass. Re-runs the forward inside the compiled vjp using
-        the snapshot from forward() (same RNG key → same dropout mask; aux
+        """Gradient pass.  Deposits the grads computed by a train-mode
+        forward(); with custom head gradients it re-runs the compiled vjp
+        on the forward snapshot (same RNG key → same dropout mask; aux
         values restored → moving stats not double-updated)."""
         if self._snapshot is None:
             raise MXNetError("backward called before forward")
+        if out_grads is None and self._pending_grads is not None:
+            self._deposit_grads(self._pending_grads)
+            self._pending_grads = None
+            return
         arg_vals, aux_vals, key = self._snapshot
         self._run_fused(arg_vals, aux_vals, key, out_grads)
 
@@ -175,6 +194,7 @@ class Executor:
         (the Module.fit hot path)."""
         arg_vals, aux_vals, key = self._gather(kwargs)
         self._snapshot = (arg_vals, aux_vals, key)
+        self._pending_grads = None
         self._run_fused(arg_vals, aux_vals, key, out_grads)
         return self._outputs_cache
 
@@ -188,6 +208,9 @@ class Executor:
                       for g in out_grads]
         outs, new_aux, grads = self._fwd_bwd(arg_vals, aux_vals, key, ograds)
         self._set_results(outs, new_aux)
+        self._deposit_grads(grads)
+
+    def _deposit_grads(self, grads):
         for name in self._grad_names:
             g = grads[name]
             tgt = self.grad_dict.get(name)

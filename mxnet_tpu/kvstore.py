@@ -40,6 +40,20 @@ from .ndarray import NDArray
 from . import optimizer as opt
 
 
+def _handoff(src: NDArray, dst: NDArray) -> None:
+    """Pull a store value into `dst`.  Arrays are immutable jax values, so
+    when dtype and placement already match this is a pointer hand-off —
+    zero device operations — instead of the reference's engine CopyTo.
+    Per-key device_puts here were the Module.update bottleneck on the
+    tunneled TPU (one RPC per parameter per step)."""
+    sd, dd = src._data, dst._data
+    if (sd.dtype == dd.dtype and
+            getattr(sd, "sharding", None) == getattr(dd, "sharding", None)):
+        dst._set_data(sd)
+    else:
+        src.copyto(dst)
+
+
 def _quantize_2bit_impl(arr, residual, threshold):
     """2-bit quantization with error feedback (pure; traceable inside any
     outer jit — the fused pushpull path inlines it).
@@ -242,7 +256,7 @@ class KVStore:
                 src = self._store[k]
                 for o in olist:
                     if o is not src:
-                        src.copyto(o)
+                        _handoff(src, o)
 
     def _fused_merge(self, keys, vals) -> List:
         """One jitted program: per-key device-copy sum (+2-bit compression
@@ -295,7 +309,7 @@ class KVStore:
                 raise MXNetError(f"key {k} not initialized")
             src = self._store[k]
             for o in olist:
-                src.copyto(o)
+                _handoff(src, o)
 
     def row_sparse_pull(self, key, out=None, priority=0, row_ids=None) -> None:
         """Pull only the rows in row_ids (parity: KVStore::PullRowSparse)."""

@@ -28,6 +28,12 @@ def _ctc_loss(p, data, label, data_lengths=None, label_lengths=None):
     label: (N, L) padded with 0/-1; optional per-sequence lengths gated by
     use_data_lengths / use_label_lengths (reference inputs 3 and 4)."""
     import optax
+    if (p["use_label_lengths"] and not p["use_data_lengths"]
+            and label_lengths is None):
+        # positional call with the unused data_lengths slot elided (symbol
+        # graphs bind inputs positionally; the slot list is gated on the
+        # use_* flags) — the third input IS label_lengths
+        data_lengths, label_lengths = None, data_lengths
     T, N, C = data.shape
     logits = jnp.transpose(data, (1, 0, 2))  # (N,T,C)
     labels = label.astype(jnp.int32)

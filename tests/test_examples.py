@@ -312,3 +312,10 @@ def test_speech_ctc_example():
     first = float(lines[0].split("ctc-loss=")[1].split()[0])
     last = float(lines[-1].split("ctc-loss=")[1].split()[0])
     assert np.isfinite(last) and last <= first + 1.0, out
+
+
+def test_profiler_example(tmp_path):
+    out = run_example("example/profiler/profiler_executor.py",
+                      "--iters", "5", "--file",
+                      str(tmp_path / "trace.json"))
+    assert "events" in out

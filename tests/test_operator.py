@@ -582,3 +582,28 @@ def test_convolution_v1_alias():
     a = nd.Convolution(x, w, b, kernel=(3, 3), num_filter=3)
     v1 = nd.Convolution_v1(x, w, b, kernel=(3, 3), num_filter=3)
     assert_almost_equal(a.asnumpy(), v1.asnumpy(), rtol=1e-6)
+
+
+def test_ctc_loss_lengths_symbol_eager_parity():
+    """CTC with per-sequence lengths: the symbol graph binds inputs
+    positionally with the unused data_lengths slot elided — must match the
+    eager keyword call (regression: slot shift silently dropped lengths)."""
+    import mxnet_tpu as mx
+    rs = np.random.RandomState(0)
+    P = rs.randn(5, 2, 4).astype("f")
+    L = np.array([[1, 2, 3, 0], [2, 1, 0, 0]], "f")
+    LL = np.array([3, 2], "f")
+    eager = nd.CTCLoss(nd.array(P), nd.array(L),
+                       label_lengths=nd.array(LL),
+                       use_label_lengths=True, blank_label="last").asnumpy()
+    s = mx.sym.CTCLoss(mx.sym.Variable("pred"), mx.sym.Variable("label"),
+                       label_lengths=mx.sym.Variable("ll"),
+                       use_label_lengths=True, blank_label="last")
+    ex = s.simple_bind(mx.cpu(), pred=(5, 2, 4), label=(2, 4), ll=(2,))
+    sym_out = ex.forward(pred=P, label=L, ll=LL)[0].asnumpy()
+    assert_almost_equal(sym_out, eager, rtol=1e-5)
+    # lengths actually bite: truncating label 2's pad changes the loss
+    full = nd.CTCLoss(nd.array(P), nd.array(L),
+                      label_lengths=nd.array([4.0, 4.0]),
+                      use_label_lengths=True, blank_label="last").asnumpy()
+    assert abs(full[1] - eager[1]) > 1e-3
