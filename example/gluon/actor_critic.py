@@ -1,0 +1,121 @@
+"""Actor-critic policy-gradient training on CartPole.
+
+Parity: /root/reference/example/gluon/actor_critic.py (gluon net with a
+shared torso and policy+value heads, REINFORCE-with-baseline updates).
+The reference pulls the environment from OpenAI gym; this host is
+zero-egress, so the classic CartPole dynamics (the standard cart-pole
+physics used by gym's CartPole-v1) are implemented inline in numpy.
+
+TPU-native notes: the policy step is a tiny jitted CachedOp forward; the
+episode rollout is inherently host-interactive (env.step between actions)
+— exactly like the reference — while the batched loss/backward at episode
+end is one compiled program.
+"""
+import argparse
+import logging
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+from mxnet_tpu.gluon import nn
+
+
+class CartPole:
+    """Classic cart-pole balancing dynamics (Barto, Sutton & Anderson)."""
+
+    def __init__(self, rs):
+        self.rs = rs
+        self.g, self.mc, self.mp, self.l = 9.8, 1.0, 0.1, 0.5
+        self.force, self.dt = 10.0, 0.02
+        self.x_lim, self.th_lim = 2.4, 12 * np.pi / 180
+
+    def reset(self):
+        self.s = self.rs.uniform(-0.05, 0.05, 4)
+        return self.s.copy()
+
+    def step(self, action):
+        x, xd, th, thd = self.s
+        f = self.force if action == 1 else -self.force
+        ct, st = np.cos(th), np.sin(th)
+        tm = self.mc + self.mp
+        tmp = (f + self.mp * self.l * thd ** 2 * st) / tm
+        thacc = (self.g * st - ct * tmp) / \
+            (self.l * (4.0 / 3.0 - self.mp * ct ** 2 / tm))
+        xacc = tmp - self.mp * self.l * thacc * ct / tm
+        self.s = np.array([x + self.dt * xd, xd + self.dt * xacc,
+                           th + self.dt * thd, thd + self.dt * thacc])
+        done = (abs(self.s[0]) > self.x_lim or abs(self.s[2]) > self.th_lim)
+        return self.s.copy(), 1.0, done
+
+
+class Net(gluon.Block):
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        with self.name_scope():
+            self.dense = nn.Dense(128, activation="relu")
+            self.action_pred = nn.Dense(2)
+            self.value_pred = nn.Dense(1)
+
+    def forward(self, x):
+        h = self.dense(x)
+        return mx.nd.softmax(self.action_pred(h)), self.value_pred(h)
+
+
+def main():
+    ap = argparse.ArgumentParser(description="actor-critic cartpole")
+    ap.add_argument("--episodes", type=int, default=120)
+    ap.add_argument("--gamma", type=float, default=0.99)
+    ap.add_argument("--lr", type=float, default=3e-2)
+    ap.add_argument("--max-steps", type=int, default=200)
+    ap.add_argument("--seed", type=int, default=1)
+    ap.add_argument("--log-every", type=int, default=20)
+    args = ap.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    rs = np.random.RandomState(args.seed)
+    env = CartPole(rs)
+    ctx = mx.cpu()
+    net = Net()
+    net.initialize(mx.init.Uniform(0.02), ctx=ctx)
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": args.lr})
+
+    running = 10.0
+    for ep in range(args.episodes):
+        state = env.reset()
+        rewards, heads, values = [], [], []
+        with autograd.record():
+            for t in range(args.max_steps):
+                probs, value = net(mx.nd.array(state[None].astype("f"),
+                                               ctx=ctx))
+                p = probs.asnumpy()[0]
+                action = int(rs.choice(2, p=p / p.sum()))
+                heads.append(mx.nd.log(probs[0, action] + 1e-8))
+                values.append(value[0, 0])
+                state, r, done = env.step(action)
+                rewards.append(r)
+                if done:
+                    break
+            # discounted returns, normalized (reference's update rule)
+            R, returns = 0.0, []
+            for r in rewards[::-1]:
+                R = r + args.gamma * R
+                returns.insert(0, R)
+            rts = np.asarray(returns, np.float32)
+            rts = (rts - rts.mean()) / (rts.std() + 1e-6)
+            loss = 0.0
+            for logp, v, rt in zip(heads, values, rts):
+                adv = float(rt) - float(v.asnumpy())
+                loss = loss - logp * adv + (v - float(rt)) ** 2
+        loss.backward()
+        trainer.step(1)
+        running = 0.95 * running + 0.05 * len(rewards)
+        if ep % args.log_every == 0 or ep == args.episodes - 1:
+            logging.info("episode %d length %d running %.1f", ep,
+                         len(rewards), running)
+    print("final running length %.2f" % running)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,37 @@
+"""AlexNet symbol (parity target: symbols/alexnet.py — Krizhevsky 2012,
+single-tower variant).  TPU notes: LRN lowers to an XLA reduce-window
+chain; the big FC layers are MXU-friendly matmuls."""
+import mxnet_tpu as mx
+
+
+def get_symbol(num_classes=1000, **kwargs):
+    data = mx.sym.Variable("data")
+    c1 = mx.sym.Convolution(data, kernel=(11, 11), stride=(4, 4),
+                            num_filter=96, name="conv1")
+    r1 = mx.sym.Activation(c1, act_type="relu")
+    l1 = mx.sym.LRN(r1, alpha=1e-4, beta=0.75, knorm=2, nsize=5)
+    p1 = mx.sym.Pooling(l1, kernel=(3, 3), stride=(2, 2), pool_type="max")
+    c2 = mx.sym.Convolution(p1, kernel=(5, 5), pad=(2, 2), num_filter=256,
+                            name="conv2")
+    r2 = mx.sym.Activation(c2, act_type="relu")
+    l2 = mx.sym.LRN(r2, alpha=1e-4, beta=0.75, knorm=2, nsize=5)
+    p2 = mx.sym.Pooling(l2, kernel=(3, 3), stride=(2, 2), pool_type="max")
+    c3 = mx.sym.Convolution(p2, kernel=(3, 3), pad=(1, 1), num_filter=384,
+                            name="conv3")
+    r3 = mx.sym.Activation(c3, act_type="relu")
+    c4 = mx.sym.Convolution(r3, kernel=(3, 3), pad=(1, 1), num_filter=384,
+                            name="conv4")
+    r4 = mx.sym.Activation(c4, act_type="relu")
+    c5 = mx.sym.Convolution(r4, kernel=(3, 3), pad=(1, 1), num_filter=256,
+                            name="conv5")
+    r5 = mx.sym.Activation(c5, act_type="relu")
+    p5 = mx.sym.Pooling(r5, kernel=(3, 3), stride=(2, 2), pool_type="max")
+    f6 = mx.sym.FullyConnected(mx.sym.Flatten(p5), num_hidden=4096,
+                               name="fc6")
+    r6 = mx.sym.Activation(f6, act_type="relu")
+    d6 = mx.sym.Dropout(r6, p=0.5)
+    f7 = mx.sym.FullyConnected(d6, num_hidden=4096, name="fc7")
+    r7 = mx.sym.Activation(f7, act_type="relu")
+    d7 = mx.sym.Dropout(r7, p=0.5)
+    f8 = mx.sym.FullyConnected(d7, num_hidden=num_classes, name="fc8")
+    return mx.sym.SoftmaxOutput(f8, name="softmax")

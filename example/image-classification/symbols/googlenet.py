@@ -1,0 +1,45 @@
+"""GoogLeNet / Inception-v1 symbol (parity target: symbols/googlenet.py —
+Szegedy 2014, without the auxiliary heads)."""
+import mxnet_tpu as mx
+
+
+def conv(x, f, k, s=(1, 1), p=(0, 0), name=None):
+    x = mx.sym.Convolution(x, num_filter=f, kernel=k, stride=s, pad=p,
+                           name=f"conv_{name}")
+    return mx.sym.Activation(x, act_type="relu", name=f"relu_{name}")
+
+
+def inception(x, f1, f3r, f3, f5r, f5, fp, name):
+    b1 = conv(x, f1, (1, 1), name=f"{name}_1x1")
+    b3 = conv(x, f3r, (1, 1), name=f"{name}_3x3r")
+    b3 = conv(b3, f3, (3, 3), p=(1, 1), name=f"{name}_3x3")
+    b5 = conv(x, f5r, (1, 1), name=f"{name}_5x5r")
+    b5 = conv(b5, f5, (5, 5), p=(2, 2), name=f"{name}_5x5")
+    bp = mx.sym.Pooling(x, kernel=(3, 3), stride=(1, 1), pad=(1, 1),
+                        pool_type="max")
+    bp = conv(bp, fp, (1, 1), name=f"{name}_proj")
+    return mx.sym.Concat(b1, b3, b5, bp, dim=1, name=f"{name}_concat")
+
+
+def get_symbol(num_classes=1000, **kwargs):
+    x = mx.sym.Variable("data")
+    x = conv(x, 64, (7, 7), s=(2, 2), p=(3, 3), name="1")
+    x = mx.sym.Pooling(x, kernel=(3, 3), stride=(2, 2), pool_type="max")
+    x = conv(x, 64, (1, 1), name="2r")
+    x = conv(x, 192, (3, 3), p=(1, 1), name="2")
+    x = mx.sym.Pooling(x, kernel=(3, 3), stride=(2, 2), pool_type="max")
+    x = inception(x, 64, 96, 128, 16, 32, 32, "3a")
+    x = inception(x, 128, 128, 192, 32, 96, 64, "3b")
+    x = mx.sym.Pooling(x, kernel=(3, 3), stride=(2, 2), pool_type="max")
+    x = inception(x, 192, 96, 208, 16, 48, 64, "4a")
+    x = inception(x, 160, 112, 224, 24, 64, 64, "4b")
+    x = inception(x, 128, 128, 256, 24, 64, 64, "4c")
+    x = inception(x, 112, 144, 288, 32, 64, 64, "4d")
+    x = inception(x, 256, 160, 320, 32, 128, 128, "4e")
+    x = mx.sym.Pooling(x, kernel=(3, 3), stride=(2, 2), pool_type="max")
+    x = inception(x, 256, 160, 320, 32, 128, 128, "5a")
+    x = inception(x, 384, 192, 384, 48, 128, 128, "5b")
+    x = mx.sym.Pooling(x, global_pool=True, pool_type="avg", kernel=(1, 1))
+    x = mx.sym.Dropout(mx.sym.Flatten(x), p=0.4)
+    x = mx.sym.FullyConnected(x, num_hidden=num_classes, name="fc")
+    return mx.sym.SoftmaxOutput(x, name="softmax")

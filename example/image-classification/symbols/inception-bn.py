@@ -1,0 +1,51 @@
+"""Inception-BN symbol (parity target: symbols/inception-bn.py — the
+BN-Inception network of Ioffe & Szegedy 2015)."""
+import mxnet_tpu as mx
+
+
+def conv(x, f, k, s=(1, 1), p=(0, 0), name=None):
+    x = mx.sym.Convolution(x, num_filter=f, kernel=k, stride=s, pad=p,
+                           no_bias=True, name=f"conv_{name}")
+    x = mx.sym.BatchNorm(x, fix_gamma=False, name=f"bn_{name}")
+    return mx.sym.Activation(x, act_type="relu", name=f"relu_{name}")
+
+
+def inception(x, f1, f3r, f3, fd3r, fd3, pool, fp, name):
+    b1 = conv(x, f1, (1, 1), name=f"{name}_1x1") if f1 else None
+    b3 = conv(x, f3r, (1, 1), name=f"{name}_3x3r")
+    stride = (1, 1) if f1 else (2, 2)
+    b3 = conv(b3, f3, (3, 3), s=stride, p=(1, 1), name=f"{name}_3x3")
+    bd = conv(x, fd3r, (1, 1), name=f"{name}_d3x3r")
+    bd = conv(bd, fd3, (3, 3), p=(1, 1), name=f"{name}_d3x3a")
+    bd = conv(bd, fd3, (3, 3), s=stride, p=(1, 1), name=f"{name}_d3x3b")
+    if f1:
+        bp = mx.sym.Pooling(x, kernel=(3, 3), stride=(1, 1), pad=(1, 1),
+                            pool_type=pool)
+        bp = conv(bp, fp, (1, 1), name=f"{name}_proj")
+        return mx.sym.Concat(b1, b3, bd, bp, dim=1, name=f"{name}_concat")
+    bp = mx.sym.Pooling(x, kernel=(3, 3), stride=(2, 2), pad=(1, 1),
+                        pool_type=pool)
+    return mx.sym.Concat(b3, bd, bp, dim=1, name=f"{name}_concat")
+
+
+def get_symbol(num_classes=1000, **kwargs):
+    x = mx.sym.Variable("data")
+    x = conv(x, 64, (7, 7), s=(2, 2), p=(3, 3), name="1")
+    x = mx.sym.Pooling(x, kernel=(3, 3), stride=(2, 2), pool_type="max")
+    x = conv(x, 64, (1, 1), name="2r")
+    x = conv(x, 192, (3, 3), p=(1, 1), name="2")
+    x = mx.sym.Pooling(x, kernel=(3, 3), stride=(2, 2), pool_type="max")
+    x = inception(x, 64, 64, 64, 64, 96, "avg", 32, "3a")
+    x = inception(x, 64, 64, 96, 64, 96, "avg", 64, "3b")
+    x = inception(x, 0, 128, 160, 64, 96, "max", 0, "3c")
+    x = inception(x, 224, 64, 96, 96, 128, "avg", 128, "4a")
+    x = inception(x, 192, 96, 128, 96, 128, "avg", 128, "4b")
+    x = inception(x, 160, 128, 160, 128, 160, "avg", 128, "4c")
+    x = inception(x, 96, 128, 192, 160, 192, "avg", 128, "4d")
+    x = inception(x, 0, 128, 192, 192, 256, "max", 0, "4e")
+    x = inception(x, 352, 192, 320, 160, 224, "avg", 128, "5a")
+    x = inception(x, 352, 192, 320, 192, 224, "max", 128, "5b")
+    x = mx.sym.Pooling(x, global_pool=True, pool_type="avg", kernel=(1, 1))
+    x = mx.sym.FullyConnected(mx.sym.Flatten(x), num_hidden=num_classes,
+                              name="fc1")
+    return mx.sym.SoftmaxOutput(x, name="softmax")

@@ -1,0 +1,101 @@
+"""Inception-v3 symbol (parity target: symbols/inception-v3.py — Szegedy
+2015 'Rethinking the Inception Architecture', 299x299 input)."""
+import mxnet_tpu as mx
+
+
+def conv(x, f, k, s=(1, 1), p=(0, 0), name=None):
+    x = mx.sym.Convolution(x, num_filter=f, kernel=k, stride=s, pad=p,
+                           no_bias=True, name=f"{name}_conv")
+    x = mx.sym.BatchNorm(x, fix_gamma=True, eps=1e-3, name=f"{name}_bn")
+    return mx.sym.Activation(x, act_type="relu", name=f"{name}_relu")
+
+
+def pool(x, k, s, ptype, p=(0, 0)):
+    return mx.sym.Pooling(x, kernel=k, stride=s, pad=p, pool_type=ptype)
+
+
+def inc_a(x, fp, name):
+    b1 = conv(x, 64, (1, 1), name=f"{name}_1x1")
+    b5 = conv(x, 48, (1, 1), name=f"{name}_5r")
+    b5 = conv(b5, 64, (5, 5), p=(2, 2), name=f"{name}_5x5")
+    b3 = conv(x, 64, (1, 1), name=f"{name}_3r")
+    b3 = conv(b3, 96, (3, 3), p=(1, 1), name=f"{name}_3a")
+    b3 = conv(b3, 96, (3, 3), p=(1, 1), name=f"{name}_3b")
+    bp = pool(x, (3, 3), (1, 1), "avg", (1, 1))
+    bp = conv(bp, fp, (1, 1), name=f"{name}_proj")
+    return mx.sym.Concat(b1, b5, b3, bp, dim=1)
+
+
+def red_a(x, name):
+    b3 = conv(x, 384, (3, 3), s=(2, 2), name=f"{name}_3x3")
+    bd = conv(x, 64, (1, 1), name=f"{name}_dr")
+    bd = conv(bd, 96, (3, 3), p=(1, 1), name=f"{name}_da")
+    bd = conv(bd, 96, (3, 3), s=(2, 2), name=f"{name}_db")
+    bp = pool(x, (3, 3), (2, 2), "max")
+    return mx.sym.Concat(b3, bd, bp, dim=1)
+
+
+def inc_b(x, f7, name):
+    b1 = conv(x, 192, (1, 1), name=f"{name}_1x1")
+    b7 = conv(x, f7, (1, 1), name=f"{name}_7r")
+    b7 = conv(b7, f7, (1, 7), p=(0, 3), name=f"{name}_7a")
+    b7 = conv(b7, 192, (7, 1), p=(3, 0), name=f"{name}_7b")
+    bd = conv(x, f7, (1, 1), name=f"{name}_dr")
+    bd = conv(bd, f7, (7, 1), p=(3, 0), name=f"{name}_da")
+    bd = conv(bd, f7, (1, 7), p=(0, 3), name=f"{name}_db")
+    bd = conv(bd, f7, (7, 1), p=(3, 0), name=f"{name}_dc")
+    bd = conv(bd, 192, (1, 7), p=(0, 3), name=f"{name}_dd")
+    bp = pool(x, (3, 3), (1, 1), "avg", (1, 1))
+    bp = conv(bp, 192, (1, 1), name=f"{name}_proj")
+    return mx.sym.Concat(b1, b7, bd, bp, dim=1)
+
+
+def red_b(x, name):
+    b3 = conv(x, 192, (1, 1), name=f"{name}_3r")
+    b3 = conv(b3, 320, (3, 3), s=(2, 2), name=f"{name}_3x3")
+    b7 = conv(x, 192, (1, 1), name=f"{name}_7r")
+    b7 = conv(b7, 192, (1, 7), p=(0, 3), name=f"{name}_7a")
+    b7 = conv(b7, 192, (7, 1), p=(3, 0), name=f"{name}_7b")
+    b7 = conv(b7, 192, (3, 3), s=(2, 2), name=f"{name}_7c")
+    bp = pool(x, (3, 3), (2, 2), "max")
+    return mx.sym.Concat(b3, b7, bp, dim=1)
+
+
+def inc_c(x, name):
+    b1 = conv(x, 320, (1, 1), name=f"{name}_1x1")
+    b3 = conv(x, 384, (1, 1), name=f"{name}_3r")
+    b3a = conv(b3, 384, (1, 3), p=(0, 1), name=f"{name}_3a")
+    b3b = conv(b3, 384, (3, 1), p=(1, 0), name=f"{name}_3b")
+    bd = conv(x, 448, (1, 1), name=f"{name}_dr")
+    bd = conv(bd, 384, (3, 3), p=(1, 1), name=f"{name}_d")
+    bda = conv(bd, 384, (1, 3), p=(0, 1), name=f"{name}_da")
+    bdb = conv(bd, 384, (3, 1), p=(1, 0), name=f"{name}_db")
+    bp = pool(x, (3, 3), (1, 1), "avg", (1, 1))
+    bp = conv(bp, 192, (1, 1), name=f"{name}_proj")
+    return mx.sym.Concat(b1, b3a, b3b, bda, bdb, bp, dim=1)
+
+
+def get_symbol(num_classes=1000, **kwargs):
+    x = mx.sym.Variable("data")
+    x = conv(x, 32, (3, 3), s=(2, 2), name="c1")
+    x = conv(x, 32, (3, 3), name="c2")
+    x = conv(x, 64, (3, 3), p=(1, 1), name="c3")
+    x = pool(x, (3, 3), (2, 2), "max")
+    x = conv(x, 80, (1, 1), name="c4")
+    x = conv(x, 192, (3, 3), name="c5")
+    x = pool(x, (3, 3), (2, 2), "max")
+    x = inc_a(x, 32, "a1")
+    x = inc_a(x, 64, "a2")
+    x = inc_a(x, 64, "a3")
+    x = red_a(x, "ra")
+    x = inc_b(x, 128, "b1")
+    x = inc_b(x, 160, "b2")
+    x = inc_b(x, 160, "b3")
+    x = inc_b(x, 192, "b4")
+    x = red_b(x, "rb")
+    x = inc_c(x, "c1i")
+    x = inc_c(x, "c2i")
+    x = mx.sym.Pooling(x, global_pool=True, pool_type="avg", kernel=(1, 1))
+    x = mx.sym.Dropout(mx.sym.Flatten(x), p=0.5)
+    x = mx.sym.FullyConnected(x, num_hidden=num_classes, name="fc1")
+    return mx.sym.SoftmaxOutput(x, name="softmax")

@@ -1,0 +1,36 @@
+"""MobileNet symbol (parity target: symbols/mobilenet.py — Howard 2017
+depthwise-separable convolutions; width multiplier via `multiplier`).
+TPU notes: the depthwise conv is a grouped conv with
+feature_group_count == channels — one XLA kernel."""
+import mxnet_tpu as mx
+
+
+def conv_bn(x, f, k, s, p, name, num_group=1):
+    x = mx.sym.Convolution(x, num_filter=f, kernel=k, stride=s, pad=p,
+                           num_group=num_group, no_bias=True,
+                           name=f"{name}_conv")
+    x = mx.sym.BatchNorm(x, fix_gamma=False, name=f"{name}_bn")
+    return mx.sym.Activation(x, act_type="relu", name=f"{name}_relu")
+
+
+def dw_sep(x, ch_in, ch_out, stride, name):
+    x = conv_bn(x, ch_in, (3, 3), stride, (1, 1), f"{name}_dw",
+                num_group=ch_in)
+    return conv_bn(x, ch_out, (1, 1), (1, 1), (0, 0), f"{name}_pw")
+
+
+def get_symbol(num_classes=1000, multiplier=1.0, **kwargs):
+    def c(n):
+        return max(8, int(n * multiplier))
+
+    x = mx.sym.Variable("data")
+    x = conv_bn(x, c(32), (3, 3), (2, 2), (1, 1), "conv1")
+    cfg = [(32, 64, 1), (64, 128, 2), (128, 128, 1), (128, 256, 2),
+           (256, 256, 1), (256, 512, 2)] + [(512, 512, 1)] * 5 + \
+          [(512, 1024, 2), (1024, 1024, 1)]
+    for i, (ci, co, s) in enumerate(cfg, 2):
+        x = dw_sep(x, c(ci), c(co), (s, s), f"block{i}")
+    x = mx.sym.Pooling(x, global_pool=True, pool_type="avg", kernel=(7, 7))
+    x = mx.sym.FullyConnected(mx.sym.Flatten(x), num_hidden=num_classes,
+                              name="fc")
+    return mx.sym.SoftmaxOutput(x, name="softmax")

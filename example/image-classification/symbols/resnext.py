@@ -1,0 +1,61 @@
+"""ResNeXt symbol (parity target: symbols/resnext.py — Xie 2016 aggregated
+residual transforms via grouped convolution; num_group=32 cardinality).
+TPU notes: grouped conv lowers to one `lax.conv_general_dilated` with
+feature_group_count — a single MXU kernel, no per-group loop."""
+import mxnet_tpu as mx
+
+
+def residual_unit(data, num_filter, stride, dim_match, name, num_group=32,
+                  bottle_neck=True, bn_mom=0.9):
+    if bottle_neck:
+        mid = int(num_filter * 0.5)
+        c1 = mx.sym.Convolution(data, num_filter=mid, kernel=(1, 1),
+                                no_bias=True, name=name + "_conv1")
+        b1 = mx.sym.BatchNorm(c1, fix_gamma=False, eps=2e-5, momentum=bn_mom,
+                              name=name + "_bn1")
+        a1 = mx.sym.Activation(b1, act_type="relu")
+        c2 = mx.sym.Convolution(a1, num_filter=mid, num_group=num_group,
+                                kernel=(3, 3), stride=stride, pad=(1, 1),
+                                no_bias=True, name=name + "_conv2")
+        b2 = mx.sym.BatchNorm(c2, fix_gamma=False, eps=2e-5, momentum=bn_mom,
+                              name=name + "_bn2")
+        a2 = mx.sym.Activation(b2, act_type="relu")
+        c3 = mx.sym.Convolution(a2, num_filter=num_filter, kernel=(1, 1),
+                                no_bias=True, name=name + "_conv3")
+        b3 = mx.sym.BatchNorm(c3, fix_gamma=False, eps=2e-5, momentum=bn_mom,
+                              name=name + "_bn3")
+        if dim_match:
+            sc = data
+        else:
+            sc = mx.sym.Convolution(data, num_filter=num_filter,
+                                    kernel=(1, 1), stride=stride,
+                                    no_bias=True, name=name + "_sc")
+            sc = mx.sym.BatchNorm(sc, fix_gamma=False, eps=2e-5,
+                                  momentum=bn_mom, name=name + "_sc_bn")
+        return mx.sym.Activation(b3 + sc, act_type="relu")
+    raise ValueError("resnext uses bottleneck units")
+
+
+def get_symbol(num_classes=1000, num_layers=50, num_group=32, **kwargs):
+    stages = {50: [3, 4, 6, 3], 101: [3, 4, 23, 3],
+              152: [3, 8, 36, 3]}.get(num_layers)
+    if stages is None:
+        raise ValueError("resnext depth must be 50/101/152")
+    filters = [256, 512, 1024, 2048]
+    x = mx.sym.Variable("data")
+    x = mx.sym.Convolution(x, num_filter=64, kernel=(7, 7), stride=(2, 2),
+                           pad=(3, 3), no_bias=True, name="conv0")
+    x = mx.sym.BatchNorm(x, fix_gamma=False, eps=2e-5, name="bn0")
+    x = mx.sym.Activation(x, act_type="relu")
+    x = mx.sym.Pooling(x, kernel=(3, 3), stride=(2, 2), pad=(1, 1),
+                       pool_type="max")
+    for i, (n, f) in enumerate(zip(stages, filters), 1):
+        stride = (1, 1) if i == 1 else (2, 2)
+        x = residual_unit(x, f, stride, False, f"stage{i}_unit1", num_group)
+        for j in range(2, n + 1):
+            x = residual_unit(x, f, (1, 1), True, f"stage{i}_unit{j}",
+                              num_group)
+    x = mx.sym.Pooling(x, global_pool=True, pool_type="avg", kernel=(7, 7))
+    x = mx.sym.FullyConnected(mx.sym.Flatten(x), num_hidden=num_classes,
+                              name="fc1")
+    return mx.sym.SoftmaxOutput(x, name="softmax")

@@ -1,0 +1,35 @@
+"""VGG symbol (parity target: symbols/vgg.py — Simonyan & Zisserman,
+11/13/16/19-layer configs selected by num_layers)."""
+import mxnet_tpu as mx
+
+CFG = {
+    11: ([1, 1, 2, 2, 2], [64, 128, 256, 512, 512]),
+    13: ([2, 2, 2, 2, 2], [64, 128, 256, 512, 512]),
+    16: ([2, 2, 3, 3, 3], [64, 128, 256, 512, 512]),
+    19: ([2, 2, 4, 4, 4], [64, 128, 256, 512, 512]),
+}
+
+
+def get_symbol(num_classes=1000, num_layers=16, batch_norm=False, **kwargs):
+    if num_layers not in CFG:
+        raise ValueError(f"vgg depth must be one of {sorted(CFG)}")
+    layers, filters = CFG[num_layers]
+    x = mx.sym.Variable("data")
+    for i, (n, f) in enumerate(zip(layers, filters), 1):
+        for j in range(1, n + 1):
+            x = mx.sym.Convolution(x, kernel=(3, 3), pad=(1, 1),
+                                   num_filter=f, name=f"conv{i}_{j}")
+            if batch_norm:
+                x = mx.sym.BatchNorm(x, name=f"bn{i}_{j}")
+            x = mx.sym.Activation(x, act_type="relu", name=f"relu{i}_{j}")
+        x = mx.sym.Pooling(x, kernel=(2, 2), stride=(2, 2), pool_type="max",
+                           name=f"pool{i}")
+    x = mx.sym.Flatten(x)
+    x = mx.sym.FullyConnected(x, num_hidden=4096, name="fc6")
+    x = mx.sym.Activation(x, act_type="relu")
+    x = mx.sym.Dropout(x, p=0.5)
+    x = mx.sym.FullyConnected(x, num_hidden=4096, name="fc7")
+    x = mx.sym.Activation(x, act_type="relu")
+    x = mx.sym.Dropout(x, p=0.5)
+    x = mx.sym.FullyConnected(x, num_hidden=num_classes, name="fc8")
+    return mx.sym.SoftmaxOutput(x, name="softmax")
