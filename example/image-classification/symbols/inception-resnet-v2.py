@@ -1,0 +1,106 @@
+"""Inception-ResNet-v2 symbol (parity target: symbols/inception-resnet-v2.py
+— Szegedy 2016, residual Inception blocks with scaled residuals)."""
+import mxnet_tpu as mx
+
+
+def conv(x, f, k, s=(1, 1), p=(0, 0), act=True, name=None):
+    x = mx.sym.Convolution(x, num_filter=f, kernel=k, stride=s, pad=p,
+                           no_bias=True, name=f"{name}_conv")
+    x = mx.sym.BatchNorm(x, fix_gamma=True, eps=1e-3, name=f"{name}_bn")
+    if act:
+        x = mx.sym.Activation(x, act_type="relu", name=f"{name}_relu")
+    return x
+
+
+def pool(x, k, s, ptype, p=(0, 0)):
+    return mx.sym.Pooling(x, kernel=k, stride=s, pad=p, pool_type=ptype)
+
+
+def stem(x):
+    x = conv(x, 32, (3, 3), s=(2, 2), name="s1")
+    x = conv(x, 32, (3, 3), name="s2")
+    x = conv(x, 64, (3, 3), p=(1, 1), name="s3")
+    x = pool(x, (3, 3), (2, 2), "max")
+    x = conv(x, 80, (1, 1), name="s4")
+    x = conv(x, 192, (3, 3), name="s5")
+    x = pool(x, (3, 3), (2, 2), "max")
+    # mixed 5b
+    b1 = conv(x, 96, (1, 1), name="m5_1")
+    b2 = conv(x, 48, (1, 1), name="m5_2a")
+    b2 = conv(b2, 64, (5, 5), p=(2, 2), name="m5_2b")
+    b3 = conv(x, 64, (1, 1), name="m5_3a")
+    b3 = conv(b3, 96, (3, 3), p=(1, 1), name="m5_3b")
+    b3 = conv(b3, 96, (3, 3), p=(1, 1), name="m5_3c")
+    bp = pool(x, (3, 3), (1, 1), "avg", (1, 1))
+    bp = conv(bp, 64, (1, 1), name="m5_p")
+    return mx.sym.Concat(b1, b2, b3, bp, dim=1)
+
+
+def block35(x, n, scale=0.17):
+    """Inception-ResNet-A: residual added with a small scale."""
+    b1 = conv(x, 32, (1, 1), name=f"{n}_1")
+    b2 = conv(x, 32, (1, 1), name=f"{n}_2a")
+    b2 = conv(b2, 32, (3, 3), p=(1, 1), name=f"{n}_2b")
+    b3 = conv(x, 32, (1, 1), name=f"{n}_3a")
+    b3 = conv(b3, 48, (3, 3), p=(1, 1), name=f"{n}_3b")
+    b3 = conv(b3, 64, (3, 3), p=(1, 1), name=f"{n}_3c")
+    up = conv(mx.sym.Concat(b1, b2, b3, dim=1), 320, (1, 1), act=False,
+              name=f"{n}_up")
+    return mx.sym.Activation(x + up * scale, act_type="relu")
+
+
+def block17(x, n, scale=0.10):
+    """Inception-ResNet-B."""
+    b1 = conv(x, 192, (1, 1), name=f"{n}_1")
+    b2 = conv(x, 128, (1, 1), name=f"{n}_2a")
+    b2 = conv(b2, 160, (1, 7), p=(0, 3), name=f"{n}_2b")
+    b2 = conv(b2, 192, (7, 1), p=(3, 0), name=f"{n}_2c")
+    up = conv(mx.sym.Concat(b1, b2, dim=1), 1088, (1, 1), act=False,
+              name=f"{n}_up")
+    return mx.sym.Activation(x + up * scale, act_type="relu")
+
+
+def block8(x, n, scale=0.20, act=True):
+    """Inception-ResNet-C."""
+    b1 = conv(x, 192, (1, 1), name=f"{n}_1")
+    b2 = conv(x, 192, (1, 1), name=f"{n}_2a")
+    b2 = conv(b2, 224, (1, 3), p=(0, 1), name=f"{n}_2b")
+    b2 = conv(b2, 256, (3, 1), p=(1, 0), name=f"{n}_2c")
+    up = conv(mx.sym.Concat(b1, b2, dim=1), 2080, (1, 1), act=False,
+              name=f"{n}_up")
+    out = x + up * scale
+    return mx.sym.Activation(out, act_type="relu") if act else out
+
+
+def get_symbol(num_classes=1000, **kwargs):
+    x = mx.sym.Variable("data")
+    x = stem(x)
+    for i in range(5):
+        x = block35(x, f"a{i}")
+    # reduction-A
+    r1 = conv(x, 384, (3, 3), s=(2, 2), name="ra_1")
+    r2 = conv(x, 256, (1, 1), name="ra_2a")
+    r2 = conv(r2, 256, (3, 3), p=(1, 1), name="ra_2b")
+    r2 = conv(r2, 384, (3, 3), s=(2, 2), name="ra_2c")
+    rp = pool(x, (3, 3), (2, 2), "max")
+    x = mx.sym.Concat(r1, r2, rp, dim=1)
+    for i in range(10):
+        x = block17(x, f"b{i}")
+    # reduction-B
+    r1 = conv(x, 256, (1, 1), name="rb_1a")
+    r1 = conv(r1, 384, (3, 3), s=(2, 2), name="rb_1b")
+    r2 = conv(x, 256, (1, 1), name="rb_2a")
+    r2 = conv(r2, 288, (3, 3), s=(2, 2), name="rb_2b")
+    r3 = conv(x, 256, (1, 1), name="rb_3a")
+    r3 = conv(r3, 288, (3, 3), p=(1, 1), name="rb_3b")
+    r3 = conv(r3, 320, (3, 3), s=(2, 2), name="rb_3c")
+    rp = pool(x, (3, 3), (2, 2), "max")
+    x = mx.sym.Concat(r1, r2, r3, rp, dim=1)
+    for i in range(5):
+        x = block8(x, f"c{i}")
+    x = block8(x, "c_last", act=False)
+    x = conv(x, 1536, (1, 1), name="top")
+    x = mx.sym.Pooling(x, global_pool=True, pool_type="avg", kernel=(1, 1))
+    x = mx.sym.Dropout(mx.sym.Flatten(x), p=0.2)
+    x = mx.sym.FullyConnected(x, num_hidden=num_classes, name="fc1")
+    return mx.sym.SoftmaxOutput(x, name="softmax")

@@ -1,0 +1,112 @@
+"""Inception-v4 symbol (parity target: symbols/inception-v4.py — Szegedy
+2016 'Inception-v4, Inception-ResNet...', pure-Inception variant)."""
+import mxnet_tpu as mx
+
+
+def conv(x, f, k, s=(1, 1), p=(0, 0), name=None):
+    x = mx.sym.Convolution(x, num_filter=f, kernel=k, stride=s, pad=p,
+                           no_bias=True, name=f"{name}_conv")
+    x = mx.sym.BatchNorm(x, fix_gamma=True, eps=1e-3, name=f"{name}_bn")
+    return mx.sym.Activation(x, act_type="relu", name=f"{name}_relu")
+
+
+def pool(x, k, s, ptype, p=(0, 0)):
+    return mx.sym.Pooling(x, kernel=k, stride=s, pad=p, pool_type=ptype)
+
+
+def stem(x):
+    x = conv(x, 32, (3, 3), s=(2, 2), name="s1")
+    x = conv(x, 32, (3, 3), name="s2")
+    x = conv(x, 64, (3, 3), p=(1, 1), name="s3")
+    a = pool(x, (3, 3), (2, 2), "max")
+    b = conv(x, 96, (3, 3), s=(2, 2), name="s4")
+    x = mx.sym.Concat(a, b, dim=1)
+    a = conv(x, 64, (1, 1), name="s5a")
+    a = conv(a, 96, (3, 3), name="s5b")
+    b = conv(x, 64, (1, 1), name="s6a")
+    b = conv(b, 64, (1, 7), p=(0, 3), name="s6b")
+    b = conv(b, 64, (7, 1), p=(3, 0), name="s6c")
+    b = conv(b, 96, (3, 3), name="s6d")
+    x = mx.sym.Concat(a, b, dim=1)
+    a = conv(x, 192, (3, 3), s=(2, 2), name="s7")
+    b = pool(x, (3, 3), (2, 2), "max")
+    return mx.sym.Concat(a, b, dim=1)
+
+
+def block_a(x, name):
+    b1 = conv(x, 96, (1, 1), name=f"{name}_1")
+    b2 = conv(x, 64, (1, 1), name=f"{name}_2a")
+    b2 = conv(b2, 96, (3, 3), p=(1, 1), name=f"{name}_2b")
+    b3 = conv(x, 64, (1, 1), name=f"{name}_3a")
+    b3 = conv(b3, 96, (3, 3), p=(1, 1), name=f"{name}_3b")
+    b3 = conv(b3, 96, (3, 3), p=(1, 1), name=f"{name}_3c")
+    bp = pool(x, (3, 3), (1, 1), "avg", (1, 1))
+    bp = conv(bp, 96, (1, 1), name=f"{name}_p")
+    return mx.sym.Concat(b1, b2, b3, bp, dim=1)
+
+
+def red_a(x, name):
+    a = conv(x, 384, (3, 3), s=(2, 2), name=f"{name}_a")
+    b = conv(x, 192, (1, 1), name=f"{name}_ba")
+    b = conv(b, 224, (3, 3), p=(1, 1), name=f"{name}_bb")
+    b = conv(b, 256, (3, 3), s=(2, 2), name=f"{name}_bc")
+    c = pool(x, (3, 3), (2, 2), "max")
+    return mx.sym.Concat(a, b, c, dim=1)
+
+
+def block_b(x, name):
+    b1 = conv(x, 384, (1, 1), name=f"{name}_1")
+    b2 = conv(x, 192, (1, 1), name=f"{name}_2a")
+    b2 = conv(b2, 224, (1, 7), p=(0, 3), name=f"{name}_2b")
+    b2 = conv(b2, 256, (7, 1), p=(3, 0), name=f"{name}_2c")
+    b3 = conv(x, 192, (1, 1), name=f"{name}_3a")
+    b3 = conv(b3, 192, (7, 1), p=(3, 0), name=f"{name}_3b")
+    b3 = conv(b3, 224, (1, 7), p=(0, 3), name=f"{name}_3c")
+    b3 = conv(b3, 224, (7, 1), p=(3, 0), name=f"{name}_3d")
+    b3 = conv(b3, 256, (1, 7), p=(0, 3), name=f"{name}_3e")
+    bp = pool(x, (3, 3), (1, 1), "avg", (1, 1))
+    bp = conv(bp, 128, (1, 1), name=f"{name}_p")
+    return mx.sym.Concat(b1, b2, b3, bp, dim=1)
+
+
+def red_b(x, name):
+    a = conv(x, 192, (1, 1), name=f"{name}_aa")
+    a = conv(a, 192, (3, 3), s=(2, 2), name=f"{name}_ab")
+    b = conv(x, 256, (1, 1), name=f"{name}_ba")
+    b = conv(b, 256, (1, 7), p=(0, 3), name=f"{name}_bb")
+    b = conv(b, 320, (7, 1), p=(3, 0), name=f"{name}_bc")
+    b = conv(b, 320, (3, 3), s=(2, 2), name=f"{name}_bd")
+    c = pool(x, (3, 3), (2, 2), "max")
+    return mx.sym.Concat(a, b, c, dim=1)
+
+
+def block_c(x, name):
+    b1 = conv(x, 256, (1, 1), name=f"{name}_1")
+    b2 = conv(x, 384, (1, 1), name=f"{name}_2")
+    b2a = conv(b2, 256, (1, 3), p=(0, 1), name=f"{name}_2a")
+    b2b = conv(b2, 256, (3, 1), p=(1, 0), name=f"{name}_2b")
+    b3 = conv(x, 384, (1, 1), name=f"{name}_3a")
+    b3 = conv(b3, 448, (3, 1), p=(1, 0), name=f"{name}_3b")
+    b3 = conv(b3, 512, (1, 3), p=(0, 1), name=f"{name}_3c")
+    b3a = conv(b3, 256, (1, 3), p=(0, 1), name=f"{name}_3d")
+    b3b = conv(b3, 256, (3, 1), p=(1, 0), name=f"{name}_3e")
+    bp = pool(x, (3, 3), (1, 1), "avg", (1, 1))
+    bp = conv(bp, 256, (1, 1), name=f"{name}_p")
+    return mx.sym.Concat(b1, b2a, b2b, b3a, b3b, bp, dim=1)
+
+
+def get_symbol(num_classes=1000, **kwargs):
+    x = mx.sym.Variable("data")
+    x = stem(x)
+    for i in range(4):
+        x = block_a(x, f"a{i}")
+    x = red_a(x, "ra")
+    for i in range(7):
+        x = block_b(x, f"b{i}")
+    x = red_b(x, "rb")
+    for i in range(3):
+        x = block_c(x, f"c{i}")
+    x = mx.sym.Pooling(x, global_pool=True, pool_type="avg", kernel=(1, 1))
+    x = mx.sym.Dropout(mx.sym.Flatten(x), p=0.2)
+    x = mx.sym.FullyConnected(x, num_hidden=num_classes, name="fc1")
+    return mx.sym.SoftmaxOutput(x, name="softmax")

@@ -1,0 +1,84 @@
+"""Multi-task training: one shared trunk, two softmax heads, joint loss.
+
+Parity: /root/reference/example/multi-task/example_multi_task.py (MNIST
+digit + parity heads via `mx.sym.Group`, a Module with two labels, and a
+per-head metric).  TPU-native: the grouped two-head graph compiles to ONE
+fused XLA program — both heads and both losses in a single step.
+"""
+import argparse
+import logging
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu.test_utils import get_mnist
+
+
+def build_symbol():
+    data = mx.sym.Variable("data")
+    x = mx.sym.Flatten(data)
+    x = mx.sym.FullyConnected(x, num_hidden=128, name="fc1")
+    x = mx.sym.Activation(x, act_type="relu")
+    x = mx.sym.FullyConnected(x, num_hidden=64, name="fc2")
+    x = mx.sym.Activation(x, act_type="relu")
+    digit = mx.sym.FullyConnected(x, num_hidden=10, name="fc_digit")
+    digit = mx.sym.SoftmaxOutput(digit, name="softmax_digit")
+    parity = mx.sym.FullyConnected(x, num_hidden=2, name="fc_parity")
+    parity = mx.sym.SoftmaxOutput(parity, mx.sym.Variable("parity_label"),
+                                  name="softmax_parity")
+    return mx.sym.Group([digit, parity])
+
+
+class MultiAccuracy(mx.metric.EvalMetric):
+    """Per-head accuracy (parity: the example's Multi_Accuracy)."""
+
+    def __init__(self, num=2):
+        self.num = num
+        super().__init__("multi-accuracy")
+        self.reset()
+
+    def reset(self):
+        self.num_inst = [0] * getattr(self, "num", 2)
+        self.sum_metric = [0.0] * getattr(self, "num", 2)
+
+    def update(self, labels, preds):
+        for i in range(self.num):
+            pred = np.argmax(preds[i].asnumpy(), axis=1)
+            lab = labels[i].asnumpy().astype(int)
+            self.sum_metric[i] += (pred == lab).sum()
+            self.num_inst[i] += len(lab)
+
+    def get(self):
+        accs = [s / max(1, n) for s, n in
+                zip(self.sum_metric, self.num_inst)]
+        return (["digit-acc", "parity-acc"], accs)
+
+
+def main():
+    ap = argparse.ArgumentParser(description="multi-task MNIST")
+    ap.add_argument("--num-epochs", type=int, default=5)
+    ap.add_argument("--batch-size", type=int, default=100)
+    ap.add_argument("--lr", type=float, default=0.01)
+    args = ap.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    mnist = get_mnist()
+    Xtr, ytr = mnist["train_data"], mnist["train_label"]
+    it = mx.io.NDArrayIter(
+        {"data": Xtr},
+        {"softmax_digit_label": ytr, "parity_label": ytr % 2},
+        batch_size=args.batch_size, shuffle=True)
+
+    mod = mx.mod.Module(build_symbol(), data_names=("data",),
+                        label_names=("softmax_digit_label", "parity_label"),
+                        context=mx.cpu())
+    metric = MultiAccuracy()
+    mod.fit(it, num_epoch=args.num_epochs, optimizer="sgd",
+            optimizer_params={"learning_rate": args.lr, "momentum": 0.9},
+            eval_metric=metric, initializer=mx.init.Xavier())
+    names, accs = metric.get()
+    print("final %s %.3f %s %.3f" % (names[0], accs[0], names[1], accs[1]))
+
+
+if __name__ == "__main__":
+    main()

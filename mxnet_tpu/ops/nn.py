@@ -189,14 +189,30 @@ def _pooling(p, x):
                       stride[i]) for i in range(n))
             parts.append(xp[idx])
         return jnp.max(jnp.stack(parts), axis=0)
+    denom = 1
+    for d in k:
+        denom *= d
+    if jnp.issubdtype(x.dtype, jnp.floating):
+        # sum/avg pooling as a grouped conv with a uniform kernel: lands
+        # on the MXU and differentiates cleanly — jax 0.9 cannot
+        # linearize reduce_window_sum under jit ('Linearization failed
+        # to produce known values'), so the reduce_window form would
+        # break any training graph containing windowed avg pooling
+        C = x.shape[1]
+        w = jnp.ones((C, 1) + k, x.dtype)
+        if p["pool_type"] != "sum":
+            # reference 'valid' convention divides by the full kernel
+            # size, padding included
+            w = w / denom
+        dn = lax.conv_dimension_numbers(x.shape, w.shape, _conv_dims(k))
+        return lax.conv_general_dilated(
+            x, w, window_strides=stride, padding=lo_hi,
+            dimension_numbers=dn, feature_group_count=C)
     summed = lax.reduce_window(x, jnp.asarray(0, x.dtype), lax.add,
                                window, strides, padding)
     if p["pool_type"] == "sum":
         return summed
     # avg: reference divides by full kernel size (padding included)
-    denom = 1
-    for d in k:
-        denom *= d
     return summed / denom
 
 

@@ -120,10 +120,20 @@ def _jitted(op_name: str, params: Tuple[Tuple[str, Any], ...]):
 def apply_op(op: Operator, params: Tuple[Tuple[str, Any], ...], inputs) -> Tuple:
     """Run the op on raw jax arrays; returns a tuple of all outputs (incl aux).
 
+    Under an outer jax trace (symbolic executor inside jit) the op fn is
+    inlined directly: a nested jit would be redundant for fusion and jax
+    0.9 cannot linearize some primitives through a nested pjit (e.g.
+    reduce_window_sum — avg-pool backward dies with 'Linearization
+    failed to produce known values').
+
     Works both eagerly and under an outer jax trace (the symbolic executor
     calls this inside jit — XLA then fuses across ops, which is the TPU
     replacement for reference op-bulking, src/executor/graph_executor.cc:1350).
     """
+    if any(isinstance(a, jax.core.Tracer) for a in inputs if a is not None):
+        pd = dict(params)
+        out = op.fn(pd, *inputs)
+        return out if isinstance(out, tuple) else (out,)
     return _jitted(op.name, params)(*inputs)
 
 

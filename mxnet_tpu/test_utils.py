@@ -412,13 +412,34 @@ def download(url, fname=None, dirname=None, overwrite=False):
     return _dl(url, fname or dirname, overwrite)
 
 
-def get_mnist():
+def get_mnist(num_train=600, num_test=100):
     """Synthetic MNIST-shaped dataset when real files are unavailable
-    (zero-egress environments)."""
+    (zero-egress environments).  LEARNABLE: each class is a fixed smooth
+    prototype image plus noise, so classifiers trained on it reach high
+    accuracy and demos (adversarial examples, multi-task, fine-tuning)
+    behave like they do on the real data."""
     rs = _np.random.RandomState(42)
-    train_x = rs.rand(600, 1, 28, 28).astype(_np.float32)
-    train_y = rs.randint(0, 10, 600).astype(_np.float32)
-    test_x = rs.rand(100, 1, 28, 28).astype(_np.float32)
-    test_y = rs.randint(0, 10, 100).astype(_np.float32)
+    # smooth per-class prototypes (low-freq random fields, blurred)
+    protos = rs.rand(10, 1, 32, 32).astype(_np.float32)
+    k = _np.ones(5, _np.float32) / 5.0  # separable box blur
+    blurred = []
+    for p in protos:
+        img = p[0]
+        for _ in range(2):
+            img = _np.stack([
+                _np.convolve(row, k, mode="same") for row in img])
+            img = _np.stack([
+                _np.convolve(col, k, mode="same") for col in img.T]).T
+        blurred.append(img[2:30, 2:30])
+    protos = _np.stack(blurred)[:, None]          # (10,1,28,28)
+    protos = (protos - protos.min()) / (_np.ptp(protos) + 1e-9)
+
+    def make(n):
+        y = rs.randint(0, 10, n)
+        x = protos[y] + rs.normal(0, 0.25, (n, 1, 28, 28))
+        return x.clip(0, 1).astype(_np.float32), y.astype(_np.float32)
+
+    train_x, train_y = make(num_train)
+    test_x, test_y = make(num_test)
     return {"train_data": train_x, "train_label": train_y,
             "test_data": test_x, "test_label": test_y}

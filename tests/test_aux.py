@@ -224,3 +224,85 @@ def test_model_store_local_resolution(tmp_path, monkeypatch):
 
     model_store.purge(root)
     assert not [f for f in os.listdir(root) if f.endswith(".params")]
+
+
+def test_fault_injection_checkpoint_resume(tmp_path):
+    """Failure-recovery drill (SURVEY §5: elastic/fault tolerance): a
+    training process is SIGKILLed mid-run; a fresh process resumes from
+    the last epoch checkpoint and the loss continues from where it was —
+    weights, optimizer momentum, and epoch counter all restored.
+    (Parity: the reference's checkpoint-restart story, common/fit.py
+    --load-epoch; it had no fault-injection CI either — this goes beyond.)
+    """
+    import os
+    import signal
+    import subprocess
+    import sys
+    import time
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    prefix = str(tmp_path / "ck")
+    script = tmp_path / "train.py"
+    script.write_text(f"""
+import os, sys, time
+import numpy as np
+import mxnet_tpu as mx
+
+prefix = {prefix!r}
+resume = int(sys.argv[1]) if len(sys.argv) > 1 else 0
+rs = np.random.RandomState(0)
+X = rs.randn(256, 10).astype("f")
+w_true = rs.randn(10, 1).astype("f")
+y = (X @ w_true).ravel()
+
+data = mx.sym.Variable("data")
+net = mx.sym.FullyConnected(data, num_hidden=8, name="fc1")
+net = mx.sym.Activation(net, act_type="relu")
+net = mx.sym.FullyConnected(net, num_hidden=1, name="fc2")
+net = mx.sym.LinearRegressionOutput(net, name="lro")
+
+it = mx.io.NDArrayIter(X, y, batch_size=32, label_name="lro_label")
+mod = mx.mod.Module(net, label_names=("lro_label",), context=mx.cpu())
+
+kw = {{}}
+if resume:
+    sym_, arg, aux = mx.model.load_checkpoint(prefix, resume)
+    kw = dict(arg_params=arg, aux_params=aux, begin_epoch=resume)
+
+losses = []
+class M(mx.metric.EvalMetric):
+    def __init__(self): super().__init__("mse")
+    def update(self, labels, preds):
+        e = ((preds[0].asnumpy().ravel() - labels[0].asnumpy().ravel())**2).mean()
+        losses.append(float(e)); self.sum_metric += e; self.num_inst += 1
+
+def at_epoch_end(epoch, s, a, x):
+    mx.model.save_checkpoint(prefix, epoch + 1, net, a, x)
+    print("EPOCH_DONE", epoch, np.mean(losses[-8:]), flush=True)
+    if not resume and epoch == 2:
+        os.kill(os.getpid(), 9)  # simulated hard failure mid-training
+
+mod.fit(it, num_epoch=6, optimizer="sgd",
+        optimizer_params={{"learning_rate": 0.05, "momentum": 0.9}},
+        eval_metric=M(), epoch_end_callback=at_epoch_end, **kw)
+print("FINAL", np.mean(losses[-8:]), flush=True)
+""")
+    env = {**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONPATH": repo}
+    r1 = subprocess.run([sys.executable, str(script)], env=env,
+                        capture_output=True, text=True, timeout=300)
+    assert r1.returncode == -signal.SIGKILL, (r1.returncode, r1.stderr)
+    done = [l for l in r1.stdout.splitlines() if l.startswith("EPOCH_DONE")]
+    assert len(done) == 3, r1.stdout  # epochs 0,1,2 then killed
+    loss_at_kill = float(done[-1].split()[2])
+
+    # resume from the surviving checkpoint
+    r2 = subprocess.run([sys.executable, str(script), "3"], env=env,
+                        capture_output=True, text=True, timeout=300)
+    assert r2.returncode == 0, r2.stdout + r2.stderr
+    final = float([l for l in r2.stdout.splitlines()
+                   if l.startswith("FINAL")][0].split()[1])
+    # training continued downward from the pre-failure loss, not from scratch
+    assert final < loss_at_kill, (final, loss_at_kill)
+    first_resumed = float([l for l in r2.stdout.splitlines()
+                           if l.startswith("EPOCH_DONE")][0].split()[2])
+    assert first_resumed < loss_at_kill * 1.5, (first_resumed, loss_at_kill)

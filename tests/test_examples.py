@@ -323,7 +323,9 @@ def test_profiler_example(tmp_path):
 
 SYMBOL_NETS = [("alexnet", {}), ("vgg", {"num_layers": 11}),
                ("googlenet", {}), ("inception-bn", {}),
-               ("inception-v3", {}), ("resnext", {"num_layers": 50}),
+               ("inception-v3", {}), ("inception-v4", {}),
+               ("inception-resnet-v2", {}),
+               ("resnext", {"num_layers": 50}),
                ("mobilenet", {}), ("resnet", {"num_layers": 18}),
                ("lenet", {}), ("mlp", {})]
 
@@ -334,7 +336,9 @@ def test_image_classification_symbols_build(net, kw):
     """Every symbols/<net>.py builds and shape-infers end to end (parity:
     the reference's --network flag surface, symbols/*.py)."""
     import importlib
-    sys.path.insert(0, os.path.join(REPO, "example", "image-classification"))
+    ic_path = os.path.join(REPO, "example", "image-classification")
+    if ic_path not in sys.path:
+        sys.path.insert(0, ic_path)
     mod = importlib.import_module(f"symbols.{net}")
     size = 299 if net == "inception-v3" else 224
     if net in ("lenet", "mlp"):
@@ -362,3 +366,30 @@ def test_tree_lstm_example():
     line = [l for l in out.splitlines() if "final acc" in l][0]
     # seeded run reaches 0.60 by epoch 2; above-chance composition
     assert float(line.rsplit(" ", 1)[-1]) > 0.52, out
+
+
+def test_autoencoder_example():
+    out = run_example("example/autoencoder/autoencoder.py",
+                      "--num-epochs", "4", "--num-examples", "500")
+    line = [l for l in out.splitlines() if "final recon mse" in l][0]
+    assert float(line.rsplit(" ", 1)[-1]) < 0.05, out
+
+
+def test_fgsm_adversary_example():
+    out = run_example("example/adversary/fgsm.py",
+                      "--epochs", "8", "--num-test", "100")
+    line = [l for l in out.splitlines() if "clean accuracy" in l][0]
+    clean = float(line.split()[2])
+    adv = float(line.split()[5])
+    # trained net learns the synthetic digits; FGSM must hurt it
+    assert clean > 0.8, out
+    assert adv < clean - 0.3, out
+
+
+def test_multi_task_example():
+    out = run_example("example/multi-task/multi_task.py",
+                      "--num-epochs", "8")
+    line = [l for l in out.splitlines() if "final digit-acc" in l][0]
+    digit = float(line.split()[2])
+    parity = float(line.split()[4])
+    assert digit > 0.6 and parity > 0.6, out

@@ -607,3 +607,32 @@ def test_ctc_loss_lengths_symbol_eager_parity():
                       label_lengths=nd.array([4.0, 4.0]),
                       use_label_lengths=True, blank_label="last").asnumpy()
     assert abs(full[1] - eager[1]) > 1e-3
+
+
+def test_pooling_avg_backward_under_jit():
+    """Windowed avg/sum pooling must differentiate inside the compiled
+    executor (regression: jax 0.9 can't linearize reduce_window_sum under
+    jit; pooling lowers to a grouped conv instead).  Non-overlapping
+    windows give an exact analytic grad: 1/kernel_volume everywhere."""
+    import mxnet_tpu as mx
+    s = mx.sym.Pooling(mx.sym.Variable("data"), kernel=(2, 2),
+                       stride=(2, 2), pool_type="avg")
+    ex = s.simple_bind(mx.cpu(), grad_req="write", data=(1, 2, 4, 4))
+    x = np.arange(32, dtype="f").reshape(1, 2, 4, 4)
+    ex.arg_dict["data"][:] = x
+    out = ex.forward(is_train=True)[0].asnumpy()
+    ref = x.reshape(1, 2, 2, 2, 2, 2).mean(axis=(3, 5))
+    assert_almost_equal(out, ref, rtol=1e-6)
+    ex.backward()
+    assert_almost_equal(ex.grad_dict["data"].asnumpy(),
+                        np.full_like(x, 0.25), rtol=1e-6)
+    # sum pooling too
+    s2 = mx.sym.Pooling(mx.sym.Variable("data"), kernel=(2, 2),
+                        stride=(2, 2), pool_type="sum")
+    ex2 = s2.simple_bind(mx.cpu(), grad_req="write", data=(1, 2, 4, 4))
+    ex2.arg_dict["data"][:] = x
+    out2 = ex2.forward(is_train=True)[0].asnumpy()
+    assert_almost_equal(out2, ref * 4, rtol=1e-6)
+    ex2.backward()
+    assert_almost_equal(ex2.grad_dict["data"].asnumpy(),
+                        np.ones_like(x), rtol=1e-6)
