@@ -1,0 +1,112 @@
+"""Transformer language-model family (gluon).
+
+Beyond-reference capability (SURVEY.md §2.3 long-context rows): the
+reference (2017-era MXNet) predates transformers; this family is the
+TPU-native flagship for the long-context story.  Design:
+
+  - the whole decoder stack is one HybridBlock → a single jitted
+    CachedOp forward + fused vjp (no per-layer dispatch),
+  - attention can run as `dense` (materialized scores — XLA fuses the
+    softmax chain) or `flash` (the Pallas `_contrib_flash_attention`
+    kernel: O(T) memory online-softmax tiling on the MXU),
+  - for sequence lengths beyond one chip, `mxnet_tpu.parallel`'s
+    ring_attention / ulysses_attention shard the same math over the
+    'sp' mesh axis (see parallel/sequence_parallel.py).
+
+Pre-LN GPT-style decoder: x + MHSA(LN(x)); x + FFN(LN(x)).
+"""
+from __future__ import annotations
+
+
+
+from .. import nn
+from ..block import HybridBlock
+
+
+class MultiHeadSelfAttention(HybridBlock):
+    """Causal multi-head self-attention over (B, T, D) activations.
+
+    attn_type: 'dense' | 'flash' (Pallas kernel, TPU hot path).
+    """
+
+    def __init__(self, dim, num_heads, attn_type="dense", dropout=0.0,
+                 **kw):
+        super().__init__(**kw)
+        assert dim % num_heads == 0
+        self._h = num_heads
+        self._dh = dim // num_heads
+        self._type = attn_type
+        with self.name_scope():
+            self.qkv = nn.Dense(3 * dim, use_bias=True, flatten=False,
+                                prefix="qkv_")
+            self.proj = nn.Dense(dim, use_bias=True, flatten=False,
+                                 prefix="proj_")
+            self.drop = nn.Dropout(dropout) if dropout else None
+
+    def hybrid_forward(self, F, x):
+        # the shape-dependent head split / mask / merge lives inside the
+        # fused `_contrib_multihead_attention` op (ops always see
+        # concrete shapes) — so this block hybridizes to a symbol graph
+        qkv = self.qkv(x)                                   # (B,T,3D)
+        out = F.multihead_attention(qkv, num_heads=self._h, causal=True,
+                                    impl="flash" if self._type == "flash"
+                                    else "dense")
+        out = self.proj(out)
+        return self.drop(out) if self.drop is not None else out
+
+
+class TransformerBlock(HybridBlock):
+    def __init__(self, dim, num_heads, ffn_dim, attn_type="dense",
+                 dropout=0.0, **kw):
+        super().__init__(**kw)
+        with self.name_scope():
+            self.ln1 = nn.LayerNorm(prefix="ln1_")
+            self.attn = MultiHeadSelfAttention(dim, num_heads, attn_type,
+                                               dropout, prefix="attn_")
+            self.ln2 = nn.LayerNorm(prefix="ln2_")
+            self.ffn1 = nn.Dense(ffn_dim, activation="relu", flatten=False,
+                                 prefix="ffn1_")
+            self.ffn2 = nn.Dense(dim, flatten=False, prefix="ffn2_")
+            self.drop = nn.Dropout(dropout) if dropout else None
+
+    def hybrid_forward(self, F, x):
+        x = x + self.attn(self.ln1(x))
+        h = self.ffn2(self.ffn1(self.ln2(x)))
+        if self.drop is not None:
+            h = self.drop(h)
+        return x + h
+
+
+class TransformerLM(HybridBlock):
+    """GPT-style causal LM: token ids (B, T) → logits (B, T, vocab)."""
+
+    def __init__(self, vocab, dim=128, num_layers=2, num_heads=4,
+                 ffn_dim=None, max_len=512, attn_type="dense",
+                 dropout=0.0, **kw):
+        super().__init__(**kw)
+        self._max_len = max_len
+        with self.name_scope():
+            self.tok = nn.Embedding(vocab, dim, prefix="tok_")
+            self.pos = nn.Embedding(max_len, dim, prefix="pos_")
+            self.blocks = nn.HybridSequential(prefix="blocks_")
+            for i in range(num_layers):
+                self.blocks.add(TransformerBlock(
+                    dim, num_heads, ffn_dim or 4 * dim, attn_type,
+                    dropout, prefix=f"l{i}_"))
+            self.ln_f = nn.LayerNorm(prefix="lnf_")
+            self.head = nn.Dense(vocab, flatten=False, prefix="head_")
+
+    def hybrid_forward(self, F, tokens):
+        if hasattr(tokens, "shape") and tokens.shape[1] > self._max_len:
+            raise ValueError(
+                f"sequence length {tokens.shape[1]} exceeds max_len "
+                f"{self._max_len} — positions would silently clamp")
+        pos_ids = F.broadcast_like(
+            F.expand_dims(F.arange_like(tokens, axis=1), 0), tokens)
+        x = self.tok(tokens) + self.pos(pos_ids)
+        x = self.blocks(x)
+        return self.head(self.ln_f(x))
+
+
+def transformer_lm(vocab, **kwargs):
+    return TransformerLM(vocab, **kwargs)

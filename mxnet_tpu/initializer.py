@@ -11,6 +11,8 @@ from typing import Optional
 
 import numpy as _np
 
+from .random import host_rng as _host_rng
+
 from .base import MXNetError, Registry
 
 _REG = Registry("initializer")
@@ -124,7 +126,7 @@ class Uniform(Initializer):
         self.scale = scale
 
     def _init_weight(self, desc, arr):
-        arr[:] = _np.random.uniform(-self.scale, self.scale, arr.shape)
+        arr[:] = _host_rng.uniform(-self.scale, self.scale, arr.shape)
 
 
 @register
@@ -134,7 +136,7 @@ class Normal(Initializer):
         self.sigma = sigma
 
     def _init_weight(self, desc, arr):
-        arr[:] = _np.random.normal(0.0, self.sigma, arr.shape)
+        arr[:] = _host_rng.normal(0.0, self.sigma, arr.shape)
 
 
 @register
@@ -148,9 +150,9 @@ class Orthogonal(Initializer):
         nout = arr.shape[0]
         nin = int(_np.prod(arr.shape[1:]))
         if self.rand_type == "uniform":
-            tmp = _np.random.uniform(-1.0, 1.0, (nout, nin))
+            tmp = _host_rng.uniform(-1.0, 1.0, (nout, nin))
         else:
-            tmp = _np.random.normal(0.0, 1.0, (nout, nin))
+            tmp = _host_rng.normal(0.0, 1.0, (nout, nin))
         u, _, v = _np.linalg.svd(tmp, full_matrices=False)
         q = u if u.shape == tmp.shape else v
         arr[:] = (self.scale * q).reshape(arr.shape)
@@ -177,9 +179,9 @@ class Xavier(Initializer):
                   "out": fan_out}[self.factor_type]
         scale = _np.sqrt(self.magnitude / factor)
         if self.rnd_type == "uniform":
-            arr[:] = _np.random.uniform(-scale, scale, shape)
+            arr[:] = _host_rng.uniform(-scale, scale, shape)
         else:
-            arr[:] = _np.random.normal(0, scale, shape)
+            arr[:] = _host_rng.normal(0, scale, shape)
 
 
 @register

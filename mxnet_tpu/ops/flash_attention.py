@@ -187,3 +187,49 @@ def _flash_attention_op(p, q, k, v):
     blk_k = min(p["block_k"], k.shape[2])
     return _flash_attention(q, k, v, float(scale), bool(p["causal"]),
                             int(blk_q), int(blk_k))
+
+
+@register("_contrib_multihead_attention", input_names=("qkv",),
+          aliases=("multihead_attention",),
+          args=[Arg("num_heads", int, required=True),
+                Arg("causal", bool, True), Arg("impl", str, "dense"),
+                Arg("scale", float, -1.0)])
+def _multihead_attention_op(p, qkv):
+    """Fused causal multi-head self-attention over packed projections.
+
+    qkv: (B, T, 3*D) — the output of one Dense QKV projection; returns
+    (B, T, D).  Registered as an op (not python in the gluon block) so the
+    shape-dependent reshapes/masks live where shapes are always concrete —
+    usable from symbol graphs and hybridized blocks.  impl='flash' routes
+    to the Pallas kernel; 'dense' materializes scores (XLA fuses the
+    softmax chain).
+    """
+    B, T, D3 = qkv.shape
+    H = p["num_heads"]
+    D = D3 // 3
+    dh = D // H
+    x = qkv.reshape(B, T, 3, H, dh).transpose(2, 0, 3, 1, 4)  # (3,B,H,T,dh)
+    q, k, v = x[0], x[1], x[2]
+    scale = p["scale"] if p["scale"] > 0 else dh ** -0.5
+    if p["impl"] == "flash":
+        out = _flash_attention(q, k, v, float(scale), bool(p["causal"]),
+                               min(128, T), min(128, T))
+    else:
+        out = _dense_reference(q, k, v, float(scale), bool(p["causal"]))
+    return out.transpose(0, 2, 1, 3).reshape(B, T, D)
+
+
+@register("_contrib_arange_like", input_names=("data",),
+          aliases=("arange_like",), differentiable=False,
+          args=[Arg("axis", int, None), Arg("start", float, 0.0),
+                Arg("step", float, 1.0)])
+def _arange_like(p, x):
+    """Parity: _contrib_arange_like — a [start, start+step, ...] ramp
+    shaped like `data` along `axis` (or flat over all elements)."""
+    if p["axis"] is None:
+        n = 1
+        for d in x.shape:
+            n *= d
+        return (p["start"] + p["step"] * jnp.arange(n)).reshape(x.shape)
+    n = x.shape[p["axis"]]
+    return p["start"] + p["step"] * jnp.arange(n, dtype=jnp.float32)

@@ -643,6 +643,37 @@ class Test(Optimizer):
 ccSGD = SGD  # deprecated alias kept by the reference
 
 
+def _conform_state_sharding(state, weight):
+    """Place freshly-created optimizer state on the weight's sharding.
+
+    Under a multi-device Module the weights are mesh-replicated
+    (NamedSharding); states created by nd.zeros land on one device and
+    would make the fused update's jit see mixed placements.  Same-shape
+    leaves (momentum, fp32 masters) take the weight's own sharding;
+    other array leaves replicate over the weight's mesh."""
+    wdata = weight._data if isinstance(weight, NDArray) else weight
+    sharding = getattr(wdata, "sharding", None)
+    if sharding is None or not hasattr(sharding, "mesh") or \
+            len(getattr(wdata, "devices", lambda: [0])()) <= 1:
+        return state
+
+    from jax.sharding import NamedSharding, PartitionSpec
+    repl = NamedSharding(sharding.mesh, PartitionSpec())
+
+    def place(s):
+        if s is None:
+            return None
+        if isinstance(s, NDArray):
+            tgt = sharding if s.shape == wdata.shape else repl
+            s._set_data(jax.device_put(s._data, tgt))
+            return s
+        if isinstance(s, (tuple, list)):
+            return type(s)(place(x) for x in s)
+        return s
+
+    return place(state)
+
+
 class Updater:
     """Applies an optimizer with per-index states (parity: optimizer.get_updater)."""
 
@@ -653,8 +684,8 @@ class Updater:
 
     def _ensure_state(self, index, weight):
         if index not in self.states:
-            self.states[index] = self.optimizer.create_state_multi_precision(
-                index, weight)
+            state = self.optimizer.create_state_multi_precision(index, weight)
+            self.states[index] = _conform_state_sharding(state, weight)
             self.states_synced[index] = True
         elif not self.states_synced[index]:
             self.states[index] = self.sync_state_context(self.states[index],

@@ -21,9 +21,18 @@ def _get():
     return _state.key
 
 
+# host-side RandomState for initializers (the reference's initializers
+# draw from the engine RNG that mx.random.seed controls; ours draw host-
+# side, so the framework owns its own stream — never numpy's global one)
+import numpy as _np
+host_rng = _np.random.RandomState(0)
+
+
 def seed(seed_state: int) -> None:
-    """Seed the framework RNG (parity: mx.random.seed / MXRandomSeed)."""
+    """Seed the framework RNG (parity: mx.random.seed / MXRandomSeed) —
+    both the jax key stream and the host RNG that initializers use."""
     _state.key = jax.random.PRNGKey(int(seed_state))
+    host_rng.seed(int(seed_state) % (2 ** 32))
 
 
 def next_key():

@@ -393,3 +393,13 @@ def test_multi_task_example():
     digit = float(line.split()[2])
     parity = float(line.split()[4])
     assert digit > 0.6 and parity > 0.6, out
+
+
+def test_transformer_lm_example():
+    out = run_example("example/gluon/transformer_lm.py",
+                      "--epochs", "2", "--corpus-len", "4000",
+                      "--max-batches", "25")
+    line = [l for l in out.splitlines() if "final ppl" in l][0]
+    ppl = float(line.split()[2])
+    # must beat the uniform baseline (vocab=32) after 2 epochs
+    assert ppl < 30.0, out
