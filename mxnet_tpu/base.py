@@ -217,7 +217,9 @@ class ParamSchema:
                 v = eval(v, {"__builtins__": {}})  # "(2, 2)" from string configs
             if isinstance(v, (int, _np.integer)):
                 return (int(v),)
-            return tuple(int(x) for x in v)
+            # None entries stay None (open-ended slice bounds, e.g.
+            # _slice_assign begin=(None, 1))
+            return tuple(None if x is None else int(x) for x in v)
         if ty == "floats":  # float tuple (anchor sizes/ratios, variances)
             if isinstance(v, str):
                 v = eval(v, {"__builtins__": {}})

@@ -59,7 +59,8 @@ class Initializer:
             self._init_gamma(desc, arr)
         elif name.endswith("beta"):
             self._init_beta(desc, arr)
-        elif "moving_mean" in name or "running_mean" in name:
+        elif "moving_mean" in name or "running_mean" in name \
+                or "moving_avg" in name:
             self._init_zero(desc, arr)
         elif "moving_var" in name or "running_var" in name:
             self._init_one(desc, arr)

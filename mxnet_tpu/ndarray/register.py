@@ -89,6 +89,10 @@ def invoke(op_name: str, ndarray_inputs, kwargs, out=None):
     # write updated aux values back into their NDArrays (BatchNorm moving
     # stats, optimizer states) — parity: mutable aux_states/engine write vars
     for i, aux_idx in enumerate(op.aux_inputs):
+        # aux omitted in an eager call (op fn defaulted it) — nothing to
+        # write back into
+        if aux_idx >= len(ndarray_inputs):
+            continue
         tgt = ndarray_inputs[aux_idx]
         if isinstance(tgt, NDArray):
             tgt._set_data(outs[n_vis + i])

@@ -15,6 +15,7 @@ from . import init_ops
 from . import random_ops
 from . import optimizer_ops
 from . import sequence
+from . import compat
 from . import vision
 from . import contrib
 from . import flash_attention

@@ -1,0 +1,170 @@
+"""Compatibility closure over the reference's remaining op names.
+
+Audited against every NNVM_REGISTER_OP / MXNET_REGISTER_OP_PROPERTY in
+/root/reference/src/operator (round-2 op-gap sweep).  Three tiers:
+
+1. alias-to-equivalent: `_`-prefixed elementwise tensor-tensor ops are the
+   reference's operator-sugar kernels for same-shape operands; the
+   broadcast_* registrations are behavior-compatible supersets, so these
+   are pure aliases.  Likewise `_linalg_*` → `linalg_*` (the reference
+   registers both spellings, src/operator/tensor/la_op.cc:73).
+2. implemented here: reshape_like, _slice_assign(_scalar) (setitem
+   kernels, matrix_op.cc:313), _identity_with_attr_like_rhs (graph-pass
+   helper), _linalg_gelqf / _linalg_syevd (la_op.cc LQ and
+   symmetric-eig factorizations), IdentityAttachKLSparseReg
+   (identity_attach_KL_sparse_reg.cc — KL sparsity penalty on
+   activations, with the reference's moving-average aux state).
+3. intentionally absent (no TPU meaning, documented in PARITY.md):
+   _CrossDeviceCopy (engine-internal), _NDArray/_Native (old C plugin
+   bridge — the torch bridge is the supported path), _broadcast_backward
+   (grad-pass internal), CuDNNBatchNorm (aliased to BatchNorm).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..base import Arg
+from .registry import OP_ALIASES, register
+
+# -- tier 1: aliases --------------------------------------------------------
+_ALIAS_MAP = {
+    "_equal": "broadcast_equal",
+    "_not_equal": "broadcast_not_equal",
+    "_greater": "broadcast_greater",
+    "_greater_equal": "broadcast_greater_equal",
+    "_lesser": "broadcast_lesser",
+    "_lesser_equal": "broadcast_lesser_equal",
+    "_maximum": "broadcast_maximum",
+    "_minimum": "broadcast_minimum",
+    "_mod": "broadcast_mod",
+    "_power": "broadcast_power",
+    "_hypot": "broadcast_hypot",
+    "_grad_add": "elemwise_add",
+    "_linalg_gemm": "linalg_gemm",
+    "_linalg_gemm2": "linalg_gemm2",
+    "_linalg_potrf": "linalg_potrf",
+    "_linalg_potri": "linalg_potri",
+    "_linalg_trmm": "linalg_trmm",
+    "_linalg_trsm": "linalg_trsm",
+    "_linalg_sumlogdiag": "linalg_sumlogdiag",
+    "_linalg_syrk": "linalg_syrk",
+    "_sparse_retain": "sparse_retain",
+    "_contrib_CTCLoss": "_contrib_ctc_loss",
+    "_contrib_SparseEmbedding": "Embedding",
+    "CuDNNBatchNorm": "BatchNorm",
+    # dense forms of the row-sparse-preserving scatter kernels
+    # (elemwise_binary_scalar_op.cc _scatter_* — storage preservation is
+    # an NDArray-level concern here)
+    "_scatter_plus_scalar": "_plus_scalar",
+    "_scatter_minus_scalar": "_minus_scalar",
+    "_scatter_elemwise_div": "elemwise_div",
+}
+for _alias, _target in _ALIAS_MAP.items():
+    OP_ALIASES.setdefault(_alias, OP_ALIASES.get(_target, _target))
+
+
+# -- tier 2: implementations ------------------------------------------------
+@register("reshape_like", input_names=("lhs", "rhs"))
+def _reshape_like(p, lhs, rhs):
+    """Parity: matrix_op.cc reshape_like — lhs reshaped to rhs's shape
+    (gradient flows to lhs only)."""
+    return lhs.reshape(rhs.shape)
+
+
+@register("_identity_with_attr_like_rhs", input_names=("lhs", "rhs"))
+def _identity_with_attr_like_rhs(p, lhs, rhs):
+    """Parity: elemwise_unary_op_basic.cc — identity on lhs; rhs only
+    donates graph attrs (storage type there, sharding here; its grad is
+    dense zeros via zero_like_grad)."""
+    return lhs
+
+
+def _slice_tuple(p, shape):
+    begin = p["begin"]
+    end = p["end"]
+    step = p.get("step") or ()
+    out = []
+    for i in range(len(shape)):
+        b = begin[i] if i < len(begin) and begin[i] is not None else None
+        e = end[i] if i < len(end) and end[i] is not None else None
+        s = step[i] if i < len(step) and step[i] is not None else None
+        out.append(slice(b, e, s))
+    return tuple(out)
+
+
+@register("_slice_assign", input_names=("lhs", "rhs"),
+          aliases=("_crop_assign",),
+          args=[Arg("begin", "shape", required=True),
+                Arg("end", "shape", required=True),
+                Arg("step", "shape", ())])
+def _slice_assign(p, lhs, rhs):
+    """Parity: matrix_op.cc:313 — functional setitem: lhs with the cropped
+    region replaced by rhs."""
+    return lhs.at[_slice_tuple(p, lhs.shape)].set(rhs.astype(lhs.dtype))
+
+
+@register("_slice_assign_scalar", input_names=("lhs",),
+          aliases=("_crop_assign_scalar",),
+          args=[Arg("scalar", float, 0.0),
+                Arg("begin", "shape", required=True),
+                Arg("end", "shape", required=True),
+                Arg("step", "shape", ())])
+def _slice_assign_scalar(p, lhs):
+    return lhs.at[_slice_tuple(p, lhs.shape)].set(
+        jnp.asarray(p["scalar"], lhs.dtype))
+
+
+@register("_linalg_gelqf", input_names=("A",), aliases=("linalg_gelqf",),
+          num_outputs=2)
+def _linalg_gelqf(p, a):
+    """Parity: la_op.cc gelqf — LQ factorization A = L @ Q with Q's rows
+    orthonormal.  Via QR of Aᵀ: Aᵀ = Q₁R₁ → A = R₁ᵀ Q₁ᵀ."""
+    q1, r1 = jnp.linalg.qr(jnp.swapaxes(a, -1, -2))
+    return jnp.swapaxes(r1, -1, -2), jnp.swapaxes(q1, -1, -2)
+
+
+@register("_linalg_syevd", input_names=("A",), aliases=("linalg_syevd",),
+          num_outputs=2)
+def _linalg_syevd(p, a):
+    """Parity: la_op.cc syevd — symmetric eigendecomposition
+    A = Uᵀ diag(L) U (U rows are eigenvectors)."""
+    lam, u = jnp.linalg.eigh(a)
+    return jnp.swapaxes(u, -1, -2), lam
+
+
+@register("IdentityAttachKLSparseReg", input_names=("data", "moving_avg"),
+          aux_inputs=[1],
+          args=[Arg("sparseness_target", float, 0.1),
+                Arg("penalty", float, 0.001),
+                Arg("momentum", float, 0.9)])
+def _identity_attach_kl_sparse_reg(p, x, moving_avg=None):
+    """Parity: identity_attach_KL_sparse_reg-inl.h — identity forward
+    whose backward adds the KL-divergence sparsity-penalty gradient,
+    penalty · (-ρ/ρ̂ + (1-ρ)/(1-ρ̂)) per element, where ρ̂ is the
+    momentum moving average of the per-unit batch-mean activation
+    (the reference's moving_avg aux state, :103-111)."""
+    rho = p["sparseness_target"]
+    pen = p["penalty"]
+    mom = p["momentum"]
+    batch_mean = jnp.mean(x, axis=0)
+    if moving_avg is None:
+        new_avg = batch_mean
+    else:
+        new_avg = mom * moving_avg + (1 - mom) * batch_mean
+
+    @jax.custom_vjp
+    def ident(v, avg):
+        return v
+
+    def fwd(v, avg):
+        return v, avg
+
+    def bwd(avg, g):
+        rho_hat = jnp.clip(avg, 1e-6, 1 - 1e-6)[None, :]
+        extra = pen * (-rho / rho_hat + (1 - rho) / (1 - rho_hat))
+        return (g + jnp.broadcast_to(extra, g.shape).astype(g.dtype),
+                jnp.zeros_like(avg))
+
+    ident.defvjp(fwd, bwd)
+    return ident(x, new_avg), new_avg

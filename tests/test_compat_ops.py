@@ -1,0 +1,116 @@
+"""Op-gap closure tier (ops/compat.py): aliases resolve, setitem kernels,
+LQ/symmetric-eig factorizations, KL sparsity regularizer gradient."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, nd
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_underscore_binary_aliases():
+    a = nd.array(np.array([[1.0, 5.0], [3.0, 2.0]], "f"))
+    b = nd.array(np.array([[2.0, 4.0], [3.0, 1.0]], "f"))
+    assert_almost_equal(getattr(nd, "_maximum")(a, b).asnumpy(),
+                        np.maximum(a.asnumpy(), b.asnumpy()))
+    assert_almost_equal(getattr(nd, "_equal")(a, b).asnumpy(),
+                        (a.asnumpy() == b.asnumpy()).astype("f"))
+    assert_almost_equal(getattr(nd, "_power")(a, b).asnumpy(),
+                        a.asnumpy() ** b.asnumpy(), rtol=1e-5)
+    assert_almost_equal(getattr(nd, "_mod")(a, b).asnumpy(),
+                        np.mod(a.asnumpy(), b.asnumpy()))
+    # symbol space resolves the aliases too
+    s = getattr(mx.sym, "_linalg_gemm2")(mx.sym.Variable("a"),
+                                         mx.sym.Variable("b"))
+    assert s.list_arguments() == ["a", "b"]
+
+
+def test_reshape_like_and_grad():
+    a = nd.array(np.arange(6.0, dtype="f").reshape(2, 3))
+    b = nd.zeros((3, 2))
+    a.attach_grad()
+    with autograd.record():
+        out = nd.reshape_like(a, b)
+        loss = (out * out).sum()
+    loss.backward()
+    assert out.shape == (3, 2)
+    assert_almost_equal(a.grad.asnumpy(), 2 * a.asnumpy())
+
+
+def test_slice_assign():
+    x = nd.array(np.arange(16.0, dtype="f").reshape(4, 4))
+    y = getattr(nd, "_slice_assign")(x, nd.zeros((2, 2)),
+                                     begin=(1, 1), end=(3, 3))
+    ref = x.asnumpy().copy()
+    ref[1:3, 1:3] = 0
+    assert_almost_equal(y.asnumpy(), ref)
+    z = getattr(nd, "_slice_assign_scalar")(x, scalar=7.0,
+                                            begin=(0, 2), end=(2, 4))
+    ref = x.asnumpy().copy()
+    ref[0:2, 2:4] = 7
+    assert_almost_equal(z.asnumpy(), ref)
+
+
+def test_linalg_gelqf():
+    rs = np.random.RandomState(0)
+    A = rs.randn(3, 5).astype("f")
+    L, Q = nd.linalg_gelqf(nd.array(A))
+    assert_almost_equal(nd.dot(L, Q).asnumpy(), A, rtol=1e-4, atol=1e-5)
+    # Q rows orthonormal
+    assert_almost_equal((Q.asnumpy() @ Q.asnumpy().T), np.eye(3, dtype="f"),
+                        rtol=1e-4, atol=1e-5)
+    # L lower-triangular
+    assert np.allclose(np.triu(L.asnumpy(), 1), 0, atol=1e-5)
+
+
+def test_linalg_syevd():
+    rs = np.random.RandomState(1)
+    S = rs.randn(4, 4).astype("f")
+    S = (S + S.T) / 2
+    U, lam = nd.linalg_syevd(nd.array(S))
+    rec = U.asnumpy().T @ np.diag(lam.asnumpy()) @ U.asnumpy()
+    assert_almost_equal(rec, S, rtol=1e-4, atol=1e-5)
+
+
+def test_identity_attach_kl_sparse_reg():
+    rs = np.random.RandomState(2)
+    h = nd.array(rs.rand(8, 5).astype("f"))
+    h.attach_grad()
+    with autograd.record():
+        out = nd.IdentityAttachKLSparseReg(h, sparseness_target=0.2,
+                                           penalty=0.01)
+        loss = out.sum()
+    loss.backward()
+    assert_almost_equal(out.asnumpy(), h.asnumpy())  # identity forward
+    rho_hat = h.asnumpy().mean(0)
+    expect = 1.0 + 0.01 * (-0.2 / rho_hat + 0.8 / (1 - rho_hat))
+    assert_almost_equal(h.grad.asnumpy(),
+                        np.broadcast_to(expect, (8, 5)), rtol=1e-4)
+
+
+def test_identity_attach_kl_sparse_reg_momentum():
+    """The moving_avg aux state follows the reference momentum update and
+    the backward uses the SMOOTHED average, not the raw batch mean."""
+    rs = np.random.RandomState(4)
+    h = nd.array(rs.rand(6, 3).astype("f"))
+    avg = nd.array(np.full(3, 0.5, "f"))
+    h.attach_grad()
+    with autograd.record():
+        out = nd.IdentityAttachKLSparseReg(
+            h, avg, sparseness_target=0.2, penalty=0.01, momentum=0.9)
+        out.sum().backward()
+    new_avg = 0.9 * 0.5 + 0.1 * h.asnumpy().mean(0)
+    assert_almost_equal(avg.asnumpy(), new_avg, rtol=1e-5)  # aux updated
+    expect = 1.0 + 0.01 * (-0.2 / new_avg + 0.8 / (1 - new_avg))
+    assert_almost_equal(h.grad.asnumpy(),
+                        np.broadcast_to(expect, (6, 3)), rtol=1e-4)
+
+
+def test_slice_assign_open_bounds():
+    """None entries in begin/end are open-ended (reference SliceParam)."""
+    x = nd.array(np.arange(12.0, dtype="f").reshape(3, 4))
+    y = getattr(nd, "_slice_assign_scalar")(x, scalar=-1.0,
+                                            begin=(None, 2),
+                                            end=(None, None))
+    ref = x.asnumpy().copy()
+    ref[:, 2:] = -1
+    assert_almost_equal(y.asnumpy(), ref)
