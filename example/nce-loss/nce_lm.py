@@ -1,0 +1,120 @@
+"""Noise-contrastive estimation language model (parity:
+/root/reference/example/nce-loss/ — train a word-embedding LM with NCE
+instead of full softmax; wordvec.py/lstm_word.py there).
+
+NCE turns the |V|-way softmax into k+1 binary discriminations per
+position: one true word vs k noise words drawn from the unigram
+distribution.  TPU-native: the sampled-candidate scores are one batched
+embedding gather + dot — a tiny dense program instead of a |V|-wide
+matmul; everything jits.
+"""
+import argparse
+import logging
+import time
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+from mxnet_tpu.gluon import nn
+
+
+class NCEModel(gluon.Block):
+    """CBOW-style: context embeddings averaged → hidden; NCE head owns an
+    output embedding + bias per vocab word."""
+
+    def __init__(self, vocab, embed, **kw):
+        super().__init__(**kw)
+        with self.name_scope():
+            self.in_embed = nn.Embedding(vocab, embed)
+            self.out_embed = nn.Embedding(vocab, embed)
+            self.out_bias = nn.Embedding(vocab, 1)
+
+    def forward(self, context, candidates):
+        """context: (B, C) ids; candidates: (B, K+1) ids (true word first).
+        Returns logits (B, K+1)."""
+        h = self.in_embed(context).mean(axis=1)          # (B, E)
+        w = self.out_embed(candidates)                   # (B, K+1, E)
+        b = self.out_bias(candidates).reshape((0, -1))   # (B, K+1)
+        return (w * h.expand_dims(1)).sum(axis=-1) + b
+
+
+def make_corpus(rs, n_tokens, vocab):
+    """Zipf-ish unigram corpus with strong bigram structure."""
+    probs = 1.0 / np.arange(1, vocab + 1)
+    probs /= probs.sum()
+    trans = rs.permutation(vocab)  # each word strongly predicts trans[w]
+    toks = [int(rs.choice(vocab, p=probs))]
+    for _ in range(n_tokens - 1):
+        if rs.rand() < 0.7:
+            toks.append(int(trans[toks[-1]]))
+        else:
+            toks.append(int(rs.choice(vocab, p=probs)))
+    return np.asarray(toks), probs
+
+
+def main():
+    ap = argparse.ArgumentParser(description="NCE word model")
+    ap.add_argument("--num-epochs", type=int, default=6)
+    ap.add_argument("--num-tokens", type=int, default=20000)
+    ap.add_argument("--batch-size", type=int, default=256)
+    ap.add_argument("--vocab", type=int, default=500)
+    ap.add_argument("--embed", type=int, default=64)
+    ap.add_argument("--window", type=int, default=2)
+    ap.add_argument("--num-noise", type=int, default=8)
+    ap.add_argument("--lr", type=float, default=0.02)
+    args = ap.parse_args()
+    logging.basicConfig(level=logging.INFO)
+    ctx = mx.cpu()
+    rs = np.random.RandomState(0)
+
+    toks, unigram = make_corpus(rs, args.num_tokens, args.vocab)
+    W = args.window
+    centers = np.arange(W, len(toks) - W)
+    contexts = np.stack([toks[c - W:c].tolist() + toks[c + 1:c + 1 + W].tolist()
+                         for c in centers])
+    targets = toks[centers]
+
+    net = NCEModel(args.vocab, args.embed)
+    net.initialize(mx.init.Xavier(), ctx=ctx)
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": args.lr})
+    bce = gluon.loss.SigmoidBinaryCrossEntropyLoss(from_sigmoid=False)
+
+    n = len(centers)
+    nb = n // args.batch_size
+    K = args.num_noise
+    labels = mx.nd.array(
+        np.concatenate([np.ones((args.batch_size, 1), "f"),
+                        np.zeros((args.batch_size, K), "f")], 1), ctx=ctx)
+    t0 = time.time()
+    for epoch in range(args.num_epochs):
+        tot = 0.0
+        perm = rs.permutation(n)
+        for b in range(nb):
+            idx = perm[b * args.batch_size:(b + 1) * args.batch_size]
+            noise = rs.choice(args.vocab, (args.batch_size, K), p=unigram)
+            cands = np.concatenate([targets[idx][:, None], noise], 1)
+            xb = mx.nd.array(contexts[idx].astype("f"), ctx=ctx)
+            cb = mx.nd.array(cands.astype("f"), ctx=ctx)
+            with autograd.record():
+                logits = net(xb, cb)
+                loss = bce(logits, labels)
+            loss.backward()
+            trainer.step(args.batch_size)
+            tot += float(loss.mean().asnumpy())
+        logging.info("Epoch[%d] nce-loss=%.4f (%.1fs)", epoch, tot / nb,
+                     time.time() - t0)
+
+    # evaluation: the true next word should outscore noise most of the time
+    idx = rs.permutation(n)[:512]
+    noise = rs.choice(args.vocab, (len(idx), K), p=unigram)
+    cands = np.concatenate([targets[idx][:, None], noise], 1)
+    logits = net(mx.nd.array(contexts[idx].astype("f"), ctx=ctx),
+                 mx.nd.array(cands.astype("f"), ctx=ctx)).asnumpy()
+    acc = (logits.argmax(1) == 0).mean()
+    print("true-word top-1 over noise %.3f" % acc)
+
+
+if __name__ == "__main__":
+    main()

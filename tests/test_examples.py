@@ -403,3 +403,27 @@ def test_transformer_lm_example():
     ppl = float(line.split()[2])
     # must beat the uniform baseline (vocab=32) after 2 epochs
     assert ppl < 30.0, out
+
+
+def test_bi_lstm_sort_example():
+    out = run_example("example/bi-lstm-sort/sort_io.py",
+                      "--num-epochs", "2", "--num-examples", "600",
+                      "--vocab", "20", "--hidden", "64")
+    lines = [l for l in out.splitlines() if "loss=" in l]
+    first = float(lines[0].split("loss=")[1].split()[0])
+    last = float(lines[-1].split("loss=")[1].split()[0])
+    assert last < first, out  # learning signal within the smoke budget
+
+
+def test_cnn_text_classification_example():
+    out = run_example("example/cnn_text_classification/text_cnn.py",
+                      "--num-epochs", "3", "--num-examples", "1000")
+    line = [l for l in out.splitlines() if "dev accuracy" in l][0]
+    assert float(line.rsplit(" ", 1)[-1]) > 0.7, out
+
+
+def test_nce_loss_example():
+    out = run_example("example/nce-loss/nce_lm.py",
+                      "--num-epochs", "3", "--num-tokens", "8000")
+    line = [l for l in out.splitlines() if "true-word top-1" in l][0]
+    assert float(line.rsplit(" ", 1)[-1]) > 0.8, out

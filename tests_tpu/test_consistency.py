@@ -139,6 +139,31 @@ CASES += [
      {"a": (3, 4), "b": (3, 4)}),
     ("norm_l2", sym.sqrt(sym.sum(sym.square(v()))) + sym.sum(v() * 0),
      {"data": (5, 5)}),
+    # round-2 additions: pooling via grouped conv, fused attention, compat
+    ("pool_sum",
+     sym.Pooling(v(), kernel=(2, 2), stride=(2, 2), pool_type="sum"),
+     {"data": (2, 3, 8, 8)}),
+    ("pool_avg_full",
+     sym.Pooling(v(), kernel=(3, 3), stride=(2, 2), pool_type="avg",
+                 pooling_convention="full"), {"data": (2, 3, 9, 9)}),
+    ("mha_dense",
+     getattr(sym, "multihead_attention")(v(), num_heads=2, causal=True,
+                                         impl="dense"),
+     {"data": (2, 8, 24)}),
+    ("mha_flash",
+     getattr(sym, "multihead_attention")(v(), num_heads=2, causal=True,
+                                         impl="flash"),
+     {"data": (2, 8, 24)}),
+    ("reshape_like", getattr(sym, "reshape_like")(v("a"), v("b")),
+     {"a": (4, 6), "b": (3, 8)}),
+    ("slice_assign",
+     getattr(sym, "_slice_assign")(v("a"), v("b"), begin=(1, 1),
+                                   end=(3, 3)),
+     {"a": (4, 4), "b": (2, 2)}),
+    ("arange_like_posemb",
+     sym.broadcast_like(sym.expand_dims(
+         getattr(sym, "arange_like")(v(), axis=1), 0), v()),
+     {"data": (3, 7)}),
 ]
 
 
@@ -162,7 +187,7 @@ def test_fc_grad_consistency():
         mod = mx.mod.Module(net, context=ctx)
         mod.bind(data_shapes=[("data", x.shape)],
                  label_shapes=[("softmax_label", y.shape)])
-        np.random.seed(3)
+        mx.random.seed(3)
         mod.init_params(mx.init.Xavier())
         mod.forward_backward(mx.io.DataBatch([mx.nd.array(x)],
                                              [mx.nd.array(y)]))
@@ -189,7 +214,7 @@ def test_resnet50_fwd_bwd_consistency():
         mod = mx.mod.Module(out, context=ctx)
         mod.bind(data_shapes=[("data", x.shape)],
                  label_shapes=[("softmax_label", y.shape)])
-        np.random.seed(5)
+        mx.random.seed(5)
         mod.init_params(mx.init.Xavier(magnitude=2))
         mod.forward_backward(mx.io.DataBatch([mx.nd.array(x)],
                                              [mx.nd.array(y)]))
