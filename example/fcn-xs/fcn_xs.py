@@ -1,0 +1,137 @@
+"""FCN semantic segmentation (parity: /root/reference/example/fcn-xs/ —
+fully-convolutional nets with deconvolution upsampling and skip fusion,
+FCN-32s/16s/8s heads over a VGG body, per-pixel softmax).
+
+Zero-egress stand-in data: images of colored geometric shapes on noise;
+the label is the per-pixel shape class.  Exercises the real FCN machinery
+— stride-16 encoder, 1x1 score heads, Deconvolution (transposed-conv)
+upsampling with skip fusion, per-pixel multi_output SoftmaxOutput — on
+shapes small enough for CI.
+"""
+import argparse
+import logging
+import time
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+NUM_CLASSES = 4  # background + square / disk / stripe
+
+
+def build_fcn(num_classes, style="16s"):
+    """VGG-ish encoder (stride 16) + FCN-32s/16s score/upsample heads."""
+    data = mx.sym.Variable("data")
+
+    def block(x, f, n, name):
+        for i in range(1, n + 1):
+            x = mx.sym.Convolution(x, kernel=(3, 3), pad=(1, 1),
+                                   num_filter=f, name=f"{name}_conv{i}")
+            x = mx.sym.Activation(x, act_type="relu")
+        return mx.sym.Pooling(x, kernel=(2, 2), stride=(2, 2),
+                              pool_type="max", name=f"{name}_pool")
+
+    p1 = block(data, 16, 1, "b1")   # /2
+    p2 = block(p1, 32, 1, "b2")     # /4
+    p3 = block(p2, 64, 2, "b3")     # /8
+    p4 = block(p3, 128, 2, "b4")    # /16
+
+    score4 = mx.sym.Convolution(p4, kernel=(1, 1), num_filter=num_classes,
+                                name="score4")
+    if style == "32s":
+        up = mx.sym.Deconvolution(score4, kernel=(32, 32), stride=(16, 16),
+                                  pad=(8, 8), num_filter=num_classes,
+                                  no_bias=True, name="up16")
+    else:  # 16s: fuse the /8 skip
+        up2 = mx.sym.Deconvolution(score4, kernel=(4, 4), stride=(2, 2),
+                                   pad=(1, 1), num_filter=num_classes,
+                                   no_bias=True, name="up2")
+        score3 = mx.sym.Convolution(p3, kernel=(1, 1),
+                                    num_filter=num_classes, name="score3")
+        fused = up2 + score3
+        up = mx.sym.Deconvolution(fused, kernel=(16, 16), stride=(8, 8),
+                                  pad=(4, 4), num_filter=num_classes,
+                                  no_bias=True, name="up8")
+    # normalization="valid": mean over labeled pixels, so lr does not
+    # need the original FCN's 1e-10 scale against a summed loss
+    return mx.sym.SoftmaxOutput(up, multi_output=True,
+                                normalization="valid", name="softmax")
+
+
+def make_batch(rs, n, size):
+    imgs = rs.normal(0, 0.15, (n, 3, size, size)).astype(np.float32)
+    labels = np.zeros((n, size, size), np.float32)
+    yy, xx = np.mgrid[0:size, 0:size]
+    for i in range(n):
+        for cls in rs.permutation([1, 2, 3])[:rs.randint(1, 4)]:
+            margin = min(16, size // 4)
+            cy, cx = rs.randint(margin, size - margin, 2)
+            r = rs.randint(8, 16)  # >= stride-16 granularity
+            if cls == 1:
+                m = (abs(yy - cy) < r) & (abs(xx - cx) < r)
+            elif cls == 2:
+                m = (yy - cy) ** 2 + (xx - cx) ** 2 < r * r
+            else:
+                m = (abs(yy - cy) < 4) & (abs(xx - cx) < 2 * r)
+            imgs[i, cls - 1][m] += 1.0
+            labels[i][m] = cls
+    return imgs, labels
+
+
+def main():
+    ap = argparse.ArgumentParser(description="FCN segmentation")
+    ap.add_argument("--num-epochs", type=int, default=6)
+    ap.add_argument("--num-examples", type=int, default=128)
+    ap.add_argument("--batch-size", type=int, default=8)
+    ap.add_argument("--image-size", type=int, default=64)
+    ap.add_argument("--style", type=str, default="16s",
+                    choices=["32s", "16s"])
+    ap.add_argument("--lr", type=float, default=1e-3)
+    ap.add_argument("--optimizer", type=str, default="adam")
+    args = ap.parse_args()
+    logging.basicConfig(level=logging.INFO)
+    rs = np.random.RandomState(0)
+
+    X, Y = make_batch(rs, args.num_examples, args.image_size)
+    # per-pixel labels (N, H, W) for multi_output softmax
+    it = mx.io.NDArrayIter(X, Y, batch_size=args.batch_size, shuffle=True)
+
+    sym = build_fcn(NUM_CLASSES, args.style)
+    mod = mx.mod.Module(sym, context=mx.cpu())
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mx.random.seed(0)
+    # FCN recipe: upsampling deconvs start as bilinear interpolation
+    mod.init_params(mx.init.Mixed(
+        ["up.*_weight", ".*"],
+        [mx.init.Bilinear(), mx.init.Xavier(magnitude=2)]))
+    opt_params = {"learning_rate": args.lr}
+    if args.optimizer == "sgd":
+        opt_params.update(momentum=0.9, wd=1e-4)
+    mod.init_optimizer(optimizer=args.optimizer, optimizer_params=opt_params)
+
+    t0 = time.time()
+    for epoch in range(args.num_epochs):
+        it.reset()
+        correct = total = 0
+        fg_correct = fg_total = 0
+        for batch in it:
+            mod.forward_backward(batch)
+            mod.update()
+            pred = mod.get_outputs()[0].asnumpy()  # (B, C, H, W)
+            lab = batch.label[0].asnumpy()
+            correct += (pred.argmax(1) == lab).sum()
+            total += lab.size
+            hit = ((pred.argmax(1) == lab) & (lab > 0)).sum()
+            fg = (lab > 0).sum()
+            fg_correct += hit
+            fg_total += fg
+        logging.info("Epoch[%d] pixel-acc=%.4f fg-recall=%.4f (%.1fs)",
+                     epoch, correct / total, fg_correct / max(fg_total, 1),
+                     time.time() - t0)
+    print("final pixel accuracy %.4f fg recall %.4f" %
+          (correct / total, fg_correct / max(fg_total, 1)))
+
+
+if __name__ == "__main__":
+    main()

@@ -427,3 +427,12 @@ def test_nce_loss_example():
                       "--num-epochs", "3", "--num-tokens", "8000")
     line = [l for l in out.splitlines() if "true-word top-1" in l][0]
     assert float(line.rsplit(" ", 1)[-1]) > 0.8, out
+
+
+def test_fcn_xs_example():
+    out = run_example("example/fcn-xs/fcn_xs.py",
+                      "--num-epochs", "10", "--num-examples", "96")
+    line = [l for l in out.splitlines() if "final pixel accuracy" in l][0]
+    acc = float(line.split()[3])
+    fg = float(line.split()[-1])
+    assert acc > 0.85 and fg > 0.15, out
