@@ -436,3 +436,35 @@ def test_fcn_xs_example():
     acc = float(line.split()[3])
     fg = float(line.split()[-1])
     assert acc > 0.85 and fg > 0.15, out
+
+
+def test_stochastic_depth_example():
+    out = run_example("example/stochastic-depth/sd_cifar10.py",
+                      "--num-epochs", "4", "--num-examples", "800")
+    lines = [l for l in out.splitlines() if "loss=" in l]
+    first = float(lines[0].split("loss=")[1].split()[0])
+    last = float(lines[-1].split("loss=")[1].split()[0])
+    assert last < first * 0.8, out  # training signal through random depth
+
+
+def test_dec_example():
+    out = run_example("example/deep-embedded-clustering/dec.py",
+                      "--num-examples", "800", "--pretrain-epochs", "12",
+                      "--dec-epochs", "4")
+    km = [l for l in out.splitlines() if "k-means init" in l][0]
+    fin = [l for l in out.splitlines() if "final cluster" in l][0]
+    km_acc = float(km.rsplit(" ", 1)[-1])
+    fin_acc = float(fin.rsplit(" ", 1)[-1])
+    # refinement must not collapse the k-means solution
+    assert fin_acc > max(0.3, km_acc - 0.1), out
+
+
+def test_captcha_ocr_example():
+    out = run_example("example/captcha/captcha_ocr.py",
+                      "--num-epochs", "3", "--num-examples", "600",
+                      "--lr", "3e-3")
+    lines = [l for l in out.splitlines() if "ctc-loss=" in l]
+    first = float(lines[0].split("ctc-loss=")[1].split()[0])
+    last = float(lines[-1].split("ctc-loss=")[1].split()[0])
+    assert last < first, out  # CTC is slow to exit the blank phase; the
+    # 30-epoch default reaches real decodes (see example docstring)
