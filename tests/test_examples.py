@@ -468,3 +468,21 @@ def test_captcha_ocr_example():
     last = float(lines[-1].split("ctc-loss=")[1].split()[0])
     assert last < first, out  # CTC is slow to exit the blank phase; the
     # 30-epoch default reaches real decodes (see example docstring)
+
+
+def test_dsd_example():
+    out = run_example("example/dsd/dsd_mlp.py",
+                      "--epochs", "3", "--num-examples", "1000")
+    line = [l for l in out.splitlines() if "accuracy dense" in l][0]
+    accs = [float(v) for v in line.split()[2:7:2]]
+    assert all(a > 0.8 for a in accs), out  # all three phases stay strong
+    density = float(line.split()[-1].rstrip(")"))
+    assert density < 0.5, out  # pruning really happened
+
+
+def test_module_api_gallery():
+    out = run_example("example/module/demo_modules.py",
+                      "--num-epochs", "8")
+    line = [l for l in out.splitlines() if "val accuracies" in l][0]
+    vals = [float(v) for v in line.split()[3::2]]
+    assert all(v > 0.8 for v in vals), out

@@ -1,0 +1,86 @@
+"""Finite-difference gradient tier (parity: the reference op suite's
+check_numeric_gradient usage across tests/python/unittest/test_operator.py)
+— every analytic vjp in the registry family below is validated against
+central differences on tiny shapes."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import sym
+from mxnet_tpu.test_utils import check_numeric_gradient
+
+
+def v(name="data"):
+    return sym.Variable(name)
+
+
+rs = np.random.RandomState(7)
+
+CASES = [
+    ("fc", sym.FullyConnected(v(), num_hidden=4, name="fc"),
+     {"data": rs.randn(3, 5), "fc_weight": rs.randn(4, 5),
+      "fc_bias": rs.randn(4)}),
+    ("conv2d",
+     sym.Convolution(v(), kernel=(3, 3), num_filter=2, pad=(1, 1),
+                     name="cv"),
+     {"data": rs.randn(1, 2, 5, 5), "cv_weight": rs.randn(2, 2, 3, 3),
+      "cv_bias": rs.randn(2)}),
+    ("deconv2d",
+     sym.Deconvolution(v(), kernel=(2, 2), num_filter=2, stride=(2, 2),
+                       name="dc"),
+     {"data": rs.randn(1, 2, 3, 3), "dc_weight": rs.randn(2, 2, 2, 2)}),
+    ("pool_max",
+     sym.Pooling(v(), kernel=(2, 2), stride=(2, 2), pool_type="max"),
+     {"data": rs.randn(1, 2, 4, 4)}),
+    ("pool_avg",
+     sym.Pooling(v(), kernel=(2, 2), stride=(1, 1), pool_type="avg",
+                 pad=(1, 1)),
+     {"data": rs.randn(1, 2, 4, 4)}),
+    ("layernorm",
+     sym.LayerNorm(v("data"), v("g"), v("b")),
+     {"data": rs.randn(3, 6), "g": rs.rand(6) + 0.5, "b": rs.randn(6)}),
+    # BlockGrad'd inputs are perturbed by the finite difference but have
+    # zero analytic grad by design — check only the data path
+    ("softmax_ce",
+     0.0 - sym.sum(sym.log_softmax(v()) *
+                   sym.BlockGrad(sym.softmax(v("t")))),
+     {"data": rs.randn(3, 5), "t": rs.randn(3, 5)}, ["data"]),
+    ("broadcast_chain",
+     sym.broadcast_mul(sym.broadcast_add(v("a"), v("b")), v("a")),
+     {"a": rs.randn(3, 1, 4), "b": rs.randn(1, 2, 4)}),
+    ("reduce_mean", sym.mean(v(), axis=1, keepdims=True) * 3.0,
+     {"data": rs.randn(4, 5)}),
+    ("take_embed", sym.take(v("w"), sym.BlockGrad(sym.abs(v("i"))) * 2),
+     {"w": rs.randn(7, 3), "i": rs.rand(4)}, ["w"]),
+    ("batch_dot", sym.batch_dot(v("a"), v("b")),
+     {"a": rs.randn(2, 3, 4), "b": rs.randn(2, 4, 2)}),
+    ("mha",
+     sym.multihead_attention(v(), num_heads=2, causal=True,
+                             impl="dense"),
+     {"data": rs.randn(1, 4, 12)}),
+    ("tanh_chain", sym.tanh(v()) * sym.sigmoid(v()),
+     {"data": rs.randn(3, 4)}),
+    ("smooth_l1", sym.smooth_l1(v(), scalar=2.0),
+     {"data": rs.randn(3, 4)}),
+    ("transpose_reshape",
+     sym.Reshape(sym.transpose(v(), axes=(1, 0, 2)), shape=(-1, 4)),
+     {"data": rs.randn(2, 3, 4)}),
+    ("upsample",
+     sym.UpSampling(v(), scale=2, sample_type="nearest"),
+     {"data": rs.randn(1, 2, 3, 3)}),
+    ("slice_assign_grad",
+     sym._slice_assign(v("a"), v("b"), begin=(1, 1), end=(3, 3)),
+     {"a": rs.randn(4, 4), "b": rs.randn(2, 2)}),
+    ("reshape_like_grad",
+     sym.reshape_like(v("a"), sym.BlockGrad(v("b"))),
+     {"a": rs.randn(2, 6), "b": rs.randn(3, 4)}, ["a"]),
+]
+
+
+@pytest.mark.parametrize("case", CASES, ids=[c[0] for c in CASES])
+def test_numeric_gradient(case):
+    name, s, loc = case[0], case[1], case[2]
+    grad_nodes = case[3] if len(case) > 3 else None
+    loc = {k: val.astype(np.float32) for k, val in loc.items()}
+    check_numeric_gradient(s, loc, numeric_eps=1e-3, rtol=2e-2, atol=2e-2,
+                           grad_nodes=grad_nodes)
