@@ -294,6 +294,66 @@ class SGD(Optimizer):
 
 
 @register
+class NAG(Optimizer):
+    """Nesterov accelerated SGD (parity: optimizer.py NAG — the lookahead
+    form: w -= lr*(grad + momentum*mom) after mom = momentum*mom + grad)."""
+
+    fused = True
+
+    def __init__(self, momentum=0.0, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return nd.zeros(weight.shape, ctx=weight.context, dtype=weight.dtype)
+
+    def fused_hyper_key(self):
+        return (self.rescale_grad, self.clip_gradient, self.momentum)
+
+    def fused_step(self, index, weight, grad, state, lr, wd, t):
+        g = self._clip(grad.astype(jnp.float32) * self.rescale_grad) \
+            + wd * weight.astype(jnp.float32)
+        if self.momentum == 0.0:
+            return (weight.astype(jnp.float32) - lr * g).astype(weight.dtype), None
+        mom = state.astype(jnp.float32) * self.momentum + g
+        neww = weight.astype(jnp.float32) - lr * (g + self.momentum * mom)
+        return neww.astype(weight.dtype), mom.astype(state.dtype)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        from .ndarray.sparse import RowSparseNDArray
+        if isinstance(grad, RowSparseNDArray):
+            # lazy row-sparse update: only rows present in the gradient
+            # step (same invariant as SGD/Adam — untouched rows never
+            # decay and their momentum does not advance)
+            rows = grad._indices
+            g = self._clip(grad._values.astype(jnp.float32)
+                           * self.rescale_grad)
+            wr = jnp.take(weight._data, rows, axis=0).astype(jnp.float32)
+            g = g + wd * wr
+            if self.momentum != 0.0 and state is not None:
+                mr = jnp.take(state._data, rows, axis=0).astype(jnp.float32)
+                new_m = self.momentum * mr + g
+                state._set_data(state._data.at[rows].set(
+                    new_m.astype(state.dtype)))
+                step = lr * (g + self.momentum * new_m)
+            else:
+                step = lr * g
+            weight._set_data(weight._data.at[rows].add(
+                (-step).astype(weight.dtype)))
+            return
+        nw, nmom = self.fused_step(index, weight._data, grad._data,
+                                   None if state is None else state._data,
+                                   lr, wd, self._index_update_count[index])
+        weight._set_data(nw)
+        if state is not None:
+            state._set_data(nmom)
+
+
+@register
 class Adam(Optimizer):
     fused = True
 

@@ -486,3 +486,15 @@ def test_module_api_gallery():
     line = [l for l in out.splitlines() if "val accuracies" in l][0]
     vals = [float(v) for v in line.split()[3::2]]
     assert all(v > 0.8 for v in vals), out
+
+
+def test_bayesian_sgld_example():
+    out = run_example("example/bayesian-methods/bdk_demo.py",
+                      "--burn-in", "300", "--num-samples", "30")
+    rmse_line = [l for l in out.splitlines() if "posterior-mean RMSE" in l][0]
+    std_line = [l for l in out.splitlines() if "predictive std" in l][0]
+    rmse = float(rmse_line.rsplit(" ", 1)[-1])
+    vals = std_line.split()
+    data_std, extrap_std = float(vals[3]), float(vals[7])
+    assert rmse < 0.3, out                      # fits the observed region
+    assert extrap_std > data_std, out           # uncertainty grows off-data

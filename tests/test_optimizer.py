@@ -279,3 +279,64 @@ def test_mixed_initializer():
     m(mx.init.InitDesc("fc_weight"), w)
     assert_almost_equal(b.asnumpy(), np.zeros(2, "f"))
     assert_almost_equal(w.asnumpy(), np.ones(2, "f"))
+
+
+def test_nag_matches_reference_formula():
+    """NAG lookahead update against a hand-rolled numpy reference."""
+    import mxnet_tpu as mx
+    rs = np.random.RandomState(0)
+    w = rs.randn(5).astype("f")
+    opt = mx.optimizer.create("nag", learning_rate=0.1, momentum=0.9,
+                              rescale_grad=1.0, wd=0.0)
+    weight = mx.nd.array(w)
+    state = opt.create_state(0, weight)
+    mom_ref = np.zeros(5, "f")
+    w_ref = w.copy()
+    for step in range(5):
+        g = rs.randn(5).astype("f")
+        opt.update(0, weight, mx.nd.array(g), state)
+        mom_ref = 0.9 * mom_ref + g
+        w_ref = w_ref - 0.1 * (g + 0.9 * mom_ref)
+        assert_almost_equal(weight.asnumpy(), w_ref, rtol=1e-5, atol=1e-6)
+
+
+def test_nag_fused_updater_matches_per_key():
+    import mxnet_tpu as mx
+    from mxnet_tpu.optimizer import FusedUpdater
+    rs = np.random.RandomState(1)
+    opt1 = mx.optimizer.create("nag", learning_rate=0.05, momentum=0.9)
+    opt2 = mx.optimizer.create("nag", learning_rate=0.05, momentum=0.9)
+    fu = FusedUpdater(opt2)
+    w1 = [mx.nd.array(rs.randn(4, 3).astype("f")) for _ in range(3)]
+    w2 = [mx.nd.array(a.asnumpy()) for a in w1]
+    s1 = [opt1.create_state(i, w) for i, w in enumerate(w1)]
+    for step in range(4):
+        gs = [rs.randn(4, 3).astype("f") for _ in range(3)]
+        for i, (w, g, s) in enumerate(zip(w1, gs, s1)):
+            opt1.update(i, w, mx.nd.array(g), s)
+        fu.update_all(list(range(3)), [mx.nd.array(g) for g in gs], w2)
+        for a, b in zip(w1, w2):
+            assert_almost_equal(a.asnumpy(), b.asnumpy(), rtol=1e-5,
+                                atol=1e-6)
+
+
+def test_nag_row_sparse_lazy():
+    """NAG preserves the lazy row-sparse invariant: untouched rows do not
+    decay and their momentum does not advance."""
+    import mxnet_tpu as mx
+    from mxnet_tpu.ndarray import sparse
+    rs = np.random.RandomState(2)
+    opt = mx.optimizer.create("nag", learning_rate=0.1, momentum=0.9,
+                              wd=0.1)
+    w0 = rs.randn(6, 3).astype("f")
+    weight = mx.nd.array(w0.copy())
+    state = opt.create_state(0, weight)
+    dense_rows = np.zeros((6, 3), "f")
+    dense_rows[[1, 4]] = rs.randn(2, 3)
+    grad = sparse.row_sparse_array(dense_rows)
+    opt.update(0, weight, grad, state)
+    w1 = weight.asnumpy()
+    touched = [1, 4]
+    untouched = [0, 2, 3, 5]
+    assert np.abs(w1[untouched] - w0[untouched]).max() == 0.0
+    assert np.abs(w1[touched] - w0[touched]).max() > 0.0
