@@ -352,6 +352,21 @@ class NAG(Optimizer):
         if state is not None:
             state._set_data(nmom)
 
+    def update_multi_precision(self, index, weight, grad, state):
+        from .ndarray.sparse import RowSparseNDArray
+        if self.multi_precision and _is_low_prec(weight.dtype) \
+                and isinstance(grad, RowSparseNDArray):
+            # the generic path's grad.astype would densify — recast only
+            # the stored values so the lazy row invariant holds under mp
+            inner, w32 = state
+            g32 = RowSparseNDArray(grad._indices,
+                                   grad._values.astype(jnp.float32),
+                                   grad.shape, weight.context)
+            self.update(index, w32, g32, inner)
+            w32.copyto(weight)
+            return
+        super().update_multi_precision(index, weight, grad, state)
+
 
 @register
 class Adam(Optimizer):

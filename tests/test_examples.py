@@ -498,3 +498,14 @@ def test_bayesian_sgld_example():
     data_std, extrap_std = float(vals[3]), float(vals[7])
     assert rmse < 0.3, out                      # fits the observed region
     assert extrap_std > data_std, out           # uncertainty grows off-data
+
+
+def test_vae_example():
+    out = run_example("example/vae/vae.py",
+                      "--num-epochs", "8", "--num-examples", "800")
+    lines = [l for l in out.splitlines() if "recon=" in l]
+    first = float(lines[0].split("recon=")[1].split()[0])
+    line = [l for l in out.splitlines() if l.startswith("final recon")][0]
+    final = float(line.split()[2])
+    assert final < first * 0.9, out  # ELBO reconstruction term improves
+    assert np.isfinite(float(line.split()[4])), out

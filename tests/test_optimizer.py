@@ -340,3 +340,25 @@ def test_nag_row_sparse_lazy():
     untouched = [0, 2, 3, 5]
     assert np.abs(w1[untouched] - w0[untouched]).max() == 0.0
     assert np.abs(w1[touched] - w0[touched]).max() > 0.0
+
+
+def test_nag_row_sparse_lazy_multi_precision():
+    """The lazy row invariant holds under multi_precision too (the generic
+    mp path would densify the gradient via astype)."""
+    import mxnet_tpu as mx
+    from mxnet_tpu.ndarray import sparse
+    rs = np.random.RandomState(3)
+    opt = mx.optimizer.create("nag", learning_rate=0.1, momentum=0.9,
+                              wd=0.1, multi_precision=True)
+    w0 = rs.randn(6, 3).astype(np.float32)
+    weight = mx.nd.array(w0).astype("bfloat16")
+    state = opt.create_state_multi_precision(0, weight)
+    dense_rows = np.zeros((6, 3), "f")
+    dense_rows[[2, 5]] = rs.randn(2, 3)
+    grad = sparse.row_sparse_array(dense_rows)
+    opt.update_multi_precision(0, weight, grad, state)
+    w1 = weight.astype("float32").asnumpy()
+    w0b = mx.nd.array(w0).astype("bfloat16").astype("float32").asnumpy()
+    untouched = [0, 1, 3, 4]
+    assert np.abs(w1[untouched] - w0b[untouched]).max() == 0.0
+    assert np.abs(w1[[2, 5]] - w0b[[2, 5]]).max() > 0.0
