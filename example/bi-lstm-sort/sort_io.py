@@ -22,7 +22,7 @@ class SortNet(gluon.HybridBlock):
         with self.name_scope():
             self.embed = nn.Embedding(vocab, embed)
             self.lstm = rnn.LSTM(hidden, num_layers=1, layout="NTC",
-                                 bidirectional=True)
+                                 bidirectional=True, input_size=embed)
             self.head = nn.Dense(vocab, flatten=False)
 
     def hybrid_forward(self, F, x):
@@ -49,6 +49,7 @@ def main():
 
     net = SortNet(args.vocab, args.embed, args.hidden)
     net.initialize(mx.init.Xavier(), ctx=ctx)
+    net.hybridize()  # whole model -> one CachedOp (fused RNN scan inside)
     trainer = gluon.Trainer(net.collect_params(), "adam",
                             {"learning_rate": args.lr})
     sce = gluon.loss.SoftmaxCrossEntropyLoss()

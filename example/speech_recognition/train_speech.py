@@ -39,7 +39,7 @@ class AcousticModel(gluon.HybridBlock):
             self.front.add(nn.Dense(hidden, activation="relu",
                                     flatten=False))
             self.lstm = rnn.LSTM(hidden, num_layers=layers, layout="NTC",
-                                 bidirectional=True)
+                                 bidirectional=True, input_size=hidden)
             self.head = nn.Dense(vocab, flatten=False)
 
     def hybrid_forward(self, F, x):
@@ -119,6 +119,7 @@ def main():
     vocab = args.num_phones + 1  # + blank (last channel)
     net = AcousticModel(vocab, args.hidden, args.layers)
     net.initialize(mx.init.Xavier(), ctx=ctx)
+    net.hybridize()
     trainer = gluon.Trainer(net.collect_params(), "adam",
                             {"learning_rate": args.lr})
     ctc = gluon.loss.CTCLoss(layout="NTC", label_layout="NT")

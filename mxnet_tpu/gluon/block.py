@@ -304,21 +304,47 @@ class HybridBlock(Block):
     def _clear_cached_op(self):
         self._cached_graph = ()
         self._cached_op = None
+        self._cached_by_fmt = {}
+
+    @staticmethod
+    def _fmt_key(fmt):
+        """Hashable key for an input-structure format (call arity: an RNN
+        layer called with vs without explicit states must not share a
+        cached graph)."""
+        return repr(fmt)
 
     def _get_graph(self, *args):
-        if not self._cached_graph:
-            flat_args, self._in_format = _flatten(args)
+        flat_args, in_format = _flatten(args)
+        key = self._fmt_key(in_format)
+        if not hasattr(self, "_cached_by_fmt"):
+            self._cached_by_fmt = {}
+        entry = self._cached_by_fmt.get(key)
+        if entry is None and getattr(self, "_graph_preset", False) \
+                and self._cached_graph:
+            # graph preset externally (SymbolBlock imports a ready-made
+            # symbol) — adopt it for this call structure
+            flat_out = self._cached_graph[1]
+            entry = {"graph": self._cached_graph,
+                     "out_format": getattr(self, "_out_format", None)
+                     or [len(flat_out.list_outputs())]}
+            self._cached_by_fmt[key] = entry
+        if entry is None or not entry.get("graph"):
             inputs = [sym_mod.Variable(f"data{i}") if len(flat_args) > 1
                       else sym_mod.Variable("data")
                       for i in range(len(flat_args))]
-            grouped, _ = _regroup(inputs, self._in_format)
+            grouped, _ = _regroup(inputs, in_format)
             params = {name: p.var() for name, p in self._reg_params.items()}
             with self.name_scope():
                 out = self.hybrid_forward(sym_mod, grouped, **params) \
                     if not isinstance(grouped, list) else \
                     self.hybrid_forward(sym_mod, *grouped, **params)
-            flat_out, self._out_format = _flatten(out, "output")
-            self._cached_graph = (inputs, sym_mod.Group(flat_out))
+            flat_out, out_format = _flatten(out, "output")
+            entry = {"graph": (inputs, sym_mod.Group(flat_out)),
+                     "out_format": out_format}
+            self._cached_by_fmt[key] = entry
+        self._in_format = in_format
+        self._out_format = entry["out_format"]
+        self._cached_graph = entry["graph"]
         return self._cached_graph
 
     def infer_shape(self, *args):
@@ -347,9 +373,20 @@ class HybridBlock(Block):
         self._cached_params = {
             n: params[n] for n in out.list_inputs() if n in params}
         self._cached_aux = set(out.list_auxiliary_states())
+        entry = self._cached_by_fmt[self._fmt_key(self._in_format)]
+        entry["op"] = (self._cached_op, self._cached_input_names,
+                       self._cached_params, self._cached_aux)
 
     def _call_cached_op(self, *args):
-        if self._cached_op is None:
+        _, in_format = _flatten(args)
+        entry = getattr(self, "_cached_by_fmt", {}).get(
+            self._fmt_key(in_format))
+        if entry is not None and "op" in entry:
+            (self._cached_op, self._cached_input_names,
+             self._cached_params, self._cached_aux) = entry["op"]
+            self._in_format = in_format
+            self._out_format = entry["out_format"]
+        else:
             self._build_cache(*args)
         flat_args, fmt = _flatten(args)
         arg_dict = {}
@@ -426,6 +463,7 @@ class SymbolBlock(HybridBlock):
                 self.params.get(name, grad_req="null",
                                 allow_deferred_init=True)
         self._cached_graph = (syms, outputs)
+        self._graph_preset = True  # imported symbol, not traced
         self._reg_params = {}
 
     def forward(self, x, *args):

@@ -115,7 +115,27 @@ class _RNNLayer(HybridBlock):
 
     def forward(self, inputs, states=None):
         from ...ndarray import NDArray
+        from ...symbol import Symbol
         from ... import ndarray as F
+        if isinstance(inputs, Symbol) or self._active:
+            # symbol composition / hybridized CachedOp: the whole layer is
+            # one RNN op node (use_default_state builds zero states inside
+            # the op, so no shape access is needed here)
+            if states is None:
+                return super().forward(inputs)
+            if isinstance(states, NDArray):
+                states = [states]
+            if isinstance(inputs, NDArray):
+                # same recurrent-state validation as the eager path — a
+                # transposed state with matching element count would
+                # otherwise reshape silently into wrong numbers
+                bs = inputs.shape[self._layout.find("N")]
+                for state, info in zip(states, self.state_info(bs)):
+                    if state.shape != info["shape"]:
+                        raise ValueError(
+                            f"Invalid recurrent state shape. Expecting "
+                            f"{info['shape']}, got {state.shape}.")
+            return super().forward(inputs, *states)
         batch_size = inputs.shape[self._layout.find("N")]
         skip_states = states is None
         if skip_states:
@@ -136,36 +156,48 @@ class _RNNLayer(HybridBlock):
         out = self._forward_kernel(inputs, states)
         return out[0] if skip_states else out
 
-    def _forward_kernel(self, inputs, states):
-        """Pack params → fused RNN op (one lax.scan XLA program)."""
-        from ... import ndarray as F
-        if self._layout == "NTC":
-            inputs = inputs.swapaxes(0, 1)
-        ctx = inputs.context
-        params = []
-        # cuDNN layout: per layer/dir W then R; then per layer/dir bW, bR
+    def hybrid_forward(self, F, inputs, *states, **params):
+        """Symbol-composable kernel: params packed with F ops, states
+        optional (the RNN op's use_default_state builds zeros on-device,
+        where shapes are concrete)."""
         dirs = ["l", "r"] if self._dir == 2 else ["l"]
+        parts = []
         for i in range(self._num_layers):
             for j in dirs:
-                params.append(getattr(self, f"{j}{i}_i2h_weight").data(ctx)
-                              .reshape((-1,)))
-                params.append(getattr(self, f"{j}{i}_h2h_weight").data(ctx)
-                              .reshape((-1,)))
+                parts.append(F.Reshape(params[f"{j}{i}_i2h_weight"],
+                                       shape=(-1,)))
+                parts.append(F.Reshape(params[f"{j}{i}_h2h_weight"],
+                                       shape=(-1,)))
         for i in range(self._num_layers):
             for j in dirs:
-                params.append(getattr(self, f"{j}{i}_i2h_bias").data(ctx))
-                params.append(getattr(self, f"{j}{i}_h2h_bias").data(ctx))
-        params = F.concatenate([p for p in params], axis=0)
-        rnn_args = [inputs, params] + list(states)
-        rnn = F.RNN(*rnn_args, state_size=self._hidden_size,
-                    num_layers=self._num_layers, bidirectional=self._dir == 2,
-                    p=self._dropout, state_outputs=True, mode=self._mode)
-        outputs, states = rnn[0], [rnn[1]]
-        if self._mode == "lstm":
-            states.append(rnn[2])
+                parts.append(params[f"{j}{i}_i2h_bias"])
+                parts.append(params[f"{j}{i}_h2h_bias"])
+        packed = F.Concat(*parts, dim=0)
+        x = F.SwapAxis(inputs, dim1=0, dim2=1) if self._layout == "NTC" \
+            else inputs
+        rnn = F.RNN(x, packed, *states, state_size=self._hidden_size,
+                    num_layers=self._num_layers,
+                    bidirectional=self._dir == 2, p=self._dropout,
+                    state_outputs=True, mode=self._mode,
+                    use_default_state=not states)
+        out = rnn[0]
         if self._layout == "NTC":
-            outputs = outputs.swapaxes(0, 1)
-        return outputs, states
+            out = F.SwapAxis(out, dim1=0, dim2=1)
+        if not states:
+            return out
+        new_states = [rnn[1]]
+        if self._mode == "lstm":
+            new_states.append(rnn[2])
+        return out, new_states
+
+    def _forward_kernel(self, inputs, states):
+        """Eager kernel = hybrid_forward with F=nd (ONE packing recipe for
+        both paths — they cannot drift)."""
+        from ... import ndarray as F
+        ctx = inputs.context
+        params = {name: p.data(ctx)
+                  for name, p in self._reg_params.items()}
+        return self.hybrid_forward(F, inputs, *states, **params)
 
 
 class RNN(_RNNLayer):

@@ -178,12 +178,16 @@ def _run_layer(x, h0, c0, W, R, bW, bR, mode, reverse):
                 Arg("bidirectional", bool, False), Arg("mode", str, required=True),
                 Arg("p", float, 0.0), Arg("state_outputs", bool, False),
                 Arg("lstm_state_clip_min", float, None),
-                Arg("lstm_state_clip_max", float, None)],
+                Arg("lstm_state_clip_max", float, None),
+                Arg("use_default_state", bool, False)],
           num_outputs=3, takes_is_train=True)
-def _rnn(p, data, parameters, state, state_cell=None):
+def _rnn(p, data, parameters, state=None, state_cell=None):
     """Fused multi-layer (bi)RNN/LSTM/GRU.
 
     data: (seq_len, batch, input_size); state: (L*D, batch, H).
+    use_default_state=True builds zero initial states inside the op
+    (shapes are concrete here), so symbol graphs / hybridized gluon RNN
+    layers need no explicit state inputs.
     Outputs (out, state_out, statecell_out) — the executor exposes the first
     1 or 3 depending on state_outputs, mirroring the reference op.
     """
@@ -194,6 +198,10 @@ def _rnn(p, data, parameters, state, state_cell=None):
     bidir = p["bidirectional"]
     d = 2 if bidir else 1
     T, B, I = data.shape
+    if p["use_default_state"] or state is None:
+        state = jnp.zeros((L * d, B, H), data.dtype)
+        if mode == "lstm":
+            state_cell = jnp.zeros((L * d, B, H), data.dtype)
     ws, rs, bws, brs = _unpack_rnn_params(parameters, L, I, H, bidir, mode)
     hs = state.reshape(L, d, B, H)
     cs = state_cell.reshape(L, d, B, H) if (mode == "lstm" and state_cell is not None) else None

@@ -307,11 +307,11 @@ def test_rcnn_end2end_example():
 
 def test_speech_ctc_example():
     out = run_example("example/speech_recognition/train_speech.py",
-                      "--num-epochs", "3", "--num-utts", "32")
-    lines = [l for l in out.splitlines() if "ctc-loss=" in l]
-    first = float(lines[0].split("ctc-loss=")[1].split()[0])
-    last = float(lines[-1].split("ctc-loss=")[1].split()[0])
-    assert np.isfinite(last) and last <= first + 1.0, out
+                      "--num-epochs", "10", "--num-utts", "48",
+                      "--lr", "5e-3")
+    line = [l for l in out.splitlines() if "final ctc-loss" in l][0]
+    cer = float(line.rsplit(" ", 1)[-1])
+    assert cer < 0.9, out  # decodes are emerging (CER 0 by epoch ~20)
 
 
 def test_profiler_example(tmp_path):
@@ -406,13 +406,12 @@ def test_transformer_lm_example():
 
 
 def test_bi_lstm_sort_example():
+    # hybridized fused-RNN path: 12 epochs run in ~15s on CPU
     out = run_example("example/bi-lstm-sort/sort_io.py",
-                      "--num-epochs", "2", "--num-examples", "600",
-                      "--vocab", "20", "--hidden", "64")
-    lines = [l for l in out.splitlines() if "loss=" in l]
-    first = float(lines[0].split("loss=")[1].split()[0])
-    last = float(lines[-1].split("loss=")[1].split()[0])
-    assert last < first, out  # learning signal within the smoke budget
+                      "--num-epochs", "12", "--num-examples", "2000",
+                      "--vocab", "30")
+    line = [l for l in out.splitlines() if "final sort accuracy" in l][0]
+    assert float(line.rsplit(" ", 1)[-1]) > 0.5, out
 
 
 def test_cnn_text_classification_example():
@@ -508,4 +507,4 @@ def test_vae_example():
     line = [l for l in out.splitlines() if l.startswith("final recon")][0]
     final = float(line.split()[2])
     assert final < first * 0.9, out  # ELBO reconstruction term improves
-    assert np.isfinite(float(line.split()[4])), out
+    assert np.isfinite(float(line.split()[6])), out  # gen-mean

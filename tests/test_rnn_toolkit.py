@@ -141,3 +141,84 @@ def test_rnn_cell_params_save_load(tmp_path):
     repacked = cell.pack_weights(unpacked)
     for k in args:
         assert_almost_equal(args[k].asnumpy(), repacked[k].asnumpy())
+
+
+def test_rnn_layer_hybridize_equivalence():
+    """gluon rnn layers hybridize into one RNN-op symbol graph with
+    numbers identical to the eager path (all modes, bidirectional,
+    explicit and default states)."""
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon import rnn
+
+    rs = np.random.RandomState(0)
+    x = mx.nd.array(rs.randn(3, 6, 5).astype("f"))  # NTC
+    cases = [
+        rnn.LSTM(8, num_layers=2, layout="NTC", bidirectional=True,
+                 input_size=5),
+        rnn.GRU(8, num_layers=1, layout="NTC", input_size=5),
+        rnn.RNN(8, activation="tanh", layout="NTC", input_size=5),
+    ]
+    for net in cases:
+        net.initialize(mx.init.Xavier(), ctx=mx.cpu())
+        eager = net(x).asnumpy()
+        net.hybridize()
+        hyb = net(x).asnumpy()
+        np.testing.assert_allclose(eager, hyb, rtol=1e-5, atol=1e-6)
+
+    # explicit states round-trip through the hybrid path
+    net = rnn.LSTM(8, layout="NTC", input_size=5)
+    net.initialize(mx.init.Xavier(), ctx=mx.cpu())
+    st = net.begin_state(batch_size=3)
+    e_out, e_st = net(x, st)
+    net.hybridize()
+    h_out, h_st = net(x, st)
+    np.testing.assert_allclose(e_out.asnumpy(), h_out.asnumpy(),
+                               rtol=1e-5, atol=1e-6)
+    for a, b in zip(e_st, h_st):
+        np.testing.assert_allclose(a.asnumpy(), b.asnumpy(),
+                                   rtol=1e-5, atol=1e-6)
+
+    # grads flow through the CachedOp path
+    from mxnet_tpu import autograd
+    net2 = rnn.LSTM(8, layout="NTC", input_size=5)
+    net2.initialize(mx.init.Xavier(), ctx=mx.cpu())
+    net2.hybridize()
+    with autograd.record():
+        loss = (net2(x) ** 2).sum()
+    loss.backward()
+    for p in net2.collect_params().values():
+        assert np.isfinite(p.grad().asnumpy()).all()
+
+
+def test_rnn_hybridize_arity_switch():
+    """Calling a hybridized layer with and without explicit states must
+    not share a cached graph (regression: the second arity silently
+    reused the first call's graph — zero states, wrong numbers)."""
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon import rnn
+
+    rs = np.random.RandomState(4)
+    x = mx.nd.array(rs.randn(2, 5, 4).astype("f"))
+    net = rnn.LSTM(6, layout="NTC", input_size=4)
+    net.initialize(mx.init.Xavier(), ctx=mx.cpu())
+    st = [mx.nd.array(rs.randn(1, 2, 6).astype("f")),
+          mx.nd.array(rs.randn(1, 2, 6).astype("f"))]
+    ref_no_st = net(x).asnumpy()
+    ref_with = net(x, st)[0].asnumpy()
+    assert not np.allclose(ref_no_st, ref_with)  # states matter
+
+    net.hybridize()
+    assert np.allclose(net(x).asnumpy(), ref_no_st, atol=1e-5)
+    out_with = net(x, st)[0].asnumpy()           # arity switch
+    assert np.allclose(out_with, ref_with, atol=1e-5)
+    assert np.allclose(net(x).asnumpy(), ref_no_st, atol=1e-5)  # and back
+
+    # wrong-shaped state raises (not silent reshape), hybridized too
+    bad = [mx.nd.zeros((2, 1, 6)), mx.nd.zeros((2, 1, 6))]
+    try:
+        net(x, bad)
+        assert False, "expected ValueError"
+    except ValueError:
+        pass
