@@ -378,7 +378,7 @@ class HybridBlock(Block):
                        self._cached_params, self._cached_aux)
 
     def _call_cached_op(self, *args):
-        _, in_format = _flatten(args)
+        flat_args, in_format = _flatten(args)
         entry = getattr(self, "_cached_by_fmt", {}).get(
             self._fmt_key(in_format))
         if entry is not None and "op" in entry:
@@ -388,7 +388,6 @@ class HybridBlock(Block):
             self._out_format = entry["out_format"]
         else:
             self._build_cache(*args)
-        flat_args, fmt = _flatten(args)
         arg_dict = {}
         aux_dict = {}
         for name, arr in zip(self._cached_input_names, flat_args):
@@ -490,6 +489,14 @@ class SymbolBlock(HybridBlock):
         self._cached_params = {
             n: params[n] for n in out.list_inputs() if n in params}
         self._cached_aux = set(out.list_auxiliary_states())
+        # register in the arity-keyed cache so _call_cached_op reuses the
+        # compiled op instead of re-tracing every forward
+        if not hasattr(self, "_cached_by_fmt"):
+            self._cached_by_fmt = {}
+        self._cached_by_fmt[self._fmt_key(self._in_format)] = {
+            "graph": self._cached_graph, "out_format": self._out_format,
+            "op": (self._cached_op, self._cached_input_names,
+                   self._cached_params, self._cached_aux)}
 
     def hybrid_forward(self, F, x, *args, **kwargs):
         raise NotImplementedError

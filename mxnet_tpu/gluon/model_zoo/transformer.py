@@ -108,5 +108,34 @@ class TransformerLM(HybridBlock):
         return self.head(self.ln_f(x))
 
 
+    def generate(self, prompt, max_new, temperature=0.0, rng=None):
+        """Autoregressive decoding from `prompt` (B, T0) token ids.
+
+        Greedy when temperature==0, else softmax sampling.  Each step
+        re-runs the (hybridized, cached) forward on the growing prefix —
+        correct-by-construction causal decoding; a KV-cache fast path is
+        a TPU-side optimization that does not change this API.
+        """
+        import numpy as np
+        from ... import ndarray as F
+        if prompt.shape[1] + max_new > self._max_len:
+            raise ValueError(
+                f"prompt length {prompt.shape[1]} + max_new {max_new} "
+                f"exceeds max_len {self._max_len}")
+        toks = prompt
+        for _ in range(max_new):
+            logits = self(toks)                      # (B, T, V)
+            last = logits[:, -1, :]
+            if temperature > 0:
+                p = F.softmax(last / temperature, axis=-1).asnumpy()
+                nxt = np.array([
+                    (rng or np.random).choice(p.shape[-1], p=row / row.sum())
+                    for row in p], dtype=np.float32)[:, None]
+            else:
+                nxt = last.asnumpy().argmax(-1).astype(np.float32)[:, None]
+            toks = F.concat(toks, F.array(nxt, ctx=toks.context), dim=1)
+        return toks
+
+
 def transformer_lm(vocab, **kwargs):
     return TransformerLM(vocab, **kwargs)

@@ -180,8 +180,8 @@ def _run_layer(x, h0, c0, W, R, bW, bR, mode, reverse):
                 Arg("lstm_state_clip_min", float, None),
                 Arg("lstm_state_clip_max", float, None),
                 Arg("use_default_state", bool, False)],
-          num_outputs=3, takes_is_train=True)
-def _rnn(p, data, parameters, state=None, state_cell=None):
+          num_outputs=3, takes_is_train=True, needs_rng=True)
+def _rnn(p, data, parameters, *rest):
     """Fused multi-layer (bi)RNN/LSTM/GRU.
 
     data: (seq_len, batch, input_size); state: (L*D, batch, H).
@@ -191,6 +191,10 @@ def _rnn(p, data, parameters, state=None, state_cell=None):
     Outputs (out, state_out, statecell_out) — the executor exposes the first
     1 or 3 depending on state_outputs, mirroring the reference op.
     """
+    key = rest[-1]                  # PRNG key (needs_rng appends last)
+    rest = rest[:-1]
+    state = rest[0] if len(rest) > 0 else None
+    state_cell = rest[1] if len(rest) > 1 else None
     mode = p["mode"]
     if mode not in _GATES:
         raise MXNetError(f"unknown RNN mode {mode}")
@@ -198,10 +202,12 @@ def _rnn(p, data, parameters, state=None, state_cell=None):
     bidir = p["bidirectional"]
     d = 2 if bidir else 1
     T, B, I = data.shape
-    if p["use_default_state"] or state is None:
+    if state is None:
+        # use_default_state marks graphs composed without state inputs;
+        # an explicitly provided state always wins
         state = jnp.zeros((L * d, B, H), data.dtype)
-        if mode == "lstm":
-            state_cell = jnp.zeros((L * d, B, H), data.dtype)
+    if mode == "lstm" and state_cell is None:
+        state_cell = jnp.zeros((L * d, B, H), data.dtype)
     ws, rs, bws, brs = _unpack_rnn_params(parameters, L, I, H, bidir, mode)
     hs = state.reshape(L, d, B, H)
     cs = state_cell.reshape(L, d, B, H) if (mode == "lstm" and state_cell is not None) else None
@@ -220,6 +226,13 @@ def _rnn(p, data, parameters, state=None, state_cell=None):
             h_out.append(hT)
             c_out.append(cT if cT is not None else hT)
         x = outs[0] if d == 1 else jnp.concatenate(outs, axis=-1)
+        # inter-layer dropout (parity: rnn-inl.h — applied to every
+        # layer's output except the last, training mode only)
+        if p["p"] > 0 and layer < L - 1 and bool(p.get("__is_train__")):
+            key, sub = jax.random.split(key)
+            keep = jax.random.bernoulli(sub, 1.0 - p["p"], x.shape)
+            x = jnp.where(keep, x / (1.0 - p["p"]),
+                          jnp.zeros((), x.dtype)).astype(x.dtype)
     state_out = jnp.stack(h_out).reshape(L * d, B, H)
     cell_out = jnp.stack(c_out).reshape(L * d, B, H)
     if mode == "lstm" and p.get("lstm_state_clip_min") is not None:

@@ -31,8 +31,6 @@ def _auto_input_names(op, params):
             names.remove("bias")
     if op.name == "RNN" and p.get("mode") != "lstm":
         names = [n for n in names if n != "state_cell"]
-    if op.name == "RNN" and _truthy(p.get("use_default_state")):
-        names = [n for n in names if n not in ("state", "state_cell")]
     if op.name == "_contrib_ctc_loss":
         if not _truthy(p.get("use_data_lengths")):
             names.remove("data_lengths")

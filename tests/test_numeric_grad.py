@@ -84,3 +84,16 @@ def test_numeric_gradient(case):
     loc = {k: val.astype(np.float32) for k, val in loc.items()}
     check_numeric_gradient(s, loc, numeric_eps=1e-3, rtol=2e-2, atol=2e-2,
                            grad_nodes=grad_nodes)
+
+
+def test_rnn_op_numeric_gradient():
+    """The fused RNN op's scan-based vjp against central differences
+    (tiny LSTM, default zero states)."""
+    rs2 = np.random.RandomState(11)
+    T_, B_, I_, H_ = 3, 2, 3, 4
+    nparams = 4 * H_ * I_ + 4 * H_ * H_ + 8 * H_
+    s = sym.RNN(v("data"), v("par"), state_size=H_, num_layers=1,
+                mode="lstm", use_default_state=True)
+    loc = {"data": rs2.randn(T_, B_, I_).astype(np.float32),
+           "par": (rs2.randn(nparams) * 0.3).astype(np.float32)}
+    check_numeric_gradient(s, loc, numeric_eps=1e-2, rtol=5e-2, atol=5e-2)

@@ -222,3 +222,27 @@ def test_rnn_hybridize_arity_switch():
         assert False, "expected ValueError"
     except ValueError:
         pass
+
+
+def test_rnn_interlayer_dropout_active():
+    """dropout>0 on a multi-layer RNN changes training-mode outputs and
+    leaves eval-mode outputs deterministic (regression: the p arg was
+    silently ignored)."""
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd
+    from mxnet_tpu.gluon import rnn
+
+    rs = np.random.RandomState(6)
+    x = mx.nd.array(rs.randn(2, 6, 4).astype("f"))
+    net = rnn.LSTM(8, num_layers=2, layout="NTC", dropout=0.5,
+                   input_size=4)
+    net.initialize(mx.init.Xavier(), ctx=mx.cpu())
+    eval_a = net(x).asnumpy()
+    eval_b = net(x).asnumpy()
+    np.testing.assert_allclose(eval_a, eval_b)  # eval: no dropout
+    with autograd.record(train_mode=True):
+        tr_a = net(x).asnumpy()
+        tr_b = net(x).asnumpy()
+    assert not np.allclose(tr_a, eval_a)   # dropout bites in training
+    assert not np.allclose(tr_a, tr_b)     # and is stochastic per call
