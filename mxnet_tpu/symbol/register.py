@@ -29,8 +29,6 @@ def _auto_input_names(op, params):
             no_bias = op.schema.args["no_bias"].default
         if _truthy(no_bias):
             names.remove("bias")
-    if op.name == "RNN" and p.get("mode") != "lstm":
-        names = [n for n in names if n != "state_cell"]
     if op.name == "_contrib_ctc_loss":
         if not _truthy(p.get("use_data_lengths")):
             names.remove("data_lengths")

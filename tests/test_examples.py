@@ -508,3 +508,26 @@ def test_vae_example():
     final = float(line.split()[2])
     assert final < first * 0.9, out  # ELBO reconstruction term improves
     assert np.isfinite(float(line.split()[6])), out  # gen-mean
+
+
+def test_kill_mxnet_tool(tmp_path):
+    """kill_mxnet finds and terminates MXT_PROC_ID-tagged workers."""
+    import signal
+    import time
+    worker = tmp_path / "w.py"
+    worker.write_text("import time\ntime.sleep(60)\n")
+    proc = subprocess.Popen([sys.executable, str(worker)],
+                            env={**ENV, "MXT_PROC_ID": "0",
+                                 "MXT_NUM_PROC": "1"})
+    try:
+        time.sleep(1.0)
+        out = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "kill_mxnet.py"),
+             "--pattern", "w.py"],
+            env=ENV, capture_output=True, text=True, timeout=60)
+        assert "killing" in out.stdout, out.stdout + out.stderr
+        proc.wait(timeout=10)
+        assert proc.returncode == -signal.SIGTERM
+    finally:
+        if proc.poll() is None:
+            proc.kill()

@@ -1,0 +1,56 @@
+#!/usr/bin/env python
+"""Kill leftover training workers (parity: tools/kill-mxnet.py — the
+reference pssh'd `kill` across cluster hosts; here the launcher is
+tools/launch.py, whose workers are tagged with MXT_PROC_ID in their
+environment, so cleanup is a local process sweep).
+
+    python kill_mxnet.py [--signal 9] [--pattern SCRIPT_SUBSTRING]
+"""
+import argparse
+import os
+import signal
+import sys
+
+
+def find_workers(pattern=None):
+    """PIDs of processes launched by tools/launch.py (MXT_PROC_ID env),
+    optionally filtered by a cmdline substring."""
+    out = []
+    for pid in os.listdir("/proc"):
+        if not pid.isdigit() or int(pid) == os.getpid():
+            continue
+        try:
+            environ = open(f"/proc/{pid}/environ", "rb").read()
+            if b"MXT_PROC_ID=" not in environ:
+                continue
+            if pattern:
+                cmdline = open(f"/proc/{pid}/cmdline", "rb").read()
+                if pattern.encode() not in cmdline:
+                    continue
+            out.append(int(pid))
+        except (PermissionError, FileNotFoundError,
+                ProcessLookupError):
+            continue
+    return out
+
+
+def main():
+    ap = argparse.ArgumentParser(description="kill launch.py workers")
+    ap.add_argument("--signal", type=int, default=signal.SIGTERM)
+    ap.add_argument("--pattern", type=str, default=None)
+    ap.add_argument("--dry-run", action="store_true")
+    args = ap.parse_args()
+    pids = find_workers(args.pattern)
+    for pid in pids:
+        print(f"{'would kill' if args.dry_run else 'killing'} {pid}")
+        if not args.dry_run:
+            try:
+                os.kill(pid, args.signal)
+            except ProcessLookupError:
+                pass
+    print(f"{len(pids)} worker(s)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
