@@ -87,6 +87,7 @@ def main():
     model = RNNModel(vocab_size, args.num_embed, args.num_hidden,
                      args.num_layers)
     model.initialize(mx.init.Xavier())
+    model.hybridize()  # LSTM child -> fused CachedOp per call arity
     trainer = gluon.Trainer(model.collect_params(), "sgd",
                             {"learning_rate": args.lr, "momentum": 0,
                              "wd": 0})
