@@ -243,3 +243,15 @@ def test_gluon_lstm_consistency():
             outs.append(lstm(mx.nd.array(x)).asnumpy())
     a, b = outs
     np.testing.assert_allclose(a, b, rtol=TOL, atol=TOL)
+
+
+def test_transformer_lm_consistency():
+    """Flagship LM: gluon TransformerLM's symbol graph produces the same
+    logits on the accelerator as on CPU (embedding + fused MHA + LN +
+    FFN chain)."""
+    from mxnet_tpu.gluon.model_zoo.transformer import TransformerLM
+    net = TransformerLM(vocab=16, dim=16, num_layers=1, num_heads=2,
+                        max_len=8)
+    toks = sym.abs(v("data")) * 7  # ids in [0, 14] from unit-normal input
+    out = net(toks)
+    check_consistency(out, _ctxs(data=(2, 8)), tol=TOL)
