@@ -531,3 +531,11 @@ def test_kill_mxnet_tool(tmp_path):
     finally:
         if proc.poll() is None:
             proc.kill()
+
+
+def test_rnn_time_major_example():
+    out = run_example("example/rnn-time-major/readme_demo.py",
+                      "--num-epochs", "3", "--corpus", "8000")
+    line = [l for l in out.splitlines() if "final TNC perplexity" in l][0]
+    ppl = float(line.rsplit(" ", 1)[-1])
+    assert ppl < 48.0, out  # well under the vocab-50 uniform baseline
