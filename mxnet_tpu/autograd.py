@@ -140,9 +140,20 @@ def mark_variables(variables, gradients, grad_reqs="write") -> None:
         v._grad_req = req
 
 
+def _acc(a, b):
+    """Gradient accumulation that understands row-sparse cotangent
+    markers (_RspCot): rsp+rsp stays rows-only; mixing with dense
+    densifies (correct fallback, e.g. tied embeddings)."""
+    from .ndarray.sparse import _RspCot
+    if isinstance(a, _RspCot) or isinstance(b, _RspCot):
+        return a + b if isinstance(a, _RspCot) else b + a
+    return a + b
+
+
 def backward(heads, head_grads=None, retain_graph: bool = False,
              train_mode: bool = True) -> None:
     """Reverse walk of the tape from `heads` (parity: Imperative::Backward)."""
+    from .ndarray.sparse import _RspCot, RowSparseNDArray
     tape = _state.tape
     grad_map: Dict[Tuple[int, int], jax.Array] = {}
     for i, h in enumerate(heads):
@@ -150,7 +161,7 @@ def backward(heads, head_grads=None, retain_graph: bool = False,
         g = jnp.ones(h.shape, h.dtype) if hg is None else (
             hg._data if hasattr(hg, "_data") else jnp.asarray(hg))
         k = _key(h)
-        grad_map[k] = grad_map[k] + g if k in grad_map else g
+        grad_map[k] = _acc(grad_map[k], g) if k in grad_map else g
 
     for entry in reversed(tape):
         if not any(k in grad_map for k in entry.out_keys):
@@ -158,12 +169,16 @@ def backward(heads, head_grads=None, retain_graph: bool = False,
         cots = list(entry.cot_zeros)
         for j, k in enumerate(entry.out_keys):
             if k in grad_map:
-                cots[j] = grad_map[k].astype(cots[j].dtype)
+                g = grad_map[k]
+                if isinstance(g, _RspCot):
+                    g = g.to_dense()  # upstream op needs a dense cotangent
+                cots[j] = g.astype(cots[j].dtype)
         in_grads = entry.vjp_fn(tuple(cots))
         for idx, k in enumerate(entry.in_keys):
-            g = _reg.zero_like_grad(in_grads[entry.in_idx[idx]],
-                                    entry.in_refs[idx]._data)
-            grad_map[k] = grad_map[k] + g if k in grad_map else g
+            g = in_grads[entry.in_idx[idx]]
+            if not isinstance(g, _RspCot):
+                g = _reg.zero_like_grad(g, entry.in_refs[idx]._data)
+            grad_map[k] = _acc(grad_map[k], g) if k in grad_map else g
 
     # write accumulated grads into attached .grad buffers
     seen = set()
@@ -173,7 +188,25 @@ def backward(heads, head_grads=None, retain_graph: bool = False,
             return
         if k in grad_map:
             seen.add(id(ref))
-            g = grad_map[k].astype(ref._grad.dtype)
+            g = grad_map[k]
+            if isinstance(ref._grad, RowSparseNDArray):
+                if not isinstance(g, _RspCot):
+                    # dense grad into an rsp buffer: keep only nonzero
+                    # rows (correct, though the dense detour already paid)
+                    from .ndarray.sparse import row_sparse_array
+                    rs = row_sparse_array(g)
+                    ids, vals = rs._indices, rs._values
+                else:
+                    ids, vals = g.ids, g.vals
+                vals = vals.astype(ref._grad.dtype)
+                if ref._grad_req == "add":
+                    ref._grad._add_rows(ids, vals)
+                else:
+                    ref._grad._assign_rows(ids, vals)
+                return
+            if isinstance(g, _RspCot):
+                g = g.to_dense()
+            g = g.astype(ref._grad.dtype)
             if ref._grad_req == "add":
                 ref._grad._set_data(ref._grad._data + g)
             else:

@@ -47,9 +47,10 @@ class Parameter:
         self.allow_deferred_init = allow_deferred_init
         self._differentiable = differentiable
         self._stype = stype
-        # row_sparse grad_stype: Trainer casts the dense autograd gradient
-        # to RowSparse before the update, so only touched rows step
-        # (parity: gluon sparse embeddings; documented dense-detour cliff)
+        # row_sparse grad_stype: the grad buffer IS a RowSparseNDArray
+        # (rows-only); autograd deposits token rows into it and the
+        # optimizer/kvstore stay on the O(nnz) lazy path
+        # (parity: gluon sparse embeddings, optimizer_op.cc rsp kernels)
         self._grad_stype = grad_stype
 
     def __repr__(self):
@@ -125,8 +126,17 @@ class Parameter:
         if self.grad_req == "null":
             self._grad = None
             return
-        self._grad = nd.zeros(self._data.shape, dtype=self._data.dtype,
-                              ctx=self._data.context)
+        if self._grad_stype == "row_sparse":
+            # rows-only gradient buffer: autograd deposits (ids, rows)
+            # directly — O(vocab) dense grads are never allocated
+            # (parity: rsp embedding grads, optimizer_op.cc rsp kernels)
+            from ..ndarray import sparse as _sp
+            self._grad = _sp.zeros_sparse("row_sparse", self._data.shape,
+                                          ctx=self._data.context,
+                                          dtype=self._data.dtype)
+        else:
+            self._grad = nd.zeros(self._data.shape, dtype=self._data.dtype,
+                                  ctx=self._data.context)
         from .. import autograd
         autograd.mark_variables([self._data], [self._grad], self.grad_req)
 
@@ -206,7 +216,11 @@ class Parameter:
     def zero_grad(self):
         if self._grad is None:
             return
-        self._grad[:] = 0
+        from ..ndarray.sparse import RowSparseNDArray
+        if isinstance(self._grad, RowSparseNDArray):
+            self._grad._clear_rows()
+        else:
+            self._grad[:] = 0
 
     def var(self):
         from .. import symbol

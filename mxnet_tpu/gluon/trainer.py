@@ -90,8 +90,11 @@ class Trainer:
             if rsp:
                 from ..ndarray import sparse as _sp
                 for i, p in rsp:
+                    # grads are already RowSparseNDArrays (rows-only
+                    # autograd deposit); cast is only a legacy fallback
                     self._kv.pushpull(
-                        i, [_sp.cast_storage(g, "row_sparse")
+                        i, [g if isinstance(g, _sp.RowSparseNDArray)
+                            else _sp.cast_storage(g, "row_sparse")
                             for g in p.list_grad()],
                         out=p.list_data())
             dense = [ip for ip in live if ip not in rsp]
@@ -141,7 +144,8 @@ class Trainer:
             for i, param in rsp:
                 for u, arr, grad in zip(self._updaters, param.list_data(),
                                         param.list_grad()):
-                    u(i, _sp.cast_storage(grad, "row_sparse"), arr)
+                    u(i, grad if isinstance(grad, _sp.RowSparseNDArray)
+                      else _sp.cast_storage(grad, "row_sparse"), arr)
             live = [ip for ip in live if ip not in rsp]
             if not live:
                 return

@@ -33,6 +33,7 @@ from typing import Dict, List, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as _np
 
 from .base import MXNetError
 from . import ndarray as nd
@@ -46,6 +47,15 @@ def _handoff(src: NDArray, dst: NDArray) -> None:
     zero device operations — instead of the reference's engine CopyTo.
     Per-key device_puts here were the Module.update bottleneck on the
     tunneled TPU (one RPC per parameter per step)."""
+    from .ndarray.sparse import RowSparseNDArray
+    if isinstance(dst, RowSparseNDArray):
+        if isinstance(src, RowSparseNDArray):
+            dst._assign_rows(src._indices, src._values)
+        else:
+            from .ndarray.sparse import row_sparse_array
+            rs = row_sparse_array(src)
+            dst._assign_rows(rs._indices, rs._values)
+        return
     sd, dd = src._data, dst._data
     if (sd.dtype == dd.dtype and
             getattr(sd, "sharding", None) == getattr(dd, "sharding", None)):
@@ -176,25 +186,34 @@ class KVStore:
             if len(vlist) > 1 and all(isinstance(v, RowSparseNDArray)
                                       for v in vlist):
                 # union-of-rows reduce keeps the result row-sparse so the
-                # updater stays on the lazy path (parity: comm.h rsp Reduce)
-                import numpy as _np
-                rows = _np.unique(_np.concatenate(
-                    [_np.asarray(v._indices) for v in vlist]))
-                dense = vlist[0]._data
-                for v in vlist[1:]:
-                    dense = dense + v._data
-                merged = RowSparseNDArray(rows, jnp.take(dense, rows, axis=0),
-                                          vlist[0].shape, vlist[0].context)
+                # updater stays on the lazy path (parity: comm.h rsp
+                # Reduce) — O(sum nnz) concat + dedup, never dense
+                merged = RowSparseNDArray(
+                    jnp.concatenate([v._indices for v in vlist]),
+                    jnp.concatenate([v._values for v in vlist]),
+                    vlist[0].shape, vlist[0].context)
             else:
                 merged = vlist[0]
                 for v in vlist[1:]:
                     merged = merged + v
-            if self._gc is not None:
-                # parity: kvstore_dist.h PushCompressed — the worker's
-                # locally-reduced gradient is quantized on the
-                # worker→server (DCN) leg only, after device aggregation
-                merged = self._compress(k, merged)
-            merged = self._allreduce(merged)
+            if isinstance(merged, RowSparseNDArray):
+                # rows-only cross-host union: ship rows+indices over DCN
+                # (parity: kvstore_dist.h rsp push; compression applies
+                # to dense grads only, as in the reference)
+                if self.num_workers > 1 and self.type != "local":
+                    from .parallel import collectives
+                    ids, vls = collectives.allgather_rows(
+                        merged._indices, merged._values)
+                    merged = RowSparseNDArray(ids, vls, merged.shape,
+                                              merged.context)
+            else:
+                if self._gc is not None:
+                    # parity: kvstore_dist.h PushCompressed — the
+                    # worker's locally-reduced gradient is quantized on
+                    # the worker→server (DCN) leg only, after device
+                    # aggregation
+                    merged = self._compress(k, merged)
+                merged = self._allreduce(merged)
             if self._updater is not None:
                 if k not in self._store:
                     raise MXNetError(f"key {k} has not been inited")
@@ -320,14 +339,29 @@ class KVStore:
         for k, olist in zip(keys, outs):
             src = self._store[k]
             for o, rid in zip(olist, rids * len(olist)):
-                idx = rid.asnumpy().astype("int64").ravel()
-                rows = src.asnumpy()[idx]
-                res = RowSparseNDArray(idx, rows, src.shape, src.context)
+                idx = _np.unique(
+                    rid.asnumpy().astype("int64").ravel())
+                # device-side gather of just the requested rows —
+                # no host round trip, no dense copy (parity:
+                # kvstore_local.h PullRowSparse)
+                if isinstance(src, RowSparseNDArray):
+                    have = _np.asarray(src._indices)
+                    pos = _np.searchsorted(have, idx)
+                    posc = _np.clip(pos, 0, max(len(have) - 1, 0))
+                    hit = (pos < len(have)) & (have[posc] == idx) \
+                        if len(have) else _np.zeros(len(idx), bool)
+                    rows = jnp.take(src._values, jnp.asarray(posc), axis=0)
+                    rows = jnp.where(
+                        jnp.asarray(hit).reshape((-1,) + (1,) *
+                                                 (rows.ndim - 1)),
+                        rows, jnp.zeros((), rows.dtype))
+                else:
+                    rows = jnp.take(src._data, jnp.asarray(idx), axis=0)
                 if isinstance(o, RowSparseNDArray):
-                    o._indices = res._indices
-                    o._values = res._values
-                    o._shape = res._shape
-                o._set_data(res._data)
+                    o._assign_rows(idx, rows)
+                else:
+                    o._set_data(jnp.zeros(src.shape, rows.dtype)
+                                .at[jnp.asarray(idx)].set(rows))
 
     # -- allreduce across processes (multi-host pods) ------------------------
     def _allreduce(self, merged: NDArray) -> NDArray:

@@ -199,6 +199,39 @@ class BaseModule:
                     self.logger.info("Epoch[%d] Validation-%s=%f", epoch, name, val)
             train_data.reset()
 
+    def _adopt_existing_bind(self, data_shapes, label_shapes, for_training,
+                             inputs_need_grad=False, grad_req="write",
+                             against=None):
+        """Already-bound handshake shared by every Module subclass: a
+        re-bind matching the current bind (data/label name+shape+dtype,
+        training mode, inputs_need_grad, grad_req) is a silent no-op; a
+        conflict raises instead of warn-and-ignore, which would silently
+        keep stale executors.  `against` overrides the module whose bind
+        state is compared (BucketingModule compares the default bucket,
+        not whichever bucket is current)."""
+        from ..io import DataDesc
+        import numpy as _np
+        ref = against if against is not None else self
+
+        def norm(descs):
+            out = []
+            for d in (descs or []):
+                d = d if isinstance(d, DataDesc) else DataDesc(*d)
+                out.append((d.name, tuple(d.shape),
+                            _np.dtype(d.dtype).name))
+            return out
+
+        req = (norm(data_shapes), norm(label_shapes), bool(for_training),
+               bool(inputs_need_grad), grad_req)
+        cur = (norm(ref.data_shapes), norm(ref.label_shapes),
+               bool(ref.for_training), bool(ref.inputs_need_grad),
+               getattr(ref, "_grad_req", grad_req))
+        if req != cur:
+            raise ValueError(
+                "Module is already bound with (data, label, for_training, "
+                "inputs_need_grad, grad_req)=%s; bind(%s) conflicts. "
+                "Use force_rebind=True." % (cur, req))
+
     # -- interface to implement ----------------------------------------------
     @property
     def symbol(self):

@@ -120,7 +120,12 @@ class BucketingModule(BaseModule):
         if force_rebind:
             self._reset_bind()
         if self.binded:
-            self.logger.warning("Already bound, ignoring bind()")
+            # compare against the DEFAULT bucket's bind state: fit() always
+            # re-binds with the default-bucket shapes, and the current
+            # bucket may legitimately differ after switch_bucket()
+            self._adopt_existing_bind(
+                data_shapes, label_shapes, for_training, inputs_need_grad,
+                grad_req, against=self._buckets[self._default_bucket_key])
             return
         assert shared_module is None
         self.for_training = for_training

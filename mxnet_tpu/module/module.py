@@ -196,7 +196,9 @@ class Module(BaseModule):
             self._exec = None
             self.binded = False
         if self.binded:
-            self.logger.warning("Already bound, ignoring bind()")
+            self._adopt_existing_bind(data_shapes, label_shapes,
+                                      for_training, inputs_need_grad,
+                                      grad_req)
             return
         self.for_training = for_training
         self.inputs_need_grad = inputs_need_grad
@@ -289,7 +291,10 @@ class Module(BaseModule):
                        force_init=False):
         assert self.binded and self.params_initialized
         if self.optimizer_initialized and not force_init:
-            self.logger.warning("optimizer already initialized, ignoring...")
+            # pre-initialized optimizer state is adopted silently — the
+            # pre-bind + pre-init + fit() pattern is first-class (bench,
+            # resume-from-checkpoint); force_init=True replaces it
+            self.logger.debug("optimizer already initialized, adopting")
             return
         if self._params_dirty:
             self._sync_params_from_devices()

@@ -65,7 +65,9 @@ class PythonModule(BaseModule):
              inputs_need_grad=False, force_rebind=False, shared_module=None,
              grad_req="write"):
         if self.binded and not force_rebind:
-            self.logger.warning("Already bound, ignoring bind()")
+            self._adopt_existing_bind(data_shapes, label_shapes,
+                                      for_training, inputs_need_grad,
+                                      grad_req)
             return
         self.for_training = for_training
         self.inputs_need_grad = inputs_need_grad

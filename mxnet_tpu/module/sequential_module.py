@@ -84,7 +84,9 @@ class SequentialModule(BaseModule):
              inputs_need_grad=False, force_rebind=False, shared_module=None,
              grad_req="write"):
         if self.binded and not force_rebind:
-            self.logger.warning("Already bound, ignoring bind()")
+            self._adopt_existing_bind(data_shapes, label_shapes,
+                                      for_training, inputs_need_grad,
+                                      grad_req)
             return
         assert shared_module is None
         self.for_training = for_training

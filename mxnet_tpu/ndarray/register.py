@@ -68,7 +68,23 @@ def invoke(op_name: str, ndarray_inputs, kwargs, out=None):
     vjp_fn = None
     profiling = _profiler.is_running()
     t0 = _time.perf_counter_ns() if profiling else 0
-    if recording:
+    if recording and op_name == "Embedding" and params.get("sparse_grad"):
+        # rows-only weight gradient (parity: rsp embedding grad,
+        # src/operator/tensor/indexing_op.h SparseEmbedding backward):
+        # the vjp never scatters into an O(vocab) dense buffer — it
+        # returns a row-sparse cotangent marker (token ids, per-token
+        # cotangent rows) that flows through the tape and deposits into
+        # the parameter's RowSparseNDArray grad
+        outs = _reg.apply_op(op, params_t, raw)
+        ids_raw, wshape = raw[0], raw[1].shape
+
+        def vjp_fn(cots, _ids=ids_raw, _ws=wshape):
+            from .sparse import _RspCot
+            cot = cots[0]
+            return (None, _RspCot(jax.numpy.ravel(_ids),
+                                  cot.reshape((-1,) + tuple(_ws[1:])),
+                                  _ws))
+    elif recording:
         outs, vjp_fn = _reg.make_vjp(op, params_t, raw)
     else:
         outs = _reg.apply_op(op, params_t, raw)

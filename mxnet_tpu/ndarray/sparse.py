@@ -1,11 +1,21 @@
 """Sparse NDArrays: row_sparse + csr (parity: python/mxnet/ndarray/sparse.py,
 include/mxnet/ndarray.h:61-63, src/operator/tensor/cast_storage / dot sparse).
 
-XLA has no first-class sparsity (SURVEY.md §7 risks), so these keep the
-reference's *API and storage layout* (indices/values, indptr/indices/data)
-while compute lowers to dense-segment gather/scatter — correct semantics,
-documented perf cliff.  row_sparse is the path gluon sparse embeddings and
-kvstore row_sparse_pull use.
+Storage behavior, not just storage API (VERDICT r2 #4): a
+RowSparseNDArray holds ONLY `indices` (sorted unique row ids) and
+`values` (the stored rows) — the O(vocab) dense form is never
+materialized at construction.  Dense materialization happens lazily and
+only at explicit dense sinks (`tostype('default')`, `asnumpy`, mixing
+into dense arithmetic), mirroring the reference where rsp tensors flow
+rows-only through optimizer/kvstore hot paths
+(src/operator/optimizer_op.cc:39-287 rsp kernels,
+src/kvstore/kvstore_local.h rsp paths) and only CastStorageComputeEx
+produces a dense array.
+
+XLA has no first-class sparsity (SURVEY.md §7), so *inside compiled
+graphs* compute stays dense; the rows-only representation lives at the
+NDArray/eager layer where the memory wins matter (embedding gradients:
+nnz = tokens-per-batch vs vocab).
 """
 from __future__ import annotations
 
@@ -17,21 +27,102 @@ from ..context import current_context
 from .ndarray import NDArray, array, zeros
 
 
+def _dedup_rows(indices, values):
+    """Sorted-unique row ids + segment-summed values (eager, O(nnz));
+    establishes the reference rsp invariant (sorted, no duplicates)."""
+    indices = jnp.asarray(indices, jnp.int32).reshape(-1)
+    values = jnp.asarray(values)
+    uids, inv = jnp.unique(indices, return_inverse=True)
+    if uids.shape[0] == indices.shape[0]:
+        # already unique; unique() returns them sorted — reorder values
+        order = jnp.argsort(indices)
+        return indices[order], values[order]
+    summed = jnp.zeros((uids.shape[0],) + values.shape[1:],
+                       values.dtype).at[inv.reshape(-1)].add(values)
+    return uids, summed
+
+
+class _RspCot:
+    """Autograd cotangent marker for a row-sparse gradient: (row ids,
+    row values) that MUST NOT be densified while flowing through the
+    tape.  Duplicated ids are allowed here (dedup happens once at
+    deposit time / construction of the RowSparseNDArray)."""
+
+    __slots__ = ("ids", "vals", "shape")
+
+    def __init__(self, ids, vals, shape):
+        self.ids = jnp.asarray(ids, jnp.int32).reshape(-1)
+        self.vals = jnp.asarray(vals).reshape(
+            (self.ids.shape[0],) + tuple(shape[1:]))
+        self.shape = tuple(shape)
+
+    @property
+    def dtype(self):
+        return self.vals.dtype
+
+    def astype(self, dtype):
+        return _RspCot(self.ids, self.vals.astype(dtype), self.shape)
+
+    def to_dense(self):
+        return jnp.zeros(self.shape, self.vals.dtype).at[self.ids].add(
+            self.vals)
+
+    def __add__(self, other):
+        if isinstance(other, _RspCot):
+            return _RspCot(jnp.concatenate([self.ids, other.ids]),
+                           jnp.concatenate([self.vals, other.vals]),
+                           self.shape)
+        return self.to_dense() + other
+
+    __radd__ = __add__
+
+
 class BaseSparseNDArray(NDArray):
     __slots__ = ()
 
 
 class RowSparseNDArray(BaseSparseNDArray):
-    """shape (N, ...) with only rows `indices` stored in `data`."""
+    """shape (N, ...) with only rows `indices` stored in `values`.
+
+    Rows-only storage is the source of truth; `_data` (the dense view
+    the base NDArray API is written against) is a lazy, uncached
+    materialization — constructing or updating a RowSparseNDArray never
+    allocates O(N) memory."""
 
     __slots__ = ("_indices", "_values", "_shape")
 
-    def __init__(self, indices, values, shape, ctx=None):
-        self._indices = jnp.asarray(indices, jnp.int64)
-        self._values = jnp.asarray(values)
-        self._shape = tuple(shape)
-        dense = jnp.zeros(shape, self._values.dtype).at[self._indices].set(self._values)
-        super().__init__(dense, ctx or current_context())
+    def __init__(self, indices, values, shape, ctx=None, _dedup=True):
+        values = jnp.asarray(values)
+        if _dedup:
+            indices, values = _dedup_rows(indices, values)
+        else:
+            indices = jnp.asarray(indices, jnp.int32).reshape(-1)
+        self._indices = indices
+        self._values = values
+        self._shape = tuple(int(s) for s in shape)
+        # NDArray.__init__ not called: it would store a dense buffer.
+        self._ctx = ctx or current_context()
+        self._version = 0
+        self._grad = None
+        self._grad_req = "null"
+        self._writable = True
+        self._base = None
+
+    # -- rows-only accessors --------------------------------------------
+    @property
+    def _data(self):
+        """Lazy dense view (NOT cached — peak memory stays O(nnz) unless
+        a dense sink is actually used)."""
+        return jnp.zeros(self._shape, self._values.dtype).at[
+            self._indices].add(self._values)
+
+    @property
+    def shape(self):
+        return self._shape
+
+    @property
+    def dtype(self):
+        return _np.dtype(self._values.dtype)
 
     @property
     def stype(self):
@@ -45,6 +136,41 @@ class RowSparseNDArray(BaseSparseNDArray):
     def data(self) -> NDArray:
         return NDArray(self._values, self._ctx)
 
+    # -- mutation (in-place row assignment keeps object identity for
+    #    Parameter._grad / kvstore out= contracts) ----------------------
+    def _set_data(self, new_data) -> None:
+        raise MXNetError(
+            "RowSparseNDArray has rows-only storage; use _assign_rows / "
+            "_add_rows (or tostype('default') for a dense copy)")
+
+    def _assign_rows(self, indices, values) -> None:
+        indices, values = _dedup_rows(indices, values)
+        self._indices = indices
+        self._values = values
+        self._version += 1
+
+    def _add_rows(self, indices, values) -> None:
+        self._assign_rows(jnp.concatenate([self._indices,
+                                           jnp.asarray(indices, jnp.int32)
+                                           .reshape(-1)]),
+                          jnp.concatenate([self._values,
+                                           jnp.asarray(values)]))
+
+    def _clear_rows(self) -> None:
+        self._indices = jnp.zeros((0,), jnp.int32)
+        self._values = jnp.zeros((0,) + self._shape[1:], self._values.dtype)
+        self._version += 1
+
+    def wait_to_read(self) -> None:
+        if hasattr(self._values, "block_until_ready"):
+            self._values.block_until_ready()
+
+    wait_to_write = wait_to_read
+
+    def copy(self):
+        return RowSparseNDArray(self._indices, self._values, self._shape,
+                                self._ctx, _dedup=False)
+
     def tostype(self, stype):
         if stype == "row_sparse":
             return self
@@ -53,31 +179,56 @@ class RowSparseNDArray(BaseSparseNDArray):
         raise MXNetError(f"cannot convert row_sparse to {stype}")
 
     def retain(self, indices):
-        idx = jnp.asarray(indices.asnumpy() if isinstance(indices, NDArray) else indices,
-                          jnp.int64)
-        vals = jnp.take(self._data, idx, axis=0)
-        return RowSparseNDArray(idx, vals, self._shape, self._ctx)
+        """Keep only the intersection with `indices` (parity:
+        sparse_retain-inl.h) — O(nnz + len(indices)), never dense."""
+        idx = _np.unique(_np.asarray(
+            indices.asnumpy() if isinstance(indices, NDArray)
+            else indices).astype(_np.int64).ravel())
+        have = _np.asarray(self._indices)
+        mask = _np.isin(idx, have)
+        kept = idx[mask]
+        pos = _np.searchsorted(have, kept)
+        vals = jnp.take(self._values, jnp.asarray(pos), axis=0)
+        return RowSparseNDArray(kept, vals, self._shape, self._ctx,
+                                _dedup=False)
 
     def __repr__(self):
         return (f"\n<RowSparseNDArray {'x'.join(map(str, self._shape))} "
-                f"({len(self._indices)} rows) @{self._ctx}>")
+                f"({self._indices.shape[0]} rows) @{self._ctx}>")
 
 
 class CSRNDArray(BaseSparseNDArray):
+    """2-D (M, N) compressed-sparse-row; nnz-only storage, lazy dense."""
+
     __slots__ = ("_indptr", "_indices_c", "_values", "_shape")
 
     def __init__(self, data, indptr, indices, shape, ctx=None):
         self._indptr = jnp.asarray(indptr, jnp.int64)
         self._indices_c = jnp.asarray(indices, jnp.int64)
         self._values = jnp.asarray(data)
-        self._shape = tuple(shape)
-        dense = _np.zeros(shape, _np.asarray(self._values).dtype)
-        ip = _np.asarray(self._indptr)
-        ic = _np.asarray(self._indices_c)
-        vv = _np.asarray(self._values)
-        for r in range(shape[0]):
-            dense[r, ic[ip[r]:ip[r + 1]]] = vv[ip[r]:ip[r + 1]]
-        super().__init__(jnp.asarray(dense), ctx or current_context())
+        self._shape = tuple(int(s) for s in shape)
+        self._ctx = ctx or current_context()
+        self._version = 0
+        self._grad = None
+        self._grad_req = "null"
+        self._writable = True
+        self._base = None
+
+    @property
+    def _data(self):
+        """Lazy dense view (uncached)."""
+        counts = _np.diff(_np.asarray(self._indptr))
+        rows = _np.repeat(_np.arange(self._shape[0]), counts)
+        return jnp.zeros(self._shape, self._values.dtype).at[
+            jnp.asarray(rows), self._indices_c].add(self._values)
+
+    @property
+    def shape(self):
+        return self._shape
+
+    @property
+    def dtype(self):
+        return _np.dtype(self._values.dtype)
 
     @property
     def stype(self):
@@ -95,6 +246,20 @@ class CSRNDArray(BaseSparseNDArray):
     def data(self) -> NDArray:
         return NDArray(self._values, self._ctx)
 
+    def _set_data(self, new_data) -> None:
+        raise MXNetError("CSRNDArray has nnz-only storage; build a new one "
+                         "or use tostype('default') for a dense copy")
+
+    def wait_to_read(self) -> None:
+        if hasattr(self._values, "block_until_ready"):
+            self._values.block_until_ready()
+
+    wait_to_write = wait_to_read
+
+    def copy(self):
+        return CSRNDArray(self._values, self._indptr, self._indices_c,
+                          self._shape, self._ctx)
+
     def tostype(self, stype):
         if stype == "csr":
             return self
@@ -104,21 +269,27 @@ class CSRNDArray(BaseSparseNDArray):
 
     def __repr__(self):
         return (f"\n<CSRNDArray {'x'.join(map(str, self._shape))} "
-                f"({len(self._values)} nnz) @{self._ctx}>")
+                f"({self._values.shape[0]} nnz) @{self._ctx}>")
 
 
 def row_sparse_array(arg1, shape=None, ctx=None, dtype=None):
     """Create RowSparseNDArray from (data, indices) tuple or dense source."""
     if isinstance(arg1, tuple) and len(arg1) == 2:
         values, indices = arg1
-        values = _np.asarray(values.asnumpy() if isinstance(values, NDArray) else values)
-        indices = _np.asarray(indices.asnumpy() if isinstance(indices, NDArray) else indices)
+        values = _np.asarray(values.asnumpy() if isinstance(values, NDArray)
+                             else values)
+        indices = _np.asarray(indices.asnumpy()
+                              if isinstance(indices, NDArray) else indices)
         if dtype is not None:
             values = values.astype(np_dtype(dtype))
         return RowSparseNDArray(indices, values, shape, ctx)
+    if isinstance(arg1, RowSparseNDArray):
+        return arg1
     dense = _np.asarray(arg1.asnumpy() if isinstance(arg1, NDArray) else arg1)
+    if dtype is not None:
+        dense = dense.astype(np_dtype(dtype))
     nz = _np.where(_np.any(dense.reshape(dense.shape[0], -1) != 0, axis=1))[0]
-    return RowSparseNDArray(nz, dense[nz], dense.shape, ctx)
+    return RowSparseNDArray(nz, dense[nz], dense.shape, ctx, _dedup=False)
 
 
 def csr_matrix(arg1, shape=None, ctx=None, dtype=None):
@@ -126,24 +297,26 @@ def csr_matrix(arg1, shape=None, ctx=None, dtype=None):
         data, indices, indptr = arg1
         return CSRNDArray(_np.asarray(data), _np.asarray(indptr),
                           _np.asarray(indices), shape, ctx)
+    if isinstance(arg1, CSRNDArray):
+        return arg1
     dense = _np.asarray(arg1.asnumpy() if isinstance(arg1, NDArray) else arg1)
     if dtype is not None:
         dense = dense.astype(np_dtype(dtype))
-    import numpy as np
-    indptr = [0]
-    indices = []
-    data = []
-    for r in range(dense.shape[0]):
-        cols = np.where(dense[r] != 0)[0]
-        indices.extend(cols.tolist())
-        data.extend(dense[r, cols].tolist())
-        indptr.append(len(indices))
-    return CSRNDArray(np.asarray(data, dense.dtype), np.asarray(indptr),
-                      np.asarray(indices), dense.shape, ctx)
+    sp_rows, sp_cols = _np.nonzero(dense)
+    order = _np.lexsort((sp_cols, sp_rows))
+    sp_rows, sp_cols = sp_rows[order], sp_cols[order]
+    indptr = _np.zeros(dense.shape[0] + 1, _np.int64)
+    _np.add.at(indptr, sp_rows + 1, 1)
+    indptr = _np.cumsum(indptr)
+    return CSRNDArray(dense[sp_rows, sp_cols], indptr, sp_cols,
+                      dense.shape, ctx)
 
 
 def cast_storage(arr: NDArray, stype: str):
-    """Parity: src/operator/tensor/cast_storage.cc."""
+    """Parity: src/operator/tensor/cast_storage.cc — REAL storage
+    conversion at the NDArray layer (dense<->rsp/csr); the symbol-space
+    twin (ops/sparse_ops.py) stays value-level because storage classes
+    do not exist inside an XLA graph."""
     cur = getattr(arr, "stype", "default")
     if stype == cur:
         # dense→default returns a fresh wrapper (callers may mutate it);
@@ -179,8 +352,9 @@ def dot(lhs, rhs, transpose_a=False, transpose_b=False):
 def zeros_sparse(stype, shape, ctx=None, dtype=None):
     if stype == "row_sparse":
         return RowSparseNDArray(_np.zeros((0,), _np.int64),
-                                _np.zeros((0,) + tuple(shape[1:]), np_dtype(dtype)),
-                                shape, ctx)
+                                _np.zeros((0,) + tuple(shape[1:]),
+                                          np_dtype(dtype)),
+                                shape, ctx, _dedup=False)
     if stype == "csr":
         return CSRNDArray(_np.zeros((0,), np_dtype(dtype)),
                           _np.zeros((shape[0] + 1,), _np.int64),

@@ -361,7 +361,8 @@ class NAG(Optimizer):
             inner, w32 = state
             g32 = RowSparseNDArray(grad._indices,
                                    grad._values.astype(jnp.float32),
-                                   grad.shape, weight.context)
+                                   grad.shape, weight.context,
+                                   _dedup=False)
             self.update(index, w32, g32, inner)
             w32.copyto(weight)
             return

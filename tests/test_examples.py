@@ -133,6 +133,35 @@ def test_bench_product_path_smoke():
     rec = json.loads(line)
     assert rec["metric"] == "resnet50_train_throughput"
     assert rec["value"] > 0
+    # a clean run must not be flagged partial (watchdog/outage path)
+    assert "partial" not in rec and "error" not in rec, rec
+
+
+def test_consistency_runner_artifact(tmp_path):
+    """The durable on-chip consistency runner: selftest mode over a case
+    subset must write a valid artifact with per-case status + max_err,
+    and survive a watchdog trip with the artifact intact."""
+    import json
+    out = tmp_path / "CONSISTENCY.json"
+    env = {**ENV, "MXT_CONSISTENCY_SELFTEST": "1"}
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools/run_tpu_consistency.py"),
+         "--out", str(out), "--only", "unary_relu,softmax,dot"],
+        env=env, capture_output=True, text=True, timeout=420)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    doc = json.loads(out.read_text())
+    assert doc["completed"] and doc["mode"] == "selftest"
+    assert doc["summary"] == {"pass": len(doc["cases"])}
+    assert all("max_err" in c for c in doc["cases"])
+    # watchdog trip: impossible budget -> hang record, artifact valid, rc 0
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools/run_tpu_consistency.py"),
+         "--out", str(out), "--only", "unary_relu", "--case-budget", "0.0"],
+        env=env, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    doc = json.loads(out.read_text())
+    assert not doc["completed"], doc
+    assert doc["cases"][-1]["status"] == "hang", doc
 
 
 def test_bench_io_harness():

@@ -183,3 +183,36 @@ def test_low_precision_training_converges():
                               "multi_precision": True})
     score = mod.score(mx.io.NDArrayIter(x, y, batch_size=50), "acc")
     assert score[0][1] > 0.85, score
+
+
+def test_rebind_adopt_or_assert(caplog):
+    """VERDICT r2 #8: fit() over a pre-bound + pre-initialized module
+    adopts the prepared state silently (no 'Already bound' warning spam);
+    a conflicting re-bind raises instead of silently keeping a stale
+    executor."""
+    import logging
+    import pytest
+    x, y = _toy_data(100)
+    net = sym.FullyConnected(sym.Variable("data"), num_hidden=3, name="fc")
+    net = sym.SoftmaxOutput(net, name="softmax")
+    it = mx.io.NDArrayIter(x, y, batch_size=25)
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params()
+    mod.init_optimizer(kvstore="local", optimizer="sgd")
+    with caplog.at_level(logging.WARNING):
+        mod.fit(it, num_epoch=1, kvstore="local")
+    assert not [r for r in caplog.records
+                if "Already bound" in r.message
+                or "already initialized" in r.message], caplog.records
+    # same bind again: silent no-op
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    # conflicting shape: loud
+    with pytest.raises(ValueError, match="force_rebind"):
+        mod.bind(data_shapes=[("data", (7, x.shape[1]))],
+                 label_shapes=it.provide_label)
+    # conflicting dtype, same shape: loud too
+    with pytest.raises(ValueError, match="force_rebind"):
+        mod.bind(data_shapes=[mx.io.DataDesc("data", (25, x.shape[1]),
+                                             "float16")],
+                 label_shapes=it.provide_label)

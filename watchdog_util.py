@@ -1,0 +1,75 @@
+"""Shared phase-watchdog for the driver-facing harnesses (bench.py,
+tools/run_tpu_consistency.py).
+
+The round-2 failure mode this exists for: a backend call through a dead
+TPU tunnel never returns, the process is killed at rc:124, and the whole
+round's evidence is lost.  The watchdog converts that into a one-shot
+`on_trip` callback (emit partial JSON / write the results artifact)
+followed by a hard exit 0.
+
+Thread-safety contract: `finish()` and the trip path race for a single
+`_fired` token under one lock, so exactly one of them runs the final
+emit — the driver is promised one JSON line / one artifact writer.
+"""
+import os
+import threading
+import time
+
+
+class Watchdog:
+    """Daemon thread that fires `on_trip()` once if the active phase
+    exceeds its deadline, then `os._exit(0)` (normal teardown may hang on
+    the same dead backend that caused the trip)."""
+
+    def __init__(self, on_trip, poll_s=1.0):
+        self._lock = threading.Lock()
+        self._deadline = float("inf")
+        self._active = False
+        self._fired = False
+        self._done = False
+        self._trip_finished = threading.Event()
+        self._on_trip = on_trip
+        self._poll_s = poll_s
+        threading.Thread(target=self._loop, daemon=True).start()
+
+    def phase(self, budget_s):
+        """Arm (or re-arm) the deadline for a new phase."""
+        with self._lock:
+            self._deadline = time.monotonic() + budget_s
+            self._active = True
+
+    def idle(self):
+        """Disarm between phases (e.g. while the main thread writes the
+        artifact) so a trip can never race a live main thread."""
+        with self._lock:
+            self._active = False
+
+    def finish(self):
+        """Main thread claims the emit token.  Returns True exactly once
+        across finish() and the trip path; the caller that gets True does
+        the final emit.  If the trip path won the race, block until its
+        emit completes — otherwise main's os._exit could kill the trip
+        thread mid-print and the driver would see a truncated line."""
+        with self._lock:
+            self._done = True
+            if not self._fired:
+                self._fired = True
+                return True
+        self._trip_finished.wait(timeout=600)
+        return False
+
+    def _loop(self):
+        while True:
+            time.sleep(self._poll_s)
+            with self._lock:
+                if self._done or self._fired:
+                    return
+                if not (self._active and
+                        time.monotonic() > self._deadline):
+                    continue
+                self._fired = True
+            try:
+                self._on_trip()
+            finally:
+                self._trip_finished.set()
+                os._exit(0)
