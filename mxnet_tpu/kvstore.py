@@ -335,7 +335,7 @@ class KVStore:
         keys, _ = _key_list(key)
         outs = _val_list(out)
         rids = row_ids if isinstance(row_ids, (list, tuple)) else [row_ids]
-        from .ndarray.sparse import RowSparseNDArray
+        from .ndarray.sparse import RowSparseNDArray, gather_rows
         for k, olist in zip(keys, outs):
             src = self._store[k]
             for o, rid in zip(olist, rids * len(olist)):
@@ -344,19 +344,7 @@ class KVStore:
                 # device-side gather of just the requested rows —
                 # no host round trip, no dense copy (parity:
                 # kvstore_local.h PullRowSparse)
-                if isinstance(src, RowSparseNDArray):
-                    have = _np.asarray(src._indices)
-                    pos = _np.searchsorted(have, idx)
-                    posc = _np.clip(pos, 0, max(len(have) - 1, 0))
-                    hit = (pos < len(have)) & (have[posc] == idx) \
-                        if len(have) else _np.zeros(len(idx), bool)
-                    rows = jnp.take(src._values, jnp.asarray(posc), axis=0)
-                    rows = jnp.where(
-                        jnp.asarray(hit).reshape((-1,) + (1,) *
-                                                 (rows.ndim - 1)),
-                        rows, jnp.zeros((), rows.dtype))
-                else:
-                    rows = jnp.take(src._data, jnp.asarray(idx), axis=0)
+                rows = gather_rows(src, idx)
                 if isinstance(o, RowSparseNDArray):
                     o._assign_rows(idx, rows)
                 else:

@@ -30,7 +30,7 @@ from .ndarray import NDArray, array, zeros
 def _dedup_rows(indices, values):
     """Sorted-unique row ids + segment-summed values (eager, O(nnz));
     establishes the reference rsp invariant (sorted, no duplicates)."""
-    indices = jnp.asarray(indices, jnp.int32).reshape(-1)
+    indices = jnp.asarray(indices, jnp.int64).reshape(-1)
     values = jnp.asarray(values)
     uids, inv = jnp.unique(indices, return_inverse=True)
     if uids.shape[0] == indices.shape[0]:
@@ -51,7 +51,7 @@ class _RspCot:
     __slots__ = ("ids", "vals", "shape")
 
     def __init__(self, ids, vals, shape):
-        self.ids = jnp.asarray(ids, jnp.int32).reshape(-1)
+        self.ids = jnp.asarray(ids, jnp.int64).reshape(-1)
         self.vals = jnp.asarray(vals).reshape(
             (self.ids.shape[0],) + tuple(shape[1:]))
         self.shape = tuple(shape)
@@ -96,7 +96,7 @@ class RowSparseNDArray(BaseSparseNDArray):
         if _dedup:
             indices, values = _dedup_rows(indices, values)
         else:
-            indices = jnp.asarray(indices, jnp.int32).reshape(-1)
+            indices = jnp.asarray(indices, jnp.int64).reshape(-1)
         self._indices = indices
         self._values = values
         self._shape = tuple(int(s) for s in shape)
@@ -151,13 +151,30 @@ class RowSparseNDArray(BaseSparseNDArray):
 
     def _add_rows(self, indices, values) -> None:
         self._assign_rows(jnp.concatenate([self._indices,
-                                           jnp.asarray(indices, jnp.int32)
+                                           jnp.asarray(indices, jnp.int64)
                                            .reshape(-1)]),
                           jnp.concatenate([self._values,
                                            jnp.asarray(values)]))
 
+    def _upsert_rows(self, indices, values) -> None:
+        """Replace the listed rows (insert if absent), keeping all other
+        stored rows — the write-back half of a rows-only optimizer step
+        (parity: optimizer_op.cc SGDUpdateRspRspImpl writes only touched
+        rows).  `indices` must be unique; O(nnz) host index plumbing."""
+        idx = _np.asarray(indices).astype(_np.int64).ravel()
+        have = _np.asarray(self._indices)
+        keep = ~_np.isin(have, idx)
+        ids = _np.concatenate([have[keep], idx])
+        kept_vals = jnp.take(self._values,
+                             jnp.asarray(_np.where(keep)[0]), axis=0)
+        vals = jnp.concatenate([kept_vals, jnp.asarray(values)])
+        order = _np.argsort(ids, kind="stable")
+        self._indices = jnp.asarray(ids[order], jnp.int64)
+        self._values = jnp.take(vals, jnp.asarray(order), axis=0)
+        self._version += 1
+
     def _clear_rows(self) -> None:
-        self._indices = jnp.zeros((0,), jnp.int32)
+        self._indices = jnp.zeros((0,), jnp.int64)
         self._values = jnp.zeros((0,) + self._shape[1:], self._values.dtype)
         self._version += 1
 
@@ -173,7 +190,9 @@ class RowSparseNDArray(BaseSparseNDArray):
 
     def tostype(self, stype):
         if stype == "row_sparse":
-            return self
+            # fresh array: rsp arrays mutate in place (_assign_rows), so
+            # returning self would alias source and result
+            return self.copy()
         if stype == "default":
             return NDArray(self._data, self._ctx)
         raise MXNetError(f"cannot convert row_sparse to {stype}")
@@ -262,7 +281,7 @@ class CSRNDArray(BaseSparseNDArray):
 
     def tostype(self, stype):
         if stype == "csr":
-            return self
+            return self.copy()
         if stype == "default":
             return NDArray(self._data, self._ctx)
         raise MXNetError(f"cannot convert csr to {stype}")
@@ -284,7 +303,9 @@ def row_sparse_array(arg1, shape=None, ctx=None, dtype=None):
             values = values.astype(np_dtype(dtype))
         return RowSparseNDArray(indices, values, shape, ctx)
     if isinstance(arg1, RowSparseNDArray):
-        return arg1
+        # fresh array: rsp arrays are mutated in place (_assign_rows), so
+        # returning arg1 itself would alias source and result
+        return arg1.copy()
     dense = _np.asarray(arg1.asnumpy() if isinstance(arg1, NDArray) else arg1)
     if dtype is not None:
         dense = dense.astype(np_dtype(dtype))
@@ -298,7 +319,7 @@ def csr_matrix(arg1, shape=None, ctx=None, dtype=None):
         return CSRNDArray(_np.asarray(data), _np.asarray(indptr),
                           _np.asarray(indices), shape, ctx)
     if isinstance(arg1, CSRNDArray):
-        return arg1
+        return arg1.copy()
     dense = _np.asarray(arg1.asnumpy() if isinstance(arg1, NDArray) else arg1)
     if dtype is not None:
         dense = dense.astype(np_dtype(dtype))
@@ -319,9 +340,10 @@ def cast_storage(arr: NDArray, stype: str):
     do not exist inside an XLA graph."""
     cur = getattr(arr, "stype", "default")
     if stype == cur:
-        # dense→default returns a fresh wrapper (callers may mutate it);
-        # same-stype sparse arrays pass through (treated as immutable)
-        return NDArray(arr._data, arr._ctx) if stype == "default" else arr
+        # always a fresh array — sparse arrays mutate in place, so a
+        # passthrough would alias source and result
+        return NDArray(arr._data, arr._ctx) if stype == "default" \
+            else arr.copy()
     if stype == "default":
         return NDArray(arr._data, arr._ctx)
     if stype == "row_sparse":
@@ -329,6 +351,27 @@ def cast_storage(arr: NDArray, stype: str):
     if stype == "csr":
         return csr_matrix(arr)
     raise MXNetError(f"unknown stype {stype}")
+
+
+def gather_rows(arr, rows):
+    """arr[rows] as a stacked block WITHOUT densifying rsp storage; rows
+    absent from an rsp array read as zero (parity: kvstore_local.h
+    PullRowSparse).  Shared by KVStore.row_sparse_pull and the rows-only
+    optimizer step."""
+    if isinstance(arr, RowSparseNDArray):
+        have = _np.asarray(arr._indices)
+        idx = _np.asarray(rows)
+        if len(have) == 0:
+            return jnp.zeros((len(idx),) + arr.shape[1:],
+                             arr._values.dtype)
+        pos = _np.searchsorted(have, idx)
+        posc = _np.clip(pos, 0, len(have) - 1)
+        hit = (pos < len(have)) & (have[posc] == idx)
+        out = jnp.take(arr._values, jnp.asarray(posc), axis=0)
+        return jnp.where(
+            jnp.asarray(hit).reshape((-1,) + (1,) * (out.ndim - 1)),
+            out, jnp.zeros((), out.dtype))
+    return jnp.take(arr._data, jnp.asarray(rows), axis=0)
 
 
 def retain(data, indices):

@@ -23,6 +23,23 @@ from .ndarray import NDArray
 _REG = Registry("optimizer")
 
 
+def _rows_of(arr, rows):
+    """Gather arr[rows] without densifying rsp storage (shared gather in
+    ndarray.sparse — same semantics as KVStore.row_sparse_pull)."""
+    from .ndarray.sparse import gather_rows
+    return gather_rows(arr, rows)
+
+
+def _write_rows(arr, rows, new_rows) -> None:
+    """arr[rows] = new_rows, rows-only for rsp storage (an rsp weight is
+    never materialized dense on the optimizer hot path)."""
+    from .ndarray.sparse import RowSparseNDArray
+    if isinstance(arr, RowSparseNDArray):
+        arr._upsert_rows(rows, new_rows)
+    else:
+        arr._set_data(arr._data.at[jnp.asarray(rows)].set(new_rows))
+
+
 def _is_low_prec(dtype) -> bool:
     """float16/bfloat16 weights get fp32 master copies under multi_precision
     (parity: optimizer_op.cc mp_sgd_* — bf16 is the TPU-native low precision)."""
@@ -70,7 +87,16 @@ class Optimizer:
 
     def create_state_multi_precision(self, index, weight):
         if self.multi_precision and _is_low_prec(weight.dtype):
-            w32 = weight.astype(_np.float32)
+            from .ndarray.sparse import RowSparseNDArray
+            if isinstance(weight, RowSparseNDArray):
+                # rows-only fp32 master: rows present now, new rows
+                # upserted by the rsp update path — never the dense
+                # O(vocab) copy (parity: mp SGDUpdateRspRspImpl)
+                w32 = RowSparseNDArray(
+                    weight._indices, weight._values.astype(jnp.float32),
+                    weight.shape, weight.context, _dedup=False)
+            else:
+                w32 = weight.astype(_np.float32)
             return (self.create_state(index, w32), w32)
         return self.create_state(index, weight)
 
@@ -210,6 +236,12 @@ class SGD(Optimizer):
             return self.create_state_multi_precision(index, weight)
         if self.momentum == 0.0:
             return None
+        if getattr(weight, "stype", "default") == "row_sparse":
+            # rsp weight gets an rsp momentum (parity: optimizer.py SGD
+            # create_state uses stype=weight.stype) — O(nnz), not O(vocab)
+            from .ndarray.sparse import zeros_sparse
+            return zeros_sparse("row_sparse", weight.shape,
+                                ctx=weight.context, dtype=weight.dtype)
         return nd.zeros(weight.shape, ctx=weight.context, dtype=weight.dtype)
 
     def fused_hyper_key(self):
@@ -243,8 +275,10 @@ class SGD(Optimizer):
             # row-sparse lazy update: ONLY rows present in the gradient
             # step (incl. their wd term) — parity: optimizer_op.cc
             # SGDUpdateRspRspImpl / SGDMomUpdateRspRspImpl (+ mp variants:
-            # the fp32 master rows step and cast back)
-            rows = grad._indices
+            # the fp32 master rows step and cast back).  Rows-only on BOTH
+            # sides: an rsp-stored weight/state is gathered and written
+            # back through its stored rows, never materialized dense.
+            rows = _np.asarray(grad._indices)
             g = grad._values.astype(jnp.float32) * self.rescale_grad
             if self.clip_gradient is not None:
                 g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
@@ -252,24 +286,18 @@ class SGD(Optimizer):
                 mom_state, w32 = state
             else:
                 mom_state, w32 = state, weight
-            master = w32._data
-            wr = jnp.take(master, rows, axis=0).astype(jnp.float32)
+            wr = _rows_of(w32, rows).astype(jnp.float32)
             if self.momentum != 0.0 and mom_state is not None:
-                mr = jnp.take(mom_state._data, rows, axis=0) \
-                    .astype(jnp.float32)
+                mr = _rows_of(mom_state, rows).astype(jnp.float32)
                 new_m = self.momentum * mr - lr * (g + wd * wr)
-                mom_state._set_data(mom_state._data.at[rows].set(
-                    new_m.astype(mom_state.dtype)))
+                _write_rows(mom_state, rows, new_m.astype(mom_state.dtype))
                 delta = new_m
             else:
                 delta = -lr * (g + wd * wr)
-            new_master = master.at[rows].add(delta.astype(master.dtype))
+            new_rows = wr + delta
+            _write_rows(w32, rows, new_rows.astype(w32.dtype))
             if multi_precision:
-                w32._set_data(new_master)
-                weight._set_data(weight._data.at[rows].set(
-                    jnp.take(new_master, rows, axis=0).astype(weight.dtype)))
-            else:
-                weight._set_data(new_master)
+                _write_rows(weight, rows, new_rows.astype(weight.dtype))
             return
         kw = self._common_kwargs()
         if multi_precision:
@@ -727,6 +755,11 @@ def _conform_state_sharding(state, weight):
     would make the fused update's jit see mixed placements.  Same-shape
     leaves (momentum, fp32 masters) take the weight's own sharding;
     other array leaves replicate over the weight's mesh."""
+    from .ndarray.sparse import BaseSparseNDArray
+    if isinstance(weight, BaseSparseNDArray):
+        # rows-only storage is host-orchestrated; no mesh sharding to
+        # conform to (and ._data would materialize the dense O(vocab) view)
+        return state
     wdata = weight._data if isinstance(weight, NDArray) else weight
     sharding = getattr(wdata, "sharding", None)
     if sharding is None or not hasattr(sharding, "mesh") or \

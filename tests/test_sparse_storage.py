@@ -154,3 +154,141 @@ def test_csr_lazy_dense_and_roundtrip():
                                rtol=1e-6)
     back = mx.nd.sparse.cast_storage(csr, "default")
     np.testing.assert_allclose(back.asnumpy(), dense, rtol=1e-6)
+
+
+# ----------------------------------------------------- ADVICE r3 regressions
+
+def test_row_sparse_pull_from_empty_store():
+    """Pulling a sparse weight before the first push returns zero rows
+    instead of crashing (parity: kvstore_local.h PullRowSparse on an
+    empty store)."""
+    from mxnet_tpu.ndarray import sparse
+    kv = mx.kv.create()
+    kv.init(21, sparse.zeros_sparse("row_sparse", (10, 4)))
+    out = sparse.zeros_sparse("row_sparse", (10, 4))
+    kv.row_sparse_pull(21, out=out, row_ids=nd.array([2, 5]))
+    np.testing.assert_allclose(out.asnumpy(), np.zeros((10, 4)))
+
+
+def test_kv_optimizer_on_rsp_weight_rows_only(densify_counter):
+    """kv.set_optimizer + push onto a row-sparse-STORED weight runs the
+    rows-only update (parity: the reference's server-side sparse update,
+    optimizer_op.cc SGDMomUpdateRspRspImpl): no dense materialization of
+    weight, momentum, or master anywhere on the path."""
+    from mxnet_tpu.ndarray import sparse
+    kv = mx.kv.create()
+    kv.init(7, sparse.zeros_sparse("row_sparse", (VOCAB, DIM)))
+    kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.5, momentum=0.9))
+    rows = np.array([3, 9])
+    kv.push(7, row_sparse_array((np.ones((2, DIM), "f"), rows),
+                                shape=(VOCAB, DIM)))
+    assert densify_counter == []
+    w = kv._store[7]
+    assert isinstance(w, RowSparseNDArray)
+    vals = np.asarray(w._values)
+    ids = np.asarray(w._indices)
+    np.testing.assert_array_equal(ids, rows)
+    # m = -lr*(g + wd*w) = -0.5; w = 0 + m = -0.5
+    np.testing.assert_allclose(vals, np.full((2, DIM), -0.5), rtol=1e-6)
+    # second push touches one old + one new row
+    kv.push(7, row_sparse_array((np.ones((2, DIM), "f"), [9, 17]),
+                                shape=(VOCAB, DIM)))
+    assert densify_counter == []
+    w = kv._store[7]
+    ids = np.asarray(w._indices)
+    np.testing.assert_array_equal(ids, [3, 9, 17])
+    got = {int(i): np.asarray(w._values)[k] for k, i in enumerate(ids)}
+    np.testing.assert_allclose(got[3], np.full(DIM, -0.5), rtol=1e-6)
+    # row 9: m=0.9*(-0.5)-0.5 = -0.95, w=-0.5-0.95=-1.45 ; row 17 fresh: -0.5
+    np.testing.assert_allclose(got[9], np.full(DIM, -1.45), rtol=1e-6)
+    np.testing.assert_allclose(got[17], np.full(DIM, -0.5), rtol=1e-6)
+
+
+def test_rsp_indices_are_int64():
+    """Row index aux dtype is int64 (parity: mshadow::kInt64 aux type) —
+    a first dimension >= 2**31 must not silently wrap."""
+    rs = row_sparse_array((np.ones((2, 3), "f"), [1, 2]), shape=(8, 3))
+    assert rs._indices.dtype == np.int64
+    g = rs.copy()
+    g._add_rows([5], np.ones((1, 3), "f"))
+    assert g._indices.dtype == np.int64
+
+
+def test_sparse_constructors_do_not_alias():
+    """row_sparse_array(rsp)/csr_matrix(csr) return fresh arrays; later
+    in-place mutation of either must not corrupt the other."""
+    from mxnet_tpu.ndarray.sparse import csr_matrix
+    src = row_sparse_array((np.ones((2, 3), "f"), [1, 4]), shape=(6, 3))
+    dup = row_sparse_array(src)
+    assert dup is not src
+    src._assign_rows([0], np.full((1, 3), 9.0, "f"))
+    np.testing.assert_allclose(dup.asnumpy()[1], np.ones(3))
+    assert dup.asnumpy()[0].sum() == 0
+
+    c = csr_matrix(np.eye(3, dtype="f"))
+    c2 = csr_matrix(c)
+    assert c2 is not c
+
+
+def test_upsert_rows():
+    """_upsert_rows replaces existing rows and inserts new ones, keeping
+    untouched rows intact (the optimizer write-back primitive)."""
+    rs = row_sparse_array((np.ones((2, 3), "f"), [2, 6]), shape=(10, 3))
+    rs._upsert_rows([6, 0], np.stack([np.full(3, 5.0, "f"),
+                                      np.full(3, 7.0, "f")]))
+    ids = np.asarray(rs._indices)
+    np.testing.assert_array_equal(ids, [0, 2, 6])
+    d = rs.asnumpy()
+    np.testing.assert_allclose(d[0], np.full(3, 7.0))
+    np.testing.assert_allclose(d[2], np.ones(3))
+    np.testing.assert_allclose(d[6], np.full(3, 5.0))
+
+
+def test_rsp_int64_on_all_construction_paths():
+    """int64 row ids survive every constructor path (dense→rsp, copy,
+    retain, zeros_sparse), not just the tuple constructor."""
+    from mxnet_tpu.ndarray import sparse
+    d = np.zeros((6, 3), "f")
+    d[2] = 1
+    rs = row_sparse_array(d)
+    assert rs._indices.dtype == np.int64
+    assert rs.copy()._indices.dtype == np.int64
+    assert rs.retain([2])._indices.dtype == np.int64
+    assert sparse.zeros_sparse("row_sparse", (4, 2))._indices.dtype \
+        == np.int64
+
+
+def test_tostype_and_cast_storage_do_not_alias():
+    """Same-stype tostype()/cast_storage() return fresh arrays (in-place
+    rsp mutation must not leak across the conversion API)."""
+    a = row_sparse_array((np.ones((1, 3), "f"), [1]), shape=(4, 3))
+    b = a.tostype("row_sparse")
+    c = mx.nd.sparse.cast_storage(a, "row_sparse")
+    a._assign_rows([0], np.full((1, 3), 9.0, "f"))
+    np.testing.assert_allclose(b.asnumpy()[1], np.ones(3))
+    np.testing.assert_allclose(c.asnumpy()[1], np.ones(3))
+    assert b.asnumpy()[0].sum() == 0
+
+
+def test_mp_rsp_update_rows_only(densify_counter):
+    """multi_precision on a bf16 rsp-stored weight keeps master+momentum
+    rows-only (no dense O(vocab) fp32 copies)."""
+    from mxnet_tpu.ndarray.sparse import RowSparseNDArray as RSP
+    import mxnet_tpu.optimizer as opt
+    w = row_sparse_array((np.ones((2, DIM), "f"), [3, 9]),
+                         shape=(VOCAB, DIM), dtype="float16")
+    o = mx.optimizer.SGD(learning_rate=0.5, momentum=0.9,
+                         multi_precision=True)
+    upd = opt.get_updater(o)
+    g = row_sparse_array((np.ones((2, DIM), "f"), [9, 17]),
+                         shape=(VOCAB, DIM), dtype="float16")
+    upd(0, g, w)
+    assert densify_counter == []
+    mom, w32 = upd.states[0]
+    assert isinstance(w32, RSP) and isinstance(mom, RSP)
+    got = {int(i): np.asarray(w._values)[k]
+           for k, i in enumerate(np.asarray(w._indices))}
+    # row 9: w=1 → m=-0.5*(1+0*1)= -0.5 → w=0.5 ; row 17: 0→-0.5 ; row 3 kept
+    np.testing.assert_allclose(got[9], np.full(DIM, 0.5), rtol=1e-2)
+    np.testing.assert_allclose(got[17], np.full(DIM, -0.5), rtol=1e-2)
+    np.testing.assert_allclose(got[3], np.full(DIM, 1.0), rtol=1e-2)
