@@ -2,10 +2,12 @@
 forward_backward vs update vs metric, to find where the 100 img/s
 collapse comes from."""
 import os
+import sys
 import time
 
 import numpy as np
 
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import mxnet_tpu as mx
 from mxnet_tpu.gluon.model_zoo import vision
 from mxnet_tpu.io import DataDesc

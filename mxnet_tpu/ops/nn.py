@@ -18,6 +18,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..base import Arg, MXNetError
+from .. import layout as _layout
 from .registry import register
 
 
@@ -49,6 +50,25 @@ def _conv_dims(kernel):
     raise MXNetError(f"unsupported conv kernel rank {n}")
 
 
+def _conv_dims_cl(kernel):
+    """Channels-last dimension numbers (mxnet_tpu.layout NHWC mode): the
+    TPU-native form — channel on the minor (lane) axis, no internal
+    transposes from XLA's conv emitter."""
+    n = len(kernel)
+    if n == 1:
+        return ("NWC", "WIO", "NWC")
+    if n == 2:
+        return ("NHWC", "HWIO", "NHWC")
+    if n == 3:
+        return ("NDHWC", "DHWIO", "NDHWC")
+    raise MXNetError(f"unsupported conv kernel rank {n}")
+
+
+def _w_to_cl(w, n):
+    """OI[spatial] kernel → [spatial]IO (constant-folded per step)."""
+    return w.transpose(tuple(range(2, n + 2)) + (1, 0))
+
+
 def _tup(v, n, default=1):
     if not v:
         return (default,) * n
@@ -71,7 +91,14 @@ def _convolution(p, data, weight, bias=None):
     """
     k = p["kernel"]
     n = len(k)
-    dn = lax.conv_dimension_numbers(data.shape, weight.shape, _conv_dims(k))
+    cl = _layout.channels_last() and data.ndim == n + 2
+    if cl:
+        # NCHW semantics, channels-last compute: boundary transposes
+        # cancel pairwise across conv→BN→relu→conv chains (layout.py)
+        data = _layout.to_cl(data)
+        weight = _w_to_cl(weight, n)
+    dn = lax.conv_dimension_numbers(
+        data.shape, weight.shape, _conv_dims_cl(k) if cl else _conv_dims(k))
     pad = _tup(p["pad"], n, 0)
     out = lax.conv_general_dilated(
         data, weight,
@@ -85,8 +112,8 @@ def _convolution(p, data, weight, bias=None):
         # conv transpose rule (f32 cotangent x bf16 weight).
     )
     if not p["no_bias"]:
-        out = out + bias.reshape((1, -1) + (1,) * n)
-    return out
+        out = out + (bias if cl else bias.reshape((1, -1) + (1,) * n))
+    return _layout.from_cl(out) if cl else out
 
 
 @register("Deconvolution", input_names=("data", "weight", "bias"),
@@ -107,9 +134,6 @@ def _deconvolution(p, data, weight, bias=None):
     adj = _tup(p["adj"], n, 0)
     # gradient-of-conv formulation: lhs_dilation=stride, padding k-1-p
     eff_k = tuple((k[i] - 1) * dilate[i] + 1 for i in range(n))
-    dn = lax.conv_dimension_numbers(
-        data.shape, (weight.shape[1] * p["num_group"], weight.shape[0] // p["num_group"]) + k,
-        _conv_dims(k))
     # weight layout for Deconvolution is (in_ch, out_ch/group, *k) → flip+swap
     w = jnp.flip(weight, axis=tuple(range(2, 2 + n)))
     if p["num_group"] > 1:
@@ -118,6 +142,12 @@ def _deconvolution(p, data, weight, bias=None):
         w = w.reshape((-1,) + w.shape[2:])
     else:
         w = jnp.swapaxes(w, 0, 1)
+    cl = _layout.channels_last() and data.ndim == n + 2
+    if cl:
+        data = _layout.to_cl(data)
+        w = _w_to_cl(w, n)
+    dn = lax.conv_dimension_numbers(
+        data.shape, w.shape, _conv_dims_cl(k) if cl else _conv_dims(k))
     out = lax.conv_general_dilated(
         data, w,
         window_strides=(1,) * n,
@@ -127,8 +157,8 @@ def _deconvolution(p, data, weight, bias=None):
         dimension_numbers=dn,
         feature_group_count=p["num_group"])
     if not p["no_bias"] and bias is not None:
-        out = out + bias.reshape((1, -1) + (1,) * n)
-    return out
+        out = out + (bias if cl else bias.reshape((1, -1) + (1,) * n))
+    return _layout.from_cl(out) if cl else out
 
 
 # ---------------------------------------------------------------------------
@@ -147,6 +177,10 @@ def _pooling(p, x):
         if p["pool_type"] == "sum":
             red = jnp.sum
         return red(x, axis=axes, keepdims=True)
+    cl = _layout.channels_last() and x.ndim >= 3
+    if cl:
+        x = _layout.to_cl(x)
+    sp = 1 if cl else 2  # first spatial axis
     k = _tup(p["kernel"], n)
     stride = _tup(p["stride"], n)
     pad = _tup(p["pad"], n, 0)
@@ -155,13 +189,24 @@ def _pooling(p, x):
         lo, hi = pad[i], pad[i]
         if p["pooling_convention"] == "full":
             # ceil output size: add extra high padding
-            size = x.shape[2 + i] + 2 * pad[i] - k[i]
+            size = x.shape[sp + i] + 2 * pad[i] - k[i]
             extra = (-size) % stride[i]
             hi += extra
         lo_hi.append((lo, hi))
-    window = (1, 1) + k
-    strides = (1, 1) + stride
-    padding = ((0, 0), (0, 0)) + tuple(lo_hi)
+    if cl:
+        window = (1,) + k + (1,)
+        strides = (1,) + stride + (1,)
+        padding = ((0, 0),) + tuple(lo_hi) + ((0, 0),)
+    else:
+        window = (1, 1) + k
+        strides = (1, 1) + stride
+        padding = ((0, 0), (0, 0)) + tuple(lo_hi)
+    out = _pool_impl(p, x, n, sp, k, stride, lo_hi, window, strides,
+                     padding, cl)
+    return _layout.from_cl(out) if cl else out
+
+
+def _pool_impl(p, x, n, sp, k, stride, lo_hi, window, strides, padding, cl):
     if p["pool_type"] == "max":
         # Patch-stack max instead of lax.reduce_window(max): the
         # select_and_gather_add gradient packs values into 64-bit pairs,
@@ -181,12 +226,15 @@ def _pooling(p, x):
             return lax.reduce_window(x, jnp.asarray(init, x.dtype), lax.max,
                                      window, strides, padding)
         xp = jnp.pad(x, padding, constant_values=jnp.asarray(init, x.dtype))
-        out_sz = [(xp.shape[2 + i] - k[i]) // stride[i] + 1 for i in range(n)]
+        out_sz = [(xp.shape[sp + i] - k[i]) // stride[i] + 1
+                  for i in range(n)]
         parts = []
         for offs in _itertools.product(*[range(ki) for ki in k]):
-            idx = (slice(None), slice(None)) + tuple(
+            spatial = tuple(
                 slice(offs[i], offs[i] + (out_sz[i] - 1) * stride[i] + 1,
                       stride[i]) for i in range(n))
+            idx = (slice(None),) + spatial + (slice(None),) if cl \
+                else (slice(None), slice(None)) + spatial
             parts.append(xp[idx])
         return jnp.max(jnp.stack(parts), axis=0)
     denom = 1
@@ -198,13 +246,14 @@ def _pooling(p, x):
         # linearize reduce_window_sum under jit ('Linearization failed
         # to produce known values'), so the reduce_window form would
         # break any training graph containing windowed avg pooling
-        C = x.shape[1]
-        w = jnp.ones((C, 1) + k, x.dtype)
+        C = x.shape[-1] if cl else x.shape[1]
+        w = jnp.ones((k + (1, C)) if cl else ((C, 1) + k), x.dtype)
         if p["pool_type"] != "sum":
             # reference 'valid' convention divides by the full kernel
             # size, padding included
             w = w / denom
-        dn = lax.conv_dimension_numbers(x.shape, w.shape, _conv_dims(k))
+        dn = lax.conv_dimension_numbers(
+            x.shape, w.shape, _conv_dims_cl(k) if cl else _conv_dims(k))
         return lax.conv_general_dilated(
             x, w, window_strides=stride, padding=lo_hi,
             dimension_numbers=dn, feature_group_count=C)
@@ -233,6 +282,12 @@ def _batch_norm(p, x, gamma, beta, mov_mean, mov_var):
     moving_var) which the runtime writes back into the aux NDArrays.
     """
     ax = p["axis"] % x.ndim
+    cl = _layout.channels_last() and ax == 1 and x.ndim >= 3
+    if cl:
+        # channels-last compute: the normalize chain stays in the same
+        # layout as the surrounding convs (boundary transposes cancel)
+        x = _layout.to_cl(x)
+        ax = x.ndim - 1
     red = tuple(i for i in range(x.ndim) if i != ax)
     bshape = tuple(x.shape[ax] if i == ax else 1 for i in range(x.ndim))
     train = bool(p.get("__is_train__")) and not p["use_global_stats"]
@@ -254,6 +309,8 @@ def _batch_norm(p, x, gamma, beta, mov_mean, mov_var):
         inv_std.reshape(bshape).astype(x.dtype)) * \
         g.reshape(bshape).astype(x.dtype) + \
         beta.reshape(bshape).astype(x.dtype)
+    if cl:
+        out = _layout.from_cl(out)
     return (out, mean.astype(x.dtype), var.astype(x.dtype),
             lax.stop_gradient(new_mm), lax.stop_gradient(new_mv))
 

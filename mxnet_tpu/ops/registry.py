@@ -106,7 +106,11 @@ def list_ops() -> List[str]:
 # Eager execution: cached jit per (op, params)
 # ---------------------------------------------------------------------------
 @functools.lru_cache(maxsize=None)
-def _jitted(op_name: str, params: Tuple[Tuple[str, Any], ...]):
+def _jitted(op_name: str, params: Tuple[Tuple[str, Any], ...],
+            layout: str = "NCHW"):
+    # `layout` is only a cache key: spatial ops trace
+    # mxnet_tpu.layout.conv_layout() at trace time, so a flag flip must
+    # miss the cache and re-trace
     op = OP_REGISTRY[op_name]
     pd = dict(params)
 
@@ -134,7 +138,8 @@ def apply_op(op: Operator, params: Tuple[Tuple[str, Any], ...], inputs) -> Tuple
         pd = dict(params)
         out = op.fn(pd, *inputs)
         return out if isinstance(out, tuple) else (out,)
-    return _jitted(op.name, params)(*inputs)
+    from .. import layout as _layout
+    return _jitted(op.name, params, _layout.conv_layout())(*inputs)
 
 
 def make_vjp(op: Operator, params: Tuple[Tuple[str, Any], ...], inputs):

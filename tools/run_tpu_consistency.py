@@ -41,6 +41,7 @@ def _write_artifact(completed):
         summary[r["status"]] = summary.get(r["status"], 0) + 1
     doc = {
         "mode": _STATE["mode"], "platform": _STATE["platform"],
+        "layout": _STATE.get("layout", "NCHW"),
         "started_unix": round(_STATE["t0"], 1),
         "elapsed_s": round(time.time() - _STATE["t0"], 1),
         "completed": completed, "summary": summary, "cases": res,
@@ -94,7 +95,13 @@ def main():
                          "backend init; gets 3x)")
     ap.add_argument("--only", default=None,
                     help="comma-separated case-name substrings to run")
+    ap.add_argument("--layout", default=None, choices=("NCHW", "NHWC"),
+                    help="internal spatial-op layout to validate "
+                         "(mxnet_tpu.layout); default = env/NCHW")
     args = ap.parse_args()
+    if args.layout:
+        os.environ["MXNET_TPU_CONV_LAYOUT"] = args.layout
+    _STATE["layout"] = os.environ.get("MXNET_TPU_CONV_LAYOUT", "NCHW")
     _STATE["out"] = args.out
     _STATE["mode"] = ("selftest"
                       if os.environ.get("MXT_CONSISTENCY_SELFTEST")
@@ -123,6 +130,29 @@ def main():
                   "test_transformer_lm_consistency"):
         cases.append((fname.replace("test_", ""),
                       lambda f=getattr(tc, fname): f()))
+
+    # golden-logit fixtures on the accelerator (tests/golden/*.npz; the
+    # CPU twin asserts 1e-4 in tests/test_golden_forward.py — bf16 MXU
+    # matmuls get 2e-2)
+    from mxnet_tpu.test_utils import (golden_fixture_path, golden_forward,
+                                      golden_model_cases)
+    import numpy as _np
+
+    def _golden_case(name):
+        def run():
+            ref = _np.load(golden_fixture_path(name))["logits"]
+            got = golden_forward(name)
+            err = float(_np.max(_np.abs(got - ref)))
+            scale = float(_np.max(_np.abs(ref))) or 1.0
+            tol = 1e-4 if _STATE["mode"] == "selftest" else 2e-2
+            if err > tol * scale:
+                raise AssertionError(
+                    f"golden drift {err:.2e} > {tol:.0e}*{scale:.2e}")
+            return err
+        return run
+
+    for name in sorted(golden_model_cases()):
+        cases.append((f"golden_{name}", _golden_case(name)))
 
     if args.only:
         keys = [k.strip() for k in args.only.split(",")]
