@@ -66,6 +66,13 @@ class Executor:
         self._outputs_cache: Optional[List[NDArray]] = None
         self._snapshot = None  # (arg_vals, aux_vals, key) of last forward
         self._pending_grads = None  # grads held by a train-mode forward()
+        # lazy train-mode forward (VERDICT r3 #6): until this executor's
+        # backward() is seen once, forward(is_train=True) runs ONLY the
+        # forward program — Monitor taps / MC eval never pay the vjp.
+        # After the first backward() the fused fwd+vjp runs eagerly again
+        # so the forward(); backward() training pattern stays one
+        # compiled step.
+        self._seen_backward = False
         self._remat = bool(getenv("MXNET_BACKWARD_DO_MIRROR", 0))
         # SPMD data parallelism: batch args sharded on 'dp' over the mesh,
         # params replicated; XLA all-reduces gradients over ICI.  This is the
@@ -159,12 +166,15 @@ class Executor:
         self._pending_grads = None
         if self.group2ctx:
             return self._forward_placed(arg_vals, aux_vals, key, is_train)
-        if is_train and self._grad_names:
-            # training forward: run the fused fwd+vjp program now and hold
-            # the grads for backward() — the reference's forward();
-            # backward() pattern then costs ONE compiled step, not a
-            # forward plus a recomputing vjp (default cotangents; a custom
-            # out_grads in backward() falls back to the snapshot replay)
+        if is_train and self._grad_names and self._seen_backward:
+            # training forward on an executor that trains: run the fused
+            # fwd+vjp program now and hold the grads — forward();
+            # backward() costs ONE compiled step, not a forward plus a
+            # recomputing vjp.  Until the first backward() the vjp is
+            # deferred (lazy path below): a forward-only train-mode call
+            # costs one forward, and the first backward() replays the
+            # fused program from the snapshot (same RNG key → same
+            # dropout mask; aux restored → stats not double-updated).
             ograds = [None] * len(self._plan.out_refs)
             outs, new_aux, grads = self._fwd_bwd(arg_vals, aux_vals, key,
                                                  ograds)
@@ -182,12 +192,16 @@ class Executor:
         values restored → moving stats not double-updated)."""
         if self._snapshot is None:
             raise MXNetError("backward called before forward")
+        self._seen_backward = True
         if out_grads is None and self._pending_grads is not None:
             self._deposit_grads(self._pending_grads)
             self._pending_grads = None
             return
         arg_vals, aux_vals, key = self._snapshot
-        self._run_fused(arg_vals, aux_vals, key, out_grads)
+        # replay: outputs/aux were already set by forward() — don't set
+        # them again (a Monitor would record every output stat twice)
+        self._run_fused(arg_vals, aux_vals, key, out_grads,
+                        set_results=False)
 
     def forward_backward(self, out_grads=None, **kwargs) -> List[NDArray]:
         """Fused training step: outputs + grads + aux in ONE compiled call
@@ -198,7 +212,8 @@ class Executor:
         self._run_fused(arg_vals, aux_vals, key, out_grads)
         return self._outputs_cache
 
-    def _run_fused(self, arg_vals, aux_vals, key, out_grads):
+    def _run_fused(self, arg_vals, aux_vals, key, out_grads,
+                   set_results=True):
         if out_grads is None:
             ograds = [None] * len(self._plan.out_refs)
         elif isinstance(out_grads, NDArray):
@@ -207,7 +222,8 @@ class Executor:
             ograds = [g._data if isinstance(g, NDArray) else jnp.asarray(g)
                       for g in out_grads]
         outs, new_aux, grads = self._fwd_bwd(arg_vals, aux_vals, key, ograds)
-        self._set_results(outs, new_aux)
+        if set_results:
+            self._set_results(outs, new_aux)
         self._deposit_grads(grads)
 
     def _deposit_grads(self, grads):
