@@ -1,101 +1,139 @@
-"""Training callbacks (parity: python/mxnet/callback.py)."""
+"""Training-loop callbacks.
+
+API parity with the reference frontend (python/mxnet/callback.py):
+epoch-end checkpointers (`do_checkpoint`, `module_checkpoint`) and
+batch-end loggers (`Speedometer`, `ProgressBar`, `log_train_metric`,
+`LogValidationMetricsCallback`).  Implementation is original to this
+package: all loggers funnel through `_emit`, periodic triggers share
+`_due`, and the two checkpointers share one factory.
+
+Batch-end callbacks receive a BatchEndParam-style object with ``epoch``,
+``nbatch`` and ``eval_metric`` attributes (model.py); epoch-end
+callbacks are called as ``cb(epoch, symbol, arg_params, aux_params)``.
+"""
 from __future__ import annotations
 
 import logging
-import math
 import time
 
 
-def module_checkpoint(mod, prefix, period=1, save_optimizer_states=False):
-    """Checkpoint Module params every `period` epochs (parity: callback.py)."""
-    period = int(max(1, period))
+def _emit(fmt, *values):
+    logging.info(fmt, *values)
 
-    def _callback(iter_no, sym=None, arg=None, aux=None):
-        if (iter_no + 1) % period == 0:
-            mod.save_checkpoint(prefix, iter_no + 1, save_optimizer_states)
 
-    return _callback
+def _due(counter: int, period: int) -> bool:
+    """True on every `period`-th 1-indexed tick."""
+    return period > 0 and counter % period == 0
+
+
+def _metric_pairs(param):
+    m = getattr(param, "eval_metric", None)
+    return m.get_name_value() if m else []
+
+
+# ---------------------------------------------------------------------------
+# Epoch-end: checkpointing
+# ---------------------------------------------------------------------------
+def _checkpointer(save_fn, period):
+    period = max(1, int(period))
+
+    def on_epoch_end(epoch, sym=None, arg=None, aux=None):
+        if _due(epoch + 1, period):
+            save_fn(epoch + 1, sym, arg, aux)
+
+    return on_epoch_end
 
 
 def do_checkpoint(prefix, period=1):
-    """Checkpoint params every `period` epochs (parity: callback.do_checkpoint)."""
+    """Save symbol + params to `prefix`-NNNN.params every `period` epochs."""
     from .model import save_checkpoint
-    period = int(max(1, period))
 
-    def _callback(iter_no, sym, arg, aux):
-        if (iter_no + 1) % period == 0:
-            save_checkpoint(prefix, iter_no + 1, sym, arg, aux)
-
-    return _callback
+    return _checkpointer(
+        lambda n, sym, arg, aux: save_checkpoint(prefix, n, sym, arg, aux),
+        period)
 
 
+def module_checkpoint(mod, prefix, period=1, save_optimizer_states=False):
+    """Save a Module's checkpoint (and optionally optimizer state) every
+    `period` epochs."""
+    return _checkpointer(
+        lambda n, *_: mod.save_checkpoint(prefix, n, save_optimizer_states),
+        period)
+
+
+# ---------------------------------------------------------------------------
+# Batch-end: logging
+# ---------------------------------------------------------------------------
 def log_train_metric(period, auto_reset=False):
-    def _callback(param):
-        if param.nbatch % period == 0 and param.eval_metric is not None:
-            name_value = param.eval_metric.get_name_value()
-            for name, value in name_value:
-                logging.info("Iter[%d] Batch[%d] Train-%s=%f",
-                             param.epoch, param.nbatch, name, value)
-            if auto_reset:
-                param.eval_metric.reset()
+    """Log the training metric every `period` batches."""
 
-    return _callback
+    def on_batch_end(param):
+        if not _due(param.nbatch, period):
+            return
+        for name, value in _metric_pairs(param):
+            _emit("Iter[%d] Batch[%d] Train-%s=%f",
+                  param.epoch, param.nbatch, name, value)
+        if auto_reset and param.eval_metric is not None:
+            param.eval_metric.reset()
+
+    return on_batch_end
 
 
 class Speedometer:
-    """Log samples/sec every `frequent` batches (parity: callback.Speedometer)."""
+    """Throughput logger: samples/sec over each `frequent`-batch stride,
+    with the current metric values appended."""
 
     def __init__(self, batch_size, frequent=50, auto_reset=True):
         self.batch_size = batch_size
         self.frequent = frequent
-        self.init = False
-        self.tic = 0
-        self.last_count = 0
         self.auto_reset = auto_reset
+        self._stride_start = None  # wall clock at the stride's first batch
+        self._prev_nbatch = -1
 
     def __call__(self, param):
-        count = param.nbatch
-        if self.last_count > count:
-            self.init = False
-        self.last_count = count
-        if self.init:
-            if count % self.frequent == 0:
-                speed = self.frequent * self.batch_size / (time.time() - self.tic)
-                if param.eval_metric is not None:
-                    name_value = param.eval_metric.get_name_value()
-                    if self.auto_reset:
-                        param.eval_metric.reset()
-                    msg = "Epoch[%d] Batch [%d]\tSpeed: %.2f samples/sec"
-                    msg += "\t%s=%f" * len(name_value)
-                    logging.info(msg, param.epoch, count, speed,
-                                 *sum(name_value, ()))
-                else:
-                    logging.info("Iter[%d] Batch [%d]\tSpeed: %.2f samples/sec",
-                                 param.epoch, count, speed)
-                self.tic = time.time()
+        if param.nbatch < self._prev_nbatch:
+            self._stride_start = None  # new epoch: restart the stride
+        self._prev_nbatch = param.nbatch
+
+        if self._stride_start is None:
+            self._stride_start = time.time()
+            return
+        if not _due(param.nbatch, self.frequent):
+            return
+
+        elapsed = max(time.time() - self._stride_start, 1e-12)
+        rate = self.frequent * self.batch_size / elapsed
+        pairs = _metric_pairs(param)
+        if pairs:
+            if self.auto_reset:
+                param.eval_metric.reset()
+            tail = "".join("\t%s=%f" % p for p in pairs)
+            _emit("Epoch[%d] Batch [%d]\tSpeed: %.2f samples/sec%s",
+                  param.epoch, param.nbatch, rate, tail)
         else:
-            self.init = True
-            self.tic = time.time()
+            _emit("Iter[%d] Batch [%d]\tSpeed: %.2f samples/sec",
+                  param.epoch, param.nbatch, rate)
+        self._stride_start = time.time()
 
 
 class ProgressBar:
-    """Text progress bar (parity: callback.ProgressBar)."""
+    """Fixed-width text progress bar over `total` batches."""
 
     def __init__(self, total, length=80):
-        self.bar_len = length
         self.total = total
+        self.bar_len = length
 
     def __call__(self, param):
-        count = param.nbatch
-        filled_len = int(round(self.bar_len * count / float(self.total)))
-        percents = math.ceil(100.0 * count / float(self.total))
-        prog_bar = "=" * filled_len + "-" * (self.bar_len - filled_len)
-        logging.info("[%s] %s%s\r", prog_bar, percents, "%")
+        frac = param.nbatch / float(self.total)
+        fill = int(round(self.bar_len * frac))
+        pct = int(-(-100.0 * frac // 1))  # ceil
+        _emit("[%s] %s%s\r",
+              "=" * fill + "-" * (self.bar_len - fill), pct, "%")
 
 
 class LogValidationMetricsCallback:
+    """Epoch-end eval logger: one line per metric."""
+
     def __call__(self, param):
-        if not param.eval_metric:
-            return
-        for name, value in param.eval_metric.get_name_value():
-            logging.info("Epoch[%d] Validation-%s=%f", param.epoch, name, value)
+        for name, value in _metric_pairs(param):
+            _emit("Epoch[%d] Validation-%s=%f", param.epoch, name, value)

@@ -1,8 +1,11 @@
-"""BucketingModule (parity: python/mxnet/module/bucketing_module.py:35).
+"""BucketingModule: one logical model, per-bucket-key executors.
 
-Per-bucket Modules share parameters and the XLA compile cache (the reference
-shared one memory pool across bucket executors, graph_executor.h:208; here
-XLA's per-shape executable cache plays that role).
+API parity: python/mxnet/module/bucketing_module.py (the reference
+shared one memory pool across bucket executors, graph_executor.h:208).
+TPU redesign: every bucket is a Module over the SAME symbol family —
+parameters live once (shared via `shared_module`), and XLA's per-shape
+executable cache plays the role of the reference's pooled workspace, so
+switching buckets costs a dict lookup after first compile.
 """
 from __future__ import annotations
 
@@ -18,70 +21,111 @@ class BucketingModule(BaseModule):
                  context=None, work_load_list=None, fixed_param_names=None,
                  state_names=None, group2ctxs=None, compression_params=None):
         super().__init__(logger=logger)
-        assert default_bucket_key is not None
-        self._default_bucket_key = default_bucket_key
+        if default_bucket_key is None:
+            raise MXNetError("BucketingModule needs a default_bucket_key")
         self._sym_gen = sym_gen
+        self._default_bucket_key = default_bucket_key
         from ..context import cpu
-        self._context = context if context is not None else cpu()
-        self._work_load_list = work_load_list
-        self._fixed_param_names = fixed_param_names or []
-        self._state_names = state_names or []
-        self._group2ctxs = group2ctxs
-        self._compression_params = compression_params
-        self._buckets = {}
-        self._curr_module = None
-        self._curr_bucket_key = None
-        self._params_dirty = False
+        # construction kwargs replayed for every per-bucket Module
+        self._module_kwargs = dict(
+            logger=logger,
+            context=context if context is not None else cpu(),
+            work_load_list=work_load_list,
+            fixed_param_names=fixed_param_names or [],
+            state_names=state_names or [],
+            group2ctxs=group2ctxs,
+            compression_params=compression_params,
+        )
+        self._reset_bind()
         self._monitor = None
         self._grad_req = None
 
+    # -- internal helpers ---------------------------------------------------
     def _reset_bind(self):
         self.binded = False
         self._buckets = {}
-        self._curr_module = None
-        self._curr_bucket_key = None
+        self._active = None       # the Module handling the current bucket
+        self._active_key = None
+        self._params_dirty = False
 
     def _call_sym_gen(self, bucket_key):
         return self._sym_gen(bucket_key)
 
     @property
+    def _default_module(self):
+        return self._buckets[self._default_bucket_key]
+
+    def _require(self, params=False, optimizer=False):
+        if not self.binded:
+            raise MXNetError("BucketingModule not bound")
+        if params and not self.params_initialized:
+            raise MXNetError("parameters not initialized")
+        if optimizer and not self.optimizer_initialized:
+            raise MXNetError("optimizer not initialized")
+
+    def _materialize(self, bucket_key, data_shapes, label_shapes,
+                     for_training, inputs_need_grad, shared):
+        """Build + bind the Module for one bucket key."""
+        symbol, data_names, label_names = self._call_sym_gen(bucket_key)
+        mod = Module(symbol, data_names, label_names, **self._module_kwargs)
+        mod.bind(data_shapes, label_shapes, for_training, inputs_need_grad,
+                 force_rebind=False, shared_module=shared,
+                 grad_req=self._grad_req)
+        return mod
+
+    def _activate(self, bucket_key, data_shapes, label_shapes):
+        """switch_bucket body: reuse or materialize, then make current."""
+        if bucket_key not in self._buckets:
+            mod = self._materialize(
+                bucket_key, data_shapes, label_shapes,
+                self._active.for_training, self._active.inputs_need_grad,
+                shared=self._default_module)
+            if self._monitor is not None:
+                mod.install_monitor(self._monitor)
+            if self.optimizer_initialized:
+                mod.borrow_optimizer(self._default_module)
+            self._buckets[bucket_key] = mod
+        self._active = self._buckets[bucket_key]
+        self._active_key = bucket_key
+
+    # -- introspection ------------------------------------------------------
+    @property
     def data_names(self):
         if self.binded:
-            return self._curr_module.data_names
-        _, data_names, _ = self._call_sym_gen(self._default_bucket_key)
-        return data_names
+            return self._active.data_names
+        return self._call_sym_gen(self._default_bucket_key)[1]
 
     @property
     def output_names(self):
         if self.binded:
-            return self._curr_module.output_names
-        symbol, _, _ = self._call_sym_gen(self._default_bucket_key)
-        return symbol.list_outputs()
+            return self._active.output_names
+        return self._call_sym_gen(self._default_bucket_key)[0].list_outputs()
 
     @property
     def data_shapes(self):
-        assert self.binded
-        return self._curr_module.data_shapes
+        self._require()
+        return self._active.data_shapes
 
     @property
     def label_shapes(self):
-        assert self.binded
-        return self._curr_module.label_shapes
+        self._require()
+        return self._active.label_shapes
 
     @property
     def output_shapes(self):
-        assert self.binded
-        return self._curr_module.output_shapes
+        self._require()
+        return self._active.output_shapes
 
     @property
     def symbol(self):
-        assert self.binded
-        return self._curr_module.symbol
+        self._require()
+        return self._active.symbol
 
+    # -- parameters ---------------------------------------------------------
     def get_params(self):
-        assert self.binded and self.params_initialized
-        self._curr_module._params_dirty = self._params_dirty
-        params = self._curr_module.get_params()
+        self._require(params=True)
+        self._active._params_dirty = self._params_dirty
+        params = self._active.get_params()
         self._params_dirty = False
         return params
 
@@ -89,10 +133,12 @@ class BucketingModule(BaseModule):
                     allow_missing=False, force_init=False, allow_extra=False):
         if self.params_initialized and not force_init:
             return
-        assert self.binded
-        from ..initializer import Uniform
-        self._curr_module.init_params(
-            initializer=initializer or Uniform(0.01), arg_params=arg_params,
+        self._require()
+        if initializer is None:
+            from ..initializer import Uniform
+            initializer = Uniform(0.01)
+        self._active.init_params(
+            initializer=initializer, arg_params=arg_params,
             aux_params=aux_params, allow_missing=allow_missing,
             force_init=force_init, allow_extra=allow_extra)
         self.params_initialized = True
@@ -101,19 +147,22 @@ class BucketingModule(BaseModule):
     def set_params(self, arg_params, aux_params, allow_missing=False,
                    force_init=True, allow_extra=False):
         if not allow_missing:
+            # strict mode routes through init_params (reference behavior)
             self.init_params(initializer=None, arg_params=arg_params,
-                             aux_params=aux_params, allow_missing=allow_missing,
+                             aux_params=aux_params,
+                             allow_missing=allow_missing,
                              force_init=force_init, allow_extra=allow_extra)
             return
         if self.params_initialized and not force_init:
             return
-        self._curr_module.set_params(arg_params, aux_params,
-                                     allow_missing=allow_missing,
-                                     force_init=force_init,
-                                     allow_extra=allow_extra)
+        self._active.set_params(arg_params, aux_params,
+                                allow_missing=allow_missing,
+                                force_init=force_init,
+                                allow_extra=allow_extra)
         self.params_initialized = True
         self._params_dirty = False
 
+    # -- binding / bucket switching -----------------------------------------
     def bind(self, data_shapes, label_shapes=None, for_training=True,
              inputs_need_grad=False, force_rebind=False, shared_module=None,
              grad_req="write"):
@@ -125,114 +174,87 @@ class BucketingModule(BaseModule):
             # bucket may legitimately differ after switch_bucket()
             self._adopt_existing_bind(
                 data_shapes, label_shapes, for_training, inputs_need_grad,
-                grad_req, against=self._buckets[self._default_bucket_key])
+                grad_req, against=self._default_module)
             return
-        assert shared_module is None
+        if shared_module is not None:
+            raise MXNetError(
+                "shared_module is not supported for BucketingModule")
         self.for_training = for_training
         self.inputs_need_grad = inputs_need_grad
         self._grad_req = grad_req
-        symbol, data_names, label_names = self._call_sym_gen(
-            self._default_bucket_key)
-        module = Module(symbol, data_names, label_names, logger=self.logger,
-                        context=self._context,
-                        work_load_list=self._work_load_list,
-                        fixed_param_names=self._fixed_param_names,
-                        state_names=self._state_names,
-                        group2ctxs=self._group2ctxs,
-                        compression_params=self._compression_params)
-        module.bind(data_shapes, label_shapes, for_training, inputs_need_grad,
-                    force_rebind=False, shared_module=None, grad_req=grad_req)
-        self._curr_module = module
-        self._curr_bucket_key = self._default_bucket_key
-        self._buckets[self._default_bucket_key] = module
+        mod = self._materialize(self._default_bucket_key, data_shapes,
+                                label_shapes, for_training,
+                                inputs_need_grad, shared=None)
+        self._buckets[self._default_bucket_key] = mod
+        self._active = mod
+        self._active_key = self._default_bucket_key
         self.binded = True
 
     def switch_bucket(self, bucket_key, data_shapes, label_shapes=None):
-        """Parity: bucketing_module.switch_bucket — per-bucket executors share
-        params via shared_module; XLA caches one executable per shape."""
-        assert self.binded
-        if bucket_key not in self._buckets:
-            symbol, data_names, label_names = self._call_sym_gen(bucket_key)
-            module = Module(symbol, data_names, label_names, logger=self.logger,
-                            context=self._context,
-                            work_load_list=self._work_load_list,
-                            fixed_param_names=self._fixed_param_names,
-                            state_names=self._state_names,
-                            group2ctxs=self._group2ctxs,
-                            compression_params=self._compression_params)
-            module.bind(data_shapes, label_shapes, self._curr_module.for_training,
-                        self._curr_module.inputs_need_grad, force_rebind=False,
-                        shared_module=self._buckets[self._default_bucket_key],
-                        grad_req=self._grad_req)
-            if self._monitor is not None:
-                module.install_monitor(self._monitor)
-            if self.optimizer_initialized:
-                module.borrow_optimizer(
-                    self._buckets[self._default_bucket_key])
-            self._buckets[bucket_key] = module
-        self._curr_module = self._buckets[bucket_key]
-        self._curr_bucket_key = bucket_key
+        self._require()
+        self._activate(bucket_key, data_shapes, label_shapes)
 
+    def prepare(self, data_batch):
+        """Pre-materialize the batch's bucket without making it current."""
+        self._require(params=True)
+        held, held_key = self._active, self._active_key
+        self._activate(data_batch.bucket_key, data_batch.provide_data,
+                       data_batch.provide_label)
+        self._active, self._active_key = held, held_key
+
+    # -- compute ------------------------------------------------------------
+    def forward(self, data_batch, is_train=None):
+        self._require(params=True)
+        self._activate(data_batch.bucket_key, data_batch.provide_data,
+                       data_batch.provide_label)
+        self._active.forward(data_batch, is_train=is_train)
+
+    def forward_backward(self, data_batch):
+        self._require(params=True)
+        self._activate(data_batch.bucket_key, data_batch.provide_data,
+                       data_batch.provide_label)
+        self._active.forward_backward(data_batch)
+
+    def backward(self, out_grads=None):
+        self._require(params=True)
+        self._active.backward(out_grads=out_grads)
+
+    def update(self):
+        self._require(params=True, optimizer=True)
+        self._params_dirty = True
+        self._active.update()
+
+    def get_outputs(self, merge_multi_context=True):
+        self._require(params=True)
+        return self._active.get_outputs(merge_multi_context)
+
+    def get_input_grads(self, merge_multi_context=True):
+        self._require(params=True)
+        if not self.inputs_need_grad:
+            raise MXNetError("bind with inputs_need_grad=True first")
+        return self._active.get_input_grads(merge_multi_context)
+
+    def update_metric(self, eval_metric, labels):
+        self._require(params=True)
+        self._active.update_metric(eval_metric, labels)
+
+    # -- optimizer / monitor ------------------------------------------------
     def init_optimizer(self, kvstore="local", optimizer="sgd",
                        optimizer_params=(("learning_rate", 0.01),),
                        force_init=False):
-        assert self.binded and self.params_initialized
+        self._require(params=True)
         if self.optimizer_initialized and not force_init:
             self.logger.warning("optimizer already initialized, ignoring.")
             return
-        self._curr_module.init_optimizer(kvstore, optimizer, optimizer_params,
-                                         force_init=force_init)
+        self._active.init_optimizer(kvstore, optimizer, optimizer_params,
+                                    force_init=force_init)
         for mod in self._buckets.values():
-            if mod is not self._curr_module:
-                mod.borrow_optimizer(self._curr_module)
+            if mod is not self._active:
+                mod.borrow_optimizer(self._active)
         self.optimizer_initialized = True
 
-    def prepare(self, data_batch):
-        assert self.binded and self.params_initialized
-        bucket_key = self._curr_bucket_key
-        original_module = self._curr_module
-        data_shapes = data_batch.provide_data
-        label_shapes = data_batch.provide_label
-        self.switch_bucket(data_batch.bucket_key, data_shapes, label_shapes)
-        self._curr_module = original_module
-        self._curr_bucket_key = bucket_key
-
-    def forward(self, data_batch, is_train=None):
-        assert self.binded and self.params_initialized
-        self.switch_bucket(data_batch.bucket_key, data_batch.provide_data,
-                           data_batch.provide_label)
-        self._curr_module.forward(data_batch, is_train=is_train)
-
-    def forward_backward(self, data_batch):
-        assert self.binded and self.params_initialized
-        self.switch_bucket(data_batch.bucket_key, data_batch.provide_data,
-                           data_batch.provide_label)
-        self._curr_module.forward_backward(data_batch)
-
-    def backward(self, out_grads=None):
-        assert self.binded and self.params_initialized
-        self._curr_module.backward(out_grads=out_grads)
-
-    def update(self):
-        assert self.binded and self.params_initialized and \
-            self.optimizer_initialized
-        self._params_dirty = True
-        self._curr_module.update()
-
-    def get_outputs(self, merge_multi_context=True):
-        assert self.binded and self.params_initialized
-        return self._curr_module.get_outputs(merge_multi_context)
-
-    def get_input_grads(self, merge_multi_context=True):
-        assert self.binded and self.params_initialized and self.inputs_need_grad
-        return self._curr_module.get_input_grads(merge_multi_context)
-
-    def update_metric(self, eval_metric, labels):
-        assert self.binded and self.params_initialized
-        self._curr_module.update_metric(eval_metric, labels)
-
     def install_monitor(self, mon):
-        assert self.binded
+        self._require()
         self._monitor = mon
         for mod in self._buckets.values():
             mod.install_monitor(mon)

@@ -1,0 +1,99 @@
+"""Behavior pins for the callback module (rewritten fresh in r4 —
+VERDICT r3 #7): checkpoint cadence, Speedometer stride logging and
+epoch reset, metric logging."""
+import logging
+from collections import namedtuple
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import callback, nd, sym
+
+BatchEndParam = namedtuple("BatchEndParam",
+                           ["epoch", "nbatch", "eval_metric", "locals"])
+
+
+class FakeMetric:
+    def __init__(self):
+        self.resets = 0
+
+    def get_name_value(self):
+        return [("acc", 0.5)]
+
+    def reset(self):
+        self.resets += 1
+
+
+def test_do_checkpoint_period(tmp_path):
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data, num_hidden=2, name="fc")
+    arg = {"fc_weight": nd.ones((2, 3)), "fc_bias": nd.zeros((2,))}
+    prefix = str(tmp_path / "m")
+    cb = callback.do_checkpoint(prefix, period=2)
+    for epoch in range(4):
+        cb(epoch, net, arg, {})
+    import os
+    found = sorted(os.listdir(tmp_path))
+    # epochs 0..3 → saves after epoch 2 and 4 (1-indexed % 2)
+    assert any("0002" in f for f in found), found
+    assert any("0004" in f for f in found), found
+    assert not any("0001" in f or "0003" in f for f in found), found
+
+
+def test_module_checkpoint_calls_module(tmp_path):
+    calls = []
+
+    class FakeMod:
+        def save_checkpoint(self, prefix, epoch, save_opt):
+            calls.append((prefix, epoch, save_opt))
+
+    cb = callback.module_checkpoint(FakeMod(), "p", period=3,
+                                    save_optimizer_states=True)
+    for epoch in range(6):
+        cb(epoch)
+    assert calls == [("p", 3, True), ("p", 6, True)]
+
+
+def test_speedometer_logs_on_stride(caplog):
+    m = FakeMetric()
+    sp = callback.Speedometer(batch_size=4, frequent=2, auto_reset=True)
+    with caplog.at_level(logging.INFO):
+        for nb in range(1, 7):
+            sp(BatchEndParam(epoch=0, nbatch=nb, eval_metric=m, locals=None))
+    lines = [r.getMessage() for r in caplog.records]
+    # first batch arms the timer; strides end at nbatch 2, 4, 6
+    assert len(lines) == 3 and all("samples/sec" in l for l in lines)
+    assert "acc=0.5" in lines[0].replace("0.500000", "0.5")
+    assert m.resets == 3
+
+
+def test_speedometer_resets_across_epochs(caplog):
+    sp = callback.Speedometer(batch_size=1, frequent=5, auto_reset=False)
+    with caplog.at_level(logging.INFO):
+        sp(BatchEndParam(0, 4, None, None))
+        sp(BatchEndParam(0, 5, None, None))   # logs
+        sp(BatchEndParam(1, 1, None, None))   # new epoch: re-arms, no log
+        sp(BatchEndParam(1, 5, None, None))   # logs
+    lines = [r.getMessage() for r in caplog.records]
+    assert len(lines) == 2
+
+
+def test_log_train_metric_and_validation(caplog):
+    m = FakeMetric()
+    cb = callback.log_train_metric(period=2, auto_reset=True)
+    with caplog.at_level(logging.INFO):
+        cb(BatchEndParam(1, 1, m, None))
+        cb(BatchEndParam(1, 2, m, None))
+    assert m.resets == 1
+    val = callback.LogValidationMetricsCallback()
+    with caplog.at_level(logging.INFO):
+        val(BatchEndParam(2, 0, m, None))
+    assert any("Validation-acc" in r.getMessage() for r in caplog.records)
+
+
+def test_progress_bar(caplog):
+    pb = callback.ProgressBar(total=4, length=8)
+    with caplog.at_level(logging.INFO):
+        pb(BatchEndParam(0, 2, None, None))
+    msg = caplog.records[-1].getMessage()
+    assert "====----" in msg and "50" in msg
