@@ -1,10 +1,17 @@
 """Multi-process distributed kvstore CI (parity model:
 tests/nightly/dist_sync_kvstore.py run via tools/launch.py -n 2
---launcher local — real separate processes, cross-process collectives)."""
+--launcher local — real separate processes, cross-process collectives).
+
+Parameterized over devices-per-process (VERDICT r3 #3): local=1 is the
+degenerate mesh; local=2 exercises the (hosts, local) stitch in
+allreduce_hosts_many / allgather_rows_many the way real TPU hosts
+(4-8 chips each) would."""
 import os
 import socket
 import subprocess
 import sys
+
+import pytest
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -17,9 +24,18 @@ def _free_port():
     return port
 
 
-def test_dist_sync_kvstore_two_processes():
-    env = {**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONPATH": REPO}
+@pytest.mark.parametrize("local_devices", [1, 2])
+def test_dist_sync_kvstore_two_processes(local_devices):
+    env = {**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONPATH": REPO,
+           "MXT_EXPECT_LOCAL_DEVICES": str(local_devices)}
     env.pop("MXT_COORDINATOR", None)
+    # the workers' own XLA must carve out local_devices CPU devices each
+    # (replace any inherited device-count flag — the parent test process
+    # forced 8 for itself — but keep other XLA flags)
+    inherited = [f for f in os.environ.get("XLA_FLAGS", "").split()
+                 if "host_platform_device_count" not in f]
+    env["XLA_FLAGS"] = " ".join(
+        inherited + [f"--xla_force_host_platform_device_count={local_devices}"])
     proc = subprocess.run(
         [sys.executable, os.path.join(REPO, "tools", "launch.py"),
          "-n", "2", "--coordinator", f"127.0.0.1:{_free_port()}",
