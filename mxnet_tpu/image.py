@@ -107,7 +107,7 @@ def center_crop(src, size, interp=2):
 
 
 def color_normalize(src, mean, std=None):
-    arr = _as_np(src).astype(_np.float32)
+    arr = _as_np(src).astype(_np.float32, copy=False)
     if mean is not None:
         arr = arr - _as_np(mean).astype(_np.float32)
     if std is not None:
@@ -201,7 +201,7 @@ class ContrastJitterAug(Augmenter):
 
     def __call__(self, src):
         alpha = 1.0 + _pyrandom.uniform(-self.contrast, self.contrast)
-        arr = _as_np(src).astype(_np.float32)
+        arr = _as_np(src).astype(_np.float32, copy=False)
         coef = _np.array([[[0.299, 0.587, 0.114]]], _np.float32)
         gray = float((arr * coef).sum() * (3.0 / arr.size))
         return [_like(arr * alpha + gray * (1.0 - alpha), src)]
@@ -216,7 +216,7 @@ class SaturationJitterAug(Augmenter):
 
     def __call__(self, src):
         alpha = 1.0 + _pyrandom.uniform(-self.saturation, self.saturation)
-        arr = _as_np(src).astype(_np.float32)
+        arr = _as_np(src).astype(_np.float32, copy=False)
         coef = _np.array([[[0.299, 0.587, 0.114]]], _np.float32)
         gray = (arr * coef).sum(axis=2, keepdims=True)
         return [_like(arr * alpha + gray * (1.0 - alpha), src)]
@@ -254,7 +254,7 @@ class RandomGrayAug(Augmenter):
 
     def __call__(self, src):
         if _pyrandom.random() < self.p:
-            arr = _as_np(src).astype(_np.float32)
+            arr = _as_np(src).astype(_np.float32, copy=False)
             coef = _np.array([[[0.299, 0.587, 0.114]]], _np.float32)
             gray = (arr * coef).sum(axis=2, keepdims=True)
             src = _like(_np.repeat(gray, 3, axis=2), src)
@@ -272,7 +272,7 @@ class ColorNormalizeAug(Augmenter):
             else (1.0 / _np.asarray(_as_np(std), _np.float32))
 
     def __call__(self, src):
-        arr = _as_np(src).astype(_np.float32)
+        arr = _as_np(src).astype(_np.float32, copy=False)
         if self.mean is not None:
             arr = arr - self.mean
         if self._inv_std is not None:
@@ -491,7 +491,7 @@ class ImageRecordIterPy(ImageIter):
                               std=std_arr)
         super().__init__(batch_size, data_shape, label_width,
                          path_imgrec=path_imgrec, shuffle=shuffle,
-                         aug_list=aug)
+                         aug_list=aug, **kwargs)
 
 
 # -- detection pipeline (parity: python/mxnet/image/detection.py namespace:

@@ -533,6 +533,7 @@ def ImageRecordIter(path_imgrec, data_shape, batch_size, label_width=1,
                            mean=(mean_r, mean_g, mean_b),
                            std=(std_r, std_g, std_b),
                            rand_crop=rand_crop, rand_mirror=rand_mirror,
+                           preprocess_threads=preprocess_threads,
                            **kwargs)
     return PrefetchingIter(it, depth=int(prefetch_buffer))
 
