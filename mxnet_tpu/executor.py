@@ -74,6 +74,17 @@ class Executor:
         # compiled step.
         self._seen_backward = False
         self._remat = bool(getenv("MXNET_BACKWARD_DO_MIRROR", 0))
+        # rows-only embedding grads (VERDICT r3 #8): args eligible for
+        # the in-graph rsp rewrite — weight of Embedding(sparse_grad)
+        # steps, grad_req 'write', no remat/group2ctx interplay.  The
+        # fused program differentiates an injected zero 'dummy' of the
+        # lookup's OUTPUT shape instead of the O(vocab) weight, so the
+        # dense V×D gradient buffer never exists on device.
+        self._rsp_grad_args = {}
+        if not self._remat and not group2ctx:
+            for n, lst in self._plan.sparse_grad_args().items():
+                if self.grad_req.get(n) == "write":
+                    self._rsp_grad_args[n] = tuple(lst)
         # SPMD data parallelism: batch args sharded on 'dp' over the mesh,
         # params replicated; XLA all-reduces gradients over ICI.  This is the
         # TPU redesign of DataParallelExecutorGroup (SURVEY.md §2.3).
@@ -105,28 +116,70 @@ class Executor:
 
     @property
     def _fwd_bwd(self):
-        key = ("fwd_bwd", self._plan_key, tuple(self._grad_names))
+        key = ("fwd_bwd", self._plan_key, tuple(self._grad_names),
+               tuple(sorted(self._rsp_grad_args)))
         if key not in self._jit_cache:
             plan = self._plan
-            grad_names = list(self._grad_names)
+            rsp_map = dict(self._rsp_grad_args)
+            grad_names = [n for n in self._grad_names if n not in rsp_map]
             remat = self._remat
 
             def fb(arg_vals, aux_vals, key_, ograds):
                 others = {k: v for k, v in arg_vals.items() if k not in grad_names}
+                # one zero dummy per sparse-embedding step, shaped like
+                # the lookup OUTPUT (tokens × dim, not vocab × dim)
+                dummies = {}
+                for n, lst in sorted(rsp_map.items()):
+                    w = arg_vals[n]
+                    for si, dvar in lst:
+                        dummies[si] = jnp.zeros(
+                            tuple(arg_vals[dvar].shape) + tuple(w.shape[1:]),
+                            w.dtype)
 
-                def fwd(gvals):
+                def fwd(gvals, dums):
                     merged = dict(others)
                     merged.update(gvals)
-                    return plan.run(merged, aux_vals, key_, True)
+                    overrides, ids_out = {}, {}
+
+                    def make_ov(si):
+                        def ov(p, ins):
+                            # clip BEFORE recording: the recorded ids are
+                            # the rsp row indices, and an unclipped OOB id
+                            # would drop/misroute its gradient where the
+                            # dense vjp of take(mode='clip') scatters it
+                            # into the clipped row
+                            ids = jnp.clip(ins[0].astype(jnp.int32), 0,
+                                           ins[1].shape[0] - 1)
+                            ids_out[si] = ids
+                            return (jnp.take(
+                                jax.lax.stop_gradient(ins[1]), ids,
+                                axis=0) + dums[si],)
+                        return ov
+
+                    for n, lst in rsp_map.items():
+                        for si, _ in lst:
+                            overrides[si] = make_ov(si)
+                    res = plan.run(merged, aux_vals, key_, True,
+                                   step_overrides=overrides or None)
+                    return res, ids_out
 
                 f = jax.checkpoint(fwd) if remat else fwd
-                (outs, new_aux), vjp_fn = jax.vjp(
-                    f, {n: arg_vals[n] for n in grad_names})
+                (outs, new_aux), vjp_fn, ids_out = jax.vjp(
+                    f, {n: arg_vals[n] for n in grad_names}, dummies,
+                    has_aux=True)
                 cots = [og if og is not None else jnp.ones(o.shape, o.dtype)
                         for og, o in zip(ograds, outs)]
                 zero_aux = jax.tree_util.tree_map(jnp.zeros_like, new_aux)
-                grads = vjp_fn((cots, zero_aux))[0]
-                return outs, new_aux, grads
+                grads, gdum = vjp_fn((cots, zero_aux))
+                rsp_grads = {}
+                for n, lst in sorted(rsp_map.items()):
+                    rowdim = tuple(arg_vals[n].shape[1:])
+                    ids = jnp.concatenate(
+                        [ids_out[si].reshape(-1) for si, _ in lst])
+                    vals = jnp.concatenate(
+                        [gdum[si].reshape((-1,) + rowdim) for si, _ in lst])
+                    rsp_grads[n] = (ids, vals)
+                return outs, new_aux, grads, rsp_grads
 
             self._jit_cache[key] = jax.jit(fb)
         return self._jit_cache[key]
@@ -176,10 +229,10 @@ class Executor:
             # fused program from the snapshot (same RNG key → same
             # dropout mask; aux restored → stats not double-updated).
             ograds = [None] * len(self._plan.out_refs)
-            outs, new_aux, grads = self._fwd_bwd(arg_vals, aux_vals, key,
-                                                 ograds)
+            outs, new_aux, grads, rsp_grads = self._fwd_bwd(
+                arg_vals, aux_vals, key, ograds)
             self._set_results(outs, new_aux)
-            self._pending_grads = grads
+            self._pending_grads = (grads, rsp_grads)
             return self._outputs_cache
         outs, new_aux = self._fwd(arg_vals, aux_vals, key, is_train)
         self._set_results(outs, new_aux)
@@ -194,7 +247,7 @@ class Executor:
             raise MXNetError("backward called before forward")
         self._seen_backward = True
         if out_grads is None and self._pending_grads is not None:
-            self._deposit_grads(self._pending_grads)
+            self._deposit_grads(*self._pending_grads)
             self._pending_grads = None
             return
         arg_vals, aux_vals, key = self._snapshot
@@ -221,13 +274,30 @@ class Executor:
         else:
             ograds = [g._data if isinstance(g, NDArray) else jnp.asarray(g)
                       for g in out_grads]
-        outs, new_aux, grads = self._fwd_bwd(arg_vals, aux_vals, key, ograds)
+        outs, new_aux, grads, rsp_grads = self._fwd_bwd(
+            arg_vals, aux_vals, key, ograds)
         if set_results:
             self._set_results(outs, new_aux)
-        self._deposit_grads(grads)
+        self._deposit_grads(grads, rsp_grads)
 
-    def _deposit_grads(self, grads):
+    def _deposit_grads(self, grads, rsp_grads=None):
+        from .ndarray.sparse import RowSparseNDArray
+        for name, (ids, vals) in (rsp_grads or {}).items():
+            tgt = self.grad_dict.get(name)
+            if tgt is None:
+                continue
+            if isinstance(tgt, RowSparseNDArray):
+                # rows-only deposit; duplicate token rows segment-sum in
+                # the constructor's dedup (grad_req 'write')
+                tgt._assign_rows(ids, vals.astype(tgt.dtype))
+            else:
+                # caller bound a dense grad buffer: honor it (dense
+                # scatter at the boundary, still no dense grad in-graph)
+                tgt._set_data(jnp.zeros(tgt.shape, tgt.dtype).at[ids].add(
+                    vals.astype(tgt.dtype)))
         for name in self._grad_names:
+            if rsp_grads and name in rsp_grads:
+                continue
             g = grads[name]
             tgt = self.grad_dict.get(name)
             if tgt is None:
@@ -292,10 +362,16 @@ class Executor:
     def copy_params_from(self, arg_params, aux_params=None,
                          allow_extra_params: bool = False) -> None:
         def _assign(tgt: NDArray, v):
+            if v._data is tgt._data:
+                # pointer-handoff roundtrip (fit()'s per-epoch
+                # get_params/set_params): already the same buffer
+                return
             # preserve the target's sharding (mesh-replicated stay replicated)
             sh = getattr(tgt._data, "sharding", None)
             data = v._data.astype(tgt.dtype)
-            tgt._set_data(jax.device_put(data, sh) if sh is not None else data)
+            if sh is not None and getattr(data, "sharding", None) != sh:
+                data = jax.device_put(data, sh)
+            tgt._set_data(data)
 
         for k, v in (arg_params or {}).items():
             if k in self.arg_dict:

@@ -539,15 +539,21 @@ def _make_loss_core(pt, data):
 
 
 def _make_loss_fwd(pt, data):
-    return data, data.shape
+    # 0-size carrier keeps shape AND dtype in the residual (a bare
+    # np.dtype is not a jax type)
+    return data, (data.shape, jnp.zeros((0,), data.dtype))
 
 
-def _make_loss_bwd(pt, shape, g):
+def _make_loss_bwd(pt, res, g):
+    shape, carrier = res
     p = dict(pt)
     scale = p["grad_scale"]
     if p["normalization"] == "batch":
         scale = scale / shape[0]
-    return (jnp.full(shape, scale),)
+    # explicit dtype: a bare python float would make jnp.full emit f64
+    # under jax_enable_x64, poisoning every upstream vjp with dtype
+    # mismatches (lax.div f64 vs f32)
+    return (jnp.full(shape, scale, carrier.dtype),)
 
 
 _make_loss_core.defvjp(_make_loss_fwd, _make_loss_bwd)

@@ -886,6 +886,20 @@ class FusedUpdater(Updater):
                 g = g if isinstance(g, NDArray) else NDArray(g, w.context)
                 self(i, g, w)
             return
+        from .ndarray.sparse import RowSparseNDArray
+        if any(isinstance(g, RowSparseNDArray) for g in grads):
+            # rsp grads take the rows-only lazy path (reading ._data here
+            # would densify the O(vocab) gradient the executor just kept
+            # rows-only); dense keys stay in the fused multi-tensor trace
+            dense = [(i, g, w) for i, g, w in zip(indices, grads, weights)
+                     if not isinstance(g, RowSparseNDArray)]
+            for i, g, w in zip(indices, grads, weights):
+                if isinstance(g, RowSparseNDArray):
+                    self(i, g, w)
+            if dense:
+                di, dg, dw = zip(*dense)
+                self.update_all(list(di), list(dg), list(dw))
+            return
         indices = list(indices)
         for i, w in zip(indices, weights):
             self._ensure_state(i, w)

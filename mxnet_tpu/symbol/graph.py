@@ -185,6 +185,31 @@ class GraphPlan:
             else:
                 self.out_refs.append(("val", (ref[1], oi)))
 
+    def sparse_grad_args(self) -> Dict[str, list]:
+        """Arg names whose gradient the executor can produce ROWS-ONLY:
+        variables used exclusively as the weight of
+        Embedding(sparse_grad=True) steps whose data input is itself a
+        graph input (the Module-API sparse-embedding pattern; parity:
+        indexing_op.h rsp EmbeddingOpBackward + infer-storage making the
+        weight grad row_sparse).  Returns {name: [(step_idx, data_var)]}.
+        """
+        users: Dict[str, list] = {}
+        for si, s in enumerate(self.steps):
+            for pos, ref in enumerate(s.in_refs):
+                if ref[0] == "var":
+                    users.setdefault(ref[1], []).append((si, s, pos))
+        direct_outs = {r[1] for r in self.out_refs if r[0] == "var"}
+        out = {}
+        for name, us in users.items():
+            if name in direct_outs:
+                continue
+            if all(s.op.name == "Embedding" and pos == 1
+                   and bool(s.params.get("sparse_grad"))
+                   and s.in_refs[0][0] == "var"
+                   for _, s, pos in us):
+                out[name] = [(si, s.in_refs[0][1]) for si, s, _ in us]
+        return out
+
     def specialize_init_shapes(self, known_shapes: Dict[str, tuple]) -> None:
         """Resolve 0-dims in init-op shape params (rnn begin_state) against
         the bound arg shapes — the bind-time leg of the candidate
@@ -207,8 +232,12 @@ class GraphPlan:
 
     # -- execution (pure; call under jit) -----------------------------------
     def run(self, arg_values: Dict[str, Any], aux_values: Dict[str, Any],
-            key, is_train: bool):
-        """Execute the graph. Returns (outputs, new_aux_values)."""
+            key, is_train: bool, step_overrides=None):
+        """Execute the graph. Returns (outputs, new_aux_values).
+
+        `step_overrides` maps step index -> fn(params, inputs) returning
+        the step's output tuple (the executor's rows-only embedding-grad
+        rewrite rides this hook)."""
         values: List[Tuple] = [None] * len(self.steps)
         new_aux = dict(aux_values)
 
@@ -230,7 +259,10 @@ class GraphPlan:
                 p["__is_train__"] = is_train
             if step.op.needs_rng:
                 ins.append(jax.random.fold_in(key, si))
-            out = step.op.fn(p, *ins)
+            if step_overrides and si in step_overrides:
+                out = step_overrides[si](p, ins)
+            else:
+                out = step.op.fn(p, *ins)
             out = out if isinstance(out, tuple) else (out,)
             n_vis = len(out) - len(step.op.aux_inputs)
             values[si] = out[:n_vis]
