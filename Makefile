@@ -6,7 +6,7 @@ SRCS := src/runtime/storage.cc src/runtime/engine.cc \
         src/runtime/recordio.cc src/runtime/prefetch.cc
 LIB := mxnet_tpu/_native/libmxtpu_runtime.so
 
-.PHONY: native test clean
+.PHONY: native test clean cpp_example
 
 native: $(LIB)
 
@@ -14,8 +14,20 @@ $(LIB): $(SRCS) src/runtime/mxt_runtime.h
 	@mkdir -p mxnet_tpu/_native
 	$(CXX) $(CXXFLAGS) -shared -o $@ $(SRCS)
 
+# C++ consumer of the native runtime (cpp-package analog): predict-only
+# MLP from a python-trained checkpoint, streamed via the batch loader.
+CPP_EX := cpp-package/example/mlp_predict
+
+cpp_example: $(CPP_EX)
+
+$(CPP_EX): cpp-package/example/mlp_predict.cc $(LIB) \
+           $(wildcard cpp-package/include/mxnet_tpu_cpp/*.hpp)
+	$(CXX) $(CXXFLAGS) -o $@ $< \
+	    -Lmxnet_tpu/_native -lmxtpu_runtime \
+	    -Wl,-rpath,'$$ORIGIN/../../mxnet_tpu/_native'
+
 test: native
 	python -m pytest tests/ -x -q
 
 clean:
-	rm -f $(LIB)
+	rm -f $(LIB) $(CPP_EX)

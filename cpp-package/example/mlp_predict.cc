@@ -1,0 +1,75 @@
+// mlp_predict — predict-only MLP from a mxnet_tpu checkpoint, pure C++.
+//
+// Parity: cpp-package/example/mlp.cpp (the reference's C++ MLP demo).
+// Streams fixed-size f32 feature records from a .rec via the native
+// threaded batch loader (src/runtime/prefetch.cc), runs the dense MLP
+// from cpp-package/include/mxnet_tpu_cpp/mlp.hpp, prints accuracy and
+// the first batch's logits (for the CI parity check against python).
+//
+//   mlp_predict <params.npz> <data.rec> <fc1,fc2,...> <feature_dim> [batch]
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <sstream>
+#include <string>
+#include <vector>
+
+#include "../include/mxnet_tpu_cpp/mlp.hpp"
+#include "../include/mxnet_tpu_cpp/runtime.hpp"
+
+int main(int argc, char **argv) {
+  if (argc < 5) {
+    std::fprintf(stderr,
+                 "usage: %s <params> <rec> <layer1,layer2,..> <dim> [batch]\n",
+                 argv[0]);
+    return 2;
+  }
+  const std::string params_path = argv[1], rec_path = argv[2];
+  std::vector<std::string> layers;
+  {
+    std::stringstream ss(argv[3]);
+    std::string item;
+    while (std::getline(ss, item, ',')) layers.push_back(item);
+  }
+  const int dim = std::atoi(argv[4]);
+  const int batch = argc > 5 ? std::atoi(argv[5]) : 32;
+
+  try {
+    auto params = mxnet_tpu_cpp::load_params(params_path);
+    mxnet_tpu_cpp::MLPPredictor mlp(params, layers);
+    if (mlp.input_dim() != dim) {
+      std::fprintf(stderr, "feature dim %d != model input %lld\n", dim,
+                   static_cast<long long>(mlp.input_dim()));
+      return 2;
+    }
+    mxnet_tpu_cpp::BatchLoader loader(
+        rec_path, batch, static_cast<uint64_t>(dim) * sizeof(float));
+    const uint8_t *data = nullptr;
+    const float *labels = nullptr;
+    uint64_t correct = 0, total = 0;
+    bool first = true;
+    int n;
+    while ((n = loader.next(&data, &labels)) > 0) {
+      const float *x = reinterpret_cast<const float *>(data);
+      if (first) {
+        auto logits = mlp.forward(x, 1);
+        std::printf("logits0:");
+        for (float v : logits) std::printf(" %.6f", v);
+        std::printf("\n");
+        first = false;
+      }
+      auto cls = mlp.predict(x, n);
+      for (int i = 0; i < n; ++i) {
+        correct += cls[i] == static_cast<int>(labels[i]);
+        ++total;
+      }
+    }
+    std::printf("samples: %llu\naccuracy: %.4f\n",
+                static_cast<unsigned long long>(total),
+                total ? static_cast<double>(correct) / total : 0.0);
+  } catch (const std::exception &e) {
+    std::fprintf(stderr, "error: %s\n", e.what());
+    return 1;
+  }
+  return 0;
+}

@@ -1,0 +1,93 @@
+// mlp.hpp — dense MLP inference from a mxnet_tpu checkpoint.
+//
+// Parity role: cpp-package/example/mlp.cpp built + ran an MLP through
+// the reference's C++ executor.  Deployment stance here (PARITY.md):
+// accelerator inference ships as AOT StableHLO (mxnet_tpu/export.py);
+// this class is the HOST-side (edge/CPU) predictor consuming the same
+// checkpoint files, so a model trained with Module.fit serves from
+// plain C++ with zero python or device dependencies.
+#ifndef MXNET_TPU_CPP_MLP_HPP_
+#define MXNET_TPU_CPP_MLP_HPP_
+
+#include <algorithm>
+#include <map>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+#include "ndarray_io.hpp"
+
+namespace mxnet_tpu_cpp {
+
+// FullyConnected stack: out = relu(xW^T + b) ... final layer linear.
+// Layer params follow the Module naming convention "arg:<name>_weight" /
+// "arg:<name>_bias" with weight shape (out, in) (fully_connected-inl.h).
+class MLPPredictor {
+ public:
+  MLPPredictor(const std::map<std::string, Tensor> &params,
+               const std::vector<std::string> &layer_names) {
+    if (layer_names.empty())
+      throw std::runtime_error("MLPPredictor needs at least one layer");
+    for (const auto &name : layer_names) {
+      auto wi = params.find("arg:" + name + "_weight");
+      auto bi = params.find("arg:" + name + "_bias");
+      if (wi == params.end())
+        throw std::runtime_error("missing weight for layer " + name);
+      if (wi->second.shape.size() != 2)
+        throw std::runtime_error("layer " + name + " weight is not 2-D");
+      layers_.push_back({wi->second,
+                         bi == params.end() ? Tensor{} : bi->second});
+    }
+  }
+
+  int64_t input_dim() const { return layers_.front().w.shape[1]; }
+  int64_t output_dim() const { return layers_.back().w.shape[0]; }
+
+  // x: (n, input_dim) row-major; returns (n, output_dim) logits.
+  std::vector<float> forward(const float *x, int n) const {
+    std::vector<float> cur(x, x + n * input_dim());
+    int64_t in = input_dim();
+    for (size_t li = 0; li < layers_.size(); ++li) {
+      const Tensor &w = layers_[li].w;
+      const int64_t out = w.shape[0];
+      std::vector<float> nxt(static_cast<size_t>(n) * out, 0.f);
+      for (int r = 0; r < n; ++r) {
+        const float *xi = cur.data() + r * in;
+        float *yo = nxt.data() + r * out;
+        for (int64_t o = 0; o < out; ++o) {
+          const float *wo = w.data.data() + o * in;
+          float acc = layers_[li].b.data.empty()
+                          ? 0.f
+                          : layers_[li].b.data[static_cast<size_t>(o)];
+          for (int64_t k = 0; k < in; ++k) acc += xi[k] * wo[k];
+          yo[o] = acc;
+        }
+        if (li + 1 < layers_.size())  // hidden layers: relu
+          for (int64_t o = 0; o < out; ++o) yo[o] = std::max(yo[o], 0.f);
+      }
+      cur.swap(nxt);
+      in = out;
+    }
+    return cur;
+  }
+
+  std::vector<int> predict(const float *x, int n) const {
+    auto logits = forward(x, n);
+    std::vector<int> cls(n);
+    const int64_t k = output_dim();
+    for (int r = 0; r < n; ++r) {
+      const float *row = logits.data() + r * k;
+      cls[r] = static_cast<int>(std::max_element(row, row + k) - row);
+    }
+    return cls;
+  }
+
+ private:
+  struct Layer {
+    Tensor w, b;
+  };
+  std::vector<Layer> layers_;
+};
+
+}  // namespace mxnet_tpu_cpp
+#endif  // MXNET_TPU_CPP_MLP_HPP_

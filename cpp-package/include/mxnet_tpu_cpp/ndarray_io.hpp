@@ -1,0 +1,184 @@
+// ndarray_io.hpp — read mxnet_tpu .params files (npz container of f32
+// .npy entries, ZIP_STORED) from C++ with no external dependencies.
+//
+// Parity role: cpp-package/include/mxnet-cpp/ndarray.hpp NDArray::Load
+// reading the reference's binary .params blobs; this package reads the
+// TPU port's container (numpy .npz, see mxnet_tpu/ndarray/ndarray.py
+// save()) so checkpoints written by the python side deploy to C++
+// hosts unchanged.
+#ifndef MXNET_TPU_CPP_NDARRAY_IO_HPP_
+#define MXNET_TPU_CPP_NDARRAY_IO_HPP_
+
+#include <cstdint>
+#include <cstring>
+#include <fstream>
+#include <map>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+namespace mxnet_tpu_cpp {
+
+struct Tensor {
+  std::vector<int64_t> shape;
+  std::vector<float> data;
+  int64_t size() const {
+    int64_t n = 1;
+    for (auto d : shape) n *= d;
+    return n;
+  }
+};
+
+namespace detail {
+
+inline uint32_t rd32(const uint8_t *p) {
+  return static_cast<uint32_t>(p[0]) | (static_cast<uint32_t>(p[1]) << 8) |
+         (static_cast<uint32_t>(p[2]) << 16) |
+         (static_cast<uint32_t>(p[3]) << 24);
+}
+inline uint16_t rd16(const uint8_t *p) {
+  return static_cast<uint16_t>(p[0]) | (static_cast<uint16_t>(p[1]) << 8);
+}
+
+// Parse one .npy blob (v1.0/2.0 header) into a Tensor.  Accepts '<f4'
+// and '<f8' (f8 narrowed to f32 — x64 mode may save float64 params).
+inline Tensor parse_npy(const uint8_t *p, size_t len) {
+  if (len < 12 || std::memcmp(p, "\x93NUMPY", 6) != 0)
+    throw std::runtime_error("not an npy blob");
+  const uint8_t major = p[6];
+  size_t hlen, hoff;
+  if (major == 1) {
+    hlen = rd16(p + 8);
+    hoff = 10;
+  } else {
+    hlen = rd32(p + 8);
+    hoff = 12;
+  }
+  if (hoff + hlen > len) throw std::runtime_error("truncated npy header");
+  std::string header(reinterpret_cast<const char *>(p + hoff), hlen);
+  const bool f8 = header.find("'<f8'") != std::string::npos;
+  if (!f8 && header.find("'<f4'") == std::string::npos)
+    throw std::runtime_error("npy dtype not f4/f8: " + header);
+  if (header.find("'fortran_order': False") == std::string::npos)
+    throw std::runtime_error("fortran-order npy unsupported");
+  const auto sp = header.find("'shape': (");
+  if (sp == std::string::npos) throw std::runtime_error("npy shape missing");
+  Tensor t;
+  size_t i = sp + 10;
+  while (header[i] != ')') {
+    if (header[i] >= '0' && header[i] <= '9') {
+      int64_t v = 0;
+      while (header[i] >= '0' && header[i] <= '9')
+        v = v * 10 + (header[i++] - '0');
+      t.shape.push_back(v);
+    } else {
+      ++i;
+    }
+  }
+  if (t.shape.empty()) t.shape.push_back(1);  // 0-d scalar
+  const uint8_t *body = p + hoff + hlen;
+  const int64_t n = t.size();
+  const size_t need = static_cast<size_t>(n) * (f8 ? 8 : 4);
+  if (hoff + hlen + need > len)
+    throw std::runtime_error("npy body shorter than its shape claims");
+  t.data.resize(static_cast<size_t>(n));
+  if (f8) {
+    for (int64_t k = 0; k < n; ++k) {
+      double v;
+      std::memcpy(&v, body + k * 8, 8);
+      t.data[static_cast<size_t>(k)] = static_cast<float>(v);
+    }
+  } else {
+    std::memcpy(t.data.data(), body, static_cast<size_t>(n) * 4);
+  }
+  return t;
+}
+
+}  // namespace detail
+
+// Load every entry of a ZIP_STORED .npz (the format numpy's savez
+// emits; mxnet_tpu never compresses params).  numpy streams members
+// with data descriptors (local-header sizes are zero), so sizes and
+// offsets come from the CENTRAL directory, with zip64 extra-field
+// support for the force_zip64 mode numpy uses.
+inline std::map<std::string, Tensor> load_params(const std::string &path) {
+  std::ifstream f(path, std::ios::binary);
+  if (!f) throw std::runtime_error("cannot open " + path);
+  std::vector<uint8_t> buf((std::istreambuf_iterator<char>(f)),
+                           std::istreambuf_iterator<char>());
+  if (buf.size() < 22)
+    throw std::runtime_error("not a zip (too small): " + path);
+  // find EOCD (scan back over a possible trailing comment)
+  const uint32_t kEOCD = 0x06054b50, kCEN = 0x02014b50;
+  size_t eocd = std::string::npos;
+  for (size_t i = buf.size() - 22;; --i) {
+    if (detail::rd32(buf.data() + i) == kEOCD) {
+      eocd = i;
+      break;
+    }
+    if (i == 0) break;
+  }
+  if (eocd == std::string::npos)
+    throw std::runtime_error("no zip end-of-central-directory in " + path);
+  size_t cdir = detail::rd32(buf.data() + eocd + 16);
+  uint64_t nent = detail::rd16(buf.data() + eocd + 10);
+  if (cdir == 0xffffffffu) {  // zip64: locator sits just before EOCD
+    if (eocd < 20 || detail::rd32(buf.data() + eocd - 20) != 0x07064b50)
+      throw std::runtime_error("zip64 locator missing in " + path);
+    uint64_t z64 = 0;
+    std::memcpy(&z64, buf.data() + eocd - 20 + 8, 8);
+    if (z64 + 56 > buf.size())
+      throw std::runtime_error("zip64 EOCD out of range in " + path);
+    std::memcpy(&nent, buf.data() + z64 + 32, 8);
+    std::memcpy(&cdir, buf.data() + z64 + 48, 8);
+  }
+
+  std::map<std::string, Tensor> out;
+  size_t off = cdir;
+  for (uint64_t e = 0; e < nent && off + 46 <= buf.size(); ++e) {
+    const uint8_t *p = buf.data() + off;
+    if (detail::rd32(p) != kCEN) break;
+    const uint16_t method = detail::rd16(p + 10);
+    uint64_t csize = detail::rd32(p + 20);
+    const uint16_t nlen = detail::rd16(p + 28);
+    const uint16_t elen = detail::rd16(p + 30);
+    const uint16_t clen = detail::rd16(p + 32);
+    uint64_t lho = detail::rd32(p + 42);
+    std::string name(reinterpret_cast<const char *>(p + 46), nlen);
+    // zip64 extra field holds any 0xffffffff values, in fixed order
+    const uint8_t *xp = p + 46 + nlen, *xe = xp + elen;
+    while (xp + 4 <= xe) {
+      const uint16_t tag = detail::rd16(xp), sz = detail::rd16(xp + 2);
+      if (tag == 1) {
+        const uint8_t *q = xp + 4;
+        if (detail::rd32(p + 24) == 0xffffffffu) q += 8;  // skip usize
+        if (csize == 0xffffffffu) {
+          std::memcpy(&csize, q, 8);
+          q += 8;
+        }
+        if (lho == 0xffffffffu) std::memcpy(&lho, q, 8);
+        break;
+      }
+      xp += 4 + sz;
+    }
+    off += 46 + nlen + elen + clen;
+    if (method != 0)
+      throw std::runtime_error("compressed npz entry unsupported: " + name);
+    // body sits after the entry's LOCAL header (its own name/extra lens)
+    if (lho + 30 > buf.size())
+      throw std::runtime_error("local header out of range: " + name);
+    const uint8_t *lp = buf.data() + lho;
+    const size_t body =
+        lho + 30 + detail::rd16(lp + 26) + detail::rd16(lp + 28);
+    if (body + csize > buf.size())
+      throw std::runtime_error("truncated npz entry: " + name);
+    if (name.size() > 4 && name.substr(name.size() - 4) == ".npy")
+      out[name.substr(0, name.size() - 4)] =
+          detail::parse_npy(buf.data() + body, csize);
+  }
+  if (out.empty()) throw std::runtime_error("no npy entries in " + path);
+  return out;
+}
+
+}  // namespace mxnet_tpu_cpp
+#endif  // MXNET_TPU_CPP_NDARRAY_IO_HPP_

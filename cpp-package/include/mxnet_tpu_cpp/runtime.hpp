@@ -1,0 +1,109 @@
+// runtime.hpp — RAII C++ wrappers over the mxnet_tpu native host
+// runtime's C ABI (src/runtime/mxt_runtime.h).
+//
+// Parity role: cpp-package/include/mxnet-cpp/ wrapped the reference's
+// C API (MXNDArray*/MXExecutor*); here the deployable native surface is
+// the HOST runtime — dependency engine, pooled storage, recordio,
+// threaded batch loader — while device compute ships as AOT StableHLO
+// (mxnet_tpu/export.py) executed by the jax/PJRT serving runtime.
+#ifndef MXNET_TPU_CPP_RUNTIME_HPP_
+#define MXNET_TPU_CPP_RUNTIME_HPP_
+
+#include <cstdint>
+#include <stdexcept>
+#include <string>
+
+#include "../../../src/runtime/mxt_runtime.h"
+
+namespace mxnet_tpu_cpp {
+
+inline void check(bool ok, const char *what) {
+  if (!ok) throw std::runtime_error(std::string(what) + ": " +
+                                    MXTGetLastError());
+}
+
+class Engine {
+ public:
+  explicit Engine(int num_workers = 0) { MXTEngineStart(num_workers); }
+  void wait_all() { MXTEngineWaitAll(); }
+  int num_workers() const { return MXTEngineNumWorkers(); }
+};
+
+class Var {
+ public:
+  Var() : h_(MXTEngineNewVar()) {}
+  ~Var() { MXTEngineDeleteVar(h_); }
+  Var(const Var &) = delete;
+  Var &operator=(const Var &) = delete;
+  MXTVarHandle handle() const { return h_; }
+
+ private:
+  MXTVarHandle h_;
+};
+
+class RecordWriter {
+ public:
+  explicit RecordWriter(const std::string &path)
+      : h_(MXTRecordIOWriterCreate(path.c_str())) {
+    check(h_ != nullptr, "RecordIOWriterCreate");
+  }
+  ~RecordWriter() {
+    if (h_) MXTRecordIOWriterClose(h_);
+  }
+  void write(const void *data, uint64_t len) {
+    check(MXTRecordIOWriterWrite(h_, data, len) == 0, "RecordIOWriterWrite");
+  }
+
+ private:
+  void *h_;
+};
+
+class RecordReader {
+ public:
+  explicit RecordReader(const std::string &path)
+      : h_(MXTRecordIOReaderCreate(path.c_str())) {
+    check(h_ != nullptr, "RecordIOReaderCreate");
+  }
+  ~RecordReader() {
+    if (h_) MXTRecordIOReaderClose(h_);
+  }
+  // false at eof; throws on corruption
+  bool next(const void **data, uint64_t *len) {
+    int rc = MXTRecordIOReaderNext(h_, data, len);
+    check(rc >= 0, "RecordIOReaderNext");
+    return rc == 1;
+  }
+
+ private:
+  void *h_;
+};
+
+// Double-buffered threaded batch loader over a .rec of fixed-size
+// samples (IRHeader + payload; see mxt_runtime.h).
+class BatchLoader {
+ public:
+  BatchLoader(const std::string &rec, int batch_size, uint64_t sample_nbytes,
+              int label_width = 1, int depth = 2, bool shuffle = false,
+              uint64_t seed = 0)
+      : h_(MXTBatchLoaderCreate(rec.c_str(), batch_size, sample_nbytes,
+                                label_width, depth, shuffle ? 1 : 0, seed)) {
+    check(h_ != nullptr, "BatchLoaderCreate");
+  }
+  ~BatchLoader() {
+    if (h_) MXTBatchLoaderFree(h_);
+  }
+  // n in [1,batch]; 0 at epoch end; throws on error
+  int next(const uint8_t **data, const float **labels) {
+    int n = MXTBatchLoaderNext(h_, data, labels);
+    check(n >= 0, "BatchLoaderNext");
+    return n;
+  }
+  void reset() { MXTBatchLoaderReset(h_); }
+  uint64_t num_samples() const { return MXTBatchLoaderNumSamples(h_); }
+
+ private:
+  void *h_;
+};
+
+}  // namespace mxnet_tpu_cpp
+#endif  // MXNET_TPU_CPP_RUNTIME_HPP_

@@ -1,0 +1,116 @@
+"""cpp-package analog CI (VERDICT r3 #10; parity:
+cpp-package/example/mlp.cpp): a python-trained Module checkpoint serves
+from pure C++ — params parsed from the .npz container, eval batches
+streamed through the native threaded batch loader, logits matching the
+python executor."""
+import os
+import shutil
+import struct
+import subprocess
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, sym
+from mxnet_tpu.io import NDArrayIter
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BIN = os.path.join(REPO, "cpp-package", "example", "mlp_predict")
+
+pytestmark = pytest.mark.skipif(shutil.which("g++") is None,
+                                reason="no C++ toolchain")
+
+DIM, HIDDEN, NCLASS = 12, 16, 3
+
+
+_CENTERS = np.random.RandomState(99).normal(0, 2.0, (NCLASS, DIM)) \
+    .astype("f")
+
+
+def _make_data(n, seed):
+    rs = np.random.RandomState(seed)
+    y = rs.randint(0, NCLASS, n)
+    x = _CENTERS[y] + rs.normal(0, 0.4, (n, DIM)).astype("f")
+    return x.astype("f"), y.astype("f")
+
+
+@pytest.fixture(scope="module")
+def trained(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("cpp_mlp")
+    subprocess.run(["make", "cpp_example"], cwd=REPO, check=True,
+                   capture_output=True)
+    x, y = _make_data(512, 0)
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data, num_hidden=HIDDEN, name="fc1")
+    net = sym.Activation(net, act_type="relu")
+    net = sym.FullyConnected(net, num_hidden=NCLASS, name="fc2")
+    net = sym.SoftmaxOutput(net, name="softmax")
+    mod = mx.mod.Module(net, label_names=("softmax_label",))
+    it = NDArrayIter(x, y, batch_size=64, label_name="softmax_label")
+    mod.fit(it, num_epoch=10, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.2})
+    prefix = str(tmp / "mlp")
+    mod.save_checkpoint(prefix, 1)
+    return mod, prefix, tmp
+
+
+def _pack_rec(path, x, y):
+    from mxnet_tpu import recordio
+    w = recordio.MXRecordIO(str(path), "w")
+    for i in range(len(x)):
+        hdr = recordio.IRHeader(0, float(y[i]), i, 0)
+        w.write(recordio.pack(hdr, x[i].tobytes()))
+    w.close()
+
+
+def test_cpp_mlp_predict_matches_python(trained):
+    mod, prefix, tmp = trained
+    xe, ye = _make_data(200, 1)
+    rec = tmp / "eval.rec"
+    _pack_rec(rec, xe, ye)
+
+    out = subprocess.run(
+        [BIN, f"{prefix}-0001.params", str(rec), "fc1,fc2", str(DIM), "32"],
+        capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stderr
+    lines = out.stdout.splitlines()
+    logits_cpp = np.array(
+        [float(v) for v in lines[0].split()[1:]], "f")
+    acc_cpp = float([l for l in lines if l.startswith("accuracy")][0]
+                    .split()[-1])
+
+    # python-side reference on the same eval set
+    from mxnet_tpu.io import DataBatch
+    mod.bind(data_shapes=[("data", (200, DIM))], force_rebind=True,
+             for_training=False)
+    sym_, arg, aux = mx.model.load_checkpoint(prefix, 1)
+    mod.set_params(arg, aux)
+    mod.forward(DataBatch(data=[nd.array(xe)], label=None, pad=0,
+                          index=None), is_train=False)
+    probs = mod.get_outputs()[0].asnumpy()
+    acc_py = float((probs.argmax(1) == ye).mean())
+
+    assert abs(acc_cpp - acc_py) < 1e-6, (acc_cpp, acc_py)
+    assert acc_cpp > 0.9
+    # logits parity on sample 0: softmax is monotone, compare pre-softmax
+    # C++ logits through python softmax against the module's probs
+    e = np.exp(logits_cpp - logits_cpp.max())
+    np.testing.assert_allclose(e / e.sum(), probs[0], rtol=1e-4, atol=1e-5)
+
+
+def test_cpp_runtime_recordio_roundtrip(trained, tmp_path):
+    """The C++ reader consumes records the python writer produced (same
+    framing) — covered implicitly above via the batch loader; here pin
+    the record count through the loader."""
+    _, _, tmp = trained
+    x, y = _make_data(37, 2)
+    rec = tmp_path / "r.rec"
+    _pack_rec(rec, x, y)
+    out = subprocess.run(
+        [BIN, f"{tmp}/mlp-0001.params", str(rec), "fc1,fc2", str(DIM), "8"],
+        capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stderr
+    n = int([l for l in out.stdout.splitlines()
+             if l.startswith("samples")][0].split()[-1])
+    assert n == 37
