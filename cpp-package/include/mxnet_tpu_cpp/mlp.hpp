@@ -35,6 +35,14 @@ class MLPPredictor {
         throw std::runtime_error("missing weight for layer " + name);
       if (wi->second.shape.size() != 2)
         throw std::runtime_error("layer " + name + " weight is not 2-D");
+      if (!layers_.empty() &&
+          wi->second.shape[1] != layers_.back().w.shape[0])
+        throw std::runtime_error(
+            "layer " + name + " input dim does not match previous output");
+      if (bi != params.end() &&
+          static_cast<int64_t>(bi->second.data.size()) !=
+              wi->second.shape[0])
+        throw std::runtime_error("layer " + name + " bias length mismatch");
       layers_.push_back({wi->second,
                          bi == params.end() ? Tensor{} : bi->second});
     }

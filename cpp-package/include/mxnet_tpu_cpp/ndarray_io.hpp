@@ -65,16 +65,18 @@ inline Tensor parse_npy(const uint8_t *p, size_t len) {
   if (sp == std::string::npos) throw std::runtime_error("npy shape missing");
   Tensor t;
   size_t i = sp + 10;
-  while (header[i] != ')') {
+  while (i < header.size() && header[i] != ')') {
     if (header[i] >= '0' && header[i] <= '9') {
       int64_t v = 0;
-      while (header[i] >= '0' && header[i] <= '9')
+      while (i < header.size() && header[i] >= '0' && header[i] <= '9')
         v = v * 10 + (header[i++] - '0');
       t.shape.push_back(v);
     } else {
       ++i;
     }
   }
+  if (i >= header.size())
+    throw std::runtime_error("unterminated npy shape tuple");
   if (t.shape.empty()) t.shape.push_back(1);  // 0-d scalar
   const uint8_t *body = p + hoff + hlen;
   const int64_t n = t.size();

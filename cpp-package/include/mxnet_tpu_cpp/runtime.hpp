@@ -50,6 +50,8 @@ class RecordWriter {
   ~RecordWriter() {
     if (h_) MXTRecordIOWriterClose(h_);
   }
+  RecordWriter(const RecordWriter &) = delete;
+  RecordWriter &operator=(const RecordWriter &) = delete;
   void write(const void *data, uint64_t len) {
     check(MXTRecordIOWriterWrite(h_, data, len) == 0, "RecordIOWriterWrite");
   }
@@ -67,6 +69,8 @@ class RecordReader {
   ~RecordReader() {
     if (h_) MXTRecordIOReaderClose(h_);
   }
+  RecordReader(const RecordReader &) = delete;
+  RecordReader &operator=(const RecordReader &) = delete;
   // false at eof; throws on corruption
   bool next(const void **data, uint64_t *len) {
     int rc = MXTRecordIOReaderNext(h_, data, len);
@@ -92,6 +96,8 @@ class BatchLoader {
   ~BatchLoader() {
     if (h_) MXTBatchLoaderFree(h_);
   }
+  BatchLoader(const BatchLoader &) = delete;
+  BatchLoader &operator=(const BatchLoader &) = delete;
   // n in [1,batch]; 0 at epoch end; throws on error
   int next(const uint8_t **data, const float **labels) {
     int n = MXTBatchLoaderNext(h_, data, labels);

@@ -1,0 +1,141 @@
+"""DCGAN with two Modules and manual adversarial gradients (parity:
+example/gan/dcgan.py — generator/discriminator as separate Modules,
+discriminator bound with inputs_need_grad=True so the gradient w.r.t.
+the fake batch flows back into the generator via gen.backward()).
+
+TPU redesign notes: both training steps are fused XLA programs
+(forward_backward), and the synthetic dataset keeps the example
+self-contained (the reference pulled MNIST via sklearn).
+
+    python dcgan.py --num-epochs 3 [--image-size 16]
+"""
+import argparse
+import logging
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+import mxnet_tpu as mx
+from mxnet_tpu import nd, sym
+from mxnet_tpu.io import DataBatch, DataDesc
+
+
+def make_generator(ngf, nc, no_bias=True, fix_gamma=True):
+    rand = sym.Variable("rand")
+    g = sym.Deconvolution(rand, name="g1", kernel=(4, 4), num_filter=ngf * 2,
+                          no_bias=no_bias)
+    g = sym.BatchNorm(g, name="gbn1", fix_gamma=fix_gamma)
+    g = sym.Activation(g, name="gact1", act_type="relu")
+    g = sym.Deconvolution(g, name="g2", kernel=(4, 4), stride=(2, 2),
+                          pad=(1, 1), num_filter=ngf, no_bias=no_bias)
+    g = sym.BatchNorm(g, name="gbn2", fix_gamma=fix_gamma)
+    g = sym.Activation(g, name="gact2", act_type="relu")
+    g = sym.Deconvolution(g, name="g3", kernel=(4, 4), stride=(2, 2),
+                          pad=(1, 1), num_filter=nc, no_bias=no_bias)
+    return sym.Activation(g, name="gout", act_type="tanh")
+
+
+def make_discriminator(ndf):
+    data = sym.Variable("data")
+    d = sym.Convolution(data, name="d1", kernel=(4, 4), stride=(2, 2),
+                        pad=(1, 1), num_filter=ndf)
+    d = sym.LeakyReLU(d, name="dact1", act_type="leaky", slope=0.2)
+    d = sym.Convolution(d, name="d2", kernel=(4, 4), stride=(2, 2),
+                        pad=(1, 1), num_filter=ndf * 2)
+    d = sym.LeakyReLU(d, name="dact2", act_type="leaky", slope=0.2)
+    d = sym.Convolution(d, name="d3", kernel=(4, 4), num_filter=1)
+    d = sym.Flatten(d)
+    label = sym.Variable("label")
+    return sym.LogisticRegressionOutput(d, label, name="dloss")
+
+
+def real_batch(rs, n, nc, size):
+    """Synthetic 'real' data: smooth blobs in [-1, 1]."""
+    yy, xx = np.mgrid[0:size, 0:size].astype(np.float32) / (size - 1)
+    cx = rs.uniform(0.25, 0.75, (n, 1, 1, 1)).astype(np.float32)
+    cy = rs.uniform(0.25, 0.75, (n, 1, 1, 1)).astype(np.float32)
+    r2 = (xx[None, None] - cx) ** 2 + (yy[None, None] - cy) ** 2
+    img = np.exp(-r2 / 0.05) * 2.0 - 1.0
+    return np.repeat(img, nc, axis=1).astype(np.float32)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--num-epochs", type=int, default=3)
+    ap.add_argument("--batch-size", type=int, default=16)
+    ap.add_argument("--batches-per-epoch", type=int, default=8)
+    ap.add_argument("--image-size", type=int, default=16)
+    ap.add_argument("--ngf", type=int, default=16)
+    ap.add_argument("--ndf", type=int, default=16)
+    ap.add_argument("--zdim", type=int, default=8)
+    ap.add_argument("--lr", type=float, default=0.02)
+    args = ap.parse_args()
+    logging.basicConfig(level=logging.INFO)
+    rs = np.random.RandomState(0)
+    B, S, nc = args.batch_size, args.image_size, 1
+
+    gen = mx.mod.Module(make_generator(args.ngf, nc), data_names=("rand",),
+                        label_names=None)
+    gen.bind(data_shapes=[DataDesc("rand", (B, args.zdim, 1, 1),
+                                   np.float32)], inputs_need_grad=False)
+    gen.init_params(mx.init.Normal(0.02))
+    gen.init_optimizer(optimizer="adam",
+                       optimizer_params={"learning_rate": args.lr,
+                                         "beta1": 0.5})
+
+    disc = mx.mod.Module(make_discriminator(args.ndf),
+                         label_names=("label",))
+    disc.bind(data_shapes=[DataDesc("data", (B, nc, S, S), np.float32)],
+              label_shapes=[DataDesc("label", (B, 1), np.float32)],
+              inputs_need_grad=True)
+    disc.init_params(mx.init.Normal(0.02))
+    disc.init_optimizer(optimizer="adam",
+                        optimizer_params={"learning_rate": args.lr,
+                                          "beta1": 0.5})
+
+    ones = nd.array(np.ones((B, 1), np.float32))
+    zeros = nd.array(np.zeros((B, 1), np.float32))
+
+    for epoch in range(args.num_epochs):
+        dloss = gloss = 0.0
+        for _ in range(args.batches_per_epoch):
+            z = nd.array(rs.normal(0, 1, (B, args.zdim, 1, 1))
+                         .astype(np.float32))
+            gen.forward(DataBatch(data=[z], label=None, pad=0, index=None),
+                        is_train=True)
+            fake = gen.get_outputs()[0]
+
+            # -- discriminator: real=1 then fake=0 (two half-steps; the
+            # reference accumulated both grads then updated once — the
+            # split update keeps each step one fused program)
+            real = nd.array(real_batch(rs, B, nc, S))
+            disc.forward_backward(DataBatch(data=[real], label=[ones],
+                                            pad=0, index=None))
+            disc.update()
+            dreal = float(disc.get_outputs()[0].asnumpy().mean())
+            disc.forward_backward(DataBatch(data=[fake.copy()],
+                                            label=[zeros], pad=0,
+                                            index=None))
+            disc.update()
+            dfake = float(disc.get_outputs()[0].asnumpy().mean())
+            dloss += (1 - dreal) + dfake
+
+            # -- generator step: fool the discriminator (label=1)
+            disc.forward(DataBatch(data=[fake], label=[ones], pad=0,
+                                   index=None), is_train=True)
+            disc.backward()
+            dgrad = disc.get_input_grads()[0]
+            gen.backward([dgrad])
+            gen.update()
+            gloss += 1 - float(disc.get_outputs()[0].asnumpy().mean())
+        n = args.batches_per_epoch
+        logging.info("epoch %d: dloss=%.3f gloss=%.3f", epoch,
+                     dloss / n, gloss / n)
+    print("dcgan done: dloss=%.3f gloss=%.3f" % (dloss / n, gloss / n))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,41 @@
+"""Monitor intermediate values during training (parity:
+example/python-howto/monitor_weights.py — mx.mon.Monitor installed on a
+Module prints per-batch stats of weights/outputs)."""
+import logging
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+import mxnet_tpu as mx
+from mxnet_tpu import sym
+from mxnet_tpu.io import NDArrayIter
+
+logging.basicConfig(level=logging.INFO)
+rs = np.random.RandomState(0)
+x = rs.rand(128, 10).astype("f")
+y = (x.sum(1) > 5).astype("f")
+
+data = sym.Variable("data")
+net = sym.FullyConnected(data, name="fc", num_hidden=2)
+net = sym.SoftmaxOutput(net, name="softmax")
+
+mod = mx.mod.Module(net, label_names=("softmax_label",))
+mon = mx.monitor.Monitor(interval=2, stat_func=lambda a: a.abs().mean(),
+                         pattern=".*")
+seen = []
+orig_toc = mon.toc_print
+
+
+def toc_print():
+    seen.extend(n for _, n, _ in mon.toc())
+
+
+mon.toc_print = toc_print
+mod.fit(NDArrayIter(x, y, batch_size=32, label_name="softmax_label"),
+        num_epoch=2, optimizer="sgd",
+        optimizer_params={"learning_rate": 0.1}, monitor=mon)
+assert any("output" in n for n in seen), seen
+print("monitor captured %d stats, e.g. %s" % (len(seen), sorted(set(seen))[:3]))

@@ -1,0 +1,28 @@
+"""Multi-output symbols (parity: example/python-howto/multiple_outputs.py
+— Group() several heads and read them all from one executor)."""
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+import mxnet_tpu as mx
+from mxnet_tpu import nd, sym
+
+data = sym.Variable("data")
+fc = sym.FullyConnected(data, name="fc", num_hidden=8)
+net = sym.SoftmaxActivation(fc, name="prob")
+# group the internal fc output with the softmax head
+group = sym.Group([net, sym.BlockGrad(fc, name="fc_blocked")])
+print("outputs:", group.list_outputs())
+
+exe = group.simple_bind(mx.cpu(), data=(2, 5))
+exe.arg_dict["data"][:] = nd.array(np.random.RandomState(0)
+                                   .rand(2, 5).astype("f"))
+outs = exe.forward()
+assert len(outs) == 2
+prob, fc_out = outs[0].asnumpy(), outs[1].asnumpy()
+assert np.allclose(prob.sum(1), 1.0, atol=1e-5)
+assert fc_out.shape == (2, 8)
+print("multiple outputs OK: prob row sums", prob.sum(1))

@@ -1,0 +1,130 @@
+"""DQN on a toy gridworld (parity role: example/reinforcement-learning/dqn
+— replay buffer, epsilon-greedy behavior policy, target network sync,
+TD(0) Q-learning; self-contained instead of the ALE dependency).
+
+The agent walks a 5x5 grid toward a goal; reward 1 at the goal, -0.01
+per step.  Gluon Q-network, training step jitted via hybridize.
+
+    python dqn.py --episodes 150
+"""
+import argparse
+import os
+import random
+import sys
+from collections import deque
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd
+
+GRID = 5
+ACTIONS = 4  # up/down/left/right
+GOAL = (4, 4)
+
+
+class Grid:
+    def reset(self):
+        self.pos = (0, 0)
+        self.t = 0
+        return self._obs()
+
+    def _obs(self):
+        o = np.zeros((GRID, GRID), np.float32)
+        o[self.pos] = 1.0
+        o[GOAL] += 0.5
+        return o.reshape(-1)
+
+    def step(self, a):
+        r, c = self.pos
+        r = max(0, min(GRID - 1, r + (a == 1) - (a == 0)))
+        c = max(0, min(GRID - 1, c + (a == 3) - (a == 2)))
+        self.pos = (r, c)
+        self.t += 1
+        done = self.pos == GOAL or self.t >= 40
+        reward = 1.0 if self.pos == GOAL else -0.01
+        return self._obs(), reward, done
+
+
+def qnet():
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(64, activation="relu"),
+            gluon.nn.Dense(ACTIONS))
+    return net
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--episodes", type=int, default=150)
+    ap.add_argument("--batch-size", type=int, default=32)
+    ap.add_argument("--gamma", type=float, default=0.95)
+    ap.add_argument("--lr", type=float, default=0.01)
+    ap.add_argument("--sync-every", type=int, default=20)
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args()
+    random.seed(args.seed)
+    np.random.seed(args.seed)
+    mx.random.seed(args.seed)
+
+    q, tgt = qnet(), qnet()
+    q.initialize(mx.init.Xavier())
+    tgt.initialize(mx.init.Xavier())
+    q.hybridize()
+    tgt.hybridize()
+    # materialize deferred-init params before the first target sync
+    dummy = nd.array(np.zeros((1, GRID * GRID), np.float32))
+    q(dummy)
+    tgt(dummy)
+    trainer = gluon.Trainer(q.collect_params(), "adam",
+                            {"learning_rate": args.lr})
+    loss_fn = gluon.loss.L2Loss()
+    buf = deque(maxlen=4000)
+    env = Grid()
+
+    def sync():
+        for (_, pt), (_, ps) in zip(tgt.collect_params().items(),
+                                    q.collect_params().items()):
+            pt.set_data(ps.data())
+
+    sync()
+    eps, returns = 1.0, []
+    for ep in range(args.episodes):
+        s = env.reset()
+        done, total = False, 0.0
+        while not done:
+            if random.random() < eps:
+                a = random.randrange(ACTIONS)
+            else:
+                a = int(q(nd.array(s[None])).asnumpy().argmax())
+            s2, r, done = env.step(a)
+            buf.append((s, a, r, s2, float(done)))
+            s, total = s2, total + r
+            if len(buf) >= args.batch_size:
+                batch = random.sample(buf, args.batch_size)
+                bs, ba, br, bs2, bd = map(np.array, zip(*batch))
+                qn = tgt(nd.array(bs2.astype("f"))).asnumpy().max(1)
+                target = br + args.gamma * qn * (1 - bd)
+                with autograd.record():
+                    qv = q(nd.array(bs.astype("f")))
+                    picked = nd.pick(qv, nd.array(ba.astype("f")))
+                    loss = loss_fn(picked, nd.array(target.astype("f")))
+                loss.backward()
+                trainer.step(args.batch_size)
+        eps = max(0.05, eps * 0.97)
+        returns.append(total)
+        if (ep + 1) % args.sync_every == 0:
+            sync()
+        if (ep + 1) % 30 == 0:
+            print("episode %d: avg return (last 30) %.3f eps %.2f"
+                  % (ep + 1, float(np.mean(returns[-30:])), eps), flush=True)
+
+    early = float(np.mean(returns[:30]))
+    late = float(np.mean(returns[-30:]))
+    print("dqn done: early=%.3f late=%.3f" % (early, late))
+    assert late > early, "no learning progress"
+
+
+if __name__ == "__main__":
+    main()

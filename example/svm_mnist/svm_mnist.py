@@ -1,0 +1,58 @@
+"""MLP with an SVM (hinge) output head (parity:
+example/svm_mnist/svm_mnist.py — FullyConnected stack trained through
+SVMOutput's L2-SVM one-vs-all hinge gradient instead of softmax CE).
+
+    python svm_mnist.py --num-epochs 5 [--use-linear]
+"""
+import argparse
+import logging
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+import mxnet_tpu as mx
+from mxnet_tpu import sym
+from mxnet_tpu.io import NDArrayIter
+from mxnet_tpu.test_utils import get_mnist
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--num-epochs", type=int, default=5)
+    ap.add_argument("--batch-size", type=int, default=64)
+    ap.add_argument("--use-linear", action="store_true",
+                    help="L1-SVM objective (L2-SVM by default)")
+    args = ap.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    mnist = get_mnist(num_train=2000, num_test=400)
+    data = sym.Variable("data")
+    net = sym.Flatten(data)
+    net = sym.FullyConnected(net, name="fc1", num_hidden=128)
+    net = sym.Activation(net, act_type="relu")
+    net = sym.FullyConnected(net, name="fc2", num_hidden=10)
+    net = sym.SVMOutput(net, name="svm", use_linear=args.use_linear,
+                        regularization_coefficient=1.0)
+
+    mod = mx.mod.Module(net, label_names=("svm_label",))
+    train = NDArrayIter(mnist["train_data"], mnist["train_label"],
+                        batch_size=args.batch_size, shuffle=True,
+                        label_name="svm_label")
+    val = NDArrayIter(mnist["test_data"], mnist["test_label"],
+                      batch_size=args.batch_size, label_name="svm_label")
+    mod.fit(train, eval_data=val, num_epoch=args.num_epochs,
+            optimizer="sgd",
+            optimizer_params={"learning_rate": 0.005, "momentum": 0.9,
+                              "wd": 1e-5},
+            eval_metric="acc")
+    score = mod.score(val, "acc")
+    acc = dict(score)["accuracy"]
+    print("svm_mnist validation accuracy: %.4f" % acc)
+    assert acc > 0.85, acc
+
+
+if __name__ == "__main__":
+    main()

@@ -567,13 +567,48 @@ def _make_loss_legacy(p, data):
     return _make_loss_core(tuple(sorted(p.items())), data)
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
+def _svm_output_op(pt, data, label):
+    return data
+
+
+def _svm_output_op_fwd(pt, data, label):
+    return data, (data, label)
+
+
+def _svm_output_op_bwd(pt, res, g):
+    """Parity: src/operator/svm_output.cc L1_SVM/L2_SVM kernels —
+    one-vs-all hinge gradient, incoming head gradient folded away
+    (loss-output semantics like SoftmaxOutput)."""
+    p = dict(pt)
+    out, label = res
+    flat = out.reshape(out.shape[0], -1)
+    m = p["margin"]
+    reg = p["regularization_coefficient"]
+    onehot = jax.nn.one_hot(label.astype(jnp.int32).reshape(-1),
+                            flat.shape[1], dtype=flat.dtype)
+    if p["use_linear"]:  # L1-SVM
+        g_true = -(m > flat).astype(flat.dtype) * reg
+        g_other = (m > -flat).astype(flat.dtype) * reg
+    else:  # L2-SVM (default)
+        g_true = jnp.where(m > flat, -2.0 * reg * (m - flat),
+                           jnp.zeros((), flat.dtype))
+        g_other = jnp.where(m > -flat, 2.0 * reg * (m + flat),
+                            jnp.zeros((), flat.dtype))
+    grad = onehot * g_true + (1 - onehot) * g_other
+    return grad.reshape(out.shape).astype(out.dtype), jnp.zeros_like(label)
+
+
+_svm_output_op.defvjp(_svm_output_op_fwd, _svm_output_op_bwd)
+
+
 @register("SVMOutput", input_names=("data", "label"),
           args=[Arg("margin", float, 1.0), Arg("regularization_coefficient", float, 1.0),
                 Arg("use_linear", bool, False)])
 def _svm_output(p, data, label):
-    """Parity: src/operator/svm_output.cc (forward identity; hinge grads via vjp
-    are not used by reference tests — gradient parity via custom loss instead)."""
-    return data
+    """Parity: src/operator/svm_output.cc — identity forward, one-vs-all
+    hinge backward (L2-SVM default, L1 via use_linear)."""
+    return _svm_output_op(tuple(sorted(p.items())), data, label)
 
 
 # ---------------------------------------------------------------------------

@@ -114,3 +114,36 @@ def test_slice_assign_open_bounds():
     ref = x.asnumpy().copy()
     ref[:, 2:] = -1
     assert_almost_equal(y.asnumpy(), ref)
+
+
+def test_svm_output_hinge_gradients():
+    """Parity: svm_output.cc L1_SVM/L2_SVM kernels — identity forward,
+    one-vs-all hinge backward (head gradient folded away)."""
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd, sym
+
+    x = np.array([[2.0, -0.5, 0.3]], np.float32)  # true class 0
+
+    def grad_of(**kw):
+        data = sym.Variable("data")
+        out = sym.SVMOutput(data, sym.Variable("svm_label"), name="svm",
+                            **kw)
+        exe = out.bind(mx.cpu(), {"data": nd.array(x),
+                                  "svm_label": nd.array(
+                                      np.array([0.0], "f"))},
+                       args_grad={"data": nd.zeros((1, 3))})
+        fwd = exe.forward(is_train=True)[0].asnumpy()
+        np.testing.assert_allclose(fwd, x)  # identity forward
+        exe.backward()
+        return exe.grad_dict["data"].asnumpy()
+
+    # L2 (default): k: -2(m-x_k) if m>x_k else 0 ; j: 2(m+x_j) if m>-x_j
+    np.testing.assert_allclose(grad_of(), [[0.0, 1.0, 2.6]], rtol=1e-6)
+    # L1: k: -1{m>x_k}*reg ; j: 1{m>-x_j}*reg
+    np.testing.assert_allclose(grad_of(use_linear=True),
+                               [[0.0, 1.0, 1.0]], rtol=1e-6)
+    # margin/reg scaling
+    np.testing.assert_allclose(
+        grad_of(margin=3.0, regularization_coefficient=0.5),
+        [[-0.5 * 2.0 * 1.0, 0.5 * 2.0 * 2.5, 0.5 * 2.0 * 3.3]], rtol=1e-5)

@@ -18,8 +18,9 @@ from mxnet_tpu.io import NDArrayIter
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 BIN = os.path.join(REPO, "cpp-package", "example", "mlp_predict")
 
-pytestmark = pytest.mark.skipif(shutil.which("g++") is None,
-                                reason="no C++ toolchain")
+pytestmark = pytest.mark.skipif(
+    shutil.which(os.environ.get("CXX", "g++")) is None,
+    reason="no C++ toolchain")
 
 DIM, HIDDEN, NCLASS = 12, 16, 3
 

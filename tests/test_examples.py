@@ -568,3 +568,50 @@ def test_rnn_time_major_example():
     line = [l for l in out.splitlines() if "final TNC perplexity" in l][0]
     ppl = float(line.rsplit(" ", 1)[-1])
     assert ppl < 48.0, out  # well under the vocab-50 uniform baseline
+
+
+# ------------------------------------------------- round-4 example families
+
+def test_dcgan_example():
+    out = run_example("example/gan/dcgan.py", "--num-epochs", "2",
+                      "--batches-per-epoch", "4")
+    assert "dcgan done" in out
+
+
+def test_dqn_example():
+    out = run_example("example/reinforcement-learning/dqn.py",
+                      "--episodes", "100", timeout=560)
+    line = [l for l in out.splitlines() if "dqn done" in l][0]
+    early, late = (float(t.split("=")[1]) for t in line.split()[2:4])
+    assert late > early, out
+
+
+def test_svm_mnist_example():
+    out = run_example("example/svm_mnist/svm_mnist.py",
+                      "--num-epochs", "6", timeout=560)
+    acc = float([l for l in out.splitlines()
+                 if "validation accuracy" in l][0].rsplit(" ", 1)[-1])
+    assert acc > 0.85, out
+
+
+def test_python_howto_examples():
+    assert "multiple outputs OK" in \
+        run_example("example/python-howto/multiple_outputs.py")
+    assert "monitor captured" in \
+        run_example("example/python-howto/monitor_weights.py")
+
+
+def test_torch_bridge_example():
+    out = run_example("example/torch/torch_bridge.py", timeout=560)
+    acc = float([l for l in out.splitlines()
+                 if "accuracy" in l][0].rsplit(" ", 1)[-1])
+    assert acc > 0.8, out
+
+
+def test_lstm_ocr_ctc_example():
+    out = run_example("example/ctc/lstm_ocr.py", "--num-epochs", "12",
+                      "--batches-per-epoch", "12", "--lr", "0.02",
+                      timeout=560)
+    acc = float([l for l in out.splitlines()
+                 if "exact-sequence accuracy" in l][0].rsplit(" ", 1)[-1])
+    assert acc > 0.8, out
