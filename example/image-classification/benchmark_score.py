@@ -42,12 +42,18 @@ def score(network, batch_size, image_shape=(3, 224, 224), repeats=10):
     net.initialize()
     net.hybridize()
     data = mx.nd.random.uniform(shape=(batch_size,) + image_shape)
+
+    def sync(o):
+        # host scalar fetch: jax block_until_ready is a no-op through the
+        # axon tunnel, so timing must sync via an actual device read
+        float(np.asarray(o._data.ravel()[0]))
+
     out = net(data)       # build + compile
-    out.wait_to_read()
+    sync(out)
     tic = time.time()
     for _ in range(repeats):
         out = net(data)
-    out.wait_to_read()
+    sync(out)
     return batch_size * repeats / (time.time() - tic)
 
 

@@ -200,6 +200,14 @@ def main():
         env["MXNET_TPU_CONV_LAYOUT"] = "NHWC"
     rec = _run("bench", [sys.executable, "bench.py"],
                args.step_timeout, summary_path, env=env)
+
+    # 6. zoo inference throughput (reference benchmark_score parity)
+    _run("benchmark_score",
+         [sys.executable, "example/image-classification/benchmark_score.py",
+          "--networks", "resnet-18,resnet-50,mobilenet,inception-v3",
+          "--batch-sizes", "1,64", "--repeats", "20"],
+         args.step_timeout, summary_path, env=env,
+         capture_to=f"SCORE_{tag}.txt")
     m = re.search(r"(\{.*\})", rec.get("tail", ""))
     if m:
         try:
