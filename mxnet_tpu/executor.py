@@ -315,6 +315,12 @@ class Executor:
 
     def _set_results(self, outs, new_aux):
         self._outputs_cache = [NDArray(o, self._ctx) for o in outs]
+        stypes = self._plan.out_stypes()
+        if any(s != "default" for s in stypes):
+            from .ndarray.sparse import cast_storage as _cast
+            self._outputs_cache = [
+                _cast(o, st) if st != "default" else o
+                for o, st in zip(self._outputs_cache, stypes)]
         for k, v in new_aux.items():
             if k in self.aux_dict:
                 self.aux_dict[k]._set_data(v)

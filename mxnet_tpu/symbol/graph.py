@@ -185,6 +185,24 @@ class GraphPlan:
             else:
                 self.out_refs.append(("val", (ref[1], oi)))
 
+    def out_stypes(self) -> list:
+        """Storage type of each graph output: 'row_sparse'/'csr' when the
+        producing node is cast_storage with a sparse target, else
+        'default'.  The executor wraps such outputs in real sparse
+        NDArrays at the graph boundary (parity: cast_storage.cc
+        CastStorageComputeEx producing an rsp/csr output chunk — inside
+        XLA compute stays dense, the storage class materializes where
+        the value leaves the compiled program)."""
+        out = []
+        for ref in self.out_refs:
+            st = "default"
+            if ref[0] == "val":
+                step = self.steps[ref[1][0]]
+                if step.op.name == "cast_storage":
+                    st = step.params.get("stype", "default")
+            out.append(st if st in ("row_sparse", "csr") else "default")
+        return out
+
     def sparse_grad_args(self) -> Dict[str, list]:
         """Arg names whose gradient the executor can produce ROWS-ONLY:
         variables used exclusively as the weight of

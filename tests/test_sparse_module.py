@@ -164,3 +164,25 @@ def test_remat_disables_rsp_rewrite(monkeypatch):
     g = mod._exec.grad_dict["emb_weight"]
     assert not isinstance(g, RowSparseNDArray)
     assert g.shape == (VOCAB, DIM)
+
+
+def test_cast_storage_symbol_boundary_produces_sparse():
+    """cast_storage in a symbol graph yields REAL sparse NDArrays at the
+    executor boundary (parity: CastStorageComputeEx output chunk) — not
+    just a value-level identity."""
+    from mxnet_tpu.ndarray.sparse import CSRNDArray
+    x = np.zeros((4, 3), "f")
+    x[1] = 2.0
+    x[3, 0] = -1.0
+    data = sym.Variable("data")
+    rsp_out = sym.cast_storage(data * 2, stype="row_sparse")
+    csr_out = sym.cast_storage(data * 2, stype="csr")
+    exe = sym.Group([rsp_out, csr_out]).bind(
+        mx.cpu(), {"data": nd.array(x)})
+    o_rsp, o_csr = exe.forward()
+    assert isinstance(o_rsp, RowSparseNDArray)
+    assert set(np.asarray(o_rsp._indices).tolist()) == {1, 3}
+    assert isinstance(o_csr, CSRNDArray)
+    assert o_csr._values.shape[0] == 4  # nnz
+    np.testing.assert_allclose(o_rsp.asnumpy(), 2 * x, rtol=1e-6)
+    np.testing.assert_allclose(o_csr.asnumpy(), 2 * x, rtol=1e-6)
