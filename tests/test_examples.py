@@ -118,13 +118,16 @@ def test_parse_log(tmp_path):
     assert rows[1]["train_acc"] == 0.8
 
 
-def test_bench_product_path_smoke():
+@pytest.mark.parametrize("layout", ["NCHW", "NHWC"])
+def test_bench_product_path_smoke(layout):
     """bench.py drives Module.fit + tpu_sync kvstore + fused updates; the
-    CPU smoke config checks the whole path wires up and the loss-sanity
-    assert passes."""
+    CPU smoke config checks the whole path wires up (both internal
+    layouts — chip_window runs the TPU bench under the A/B winner) and
+    the loss-sanity assert passes."""
     import json
     env = {**ENV, "MXT_BENCH_BATCH": "8", "MXT_BENCH_IMG": "64",
-           "MXT_BENCH_BATCHES": "2", "MXT_BENCH_LR": "0.01"}
+           "MXT_BENCH_BATCHES": "2", "MXT_BENCH_LR": "0.01",
+           "MXNET_TPU_CONV_LAYOUT": layout}
     proc = subprocess.run([sys.executable, os.path.join(REPO, "bench.py")],
                           env=env, capture_output=True, text=True,
                           timeout=560)
