@@ -164,6 +164,24 @@ CASES += [
      sym.broadcast_like(sym.expand_dims(
          getattr(sym, "arange_like")(v(), axis=1), 0), v()),
      {"data": (3, 7)}),
+    # round 4: hinge-output gradients + conv1d/3d (the NHWC lowering's
+    # rank edges; the 2d NHWC sweep runs via run_tpu_consistency --layout)
+    ("svm_output_l2", sym.SVMOutput(v(), sym.clip(sym.abs(
+        v("svm_label")) * 2, a_min=0, a_max=4)), {"data": (5, 5),
+                                                  "svm_label": (5,)}),
+    ("svm_output_l1", sym.SVMOutput(v(), sym.clip(sym.abs(
+        v("svm_label")) * 2, a_min=0, a_max=4), use_linear=True),
+     {"data": (5, 5), "svm_label": (5,)}),
+    ("conv1d", sym.Convolution(v(), v("w"), v("b"), kernel=(3,),
+                               num_filter=6),
+     {"data": (2, 4, 9), "w": (6, 4, 3), "b": (6,)}),
+    ("conv3d", sym.Convolution(v(), v("w"), v("b"), kernel=(2, 2, 2),
+                               num_filter=5),
+     {"data": (2, 3, 5, 6, 7), "w": (5, 3, 2, 2, 2), "b": (5,)}),
+    ("pool_full_convention",
+     sym.Pooling(v(), kernel=(3, 3), stride=(2, 2), pad=(1, 1),
+                 pool_type="max", pooling_convention="full"),
+     {"data": (2, 4, 11, 11)}),
 ]
 
 
