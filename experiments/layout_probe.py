@@ -55,18 +55,31 @@ def block(x, p, pre, stride, layout, bn_dtype, proj):
     return jax.nn.relu(out + sc)
 
 
+def maxpool3x3s2(x, layout):
+    """Patch-stack max (9 static strided slices + reduce_max): the
+    reduce_window(max) gradient lowers to select_and_gather_add, which
+    this backend cannot linearize — same trick as ops/nn.py:_pool_impl."""
+    sp = 2 if layout == "NCHW" else 1
+    pad = [(0, 0)] * 4
+    pad[sp] = pad[sp + 1] = (1, 1)
+    init = jnp.asarray(-jnp.inf, x.dtype)
+    xp = jnp.pad(x, pad, constant_values=init)
+    out_h = (xp.shape[sp] - 3) // 2 + 1
+    out_w = (xp.shape[sp + 1] - 3) // 2 + 1
+    parts = []
+    for oh in range(3):
+        for ow in range(3):
+            idx = [slice(None)] * 4
+            idx[sp] = slice(oh, oh + (out_h - 1) * 2 + 1, 2)
+            idx[sp + 1] = slice(ow, ow + (out_w - 1) * 2 + 1, 2)
+            parts.append(xp[tuple(idx)])
+    return jnp.max(jnp.stack(parts), axis=0)
+
+
 def forward(p, x, layout, bn_dtype):
     out = conv(x, p["stem"], 2, layout)
     out = jax.nn.relu(bn(out, p, "stembn", layout, bn_dtype))
-    if layout == "NCHW":
-        out = lax.reduce_window(out, -jnp.inf if out.dtype == jnp.float32 else
-                                jnp.asarray(-jnp.inf, out.dtype), lax.max,
-                                (1, 1, 3, 3), (1, 1, 2, 2),
-                                ((0, 0), (0, 0), (1, 1), (1, 1)))
-    else:
-        out = lax.reduce_window(out, jnp.asarray(-jnp.inf, out.dtype), lax.max,
-                                (1, 3, 3, 1), (1, 2, 2, 1),
-                                ((0, 0), (1, 1), (1, 1), (0, 0)))
+    out = maxpool3x3s2(out, layout)
     for si, (n, w) in enumerate(zip(L, WIDTHS)):
         for bi in range(n):
             stride = 2 if (si > 0 and bi == 0) else 1

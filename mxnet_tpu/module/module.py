@@ -370,23 +370,44 @@ class Module(BaseModule):
             self._preload_opt_states = None
 
     # -- compute --------------------------------------------------------------
+    @staticmethod
+    def _load_arg(arr, tgt):
+        """Batch data typed AND placed like the executor's buffer (the
+        reference copies batches to executor contexts in _load_data,
+        executor_group.py:28-71 — a CPU-built mx.nd.array fed to a
+        TPU-bound module must hop devices here, and a mesh-sharded
+        target keeps its sharding so re-jit never triggers).  The
+        dtype-cast + sharding-preserving placement rule lives in ONE
+        place: NDArray.copyto."""
+        if isinstance(arr, nd.NDArray):
+            arr.copyto(tgt)
+        else:
+            # host (numpy) batch: one transfer, straight to the
+            # executor's placement — no default-device stopover
+            import jax
+            import numpy as _np
+            want = getattr(tgt._data, "sharding", None) \
+                or tgt.context.jax_device()
+            tgt._set_data(jax.device_put(
+                _np.asarray(arr, dtype=tgt.dtype), want))
+
     def _set_batch(self, data_batch, is_train):
         for name, arr in zip(self._data_names, data_batch.data):
             tgt = self._exec.arg_dict[name]
             if tuple(tgt.shape) != tuple(arr.shape):
-                # shape change (e.g. last partial batch): XLA re-specializes
-                self._exec.arg_dict[name] = arr.astype(tgt.dtype) \
-                    if not isinstance(arr, nd.NDArray) else arr
+                # shape change (e.g. last partial batch): XLA re-specializes;
+                # placement decided by the buffer, same rule as copyto
+                src = arr if isinstance(arr, nd.NDArray) \
+                    else nd.array(arr, ctx=tgt.context)
+                self._exec.arg_dict[name] = \
+                    src.astype(tgt.dtype).copyto(tgt.context)
             else:
-                tgt._set_data((arr._data if isinstance(arr, nd.NDArray)
-                               else nd.array(arr)._data).astype(tgt.dtype))
+                self._load_arg(arr, tgt)
         if is_train and data_batch.label:
             for name, arr in zip(self._label_names, data_batch.label):
                 if name not in self._exec.arg_dict:
                     continue
-                tgt = self._exec.arg_dict[name]
-                tgt._set_data((arr._data if isinstance(arr, nd.NDArray)
-                               else nd.array(arr)._data).astype(tgt.dtype))
+                self._load_arg(arr, self._exec.arg_dict[name])
 
     def forward(self, data_batch, is_train=None):
         assert self.binded and self.params_initialized

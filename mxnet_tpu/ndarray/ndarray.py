@@ -147,10 +147,16 @@ class NDArray:
         # preserve the target's sharding (mesh-replicated params stay
         # replicated through kvstore pulls / set_params)
         tgt_sharding = getattr(other._data, "sharding", None)
-        placement = tgt_sharding if tgt_sharding is not None else \
-            other._ctx.jax_device()
-        other._set_data(jax.device_put(self._data.astype(other.dtype),
-                                       placement))
+        data = self._data.astype(other.dtype)
+        if tgt_sharding is not None and \
+                getattr(data, "sharding", None) == tgt_sharding:
+            # already typed and placed: no transfer (keeps the training
+            # hot path at 0 device_puts/step, tests/test_dispatch_count)
+            other._set_data(data)
+        else:
+            placement = tgt_sharding if tgt_sharding is not None else \
+                other._ctx.jax_device()
+            other._set_data(jax.device_put(data, placement))
         return other
 
     def as_in_context(self, ctx: Context) -> "NDArray":

@@ -60,7 +60,12 @@ class Predictor:
 
         arg_names = symbol.list_arguments()
         self._input_names = [n for n in arg_names if n not in arg_params]
-        args = dict(arg_params)
+        # MXPredCreate copies the param blob onto the requested device
+        # (c_predict_api.cc) — loaded params live on the default/CPU
+        # context here, so place them before binding
+        args = {k: v.as_in_context(self._ctx) for k, v in arg_params.items()}
+        aux_params = {k: v.as_in_context(self._ctx)
+                      for k, v in aux_params.items()}
         for name, shp in input_shapes.items():
             args[name] = nd.zeros(shp, ctx=self._ctx)
         missing = [n for n in self._input_names if n not in input_shapes]
@@ -88,9 +93,13 @@ class Predictor:
         if name not in self._input_names:
             raise MXNetError(f"unknown input '{name}'; inputs: "
                              f"{self._input_names}")
-        arr = data if isinstance(data, NDArray) else nd.array(data)
-        self._exec.arg_dict[name]._set_data(arr._data.astype(
-            self._exec.arg_dict[name].dtype))
+        # host/CPU-built input fed to an accelerator-bound predictor
+        # (MXPredSetInput memcpys host->device in the reference);
+        # numpy goes straight to the target device (one transfer),
+        # copyto owns the dtype-cast + placement rule
+        arr = data if isinstance(data, NDArray) \
+            else nd.array(data, ctx=self._ctx)
+        arr.copyto(self._exec.arg_dict[name])
 
     def forward(self) -> None:
         """MXPredForward."""

@@ -213,6 +213,18 @@ def test_predictor_roundtrip(tmp_path):
     out = pred.get_output(0)
     assert_almost_equal(out, ref[:10], rtol=1e-4, atol=1e-5)
 
+    # cross-device deployment (on-chip finding, CONSISTENCY_r04): params
+    # load on the default CPU context but the predictor targets another
+    # device — MXPredCreate copies the blob to the requested device, and
+    # set_input copies host inputs likewise
+    pred2 = predictor.create(prefix + "-symbol.json",
+                             prefix + "-0000.params",
+                             {"data": (10, 6)}, dev=mx.cpu(2))
+    pred2.set_input("data", mx.nd.array(x[:10], ctx=mx.cpu(0)))
+    pred2.forward()
+    assert_almost_equal(pred2.get_output(0), ref[:10], rtol=1e-4,
+                        atol=1e-5)
+
 
 def test_launch_local(tmp_path):
     """tools/launch.py forks N workers with the rank env contract."""

@@ -216,3 +216,31 @@ def test_rebind_adopt_or_assert(caplog):
         mod.bind(data_shapes=[mx.io.DataDesc("data", (25, x.shape[1]),
                                              "float16")],
                  label_shapes=it.provide_label)
+
+
+def test_batch_follows_module_device():
+    """On-chip finding (CONSISTENCY_r04 fc_grad_consistency): a module
+    bound to an accelerator fed mx.nd.array batches built on the default
+    (CPU) context crashed jit with 'incompatible devices' — _set_batch
+    must copy batches to the executor's device, like the reference's
+    _load_data (executor_group.py:28-71).  Reproduced cross-device on
+    the virtual CPU mesh: module on cpu(1), data committed to cpu(0)."""
+    x, y = _toy_data(50)
+    mod = mx.mod.Module(_mlp(), context=mx.cpu(1))
+    mod.bind(data_shapes=[("data", (25, x.shape[1]))],
+             label_shapes=[("softmax_label", (25,))])
+    mod.init_params()
+    mod.init_optimizer(optimizer="sgd")
+    # data explicitly committed to a DIFFERENT device than the module's
+    batch = mx.io.DataBatch([nd.array(x[:25], ctx=mx.cpu(0))],
+                            [nd.array(y[:25], ctx=mx.cpu(0))])
+    mod.forward_backward(batch)   # fused path
+    mod.update()
+    mod.forward(batch, is_train=False)  # forward-only path
+    out = mod.get_outputs()[0].asnumpy()
+    assert np.isfinite(out).all()
+    # shape-respecialization branch places too (last partial batch)
+    small = mx.io.DataBatch([nd.array(x[:7], ctx=mx.cpu(0))],
+                            [nd.array(y[:7], ctx=mx.cpu(0))])
+    mod.forward(small, is_train=False)
+    assert mod.get_outputs()[0].shape[0] == 7
