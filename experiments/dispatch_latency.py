@@ -1,0 +1,76 @@
+"""Measure per-dispatch overhead through the axon tunnel.
+
+The framework's fit step issues 3 compiled programs per batch (fused
+fwd+bwd, fused optimizer, metric NLL) where the raw-JAX layout probe
+issues 1.  If each extra dispatch costs ~10-30 ms of tunnel RPC latency
+that is the whole framework-vs-raw throughput gap (1578 vs 1929 img/s,
+LAYOUT_r04.json) — and the fix is fusing the step, not faster kernels.
+
+Prints: per-call wall time for a trivial jit program at queue depths
+1/8/64, and the marginal cost of interleaving 2 tiny programs between
+big-program dispatches (the fit pattern).
+"""
+import os
+import sys
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+
+def sync(x):
+    float(np.asarray(x.ravel()[0] if hasattr(x, "ravel") else x))
+
+
+def main():
+    dev = jax.devices()[0]
+    print("device:", dev, flush=True)
+
+    tiny = jax.jit(lambda x: x + 1.0)
+    x = jax.device_put(jnp.zeros((8, 8), jnp.float32), dev)
+    sync(tiny(x))  # compile
+
+    # dispatch-only rate: issue N calls, then one sync
+    for depth in (1, 8, 64):
+        t0 = time.perf_counter()
+        y = x
+        for _ in range(depth):
+            y = tiny(y)
+        sync(y)
+        dt = (time.perf_counter() - t0) / depth
+        print(f"tiny chained xN={depth}: {dt*1e3:.2f} ms/call", flush=True)
+
+    # big program (conv-sized matmul) alone vs big + 2 tiny interleaved
+    big = jax.jit(lambda a, b: (a @ b).sum(axis=1))
+    a = jax.device_put(jnp.ones((4096, 4096), jnp.bfloat16), dev)
+    b = jax.device_put(jnp.ones((4096, 4096), jnp.bfloat16), dev)
+    sync(big(a, b))
+    N = 30
+    t0 = time.perf_counter()
+    for _ in range(N):
+        r = big(a, b)
+    sync(r)
+    alone = (time.perf_counter() - t0) / N
+    print(f"big alone: {alone*1e3:.2f} ms/step", flush=True)
+
+    t0 = time.perf_counter()
+    for _ in range(N):
+        r = big(a, b)
+        t1 = tiny(x)
+        t2 = tiny(t1)
+    sync(r); sync(t2)
+    mixed = (time.perf_counter() - t0) / N
+    print(f"big + 2 tiny: {mixed*1e3:.2f} ms/step "
+          f"(marginal {1e3*(mixed-alone):.2f} ms)", flush=True)
+
+    # host round-trip latency (the cost of any per-step scalar fetch)
+    t0 = time.perf_counter()
+    for _ in range(20):
+        sync(tiny(x))
+    print(f"dispatch+fetch round trip: "
+          f"{(time.perf_counter()-t0)/20*1e3:.2f} ms", flush=True)
+
+
+if __name__ == "__main__":
+    main()

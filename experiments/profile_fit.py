@@ -44,14 +44,21 @@ def main():
     from mxnet_tpu.io import DataBatch
     batch = DataBatch(data=[data], label=[label], pad=0, index=None)
 
-    # warm up (compile)
+    # warm up (compile), then drain the async queue: the r04 window
+    # showed phase-1 timings absorbing leftover compile/dispatch tail
+    # (PROFILE_r04.txt's 5169 ms/step "fb" was warmup contamination)
     t = time.perf_counter()
     mod.forward_backward(batch)
     mod.update()
     sync(mod.get_outputs()[0])
     print(f"compile+first step: {time.perf_counter()-t:.1f}s", flush=True)
+    for _ in range(6):
+        mod.forward_backward(batch)
+        mod.update()
+    sync(mod.get_outputs()[0])
+    sync(next(iter(mod._exec.arg_dict.values())))
 
-    N = 8
+    N = int(os.environ.get("N", 30))
     # phase 1: forward_backward only
     t = time.perf_counter()
     for _ in range(N):
