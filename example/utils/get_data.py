@@ -1,0 +1,94 @@
+"""Shared dataset helpers for the examples (parity:
+/root/reference/example/utils/get_data.py — the reference downloads
+mnist/cifar10 archives from data.mxnet.io; this environment is
+zero-egress, so these helpers materialize seeded SYNTHETIC stand-ins
+with the same shapes/interfaces and cache them on disk so repeated
+example runs don't regenerate).
+
+The synthetic tasks are learnable (class-conditioned means), so example
+trainings that assert falling loss / rising accuracy exercise real
+optimization, not noise-fitting.
+"""
+import os
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+_CACHE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "_data_cache")
+
+
+def _cached(name, maker):
+    os.makedirs(_CACHE, exist_ok=True)
+    path = os.path.join(_CACHE, name + ".npz")
+    if os.path.exists(path):
+        z = np.load(path)
+        return {k: z[k] for k in z.files}
+    out = maker()
+    tmp = path + ".tmp.npz"  # savez appends .npz unless already there
+    np.savez_compressed(tmp, **out)
+    os.replace(tmp, path)
+    return out
+
+
+def _class_images(rs, n, templates):
+    """Each class is a fixed random template plus noise — linearly
+    separable with realistic within-class variation, so small models
+    reach high accuracy in a few epochs (the reference's examples train
+    on real MNIST/CIFAR, where the same holds).  The templates are drawn
+    ONCE per dataset and shared by the train/val splits."""
+    classes = len(templates)
+    y = rs.randint(0, classes, n).astype(np.float32)
+    x = templates[y.astype(np.int64)] + \
+        rs.normal(0, 1.0, (n,) + templates.shape[1:]).astype(np.float32)
+    return x, y
+
+
+def get_mnist(data_dir=None, num_examples=6000):
+    """Synthetic MNIST-shaped arrays: (N,1,28,28) in [0,1], labels 0-9.
+    Reference get_mnist downloads the idx files (get_data.py:21-36)."""
+    def make():
+        rs = np.random.RandomState(42)
+        t = rs.normal(0, 1, (10, 1, 28, 28)).astype(np.float32)
+        x, y = _class_images(rs, num_examples, t)
+        xv, yv = _class_images(rs, num_examples // 6, t)
+        return {"train_data": x, "train_label": y,
+                "val_data": xv, "val_label": yv}
+    return _cached("mnist_%d" % num_examples, make)
+
+
+def get_cifar10(data_dir=None, num_examples=6000):
+    """Synthetic CIFAR10-shaped arrays: (N,3,32,32), labels 0-9.
+    Reference get_cifar10 downloads rec files (get_data.py:38-52)."""
+    def make():
+        rs = np.random.RandomState(43)
+        t = rs.normal(0, 1, (10, 3, 32, 32)).astype(np.float32)
+        x, y = _class_images(rs, num_examples, t)
+        xv, yv = _class_images(rs, num_examples // 6, t)
+        return {"train_data": x, "train_label": y,
+                "val_data": xv, "val_label": yv}
+    return _cached("cifar10_%d" % num_examples, make)
+
+
+def mnist_iterator(batch_size=64, input_shape=(1, 28, 28),
+                   num_examples=6000):
+    """(train_iter, val_iter) over the synthetic MNIST; mirrors the
+    iterator the reference examples build from the idx files."""
+    d = get_mnist(num_examples=num_examples)
+    shape = (num_examples,) + tuple(input_shape)
+    train = mx.io.NDArrayIter(
+        d["train_data"].reshape(shape), d["train_label"],
+        batch_size, shuffle=True)
+    val = mx.io.NDArrayIter(
+        d["val_data"].reshape((len(d["val_label"]),) + tuple(input_shape)),
+        d["val_label"], batch_size)
+    return train, val
+
+
+def cifar10_iterator(batch_size=64, num_examples=6000):
+    d = get_cifar10(num_examples=num_examples)
+    train = mx.io.NDArrayIter(d["train_data"], d["train_label"],
+                              batch_size, shuffle=True)
+    val = mx.io.NDArrayIter(d["val_data"], d["val_label"], batch_size)
+    return train, val

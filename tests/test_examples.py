@@ -630,3 +630,54 @@ def test_lstm_ocr_ctc_example():
     acc = float([l for l in out.splitlines()
                  if "exact-sequence accuracy" in l][0].rsplit(" ", 1)[-1])
     assert acc > 0.8, out
+
+
+def test_chinese_text_cnn_example():
+    out = run_example(
+        "example/cnn_chinese_text_classification/text_cnn.py",
+        "--num-epochs", "6", "--num-examples", "1024", timeout=560)
+    acc = float([l for l in out.splitlines()
+                 if "final validation accuracy" in l][0].rsplit(" ", 1)[-1])
+    assert acc > 0.75, out
+
+
+def test_toy_ctc_warpctc_example():
+    out = run_example("example/warpctc/toy_ctc.py", "--num-epochs", "14",
+                      "--batches", "12", "--frames", "4", timeout=560)
+    acc = float([l for l in out.splitlines()
+                 if "sequence accuracy" in l][0].rsplit(" ", 1)[-1])
+    assert acc > 0.6, out
+
+
+def test_utils_get_data_cache(tmp_path):
+    # second call must hit the on-disk cache and return identical arrays
+    import example.utils.get_data as gd
+    old = gd._CACHE
+    gd._CACHE = str(tmp_path)
+    try:
+        a = gd.get_mnist(num_examples=64)
+        b = gd.get_mnist(num_examples=64)
+        assert np.array_equal(a["train_data"], b["train_data"])
+        tr, va = gd.mnist_iterator(batch_size=8, num_examples=64)
+        batch = next(iter(tr))
+        assert batch.data[0].shape == (8, 1, 28, 28)
+    finally:
+        gd._CACHE = old
+
+
+def test_getting_started_notebook(tmp_path):
+    """Execute every code cell of the tutorial notebook in order (the
+    reference's notebooks live in an external repo; ours is CI-run)."""
+    import json
+    nb_path = os.path.join(REPO, "example/notebooks/getting_started.ipynb")
+    with open(nb_path) as f:
+        nb = json.load(f)
+    script = "\n\n".join("".join(c["source"]) for c in nb["cells"]
+                         if c["cell_type"] == "code")
+    p = tmp_path / "nb_script.py"
+    p.write_text(script)
+    proc = subprocess.run([sys.executable, str(p)], env=ENV,
+                          cwd=os.path.join(REPO, "example/notebooks"),
+                          capture_output=True, text=True, timeout=560)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "module val acc" in proc.stdout
