@@ -64,19 +64,23 @@ def sym_gen(sentence_size, num_embed, vocab_size, num_label=2,
 
 
 def make_corpus(rs, n, vocab, seq_len):
-    """Synthetic char-level task: polarity decided by which of two
-    character BIGRAMS occurs more often — unigram counts are balanced,
-    so only a model that sees adjacent-character patterns (the conv
-    filters) can solve it.  Chars 0..9 are reserved (pad etc.)."""
-    a, b, c = vocab - 3, vocab - 2, vocab - 1
-    x = rs.randint(10, vocab - 3, (n, seq_len)).astype(np.float32)
+    """Synthetic char-level task: polarity decided by which ORDER of
+    the marker pair dominates — positive samples plant mostly (a,b)
+    bigrams, negative mostly (b,a).  Every sample contains exactly six
+    a's and six b's, so unigram counts carry ZERO signal; only a model
+    that sees adjacent-character order (the conv filters) can solve
+    it.  Chars 0..9 are reserved (pad etc.)."""
+    a, b = vocab - 2, vocab - 1
+    x = rs.randint(10, vocab - 2, (n, seq_len)).astype(np.float32)
     y = rs.randint(0, 2, n)
     for i in range(n):
-        pos = rs.choice(seq_len - 1, 6, replace=False)
-        k = rs.randint(4, 7)  # majority bigram count (4..6 of 6)
+        # even slots, 2 apart — planted bigrams can never overlap and
+        # corrupt each other
+        pos = 2 * rs.choice((seq_len - 1) // 2, 6, replace=False)
+        k = rs.randint(4, 7)  # majority-order count (4..6 of 6)
         for j, p in enumerate(pos):
-            first = (a if j < k else b) if y[i] else (b if j < k else a)
-            x[i, p], x[i, p + 1] = first, c
+            fwd = (j < k) if y[i] else (j >= k)
+            x[i, p], x[i, p + 1] = (a, b) if fwd else (b, a)
     return x, y.astype(np.float32)
 
 

@@ -1,0 +1,38 @@
+"""NDSB-1 convnet, redesigned compact (parity:
+/root/reference/example/kaggle-ndsb1/symbol_dsb.py — a 3-stage
+VGG-style stack with a global average pool before the classifier).
+Stage widths are scaled down (the reference targeted 121 classes at
+48x48 on a K40; this CI-sized variant keeps the architecture shape:
+paired 3x3 convs per stage, max-pool between stages, global avg pool,
+dropout, softmax).  TPU note: global average pooling uses
+kernel=(0, 0) global=True semantics via `global_pool` so the head is
+resolution-independent."""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+import mxnet_tpu as mx
+
+
+def _stage(net, filters, name):
+    for j, f in enumerate(filters):
+        net = mx.sym.Convolution(net, kernel=(3, 3), num_filter=f,
+                                 pad=(1, 1), name="%s_conv%d" % (name, j))
+        net = mx.sym.Activation(net, act_type="relu")
+    return mx.sym.Pooling(net, pool_type="max", kernel=(2, 2),
+                          stride=(2, 2))
+
+
+def get_symbol(num_classes=121, widths=((16, 16), (32, 32), (64, 64)),
+               dropout=0.25):
+    net = mx.sym.Variable("data")
+    for i, ws in enumerate(widths):
+        net = _stage(net, ws, "stage%d" % i)
+    net = mx.sym.Pooling(net, pool_type="avg", kernel=(1, 1),
+                         global_pool=True)
+    net = mx.sym.Flatten(net)
+    if dropout > 0:
+        net = mx.sym.Dropout(net, p=dropout)
+    net = mx.sym.FullyConnected(net, num_hidden=num_classes, name="cls")
+    return mx.sym.SoftmaxOutput(net, name="softmax")

@@ -1,0 +1,88 @@
+"""Memory cost of an inception net under different allocation modes
+(parity: /root/reference/example/memcost/inception_memcost.py + Makefile
+— the reference binds inception-bn at BS=32 under NNVM allocator flags
+(no-opt / inplace / sharing / both / forward-only) and prints "Total x
+MB allocated" from its graph allocator).
+
+TPU redesign: the inplace/sharing plan is XLA's buffer assignment, so
+the modes that remain meaningful are the ones a user can still choose:
+
+  forward_only   — inference program (no grad buffers, stats frozen)
+  train          — fused forward+backward, XLA's default plan
+  train_mirror   — + MXNET_BACKWARD_DO_MIRROR=1 (jax.checkpoint remat:
+                   recompute activations in the vjp, the reference's
+                   mirror pass, docs/faq/env_var.md)
+
+Numbers come from `Executor.memory_analysis()` — the compiler's own
+buffer assignment (temp = transient activation pool, what remat
+shrinks), not a simulator.
+
+    python inception_memcost.py [--batch-size 32]
+"""
+import argparse
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+import mxnet_tpu as mx
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", "image-classification"))
+from symbols import googlenet  # inception blocks (symbols/googlenet.py)
+
+
+def bind_executor(batch, img, mirror):
+    os.environ["MXNET_BACKWARD_DO_MIRROR"] = "1" if mirror else "0"
+    sym = googlenet.get_symbol(num_classes=100)
+    ex = sym.simple_bind(mx.context.current_context(),
+                         data=(batch, 3, img, img),
+                         softmax_label=(batch,), grad_req="write")
+    return ex
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch-size", type=int, default=32)
+    ap.add_argument("--image-size", type=int, default=224)
+    args = ap.parse_args()
+
+    rows = []
+    for mode, train, mirror in (("forward_only", False, False),
+                                ("train", True, False),
+                                ("train_mirror", True, True)):
+        ex = bind_executor(args.batch_size, args.image_size, mirror)
+        stats = ex.memory_analysis(train=train)
+        if not stats:
+            print("backend reports no memory analysis; nothing to show")
+            return
+        mb = {k: v / 2**20 for k, v in stats.items()}
+        rows.append((mode, mb))
+        print("%-13s temp %8.1f MB  args %8.1f MB  peak %8.1f MB"
+              % (mode, mb["temp_bytes"], mb["argument_bytes"],
+                 mb.get("peak_bytes", 0.0)), flush=True)
+
+    by = {m: r for m, r in rows}
+    fwd, tr, mir = (by[k]["temp_bytes"] for k in
+                    ("forward_only", "train", "train_mirror"))
+    on_tpu = bool(mx.context.num_tpus())
+    print(json.dumps({"forward_only_mb": round(fwd, 1),
+                      "train_mb": round(tr, 1),
+                      "train_mirror_mb": round(mir, 1),
+                      "mirror_saving_pct":
+                      round(100 * (1 - mir / tr), 1) if tr else 0.0}))
+    # forward-only must be the cheapest plan everywhere
+    assert fwd <= tr, (fwd, tr)
+    if on_tpu:
+        # the remat plan trades FLOPs for memory — on TPU it must not
+        # cost transient memory.  (XLA:CPU CSEs the recompute away, so
+        # the CPU numbers only demonstrate the API, not the saving —
+        # tests/test_executor.py proves the remat2 segments exist and
+        # the grads match.)
+        assert mir <= tr * 1.05, (mir, tr)
+
+
+if __name__ == "__main__":
+    main()

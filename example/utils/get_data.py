@@ -26,7 +26,9 @@ def _cached(name, maker):
         z = np.load(path)
         return {k: z[k] for k in z.files}
     out = maker()
-    tmp = path + ".tmp.npz"  # savez appends .npz unless already there
+    # pid-unique tmp (parallel cold-start writers must not interleave);
+    # savez appends .npz unless the name already ends with it
+    tmp = "%s.%d.tmp.npz" % (path, os.getpid())
     np.savez_compressed(tmp, **out)
     os.replace(tmp, path)
     return out

@@ -74,6 +74,12 @@ class Executor:
         # compiled step.
         self._seen_backward = False
         self._remat = bool(getenv("MXNET_BACKWARD_DO_MIRROR", 0))
+        # sqrt(N) contiguous jax.checkpoint segments (graph.py
+        # _run_segmented) — a WHOLE-graph checkpoint saves nothing;
+        # MXNET_MIRROR_SEGMENTS overrides the sqrt default
+        nsteg = int(getenv("MXNET_MIRROR_SEGMENTS", 0) or 0)
+        self._mirror_segments = nsteg or max(
+            2, int(len(self._plan.steps) ** 0.5))
         # rows-only embedding grads (VERDICT r3 #8): args eligible for
         # the in-graph rsp rewrite — weight of Embedding(sparse_grad)
         # steps, grad_req 'write', no remat/group2ctx interplay.  The
@@ -123,6 +129,7 @@ class Executor:
             rsp_map = dict(self._rsp_grad_args)
             grad_names = [n for n in self._grad_names if n not in rsp_map]
             remat = self._remat
+            segN = self._mirror_segments
 
             def fb(arg_vals, aux_vals, key_, ograds):
                 others = {k: v for k, v in arg_vals.items() if k not in grad_names}
@@ -160,12 +167,12 @@ class Executor:
                         for si, _ in lst:
                             overrides[si] = make_ov(si)
                     res = plan.run(merged, aux_vals, key_, True,
-                                   step_overrides=overrides or None)
+                                   step_overrides=overrides or None,
+                                   segments=segN if remat else 1)
                     return res, ids_out
 
-                f = jax.checkpoint(fwd) if remat else fwd
                 (outs, new_aux), vjp_fn, ids_out = jax.vjp(
-                    f, {n: arg_vals[n] for n in grad_names}, dummies,
+                    fwd, {n: arg_vals[n] for n in grad_names}, dummies,
                     has_aux=True)
                 cots = [og if og is not None else jnp.ones(o.shape, o.dtype)
                         for og, o in zip(ograds, outs)]
@@ -306,6 +313,38 @@ class Executor:
                 tgt._set_data(tgt._data + g.astype(tgt.dtype))
             else:
                 tgt._set_data(g.astype(tgt.dtype))
+
+    def memory_analysis(self, train: bool = True) -> dict:
+        """XLA buffer-assignment footprint of this executor's compiled
+        program, in bytes.  TPU redesign of the reference's allocation
+        planner/estimator (GraphExecutor::InitDataEntryMemory,
+        src/executor/graph_executor.cc; demoed by example/memcost): the
+        inplace/sharing plan the reference computes on its own graph is
+        made here by XLA's buffer assignment, so the numbers come from
+        the compiler that actually allocates.  `temp` is the transient
+        activation/workspace pool (what remat shrinks), `argument` the
+        bound params+inputs, `peak` the high-water mark."""
+        arg_vals = {k: v._data for k, v in self.arg_dict.items()}
+        aux_vals = {k: v._data for k, v in self.aux_dict.items()}
+        # fixed key: only shapes/dtypes matter for lowering, and a
+        # diagnostic must not advance the global RNG stream
+        key = jax.random.PRNGKey(0)
+        if train and self._grad_names:
+            ograds = [None] * len(self._plan.out_refs)
+            lowered = self._fwd_bwd.lower(arg_vals, aux_vals, key, ograds)
+        else:
+            lowered = self._fwd.lower(arg_vals, aux_vals, key, train)
+        stats = lowered.compile().memory_analysis()
+        if stats is None:  # backend doesn't report (older PJRT)
+            return {}
+        return {
+            "temp_bytes": stats.temp_size_in_bytes,
+            "argument_bytes": stats.argument_size_in_bytes,
+            "output_bytes": stats.output_size_in_bytes,
+            "alias_bytes": stats.alias_size_in_bytes,
+            "peak_bytes": stats.peak_memory_in_bytes,
+            "generated_code_bytes": stats.generated_code_size_in_bytes,
+        }
 
     @property
     def outputs(self) -> List[NDArray]:

@@ -250,12 +250,25 @@ class GraphPlan:
 
     # -- execution (pure; call under jit) -----------------------------------
     def run(self, arg_values: Dict[str, Any], aux_values: Dict[str, Any],
-            key, is_train: bool, step_overrides=None):
+            key, is_train: bool, step_overrides=None, segments: int = 1):
         """Execute the graph. Returns (outputs, new_aux_values).
 
         `step_overrides` maps step index -> fn(params, inputs) returning
         the step's output tuple (the executor's rows-only embedding-grad
-        rewrite rides this hook)."""
+        rewrite rides this hook).
+
+        `segments > 1` runs the step list as that many contiguous
+        `jax.checkpoint` segments: a vjp over the call then saves only
+        the segment-boundary live values and recomputes within each
+        segment during backprop — sqrt(N) activation memory, the TPU
+        redesign of the reference's backward-mirror pass
+        (MXNET_BACKWARD_DO_MIRROR, src/executor/graph_executor.cc
+        mirror-stage selection).  A whole-graph jax.checkpoint gives no
+        saving (the recompute re-materializes every activation at
+        once); segmentation is what makes remat pay."""
+        if segments and segments > 1 and not step_overrides:
+            return self._run_segmented(arg_values, aux_values, key,
+                                       is_train, int(segments))
         values: List[Tuple] = [None] * len(self.steps)
         new_aux = dict(aux_values)
 
@@ -288,6 +301,82 @@ class GraphPlan:
                 new_aux[nm] = out[n_vis + pos]
         outputs = [resolve(r) for r in self.out_refs]
         return outputs, new_aux
+
+    def _segment_layout(self, k: int):
+        """Contiguous segmentation [(b0, b1, live_in_keys), ...] where
+        live_in_keys are the (step, out_idx) values produced before b0
+        and still consumed at/after b0 (step index len(steps) stands for
+        the graph outputs).  Cached per k."""
+        cache = self.__dict__.setdefault("_seg_cache", {})
+        if k in cache:
+            return cache[k]
+        n = len(self.steps)
+        k = max(1, min(k, n))
+        bounds = sorted({int(round(i * n / k)) for i in range(k + 1)})
+        consumers: Dict[tuple, list] = {}
+        for si, step in enumerate(self.steps):
+            for ref in step.in_refs:
+                if ref[0] == "val":
+                    consumers.setdefault(ref[1], []).append(si)
+        for ref in self.out_refs:
+            if ref[0] == "val":
+                consumers.setdefault(ref[1], []).append(n)
+        segs = []
+        for b0, b1 in zip(bounds[:-1], bounds[1:]):
+            live_in = sorted(key for key, cs in consumers.items()
+                             if key[0] < b0 and any(c >= b0 for c in cs))
+            segs.append((b0, b1, live_in))
+        # live set AFTER the last segment = exactly the output values
+        cache[k] = segs
+        return segs
+
+    def _run_segmented(self, arg_values, aux_values, key, is_train, k):
+        segs = self._segment_layout(k)
+
+        def make_seg(b0, b1, live_out_keys):
+            def seg(args, live_in, aux_in, key_):
+                local: Dict[tuple, Any] = dict(live_in)
+                aux = dict(aux_in)
+
+                def resolve(ref):
+                    if ref[0] == "var":
+                        nm = ref[1]
+                        if nm in args:
+                            return args[nm]
+                        if nm in aux:
+                            return aux[nm]
+                        raise MXNetError(f"unbound variable '{nm}'")
+                    return local[ref[1]]
+
+                for si in range(b0, b1):
+                    step = self.steps[si]
+                    ins = [resolve(r) for r in step.in_refs]
+                    p = dict(step.params)
+                    if step.op.takes_is_train:
+                        p["__is_train__"] = is_train
+                    if step.op.needs_rng:
+                        ins.append(jax.random.fold_in(key_, si))
+                    out = step.op.fn(p, *ins)
+                    out = out if isinstance(out, tuple) else (out,)
+                    n_vis = len(out) - len(step.op.aux_inputs)
+                    for oi in range(n_vis):
+                        local[(si, oi)] = out[oi]
+                    for pos, nm in step.aux_var_names.items():
+                        aux[nm] = out[n_vis + pos]
+                return {kk: local[kk] for kk in live_out_keys}, aux
+            return jax.checkpoint(seg)
+
+        live: Dict[tuple, Any] = {}
+        aux = dict(aux_values)
+        out_keys = sorted({ref[1] for ref in self.out_refs
+                           if ref[0] == "val"})
+        for i, (b0, b1, _) in enumerate(segs):
+            nxt = segs[i + 1][2] if i + 1 < len(segs) else out_keys
+            live, aux = make_seg(b0, b1, nxt)(arg_values, live, aux, key)
+        outputs = [arg_values[r[1]] if r[0] == "var" and r[1] in arg_values
+                   else aux[r[1]] if r[0] == "var"
+                   else live[r[1]] for r in self.out_refs]
+        return outputs, aux
 
 
 def _canon_params(op, node, n_inputs):

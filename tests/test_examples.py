@@ -681,3 +681,61 @@ def test_getting_started_notebook(tmp_path):
                           capture_output=True, text=True, timeout=560)
     assert proc.returncode == 0, proc.stdout + proc.stderr
     assert "module val acc" in proc.stdout
+
+
+def test_memcost_example():
+    out = run_example("example/memcost/inception_memcost.py",
+                      "--batch-size", "4", "--image-size", "64",
+                      timeout=560)
+    import json as _json
+    line = [l for l in out.splitlines() if l.startswith("{")][-1]
+    d = _json.loads(line)
+    # training needs more transient memory than inference
+    assert d["train_mb"] > d["forward_only_mb"], d
+
+
+def test_kaggle_ndsb1_pipeline(tmp_path):
+    out = run_example("example/kaggle-ndsb1/train_dsb.py",
+                      "--num-epochs", "8", "--num-examples", "1536",
+                      "--classes", "8", "--submission",
+                      str(tmp_path / "sub.csv"), timeout=560)
+    acc = float([l for l in out.splitlines()
+                 if "validation accuracy" in l][0].rsplit(" ", 1)[-1])
+    assert acc > 0.5, out
+    header = (tmp_path / "sub.csv").read_text().splitlines()[0]
+    assert header.startswith("image,class_0")
+
+
+def test_kaggle_ndsb2_crps():
+    out = run_example("example/kaggle-ndsb2/Train.py",
+                      "--num-epochs", "6", "--num-examples", "768",
+                      timeout=560)
+    line = [l for l in out.splitlines() if "ndsb2 CRPS" in l][0]
+    crps_v = float(line.split()[2])
+    mae = float(line.split()[5])
+    assert crps_v < 0.05, out
+    assert mae < 40, out
+
+
+def test_adversarial_vae_example():
+    out = run_example("example/mxnet_adversarial_vae/vaegan.py",
+                      "--num-epochs", "3", "--num-examples", "256",
+                      timeout=560)
+    lines = [l for l in out.splitlines() if l.startswith("epoch ")]
+    assert len(lines) == 3, out
+    d0 = float(lines[0].split()[3])
+    d2 = float(lines[2].split()[3])
+    assert d2 < d0, out  # discriminator is learning
+    assert "feat-recon first->last" in out
+
+
+def test_speech_demo_example(tmp_path):
+    post = tmp_path / "post.npz"
+    out = run_example("example/speech-demo/train_lstm.py",
+                      "--num-epochs", "4", "--posteriors", str(post),
+                      timeout=560)
+    acc = float([l for l in out.splitlines()
+                 if "framewise accuracy" in l][0].rsplit(" ", 1)[-1])
+    assert acc > 0.6, out
+    z = np.load(post)
+    assert any(k.startswith("bucket_") for k in z.files)

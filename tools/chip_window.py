@@ -140,7 +140,24 @@ def main():
     ap.add_argument("--probe-timeout", type=float, default=120.0)
     ap.add_argument("--step-timeout", type=float, default=900.0)
     ap.add_argument("--batch", type=int, default=256)
+    ap.add_argument("--steps", default="consistency,layout,nhwc,profile,"
+                    "bench,score",
+                    help="which steps to run, in this fixed order — lets a "
+                         "re-armed poller skip artifacts already harvested "
+                         "in an earlier window this round")
+    ap.add_argument("--conv-layout", default=None,
+                    choices=("NCHW", "NHWC"),
+                    help="force MXNET_TPU_CONV_LAYOUT for bench/score "
+                         "when the layout step is skipped (a re-armed "
+                         "poller otherwise measures the default layout "
+                         "with no warning)")
     args = ap.parse_args()
+    steps = {s.strip() for s in args.steps.split(",") if s.strip()}
+    known = {"consistency", "layout", "nhwc", "profile", "bench", "score"}
+    if steps - known:
+        # a typo must not silently skip a step a rare window exists for
+        ap.error(f"unknown --steps {sorted(steps - known)}; "
+                 f"choose from {sorted(known)}")
 
     tag = args.tag
     summary_path = os.path.join(REPO, f"CHIP_WINDOW_{tag}.json")
@@ -153,7 +170,10 @@ def main():
     if selftest:
         SUMMARY["mode"] = "selftest"
         os.environ["MXT_CONSISTENCY_SELFTEST"] = "1"
-        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        # force, don't setdefault: the driver environment exports
+        # JAX_PLATFORMS=axon, and a selftest that inherits it hangs on
+        # a dead tunnel instead of exercising the cpu path
+        os.environ["JAX_PLATFORMS"] = "cpu"
 
     while True:
         plat = probe(args.probe_timeout)
@@ -172,51 +192,60 @@ def main():
     print(f"WINDOW OPEN: {plat}", flush=True)
 
     # 1. correctness first — the artifact no round has ever produced
-    _run("consistency",
-         [sys.executable, "tools/run_tpu_consistency.py",
-          "--out", os.path.join(REPO, f"CONSISTENCY_{tag}.json")],
-         args.step_timeout * 2, summary_path)
+    if "consistency" in steps:
+        _run("consistency",
+             [sys.executable, "tools/run_tpu_consistency.py",
+              "--out", os.path.join(REPO, f"CONSISTENCY_{tag}.json")],
+             args.step_timeout * 2, summary_path)
 
     # 2. layout/precision A/B (raw JAX ceiling probe)
-    winner = layout_ab(summary_path, args.batch, args.step_timeout)
+    winner = (layout_ab(summary_path, args.batch, args.step_timeout)
+              if "layout" in steps else None)
 
     # 3. the framework's own NHWC lowering, on-chip, resnet-path subset
-    _run("consistency_nhwc",
-         [sys.executable, "tools/run_tpu_consistency.py",
-          "--layout", "NHWC", "--only", "conv,pool,batchnorm,resnet",
-          "--out", os.path.join(REPO, f"CONSISTENCY_{tag}_nhwc.json")],
-         args.step_timeout, summary_path)
+    if "nhwc" in steps:
+        _run("consistency_nhwc",
+             [sys.executable, "tools/run_tpu_consistency.py",
+              "--layout", "NHWC", "--only", "conv,pool,batchnorm,resnet",
+              "--out", os.path.join(REPO, f"CONSISTENCY_{tag}_nhwc.json")],
+             args.step_timeout, summary_path)
 
     # 4. where does fit() time go
-    _run("profile_fit",
-         [sys.executable, "experiments/profile_fit.py"],
-         args.step_timeout, summary_path,
-         env={"B": str(args.batch)},
-         capture_to=f"PROFILE_{tag}.txt")
+    if "profile" in steps:
+        _run("profile_fit",
+             [sys.executable, "experiments/profile_fit.py"],
+             args.step_timeout, summary_path,
+             env={"B": str(args.batch)},
+             capture_to=f"PROFILE_{tag}.txt")
 
     # 5. the product-path bench under the winning config
     env = {}
-    if winner and winner["img_s"] > 0 and winner["layout"] == "NHWC":
+    if args.conv_layout:
+        env["MXNET_TPU_CONV_LAYOUT"] = args.conv_layout
+    elif winner and winner["img_s"] > 0 and winner["layout"] == "NHWC":
         env["MXNET_TPU_CONV_LAYOUT"] = "NHWC"
-    rec = _run("bench", [sys.executable, "bench.py"],
-               args.step_timeout, summary_path, env=env)
+    if "bench" in steps:
+        rec = _run("bench", [sys.executable, "bench.py"],
+                   args.step_timeout, summary_path, env=env)
+        m = re.search(r"(\{.*\})", rec.get("tail", ""))
+        if m:
+            try:
+                SUMMARY["bench"] = json.loads(m.group(1))
+                with open(os.path.join(REPO, f"BENCH_WINDOW_{tag}.json"),
+                          "w") as f:
+                    json.dump(SUMMARY["bench"], f, indent=1)
+            except ValueError:
+                pass
 
     # 6. zoo inference throughput (reference benchmark_score parity)
-    _run("benchmark_score",
-         [sys.executable, "example/image-classification/benchmark_score.py",
-          "--networks", "resnet-18,resnet-50,mobilenet,inception-v3",
-          "--batch-sizes", "1,64", "--repeats", "20"],
-         args.step_timeout, summary_path, env=env,
-         capture_to=f"SCORE_{tag}.txt")
-    m = re.search(r"(\{.*\})", rec.get("tail", ""))
-    if m:
-        try:
-            SUMMARY["bench"] = json.loads(m.group(1))
-            with open(os.path.join(REPO, f"BENCH_WINDOW_{tag}.json"),
-                      "w") as f:
-                json.dump(SUMMARY["bench"], f, indent=1)
-        except ValueError:
-            pass
+    if "score" in steps:
+        _run("benchmark_score",
+             [sys.executable,
+              "example/image-classification/benchmark_score.py",
+              "--networks", "resnet-18,resnet-50,mobilenet,inception-v3",
+              "--batch-sizes", "1,64", "--repeats", "20"],
+             args.step_timeout, summary_path, env=env,
+             capture_to=f"SCORE_{tag}.txt")
 
     SUMMARY["completed"] = True
     _write_summary(summary_path)
