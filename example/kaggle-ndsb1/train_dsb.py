@@ -26,9 +26,9 @@ def gen_img_list(n, classes, rs, val_frac=0.2):
     """Synthetic analog of gen_img_list.py: (index, label, path) rows
     split into train/val — the reference writes .lst files consumed by
     ImageRecordIter; here the 'images' are generated per row."""
-    rows = [(i, int(rs.randint(classes)),
-             "cls%03d/img_%05d.jpg" % (0, i)) for i in range(n)]
-    rows = [(i, c, "cls%03d/img_%05d.jpg" % (c, i)) for i, c, _ in rows]
+    labels = rs.randint(0, classes, n)
+    rows = [(i, int(c), "cls%03d/img_%05d.jpg" % (c, i))
+            for i, c in enumerate(labels)]
     n_val = int(n * val_frac)
     return rows[n_val:], rows[:n_val]
 

@@ -50,7 +50,10 @@ def crps(label, pred):
 
 
 def encode_label(vols, vmax=VMAX):
-    """volume (mL) -> 600-dim step CDF: P(V <= x) (reference :69-80)."""
+    """volume (mL) -> 600-dim step SURVIVAL curve 1[V > x] — the
+    complement of the reference's (x < arange(600)) CDF encoding
+    (:69-80); CRPS is identical under complement, and the volume
+    readout below measures the >0.5 plateau accordingly."""
     return (vols[:, None] > np.arange(vmax)[None, :]).astype(np.float32)
 
 

@@ -1,0 +1,265 @@
+"""Converter tests (parity: tools/caffe_converter/test_converter.py —
+the reference round-trips reference caffe models; zero-egress here, so
+a hand-written LeNet-style prototxt + a synthetic .caffemodel written
+by our own wire-format encoder stand in).
+"""
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.test_utils import assert_almost_equal
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools", "caffe_converter"))
+
+
+LENET_PROTOTXT = """
+name: "TinyLeNet"
+input: "data"
+input_dim: 1
+input_dim: 1
+input_dim: 16
+input_dim: 16
+layer {
+  name: "conv1"
+  type: "Convolution"
+  bottom: "data"
+  top: "conv1"
+  convolution_param { num_output: 4 kernel_size: 3 stride: 1 pad: 1 }
+}
+layer { name: "relu1" type: "ReLU" bottom: "conv1" top: "conv1" }
+layer {
+  name: "pool1"
+  type: "Pooling"
+  bottom: "conv1"
+  top: "pool1"
+  pooling_param { pool: MAX kernel_size: 2 stride: 2 }
+}
+layer {
+  name: "bn1" type: "BatchNorm" bottom: "pool1" top: "bn1"
+  batch_norm_param { use_global_stats: true eps: 1e-5 }
+}
+layer { name: "scale1" type: "Scale" bottom: "bn1" top: "bn1"
+  scale_param { bias_term: true } }
+layer {
+  name: "ip1"
+  type: "InnerProduct"
+  bottom: "bn1"
+  top: "ip1"
+  inner_product_param { num_output: 10 }
+}
+layer { name: "prob" type: "Softmax" bottom: "ip1" top: "prob" }
+"""
+
+
+def _make_caffemodel(tmp_path, rs):
+    import caffemodel as cm
+    w_conv = rs.normal(0, 0.2, (4, 1, 3, 3)).astype("f")
+    b_conv = rs.normal(0, 0.1, (4,)).astype("f")
+    mean = rs.normal(0, 0.5, (4,)).astype("f")
+    var = rs.uniform(0.5, 2.0, (4,)).astype("f")
+    sf = np.array([2.0], "f")  # caffe stores running sums * scale_factor
+    gamma = rs.uniform(0.5, 1.5, (4,)).astype("f")
+    beta = rs.normal(0, 0.2, (4,)).astype("f")
+    w_ip = rs.normal(0, 0.1, (10, 4 * 8 * 8)).astype("f")
+    b_ip = rs.normal(0, 0.1, (10,)).astype("f")
+    layers = [
+        {"name": "conv1", "type": "Convolution", "blobs": [w_conv, b_conv]},
+        {"name": "bn1", "type": "BatchNorm",
+         "blobs": [mean * 2.0, var * 2.0, sf]},
+        {"name": "scale1", "type": "Scale", "blobs": [gamma, beta]},
+        {"name": "ip1", "type": "InnerProduct", "blobs": [w_ip, b_ip]},
+    ]
+    path = str(tmp_path / "tiny.caffemodel")
+    cm.write_caffemodel(path, "TinyLeNet", layers)
+    return path, dict(w_conv=w_conv, b_conv=b_conv, mean=mean, var=var,
+                      gamma=gamma, beta=beta, w_ip=w_ip, b_ip=b_ip)
+
+
+def test_prototxt_parser_shapes():
+    from prototxt import parse
+    p = parse(LENET_PROTOTXT)
+    assert p["name"] == "TinyLeNet"
+    assert p.as_list("input_dim") == [1, 1, 16, 16]
+    layers = p.as_list("layer")
+    assert [l["type"] for l in layers] == [
+        "Convolution", "ReLU", "Pooling", "BatchNorm", "Scale",
+        "InnerProduct", "Softmax"]
+    conv = layers[0]["convolution_param"]
+    assert conv["num_output"] == 4 and conv["kernel_size"] == 3
+    assert layers[2]["pooling_param"]["pool"] == "MAX"
+    assert layers[3]["batch_norm_param"]["use_global_stats"] is True
+
+
+def test_caffemodel_wire_roundtrip(tmp_path):
+    import caffemodel as cm
+    rs = np.random.RandomState(0)
+    path, _ = _make_caffemodel(tmp_path, rs)
+    net_name, layers = cm.read_caffemodel(path)
+    assert net_name == "TinyLeNet"
+    assert [l["name"] for l in layers] == ["conv1", "bn1", "scale1", "ip1"]
+    assert layers[0]["blobs"][0].shape == (4, 1, 3, 3)
+    assert layers[3]["blobs"][0].shape == (10, 256)
+
+
+def test_convert_model_forward_matches_manual(tmp_path):
+    """Converted (symbol, params) must produce the same probabilities
+    as the hand-built equivalent network with the same weights."""
+    from convert_model import convert_model
+    rs = np.random.RandomState(1)
+    proto_path = str(tmp_path / "tiny.prototxt")
+    with open(proto_path, "w") as f:
+        f.write(LENET_PROTOTXT)
+    model_path, p = _make_caffemodel(tmp_path, rs)
+
+    sym, arg_params, aux_params, iname, idim = convert_model(
+        proto_path, model_path)
+    assert iname == "data" and idim == [1, 1, 16, 16]
+
+    x = rs.normal(0, 1, (1, 1, 16, 16)).astype("f")
+    ex = sym.simple_bind(mx.cpu(), data=(1, 1, 16, 16), grad_req="null")
+    for k, v in {**arg_params, **aux_params}.items():
+        if k in ex.arg_dict:
+            ex.arg_dict[k][:] = v
+        elif k in ex.aux_dict:
+            ex.aux_dict[k][:] = v
+    got = ex.forward(is_train=False, data=mx.nd.array(x))[0].asnumpy()
+
+    # manual reference network in numpy
+    from numpy.lib.stride_tricks import sliding_window_view
+    xp = np.pad(x, ((0, 0), (0, 0), (1, 1), (1, 1)))
+    win = sliding_window_view(xp, (3, 3), axis=(2, 3))[:, 0]
+    conv = np.einsum("nhwij,oij->nohw", win, p["w_conv"][:, 0]) \
+        + p["b_conv"][None, :, None, None]
+    r = np.maximum(conv, 0)
+    pool = r.reshape(1, 4, 8, 2, 8, 2).max(axis=(3, 5))
+    bn = (pool - p["mean"][None, :, None, None]) / np.sqrt(
+        p["var"][None, :, None, None] + 1e-5)
+    bn = bn * p["gamma"][None, :, None, None] + \
+        p["beta"][None, :, None, None]
+    ip = bn.reshape(1, -1) @ p["w_ip"].T + p["b_ip"]
+    e = np.exp(ip - ip.max())
+    want = e / e.sum()
+    assert_almost_equal(got, want.astype("f"), rtol=1e-4, atol=1e-5)
+
+
+def test_convert_model_cli_checkpoint(tmp_path):
+    """The CLI writes a loadable standard checkpoint."""
+    import subprocess
+    proto_path = str(tmp_path / "tiny.prototxt")
+    with open(proto_path, "w") as f:
+        f.write(LENET_PROTOTXT)
+    rs = np.random.RandomState(2)
+    model_path, _ = _make_caffemodel(tmp_path, rs)
+    prefix = str(tmp_path / "converted")
+    env = {**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONPATH": REPO}
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO, "tools/caffe_converter/convert_model.py"),
+         proto_path, model_path, prefix], env=env,
+        capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    sym2, args2, aux2 = mx.model.load_checkpoint(prefix, 0)
+    assert "conv1_weight" in args2 and "bn1_moving_mean" in aux2
+
+
+def test_coreml_spec_export(tmp_path):
+    """Train a tiny convnet, export the CoreML NeuralNetwork spec JSON,
+    check layer coverage and that weights round-trip bit-exact."""
+    import base64
+    import json
+    import subprocess
+    sys.path.insert(0, os.path.join(REPO, "tools", "coreml"))
+    data = mx.sym.Variable("data")
+    net = mx.sym.Convolution(data, num_filter=4, kernel=(3, 3),
+                             pad=(1, 1), name="c1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.BatchNorm(net, name="bn1")
+    net = mx.sym.Pooling(net, pool_type="max", kernel=(2, 2),
+                         stride=(2, 2))
+    net = mx.sym.Flatten(net)
+    net = mx.sym.Dropout(net, p=0.5)
+    net = mx.sym.FullyConnected(net, num_hidden=3, name="fc")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    mod = mx.mod.Module(net)
+    mod.bind(data_shapes=[("data", (2, 1, 8, 8))],
+             label_shapes=[("softmax_label", (2,))])
+    mod.init_params(mx.init.Xavier())
+    prefix = str(tmp_path / "tiny")
+    mod.save_checkpoint(prefix, 0)
+
+    out = str(tmp_path / "tiny.mlmodel.json")
+    env = {**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONPATH": REPO}
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO, "tools/coreml/mxnet_coreml_converter.py"),
+         "--model-prefix", prefix, "--epoch", "0",
+         "--input-shape", "1,1,8,8", "--output", out],
+        env=env, capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    spec = json.loads(open(out).read())
+    kinds = [next(k for k in l if k not in ("name", "input", "output"))
+             for l in spec["neuralNetwork"]["layers"]]
+    # dropout skipped; conv/act/bn/pool/flatten/fc/softmax present
+    assert kinds == ["convolution", "activation", "batchnorm",
+                     "pooling", "flatten", "innerProduct", "softmax"], kinds
+    conv = spec["neuralNetwork"]["layers"][0]["convolution"]
+    w = np.frombuffer(base64.b64decode(conv["weights"]), "<f4")
+    _, args_p, _ = mx.model.load_checkpoint(prefix, 0)
+    assert np.array_equal(w, args_p["c1_weight"].asnumpy().ravel())
+
+
+def test_amalgamation_single_file_predictor(tmp_path):
+    """amalgamation/amalgamate.py emits ONE .py whose only deps are
+    jax+numpy; its predictions must match the live module's."""
+    import subprocess
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(mx.sym.Flatten(data), num_hidden=8,
+                                name="fc1")
+    net = mx.sym.Activation(net, act_type="tanh")
+    net = mx.sym.FullyConnected(net, num_hidden=3, name="fc2")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    mod = mx.mod.Module(net)
+    mod.bind(data_shapes=[("data", (2, 5))],
+             label_shapes=[("softmax_label", (2,))])
+    mod.init_params(mx.init.Xavier())
+    prefix = str(tmp_path / "m")
+    mod.save_checkpoint(prefix, 0)
+
+    out_py = str(tmp_path / "predict_m.py")
+    env = {**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONPATH": REPO}
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "amalgamation/amalgamate.py"),
+         "--prefix", prefix, "--input-shape", "2,5", "--out", out_py],
+        env=env, capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+    # run the generated file standalone (its own __main__ smoke), from a
+    # DIFFERENT cwd, with PYTHONPATH NOT including the repo
+    proc = subprocess.run(
+        [sys.executable, out_py],
+        env={**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONPATH": ""},
+        cwd=str(tmp_path), capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "predict OK: (2, 3)" in proc.stdout
+
+    # numerical parity with the live module
+    rs = np.random.RandomState(0)
+    x = rs.normal(0, 1, (2, 5)).astype("f")
+    want = mod.predict(mx.io.NDArrayIter(x, None, 2)).asnumpy()
+    code = ("import sys, json, numpy as np; sys.path.insert(0, %r); "
+            "import predict_m; "
+            "x = np.load(%r); print(json.dumps(predict_m.predict(x)"
+            ".tolist()))" % (str(tmp_path), str(tmp_path / "x.npy")))
+    np.save(str(tmp_path / "x.npy"), x)
+    proc = subprocess.run(
+        [sys.executable, "-c", code],
+        env={**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONPATH": ""},
+        cwd=str(tmp_path), capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    import json as _json
+    got = np.array(_json.loads(proc.stdout.strip().splitlines()[-1]))
+    assert_almost_equal(got.astype("f"), want, rtol=1e-5, atol=1e-6)

@@ -739,3 +739,11 @@ def test_speech_demo_example(tmp_path):
     assert acc > 0.6, out
     z = np.load(post)
     assert any(k.startswith("bucket_") for k in z.files)
+
+
+def test_caffe_prototxt_example():
+    out = run_example("example/caffe/train_caffe_prototxt.py",
+                      "--num-epochs", "3", timeout=560)
+    acc = float([l for l in out.splitlines()
+                 if "validation accuracy" in l][0].rsplit(" ", 1)[-1])
+    assert acc > 0.7, out

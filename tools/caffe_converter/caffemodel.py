@@ -1,0 +1,150 @@
+"""Minimal protobuf WIRE-FORMAT reader/writer for .caffemodel blobs
+(parity: tools/caffe_converter/caffe_parser.py read_caffemodel — the
+reference decodes via caffe_pb2; here the handful of NetParameter
+field numbers are decoded directly from the public wire format, so no
+caffe/protoc dependency).
+
+Field numbers (caffe.proto, public schema):
+  NetParameter:   name=1, layers(V1)=2, layer(V2)=100
+  LayerParameter: name=1, type=2, blobs=7
+  V1LayerParameter: name=4, type=5(enum), blobs=6
+  BlobProto:      num=1, channels=2, height=3, width=4,
+                  data=5 (packed/repeated float), shape=7
+  BlobShape:      dim=1 (packed/repeated int64)
+
+The writer emits just enough (V2 layer + shaped blobs) for round-trip
+tests and for packaging params the same way Caffe does.
+"""
+import struct
+
+
+# ---------------------------------------------------------------- decode
+def _varint(buf, i):
+    shift = val = 0
+    while True:
+        b = buf[i]
+        i += 1
+        val |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return val, i
+        shift += 7
+
+
+def _fields(buf):
+    """Yield (field_no, wire_type, value) over a message buffer."""
+    i, n = 0, len(buf)
+    while i < n:
+        key, i = _varint(buf, i)
+        fno, wt = key >> 3, key & 7
+        if wt == 0:
+            v, i = _varint(buf, i)
+        elif wt == 1:
+            v, i = buf[i:i + 8], i + 8
+        elif wt == 2:
+            ln, i = _varint(buf, i)
+            v, i = buf[i:i + ln], i + ln
+        elif wt == 5:
+            v, i = buf[i:i + 4], i + 4
+        else:
+            raise ValueError(f"unsupported wire type {wt}")
+        yield fno, wt, v
+
+
+def _floats(v, wt):
+    if wt == 2:  # packed
+        return list(struct.unpack("<%df" % (len(v) // 4), v))
+    return [struct.unpack("<f", v)[0]]
+
+
+def _blob(buf):
+    import numpy as np
+    data, shape, legacy = [], [], {}
+    for fno, wt, v in _fields(buf):
+        if fno == 5:
+            data.extend(_floats(v, wt))
+        elif fno == 7:
+            for f2, w2, v2 in _fields(v):
+                if f2 == 1:
+                    if w2 == 2:  # packed varints
+                        i = 0
+                        while i < len(v2):
+                            d, i = _varint(v2, i)
+                            shape.append(d)
+                    else:
+                        shape.append(v2)
+        elif fno in (1, 2, 3, 4):
+            legacy[fno] = v
+    if not shape and legacy:
+        shape = [legacy.get(k, 1) for k in (1, 2, 3, 4)]
+    arr = np.asarray(data, dtype=np.float32)
+    return arr.reshape(shape) if shape and arr.size else arr
+
+
+def _layer(buf, v1=False):
+    name, ltype, blobs = "", "", []
+    f_name, f_type, f_blobs = (4, 5, 6) if v1 else (1, 2, 7)
+    for fno, wt, v in _fields(buf):
+        if fno == f_name:
+            name = v.decode("utf-8", "replace")
+        elif fno == f_type:
+            ltype = (str(v) if v1 else v.decode("utf-8", "replace"))
+        elif fno == f_blobs:
+            blobs.append(_blob(v))
+    return {"name": name, "type": ltype, "blobs": blobs}
+
+
+def read_caffemodel(fname):
+    """-> (net_name, [ {name, type, blobs:[ndarray]} ])."""
+    with open(fname, "rb") as f:
+        buf = f.read()
+    net_name, layers = "", []
+    for fno, wt, v in _fields(buf):
+        if fno == 1:
+            net_name = v.decode("utf-8", "replace")
+        elif fno == 100:
+            layers.append(_layer(v))
+        elif fno == 2:
+            layers.append(_layer(v, v1=True))
+    return net_name, layers
+
+
+# ---------------------------------------------------------------- encode
+def _key(fno, wt):
+    return _enc_varint((fno << 3) | wt)
+
+
+def _enc_varint(x):
+    out = b""
+    while True:
+        b7 = x & 0x7F
+        x >>= 7
+        if x:
+            out += bytes([b7 | 0x80])
+        else:
+            return out + bytes([b7])
+
+
+def _len_field(fno, payload):
+    return _key(fno, 2) + _enc_varint(len(payload)) + payload
+
+
+def _enc_blob(arr):
+    import numpy as np
+    arr = np.asarray(arr, np.float32)
+    shape = b"".join(_key(1, 0) + _enc_varint(int(d)) for d in arr.shape)
+    data = arr.ravel().tobytes()
+    return (_len_field(7, shape) +
+            _key(5, 2) + _enc_varint(len(data)) + data)
+
+
+def write_caffemodel(fname, net_name, layers):
+    """layers: [{name, type, blobs: [ndarray]}] -> V2 .caffemodel."""
+    payload = _len_field(1, net_name.encode())
+    for lay in layers:
+        lp = _len_field(1, lay["name"].encode())
+        lp += _len_field(2, lay["type"].encode())
+        for b in lay.get("blobs", []):
+            lp += _len_field(7, _enc_blob(b))
+        payload += _len_field(100, lp)
+    with open(fname, "wb") as f:
+        f.write(payload)

@@ -1,0 +1,99 @@
+"""Caffe (prototxt, caffemodel) -> mxnet_tpu checkpoint (parity:
+tools/caffe_converter/convert_model.py — maps each caffe layer's blobs
+onto the converted symbol's {layer}_weight/_bias args and writes the
+standard two-file checkpoint; BatchNorm's (mean, var, scale_factor)
+triple becomes moving_mean/moving_var divided by the scale factor, and
+a paired Scale layer's (gamma, beta) land on the BN's gamma/beta).
+
+    python convert_model.py net.prototxt net.caffemodel out-prefix
+"""
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+import mxnet_tpu as mx
+
+from caffemodel import read_caffemodel  # noqa: E402
+from convert_symbol import convert_symbol, get_layers  # noqa: E402
+from prototxt import read_prototxt  # noqa: E402
+
+
+def convert_model(prototxt_fname, caffemodel_fname):
+    """-> (symbol, arg_params, aux_params, input_name, input_dim)."""
+    symbol, input_name, input_dim = convert_symbol(prototxt_fname)
+    _, wlayers = read_caffemodel(caffemodel_fname)
+    blobs = {l["name"]: l["blobs"] for l in wlayers if l["blobs"]}
+    proto = read_prototxt(prototxt_fname)
+
+    arg_names = set(symbol.list_arguments())
+    aux_names = set(symbol.list_auxiliary_states())
+    arg_params, aux_params = {}, {}
+
+    def put(store, names, key, arr):
+        if key in names:
+            store[key] = mx.nd.array(np.asarray(arr, np.float32))
+
+    bn_by_top = {}
+    for lay in get_layers(proto):
+        name = lay.get("name", "")
+        ltype = lay.get("type")
+        bs = blobs.get(name)
+        if ltype == "BatchNorm" and "top" in lay:
+            bn_by_top[lay.as_list("top")[0]] = name
+        if not bs:
+            continue
+        if ltype in ("Convolution", "Deconvolution", "InnerProduct"):
+            put(arg_params, arg_names, name + "_weight", bs[0])
+            if len(bs) > 1:
+                put(arg_params, arg_names, name + "_bias", bs[1])
+        elif ltype == "BatchNorm":
+            # blobs: mean, variance, scale_factor (caffe normalizes the
+            # running sums by blobs[2][0])
+            sf = float(bs[2].ravel()[0]) if len(bs) > 2 and bs[2].size \
+                else 1.0
+            sf = sf or 1.0
+            put(aux_params, aux_names, name + "_moving_mean", bs[0] / sf)
+            put(aux_params, aux_names, name + "_moving_var", bs[1] / sf)
+        elif ltype == "Scale":
+            # gamma/beta of the bottom BatchNorm layer
+            bn = bn_by_top.get(lay.as_list("bottom")[0])
+            if bn:
+                put(arg_params, arg_names, bn + "_gamma", bs[0])
+                if len(bs) > 1:
+                    put(arg_params, arg_names, bn + "_beta", bs[1])
+
+    # BN layers with no Scale partner: fixed gamma=1, beta=0
+    for n in arg_names:
+        if n.endswith("_gamma") and n not in arg_params:
+            shp = None
+            base = n[:-6]
+            mm = aux_params.get(base + "_moving_mean")
+            if mm is not None:
+                arg_params[n] = mx.nd.ones(mm.shape)
+                arg_params.setdefault(base + "_beta",
+                                      mx.nd.zeros(mm.shape))
+    return symbol, arg_params, aux_params, input_name, input_dim
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("prototxt")
+    ap.add_argument("caffemodel")
+    ap.add_argument("prefix")
+    ap.add_argument("--epoch", type=int, default=0)
+    args = ap.parse_args()
+    sym, arg_params, aux_params, iname, idim = convert_model(
+        args.prototxt, args.caffemodel)
+    mx.model.save_checkpoint(args.prefix, args.epoch, sym,
+                             arg_params, aux_params)
+    print("converted %s + %s -> %s-symbol.json / %s-%04d.params "
+          "(input %s %s)" % (args.prototxt, args.caffemodel, args.prefix,
+                             args.prefix, args.epoch, iname, idim))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,201 @@
+"""Caffe prototxt -> mxnet_tpu Symbol (parity:
+tools/caffe_converter/convert_symbol.py — same layer coverage, built on
+the schema-free prototxt parser instead of caffe_pb2).
+
+Supported layer types: Input/Data/DummyData, Convolution,
+Deconvolution, Pooling (max/ave, global), InnerProduct, ReLU, Sigmoid,
+TanH, Dropout, LRN, BatchNorm(+Scale), Concat, Eltwise (SUM/PROD/MAX),
+Flatten, Softmax, SoftmaxWithLoss.  Accuracy/Silence layers are
+skipped (train-harness artifacts).  In-place layers (top == bottom)
+chain naturally.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+import mxnet_tpu as mx
+
+from prototxt import read_prototxt  # noqa: E402
+
+
+def _ints(v, default=0):
+    if v is None:
+        return default
+    return v if isinstance(v, int) else int(v)
+
+
+def _has_bias(param):
+    """bias_term accepts true/false AND 0/1 in protobuf text format."""
+    return bool(param.get("bias_term", True))
+
+
+# legacy V1 'layers {}' sections use enum tokens; map onto the V2 names
+# the dispatch table speaks (V1LayerParameter.LayerType)
+_V1_TYPES = {
+    "CONVOLUTION": "Convolution", "DECONVOLUTION": "Deconvolution",
+    "POOLING": "Pooling", "INNER_PRODUCT": "InnerProduct",
+    "RELU": "ReLU", "SIGMOID": "Sigmoid", "TANH": "TanH",
+    "DROPOUT": "Dropout", "LRN": "LRN", "CONCAT": "Concat",
+    "ELTWISE": "Eltwise", "FLATTEN": "Flatten", "SOFTMAX": "Softmax",
+    "SOFTMAX_LOSS": "SoftmaxWithLoss", "ACCURACY": "Accuracy",
+    "SILENCE": "Silence", "DATA": "Data",
+}
+
+
+def _pair(param, base, h, w, default=0):
+    """Caffe kernel/stride/pad: either `kernel_size: k` (square, maybe
+    repeated) or kernel_h/kernel_w."""
+    if h in param or w in param:
+        return (_ints(param.get(h), default), _ints(param.get(w), default))
+    v = param.get(base, default)
+    if isinstance(v, list):
+        v = v[0]
+    return (_ints(v, default), _ints(v, default))
+
+
+def _conv(sym, name, param, deconv=False):
+    kh, kw = _pair(param, "kernel_size", "kernel_h", "kernel_w", 1)
+    sh, sw = _pair(param, "stride", "stride_h", "stride_w", 1)
+    ph, pw = _pair(param, "pad", "pad_h", "pad_w", 0)
+    kw_args = dict(num_filter=_ints(param.get("num_output")),
+                   kernel=(kh, kw), stride=(sh, sw), pad=(ph, pw),
+                   no_bias=not _has_bias(param),
+                   num_group=_ints(param.get("group"), 1), name=name)
+    op = mx.sym.Deconvolution if deconv else mx.sym.Convolution
+    return op(sym, **kw_args)
+
+
+def _pool(sym, name, param):
+    global_pool = bool(param.get("global_pooling"))
+    kh, kw = _pair(param, "kernel_size", "kernel_h", "kernel_w", 1)
+    sh, sw = _pair(param, "stride", "stride_h", "stride_w", 1)
+    ph, pw = _pair(param, "pad", "pad_h", "pad_w", 0)
+    ptype = {"MAX": "max", "AVE": "avg", 0: "max", 1: "avg"}.get(
+        param.get("pool", "MAX"), "max")
+    return mx.sym.Pooling(sym, pool_type=ptype, kernel=(kh, kw),
+                          stride=(sh, sw), pad=(ph, pw),
+                          global_pool=global_pool,
+                          pooling_convention="full", name=name)
+    # caffe ceil-mode output sizes == the reference's 'full' convention
+
+
+def get_layers(proto):
+    return proto.as_list("layer") or proto.as_list("layers")
+
+
+def convert_symbol(prototxt_fname):
+    """-> (symbol, input_name, input_dim)."""
+    proto = read_prototxt(prototxt_fname)
+    layers = get_layers(proto)
+    # caffe pairs BatchNorm with a Scale layer for gamma/beta; prescan
+    # so the BN emits fix_gamma=False when a Scale consumes its top
+    scaled_tops = {lay.as_list("bottom")[0] for lay in layers
+                   if lay.get("type") == "Scale" and "bottom" in lay}
+    bn_tops = set()
+    tops = {}
+    last = None
+    input_name, input_dim = "data", None
+    if "input" in proto:
+        input_name = proto["input"]
+        if "input_dim" in proto:
+            input_dim = [int(d) for d in proto.as_list("input_dim")]
+        elif "input_shape" in proto:
+            input_dim = [int(d)
+                         for d in proto["input_shape"].as_list("dim")]
+        tops[input_name] = mx.sym.Variable(input_name)
+
+    for lay in layers:
+        ltype = _V1_TYPES.get(lay.get("type"), lay.get("type"))
+        name = lay.get("name", "")
+        bottoms = lay.as_list("bottom")
+        top = lay.as_list("top")[0] if "top" in lay else name
+        ins = [tops[b] for b in bottoms if b in tops]
+
+        if ltype in ("Input", "Data", "DummyData"):
+            input_name = top
+            shp = lay.get("input_param", {})
+            if "shape" in shp:
+                input_dim = [int(d) for d in shp["shape"].as_list("dim")]
+            tops[top] = mx.sym.Variable(top)
+        elif ltype == "Convolution":
+            tops[top] = _conv(ins[0], name,
+                              lay.get("convolution_param", {}))
+        elif ltype == "Deconvolution":
+            tops[top] = _conv(ins[0], name,
+                              lay.get("convolution_param", {}),
+                              deconv=True)
+        elif ltype == "Pooling":
+            tops[top] = _pool(ins[0], name, lay.get("pooling_param", {}))
+        elif ltype == "InnerProduct":
+            p = lay.get("inner_product_param", {})
+            tops[top] = mx.sym.FullyConnected(
+                ins[0], num_hidden=_ints(p.get("num_output")),
+                no_bias=not _has_bias(p), name=name)
+        elif ltype == "ReLU":
+            tops[top] = mx.sym.Activation(ins[0], act_type="relu")
+        elif ltype == "Sigmoid":
+            tops[top] = mx.sym.Activation(ins[0], act_type="sigmoid")
+        elif ltype == "TanH":
+            tops[top] = mx.sym.Activation(ins[0], act_type="tanh")
+        elif ltype == "Dropout":
+            p = lay.get("dropout_param", {})
+            tops[top] = mx.sym.Dropout(
+                ins[0], p=float(p.get("dropout_ratio", 0.5)))
+        elif ltype == "LRN":
+            p = lay.get("lrn_param", {})
+            tops[top] = mx.sym.LRN(
+                ins[0], alpha=float(p.get("alpha", 1e-4)),
+                beta=float(p.get("beta", 0.75)),
+                knorm=float(p.get("k", 2.0)),
+                nsize=_ints(p.get("local_size"), 5), name=name)
+        elif ltype == "BatchNorm":
+            p = lay.get("batch_norm_param", {})
+            tops[top] = mx.sym.BatchNorm(
+                ins[0], eps=float(p.get("eps", 1e-5)),
+                fix_gamma=top not in scaled_tops,
+                use_global_stats=bool(p.get("use_global_stats", False)),
+                name=name)
+            bn_tops.add(top)
+        elif ltype == "Scale":
+            # ONLY the BatchNorm-paired form folds (gamma/beta live on
+            # the BN symbol); a standalone Scale has learned blobs this
+            # converter would silently drop — refuse loudly instead
+            bottom0 = lay.as_list("bottom")[0]
+            if bottom0 not in bn_tops:
+                raise NotImplementedError(
+                    f"standalone Scale layer {name!r} (bottom "
+                    f"{bottom0!r} is not a BatchNorm top) is not "
+                    "supported — its gamma/beta would be dropped")
+            tops[top] = tops[bottom0]
+        elif ltype == "Concat":
+            p = lay.get("concat_param", {})
+            tops[top] = mx.sym.Concat(*ins, dim=_ints(p.get("axis"), 1),
+                                      name=name)
+        elif ltype == "Eltwise":
+            p = lay.get("eltwise_param", {})
+            op = {"SUM": "sum", "PROD": "prod", "MAX": "max"}.get(
+                p.get("operation", "SUM"), "sum")
+            acc = ins[0]
+            for other in ins[1:]:
+                acc = (acc + other if op == "sum" else
+                       acc * other if op == "prod" else
+                       mx.sym.maximum(acc, other))
+            tops[top] = acc
+        elif ltype == "Flatten":
+            tops[top] = mx.sym.Flatten(ins[0], name=name)
+        elif ltype == "Softmax":
+            tops[top] = mx.sym.softmax(ins[0], axis=1)
+        elif ltype == "SoftmaxWithLoss":
+            tops[top] = mx.sym.SoftmaxOutput(ins[0], name="softmax")
+        elif ltype in ("Accuracy", "Silence"):
+            continue
+        else:
+            raise NotImplementedError(
+                f"caffe layer type {ltype!r} ({name}) not supported")
+        last = top
+
+    if last is None:
+        raise ValueError(
+            f"{prototxt_fname}: no convertible layers found")
+    return tops[last], input_name, input_dim

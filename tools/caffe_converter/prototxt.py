@@ -1,0 +1,117 @@
+"""Minimal protobuf TEXT-FORMAT parser for Caffe prototxt files
+(parity: tools/caffe_converter/caffe_parser.py — the reference parses
+via the caffe_pb2 schema compiled from its bundled caffe.proto; this
+environment has no caffe, so a schema-free text parser produces the
+same nested structure: repeated keys collect into lists).
+
+Grammar handled (the whole of what prototxt uses):
+    message   :=  (field)*
+    field     :=  name ':' scalar  |  name '{' message '}'
+    scalar    :=  number | "string" | 'string' | enum_token
+Comments (#...) stripped; enums stay strings.
+"""
+
+
+class Msg(dict):
+    """dict where repeated fields accumulate into lists."""
+
+    def add(self, key, value):
+        if key in self:
+            cur = self[key]
+            if isinstance(cur, list):
+                cur.append(value)
+            else:
+                self[key] = [cur, value]
+        else:
+            self[key] = value
+
+    def as_list(self, key):
+        v = self.get(key, [])
+        return v if isinstance(v, list) else [v]
+
+
+def _tokenize(text):
+    out, i, n = [], 0, len(text)
+    while i < n:
+        c = text[i]
+        if c == "#":
+            while i < n and text[i] != "\n":
+                i += 1
+        elif c in " \t\r\n,;":
+            i += 1
+        elif c in "{}:":
+            out.append(c)
+            i += 1
+        elif c in "\"'":
+            q, j = c, i + 1
+            while j < n and text[j] != q:
+                j += 1
+            out.append(("str", text[i + 1:j]))
+            i = j + 1
+        else:
+            j = i
+            while j < n and text[j] not in " \t\r\n,;{}:#\"'":
+                j += 1
+            out.append(("tok", text[i:j]))
+            i = j
+    return out
+
+
+def _scalar(tok):
+    kind, v = tok
+    if kind == "str":
+        return v
+    low = v.lower()
+    if low in ("true", "false"):
+        return low == "true"
+    try:
+        return int(v)
+    except ValueError:
+        pass
+    try:
+        return float(v)
+    except ValueError:
+        return v  # enum token (MAX, LMDB, ...)
+
+
+def parse(text):
+    """prototxt text -> Msg tree."""
+    toks = _tokenize(text)
+    pos = [0]
+
+    def message(depth=0):
+        m = Msg()
+        while pos[0] < len(toks):
+            t = toks[pos[0]]
+            if t == "}":
+                pos[0] += 1
+                return m
+            if not isinstance(t, tuple):
+                raise ValueError(f"unexpected token {t!r}")
+            name = t[1]
+            pos[0] += 1
+            t2 = toks[pos[0]]
+            if t2 == ":":
+                pos[0] += 1
+                nxt = toks[pos[0]]
+                if nxt == "{":  # 'name: {...}' is legal text format
+                    pos[0] += 1
+                    m.add(name, message(depth + 1))
+                else:
+                    m.add(name, _scalar(nxt))
+                    pos[0] += 1
+            elif t2 == "{":
+                pos[0] += 1
+                m.add(name, message(depth + 1))
+            else:
+                raise ValueError(f"expected ':' or '{{' after {name}")
+        if depth:
+            raise ValueError("unbalanced braces")
+        return m
+
+    return message()
+
+
+def read_prototxt(fname):
+    with open(fname) as f:
+        return parse(f.read())

