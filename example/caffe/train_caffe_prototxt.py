@@ -23,31 +23,6 @@ from convert_symbol import convert_symbol  # noqa: E402
 sys.path.insert(0, os.path.join(HERE, ".."))
 from utils.get_data import mnist_iterator  # noqa: E402
 
-LENET = """
-name: "CaffeLeNet"
-input: "data"
-input_dim: 64
-input_dim: 1
-input_dim: 28
-input_dim: 28
-layer { name: "conv1" type: "Convolution" bottom: "data" top: "conv1"
-  convolution_param { num_output: 8 kernel_size: 5 pad: 2 } }
-layer { name: "relu1" type: "ReLU" bottom: "conv1" top: "conv1" }
-layer { name: "pool1" type: "Pooling" bottom: "conv1" top: "pool1"
-  pooling_param { pool: MAX kernel_size: 2 stride: 2 } }
-layer { name: "conv2" type: "Convolution" bottom: "pool1" top: "conv2"
-  convolution_param { num_output: 16 kernel_size: 5 pad: 2 } }
-layer { name: "relu2" type: "ReLU" bottom: "conv2" top: "conv2" }
-layer { name: "pool2" type: "Pooling" bottom: "conv2" top: "pool2"
-  pooling_param { pool: MAX kernel_size: 2 stride: 2 } }
-layer { name: "ip1" type: "InnerProduct" bottom: "pool2" top: "ip1"
-  inner_product_param { num_output: 64 } }
-layer { name: "relu3" type: "ReLU" bottom: "ip1" top: "ip1" }
-layer { name: "ip2" type: "InnerProduct" bottom: "ip1" top: "ip2"
-  inner_product_param { num_output: 10 } }
-layer { name: "loss" type: "SoftmaxWithLoss" bottom: "ip2" top: "loss" }
-"""
-
 
 def main():
     ap = argparse.ArgumentParser()
@@ -57,8 +32,6 @@ def main():
     args = ap.parse_args()
 
     proto_path = os.path.join(HERE, "lenet.prototxt")
-    with open(proto_path, "w") as f:
-        f.write(LENET)
     sym, input_name, input_dim = convert_symbol(proto_path)
     print("converted %s: input %s %s, outputs %s"
           % (proto_path, input_name, input_dim, sym.list_outputs()))

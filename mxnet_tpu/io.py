@@ -263,6 +263,13 @@ class PrefetchingIter(DataIter):
         self._queue: _queue.Queue = _queue.Queue(maxsize=self._depth)
         self._stop = threading.Event()
         self._thread = None
+        global _live_prefetchers
+        if _live_prefetchers is None:
+            import atexit
+            import weakref
+            _live_prefetchers = weakref.WeakSet()
+            atexit.register(_close_live_prefetchers)
+        _live_prefetchers.add(self)
         self._start()
 
     @property
@@ -321,8 +328,39 @@ class PrefetchingIter(DataIter):
     def iter_next(self):
         raise NotImplementedError
 
-    def __del__(self):
+    def close(self):
+        """Stop the prefetch worker and drain the buffer.  Registered
+        atexit: a daemon worker mid-XLA-dispatch at interpreter
+        teardown aborts the process ('terminate called without an
+        active exception'), so every live prefetcher is stopped before
+        the runtime goes away."""
         self._stop.set()
+        try:
+            while True:
+                self._queue.get_nowait()
+        except _queue.Empty:
+            pass
+        t = self._thread
+        if t is not None and t.is_alive():
+            t.join(timeout=5)
+        self._thread = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+_live_prefetchers: "weakref.WeakSet[PrefetchingIter]" = None  # type: ignore
+
+
+def _close_live_prefetchers():
+    for it in list(_live_prefetchers or ()):
+        try:
+            it.close()
+        except Exception:
+            pass
 
 
 class MNISTIter(DataIter):
@@ -520,12 +558,20 @@ def ImageDetRecordIter(path_imgrec, data_shape, batch_size, **kwargs):
 def ImageRecordIter(path_imgrec, data_shape, batch_size, label_width=1,
                     shuffle=False, mean_r=0., mean_g=0., mean_b=0., std_r=1.,
                     std_g=1., std_b=1., rand_crop=False, rand_mirror=False,
-                    preprocess_threads=4, prefetch_buffer=4, **kwargs):
+                    preprocess_threads=4, prefetch_buffer=4,
+                    device_augment=False, device_dtype="float32", **kwargs):
     """RecordIO-backed image iterator (parity: src/io/iter_image_recordio_2.cc).
 
     Decodes JPEG/pack payloads from a .rec file and yields augmented NCHW
     batches; heavy decode runs in the prefetch thread.
-    """
+
+    `device_augment=True` is the TPU-first split of the pipeline: the
+    host pays JPEG decode + geometric crops ONLY and uploads the batch
+    as uint8 NHWC (4x fewer host->device bytes); mirror/cast/mean-std/
+    transpose run as one fused XLA program on the accelerator, where
+    that elementwise work is HBM-trivial.  `device_dtype` selects the
+    on-device output dtype (e.g. "bfloat16" to feed the bf16-resident
+    train step with no extra cast)."""
     from .image import ImageRecordIterPy
     it = ImageRecordIterPy(path_imgrec=path_imgrec, data_shape=tuple(data_shape),
                            batch_size=batch_size, label_width=label_width,
@@ -535,6 +581,9 @@ def ImageRecordIter(path_imgrec, data_shape, batch_size, label_width=1,
                            rand_crop=rand_crop, rand_mirror=rand_mirror,
                            preprocess_threads=preprocess_threads,
                            **kwargs)
+    if device_augment:
+        it._device_augment = True
+        it._device_dtype = device_dtype
     return PrefetchingIter(it, depth=int(prefetch_buffer))
 
 

@@ -143,3 +143,50 @@ def test_data_desc_provide():
     it = mx.io.NDArrayIter(x, batch_size=3)
     d = it.provide_data[0]
     assert d.shape == (3, 2, 3)
+
+
+def test_image_record_iter_device_augment_matches_host(tmp_path):
+    """device_augment=True (uint8 upload + fused on-device mirror/cast/
+    normalize/transpose) must produce the same batches as the host
+    numpy pipeline."""
+    from mxnet_tpu import recordio
+    rec = str(tmp_path / "t.rec")
+    rs = np.random.RandomState(0)
+    w = recordio.MXRecordIO(rec, "w")
+    for i in range(8):
+        img = (rs.rand(20, 20, 3) * 255).astype(np.uint8)
+        w.write(recordio.pack_img(recordio.IRHeader(0, float(i), i, 0),
+                                  img, quality=95, img_fmt=".png"))
+    w.close()
+
+    kw = dict(path_imgrec=rec, data_shape=(3, 16, 16), batch_size=4,
+              mean_r=123.7, mean_g=116.3, mean_b=103.5,
+              std_r=58.4, std_g=57.1, std_b=57.4,
+              preprocess_threads=1, prefetch_buffer=1)
+    host = mx.io.ImageRecordIter(**kw)
+    dev = mx.io.ImageRecordIter(device_augment=True, **kw)
+    for bh, bd in zip(host, dev):
+        assert bd.data[0].dtype == np.float32
+        np.testing.assert_allclose(bh.data[0].asnumpy(),
+                                   bd.data[0].asnumpy(),
+                                   rtol=1e-5, atol=1e-4)
+        np.testing.assert_array_equal(bh.label[0].asnumpy(),
+                                      bd.label[0].asnumpy())
+
+    # bf16 output dtype for feeding bf16-resident training directly
+    dev16 = mx.io.ImageRecordIter(device_augment=True,
+                                  device_dtype="bfloat16", **kw)
+    b = next(iter(dev16))
+    assert str(b.data[0].dtype) == "bfloat16"
+
+    # rand_mirror: every device image must be the host image or its
+    # horizontal flip
+    host_m = mx.io.ImageRecordIter(rand_mirror=True, **kw)
+    dev_m = mx.io.ImageRecordIter(rand_mirror=True, device_augment=True,
+                                  **kw)
+    bh = next(iter(host_m)).data[0].asnumpy()
+    bd = next(iter(dev_m)).data[0].asnumpy()
+    for i in range(4):
+        match = (np.allclose(bd[i], bh[i], atol=1e-4) or
+                 np.allclose(bd[i], bh[i][:, :, ::-1], atol=1e-4))
+        assert match, i

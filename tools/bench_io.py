@@ -41,6 +41,9 @@ def main():
     ap.add_argument("--threads", type=int, default=8)
     ap.add_argument("--epochs", type=int, default=2)
     ap.add_argument("--rec", type=str, default="")
+    ap.add_argument("--device-augment", action="store_true",
+                    help="host decodes to uint8; mirror/normalize/"
+                         "transpose fuse into one on-device program")
     args = ap.parse_args()
 
     import mxnet_tpu as mx
@@ -55,17 +58,25 @@ def main():
         path_imgrec=rec, data_shape=(3, args.image_size, args.image_size),
         batch_size=args.batch_size, preprocess_threads=args.threads,
         rand_mirror=True, mean_r=123.7, mean_g=116.3, mean_b=103.5,
-        std_r=58.4, std_g=57.1, std_b=57.4)
-    # warm epoch (thread pool spin-up, file cache)
+        std_r=58.4, std_g=57.1, std_b=57.4,
+        device_augment=args.device_augment)
+    # warm epoch (thread pool spin-up, file cache, XLA compile for the
+    # device_augment program)
     n = 0
     for b in it:
         n += b.data[0].shape[0]
     it.reset()
     t0 = time.perf_counter()
     total = 0
+    last = None
     for _ in range(args.epochs):
         for b in it:
             total += b.data[0].shape[0]
+            last = b.data[0]
+        # fair under async dispatch: execution is FIFO per device, so a
+        # host fetch of the LAST batch proves every queued augmentation
+        # program retired before the clock stops
+        float(np.asarray(last.asnumpy()).ravel()[0])
         it.reset()
     dt = time.perf_counter() - t0
     print(f"decode+augment throughput: {total / dt:.1f} img/s "

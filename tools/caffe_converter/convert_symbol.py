@@ -44,13 +44,15 @@ _V1_TYPES = {
 
 
 def _pair(param, base, h, w, default=0):
-    """Caffe kernel/stride/pad: either `kernel_size: k` (square, maybe
-    repeated) or kernel_h/kernel_w."""
+    """Caffe kernel/stride/pad: `kernel_size: k` (square), the repeated
+    per-spatial-axis form `kernel_size: kh kernel_size: kw`, or
+    kernel_h/kernel_w."""
     if h in param or w in param:
         return (_ints(param.get(h), default), _ints(param.get(w), default))
     v = param.get(base, default)
     if isinstance(v, list):
-        v = v[0]
+        return (_ints(v[0], default),
+                _ints(v[1] if len(v) > 1 else v[0], default))
     return (_ints(v, default), _ints(v, default))
 
 

@@ -84,6 +84,8 @@ def parse(text):
         while pos[0] < len(toks):
             t = toks[pos[0]]
             if t == "}":
+                if depth == 0:
+                    raise ValueError("unbalanced braces: stray '}'")
                 pos[0] += 1
                 return m
             if not isinstance(t, tuple):
