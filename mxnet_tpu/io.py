@@ -304,14 +304,7 @@ class PrefetchingIter(DataIter):
         self._thread.start()
 
     def reset(self):
-        self._stop.set()
-        try:
-            while True:
-                self._queue.get_nowait()
-        except _queue.Empty:
-            pass
-        if self._thread is not None:
-            self._thread.join(timeout=5)
+        self.close()
         self.iter.reset()
         self._stop = threading.Event()
         self._queue = _queue.Queue(maxsize=self._depth)

@@ -747,3 +747,22 @@ def test_caffe_prototxt_example():
     acc = float([l for l in out.splitlines()
                  if "validation accuracy" in l][0].rsplit(" ", 1)[-1])
     assert acc > 0.7, out
+
+
+def test_train_imagenet_rec_device_augment(tmp_path):
+    """The north-star rec-file path end to end: pack a tiny JPEG .rec,
+    train resnet-8 on it with the device-augment input split (the
+    default), bf16 data dtype."""
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import importlib
+    bench_io = importlib.import_module("bench_io")
+    rec = str(tmp_path / "tiny.rec")
+    bench_io.pack(rec, 96, 40)
+    out = run_example("example/image-classification/train_imagenet.py",
+                      "--data-train", rec, "--network", "resnet",
+                      "--num-layers", "8", "--num-classes", "10",
+                      "--num-examples", "96", "--image-shape", "3,32,32",
+                      "--batch-size", "32", "--num-epochs", "1",
+                      "--lr", "0.05", "--device-augment", "1",
+                      timeout=560)
+    assert "Epoch[0]" in out, out
