@@ -766,3 +766,18 @@ def test_train_imagenet_rec_device_augment(tmp_path):
                       "--lr", "0.05", "--device-augment", "1",
                       timeout=560)
     assert "Epoch[0]" in out, out
+
+
+def test_sparse_benchmark_harness():
+    out = run_example("benchmark/python/sparse/sparse_bench.py",
+                      "--quick", timeout=560)
+    assert "sparse bench done" in out
+    assert "grad stype=row_sparse" in out  # rows-only path exercised
+
+
+def test_setup_py_metadata():
+    proc = subprocess.run([sys.executable, os.path.join(REPO, "setup.py"),
+                           "--version"], env=ENV, cwd=REPO,
+                          capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert proc.stdout.strip().startswith("1."), proc.stdout
