@@ -46,6 +46,16 @@ def _write_artifact(completed):
         "elapsed_s": round(time.time() - _STATE["t0"], 1),
         "completed": completed, "summary": summary, "cases": res,
     }
+    # distinguish a real flash-kernel pass from the dense fallback the
+    # op takes when the tunnel's remote Mosaic helper is down
+    try:
+        from mxnet_tpu.ops import flash_attention as _fa
+        if _fa._PALLAS_OK is not None:
+            doc["pallas_available"] = bool(_fa._PALLAS_OK)
+            if _fa._PALLAS_ERR:
+                doc["pallas_error"] = _fa._PALLAS_ERR
+    except Exception:
+        pass
     with _WLOCK:
         tmp = _STATE["out"] + ".tmp"
         with open(tmp, "w") as f:
@@ -168,8 +178,20 @@ def main():
         _run_case(name, fn, args.case_budget * mult)
 
     _WD.finish()
+    # a flash case that "passed" via the dense fallback (remote Mosaic
+    # helper down) must say so in its own record, not only in the
+    # top-level pallas_available flag
+    try:
+        from mxnet_tpu.ops import flash_attention as _fa
+        if _fa._PALLAS_OK is False:
+            for rec in _STATE["results"]:
+                if "flash" in rec["case"] and rec["status"] == "pass":
+                    rec["status"] = "pass-dense-fallback"
+    except Exception:
+        pass
     _write_artifact(completed=True)
-    npass = sum(1 for r in _STATE["results"] if r["status"] == "pass")
+    npass = sum(1 for r in _STATE["results"]
+                if r["status"].startswith("pass"))
     print("DONE: %d/%d pass -> %s" % (npass, len(_STATE["results"]),
                                       args.out), flush=True)
     os._exit(0 if npass == len(_STATE["results"]) else 1)
