@@ -56,7 +56,13 @@ def _maybe_init_distributed():
     nproc = int(os.environ.get("MXT_NUM_PROC", "1") or 1)
     if not coord or nproc <= 1:
         return
-    pid = int(os.environ.get("MXT_PROC_ID", "0") or 0)
+    pid = os.environ.get("MXT_PROC_ID")
+    if pid is None:
+        # mpirun placement (tools/launch.py --launcher mpi): the rank
+        # comes from the MPI runtime's own env
+        pid = (os.environ.get("OMPI_COMM_WORLD_RANK")
+               or os.environ.get("PMI_RANK") or "0")
+    pid = int(pid or 0)
     try:
         _jax.distributed.initialize(coord, nproc, pid)
     except RuntimeError as e:

@@ -44,3 +44,37 @@ def test_dist_sync_kvstore_two_processes(local_devices):
     out = proc.stdout + proc.stderr
     assert proc.returncode == 0, out
     assert "DIST_OK rank=0" in out and "DIST_OK rank=1" in out, out
+
+
+def test_launch_ssh_mpi_dry_run(tmp_path):
+    """The ssh/mpi launch backends generate correct per-rank plans
+    (reference dmlc_tracker ssh/mpi roles) — validated via --dry-run."""
+    import subprocess
+    hosts = tmp_path / "hosts"
+    hosts.write_text("nodeA\nnodeB\n# comment\n")
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools/launch.py"),
+         "-n", "2", "--launcher", "ssh", "--hostfile", str(hosts),
+         "--remote-cwd", "/work", "--dry-run",
+         "python", "train.py", "--kv-store", "dist_sync"],
+        env=env, capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stdout + out.stderr
+    lines = [l for l in out.stdout.splitlines() if l.startswith("ssh:")]
+    assert len(lines) == 2
+    assert "nodeA" in lines[0] and "MXT_PROC_ID=0" in lines[0]
+    assert "nodeB" in lines[1] and "MXT_PROC_ID=1" in lines[1]
+    # coordinator rewritten onto worker-0's host
+    assert "MXT_COORDINATOR=nodeA:8431" in lines[0]
+    assert "cd /work" in lines[0]
+
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools/launch.py"),
+         "-n", "4", "--launcher", "mpi", "--dry-run",
+         "python", "train.py"],
+        env=env, capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stdout + out.stderr
+    line = [l for l in out.stdout.splitlines() if l.startswith("mpi:")][0]
+    assert "mpirun -np 4" in line
+    assert "MXT_PROC_ID" not in line  # per-rank, from the MPI env
+    assert "MXT_NUM_PROC=4" in line

@@ -172,7 +172,9 @@ def main():
     # first case absorbs backend init; the full-model cases pay TWO
     # fwd+bwd XLA compiles (CPU reference + accelerator) — the r04c
     # window showed resnet50 needs >180s of pure compile on-chip
-    heavy = ("resnet50", "transformer_lm", "gluon_lstm")
+    # "flash": its first case may run the Pallas-availability subprocess
+    # probe (up to 150s) on top of its own compile
+    heavy = ("resnet50", "transformer_lm", "gluon_lstm", "flash")
     for i, (name, fn) in enumerate(cases):
         mult = 3 if (i == 0 or any(h in name for h in heavy)) else 1
         _run_case(name, fn, args.case_budget * mult)
