@@ -59,10 +59,19 @@ def _maybe_init_distributed():
     pid = os.environ.get("MXT_PROC_ID")
     if pid is None:
         # mpirun placement (tools/launch.py --launcher mpi): the rank
-        # comes from the MPI runtime's own env
+        # comes from the MPI runtime's own env.  No rank var at all is
+        # a misconfiguration — every process would claim rank 0 and the
+        # coordinator would wait forever; fail fast instead.
         pid = (os.environ.get("OMPI_COMM_WORLD_RANK")
-               or os.environ.get("PMI_RANK") or "0")
-    pid = int(pid or 0)
+               or os.environ.get("PMI_RANK")
+               or os.environ.get("PMIX_RANK"))
+        if pid is None:
+            raise MXNetError(
+                "MXT_NUM_PROC=%d but no process rank found: set "
+                "MXT_PROC_ID (tools/launch.py does) or launch under "
+                "mpirun (OMPI_COMM_WORLD_RANK/PMI_RANK/PMIX_RANK)"
+                % nproc)
+    pid = int(pid)
     try:
         _jax.distributed.initialize(coord, nproc, pid)
     except RuntimeError as e:

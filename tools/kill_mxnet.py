@@ -21,7 +21,10 @@ def find_workers(pattern=None):
             continue
         try:
             environ = open(f"/proc/{pid}/environ", "rb").read()
-            if b"MXT_PROC_ID=" not in environ:
+            # local/ssh workers carry MXT_PROC_ID; mpi workers get their
+            # rank from the MPI env and carry only MXT_NUM_PROC
+            if (b"MXT_PROC_ID=" not in environ
+                    and b"MXT_NUM_PROC=" not in environ):
                 continue
             if pattern:
                 cmdline = open(f"/proc/{pid}/cmdline", "rb").read()

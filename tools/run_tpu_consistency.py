@@ -137,7 +137,9 @@ def main():
     for fname in ("test_fc_grad_consistency",
                   "test_resnet50_fwd_bwd_consistency",
                   "test_gluon_lstm_consistency",
-                  "test_transformer_lm_consistency"):
+                  "test_transformer_lm_consistency",
+                  "test_mirror_segments_consistency",
+                  "test_device_augment_consistency"):
         cases.append((fname.replace("test_", ""),
                       lambda f=getattr(tc, fname): f()))
 
@@ -174,7 +176,8 @@ def main():
     # window showed resnet50 needs >180s of pure compile on-chip
     # "flash": its first case may run the Pallas-availability subprocess
     # probe (up to 150s) on top of its own compile
-    heavy = ("resnet50", "transformer_lm", "gluon_lstm", "flash")
+    heavy = ("resnet50", "transformer_lm", "gluon_lstm", "flash",
+             "mirror_segments", "device_augment")
     for i, (name, fn) in enumerate(cases):
         mult = 3 if (i == 0 or any(h in name for h in heavy)) else 1
         _run_case(name, fn, args.case_budget * mult)
