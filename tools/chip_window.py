@@ -151,6 +151,10 @@ def main():
                          "when the layout step is skipped (a re-armed "
                          "poller otherwise measures the default layout "
                          "with no warning)")
+    ap.add_argument("--consistency-subset", default=None,
+                    help="pass --only SUBSET to the consistency step — "
+                         "lets a re-armed poller validate just the "
+                         "cases added since the last harvested window")
     args = ap.parse_args()
     steps = {s.strip() for s in args.steps.split(",") if s.strip()}
     known = {"consistency", "layout", "nhwc", "profile", "bench", "score"}
@@ -193,10 +197,11 @@ def main():
 
     # 1. correctness first — the artifact no round has ever produced
     if "consistency" in steps:
-        _run("consistency",
-             [sys.executable, "tools/run_tpu_consistency.py",
-              "--out", os.path.join(REPO, f"CONSISTENCY_{tag}.json")],
-             args.step_timeout * 2, summary_path)
+        cmd = [sys.executable, "tools/run_tpu_consistency.py",
+               "--out", os.path.join(REPO, f"CONSISTENCY_{tag}.json")]
+        if args.consistency_subset:
+            cmd += ["--only", args.consistency_subset]
+        _run("consistency", cmd, args.step_timeout * 2, summary_path)
 
     # 2. layout/precision A/B (raw JAX ceiling probe)
     winner = (layout_ab(summary_path, args.batch, args.step_timeout)
