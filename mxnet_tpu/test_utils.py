@@ -496,7 +496,12 @@ def golden_model_cases():
 
     return {
         "resnet18_v1": _vision_case(_vision.resnet18_v1),
+        "resnet18_v2": _vision_case(_vision.resnet18_v2),
         "mobilenet0_25": _vision_case(_vision.mobilenet0_25),
+        "squeezenet1_0": _vision_case(_vision.squeezenet1_0),
+        # densenet's final AvgPool2D(7) assumes the 224 input contract
+        "densenet121": _vision_case(_vision.densenet121,
+                                    shape=(1, 3, 224, 224)),
         "transformer_lm": _lm_case(),
     }
 

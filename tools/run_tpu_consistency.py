@@ -177,7 +177,7 @@ def main():
     # "flash": its first case may run the Pallas-availability subprocess
     # probe (up to 150s) on top of its own compile
     heavy = ("resnet50", "transformer_lm", "gluon_lstm", "flash",
-             "mirror_segments", "device_augment")
+             "mirror_segments", "device_augment", "densenet")
     for i, (name, fn) in enumerate(cases):
         mult = 3 if (i == 0 or any(h in name for h in heavy)) else 1
         _run_case(name, fn, args.case_budget * mult)
