@@ -781,3 +781,18 @@ def test_setup_py_metadata():
                           capture_output=True, text=True, timeout=120)
     assert proc.returncode == 0, proc.stdout + proc.stderr
     assert proc.stdout.strip().startswith("1."), proc.stdout
+
+
+def test_tutorial_template_notebook(tmp_path):
+    import json
+    nb = json.load(open(os.path.join(REPO,
+                                     "example/MXNetTutorialTemplate.ipynb")))
+    script = "\n\n".join("".join(c["source"]) for c in nb["cells"]
+                         if c["cell_type"] == "code")
+    p = tmp_path / "tpl.py"
+    p.write_text(script)
+    proc = subprocess.run([sys.executable, str(p)], env=ENV,
+                          cwd=str(tmp_path), capture_output=True,
+                          text=True, timeout=300)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "accuracy" in proc.stdout
