@@ -796,3 +796,10 @@ def test_tutorial_template_notebook(tmp_path):
                           text=True, timeout=300)
     assert proc.returncode == 0, proc.stdout + proc.stderr
     assert "accuracy" in proc.stdout
+
+
+def test_gen_op_docs_tool(tmp_path):
+    out = run_example("tools/gen_op_docs.py", timeout=300)
+    assert "wrote" in out
+    doc = open(os.path.join(REPO, "docs/api_ops.md")).read()
+    assert "## `Convolution`" in doc and "num_filter" in doc
