@@ -1,0 +1,86 @@
+"""Convert a trained SSD training checkpoint into a deploy-only
+detection network (parity: /root/reference/example/ssd/deploy.py —
+strips MultiBoxTarget/losses, leaving image → (id, score, box)
+detections; the deployable two-file checkpoint loads through
+`mxnet_tpu.predictor.Predictor` (c_predict_api role) or exports AOT).
+
+    python deploy.py --prefix ssd --epoch 2 [--aot out_dir]
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+import mxnet_tpu as mx
+
+from train_ssd import build_ssd_body  # noqa: E402 — SHARED factory
+
+
+def build_deploy_ssd(num_classes, ratios=(1.0, 2.0, 0.5),
+                     nms_threshold=0.5):
+    """The inference subgraph: the SAME body factory the training graph
+    uses (param names/anchors stay in lockstep by construction), no
+    label/targets/losses — softmax over class logits + MultiBoxDetection
+    decode is the whole head."""
+    cls_pred, loc_pred, anchor = build_ssd_body(num_classes, ratios)
+    cls_prob = mx.sym.softmax(cls_pred, axis=1)
+    det = mx.sym.MultiBoxDetection(cls_prob, loc_pred, anchor,
+                                   name="detection",
+                                   nms_threshold=nms_threshold)
+    return det
+
+
+def latest_epoch(prefix):
+    """Newest <prefix>-NNNN.params next to the symbol file."""
+    import glob
+    import re
+    cands = []
+    for p in glob.glob(prefix + "-*.params"):
+        m = re.search(r"-(\d{4})\.params$", p)
+        if m:
+            cands.append(int(m.group(1)))
+    if not cands:
+        raise SystemExit(f"no {prefix}-*.params checkpoints found")
+    return max(cands)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--prefix", default="ssd")
+    ap.add_argument("--epoch", type=int, default=None,
+                    help="default: newest <prefix>-*.params")
+    ap.add_argument("--num-classes", type=int, default=3)
+    ap.add_argument("--nms-threshold", type=float, default=0.5)
+    ap.add_argument("--aot", default=None,
+                    help="also AOT-export (StableHLO dir) for serving")
+    ap.add_argument("--data-shape", default="1,3,32,32")
+    args = ap.parse_args()
+
+    if args.epoch is None:
+        args.epoch = latest_epoch(args.prefix)
+    _, arg_params, aux_params = mx.model.load_checkpoint(args.prefix,
+                                                         args.epoch)
+    det = build_deploy_ssd(args.num_classes,
+                           nms_threshold=args.nms_threshold)
+    # deploy params = the subset the inference graph still references
+    keep = set(det.list_arguments()) | set(det.list_auxiliary_states())
+    arg_params = {k: v for k, v in arg_params.items() if k in keep}
+    aux_params = {k: v for k, v in aux_params.items() if k in keep}
+    out_prefix = args.prefix + "-deploy"
+    mx.model.save_checkpoint(out_prefix, args.epoch, det, arg_params,
+                             aux_params)
+    print("deployed %s-%04d -> %s-symbol.json (+params): outputs %s"
+          % (args.prefix, args.epoch, out_prefix, det.list_outputs()))
+
+    if args.aot:
+        from mxnet_tpu.export import export_checkpoint
+        shape = tuple(int(d) for d in args.data_shape.split(","))
+        export_checkpoint(out_prefix, args.epoch, {"data": shape},
+                          args.aot)
+        print("AOT-exported to %s" % args.aot)
+
+
+if __name__ == "__main__":
+    main()

@@ -31,11 +31,13 @@ def conv_act(data, num_filter, name, stride=(1, 1)):
     return mx.sym.Activation(b, act_type="relu", name=name + "_relu")
 
 
-def build_ssd(num_classes, ratios=(1.0, 2.0, 0.5)):
-    """Tiny SSD: two detection scales over a 4-conv backbone."""
+def build_ssd_body(num_classes, ratios=(1.0, 2.0, 0.5)):
+    """Shared inference subgraph (backbone + multi-scale heads +
+    priors): returns (cls_pred (N,C+1,A), loc_pred (N,A*4), anchor
+    (1,A,4)).  ONE factory serves both the training graph below and
+    example/ssd/deploy.py (the reference splits the same way via
+    symbol_factory) — edits here propagate to both."""
     data = mx.sym.Variable("data")
-    label = mx.sym.Variable("label")
-
     body = conv_act(data, 16, "c1")
     body = conv_act(body, 32, "c2", stride=(2, 2))   # 16x16
     scale1 = conv_act(body, 32, "c3")
@@ -64,6 +66,13 @@ def build_ssd(num_classes, ratios=(1.0, 2.0, 0.5)):
     cls_pred = mx.sym.transpose(cls_pred, axes=(0, 2, 1))  # (N, C+1, A)
     loc_pred = mx.sym.Concat(*loc_preds, dim=1)            # (N, A*4)
     anchor = mx.sym.Concat(*anchors, dim=1)                # (1, A, 4)
+    return cls_pred, loc_pred, anchor
+
+
+def build_ssd(num_classes, ratios=(1.0, 2.0, 0.5)):
+    """Tiny SSD training graph: shared body + targets/losses."""
+    label = mx.sym.Variable("label")
+    cls_pred, loc_pred, anchor = build_ssd_body(num_classes, ratios)
 
     loc_t, loc_m, cls_t = mx.sym.MultiBoxTarget(
         anchor, label, cls_pred, overlap_threshold=0.5,
@@ -156,6 +165,9 @@ def main():
                         "synthetic = in-memory batches")
     p.add_argument("--rec-path", type=str, default="")
     p.add_argument("--num-examples", type=int, default=320)
+    p.add_argument("--save-prefix", type=str, default="",
+                   help="save a checkpoint after training (feeds "
+                        "deploy.py)")
     args = p.parse_args()
 
     net = build_ssd(args.num_classes)
@@ -219,6 +231,11 @@ def main():
     det = outs[3].asnumpy()
     kept = (det[:, :, 0] >= 0).sum()
     logging.info("detections kept after NMS: %d", int(kept))
+
+    if args.save_prefix:
+        mod.save_checkpoint(args.save_prefix, args.epochs)
+        logging.info("saved %s-%04d.params (deploy with deploy.py)",
+                     args.save_prefix, args.epochs)
 
 
 if __name__ == "__main__":

@@ -799,7 +799,33 @@ def test_tutorial_template_notebook(tmp_path):
 
 
 def test_gen_op_docs_tool(tmp_path):
-    out = run_example("tools/gen_op_docs.py", timeout=300)
+    target = str(tmp_path / "api_ops.md")
+    out = run_example("tools/gen_op_docs.py", target, timeout=300)
     assert "wrote" in out
-    doc = open(os.path.join(REPO, "docs/api_ops.md")).read()
+    doc = open(target).read()
     assert "## `Convolution`" in doc and "num_filter" in doc
+
+
+def test_ssd_deploy_predictor(tmp_path):
+    """Train tiny SSD -> save -> deploy.py strips the training head ->
+    the deploy checkpoint serves through the Predictor (c_predict_api
+    role) and yields (N, anchors, 6) decoded detections."""
+    prefix = str(tmp_path / "ssd")
+    run_example("example/ssd/train_ssd.py", "--epochs", "1",
+                "--batches-per-epoch", "6", "--data-source", "synthetic",
+                "--save-prefix", prefix, timeout=560)
+    out = run_example("example/ssd/deploy.py", "--prefix", prefix,
+                      timeout=560)  # epoch auto-detected (newest)
+    assert "deployed" in out, out
+
+    from mxnet_tpu import predictor
+    sym_json = open(prefix + "-deploy-symbol.json").read()
+    params = open(prefix + "-deploy-0001.params", "rb").read()
+    pred = predictor.Predictor(sym_json, params,
+                               {"data": (2, 3, 32, 32)})
+    x = np.random.RandomState(0).normal(0, 1, (2, 3, 32, 32)).astype("f")
+    pred.set_input("data", x)
+    pred.forward()
+    det = pred.get_output(0)
+    assert det.ndim == 3 and det.shape[0] == 2 and det.shape[2] == 6, \
+        det.shape

@@ -85,7 +85,8 @@ def main():
               "through autograd or frontend helpers:", "",
               ", ".join("`%s`" % n for n in sorted(internal)), ""]
 
-    out = os.path.join(REPO, "docs", "api_ops.md")
+    out = (sys.argv[1] if len(sys.argv) > 1
+           else os.path.join(REPO, "docs", "api_ops.md"))
     with open(out, "w") as f:
         f.write("\n".join(lines))
     print("wrote %s (%d public ops, %d KB)"
