@@ -1,0 +1,150 @@
+"""Would a single fused train-step close the framework-vs-raw gap?
+
+The product fit path runs 3 programs/step (fused fwd+bwd, fused
+multi-tensor update, metric).  The raw-JAX probe (layout_probe.py)
+runs ONE donated program and is ~20 ms/step faster at BS=256 than the
+product path even after the dispatch/transfer fixes.  This probe
+answers the attribution question by running the FRAMEWORK'S OWN
+GraphPlan (the exact zoo resnet50_v1 symbol graph the bench compiles)
+inside one jitted step with the update fused in-graph and params
+donated — i.e. the raw probe's structure with the framework's graph.
+
+  fw3:   framework 3-program structure (plan fwd+bwd, then update)
+  fused: plan fwd+bwd + sgd_mom update in ONE program, donate params
+
+If fused ≈ raw ceiling, the gap is program-boundary overhead and a
+product fused-step path is worth building; if fused ≈ fw3, the gap
+lives inside the plan's compiled code vs the hand-rolled model.
+
+    B=256 python experiments/fused_step_probe.py
+"""
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import mxnet_tpu as mx
+
+B = int(os.environ.get("B", 256))
+IMG = int(os.environ.get("IMG", 224))
+N = int(os.environ.get("N", 20))
+
+
+def sync(x):
+    float(np.asarray(x).ravel()[0])
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu.gluon.model_zoo import vision
+    from mxnet_tpu.io import DataDesc
+
+    net = vision.resnet50_v1()
+    out = net(mx.sym.Variable("data"))
+    out = mx.sym.SoftmaxOutput(out, name="softmax")
+    mod = mx.mod.Module(out, context=(mx.tpu() if mx.context.num_tpus()
+                                      else mx.cpu()))
+    mod.bind(data_shapes=[DataDesc("data", (B, 3, IMG, IMG),
+                                   np.dtype("bfloat16"))],
+             label_shapes=[DataDesc("softmax_label", (B,), np.float32)])
+    mod.init_params(mx.init.Xavier(rnd_type="gaussian", factor_type="in",
+                                   magnitude=2))
+    ex = mod._exec
+    plan = ex._plan
+    rs = np.random.RandomState(0)
+    x = jnp.asarray(rs.normal(0, 1, (B, 3, IMG, IMG)), jnp.bfloat16)
+    y = jnp.asarray(rs.randint(0, 1000, (B,)).astype("f"))
+
+    arg_vals = {k: v._data for k, v in ex.arg_dict.items()}
+    aux_vals = {k: v._data for k, v in ex.aux_dict.items()}
+    grad_names = [n for n in ex._grad_names]
+    key = jax.random.PRNGKey(0)
+
+    # ---- fused: ONE program = plan fwd+bwd + sgd_mom, donated params
+    def fused_step(params, moms, aux, x, y):
+        merged = dict(params)
+        merged["data"] = x
+        merged["softmax_label"] = y
+
+        def loss_like(p):
+            m = dict(merged)
+            m.update(p)
+            outs, new_aux = plan.run(m, aux, key, True)
+            return outs, new_aux
+
+        def fwd(p):
+            outs, new_aux = loss_like(p)
+            return outs, new_aux
+
+        (outs, new_aux), vjp = jax.vjp(
+            fwd, {n: params[n] for n in grad_names}, has_aux=False)
+        cots = ([jnp.ones(o.shape, o.dtype) for o in outs],
+                jax.tree_util.tree_map(jnp.zeros_like, new_aux))
+        (grads,) = vjp(cots)
+        new_p, new_m = {}, {}
+        for n in params:
+            if n in grads:
+                g = grads[n].astype(jnp.float32)
+                m2 = 0.9 * moms[n] + g
+                new_p[n] = (params[n].astype(jnp.float32) -
+                            0.05 * m2).astype(params[n].dtype)
+                new_m[n] = m2
+            else:
+                new_p[n], new_m[n] = params[n], moms[n]
+        return new_p, new_m, new_aux, outs[0]
+
+    # COPIES: the fused leg donates its buffers each step; the executor's
+    # own param/aux buffers must survive for the fw3 leg below
+    params = {k: jnp.array(v) for k, v in arg_vals.items()
+              if k not in ("data", "softmax_label")}
+    aux_vals = {k: jnp.array(v) for k, v in aux_vals.items()}
+    moms = {k: jnp.zeros(v.shape, jnp.float32) for k, v in params.items()}
+    jf = jax.jit(fused_step, donate_argnums=(0, 1, 2))
+    t0 = time.perf_counter()
+    params, moms, aux_vals, probs = jf(params, moms, aux_vals, x, y)
+    sync(probs[:1, :1])
+    print("fused compile+first: %.1fs" % (time.perf_counter() - t0),
+          flush=True)
+    for _ in range(3):
+        params, moms, aux_vals, probs = jf(params, moms, aux_vals, x, y)
+    sync(probs[:1, :1])
+    t0 = time.perf_counter()
+    for _ in range(N):
+        params, moms, aux_vals, probs = jf(params, moms, aux_vals, x, y)
+    sync(probs[:1, :1])
+    dt = (time.perf_counter() - t0) / N
+    print("fused single-program step: %.1f ms (%.0f img/s)"
+          % (dt * 1e3, B / dt), flush=True)
+
+    # ---- fw3 reference: the product path's own forward_backward+update
+    from mxnet_tpu.io import DataBatch
+    mod.init_optimizer(kvstore="tpu_sync", optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.05,
+                                         "momentum": 0.9})
+    batch = DataBatch(data=[mx.nd.array(np.asarray(x, np.float32))
+                            .astype("bfloat16")],
+                      label=[mx.nd.array(np.asarray(y))], pad=0,
+                      index=None)
+    mod.forward_backward(batch)
+    mod.update()
+    sync(mod.get_outputs()[0].asnumpy()[:1, :1])
+    for _ in range(3):
+        mod.forward_backward(batch)
+        mod.update()
+    sync(mod.get_outputs()[0].asnumpy()[:1, :1])
+    t0 = time.perf_counter()
+    for _ in range(N):
+        mod.forward_backward(batch)
+        mod.update()
+    sync(mod.get_outputs()[0].asnumpy()[:1, :1])
+    dt3 = (time.perf_counter() - t0) / N
+    print("product 2-program step:   %.1f ms (%.0f img/s)"
+          % (dt3 * 1e3, B / dt3), flush=True)
+    print("fused/product speedup: %.2fx" % (dt3 / dt))
+
+
+if __name__ == "__main__":
+    main()

@@ -157,7 +157,8 @@ def main():
                          "cases added since the last harvested window")
     args = ap.parse_args()
     steps = {s.strip() for s in args.steps.split(",") if s.strip()}
-    known = {"consistency", "layout", "nhwc", "profile", "bench", "score"}
+    known = {"consistency", "layout", "nhwc", "profile", "fusedprobe",
+             "bench", "score"}
     if steps - known:
         # a typo must not silently skip a step a rare window exists for
         ap.error(f"unknown --steps {sorted(steps - known)}; "
@@ -222,6 +223,14 @@ def main():
              args.step_timeout, summary_path,
              env={"B": str(args.batch)},
              capture_to=f"PROFILE_{tag}.txt")
+
+    # 4b. would a single fused donated train-step close the gap?
+    if "fusedprobe" in steps:
+        _run("fused_step_probe",
+             [sys.executable, "experiments/fused_step_probe.py"],
+             args.step_timeout, summary_path,
+             env={"B": str(args.batch)},
+             capture_to=f"FUSEDPROBE_{tag}.txt")
 
     # 5. the product-path bench under the winning config
     env = {}
