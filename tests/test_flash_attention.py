@@ -82,3 +82,67 @@ def test_multi_head_attention_layer():
     assert any((p.grad() is not None and
                 float(np.abs(p.grad().asnumpy()).sum()) > 0)
                for p in g.values() if p.grad_req != "null")
+
+
+def test_pallas_available_fallback_paths(monkeypatch):
+    """The availability probe's decision table: subprocess failure ->
+    False (dense fallback); exclusive-lock chatter -> inconclusive True;
+    timeout -> False; probe-child env flag -> True without spawning."""
+    import subprocess as sp
+    from mxnet_tpu.ops import flash_attention as fa
+
+    def reset():
+        fa._PALLAS_OK = None
+        fa._PALLAS_ERR = ""
+
+    # pretend we're on tpu so the subprocess path runs
+    monkeypatch.setattr(fa.jax, "default_backend", lambda: "tpu")
+
+    class R:
+        def __init__(self, rc, out="", err=""):
+            self.returncode, self.stdout, self.stderr = rc, out, err
+
+    # 1. hard failure -> unavailable, error recorded
+    reset()
+    monkeypatch.setattr(sp, "run",
+                        lambda *a, **k: R(1, "", "MosaicError: HTTP 500"))
+    assert fa.pallas_available() is False
+    assert "500" in fa._PALLAS_ERR
+    # cached: a second call must not re-probe
+    monkeypatch.setattr(sp, "run", lambda *a, **k: 1 / 0)
+    assert fa.pallas_available() is False
+
+    # 2. exclusive chip lock -> inconclusive -> stays enabled
+    reset()
+    monkeypatch.setattr(
+        sp, "run",
+        lambda *a, **k: R(1, "", "The TPU is already in use by pid 7"))
+    assert fa.pallas_available() is True
+
+    # 3. hang -> timeout -> unavailable
+    reset()
+
+    def raise_timeout(*a, **k):
+        raise sp.TimeoutExpired(cmd="x", timeout=1)
+    monkeypatch.setattr(sp, "run", raise_timeout)
+    assert fa.pallas_available() is False
+    assert "timed out" in fa._PALLAS_ERR
+
+    # 4. probe child: env flag short-circuits (no recursion)
+    reset()
+    monkeypatch.setenv("MXT_PALLAS_PROBE", "1")
+    monkeypatch.setattr(sp, "run", lambda *a, **k: 1 / 0)
+    assert fa.pallas_available() is True
+
+    # 5. flash op routes to dense when unavailable
+    reset()
+    monkeypatch.delenv("MXT_PALLAS_PROBE", raising=False)
+    monkeypatch.setattr(sp, "run",
+                        lambda *a, **k: R(1, "", "boom"))
+    import jax.numpy as jnp
+    q = jnp.ones((1, 1, 8, 4), jnp.float32)
+    out = fa._flash_attention(q, q, q, 1.0, False, 8, 8)
+    ref = fa._dense_reference(q, q, q, 1.0, False)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-6, atol=1e-6)
+    fa._PALLAS_OK = None  # leave clean for other tests
