@@ -188,8 +188,17 @@ class Module(BaseModule):
         param device_put the old copyto loop paid was O(params) tunnel
         RPCs per epoch (fit() syncs every epoch for the epoch-end
         callback; 2x193 RPCs/epoch on ResNet-50)."""
+        fused_active = self.__dict__.get("_fstep") is not None
+
         def _handoff(src_nd, tgt_nd):
             data = src_nd._data
+            if fused_active:
+                # the fused train step DONATES param buffers each step;
+                # a handed-off alias held by the user (get_params,
+                # epoch-end callback) would be invalidated on the next
+                # step — give them their own buffer instead
+                import jax.numpy as jnp
+                data = jnp.array(data)
             if data.dtype != tgt_nd.dtype:
                 data = data.astype(tgt_nd.dtype)
             tgt_nd._set_data(data)
@@ -423,8 +432,155 @@ class Module(BaseModule):
     def forward_backward(self, data_batch):
         """Fused single-compiled-call training step (TPU hot path)."""
         assert self.binded and self.params_initialized
+        # a stale flag from a fused step whose update() was skipped must
+        # not swallow the NEXT standard-path update
+        self.__dict__.pop("_fused_stepped", None)
+        if self._maybe_fused_train_step(data_batch):
+            return
         self._set_batch(data_batch, True)
         self._exec.forward_backward()
+
+    # -- single-program train step (MXNET_FUSED_STEP=1) ---------------------
+    def _fused_step_updater(self):
+        if self._update_on_kvstore and self._kvstore is not None:
+            return getattr(self._kvstore, "_updater", None)
+        return self._updater
+
+    def _fused_step_eligible(self):
+        """ONE donated XLA program per step (fwd+bwd+optimizer) — the
+        full engine-bulking limit.  Opt-in (MXNET_FUSED_STEP=1) because
+        it changes two observable contracts: grad_dict is not
+        materialized per step, and params/optimizer states are donated
+        (updated in place device-side)."""
+        from ..base import getenv
+        from ..optimizer import FusedUpdater
+        if not getenv("MXNET_FUSED_STEP", 0):
+            return False
+        if not self.optimizer_initialized:
+            return False
+        ex = self._exec
+        upd = self._fused_step_updater()
+        ok = (isinstance(upd, FusedUpdater)
+              and getattr(upd.optimizer, "fused", False)
+              and ex._mesh is None and not ex.group2ctx
+              and not ex._rsp_grad_args
+              and ex._monitor is None
+              and not self.inputs_need_grad
+              and not getattr(self._kvstore, "_gc", None)
+              and (self._kvstore is None
+                   or self._kvstore.num_workers == 1)
+              and all(ex.grad_req.get(n, "null") in ("null", "write")
+                      for n in ex.arg_dict))
+        if not ok and not self.__dict__.get("_fstep_warned"):
+            self.logger.warning(
+                "MXNET_FUSED_STEP=1 requested but this module is not "
+                "eligible (needs: fused optimizer, single device, dense "
+                "write grads, no compression/monitor) — using the "
+                "standard 2-program step")
+            self._fstep_warned = True
+        return ok
+
+    def _maybe_fused_train_step(self, data_batch):
+        if not self._fused_step_eligible():
+            return False
+        import jax
+        import jax.numpy as jnp
+        import numpy as _np
+        from .. import random as _random
+
+        ex = self._exec
+        upd = self._fused_step_updater()
+        opt_ = upd.optimizer
+        self._set_batch(data_batch, True)
+        arg_vals = {k: v._data for k, v in ex.arg_dict.items()}
+        aux_vals = {k: v._data for k, v in ex.aux_dict.items()}
+        feed = set(self._data_names) | set(self._label_names)
+        grad_names = [n for n in ex._grad_names if n not in feed]
+        pnames = [n for n in arg_vals if n not in feed]
+
+        live = [(i, n) for i, n in enumerate(self._param_names)
+                if n in ex.grad_dict]
+        idx_of = {n: i for i, n in live}
+        kv_key = bool(self._update_on_kvstore and self._kvstore is not None)
+        from ..kvstore import _updater_key as _ukey
+        for i, n in live:
+            upd._ensure_state(_ukey(n) if kv_key else i,
+                              ex.arg_dict[n])
+            opt_._update_count(_ukey(n) if kv_key else i)
+        ukeys = {n: (_ukey(n) if kv_key else idx_of[n]) for _, n in live}
+
+        fs = self.__dict__.get("_fstep")
+        fkey = (id(ex._plan), type(opt_).__name__,
+                opt_.fused_hyper_key(), tuple(sorted(grad_names)),
+                tuple(pnames))
+        if fs is None or fs["key"] != fkey:
+            plan = ex._plan
+            gset = list(grad_names)
+
+            def ftrain(params, states, aux, xs, key, lrs, wds, ts):
+                merged = dict(params)
+                merged.update(xs)
+
+                def fwd(p):
+                    m = dict(merged)
+                    m.update(p)
+                    return plan.run(m, aux, key, True)
+
+                (outs, new_aux), vjp = jax.vjp(
+                    fwd, {n: params[n] for n in gset})
+                cots = ([jnp.ones(o.shape, o.dtype) for o in outs],
+                        jax.tree_util.tree_map(jnp.zeros_like, new_aux))
+                (grads,) = vjp(cots)
+                new_p, new_s = dict(params), dict(states)
+                for k, n in enumerate(sorted(gset)):
+                    nw, ns = opt_._fused_step_mp(
+                        ukeys[n], params[n], grads[n], states[n],
+                        lrs[k], wds[k], ts[k])
+                    new_p[n] = (nw if nw.dtype == params[n].dtype
+                                else nw.astype(params[n].dtype))
+                    new_s[n] = jax.tree_util.tree_map(
+                        lambda a, b: a if a.dtype == b.dtype
+                        else a.astype(b.dtype), ns, states[n])
+                return outs, new_aux, new_p, new_s, ts + 1
+
+            # hold the plan ref: id() keys must not be recycled
+            fs = {"key": fkey, "plan": plan,
+                  "fn": jax.jit(ftrain, donate_argnums=(0, 1, 2))}
+            self._fstep = fs
+
+        snames = sorted(grad_names)
+        # hyper/ts device caches shared with FusedUpdater.update_all
+        lrs, wds, ts, commit_ts = upd.hyper_arrays(
+            tuple(ukeys[n] for n in snames))
+
+        params = {n: arg_vals[n] for n in pnames}
+        states = {n: upd._state_data(upd.states[ukeys[n]])
+                  for n in snames}
+        xs = {n: arg_vals[n] for n in feed if n in arg_vals}
+        outs, new_aux, new_p, new_s, nts = fs["fn"](
+            params, states, aux_vals, xs, _random.next_key(),
+            lrs, wds, ts)
+        commit_ts(nts)
+
+        kv_store = (self._kvstore._store
+                    if kv_key and hasattr(self._kvstore, "_store")
+                    else None)
+        for n in pnames:
+            ex.arg_dict[n]._set_data(new_p[n])
+            if kv_store is not None and n in kv_store:
+                # keep the kvstore's weight copy current: a later
+                # pushpull/pull (eligibility flips mid-run) must not
+                # revert training to stale buffers
+                kv_store[n]._set_data(new_p[n])
+        for n in snames:
+            upd.states[ukeys[n]] = upd._state_writeback(
+                upd.states[ukeys[n]], new_s[n])
+        ex._set_results(outs, new_aux)
+        ex._snapshot = None
+        ex._pending_grads = None
+        self._params_dirty = True
+        self._fused_stepped = True
+        return True
 
     def update(self):
         """Parity: _update_params_on_kvstore / _update_params (model.py:97-138).
@@ -436,6 +592,8 @@ class Module(BaseModule):
         pushes."""
         assert self.binded and self.params_initialized and \
             self.optimizer_initialized
+        if self.__dict__.pop("_fused_stepped", False):
+            return  # the fused train step already applied the update
         self._params_dirty = True
         live = [(i, n) for i, n in enumerate(self._param_names)
                 if n in self._exec.grad_dict]

@@ -873,6 +873,41 @@ class FusedUpdater(Updater):
                              for o, n in zip(old, new))
         return new
 
+    def hyper_arrays(self, indices):
+        """Device-cached (lrs, wds, ts, commit_ts) for a key tuple.
+
+        Through the tunnel every fresh host->device transfer costs a
+        latency hop on the hot path, so lr/wd re-upload only when a
+        schedule actually changes them (last-VALUE cache — a per-step
+        schedule must not grow a dict by one device array per step) and
+        the per-key step counter lives ON DEVICE, incremented by the
+        compiled update itself; call commit_ts(new_ts) after the step.
+        Re-seeds from the python counts when they diverge (e.g. a
+        per-key update interleaved).  Shared by update_all and the
+        module-level fused train step."""
+        opt_ = self.optimizer
+        hc = self.__dict__.setdefault("_hyper_cache", {})
+        lr_t = tuple(opt_._get_lr(i) for i in indices)
+        wd_t = tuple(opt_._get_wd(i) for i in indices)
+        if hc.get("lr_key") != lr_t:
+            hc["lr_key"] = lr_t
+            hc["lr"] = jnp.asarray(_np.array(lr_t, _np.float32))
+        if hc.get("wd_key") != wd_t:
+            hc["wd_key"] = wd_t
+            hc["wd"] = jnp.asarray(_np.array(wd_t, _np.float32))
+        counts_t = tuple(opt_._index_update_count[i] for i in indices)
+        tc = self.__dict__.setdefault("_ts_cache", {})
+        ent = tc.get(tuple(indices))
+        if ent is not None and ent[1] == counts_t:
+            ts = ent[0]
+        else:
+            ts = jnp.asarray(_np.array(counts_t, _np.int32))
+
+        def commit_ts(nts):
+            tc[tuple(indices)] = (nts, tuple(c + 1 for c in counts_t))
+
+        return hc["lr"], hc["wd"], ts, commit_ts
+
     def update_all(self, indices, grads, weights) -> None:
         """Apply the optimizer to all (grad, weight) pairs in one dispatch.
 
@@ -905,32 +940,7 @@ class FusedUpdater(Updater):
             self._ensure_state(i, w)
         for i in indices:
             opt_._update_count(i)
-        # hyper/step uploads are cached: through the tunnel every fresh
-        # host->device transfer costs a latency hop on the hot path, so
-        # lr/wd re-upload only when a schedule actually changes them and
-        # the per-key step counter lives ON DEVICE (incremented by the
-        # compiled update itself; re-seeded from the python counts when
-        # they diverge, e.g. a per-key update interleaved)
-        # last-value cache (not a dict keyed by value: a per-step lr
-        # schedule would grow such a dict by one device array per step)
-        hc = self.__dict__.setdefault("_hyper_cache", {})
-        lr_t = tuple(opt_._get_lr(i) for i in indices)
-        wd_t = tuple(opt_._get_wd(i) for i in indices)
-        if hc.get("lr_key") != lr_t:
-            hc["lr_key"] = lr_t
-            hc["lr"] = jnp.asarray(_np.array(lr_t, _np.float32))
-        lrs = hc["lr"]
-        if hc.get("wd_key") != wd_t:
-            hc["wd_key"] = wd_t
-            hc["wd"] = jnp.asarray(_np.array(wd_t, _np.float32))
-        wds = hc["wd"]
-        counts_t = tuple(opt_._index_update_count[i] for i in indices)
-        tc = self.__dict__.setdefault("_ts_cache", {})
-        ent = tc.get(tuple(indices))
-        if ent is not None and ent[1] == counts_t:
-            ts = ent[0]
-        else:
-            ts = jnp.asarray(_np.array(counts_t, _np.int32))
+        lrs, wds, ts, commit_ts = self.hyper_arrays(indices)
         wvals = [w._data for w in weights]
         gvals = [g._data if isinstance(g, NDArray) else g for g in grads]
         svals = [self._state_data(self.states[i]) for i in indices]
@@ -965,7 +975,7 @@ class FusedUpdater(Updater):
             fn = jax.jit(_apply, donate_argnums=(2,))
             self._fn_cache[key] = fn
         nws, nss, nts = fn(wvals, gvals, svals, lrs, wds, ts)
-        tc[tuple(indices)] = (nts, tuple(c + 1 for c in counts_t))
+        commit_ts(nts)
         for k, i in enumerate(indices):
             weights[k]._set_data(nws[k])
             self.states[i] = self._state_writeback(self.states[i], nss[k])

@@ -1,0 +1,155 @@
+"""MXNET_FUSED_STEP=1: the whole train step (fwd+bwd+optimizer) as ONE
+donated XLA program (the engine-bulking limit).  Contract: numerically
+identical training to the standard forward_backward+update path."""
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+def _fit(fused, optimizer, opt_params, dtype="float32", epochs=3):
+    os.environ["MXNET_FUSED_STEP"] = "1" if fused else "0"
+    try:
+        net = mx.sym.Variable("data")
+        net = mx.sym.Activation(
+            mx.sym.Convolution(net, num_filter=4, kernel=(3, 3),
+                               pad=(1, 1), name="c1"), act_type="relu")
+        net = mx.sym.BatchNorm(net, name="bn")
+        net = mx.sym.SoftmaxOutput(
+            mx.sym.FullyConnected(mx.sym.Flatten(net), num_hidden=3,
+                                  name="fc"), name="softmax")
+        rs = np.random.RandomState(0)
+        x = rs.normal(0, 1, (64, 3, 8, 8)).astype("f")
+        y = rs.randint(0, 3, 64).astype("f")
+        it = mx.io.NDArrayIter(x.astype(dtype), y, 16,
+                               label_name="softmax_label")
+        mx.random.seed(5)
+        mod = mx.mod.Module(net)
+        mod.bind(data_shapes=[("data", (16, 3, 8, 8), np.dtype(dtype))],
+                 label_shapes=[("softmax_label", (16,))])
+        mod.init_params(mx.init.Xavier())
+        mod.init_optimizer(kvstore="tpu_sync", optimizer=optimizer,
+                           optimizer_params=dict(opt_params))
+        mod.fit(it, num_epoch=epochs)
+        return {k: v.asnumpy().astype("f")
+                for k, v in mod._exec.arg_dict.items()
+                if k not in ("data", "softmax_label")}, mod
+    finally:
+        os.environ["MXNET_FUSED_STEP"] = "0"
+
+
+@pytest.mark.parametrize("optimizer,params,dtype,tol", [
+    ("sgd", {"learning_rate": 0.1, "momentum": 0.9, "wd": 1e-4},
+     "float32", 0.0),
+    ("adam", {"learning_rate": 3e-3}, "float32", 0.0),
+    # bf16 mp: the fused and standard programs are DIFFERENT XLA
+    # fusions of the same math — their f32 masters drift ~5e-5/step
+    # (measured; weights stay bit-identical per step until bf16
+    # quantization surfaces the accumulated master delta), so the
+    # 36-step bound is training-noise scale, not exactness
+    ("sgd", {"learning_rate": 0.05, "momentum": 0.9,
+             "multi_precision": True}, "bfloat16", 0.06),
+])
+def test_fused_step_matches_standard(optimizer, params, dtype, tol):
+    a, _ = _fit(False, optimizer, params, dtype)
+    b, _ = _fit(True, optimizer, params, dtype)
+    assert set(a) == set(b)
+    for k in a:
+        err = float(np.max(np.abs(a[k] - b[k])))
+        assert err <= tol, (k, err)
+
+
+def test_fused_step_one_program_per_batch(monkeypatch):
+    """Steady state must be exactly ONE compiled execution per batch."""
+    monkeypatch.setenv("MXNET_FUSED_STEP", "1")
+    net = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=4,
+                              name="fc"), name="softmax")
+    rs = np.random.RandomState(1)
+    x = rs.normal(0, 1, (64, 8)).astype("f")
+    y = rs.randint(0, 4, 64).astype("f")
+    it = mx.io.NDArrayIter(x, y, 16, label_name="softmax_label")
+    mod = mx.mod.Module(net)
+    mod.bind(data_shapes=[("data", (16, 8))],
+             label_shapes=[("softmax_label", (16,))])
+    mod.init_params(mx.init.Xavier())
+    mod.init_optimizer(kvstore="tpu_sync", optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.1})
+    # warm epoch: compile + (possible) hyper upload
+    mod.fit(it, num_epoch=1)
+    fs = mod._fstep
+    calls = []
+    real = fs["fn"]
+
+    def spy(*a, **k):
+        calls.append(1)
+        return real(*a, **k)
+    fs["fn"] = spy
+    it.reset()
+    for batch in it:
+        mod.forward_backward(batch)
+        mod.update()
+    assert len(calls) == 4, len(calls)  # 64/16 batches, 1 program each
+
+
+def test_fused_step_ineligible_falls_back(monkeypatch, caplog):
+    """A non-fused optimizer must warn once and use the standard path."""
+    monkeypatch.setenv("MXNET_FUSED_STEP", "1")
+    net = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=4,
+                              name="fc"), name="softmax")
+    rs = np.random.RandomState(1)
+    it = mx.io.NDArrayIter(rs.normal(0, 1, (32, 8)).astype("f"),
+                           rs.randint(0, 4, 32).astype("f"), 16,
+                           label_name="softmax_label")
+    mod = mx.mod.Module(net)
+    # DCASGD has no fused_step
+    mod.fit(it, num_epoch=1, optimizer="dcasgd",
+            optimizer_params={"learning_rate": 0.05})
+    # training happened through the standard path
+    assert mod._exec.grad_dict["fc_weight"] is not None
+
+
+def test_fused_step_get_params_survives_donation(monkeypatch):
+    """get_params/epoch callbacks hold host-side mirrors; the fused
+    step's buffer donation must not invalidate them, and the kvstore's
+    weight copies must track training (a later pull would otherwise
+    revert)."""
+    monkeypatch.setenv("MXNET_FUSED_STEP", "1")
+    net = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=4,
+                              name="fc"), name="softmax")
+    rs = np.random.RandomState(2)
+    x = rs.normal(0, 1, (32, 8)).astype("f")
+    y = rs.randint(0, 4, 32).astype("f")
+    it = mx.io.NDArrayIter(x, y, 16, label_name="softmax_label")
+    mod = mx.mod.Module(net)
+    mod.bind(data_shapes=[("data", (16, 8))],
+             label_shapes=[("softmax_label", (16,))])
+    mod.init_params(mx.init.Xavier())
+    mod.init_optimizer(kvstore="tpu_sync", optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.1})
+    held, snaps = [], []
+    for epoch in range(3):
+        it.reset()
+        for batch in it:
+            mod.forward_backward(batch)
+            mod.update()
+        arg, _ = mod.get_params()
+        held.append(arg["fc_weight"])
+        snaps.append(arg["fc_weight"].asnumpy().copy())
+    # more donated steps AFTER the last get_params, then read the held
+    # mirror (pre-fix: the sync handed off the executor's live buffer,
+    # the donation deleted it -> RuntimeError 'Array has been deleted')
+    it.reset()
+    for batch in it:
+        mod.forward_backward(batch)
+        mod.update()
+    held[0].asnumpy()
+    assert not np.allclose(snaps[0], snaps[-1])  # training moved
+    # kvstore copy tracks training
+    kv_w = mod._kvstore._store["fc_weight"].asnumpy()
+    np.testing.assert_allclose(
+        kv_w, mod._exec.arg_dict["fc_weight"].asnumpy(), rtol=1e-6)

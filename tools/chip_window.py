@@ -239,17 +239,29 @@ def main():
     elif winner and winner["img_s"] > 0 and winner["layout"] == "NHWC":
         env["MXNET_TPU_CONV_LAYOUT"] = "NHWC"
     if "bench" in steps:
-        rec = _run("bench", [sys.executable, "bench.py"],
-                   args.step_timeout, summary_path, env=env)
-        m = re.search(r"(\{.*\})", rec.get("tail", ""))
-        if m:
-            try:
-                SUMMARY["bench"] = json.loads(m.group(1))
-                with open(os.path.join(REPO, f"BENCH_WINDOW_{tag}.json"),
-                          "w") as f:
-                    json.dump(SUMMARY["bench"], f, indent=1)
-            except ValueError:
-                pass
+        def _bench_json(rec):
+            m = re.search(r"(\{.*\})", rec.get("tail", ""))
+            if m:
+                try:
+                    return json.loads(m.group(1))
+                except ValueError:
+                    pass
+            return None
+
+        SUMMARY["bench"] = _bench_json(
+            _run("bench", [sys.executable, "bench.py"],
+                 args.step_timeout, summary_path, env=env))
+        # A/B: the single-donated-program train step (MXNET_FUSED_STEP)
+        SUMMARY["bench_fused"] = _bench_json(
+            _run("bench_fused", [sys.executable, "bench.py"],
+                 args.step_timeout, summary_path,
+                 env={**env, "MXNET_FUSED_STEP": "1"}))
+        # ONE schema regardless of which legs parsed
+        with open(os.path.join(REPO, f"BENCH_WINDOW_{tag}.json"),
+                  "w") as f:
+            json.dump({"default": SUMMARY["bench"],
+                       "fused_step": SUMMARY["bench_fused"]},
+                      f, indent=1)
 
     # 6. zoo inference throughput (reference benchmark_score parity)
     if "score" in steps:
