@@ -198,3 +198,72 @@ def test_full_fit_loop_dispatch_budget(counters):
     # 1 fused fwd+bwd + 1 fused update + 1 metric nll (measured exactly
     # 3.0; small headroom for iterator slicing variants)
     assert compiled + eager <= 4.0, per_batch
+
+
+def test_fused_step_fit_loop_dispatch_budget(counters, monkeypatch):
+    """MXNET_FUSED_STEP=1 bench pattern: ONE donated train-step program
+    + the metric's NLL per batch — 0 device_puts, <= 2 programs."""
+    import collections as _c
+
+    import jax.numpy as jnp
+
+    from mxnet_tpu.io import NDArrayIter
+
+    monkeypatch.setenv("MXNET_FUSED_STEP", "1")
+    rs = np.random.RandomState(0)
+    batch, nbatch = 8, 4
+    net = sym.Convolution(sym.Variable("data"), kernel=(3, 3),
+                          num_filter=4, pad=(1, 1), name="conv0")
+    net = sym.Activation(net, act_type="relu")
+    net = sym.Pooling(net, global_pool=True, pool_type="avg")
+    net = sym.FullyConnected(sym.Flatten(net), num_hidden=10, name="fc")
+    net = sym.SoftmaxOutput(net, name="softmax")
+
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.bind(data_shapes=[DataDesc("data", (batch, 3, 8, 8), np.float32)],
+             label_shapes=[DataDesc("softmax_label", (batch,),
+                                    np.float32)])
+    mod.init_params(mx.init.Xavier())
+    mod.init_optimizer(kvstore="tpu_sync", optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.05,
+                                         "momentum": 0.9,
+                                         "multi_precision": True})
+    x = mx.nd.array(rs.normal(0, 1, (batch * nbatch, 3, 8, 8)).astype("f"))
+    y = mx.nd.array(rs.randint(0, 10, batch * nbatch).astype("f"))
+    it = NDArrayIter(x, y, batch_size=batch)
+
+    nll = jax.jit(lambda p, l: -jnp.log(jnp.take_along_axis(
+        p.astype(jnp.float32), l.astype(jnp.int32)[:, None],
+        axis=1) + 1e-8).mean())
+
+    class LossMetric(mx.metric.EvalMetric):
+        def __init__(self):
+            super().__init__("nll")
+            self._device_vals = []
+
+        def update(self, labels_, preds):
+            self._device_vals.append(nll(preds[0]._data,
+                                         labels_[0]._data))
+            self.num_inst += 1
+
+        def get(self):
+            return ("nll", 0.0)
+
+    snaps = []
+
+    def epoch_end(epoch, sym_=None, arg=None, aux=None):
+        snaps.append(_c.Counter(counters))
+
+    mod.fit(it, num_epoch=3, eval_metric=LossMetric(),
+            epoch_end_callback=epoch_end)
+    assert mod.__dict__.get("_fstep") is not None  # path actually taken
+
+    steady = snaps[-1] - snaps[-2]
+    per_batch = {k: v / nbatch for k, v in steady.items()}
+    assert per_batch.get("device_put", 0) == 0, per_batch
+    compiled = sum(v for k, v in per_batch.items()
+                   if k.startswith("jit:"))
+    eager = sum(v for k, v in per_batch.items()
+                if k.startswith("eager_op"))
+    # 1 fused train-step + 1 metric nll (+ iterator slice headroom)
+    assert compiled + eager <= 3.0, per_batch
