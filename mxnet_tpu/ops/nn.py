@@ -361,13 +361,24 @@ def _l2_normalization(p, x):
           args=[Arg("alpha", float, 1e-4), Arg("beta", float, 0.75),
                 Arg("knorm", float, 2.0), Arg("nsize", int, required=True)])
 def _lrn(p, x):
-    """Parity: src/operator/lrn.cc — cross-channel local response norm."""
+    """Parity: src/operator/lrn.cc — cross-channel local response norm.
+
+    The window sum is nsize shifted channel slices added together (not
+    lax.reduce_window: its sum flavor fails to LINEARIZE inside jit on
+    this jax — 'Linearization failed to produce known values' — found
+    by the finite-difference tier; slices also fuse better on TPU for
+    the tiny windows LRN uses)."""
+    if p["nsize"] % 2 == 0:
+        raise MXNetError(
+            f"LRN nsize must be odd (got {p['nsize']}): the window is "
+            "centered on each channel")
     half = p["nsize"] // 2
     sq = jnp.square(x)
     padded = jnp.pad(sq, ((0, 0), (half, half)) + ((0, 0),) * (x.ndim - 2))
-    window = (1, p["nsize"]) + (1,) * (x.ndim - 2)
-    ssum = lax.reduce_window(padded, jnp.asarray(0, x.dtype), lax.add,
-                             window, (1,) * x.ndim, "VALID")
+    C = x.shape[1]
+    ssum = padded[:, 0:C]
+    for i in range(1, p["nsize"]):
+        ssum = ssum + padded[:, i:i + C]
     return x / jnp.power(p["knorm"] + p["alpha"] / p["nsize"] * ssum, p["beta"])
 
 

@@ -65,8 +65,11 @@ def _grid_generator(p, data):
     if p["transform_type"] == "affine":
         h, w = p["target_shape"]
         theta = data.reshape(-1, 2, 3)
-        ys = jnp.linspace(-1, 1, h)
-        xs = jnp.linspace(-1, 1, w)
+        # dtype pinned: under x64 linspace defaults to f64 and would
+        # promote the whole sampling path (found by the finite-diff
+        # tier: executor output dtype flipped f32->f64)
+        ys = jnp.linspace(-1, 1, h, dtype=data.dtype)
+        xs = jnp.linspace(-1, 1, w, dtype=data.dtype)
         grid_x, grid_y = jnp.meshgrid(xs, ys)
         ones = jnp.ones_like(grid_x)
         base = jnp.stack([grid_x.ravel(), grid_y.ravel(), ones.ravel()])

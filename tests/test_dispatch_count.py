@@ -203,8 +203,6 @@ def test_full_fit_loop_dispatch_budget(counters):
 def test_fused_step_fit_loop_dispatch_budget(counters, monkeypatch):
     """MXNET_FUSED_STEP=1 bench pattern: ONE donated train-step program
     + the metric's NLL per batch — 0 device_puts, <= 2 programs."""
-    import collections as _c
-
     import jax.numpy as jnp
 
     from mxnet_tpu.io import NDArrayIter
@@ -252,7 +250,7 @@ def test_fused_step_fit_loop_dispatch_budget(counters, monkeypatch):
     snaps = []
 
     def epoch_end(epoch, sym_=None, arg=None, aux=None):
-        snaps.append(_c.Counter(counters))
+        snaps.append(collections.Counter(counters))
 
     mod.fit(it, num_epoch=3, eval_metric=LossMetric(),
             epoch_end_callback=epoch_end)

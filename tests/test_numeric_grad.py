@@ -77,6 +77,41 @@ CASES = [
 ]
 
 
+CASES += [
+    # round 4: vision/legacy backward paths that had no finite-diff net
+    ("lrn", sym.LRN(v(), nsize=3, alpha=1e-2, beta=0.5),
+     {"data": rs.randn(1, 4, 3, 3) * 0.5 + 1.0}),
+    ("l2_normalization", sym.L2Normalization(v(), eps=1e-4),
+     {"data": rs.randn(2, 3, 4) + 0.3}),
+    ("instance_norm",
+     sym.InstanceNorm(v("data"), v("g"), v("b"), eps=1e-3),
+     {"data": rs.randn(2, 3, 4, 4), "g": rs.rand(3) + 0.5,
+      "b": rs.randn(3)}),
+    ("pad_reflect",
+     sym.Pad(v(), mode="reflect", pad_width=(0, 0, 0, 0, 1, 1, 1, 1)),
+     {"data": rs.randn(1, 2, 3, 3)}),
+    ("sequence_reverse", sym.SequenceReverse(v()) * 2.0,
+     {"data": rs.randn(3, 2, 4)}),
+    ("bilinear_sampler",
+     sym.BilinearSampler(v("data"), sym.BlockGrad(sym.tanh(v("grid")))
+                         * 0.7),
+     {"data": rs.randn(1, 2, 4, 4), "grid": rs.randn(1, 2, 3, 3)},
+     ["data"]),
+    ("spatial_transformer",
+     sym.SpatialTransformer(v("data"), sym.BlockGrad(v("theta")),
+                            target_shape=(3, 3),
+                            transform_type="affine",
+                            sampler_type="bilinear"),
+     {"data": rs.randn(1, 2, 4, 4),
+      "theta": np.array([[0.9, 0.05, 0.02, -0.04, 0.85, 0.01]])},
+     ["data"]),
+    ("swapaxis_crop",
+     sym.Crop(sym.SwapAxis(v(), dim1=2, dim2=3), offset=(1, 1),
+              h_w=(2, 2)),
+     {"data": rs.randn(1, 2, 4, 4)}),
+]
+
+
 @pytest.mark.parametrize("case", CASES, ids=[c[0] for c in CASES])
 def test_numeric_gradient(case):
     name, s, loc = case[0], case[1], case[2]
