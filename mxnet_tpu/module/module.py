@@ -465,6 +465,7 @@ class Module(BaseModule):
               and ex._mesh is None and not ex.group2ctx
               and not ex._rsp_grad_args
               and ex._monitor is None
+              and not ex._remat  # mirror remat rides the standard path
               and not self.inputs_need_grad
               and not getattr(self._kvstore, "_gc", None)
               and (self._kvstore is None
