@@ -43,6 +43,7 @@ from . import metric
 from . import callback
 from . import io
 from . import recordio
+from . import registry
 from . import kvstore as kv
 from .kvstore import KVStore
 from . import model

@@ -1,0 +1,76 @@
+"""Generic object registry helpers (parity: python/mxnet/registry.py —
+`get_register_func` / `get_create_func` / `get_alias_func` build the
+register()/create() surfaces that optimizer.py, initializer.py,
+metric.py and lr_scheduler use; here they wrap `base.Registry`, the
+same store those modules already register into)."""
+from __future__ import annotations
+
+import json
+
+from .base import MXNetError, Registry
+
+_REGISTRIES: dict = {}
+
+
+def _registry(base_class, nickname: str) -> Registry:
+    reg = _REGISTRIES.get(nickname)
+    if reg is None:
+        reg = _REGISTRIES[nickname] = Registry(nickname)
+        reg.base_class = base_class
+    return reg
+
+
+def get_register_func(base_class, nickname: str):
+    """-> register(klass, name=None) for this kind (reference
+    registry.py register())."""
+    reg = _registry(base_class, nickname)
+
+    def register(klass, name=None):
+        if not issubclass(klass, base_class):
+            raise MXNetError(
+                f"can only register subclasses of "
+                f"{base_class.__name__}, got {klass}")
+        reg.register(klass, name=name or klass.__name__)
+        return klass
+    register.__doc__ = f"Register a new {nickname}."
+    return register
+
+
+def get_alias_func(base_class, nickname: str):
+    """-> alias(name) class decorator (reference registry.py alias())."""
+    reg = _registry(base_class, nickname)
+
+    def alias(*names):
+        def _do(klass):
+            for n in names:
+                reg.register(klass, name=n)
+            return klass
+        return _do
+    return alias
+
+
+def get_create_func(base_class, nickname: str):
+    """-> create(spec, *args, **kwargs): by name, by (name, kwargs)
+    json string, by instance passthrough (reference registry.py
+    create())."""
+    reg = _registry(base_class, nickname)
+
+    def create(*args, **kwargs):
+        if args and isinstance(args[0], base_class):
+            if len(args) > 1 or kwargs:
+                raise MXNetError(
+                    f"{nickname} instance passthrough takes no extra "
+                    "arguments")
+            return args[0]
+        if not args or not isinstance(args[0], str):
+            raise MXNetError(
+                f"create expects a {nickname} name or instance")
+        name, rest = args[0], args[1:]
+        if name.startswith("["):  # json ["name", {kwargs}] form
+            spec = json.loads(name)
+            name, kw = spec[0], (spec[1] if len(spec) > 1 else {})
+            kw.update(kwargs)
+            return reg.get(name)(*rest, **kw)
+        return reg.get(name)(*rest, **kwargs)
+    create.__doc__ = f"Create a {nickname} instance by name."
+    return create

@@ -306,3 +306,34 @@ print("FINAL", np.mean(losses[-8:]), flush=True)
     first_resumed = float([l for l in r2.stdout.splitlines()
                            if l.startswith("EPOCH_DONE")][0].split()[2])
     assert first_resumed < loss_at_kill * 1.5, (first_resumed, loss_at_kill)
+
+
+def test_registry_helpers():
+    """mx.registry get_register_func/get_create_func/get_alias_func
+    (parity: python/mxnet/registry.py)."""
+    from mxnet_tpu import registry
+
+    class Sched:
+        def __init__(self, base=1.0):
+            self.base = base
+
+    register = registry.get_register_func(Sched, "sched")
+    alias = registry.get_alias_func(Sched, "sched")
+    create = registry.get_create_func(Sched, "sched")
+
+    @alias("warm", "warmup")
+    class WarmSched(Sched):
+        pass
+    register(WarmSched)
+
+    assert isinstance(create("warmsched"), WarmSched)
+    assert isinstance(create("warm", base=2.0), WarmSched)
+    assert create("warm", base=2.0).base == 2.0
+    # json ["name", {kwargs}] form and instance passthrough
+    s = create('["warmup", {"base": 3.0}]')
+    assert s.base == 3.0
+    assert create(s) is s
+    with pytest.raises(mx.base.MXNetError):
+        create("nope")
+    with pytest.raises(mx.base.MXNetError):
+        register(dict)  # not a subclass
