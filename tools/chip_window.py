@@ -11,9 +11,12 @@ successful device probe it runs, in value order:
      (raw-JAX NCHW/NHWC x residency sweep; picks the winning config)
   3. tools/run_tpu_consistency.py --layout NHWC (resnet subset)
      (validates the framework's channels-last lowering on-chip)
-  4. experiments/profile_fit.py          -> PROFILE_<tag>.txt
+  4. bench.py with the winning layout    -> BENCH_WINDOW_<tag>.json
+     (default vs MXNET_FUSED_STEP=1 A/B — the headline number rides
+     earlier than the diagnostics: windows close without warning)
+  5. benchmark_score.py zoo inference    -> SCORE_<tag>.txt
+  6. experiments/profile_fit.py          -> PROFILE_<tag>.txt
      (phase-level fit() timing: where does the throughput go)
-  5. bench.py with the winning layout    -> BENCH_WINDOW_<tag>.json
 
 Every step is a subprocess with its own timeout, so one hang cannot eat
 the window; the summary (CHIP_WINDOW_<tag>.json) is rewritten atomically
@@ -140,11 +143,12 @@ def main():
     ap.add_argument("--probe-timeout", type=float, default=120.0)
     ap.add_argument("--step-timeout", type=float, default=900.0)
     ap.add_argument("--batch", type=int, default=256)
-    ap.add_argument("--steps", default="consistency,layout,nhwc,profile,"
-                    "bench,score",
-                    help="which steps to run, in this fixed order — lets a "
-                         "re-armed poller skip artifacts already harvested "
-                         "in an earlier window this round")
+    ap.add_argument("--steps", default="consistency,layout,nhwc,bench,"
+                    "score,profile,fusedprobe",
+                    help="which steps to run, in this fixed order "
+                         "(bench/score before the profile diagnostics) — "
+                         "lets a re-armed poller skip artifacts already "
+                         "harvested in an earlier window this round")
     ap.add_argument("--conv-layout", default=None,
                     choices=("NCHW", "NHWC"),
                     help="force MXNET_TPU_CONV_LAYOUT for bench/score "
@@ -216,23 +220,9 @@ def main():
               "--out", os.path.join(REPO, f"CONSISTENCY_{tag}_nhwc.json")],
              args.step_timeout, summary_path)
 
-    # 4. where does fit() time go
-    if "profile" in steps:
-        _run("profile_fit",
-             [sys.executable, "experiments/profile_fit.py"],
-             args.step_timeout, summary_path,
-             env={"B": str(args.batch)},
-             capture_to=f"PROFILE_{tag}.txt")
-
-    # 4b. would a single fused donated train-step close the gap?
-    if "fusedprobe" in steps:
-        _run("fused_step_probe",
-             [sys.executable, "experiments/fused_step_probe.py"],
-             args.step_timeout, summary_path,
-             env={"B": str(args.batch)},
-             capture_to=f"FUSEDPROBE_{tag}.txt")
-
-    # 5. the product-path bench under the winning config
+    # 4. the product-path bench under the winning config (runs BEFORE the
+    # diagnostic steps: windows close without warning — r04g lost its
+    # bench to a 15-minute profile_fit that the window outlived)
     env = {}
     if args.conv_layout:
         env["MXNET_TPU_CONV_LAYOUT"] = args.conv_layout
@@ -263,7 +253,7 @@ def main():
                        "fused_step": SUMMARY["bench_fused"]},
                       f, indent=1)
 
-    # 6. zoo inference throughput (reference benchmark_score parity)
+    # 5. zoo inference throughput (reference benchmark_score parity)
     if "score" in steps:
         _run("benchmark_score",
              [sys.executable,
@@ -272,6 +262,22 @@ def main():
               "--batch-sizes", "1,64", "--repeats", "20"],
              args.step_timeout, summary_path, env=env,
              capture_to=f"SCORE_{tag}.txt")
+
+    # 6. diagnostics, cheapest-to-lose last: where does fit() time go
+    if "profile" in steps:
+        _run("profile_fit",
+             [sys.executable, "experiments/profile_fit.py"],
+             args.step_timeout, summary_path,
+             env={"B": str(args.batch)},
+             capture_to=f"PROFILE_{tag}.txt")
+
+    # 6b. would a single fused donated train-step close the gap?
+    if "fusedprobe" in steps:
+        _run("fused_step_probe",
+             [sys.executable, "experiments/fused_step_probe.py"],
+             args.step_timeout, summary_path,
+             env={"B": str(args.batch)},
+             capture_to=f"FUSEDPROBE_{tag}.txt")
 
     SUMMARY["completed"] = True
     _write_summary(summary_path)
