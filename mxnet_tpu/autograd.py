@@ -99,7 +99,12 @@ class _TapeEntry:
         self.out_keys = out_keys
         self.out_refs = out_refs
         self.vjp_fn = vjp_fn       # cotangents tuple -> input grads tuple
-        self.cot_zeros = cot_zeros  # zero cotangent per forward output
+        # (shape, dtype) spec per forward output; the zero cotangent is
+        # materialized lazily in backward() and only for slots that did
+        # not receive a gradient — recording must not allocate (a
+        # row-sparse dot output would otherwise pin an O(vocab) dense
+        # zeros buffer per recorded call)
+        self.cot_zeros = cot_zeros
         # vjp-grad slot per tape input (optional tensor inputs may be None
         # in the op call — their slots exist in the vjp but not on the tape)
         self.in_idx = in_idx if in_idx is not None else list(range(len(in_keys)))
@@ -123,7 +128,7 @@ def _record(op, inputs, outputs, vjp_fn, raw_outs) -> None:
         [_key(o) for o in outputs],
         list(outputs),
         vjp_fn,
-        tuple(jnp.zeros(o.shape, o.dtype) for o in raw_outs),
+        tuple((tuple(o.shape), o.dtype) for o in raw_outs),
         in_idx=[i for i, _ in indexed]))
 
 
@@ -166,13 +171,15 @@ def backward(heads, head_grads=None, retain_graph: bool = False,
     for entry in reversed(tape):
         if not any(k in grad_map for k in entry.out_keys):
             continue
-        cots = list(entry.cot_zeros)
+        cots = [None] * len(entry.cot_zeros)
         for j, k in enumerate(entry.out_keys):
             if k in grad_map:
                 g = grad_map[k]
                 if isinstance(g, _RspCot):
                     g = g.to_dense()  # upstream op needs a dense cotangent
-                cots[j] = g.astype(cots[j].dtype)
+                cots[j] = g.astype(entry.cot_zeros[j][1])
+        cots = [jnp.zeros(*entry.cot_zeros[j]) if c is None else c
+                for j, c in enumerate(cots)]
         in_grads = entry.vjp_fn(tuple(cots))
         for idx, k in enumerate(entry.in_keys):
             g = in_grads[entry.in_idx[idx]]
@@ -283,5 +290,5 @@ class Function:
             _state.tape.append(_TapeEntry(
                 [_key(a) for a in inputs], list(inputs),
                 [_key(o) for o in outs], list(outs), vjp_fn,
-                tuple(jnp.zeros(o.shape, o.dtype) for o in outs)))
+                tuple((tuple(o.shape), o.dtype) for o in outs)))
         return outputs

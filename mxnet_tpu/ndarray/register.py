@@ -34,6 +34,21 @@ def _canon_kwargs(kwargs):
     return out
 
 
+# storage-type dispatch (parity: DispatchMode::kFComputeEx picked in
+# Imperative::InvokeOp, src/imperative/imperative.cc:37-65): an op with a
+# registered sparse executor receives the NDArray OBJECTS (nnz storage
+# intact) when any input is sparse, instead of the default dense `_data`
+# lowering.  Handlers: fn(op, ndarray_inputs, params, out) -> result(s).
+_SPARSE_EX = {}
+
+
+def register_sparse_ex(op_name):
+    def deco(fn):
+        _SPARSE_EX[op_name] = fn
+        return fn
+    return deco
+
+
 def invoke(op_name: str, ndarray_inputs, kwargs, out=None):
     """Execute a registered op eagerly on NDArrays; records on the autograd tape."""
     op = _reg.get_op(op_name)
@@ -50,6 +65,11 @@ def invoke(op_name: str, ndarray_inputs, kwargs, out=None):
     params = dict(op.normalize(_canon_kwargs(kwargs)))
 
     from .. import autograd, random as _random
+
+    if op_name in _SPARSE_EX:
+        from .sparse import BaseSparseNDArray
+        if any(isinstance(a, BaseSparseNDArray) for a in ndarray_inputs):
+            return _SPARSE_EX[op_name](op, ndarray_inputs, params, out)
 
     if op.takes_is_train:
         params["__is_train__"] = autograd.is_training()

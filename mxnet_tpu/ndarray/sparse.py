@@ -19,6 +19,9 @@ nnz = tokens-per-batch vs vocab).
 """
 from __future__ import annotations
 
+import functools as _functools
+
+import jax as _jax
 import jax.numpy as jnp
 import numpy as _np
 
@@ -385,11 +388,156 @@ def retain(data, indices):
 
 
 def dot(lhs, rhs, transpose_a=False, transpose_b=False):
-    """Sparse dot (parity: dot-inl.h CSR×dense forms) — dense-backed
-    lowering onto the MXU; storage classes accepted on either side."""
+    """Sparse dot (parity: src/operator/tensor/dot-inl.h CSR×dense
+    forms).  CSR lhs takes the O(nnz·N) storage-dispatch path below
+    (`_dot_sparse_ex`); other sparse operand combinations fall back to
+    the dense MXU lowering (documented perf cliff, SURVEY.md §7)."""
     from .register import _gen
     return _gen.dot(lhs, rhs, transpose_a=transpose_a,
                     transpose_b=transpose_b)
+
+
+# ---------------------------------------------------------------------------
+# nnz-path CSR dot (parity: src/operator/tensor/dot-inl.h DotCsrDnsDns /
+# DotCsrDnsRspImpl; dispatch parity: DispatchMode::kFComputeEx,
+# src/imperative/imperative.cc:37-65).  O(nnz·N) work instead of
+# O(M·K·N): per-nonzero gather of the dense rows, scaled, scatter-added
+# — the dense (M,K) form of the csr operand never exists.
+# ---------------------------------------------------------------------------
+def _csr_row_ids(indptr, nnz):
+    """Per-nonzero row id from the indptr fenceposts (device, jittable)."""
+    return jnp.searchsorted(indptr, jnp.arange(nnz, dtype=indptr.dtype),
+                            side="right") - 1
+
+
+@_functools.partial(_jax.jit, static_argnums=(4,))
+def _csr_mm(vals, indptr, cols, rhs, n_rows):
+    """dense(M,N) = csr(M,K) · dense(K,N)."""
+    row_ids = _csr_row_ids(indptr, vals.shape[0])
+    contrib = jnp.take(rhs, cols, axis=0, mode="clip") * vals[:, None]
+    out_dtype = jnp.result_type(vals.dtype, rhs.dtype)
+    return jnp.zeros((n_rows, rhs.shape[1]), out_dtype).at[row_ids].add(
+        contrib.astype(out_dtype))
+
+
+@_jax.jit
+def _csr_t_rows(vals, indptr, cols, rhs):
+    """Per-nonzero rows of csr(M,K)ᵀ · dense(M,N), keyed by column id:
+    row r of the result = Σ_{nnz in col r} v·rhs[row].  The caller wraps
+    (cols, rows) in a RowSparseNDArray / _RspCot; duplicate column ids
+    segment-sum in the dedup."""
+    row_ids = _csr_row_ids(indptr, vals.shape[0])
+    return jnp.take(rhs, row_ids, axis=0, mode="clip") * vals[:, None]
+
+
+def _grad_wanted(a):
+    """A sparse operand gets a gradient only when one is attached to it
+    (reference parity: sparse tensors are terminal data/feature inputs;
+    the dense-lowered grad is computed on demand, not by default)."""
+    return (getattr(a, "_grad", None) is not None
+            and getattr(a, "_grad_req", "null") != "null")
+
+
+def _dot_sparse_ex(op, inputs, params, out):
+    """Eager storage-dispatch executor for `dot` with sparse operands."""
+    from ..ops import registry as _ops_reg
+    from .. import autograd
+
+    lhs, rhs = inputs[0], inputs[1]
+    ta = bool(params.get("transpose_a", False))
+    tb = bool(params.get("transpose_b", False))
+    recording = autograd.is_recording() and op.differentiable
+
+    nnz_path = (isinstance(lhs, CSRNDArray)
+                and not isinstance(rhs, BaseSparseNDArray)
+                and getattr(rhs, "ndim", None) == 2)
+    if not nnz_path:
+        # documented dense fallback for the remaining stype combinations —
+        # recorded against the ORIGINAL operands so an attached grad on a
+        # sparse input still receives the dense-lowered gradient
+        params_t = tuple(sorted(params.items()))
+        raw = [lhs._data, rhs._data]
+        if recording:
+            outs, vjp_fn = _ops_reg.make_vjp(op, params_t, raw)
+        else:
+            outs, vjp_fn = _ops_reg.apply_op(op, params_t, raw), None
+        res = NDArray(outs[0], lhs._ctx)
+        if out is not None:
+            out._set_data(res._data.astype(out.dtype))
+            res = out
+        if recording:
+            autograd._record(op, [lhs, rhs], [res], vjp_fn, outs)
+        return res
+
+    vals, indptr, cols = lhs._values, lhs._indptr, lhs._indices_c
+    M, K = lhs.shape
+    B = rhs._data.T if tb else rhs._data
+    N = int(B.shape[1])
+    nnz = int(vals.shape[0])
+    out_dtype = jnp.result_type(vals.dtype, B.dtype)
+
+    if ta:
+        # dot(csrᵀ, dense) -> row_sparse (reference output-stype inference:
+        # DotCsrDnsRspImpl) with rows = the csr's occupied columns
+        if nnz == 0:
+            res = zeros_sparse("row_sparse", (K, N), lhs._ctx, out_dtype)
+        else:
+            res = RowSparseNDArray(
+                cols, _csr_t_rows(vals, indptr, cols, B).astype(out_dtype),
+                (K, N), lhs._ctx)
+    else:
+        data = (jnp.zeros((M, N), out_dtype) if nnz == 0
+                else _csr_mm(vals, indptr, cols, B, M))
+        res = NDArray(data, lhs._ctx)
+
+    if out is not None:
+        if isinstance(out, RowSparseNDArray) and \
+                isinstance(res, RowSparseNDArray):
+            out._assign_rows(res._indices, res._values)
+        elif not isinstance(out, BaseSparseNDArray):
+            # dense out= is well-defined for either result stype
+            out._set_data(res._data.astype(out.dtype))
+        else:
+            raise MXNetError("dot(csr, ...): out= storage type mismatch "
+                             f"({type(out).__name__} vs {type(res).__name__})")
+        res = out
+
+    if recording:
+        rshape = tuple(rhs.shape)
+        # grad w.r.t. the csr operand is dense (M,K) — only computed when
+        # the caller attached a grad buffer to it
+        want_lhs = _grad_wanted(lhs)
+        B_cap = B if want_lhs else None
+
+        def vjp_fn(cots, _v=vals, _ip=indptr, _c=cols, _ta=ta, _tb=tb,
+                   _rs=rshape, _M=M, _B=B_cap):
+            cot = cots[0]  # dense, out-shaped (rsp heads densify upstream)
+            if _ta:
+                # out = Aᵀ·B: grad_rhs = A·cot, dense (M,N)
+                g = _csr_mm(_v, _ip, _c, cot, _M)
+                g_lhs = None if _B is None else jnp.matmul(_B, cot.T)
+            elif _tb:
+                # out = A·rhsᵀ: grad_B = Aᵀ·cot (K,N) dense, transposed back
+                rows = _csr_t_rows(_v, _ip, _c, cot)
+                g = jnp.zeros((_rs[1], cot.shape[1]),
+                              rows.dtype).at[_c].add(rows).T
+                g_lhs = None if _B is None else jnp.matmul(cot, _B.T)
+            else:
+                # out = A·B: grad_rhs = Aᵀ·cot — rows-only on the csr's
+                # columns; stays an _RspCot through the tape (dense only
+                # at an explicit dense deposit)
+                g = _RspCot(_c, _csr_t_rows(_v, _ip, _c, cot), _rs)
+                g_lhs = None if _B is None else jnp.matmul(cot, _B.T)
+            return (g_lhs, g)
+
+        autograd._record(op, [lhs if want_lhs else None, rhs], [res],
+                         vjp_fn, (res,))
+    return res
+
+
+from .register import register_sparse_ex as _register_sparse_ex  # noqa: E402
+
+_register_sparse_ex("dot")(_dot_sparse_ex)
 
 
 def zeros_sparse(stype, shape, ctx=None, dtype=None):
