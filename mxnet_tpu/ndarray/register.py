@@ -69,7 +69,12 @@ def invoke(op_name: str, ndarray_inputs, kwargs, out=None):
     if op_name in _SPARSE_EX:
         from .sparse import BaseSparseNDArray
         if any(isinstance(a, BaseSparseNDArray) for a in ndarray_inputs):
-            return _SPARSE_EX[op_name](op, ndarray_inputs, params, out)
+            res = _SPARSE_EX[op_name](op, ndarray_inputs, params, out)
+            # NotImplemented = handler declined (unsupported stype combo);
+            # fall through to the dense lowering below (parity: storage
+            # fallback, src/executor/attach_op_execs_pass.cc:49-226)
+            if res is not NotImplemented:
+                return res
 
     if op.takes_is_train:
         params["__is_train__"] = autograd.is_training()

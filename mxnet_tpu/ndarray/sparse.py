@@ -440,7 +440,6 @@ def _grad_wanted(a):
 
 def _dot_sparse_ex(op, inputs, params, out):
     """Eager storage-dispatch executor for `dot` with sparse operands."""
-    from ..ops import registry as _ops_reg
     from .. import autograd
 
     lhs, rhs = inputs[0], inputs[1]
@@ -452,22 +451,11 @@ def _dot_sparse_ex(op, inputs, params, out):
                 and not isinstance(rhs, BaseSparseNDArray)
                 and getattr(rhs, "ndim", None) == 2)
     if not nnz_path:
-        # documented dense fallback for the remaining stype combinations —
-        # recorded against the ORIGINAL operands so an attached grad on a
-        # sparse input still receives the dense-lowered gradient
-        params_t = tuple(sorted(params.items()))
-        raw = [lhs._data, rhs._data]
-        if recording:
-            outs, vjp_fn = _ops_reg.make_vjp(op, params_t, raw)
-        else:
-            outs, vjp_fn = _ops_reg.apply_op(op, params_t, raw), None
-        res = NDArray(outs[0], lhs._ctx)
-        if out is not None:
-            out._set_data(res._data.astype(out.dtype))
-            res = out
-        if recording:
-            autograd._record(op, [lhs, rhs], [res], vjp_fn, outs)
-        return res
+        # remaining stype combinations: decline — invoke() continues its
+        # normal dense lowering (documented perf cliff) with profiler
+        # events, out= handling, and recording against the original
+        # operands, so an attached grad on a sparse input still arrives
+        return NotImplemented
 
     vals, indptr, cols = lhs._values, lhs._indptr, lhs._indices_c
     M, K = lhs.shape
@@ -513,8 +501,11 @@ def _dot_sparse_ex(op, inputs, params, out):
                    _rs=rshape, _M=M, _B=B_cap):
             cot = cots[0]  # dense, out-shaped (rsp heads densify upstream)
             if _ta:
-                # out = Aᵀ·B: grad_rhs = A·cot, dense (M,N)
+                # out = Aᵀ·B: grad_B = A·cot, dense (M,N); with tb the
+                # effective B was rhsᵀ, so transpose back to rhs layout
                 g = _csr_mm(_v, _ip, _c, cot, _M)
+                if _tb:
+                    g = g.T
                 g_lhs = None if _B is None else jnp.matmul(_B, cot.T)
             elif _tb:
                 # out = A·rhsᵀ: grad_B = Aᵀ·cot (K,N) dense, transposed back

@@ -831,6 +831,42 @@ def test_ssd_deploy_predictor(tmp_path):
         det.shape
 
 
+def test_rec2idx_tool(tmp_path):
+    """rec2idx builds an index a MXIndexedRecordIO can random-access
+    (parity: tools/rec2idx.py IndexCreator)."""
+    from mxnet_tpu.recordio import MXRecordIO, MXIndexedRecordIO
+    rec = str(tmp_path / "t.rec")
+    w = MXRecordIO(rec, "w")
+    payloads = [b"rec%d" % i * (i + 1) for i in range(7)]
+    for p in payloads:
+        w.write(p)
+    w.close()
+    out = run_example("tools/rec2idx.py", rec, str(tmp_path / "t.idx"))
+    assert "7 records indexed" in out
+    r = MXIndexedRecordIO(str(tmp_path / "t.idx"), rec, "r")
+    for i in (6, 0, 3):
+        assert r.read_idx(i) == payloads[i]
+    r.close()
+
+
+def test_diagnose_tool():
+    out = run_example("tools/diagnose.py", "--device-timeout", "3",
+                      timeout=180)
+    for section in ("Platform Info", "Dependency Versions",
+                    "MXNet-TPU Info", "Device Info"):
+        assert section in out, out
+    assert "jax" in out
+
+
+def test_ipynb2md_tool(tmp_path):
+    src = os.path.join(REPO, "example/notebooks/getting_started.ipynb")
+    dst = str(tmp_path / "g.md")
+    out = run_example("tools/ipynb2md.py", src, "-o", dst)
+    assert "wrote" in out
+    md = open(dst).read()
+    assert "```python" in md and "mxnet_tpu" in md
+
+
 def test_every_example_dir_is_ci_covered():
     """Breadth guard: every example/ directory must be exercised by at
     least one test in this file (or hold only docs) — a new example dir

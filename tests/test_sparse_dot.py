@@ -115,6 +115,23 @@ def test_csr_dot_transpose_b_grad():
                                atol=1e-5)
 
 
+def test_csr_dot_transpose_both_grad():
+    """transpose_a AND transpose_b: grad_rhs must come back in rhs's
+    (N,M) layout, not the effective B's (M,N)."""
+    rs = np.random.RandomState(8)
+    csr, dense = make_csr(rs, 6, 9)  # M=6, K=9; rhs (4, 6)
+    w = mx.nd.array(rs.normal(0, 1, (4, 6)).astype("f"))
+    g = mx.nd.zeros((4, 6))
+    autograd.mark_variables([w], [g])
+    with autograd.record():
+        y = mx.nd.dot(csr, w, transpose_a=True, transpose_b=True)
+    assert y.shape == (9, 4)
+    autograd.backward([y])
+    # out = Aᵀ·rhsᵀ; dL/drhs = (A·cot)ᵀ with cot = ones(9,4)
+    np.testing.assert_allclose(g.asnumpy(), (dense @ np.ones((9, 4))).T,
+                               atol=1e-5)
+
+
 def test_csr_dot_empty():
     w = mx.nd.array(np.ones((11, 3), "f"))
     z = mx.nd.sparse.zeros_sparse("csr", (5, 11), dtype="float32")

@@ -70,9 +70,20 @@ def _run(name, cmd, timeout, summary_path, env=None, capture_to=None):
             rec["captured"] = capture_to
     except subprocess.TimeoutExpired as e:
         rec["rc"] = "timeout"
-        rec["tail"] = ((e.stdout or b"").decode("utf-8", "replace")
-                       if isinstance(e.stdout, bytes)
-                       else (e.stdout or ""))[-2000:]
+
+        def _dec(b):
+            return (b.decode("utf-8", "replace")
+                    if isinstance(b, bytes) else (b or ""))
+
+        partial, perr = _dec(e.stdout), _dec(e.stderr)
+        rec["tail"] = (partial + perr)[-2000:]
+        if capture_to:
+            # a timed-out diagnostic still printed per-phase lines —
+            # durable partial beats nothing (r04g lost its profile this way)
+            with open(os.path.join(REPO, capture_to), "w") as f:
+                f.write(partial + "\n--- stderr ---\n" + perr +
+                        "\n--- TIMEOUT at %.0fs ---\n" % timeout)
+            rec["captured"] = capture_to
     rec["s"] = round(time.perf_counter() - t0, 1)
     SUMMARY["steps"].append(rec)
     _write_summary(summary_path)

@@ -1,0 +1,127 @@
+"""Environment diagnostic for issue reports (parity: tools/diagnose.py —
+OS/hardware/python/deps/framework checks; the reference also probed
+website reachability, which is skipped by default here: TPU pods are
+routinely egress-less, pass --network to attempt it).
+
+    python tools/diagnose.py [--network] [--device-timeout S]
+"""
+import argparse
+import os
+import platform
+import subprocess
+import sys
+import time
+
+
+def _section(title):
+    print("----------" + title + "----------", flush=True)
+
+
+def check_platform():
+    _section("Platform Info")
+    print("Platform     :", platform.platform())
+    print("system       :", platform.system())
+    print("node         :", platform.node())
+    print("release      :", platform.release())
+    print("version      :", platform.version())
+
+
+def check_hardware():
+    _section("Hardware Info")
+    print("machine      :", platform.machine())
+    print("processor    :", platform.processor() or "n/a")
+    if platform.system() == "Linux":
+        try:
+            out = subprocess.run(["lscpu"], capture_output=True, text=True,
+                                 timeout=10).stdout
+            for line in out.splitlines():
+                if any(k in line for k in ("Architecture", "Model name",
+                                           "CPU(s)", "Thread", "MHz")):
+                    print(line)
+        except (OSError, subprocess.TimeoutExpired):
+            pass
+
+
+def check_python():
+    _section("Python Info")
+    print("Version      :", platform.python_version())
+    print("Compiler     :", platform.python_compiler())
+    print("Build        :", platform.python_build())
+
+
+def check_deps():
+    _section("Dependency Versions")
+    for mod in ("numpy", "jax", "jaxlib", "flax", "optax"):
+        try:
+            m = __import__(mod)
+            print("%-12s : %s" % (mod, getattr(m, "__version__", "?")))
+        except ImportError:
+            print("%-12s : NOT INSTALLED" % mod)
+
+
+def check_framework(device_timeout):
+    _section("MXNet-TPU Info")
+    t0 = time.time()
+    try:
+        import mxnet_tpu as mx
+        print("Version      :", mx.__version__)
+        print("Directory    :", os.path.dirname(mx.__file__))
+        print("Import time  : %.2fs" % (time.time() - t0))
+    except Exception as e:  # noqa: BLE001 — diagnostic must keep going
+        print("IMPORT FAILED:", e)
+        return
+    # device probe in a SUBPROCESS: a dead axon tunnel hangs instead of
+    # erroring, and a diagnostic that hangs is useless
+    _section("Device Info")
+    code = ("import mxnet_tpu as mx; "
+            "print('tpu chips   :', mx.context.num_tpus())")
+    try:
+        out = subprocess.run([sys.executable, "-c", code],
+                             capture_output=True, text=True,
+                             timeout=device_timeout)
+        print(out.stdout.strip() or out.stderr.strip()[-200:])
+    except subprocess.TimeoutExpired:
+        print("tpu chips   : PROBE TIMED OUT after %ss (tunnel down?)"
+              % device_timeout)
+    env = {k: v for k, v in os.environ.items() if k.startswith("MXNET_")}
+    if env:
+        _section("MXNET_* Environment")
+        for k in sorted(env):
+            print("%-28s = %s" % (k, env[k]))
+
+
+def check_network(timeout=5):
+    _section("Network Test")
+    try:
+        from urllib.request import urlopen
+    except ImportError:
+        print("urllib unavailable")
+        return
+    for name, url in (("PYPI", "https://pypi.python.org"),
+                      ("Github", "https://github.com")):
+        t0 = time.time()
+        try:
+            urlopen(url, timeout=timeout)
+            print("%s ok in %.3fs" % (name, time.time() - t0))
+        except Exception as e:  # noqa: BLE001
+            print("%s FAILED (%s)" % (name, type(e).__name__))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--network", action="store_true",
+                    help="also probe external sites (off by default: "
+                         "TPU pods are typically egress-less)")
+    ap.add_argument("--device-timeout", type=float, default=20.0)
+    args = ap.parse_args()
+    check_platform()
+    check_hardware()
+    check_python()
+    check_deps()
+    check_framework(args.device_timeout)
+    if args.network:
+        check_network()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,46 @@
+"""Convert a Jupyter notebook to markdown, dropping outputs
+(parity: tools/ipynb2md.py — used to publish example notebooks as docs).
+
+Pure-json implementation (no nbconvert dependency): markdown cells pass
+through, code cells become fenced ```python blocks, outputs are removed.
+
+    python tools/ipynb2md.py example/notebooks/getting_started.ipynb [-o out.md]
+"""
+import argparse
+import json
+import os
+
+
+def notebook_to_md(nb):
+    """Notebook dict -> markdown string (outputs stripped)."""
+    parts = []
+    for cell in nb.get("cells", []):
+        src = "".join(cell.get("source", []))
+        if not src.strip():
+            continue
+        if cell.get("cell_type") == "markdown":
+            parts.append(src.rstrip())
+        elif cell.get("cell_type") == "code":
+            parts.append("```python\n%s\n```" % src.rstrip())
+    return "\n\n".join(parts) + "\n"
+
+
+def main():
+    ap = argparse.ArgumentParser(
+        description="Convert .ipynb to .md (outputs removed)")
+    ap.add_argument("input", help="input notebook")
+    ap.add_argument("-o", "--output", default=None,
+                    help="output path (default: input with .md suffix)")
+    args = ap.parse_args()
+    out_path = args.output or os.path.splitext(args.input)[0] + ".md"
+    with open(args.input) as f:
+        nb = json.load(f)
+    md = notebook_to_md(nb)
+    with open(out_path, "w") as f:
+        f.write(md)
+    print("wrote %s (%d chars from %d cells)"
+          % (out_path, len(md), len(nb.get("cells", []))))
+
+
+if __name__ == "__main__":
+    main()

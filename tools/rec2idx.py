@@ -1,0 +1,48 @@
+"""Create a random-access .idx file for an existing .rec file
+(parity: tools/rec2idx.py IndexCreator — reads the RecordIO stream
+sequentially, recording the byte offset of every record).
+
+The index format matches MXIndexedRecordIO: one `key\toffset` line per
+record, keys numbered 0..N-1, so a packed dataset gains shuffled /
+distributed-shard access without repacking.
+
+    python tools/rec2idx.py data/train.rec data/train.idx
+"""
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from mxnet_tpu.recordio import MXRecordIO
+
+
+def create_index(rec_path, idx_path, key_dtype=int):
+    """Walk the .rec sequentially; write `key\toffset` per record."""
+    reader = MXRecordIO(rec_path, "r")
+    n = 0
+    with open(idx_path, "w") as idx:
+        while True:
+            pos = reader.tell()
+            if reader.read() is None:
+                break
+            idx.write("%s\t%d\n" % (key_dtype(n), pos))
+            n += 1
+    reader.close()
+    return n
+
+
+def main():
+    ap = argparse.ArgumentParser(
+        description="Make an index file for a RecordIO file")
+    ap.add_argument("record", help="path to the .rec file")
+    ap.add_argument("index", help="path for the output .idx file")
+    args = ap.parse_args()
+    t0 = time.time()
+    n = create_index(args.record, args.index)
+    print("wrote %s: %d records indexed in %.2fs"
+          % (args.index, n, time.time() - t0))
+
+
+if __name__ == "__main__":
+    main()
