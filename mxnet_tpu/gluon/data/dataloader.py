@@ -71,3 +71,9 @@ class DataLoader:
 
     def __len__(self):
         return len(self._batch_sampler)
+
+
+# parity alias: the reference's multiprocessing batchify is the same
+# stacking logic (shared-memory pickling is a CUDA-host concern the
+# jax.Array path doesn't have)
+default_mp_batchify_fn = default_batchify_fn

@@ -5,7 +5,9 @@ Parity: `python/mxnet/ndarray/__init__.py` — flat op functions plus
 """
 from .ndarray import (NDArray, array, zeros, ones, full, empty, arange, eye,
                       concatenate, moveaxis, waitall, save, load, from_numpy,
-                      from_dlpack)
+                      from_dlpack, equal, not_equal, greater, greater_equal,
+                      lesser, lesser_equal, modulo, true_divide,
+                      onehot_encode)
 from . import register
 from .register import invoke, _gen
 
@@ -24,7 +26,6 @@ from .sparse import CSRNDArray, RowSparseNDArray
 cast_storage = sparse.cast_storage
 sparse_retain = sparse.retain
 
-onehot_encode = _gen.one_hot
 imdecode = None  # provided by mxnet_tpu.image
 
 
@@ -34,7 +35,9 @@ def maximum(lhs, rhs, **kw):
         return _gen.broadcast_maximum(lhs, rhs)
     if isinstance(lhs, NDArray):
         return _gen._maximum_scalar(lhs, scalar=float(rhs))
-    return _gen._maximum_scalar(rhs, scalar=float(lhs))
+    if isinstance(rhs, NDArray):
+        return _gen._maximum_scalar(rhs, scalar=float(lhs))
+    return lhs if lhs > rhs else rhs
 
 
 def minimum(lhs, rhs, **kw):
@@ -42,7 +45,20 @@ def minimum(lhs, rhs, **kw):
         return _gen.broadcast_minimum(lhs, rhs)
     if isinstance(lhs, NDArray):
         return _gen._minimum_scalar(lhs, scalar=float(rhs))
-    return _gen._minimum_scalar(rhs, scalar=float(lhs))
+    if isinstance(rhs, NDArray):
+        return _gen._minimum_scalar(rhs, scalar=float(lhs))
+    return lhs if lhs < rhs else rhs
+
+
+def hypot(lhs, rhs):
+    """sqrt(lhs² + rhs²) of arrays/scalars (parity: nd.hypot)."""
+    if isinstance(lhs, NDArray) and isinstance(rhs, NDArray):
+        return _gen.broadcast_hypot(lhs, rhs)
+    if isinstance(lhs, NDArray):
+        return _gen._hypot_scalar(lhs, scalar=float(rhs))
+    if isinstance(rhs, NDArray):
+        return _gen._hypot_scalar(rhs, scalar=float(lhs))
+    return (lhs * lhs + rhs * rhs) ** 0.5
 
 
 def add(l, r):
@@ -63,3 +79,6 @@ def divide(l, r):
 
 def power(l, r):
     return l ** r
+
+
+pow = power

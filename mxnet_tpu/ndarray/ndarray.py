@@ -608,6 +608,37 @@ def from_dlpack(cap) -> NDArray:
     return NDArray(jnp.from_dlpack(cap))
 
 
+def _mod_fn(dunder, mirror):
+    """Module-level binary helper (parity: the ndarray.py free functions
+    equal/greater/... that mirror the operator dunders).  A scalar lhs
+    dispatches the MIRRORED comparison on the NDArray rhs
+    (greater(2, x) == x < 2)."""
+    def fn(lhs, rhs):
+        if isinstance(lhs, NDArray):
+            return getattr(lhs, dunder)(rhs)
+        if isinstance(rhs, NDArray):
+            return getattr(rhs, mirror)(lhs)
+        raise TypeError("at least one operand must be an NDArray")
+    return fn
+
+
+equal = _mod_fn("__eq__", "__eq__")
+not_equal = _mod_fn("__ne__", "__ne__")
+greater = _mod_fn("__gt__", "__lt__")
+greater_equal = _mod_fn("__ge__", "__le__")
+lesser = _mod_fn("__lt__", "__gt__")
+lesser_equal = _mod_fn("__le__", "__ge__")
+modulo = _mod_fn("__mod__", "__rmod__")
+true_divide = _mod_fn("__truediv__", "__rtruediv__")
+
+
+def onehot_encode(indices, out):
+    """Deprecated one-hot (parity: ndarray.onehot_encode — kept for v0
+    compat; use `one_hot`)."""
+    from . import _gen
+    return _gen.one_hot(indices, depth=out.shape[1], out=out)
+
+
 def moveaxis(a: NDArray, source, destination) -> NDArray:
     return NDArray(jnp.moveaxis(a._data, source, destination), a._ctx)
 

@@ -105,3 +105,8 @@ class NativeOp(PythonOp):
 
 class NDArrayOp(PythonOp):
     pass
+
+
+class NumpyOp(PythonOp):
+    """Deprecated v0.x numpy custom-op base (parity: operator.py
+    NumpyOp) — superseded by CustomOp/CustomOpProp."""

@@ -322,6 +322,11 @@ class SGD(Optimizer):
 
 
 @register
+class ccSGD(SGD):
+    """Deprecated alias of SGD (parity: optimizer.py ccSGD — the old
+    C++-side SGD; identical math here)."""
+
+@register
 class NAG(Optimizer):
     """Nesterov accelerated SGD (parity: optimizer.py NAG — the lookahead
     form: w -= lr*(grad + momentum*mom) after mom = momentum*mom + grad)."""

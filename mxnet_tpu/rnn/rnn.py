@@ -30,3 +30,18 @@ def do_rnn_checkpoint(cells, prefix, period=1):
             save_rnn_checkpoint(cells, prefix, iter_no + 1, sym, arg, aux)
 
     return _callback
+
+
+def rnn_unroll(cell, length, inputs=None, begin_state=None,
+               input_prefix="", layout="NTC"):
+    """Deprecated (parity: rnn.rnn_unroll) — use cell.unroll.  An
+    input_prefix names the auto-generated per-step input variables the
+    way the v0 API did (`<prefix>t<i>_data`)."""
+    import warnings
+    warnings.warn("rnn_unroll is deprecated; call cell.unroll directly.")
+    if inputs is None:
+        from .. import symbol as _sym
+        inputs = [_sym.Variable("%st%d_data" % (input_prefix, i))
+                  for i in range(length)]
+    return cell.unroll(length=length, inputs=inputs,
+                       begin_state=begin_state, layout=layout)

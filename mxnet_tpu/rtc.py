@@ -51,3 +51,4 @@ class PallasModule:
 
 
 CudaModule = PallasModule
+CudaKernel = Kernel  # reference rtc.CudaKernel role: a launchable handle

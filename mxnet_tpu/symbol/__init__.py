@@ -9,5 +9,9 @@ for _name in dir(_gen):
     if not _name.startswith("__"):
         _g[_name] = getattr(_gen, _name)
 
+# scalar/Symbol-dispatching free functions AFTER the op hoist so they
+# shadow the raw generated wrappers (which don't take scalars)
+from .symbol import pow, maximum, minimum, hypot  # noqa: E402
+
 from . import graph
 from .graph import GraphPlan

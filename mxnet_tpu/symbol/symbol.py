@@ -452,3 +452,31 @@ def arange(start, stop=None, step=1.0, repeat=1, dtype=None, **kwargs) -> Symbol
     return _r.invoke_symbol("_arange", [], {"start": start, "stop": stop,
                                             "step": step, "repeat": repeat,
                                             "dtype": dtype or "float32"})
+
+
+def _binary_free_fn(op, scalar_op, rscalar_op, pyfn):
+    """Scalar/Symbol-dispatching free function (parity: the symbol.py
+    pow/maximum/minimum/hypot helpers, symbol/symbol.py:2524-2703)."""
+    def fn(left, right):
+        from . import register as _r
+        lsym, rsym = isinstance(left, Symbol), isinstance(right, Symbol)
+        if lsym and rsym:
+            return _r.invoke_symbol(op, [left, right], {})
+        if lsym:
+            return _r.invoke_symbol(scalar_op, [left],
+                                    {"scalar": float(right)})
+        if rsym:
+            return _r.invoke_symbol(rscalar_op, [right],
+                                    {"scalar": float(left)})
+        return pyfn(left, right)
+    return fn
+
+
+pow = _binary_free_fn("_power", "_power_scalar", "_rpower_scalar",
+                      lambda a, b: a ** b)
+maximum = _binary_free_fn("_maximum", "_maximum_scalar", "_maximum_scalar",
+                          lambda a, b: a if a > b else b)
+minimum = _binary_free_fn("_minimum", "_minimum_scalar", "_minimum_scalar",
+                          lambda a, b: a if a < b else b)
+hypot = _binary_free_fn("_hypot", "_hypot_scalar", "_hypot_scalar",
+                        lambda a, b: (a * a + b * b) ** 0.5)

@@ -11,6 +11,7 @@ import numpy as _np
 
 from .base import MXNetError
 from .context import Context, cpu, current_context
+from . import io
 from . import ndarray as nd
 from .ndarray import NDArray
 from . import symbol as sym
@@ -518,3 +519,268 @@ def golden_fixture_path(name):
     return _os.path.join(_os.path.dirname(_os.path.dirname(
         _os.path.abspath(__file__))), "tests", "golden",
         f"{name}.npz")
+
+
+# -- reference test_utils closure (round-4 API audit) -----------------------
+
+def rand_sparse_ndarray(shape, stype, density=None, dtype=None,
+                        distribution=None, data_init=None,
+                        rsp_indices=None, modifier_func=None,
+                        shuffle_csr_indices=False):
+    """Random sparse NDArray (parity: test_utils.rand_sparse_ndarray —
+    returns (arr, aux) with aux = (vals, idx) for rsp, (data, indices,
+    indptr) for csr).  distribution: 'uniform' (default) or 'powerlaw'
+    (csr only — geometrically decaying per-row nnz, the reference's
+    skewed-structure generator)."""
+    density = 0.1 if density is None else density
+    dtype = dtype or "float32"
+    if distribution not in (None, "uniform", "powerlaw"):
+        raise MXNetError(f"unsupported distribution {distribution!r}")
+    from .ndarray import sparse
+    if stype == "row_sparse":
+        if distribution == "powerlaw":
+            raise MXNetError("powerlaw distribution is csr-only")
+        if rsp_indices is not None:
+            idx = _np.asarray(rsp_indices, _np.int64)
+        else:
+            n = max(1, int(round(shape[0] * density)))
+            idx = _np.sort(_np.random.choice(shape[0], n, replace=False))
+        vals = _np.random.randn(len(idx), *shape[1:]).astype(dtype)
+        if data_init is not None:
+            vals[:] = data_init
+        if modifier_func is not None:
+            vals = _np.vectorize(modifier_func)(vals).astype(dtype)
+        arr = sparse.row_sparse_array((vals, idx), shape=shape, dtype=dtype)
+        return arr, (vals, idx)
+    if stype == "csr":
+        if distribution == "powerlaw":
+            # row i gets ~2x row i+1's nonzeros until the budget runs out
+            total = max(1, int(round(shape[0] * shape[1] * density)))
+            dense = _np.zeros(shape, dtype)
+            unused = total
+            per_row = max(1, int(round(unused * 0.5)))
+            for i in range(shape[0]):
+                n = min(per_row, shape[1], unused)
+                if n <= 0:
+                    break
+                cols = _np.random.choice(shape[1], n, replace=False)
+                dense[i, cols] = _np.random.randn(n)
+                unused -= n
+                per_row = max(1, per_row // 2)
+        else:
+            dense = _np.random.randn(*shape).astype(dtype)
+            dense *= _np.random.rand(*shape) < density
+        if data_init is not None:
+            dense[dense != 0] = data_init
+        if modifier_func is not None:
+            nz = dense != 0
+            dense[nz] = _np.vectorize(modifier_func)(dense[nz])
+        arr = sparse.csr_matrix(nd.array(dense.astype(dtype)))
+        if shuffle_csr_indices:
+            arr = shuffle_csr_column_indices(arr)
+        return arr, (arr.data.asnumpy(), arr.indices.asnumpy(),
+                     arr.indptr.asnumpy())
+    raise MXNetError(f"unknown storage type {stype}")
+
+
+def create_sparse_array(shape, stype, data_init=None, rsp_indices=None,
+                        dtype=None, modifier_func=None, density=0.5,
+                        shuffle_csr_indices=False):
+    """Parity: test_utils.create_sparse_array."""
+    arr, _ = rand_sparse_ndarray(shape, stype, density=density, dtype=dtype,
+                                 data_init=data_init,
+                                 rsp_indices=rsp_indices,
+                                 modifier_func=modifier_func,
+                                 shuffle_csr_indices=shuffle_csr_indices)
+    return arr
+
+
+def create_sparse_array_zd(shape, stype, density, data_init=None,
+                           rsp_indices=None, dtype=None,
+                           modifier_func=None, shuffle_csr_indices=False):
+    """Sparse array generator admitting zero-density (parity:
+    test_utils.create_sparse_array_zd)."""
+    if stype == "row_sparse" and density == 0:
+        rsp_indices = _np.array([], _np.int64)
+    return create_sparse_array(shape, stype, data_init=data_init,
+                               rsp_indices=rsp_indices, dtype=dtype,
+                               modifier_func=modifier_func,
+                               density=density,
+                               shuffle_csr_indices=shuffle_csr_indices)
+
+
+def shuffle_csr_column_indices(csr):
+    """Permute column order within each CSR row (parity: tests feed
+    unsorted-column CSRs to check kernels don't assume sorted cols)."""
+    from .ndarray.sparse import CSRNDArray
+    indptr = _np.asarray(csr.indptr.asnumpy())
+    cols = _np.array(csr.indices.asnumpy())
+    vals = _np.array(csr.data.asnumpy())
+    for i in range(len(indptr) - 1):
+        s, e = indptr[i], indptr[i + 1]
+        p = _np.random.permutation(e - s)
+        cols[s:e] = cols[s:e][p]
+        vals[s:e] = vals[s:e][p]
+    return CSRNDArray(vals, indptr, cols, csr.shape)
+
+
+def almost_equal_ignore_nan(a, b, rtol=None, atol=None):
+    """Parity: test_utils.almost_equal_ignore_nan — drop positions where
+    EITHER side is NaN, compare the rest."""
+    a = _np.copy(a)
+    b = _np.copy(b)
+    nan_mask = _np.logical_or(_np.isnan(a), _np.isnan(b))
+    a[nan_mask] = 0
+    b[nan_mask] = 0
+    return almost_equal(a, b, rtol, atol)
+
+
+def assert_almost_equal_ignore_nan(a, b, rtol=None, atol=None,
+                                   names=("a", "b")):
+    a = _np.copy(a)
+    b = _np.copy(b)
+    nan_mask = _np.logical_or(_np.isnan(a), _np.isnan(b))
+    a[nan_mask] = 0
+    b[nan_mask] = 0
+    assert_almost_equal(a, b, rtol, atol, names)
+
+
+def same_array(array1, array2):
+    """Whether two NDArrays share the same backing buffer (parity:
+    test_utils.same_array's aliasing probe — functional buffers make
+    identity the sharing criterion).  Sparse arrays rebuild their dense
+    view per access, so only object identity can witness sharing."""
+    if array1 is array2:
+        return True
+    if array1.shape != array2.shape:
+        return False
+    if array1.stype != "default" or array2.stype != "default":
+        return False
+    return array1._data is array2._data
+
+
+def assign_each(the_input, function):
+    """Elementwise python function application (parity: assign_each)."""
+    arr = _np.array(the_input.asnumpy() if hasattr(the_input, "asnumpy")
+                    else the_input)
+    out = _np.vectorize(function)(arr) if function is not None else arr
+    return nd.array(out.astype(arr.dtype))
+
+
+def assign_each2(input1, input2, function):
+    a = _np.array(input1.asnumpy() if hasattr(input1, "asnumpy")
+                  else input1)
+    b = _np.array(input2.asnumpy() if hasattr(input2, "asnumpy")
+                  else input2)
+    out = _np.vectorize(function)(a, b) if function is not None else a
+    return nd.array(out.astype(a.dtype))
+
+
+class DummyIter(io.DataIter):
+    """Infinite repetition of the first batch of a real iterator —
+    removes IO cost from op benchmarks (parity: test_utils.DummyIter,
+    a DataIter so reset()-calling training loops work)."""
+
+    def __init__(self, real_iter):
+        super().__init__(real_iter.batch_size)
+        self.real_iter = real_iter
+        self._provide_data = real_iter.provide_data
+        self._provide_label = real_iter.provide_label
+        self.the_batch = next(iter(real_iter))
+
+    @property
+    def provide_data(self):
+        return self._provide_data
+
+    @property
+    def provide_label(self):
+        return self._provide_label
+
+    def next(self):
+        return self.the_batch
+
+
+def check_speed(sym_, location=None, ctx=None, N=20, grad_req=None,
+                typ="whole", **kwargs):
+    """Mean seconds/iteration of forward(+backward) on a bound executor
+    (parity: test_utils.check_speed)."""
+    import time
+    ctx = ctx or default_context()
+    if grad_req is None:
+        grad_req = "write" if typ == "whole" else "null"
+    if location is None:
+        shapes, _, _ = sym_.infer_shape(**kwargs)
+        location = {k: _np.random.normal(0, 1, s).astype("float32")
+                    for k, s in zip(sym_.list_arguments(), shapes)}
+    exe = sym_.simple_bind(ctx=ctx, grad_req=grad_req,
+                           **{k: v.shape for k, v in location.items()})
+    for k, v in location.items():
+        exe.arg_dict[k][:] = v
+    # warmup (compile) then timed loop with one end sync
+    if typ == "whole":
+        exe.forward(is_train=True)
+        exe.backward(out_grads=exe.outputs)
+        exe.outputs[0].wait_to_read()
+        tic = time.time()
+        for _ in range(N):
+            exe.forward(is_train=True)
+            exe.backward(out_grads=exe.outputs)
+        _np.asarray(exe.outputs[0].asnumpy())
+        return (time.time() - tic) / N
+    exe.forward(is_train=False)
+    exe.outputs[0].wait_to_read()
+    tic = time.time()
+    for _ in range(N):
+        exe.forward(is_train=False)
+    _np.asarray(exe.outputs[0].asnumpy())
+    return (time.time() - tic) / N
+
+
+def get_bz2_data(data_dir, data_name, url, data_origin_name):
+    """Fetch+decompress a .bz2 dataset (parity: test_utils.get_bz2_data;
+    on an egress-less pod an already-present archive is decompressed
+    without network)."""
+    import bz2
+    import os
+    path = os.path.join(data_dir, data_name)
+    origin = os.path.join(data_dir, data_origin_name)
+    if os.path.exists(path):
+        return path
+    if not os.path.exists(origin):
+        download(url, fname=origin)
+    with bz2.BZ2File(origin, "rb") as src, open(path, "wb") as dst:
+        dst.write(src.read())
+    return path
+
+
+def set_env_var(key, val, default_val=""):
+    """Set an env var, returning the previous value (parity:
+    test_utils.set_env_var)."""
+    import os
+    prev = os.environ.get(key, default_val)
+    if val is None:
+        os.environ.pop(key, None)
+    else:
+        os.environ[key] = str(val)
+    return prev
+
+
+def retry(n):
+    """Decorator: re-run a flaky test up to n times on assertion failure
+    (parity: test_utils.retry)."""
+    assert n > 0
+
+    def decorate(f):
+        import functools
+
+        @functools.wraps(f)
+        def wrapper(*args, **kwargs):
+            for i in range(n):
+                try:
+                    return f(*args, **kwargs)
+                except AssertionError:
+                    if i == n - 1:
+                        raise
+            return None
+        return wrapper
+    return decorate
