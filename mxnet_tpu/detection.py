@@ -199,6 +199,40 @@ class DetRandomPadAug(DetAugmenter):
         return canvas, out
 
 
+def CreateMultiRandCropAugmenter(min_object_covered=0.1,
+                                 aspect_ratio_range=(0.75, 1.33),
+                                 area_range=(0.05, 1.0),
+                                 min_eject_coverage=0.3, max_attempts=50,
+                                 skip_prob=0):
+    """Compose several IOU-constrained random-crop augmenters, one per
+    parameter combination, behind a random selector (parity:
+    detection.py CreateMultiRandCropAugmenter — scalar parameters are
+    broadcast to the longest list length)."""
+    def listify(v):
+        return v if isinstance(v, list) else [v]
+
+    moc = listify(min_object_covered)
+    arr_ = listify(aspect_ratio_range)
+    area = listify(area_range)
+    mec = listify(min_eject_coverage)
+    ma = listify(max_attempts)
+    n = max(len(x) for x in (moc, arr_, area, mec, ma))
+    for name, lst in (("min_object_covered", moc),
+                      ("aspect_ratio_range", arr_),
+                      ("area_range", area),
+                      ("min_eject_coverage", mec),
+                      ("max_attempts", ma)):
+        if len(lst) not in (1, n):
+            raise ValueError(f"{name}: length {len(lst)} != {n}")
+    crops = [DetRandomCropAug(
+        min_object_covered=moc[i % len(moc)],
+        aspect_ratio_range=arr_[i % len(arr_)],
+        area_range=area[i % len(area)],
+        min_eject_coverage=mec[i % len(mec)],
+        max_attempts=ma[i % len(ma)]) for i in range(n)]
+    return DetRandomSelectAug(crops, skip_prob=skip_prob)
+
+
 def CreateDetAugmenter(data_shape, resize=0, rand_crop=0, rand_pad=0,
                        rand_gray=0, rand_mirror=False, mean=None, std=None,
                        brightness=0, contrast=0, saturation=0, pca_noise=0,

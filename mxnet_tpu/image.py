@@ -106,6 +106,38 @@ def center_crop(src, size, interp=2):
     return out, (x0, y0, new_w, new_h)
 
 
+def scale_down(src_size, size):
+    """Shrink a requested crop (w, h) to fit inside src (w, h) keeping
+    its aspect ratio (parity: image.scale_down)."""
+    w, h = size
+    sw, sh = src_size
+    if sh < h:
+        w, h = float(w * sh) / h, sh
+    if sw < w:
+        w, h = sw, float(h * sw) / w
+    return int(w), int(h)
+
+
+def random_size_crop(src, size, min_area, ratio, interp=2):
+    """Random area-and-aspect crop resized to `size` (parity:
+    image.random_size_crop — the inception-style crop).  Falls back to a
+    random fitting crop when no sample satisfies the constraints."""
+    h, w = src.shape[:2]
+    area = h * w
+    for _ in range(10):
+        target_area = _pyrandom.uniform(min_area, 1.0) * area
+        log_ratio = (_np.log(ratio[0]), _np.log(ratio[1]))
+        new_ratio = _np.exp(_pyrandom.uniform(*log_ratio))
+        new_w = int(round((target_area * new_ratio) ** 0.5))
+        new_h = int(round((target_area / new_ratio) ** 0.5))
+        if new_w <= w and new_h <= h:
+            x0 = _pyrandom.randint(0, w - new_w)
+            y0 = _pyrandom.randint(0, h - new_h)
+            out = fixed_crop(src, x0, y0, new_w, new_h, size, interp)
+            return out, (x0, y0, new_w, new_h)
+    return random_crop(src, size, interp)
+
+
 def color_normalize(src, mean, std=None):
     arr = _as_np(src).astype(_np.float32, copy=False)
     if mean is not None:
@@ -261,6 +293,106 @@ class RandomGrayAug(Augmenter):
         return [src]
 
 
+class HueJitterAug(Augmenter):
+    """Parity: image.py HueJitterAug — rotate chroma in YIQ space by a
+    random angle in [-hue, hue]·π."""
+
+    _TYIQ = _np.array([[0.299, 0.587, 0.114],
+                       [0.596, -0.274, -0.321],
+                       [0.211, -0.523, 0.311]], _np.float32)
+    _ITYIQ = _np.array([[1.0, 0.956, 0.621],
+                        [1.0, -0.272, -0.647],
+                        [1.0, -1.107, 1.705]], _np.float32)
+
+    def __init__(self, hue):
+        super().__init__(hue=hue)
+        self.hue = hue
+
+    def __call__(self, src):
+        alpha = _pyrandom.uniform(-self.hue, self.hue)
+        u = _np.cos(alpha * _np.pi)
+        w = _np.sin(alpha * _np.pi)
+        rot = _np.array([[1.0, 0.0, 0.0],
+                         [0.0, u, -w],
+                         [0.0, w, u]], _np.float32)
+        t = (self._ITYIQ @ rot @ self._TYIQ).T
+        arr = _as_np(src).astype(_np.float32, copy=False)
+        return [_like(arr @ t, src)]
+
+
+class LightingAug(Augmenter):
+    """Parity: image.py LightingAug — AlexNet-style PCA lighting noise:
+    add eigvec·(alpha∘eigval) with alpha ~ N(0, alphastd)."""
+
+    def __init__(self, alphastd, eigval, eigvec):
+        super().__init__(alphastd=alphastd, eigval=eigval, eigvec=eigvec)
+        self.alphastd = alphastd
+        self.eigval = _np.asarray(eigval, _np.float32)
+        self.eigvec = _np.asarray(eigvec, _np.float32)
+
+    def __call__(self, src):
+        alpha = _np.random.normal(0, self.alphastd, size=(3,))
+        rgb = self.eigvec @ (alpha * self.eigval)
+        arr = _as_np(src).astype(_np.float32, copy=False)
+        return [_like(arr + rgb.astype(_np.float32), src)]
+
+
+class SequentialAug(Augmenter):
+    """Parity: image.py SequentialAug — apply sub-augmenters in order."""
+
+    def __init__(self, ts):
+        super().__init__()
+        self.ts = ts
+
+    def __call__(self, src):
+        imgs = [src]
+        for aug in self.ts:
+            imgs = [out for img in imgs for out in aug(img)]
+        return imgs
+
+    def dumps(self):
+        return [self.__class__.__name__.lower(),
+                [a.dumps() for a in self.ts]]
+
+
+class RandomOrderAug(Augmenter):
+    """Parity: image.py RandomOrderAug — apply sub-augmenters in a
+    random order."""
+
+    def __init__(self, ts):
+        super().__init__()
+        self.ts = ts
+
+    def __call__(self, src):
+        order = list(self.ts)
+        _pyrandom.shuffle(order)
+        imgs = [src]
+        for aug in order:
+            imgs = [out for img in imgs for out in aug(img)]
+        return imgs
+
+    def dumps(self):
+        return [self.__class__.__name__.lower(),
+                [a.dumps() for a in self.ts]]
+
+
+class RandomSizedCropAug(Augmenter):
+    """Parity: image.py RandomSizedCropAug — random_size_crop as an
+    augmenter (inception training crop)."""
+
+    def __init__(self, size, min_area, ratio, interp=2):
+        super().__init__(size=size, min_area=min_area, ratio=ratio,
+                         interp=interp)
+        self.size = size
+        self.min_area = min_area
+        self.ratio = ratio
+        self.interp = interp
+
+    def __call__(self, src):
+        return [random_size_crop(src, self.size, self.min_area,
+                                 self.ratio, self.interp)[0]]
+
+
 class ColorNormalizeAug(Augmenter):
     def __init__(self, mean, std):
         super().__init__(mean=mean, std=std)
@@ -284,22 +416,46 @@ def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
                     rand_mirror=False, mean=None, std=None, brightness=0,
                     contrast=0, saturation=0, hue=0, pca_noise=0, rand_gray=0,
                     inter_method=2):
-    """Parity: image.CreateAugmenter."""
+    """Parity: image.CreateAugmenter (full flag set: rand_resize →
+    inception crop, color jitters composed in random order, PCA
+    lighting, random gray)."""
     auglist: List[Augmenter] = []
     if resize > 0:
         auglist.append(ResizeAug(resize, inter_method))
     crop_size = (data_shape[2], data_shape[1])
-    if rand_crop:
+    if rand_resize:
+        assert rand_crop
+        auglist.append(RandomSizedCropAug(crop_size, 0.08,
+                                          (3.0 / 4.0, 4.0 / 3.0),
+                                          inter_method))
+    elif rand_crop:
         auglist.append(RandomCropAug(crop_size, inter_method))
     else:
         auglist.append(CenterCropAug(crop_size, inter_method))
     if rand_mirror:
         auglist.append(HorizontalFlipAug(0.5))
     auglist.append(CastAug())
+    jitters: List[Augmenter] = []
     if brightness:
-        auglist.append(BrightnessJitterAug(brightness))
+        jitters.append(BrightnessJitterAug(brightness))
     if contrast:
-        auglist.append(ContrastJitterAug(contrast))
+        jitters.append(ContrastJitterAug(contrast))
+    if saturation:
+        jitters.append(SaturationJitterAug(saturation))
+    if len(jitters) > 1:
+        auglist.append(RandomOrderAug(jitters))
+    else:
+        auglist.extend(jitters)
+    if hue:
+        auglist.append(HueJitterAug(hue))
+    if pca_noise > 0:
+        eigval = _np.array([55.46, 4.794, 1.148])
+        eigvec = _np.array([[-0.5675, 0.7192, 0.4009],
+                            [-0.5808, -0.0045, -0.8140],
+                            [-0.5836, -0.6948, 0.4203]])
+        auglist.append(LightingAug(pca_noise, eigval, eigvec))
+    if rand_gray > 0:
+        auglist.append(RandomGrayAug(rand_gray))
     if mean is True:
         mean = _np.array([123.68, 116.28, 103.53])
     if std is True:

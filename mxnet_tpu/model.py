@@ -11,6 +11,7 @@ from collections import namedtuple
 from typing import Dict, Tuple
 
 from .base import MXNetError
+from .context import cpu
 from . import ndarray as nd
 from . import symbol as sym
 
@@ -65,3 +66,144 @@ def _create_kvstore(kvstore, num_device: int, arg_params):
     if kv is None:
         update_on_kvstore = False
     return kv, update_on_kvstore
+
+
+class FeedForward:
+    """Legacy v0.x model API (parity: model.py FeedForward — kept for
+    pre-Module user code; delegates to mx.mod.Module, which is the
+    supported path).  Supports numpy or DataIter inputs, fit/predict/
+    score, save/load checkpoints, and the one-call `create`."""
+
+    def __init__(self, symbol, ctx=None, num_epoch=None, epoch_size=None,
+                 optimizer="sgd", initializer=None, numpy_batch_size=128,
+                 arg_params=None, aux_params=None,
+                 allow_extra_params=False, begin_epoch=0, **kwargs):
+        from . import initializer as _init
+        self.symbol = symbol
+        self.ctx = ctx
+        self.num_epoch = num_epoch
+        self.epoch_size = epoch_size
+        self.optimizer = optimizer
+        self.initializer = initializer or _init.Uniform(0.01)
+        self.numpy_batch_size = numpy_batch_size
+        self.arg_params = arg_params
+        self.aux_params = aux_params
+        self.allow_extra_params = allow_extra_params
+        self.begin_epoch = begin_epoch
+        self.optimizer_params = kwargs
+        self._mod = None
+
+    # -- data plumbing ------------------------------------------------------
+    def _as_iter(self, X, y=None, is_train=False):
+        from . import io as _io
+        import numpy as _np2
+        if isinstance(X, _io.DataIter):
+            return X
+        X = _np2.asarray(X)
+        if y is None and is_train:
+            raise MXNetError("y is required when X is a numpy array")
+        y = _np2.zeros(X.shape[0]) if y is None else _np2.asarray(y)
+        return _io.NDArrayIter(X, y, batch_size=min(self.numpy_batch_size,
+                                                    X.shape[0]),
+                               shuffle=is_train)
+
+    def _init_module(self, it):
+        from . import module as _mod
+        self._mod = _mod.Module(
+            self.symbol,
+            data_names=[d.name for d in it.provide_data],
+            label_names=[l.name for l in it.provide_label],
+            context=self.ctx or cpu())
+        self._mod.bind(data_shapes=it.provide_data,
+                       label_shapes=it.provide_label, for_training=True)
+        self._mod.init_params(self.initializer,
+                              arg_params=self.arg_params,
+                              aux_params=self.aux_params,
+                              allow_missing=self.arg_params is not None,
+                              allow_extra=self.allow_extra_params)
+
+    # -- training / inference ----------------------------------------------
+    def fit(self, X, y=None, eval_data=None, eval_metric="acc",
+            epoch_end_callback=None, batch_end_callback=None,
+            kvstore="local", logger=None, monitor=None):
+        from . import metric as _metric
+        it = self._as_iter(X, y, is_train=True)
+        if self._mod is None:
+            self._init_module(it)
+        if isinstance(eval_metric, str):
+            eval_metric = _metric.create(eval_metric)
+        self._mod.fit(it, eval_data=eval_data, eval_metric=eval_metric,
+                      kvstore=kvstore, optimizer=self.optimizer,
+                      optimizer_params=self.optimizer_params,
+                      begin_epoch=self.begin_epoch,
+                      num_epoch=self.num_epoch or 1,
+                      epoch_end_callback=epoch_end_callback,
+                      batch_end_callback=batch_end_callback,
+                      monitor=monitor)
+        self.arg_params, self.aux_params = self._mod.get_params()
+        return self
+
+    def predict(self, X, num_batch=None, return_data=False, reset=True):
+        import numpy as _np2
+        it = self._as_iter(X)
+        if self._mod is None:
+            self._init_module(it)
+        if reset:
+            it.reset()
+        outs, datas, labels = [], [], []
+        for i, batch in enumerate(it):
+            if num_batch is not None and i >= num_batch:
+                break
+            self._mod.forward(batch, is_train=False)
+            pad = batch.pad or 0
+            n = batch.data[0].shape[0] - pad
+            outs.append(self._mod.get_outputs()[0].asnumpy()[:n])
+            if return_data:
+                datas.append(batch.data[0].asnumpy()[:n])
+                labels.append(batch.label[0].asnumpy()[:n])
+        out = _np2.concatenate(outs)
+        if return_data:
+            return out, _np2.concatenate(datas), _np2.concatenate(labels)
+        return out
+
+    def score(self, X, eval_metric="acc", num_batch=None, reset=True):
+        from . import metric as _metric
+        it = self._as_iter(X)
+        if self._mod is None:
+            self._init_module(it)
+        if reset:
+            it.reset()
+        if isinstance(eval_metric, str):
+            eval_metric = _metric.create(eval_metric)
+        self._mod.score(it, eval_metric, num_batch=num_batch)
+        return eval_metric.get()[1]
+
+    # -- checkpoints --------------------------------------------------------
+    def save(self, prefix, epoch=None):
+        epoch = self.num_epoch if epoch is None else epoch
+        assert epoch is not None
+        save_checkpoint(prefix, epoch, self.symbol, self.arg_params or {},
+                        self.aux_params or {})
+
+    @staticmethod
+    def load(prefix, epoch, ctx=None, **kwargs):
+        symbol, arg_params, aux_params = load_checkpoint(prefix, epoch)
+        return FeedForward(symbol, ctx=ctx, arg_params=arg_params,
+                           aux_params=aux_params, begin_epoch=epoch,
+                           **kwargs)
+
+    @staticmethod
+    def create(symbol, X, y=None, ctx=None, num_epoch=None,
+               epoch_size=None, optimizer="sgd", initializer=None,
+               eval_data=None, eval_metric="acc", epoch_end_callback=None,
+               batch_end_callback=None, kvstore="local", logger=None,
+               **kwargs):
+        """Build + fit in one call (parity: FeedForward.create)."""
+        model = FeedForward(symbol, ctx=ctx, num_epoch=num_epoch,
+                            epoch_size=epoch_size, optimizer=optimizer,
+                            initializer=initializer, **kwargs)
+        model.fit(X, y, eval_data=eval_data, eval_metric=eval_metric,
+                  epoch_end_callback=epoch_end_callback,
+                  batch_end_callback=batch_end_callback,
+                  kvstore=kvstore, logger=logger)
+        return model

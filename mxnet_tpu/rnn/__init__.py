@@ -1,6 +1,9 @@
 """mx.rnn toolkit (parity: python/mxnet/rnn/__init__.py)."""
 from .rnn_cell import (BaseRNNCell, RNNCell, LSTMCell, GRUCell, FusedRNNCell,
                        SequentialRNNCell, BidirectionalCell, DropoutCell,
-                       ZoneoutCell, ResidualCell, ModifierCell, RNNParams)
+                       ZoneoutCell, ResidualCell, ModifierCell, RNNParams,
+                       BaseConvRNNCell, ConvRNNCell, ConvLSTMCell,
+                       ConvGRUCell)
 from .io import BucketSentenceIter, encode_sentences
-from .rnn import (save_rnn_checkpoint, load_rnn_checkpoint, do_rnn_checkpoint)
+from .rnn import (save_rnn_checkpoint, load_rnn_checkpoint, do_rnn_checkpoint,
+                  rnn_unroll)

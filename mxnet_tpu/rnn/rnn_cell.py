@@ -549,3 +549,178 @@ class BidirectionalCell(BaseRNNCell):
             outputs = symbol.Concat(*outputs, dim=axis)
         states = l_states + r_states
         return outputs, states
+
+
+# -- convolutional recurrent cells (parity: rnn_cell.py BaseConvRNNCell /
+# ConvRNNCell / ConvLSTMCell / ConvGRUCell — recurrence over NCHW feature
+# maps with Convolution i2h/h2h instead of FullyConnected; used for
+# spatiotemporal models, e.g. precipitation nowcasting) -------------------
+
+class BaseConvRNNCell(BaseRNNCell):
+    """Shared conv-gate machinery.  `input_shape` is the per-step
+    (C, H, W); the state shape follows from the i2h conv arithmetic, and
+    the h2h kernel must be odd so its SAME padding preserves it."""
+
+    def __init__(self, input_shape, num_hidden, h2h_kernel, h2h_dilate,
+                 i2h_kernel, i2h_stride, i2h_pad, i2h_dilate,
+                 activation, prefix="", params=None, i2h_bias_init=None):
+        super().__init__(prefix=prefix, params=params)
+        self._num_hidden = num_hidden
+        self._input_shape = tuple(input_shape)
+        self._activation = activation
+        self._h2h_kernel = tuple(h2h_kernel)
+        if any(k % 2 == 0 for k in self._h2h_kernel):
+            raise ValueError(
+                f"h2h kernel must be odd to preserve the state shape, "
+                f"got {h2h_kernel}")
+        self._h2h_dilate = tuple(h2h_dilate)
+        self._h2h_pad = (self._h2h_dilate[0] * (self._h2h_kernel[0] - 1) // 2,
+                         self._h2h_dilate[1] * (self._h2h_kernel[1] - 1) // 2)
+        self._i2h_kernel = tuple(i2h_kernel)
+        self._i2h_stride = tuple(i2h_stride)
+        self._i2h_pad = tuple(i2h_pad)
+        self._i2h_dilate = tuple(i2h_dilate)
+        # conv output arithmetic fixes the recurrent state's spatial dims
+        _, h, w = self._input_shape
+        sh = (h + 2 * self._i2h_pad[0]
+              - self._i2h_dilate[0] * (self._i2h_kernel[0] - 1) - 1) \
+            // self._i2h_stride[0] + 1
+        sw = (w + 2 * self._i2h_pad[1]
+              - self._i2h_dilate[1] * (self._i2h_kernel[1] - 1) - 1) \
+            // self._i2h_stride[1] + 1
+        self._state_shape = (num_hidden, sh, sw)
+        self._iW = self.params.get("i2h_weight")
+        # RNNParams.get caches the first Variable it creates per name, so
+        # a subclass's bias initializer must ride THIS call — a re-get
+        # with init= later would be silently ignored
+        self._iB = self.params.get("i2h_bias", init=i2h_bias_init) \
+            if i2h_bias_init is not None else self.params.get("i2h_bias")
+        self._hW = self.params.get("h2h_weight")
+        self._hB = self.params.get("h2h_bias")
+
+    @property
+    def _num_gates(self):
+        return len(self._gate_names)
+
+    @property
+    def state_info(self):
+        return [{"shape": (0,) + self._state_shape, "__layout__": "NCHW"}
+                for _ in range(self._num_states)]
+
+    def _conv_gates(self, inputs, states, name):
+        ng = self._num_gates
+        i2h = symbol.Convolution(inputs, self._iW, self._iB,
+                                 kernel=self._i2h_kernel,
+                                 stride=self._i2h_stride,
+                                 pad=self._i2h_pad,
+                                 dilate=self._i2h_dilate,
+                                 num_filter=self._num_hidden * ng,
+                                 name=f"{name}i2h")
+        h2h = symbol.Convolution(states[0], self._hW, self._hB,
+                                 kernel=self._h2h_kernel,
+                                 dilate=self._h2h_dilate,
+                                 pad=self._h2h_pad,
+                                 num_filter=self._num_hidden * ng,
+                                 name=f"{name}h2h")
+        return i2h, h2h
+
+
+class ConvRNNCell(BaseConvRNNCell):
+    """Parity: rnn_cell.ConvRNNCell — h' = act(conv(x) + conv(h))."""
+
+    _num_states = 1
+
+    def __init__(self, input_shape, num_hidden, h2h_kernel=(3, 3),
+                 h2h_dilate=(1, 1), i2h_kernel=(3, 3), i2h_stride=(1, 1),
+                 i2h_pad=(1, 1), i2h_dilate=(1, 1), activation="tanh",
+                 prefix="ConvRNN_", params=None):
+        super().__init__(input_shape, num_hidden, h2h_kernel, h2h_dilate,
+                         i2h_kernel, i2h_stride, i2h_pad, i2h_dilate,
+                         activation, prefix, params)
+
+    @property
+    def _gate_names(self):
+        return ("",)
+
+    def __call__(self, inputs, states):
+        self._counter += 1
+        name = f"{self._prefix}t{self._counter}_"
+        i2h, h2h = self._conv_gates(inputs, states, name)
+        output = self._get_activation(i2h + h2h, self._activation,
+                                      name=f"{name}out")
+        return output, [output]
+
+
+class ConvLSTMCell(BaseConvRNNCell):
+    """Parity: rnn_cell.ConvLSTMCell (Shi et al. 2015, "Convolutional
+    LSTM Network") — LSTM gates computed by convolutions over feature
+    maps; state is (h, c) pairs of NCHW maps."""
+
+    _num_states = 2
+
+    def __init__(self, input_shape, num_hidden, h2h_kernel=(3, 3),
+                 h2h_dilate=(1, 1), i2h_kernel=(3, 3), i2h_stride=(1, 1),
+                 i2h_pad=(1, 1), i2h_dilate=(1, 1), activation="tanh",
+                 prefix="ConvLSTM_", params=None, forget_bias=1.0):
+        from ..initializer import LSTMBias
+        super().__init__(input_shape, num_hidden, h2h_kernel, h2h_dilate,
+                         i2h_kernel, i2h_stride, i2h_pad, i2h_dilate,
+                         activation, prefix, params,
+                         i2h_bias_init=LSTMBias(forget_bias=forget_bias))
+
+    @property
+    def _gate_names(self):
+        return ("_i", "_f", "_c", "_o")
+
+    def __call__(self, inputs, states):
+        self._counter += 1
+        name = f"{self._prefix}t{self._counter}_"
+        i2h, h2h = self._conv_gates(inputs, states, name)
+        gates = i2h + h2h
+        sl = symbol.SliceChannel(gates, num_outputs=4, name=f"{name}slice")
+        in_gate = symbol.Activation(sl[0], act_type="sigmoid",
+                                    name=f"{name}i")
+        forget_gate = symbol.Activation(sl[1], act_type="sigmoid",
+                                        name=f"{name}f")
+        in_transform = self._get_activation(sl[2], self._activation,
+                                            name=f"{name}c")
+        out_gate = symbol.Activation(sl[3], act_type="sigmoid",
+                                     name=f"{name}o")
+        next_c = forget_gate * states[1] + in_gate * in_transform
+        next_h = out_gate * self._get_activation(next_c, self._activation)
+        return next_h, [next_h, next_c]
+
+
+class ConvGRUCell(BaseConvRNNCell):
+    """Parity: rnn_cell.ConvGRUCell — GRU gates by convolution."""
+
+    _num_states = 1
+
+    def __init__(self, input_shape, num_hidden, h2h_kernel=(3, 3),
+                 h2h_dilate=(1, 1), i2h_kernel=(3, 3), i2h_stride=(1, 1),
+                 i2h_pad=(1, 1), i2h_dilate=(1, 1), activation="tanh",
+                 prefix="ConvGRU_", params=None):
+        super().__init__(input_shape, num_hidden, h2h_kernel, h2h_dilate,
+                         i2h_kernel, i2h_stride, i2h_pad, i2h_dilate,
+                         activation, prefix, params)
+
+    @property
+    def _gate_names(self):
+        return ("_r", "_z", "_o")
+
+    def __call__(self, inputs, states):
+        self._counter += 1
+        name = f"{self._prefix}t{self._counter}_"
+        i2h, h2h = self._conv_gates(inputs, states, name)
+        i2h_r, i2h_z, i2h_o = symbol.SliceChannel(
+            i2h, num_outputs=3, name=f"{name}i2h_slice")
+        h2h_r, h2h_z, h2h_o = symbol.SliceChannel(
+            h2h, num_outputs=3, name=f"{name}h2h_slice")
+        reset = symbol.Activation(i2h_r + h2h_r, act_type="sigmoid",
+                                  name=f"{name}r")
+        update = symbol.Activation(i2h_z + h2h_z, act_type="sigmoid",
+                                   name=f"{name}z")
+        cand = self._get_activation(i2h_o + reset * h2h_o,
+                                    self._activation, name=f"{name}h")
+        next_h = (1.0 - update) * cand + update * states[0]
+        return next_h, [next_h]

@@ -548,7 +548,7 @@ def rand_sparse_ndarray(shape, stype, density=None, dtype=None,
         vals = _np.random.randn(len(idx), *shape[1:]).astype(dtype)
         if data_init is not None:
             vals[:] = data_init
-        if modifier_func is not None:
+        if modifier_func is not None and vals.size:
             vals = _np.vectorize(modifier_func)(vals).astype(dtype)
         arr = sparse.row_sparse_array((vals, idx), shape=shape, dtype=dtype)
         return arr, (vals, idx)
@@ -574,7 +574,8 @@ def rand_sparse_ndarray(shape, stype, density=None, dtype=None,
             dense[dense != 0] = data_init
         if modifier_func is not None:
             nz = dense != 0
-            dense[nz] = _np.vectorize(modifier_func)(dense[nz])
+            if nz.any():
+                dense[nz] = _np.vectorize(modifier_func)(dense[nz])
         arr = sparse.csr_matrix(nd.array(dense.astype(dtype)))
         if shuffle_csr_indices:
             arr = shuffle_csr_column_indices(arr)
@@ -706,6 +707,8 @@ def check_speed(sym_, location=None, ctx=None, N=20, grad_req=None,
     (parity: test_utils.check_speed)."""
     import time
     ctx = ctx or default_context()
+    if typ not in ("whole", "forward"):
+        raise MXNetError(f"typ must be 'whole' or 'forward', got {typ!r}")
     if grad_req is None:
         grad_req = "write" if typ == "whole" else "null"
     if location is None:

@@ -197,3 +197,141 @@ def test_same_array_sparse_and_dummyiter_reset():
 
 
 import os  # noqa: E402  (used by test_retry_and_set_env_var)
+
+
+def test_image_augmenters_closure():
+    from mxnet_tpu import image as img
+    rs = np.random.RandomState(0)
+    a = rs.randint(0, 255, (40, 48, 3)).astype(np.float32)
+    # hue rotation preserves shape and roughly preserves luma
+    out = np.asarray(img.HueJitterAug(0.3)(a)[0])
+    assert out.shape == a.shape
+    luma = np.array([0.299, 0.587, 0.114])
+    np.testing.assert_allclose((out @ luma).mean(), (a @ luma).mean(),
+                               rtol=0.05)
+    # PCA lighting: zero alphastd is identity
+    np.testing.assert_allclose(
+        np.asarray(img.LightingAug(0.0, np.ones(3), np.eye(3))(a)[0]), a)
+    # inception crop produces the requested size
+    out = np.asarray(img.RandomSizedCropAug((24, 24), 0.3,
+                                            (0.75, 1.33))(a)[0])
+    assert out.shape == (24, 24, 3)
+    # sequential & random-order compose
+    seq = img.SequentialAug([img.CastAug(), img.HorizontalFlipAug(1.0)])
+    np.testing.assert_allclose(np.asarray(seq(a)[0]), a[:, ::-1])
+    ro = img.RandomOrderAug([img.BrightnessJitterAug(0.1),
+                             img.ContrastJitterAug(0.1)])
+    assert np.asarray(ro(a)[0]).shape == a.shape
+    assert img.scale_down((30, 20), (60, 40)) == (30, 20)
+    assert img.scale_down((100, 100), (60, 40)) == (60, 40)
+    augs = img.CreateAugmenter((3, 24, 24), rand_crop=True,
+                               rand_resize=True, rand_mirror=True,
+                               brightness=0.1, contrast=0.1,
+                               saturation=0.1, hue=0.1, pca_noise=0.05,
+                               rand_gray=0.05, mean=True, std=True)
+    names = [type(x).__name__ for x in augs]
+    assert names[0] == "RandomSizedCropAug" and "RandomOrderAug" in names \
+        and "HueJitterAug" in names and "LightingAug" in names
+    x = a
+    for g in augs:
+        x = g(x)[0]
+    assert np.asarray(x).shape == (24, 24, 3)
+
+
+def test_create_multi_rand_crop_augmenter():
+    from mxnet_tpu import detection as det
+    m = det.CreateMultiRandCropAugmenter(
+        min_object_covered=[0.1, 0.5],
+        aspect_ratio_range=(0.75, 1.33), max_attempts=10)
+    assert len(m.aug_list) == 2
+    rs = np.random.RandomState(0)
+    src = rs.randint(0, 255, (32, 32, 3)).astype(np.float32)
+    label = np.array([[0, 0.1, 0.1, 0.8, 0.8]], "f")
+    out, lab = m(src, label)
+    assert np.asarray(out).ndim == 3 and lab.shape[1] == 5
+    with pytest.raises(ValueError):
+        det.CreateMultiRandCropAugmenter(min_object_covered=[0.1, 0.5],
+                                         max_attempts=[1, 2, 3])
+
+
+@pytest.mark.parametrize("cls_name,nstates", [("ConvRNNCell", 1),
+                                              ("ConvLSTMCell", 2),
+                                              ("ConvGRUCell", 1)])
+def test_conv_rnn_cells(cls_name, nstates):
+    from mxnet_tpu.rnn import rnn_cell as rc
+    cls = getattr(rc, cls_name)
+    B, C, H, W, T = 2, 3, 8, 8, 3
+    cell = cls(input_shape=(C, H, W), num_hidden=4)
+    assert cell.state_info[0]["shape"] == (0, 4, H, W)
+    assert len(cell.state_info) == nstates
+    xs = [mx.sym.Variable(f"x{t}") for t in range(T)]
+    st = [mx.sym.Variable(f"s{i}") for i in range(nstates)]
+    outs, states = cell.unroll(T, inputs=xs, begin_state=st)
+    assert len(outs) == T and len(states) == nstates
+    net = outs[-1]
+    rs = np.random.RandomState(0)
+    args = {f"x{t}": (B, C, H, W) for t in range(T)}
+    args.update({f"s{i}": (B, 4, H, W) for i in range(nstates)})
+    ex = net.simple_bind(ctx=mx.cpu(), grad_req="null", **args)
+    for k, v in ex.arg_dict.items():
+        v[:] = rs.normal(0, 0.5, v.shape).astype("f")
+    out = ex.forward()[0]
+    # recurrence over feature maps: state-shaped output, nonzero signal
+    assert out.shape == (B, 4, H, W)
+    assert np.abs(out.asnumpy()).mean() > 1e-3
+    # parameters are conv-shaped (shared across steps)
+    assert ex.arg_dict[f"{cell._prefix}i2h_weight"].shape[2:] == (3, 3)
+
+
+def test_conv_rnn_cell_validations():
+    from mxnet_tpu.rnn.rnn_cell import ConvRNNCell
+    with pytest.raises(ValueError):
+        ConvRNNCell(input_shape=(3, 8, 8), num_hidden=4,
+                    h2h_kernel=(2, 2))
+    # strided i2h shrinks the recurrent state accordingly
+    c = ConvRNNCell(input_shape=(3, 9, 9), num_hidden=4,
+                    i2h_stride=(2, 2), i2h_kernel=(3, 3), i2h_pad=(1, 1))
+    assert c.state_info[0]["shape"] == (0, 4, 5, 5)
+
+
+def test_feedforward_legacy_api(tmp_path):
+    """v0.x FeedForward trains, predicts, scores, and round-trips
+    checkpoints (parity: model.py FeedForward over numpy inputs)."""
+    from mxnet_tpu.model import FeedForward
+    rs = np.random.RandomState(0)
+    X = rs.normal(0, 1, (200, 10)).astype("f")
+    w = rs.normal(0, 1, (10,))
+    y = (X @ w > 0).astype("f")
+    net = mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=2,
+                                name="fc")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    m = FeedForward(net, num_epoch=8, learning_rate=0.5)
+    m.fit(X, y)
+    acc = m.score(mx.io.NDArrayIter(X, y, batch_size=64))
+    assert acc > 0.8, acc
+    p = m.predict(X)
+    assert p.shape == (200, 2)
+    prefix = str(tmp_path / "ff")
+    m.save(prefix, 8)
+    m2 = FeedForward.load(prefix, 8)
+    np.testing.assert_allclose(m2.predict(X), p, atol=1e-5)
+    m3 = FeedForward.create(net, X, y, num_epoch=1, learning_rate=0.5)
+    assert m3.arg_params
+
+
+def test_conv_lstm_forget_bias_initializer():
+    """The forget-gate bias initializer must survive RNNParams' cache
+    (a re-get with init= after the base class created the Variable is
+    silently ignored)."""
+    from mxnet_tpu.rnn.rnn_cell import ConvLSTMCell
+    c = ConvLSTMCell(input_shape=(3, 8, 8), num_hidden=4, forget_bias=1.0)
+    attrs = c._iB.attr_dict().get(c._iB.name, {})
+    assert "lstmbias" in str(attrs), attrs
+
+
+def test_sparse_gen_edge_cases():
+    z = tu.create_sparse_array_zd((10, 4), "row_sparse", 0,
+                                  modifier_func=lambda v: v + 1)
+    assert z._values.shape[0] == 0
+    with pytest.raises(MXNetError):
+        tu.check_speed(mx.sym.Variable("x"), typ="forwrad")
