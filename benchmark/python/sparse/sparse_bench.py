@@ -26,29 +26,68 @@ import mxnet_tpu as mx
 from mxnet_tpu import nd
 
 
+def _sync(out):
+    """Force the result to materialize — jax dispatch is async and
+    engine waitall only covers host-side ops, so timing must block on
+    the device buffers themselves (a host fetch is the reliable sync,
+    see verify notes: block_until_ready is a no-op through the tunnel)."""
+    if isinstance(out, (list, tuple)):
+        for o in out:
+            _sync(o)
+    elif hasattr(out, "_values"):  # sparse: nnz storage
+        np.asarray(out._values)
+    elif hasattr(out, "asnumpy"):
+        out.asnumpy()
+    elif out is not None:
+        np.asarray(out)
+
+
 def timeit(fn, repeat=10):
-    fn()  # warm (compile)
-    nd.waitall()  # compile/dispatch must retire before the clock starts
+    """ms/call; fn must RETURN what it computes so the timer can sync
+    every result (the previous cut timed async dispatch only — dense
+    65536x1024 dot 'took' 0.03 ms)."""
+    _sync(fn())  # warm (compile)
     t0 = time.perf_counter()
-    for _ in range(repeat):
-        fn()
-    nd.waitall()  # ...and every timed dispatch before it stops
+    outs = [fn() for _ in range(repeat)]
+    _sync(outs)
     return (time.perf_counter() - t0) / repeat * 1e3
 
 
-def bench_dot(rows, dim, density, repeat):
-    """csr dot vs dense dot (reference dot.py)."""
+def bench_dot(rows, dim, density, repeat, n_out=64):
+    """csr dot vs dense dot (reference dot.py).  Times csr under both
+    forced paths plus the auto heuristic's pick — the data behind the
+    nnz/dense cutoff in ndarray/sparse.py:_dot_sparse_ex."""
+    import os as _os
     rs = np.random.RandomState(0)
     dense = rs.normal(0, 1, (rows, dim)).astype("f")
     mask = rs.rand(rows, dim) < density
     sp = np.where(mask, dense, 0).astype("f")
-    w = nd.array(rs.normal(0, 1, (dim, 64)).astype("f"))
+    w = nd.array(rs.normal(0, 1, (dim, n_out)).astype("f"))
     csr = nd.sparse.array(sp).tostype("csr")
     dns = nd.array(sp)
-    t_csr = timeit(lambda: nd.sparse.dot(csr, w), repeat)
+
+    def forced(mode):
+        prev = _os.environ.get("MXNET_SPARSE_DOT")
+        _os.environ["MXNET_SPARSE_DOT"] = mode
+        try:
+            return timeit(lambda: nd.sparse.dot(csr, w), repeat)
+        finally:
+            if prev is None:
+                _os.environ.pop("MXNET_SPARSE_DOT", None)
+            else:
+                _os.environ["MXNET_SPARSE_DOT"] = prev
+
+    t_nnz = forced("nnz")
+    t_csr_dense = forced("dense")
+    t_auto = forced("auto")
     t_dns = timeit(lambda: nd.dot(dns, w), repeat)
-    print("dot        rows=%d dim=%d density=%.2f: csr %.2f ms  "
-          "dense %.2f ms" % (rows, dim, density, t_csr, t_dns))
+    from mxnet_tpu.ndarray.sparse import _dot_use_nnz
+    pick = "nnz" if _dot_use_nnz(int(csr.data.shape[0]), rows, dim,
+                                 n_out, 4) else "dense"
+    print("dot        rows=%d dim=%d N=%d density=%.2f: csr[nnz] %.2f ms  "
+          "csr[dense] %.2f ms  csr[auto->%s] %.2f ms  dense %.2f ms"
+          % (rows, dim, n_out, density, t_nnz, t_csr_dense, pick, t_auto,
+             t_dns))
 
 
 def bench_cast_storage(rows, dim, density, repeat):

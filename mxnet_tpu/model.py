@@ -130,6 +130,14 @@ class FeedForward:
         it = self._as_iter(X, y, is_train=True)
         if self._mod is None:
             self._init_module(it)
+        if self.epoch_size is not None:
+            # reference semantics: bound each epoch at epoch_size batches
+            # (non-terminating iterators end their epoch here)
+            from .io import ResizeIter
+            it = ResizeIter(it, self.epoch_size, reset_internal=False)
+        if logger is not None:
+            logger.info("Start training with %s",
+                        self.ctx if self.ctx is not None else "cpu(0)")
         if isinstance(eval_metric, str):
             eval_metric = _metric.create(eval_metric)
         self._mod.fit(it, eval_data=eval_data, eval_metric=eval_metric,

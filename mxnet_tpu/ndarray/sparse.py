@@ -20,6 +20,7 @@ nnz = tokens-per-batch vs vocab).
 from __future__ import annotations
 
 import functools as _functools
+import os
 
 import jax as _jax
 import jax.numpy as jnp
@@ -438,6 +439,21 @@ def _grad_wanted(a):
             and getattr(a, "_grad_req", "null") != "null")
 
 
+def _dot_use_nnz(nnz, m, k, n, itemsize):
+    """Path choice for csr·dense (measured,
+    benchmark/python/sparse/sparse_bench.py): the nnz path builds an
+    (nnz, N) gather intermediate; the dense path materializes the (M, K)
+    lhs and rides the MXU, which wins by ~100x at 10% density.  Take nnz
+    only when its intermediate is smaller than the dense form
+    (true-sparse regime — e.g. libsvm features with N=1..small) or when
+    densifying is infeasible at this dtype.  MXNET_SPARSE_DOT=nnz|dense
+    overrides (tests pin storage behavior; the benchmark A/Bs both)."""
+    mode = os.environ.get("MXNET_SPARSE_DOT", "auto")
+    if mode in ("nnz", "dense"):
+        return mode == "nnz"
+    return nnz * n < m * k or m * k * itemsize > (1 << 31)
+
+
 def _dot_sparse_ex(op, inputs, params, out):
     """Eager storage-dispatch executor for `dot` with sparse operands."""
     from .. import autograd
@@ -464,6 +480,9 @@ def _dot_sparse_ex(op, inputs, params, out):
     nnz = int(vals.shape[0])
     out_dtype = jnp.result_type(vals.dtype, B.dtype)
 
+    use_nnz = _dot_use_nnz(nnz, M, K, N,
+                           _np.dtype(out_dtype).itemsize)
+
     if ta:
         # dot(csrᵀ, dense) -> row_sparse (reference output-stype inference:
         # DotCsrDnsRspImpl) with rows = the csr's occupied columns
@@ -474,8 +493,14 @@ def _dot_sparse_ex(op, inputs, params, out):
                 cols, _csr_t_rows(vals, indptr, cols, B).astype(out_dtype),
                 (K, N), lhs._ctx)
     else:
-        data = (jnp.zeros((M, N), out_dtype) if nnz == 0
-                else _csr_mm(vals, indptr, cols, B, M))
+        A_dense = None  # densified ONCE here, shared with the vjp below
+        if nnz == 0:
+            data = jnp.zeros((M, N), out_dtype)
+        elif use_nnz:
+            data = _csr_mm(vals, indptr, cols, B, M)
+        else:
+            A_dense = lhs._data.astype(out_dtype)
+            data = jnp.matmul(A_dense, B.astype(out_dtype))
         res = NDArray(data, lhs._ctx)
 
     if out is not None:
@@ -496,9 +521,12 @@ def _dot_sparse_ex(op, inputs, params, out):
         # the caller attached a grad buffer to it
         want_lhs = _grad_wanted(lhs)
         B_cap = B if want_lhs else None
+        # dense-regime forward keeps the backward dense too, reusing the
+        # forward's one densification (A_dense is None on the ta path)
+        A_cap = None if ta else A_dense
 
         def vjp_fn(cots, _v=vals, _ip=indptr, _c=cols, _ta=ta, _tb=tb,
-                   _rs=rshape, _M=M, _B=B_cap):
+                   _rs=rshape, _M=M, _B=B_cap, _A=A_cap):
             cot = cots[0]  # dense, out-shaped (rsp heads densify upstream)
             if _ta:
                 # out = Aᵀ·B: grad_B = A·cot, dense (M,N); with tb the
@@ -507,17 +535,21 @@ def _dot_sparse_ex(op, inputs, params, out):
                 if _tb:
                     g = g.T
                 g_lhs = None if _B is None else jnp.matmul(_B, cot.T)
-            elif _tb:
-                # out = A·rhsᵀ: grad_B = Aᵀ·cot (K,N) dense, transposed back
-                rows = _csr_t_rows(_v, _ip, _c, cot)
-                g = jnp.zeros((_rs[1], cot.shape[1]),
-                              rows.dtype).at[_c].add(rows).T
-                g_lhs = None if _B is None else jnp.matmul(cot, _B.T)
             else:
-                # out = A·B: grad_rhs = Aᵀ·cot — rows-only on the csr's
-                # columns; stays an _RspCot through the tape (dense only
-                # at an explicit dense deposit)
-                g = _RspCot(_c, _csr_t_rows(_v, _ip, _c, cot), _rs)
+                # out = A·B(ᵀ): grad_B = Aᵀ·cot.  nnz regime: rows-only
+                # on the csr's columns (an _RspCot through the tape,
+                # dense only at an explicit dense deposit); dense
+                # regime: one MXU matmul on the captured lhs.
+                if _A is not None:
+                    g = jnp.matmul(_A.T, cot)
+                elif _tb:
+                    rows = _csr_t_rows(_v, _ip, _c, cot)
+                    g = jnp.zeros((_rs[1], cot.shape[1]),
+                                  rows.dtype).at[_c].add(rows)
+                else:
+                    g = _RspCot(_c, _csr_t_rows(_v, _ip, _c, cot), _rs)
+                if _tb and not isinstance(g, _RspCot):
+                    g = g.T
                 g_lhs = None if _B is None else jnp.matmul(cot, _B.T)
             return (g_lhs, g)
 

@@ -335,3 +335,21 @@ def test_sparse_gen_edge_cases():
     assert z._values.shape[0] == 0
     with pytest.raises(MXNetError):
         tu.check_speed(mx.sym.Variable("x"), typ="forwrad")
+
+
+def test_feedforward_epoch_size_caps_epochs():
+    """epoch_size bounds each epoch's batch count (reference legacy
+    semantics for non-terminating iterators)."""
+    from mxnet_tpu.model import FeedForward
+    rs = np.random.RandomState(1)
+    X = rs.normal(0, 1, (64, 6)).astype("f")
+    y = (X[:, 0] > 0).astype("f")
+    net = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=2,
+                              name="fc"), name="softmax")
+    seen = []
+    m = FeedForward(net, num_epoch=2, epoch_size=2, learning_rate=0.1,
+                    numpy_batch_size=8)
+    m.fit(X, y, batch_end_callback=lambda p: seen.append(p.nbatch))
+    # 64/8 = 8 batches available, but each epoch stops at 2
+    assert max(seen) <= 2 and len(seen) == 4, seen

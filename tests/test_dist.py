@@ -24,7 +24,7 @@ def _free_port():
     return port
 
 
-@pytest.mark.parametrize("local_devices", [1, 2])
+@pytest.mark.parametrize("local_devices", [1, 2, 4])
 def test_dist_sync_kvstore_two_processes(local_devices):
     env = {**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONPATH": REPO,
            "MXT_EXPECT_LOCAL_DEVICES": str(local_devices)}

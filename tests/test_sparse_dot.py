@@ -18,6 +18,10 @@ from mxnet_tpu.ndarray.sparse import (CSRNDArray, RowSparseNDArray,
 
 @pytest.fixture
 def csr_densify_counter(monkeypatch):
+    """Counts dense materializations; pins MXNET_SPARSE_DOT=nnz so the
+    storage-behavior assertions don't depend on the auto heuristic's
+    size cutoffs (tested separately below)."""
+    monkeypatch.setenv("MXNET_SPARSE_DOT", "nnz")
     calls = []
     real = CSRNDArray._data.fget
 
@@ -140,6 +144,37 @@ def test_csr_dot_empty():
     outT = mx.nd.dot(z, mx.nd.array(np.ones((5, 2), "f")), transpose_a=True)
     assert isinstance(outT, RowSparseNDArray)
     assert outT._values.shape[0] == 0
+
+
+def test_auto_heuristic_dense_regime(monkeypatch):
+    """Wide-N / denser csr: auto mode rides the MXU dense path
+    (measured ~100x faster at 10% density) — same math, dense detour."""
+    monkeypatch.setenv("MXNET_SPARSE_DOT", "auto")
+    rs = np.random.RandomState(9)
+    csr, dense = make_csr(rs, 32, 64, density=0.3)   # nnz*N >> M*K
+    w = mx.nd.array(rs.normal(0, 1, (64, 48)).astype("f"))
+    g = mx.nd.zeros((64, 48))
+    autograd.mark_variables([w], [g])
+    with autograd.record():
+        y = mx.nd.dot(csr, w)
+    autograd.backward([y])
+    np.testing.assert_allclose(y.asnumpy(), dense @ w.asnumpy(),
+                               rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(g.asnumpy(), dense.T @ np.ones((32, 48)),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_auto_heuristic_nnz_regime(monkeypatch, csr_densify_counter):
+    """Tall-skinny (the libsvm linear-classification shape): auto mode
+    stays rows-only — no dense (M,K) materialization."""
+    monkeypatch.setenv("MXNET_SPARSE_DOT", "auto")
+    rs = np.random.RandomState(10)
+    csr, dense = make_csr(rs, 64, 500, density=0.02)  # nnz*1 << M*K
+    w = mx.nd.array(rs.normal(0, 1, (500, 1)).astype("f"))
+    out = mx.nd.dot(csr, w)
+    np.testing.assert_allclose(out.asnumpy(), dense @ w.asnumpy(),
+                               rtol=1e-4, atol=1e-4)
+    assert csr_densify_counter == []
 
 
 def test_rsp_lhs_falls_back_dense():
