@@ -6,13 +6,28 @@ SRCS := src/runtime/storage.cc src/runtime/engine.cc \
         src/runtime/recordio.cc src/runtime/prefetch.cc
 LIB := mxnet_tpu/_native/libmxtpu_runtime.so
 
-.PHONY: native test clean cpp_example
+.PHONY: native test clean cpp_example predict_capi capi_example
 
 native: $(LIB)
 
 $(LIB): $(SRCS) src/runtime/mxt_runtime.h
 	@mkdir -p mxnet_tpu/_native
 	$(CXX) $(CXXFLAGS) -shared -o $@ $(SRCS)
+
+# C inference API (c_predict_api analog): flat MXTPred* calls over an
+# embedded CPython driving mxnet_tpu.predictor.Predictor.
+PY_INC = $(shell python3 -c "import sysconfig; print(sysconfig.get_paths()['include'])")
+PY_LIBDIR = $(shell python3 -c "import sysconfig; print(sysconfig.get_config_var('LIBDIR'))")
+PY_LIB = $(shell python3 -c "import sysconfig; print('python' + sysconfig.get_config_var('VERSION'))")
+PRED_LIB := mxnet_tpu/_native/libmxt_predict.so
+
+predict_capi: $(PRED_LIB)
+
+$(PRED_LIB): src/runtime/predict_capi.cc src/runtime/mxt_predict.h
+	@mkdir -p mxnet_tpu/_native
+	$(CXX) $(CXXFLAGS) -I$(PY_INC) -shared -o $@ \
+	    src/runtime/predict_capi.cc \
+	    -L$(PY_LIBDIR) -l$(PY_LIB) -Wl,-rpath,$(PY_LIBDIR)
 
 # C++ consumer of the native runtime (cpp-package analog): predict-only
 # MLP from a python-trained checkpoint, streamed via the batch loader.
@@ -26,8 +41,18 @@ $(CPP_EX): cpp-package/example/mlp_predict.cc $(LIB) \
 	    -Lmxnet_tpu/_native -lmxtpu_runtime \
 	    -Wl,-rpath,'$$ORIGIN/../../mxnet_tpu/_native'
 
+CAPI_EX := cpp-package/example/capi_predict
+
+capi_example: $(CAPI_EX)
+
+$(CAPI_EX): cpp-package/example/capi_predict.c $(PRED_LIB) \
+            src/runtime/mxt_predict.h
+	$(CC) -O2 -Wall -o $@ $< \
+	    -Lmxnet_tpu/_native -lmxt_predict \
+	    -Wl,-rpath,'$$ORIGIN/../../mxnet_tpu/_native'
+
 test: native
 	python -m pytest tests/ -x -q
 
 clean:
-	rm -f $(LIB) $(CPP_EX)
+	rm -f $(LIB) $(CPP_EX) $(PRED_LIB) $(CAPI_EX)

@@ -57,7 +57,6 @@ def bench_dot(rows, dim, density, repeat, n_out=64):
     """csr dot vs dense dot (reference dot.py).  Times csr under both
     forced paths plus the auto heuristic's pick — the data behind the
     nnz/dense cutoff in ndarray/sparse.py:_dot_sparse_ex."""
-    import os as _os
     rs = np.random.RandomState(0)
     dense = rs.normal(0, 1, (rows, dim)).astype("f")
     mask = rs.rand(rows, dim) < density
@@ -67,15 +66,15 @@ def bench_dot(rows, dim, density, repeat, n_out=64):
     dns = nd.array(sp)
 
     def forced(mode):
-        prev = _os.environ.get("MXNET_SPARSE_DOT")
-        _os.environ["MXNET_SPARSE_DOT"] = mode
+        prev = os.environ.get("MXNET_SPARSE_DOT")
+        os.environ["MXNET_SPARSE_DOT"] = mode
         try:
             return timeit(lambda: nd.sparse.dot(csr, w), repeat)
         finally:
             if prev is None:
-                _os.environ.pop("MXNET_SPARSE_DOT", None)
+                os.environ.pop("MXNET_SPARSE_DOT", None)
             else:
-                _os.environ["MXNET_SPARSE_DOT"] = prev
+                os.environ["MXNET_SPARSE_DOT"] = prev
 
     t_nnz = forced("nnz")
     t_csr_dense = forced("dense")

@@ -1,0 +1,97 @@
+/* capi_predict — drive the MXTPred* C inference API (c_predict_api
+ * analog) from plain C: load a symbol JSON + checkpoint, push one
+ * float32 input batch, forward, print the output shape and values.
+ *
+ * Parity model: the reference's C predict example
+ * (example/image-classification/predict-cpp over c_predict_api.h).
+ *
+ *   capi_predict <symbol.json> <params file> <input.f32> N D
+ *
+ * input.f32 holds N*D raw little-endian float32 features; output goes
+ * to stdout as "shape: ..." + one line of logits per row (parsed by
+ * tests/test_cpp_package.py against the python Predictor).
+ */
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+
+#include "../../src/runtime/mxt_predict.h"
+
+static char *read_file(const char *path, long *len) {
+  FILE *f = fopen(path, "rb");
+  if (!f) return NULL;
+  fseek(f, 0, SEEK_END);
+  *len = ftell(f);
+  fseek(f, 0, SEEK_SET);
+  char *buf = (char *)malloc(*len + 1);
+  if (fread(buf, 1, *len, f) != (size_t)*len) {
+    fclose(f);
+    free(buf);
+    return NULL;
+  }
+  buf[*len] = 0;
+  fclose(f);
+  return buf;
+}
+
+int main(int argc, char **argv) {
+  if (argc != 6) {
+    fprintf(stderr, "usage: %s <symbol.json> <params> <input.f32> N D\n",
+            argv[0]);
+    return 2;
+  }
+  long json_len = 0, data_len = 0;
+  char *json = read_file(argv[1], &json_len);
+  char *raw = read_file(argv[3], &data_len);
+  uint32_t n = (uint32_t)atoi(argv[4]), d = (uint32_t)atoi(argv[5]);
+  if (!json || !raw || data_len != (long)(n * d * sizeof(float))) {
+    fprintf(stderr, "bad inputs (data %ld bytes, want %lu)\n", data_len,
+            (unsigned long)(n * d * sizeof(float)));
+    return 2;
+  }
+
+  const char *keys[] = {"data"};
+  uint32_t shape[] = {n, d};
+  const uint32_t *shapes[] = {shape};
+  uint32_t ndims[] = {2};
+
+  MXTPredictorHandle h = NULL;
+  if (MXTPredCreate(json, argv[2], 1, keys, shapes, ndims, &h) != 0) {
+    fprintf(stderr, "create failed: %s\n", MXTPredGetLastError());
+    return 1;
+  }
+  if (MXTPredSetInput(h, "data", (const float *)raw, (uint64_t)n * d) != 0 ||
+      MXTPredForward(h) != 0) {
+    fprintf(stderr, "forward failed: %s\n", MXTPredGetLastError());
+    return 1;
+  }
+
+  uint32_t out_shape[8], rank = 8;
+  if (MXTPredGetOutputShape(h, 0, out_shape, &rank) != 0) {
+    fprintf(stderr, "shape failed: %s\n", MXTPredGetLastError());
+    return 1;
+  }
+  printf("shape:");
+  uint64_t total = 1;
+  for (uint32_t i = 0; i < rank; ++i) {
+    printf(" %u", out_shape[i]);
+    total *= out_shape[i];
+  }
+  printf("\n");
+
+  float *out = (float *)malloc(total * sizeof(float));
+  if (MXTPredGetOutput(h, 0, out, total) != 0) {
+    fprintf(stderr, "output failed: %s\n", MXTPredGetLastError());
+    return 1;
+  }
+  uint64_t cols = rank >= 2 ? total / out_shape[0] : total;
+  for (uint64_t i = 0; i < total; ++i) {
+    printf("%.6f%s", out[i], ((i + 1) % cols == 0) ? "\n" : " ");
+  }
+
+  MXTPredFree(h);
+  free(out);
+  free(raw);
+  free(json);
+  return 0;
+}

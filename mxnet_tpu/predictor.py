@@ -99,7 +99,18 @@ class Predictor:
         # copyto owns the dtype-cast + placement rule
         arr = data if isinstance(data, NDArray) \
             else nd.array(data, ctx=self._ctx)
-        arr.copyto(self._exec.arg_dict[name])
+        tgt = self._exec.arg_dict[name]
+        if arr.shape != tgt.shape:
+            # the C API hands over flat buffers (MXTPredSetInput passes
+            # element count only); accept any size-matching layout and
+            # fail loudly otherwise — a silent shape swap poisons the
+            # bound executor (the reference validates size the same way)
+            if arr.size != tgt.size:
+                raise MXNetError(
+                    f"set_input('{name}'): got {arr.size} elements, "
+                    f"expected {tgt.size} {tgt.shape}")
+            arr = arr.reshape(tgt.shape)
+        arr.copyto(tgt)
 
     def forward(self) -> None:
         """MXPredForward."""

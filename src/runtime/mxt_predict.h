@@ -1,0 +1,81 @@
+/*
+ * mxt_predict.h — C inference API (parity: include/mxnet/c_predict_api.h).
+ *
+ * The reference's predict API creates a standalone forward-only executor
+ * from a symbol JSON + parameter blob and drives it through flat C calls
+ * (c_predict_api.h:78-179: MXPredCreate / SetInput / Forward /
+ * GetOutputShape / GetOutput / Free).  This library gives C/C++ consumers
+ * the same workflow over the TPU-native stack: it embeds CPython and
+ * drives mxnet_tpu.predictor.Predictor (the python-native executor
+ * boundary, PARITY.md §2.1 "C API"), so a C program needs no Python
+ * source — just this ABI and a process environment where `import
+ * mxnet_tpu` works (PYTHONPATH; JAX_PLATFORMS to pick the device).
+ *
+ * Divergences from the reference, documented:
+ *   - parameters are passed as a FILE PATH (the checkpoint written by
+ *     mx.model.save_checkpoint / Predictor tooling), not an in-memory
+ *     blob: the formats differ (npz container vs dmlc binary).
+ *   - dev_type/dev_id arguments are absent; device selection follows
+ *     the embedded runtime's context (JAX_PLATFORMS / MXNET_* env).
+ *
+ * All functions return 0 on success, -1 on failure; the error message
+ * is retrievable via MXTPredGetLastError (thread-local, like
+ * c_api_error.cc's ring).
+ */
+#ifndef MXT_PREDICT_H_
+#define MXT_PREDICT_H_
+
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+#define MXT_API __attribute__((visibility("default")))
+
+typedef void *MXTPredictorHandle;
+
+/* Create a predictor from a symbol JSON string and a checkpoint params
+ * file.  input_keys/shape_data/shape_ndim describe each input's name and
+ * shape, c_predict_api-style (shape_data[i] points at shape_ndim[i]
+ * uint32 dims). */
+MXT_API int MXTPredCreate(const char *symbol_json_str,
+                          const char *param_file,
+                          uint32_t num_input_nodes,
+                          const char **input_keys,
+                          const uint32_t **shape_data,
+                          const uint32_t *shape_ndim,
+                          MXTPredictorHandle *out);
+
+/* Copy float32 data into the named input (size = element count, must
+ * match the declared shape). */
+MXT_API int MXTPredSetInput(MXTPredictorHandle handle, const char *key,
+                            const float *data, uint64_t size);
+
+MXT_API int MXTPredForward(MXTPredictorHandle handle);
+
+/* Output shape query: writes up to *ndim dims into shape and sets *ndim
+ * to the actual rank.  Call with shape=NULL to query the rank only. */
+MXT_API int MXTPredGetOutputShape(MXTPredictorHandle handle,
+                                  uint32_t index, uint32_t *shape,
+                                  uint32_t *ndim);
+
+/* Copy output `index` into data (size = element count). */
+MXT_API int MXTPredGetOutput(MXTPredictorHandle handle, uint32_t index,
+                             float *data, uint64_t size);
+
+/* Rebind to new input shapes (parity: MXPredReshape). */
+MXT_API int MXTPredReshape(MXTPredictorHandle handle,
+                           uint32_t num_input_nodes,
+                           const char **input_keys,
+                           const uint32_t **shape_data,
+                           const uint32_t *shape_ndim);
+
+MXT_API void MXTPredFree(MXTPredictorHandle handle);
+
+MXT_API const char *MXTPredGetLastError(void);
+
+#ifdef __cplusplus
+}
+#endif
+#endif /* MXT_PREDICT_H_ */

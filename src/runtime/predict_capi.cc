@@ -1,0 +1,306 @@
+// predict_capi.cc — C inference API over an embedded CPython runtime.
+//
+// Parity: src/c_api/c_predict_api.cc (MXPredCreate/SetInput/Forward/
+// GetOutputShape/GetOutput/Reshape/Free).  The reference builds a
+// forward-only GraphExecutor in-process; here the executor IS the
+// python-native mxnet_tpu.predictor.Predictor (XLA-compiled forward),
+// and this file is the flat-C bridge: one embedded interpreter per
+// process, one Predictor object per handle, GIL taken around every
+// call so arbitrary C threads may drive it.
+#include "mxt_predict.h"
+
+#include <Python.h>
+
+#include <cstdio>
+#include <cstring>
+#include <mutex>
+#include <string>
+
+namespace {
+
+thread_local std::string g_last_error;
+
+void set_error(const char *where) {
+  PyObject *type = nullptr, *value = nullptr, *tb = nullptr;
+  PyErr_Fetch(&type, &value, &tb);
+  std::string msg = where;
+  if (value != nullptr) {
+    PyObject *s = PyObject_Str(value);
+    if (s != nullptr) {
+      msg += ": ";
+      msg += PyUnicode_AsUTF8(s) ? PyUnicode_AsUTF8(s) : "<unprintable>";
+      Py_DECREF(s);
+    }
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+  g_last_error = msg;
+}
+
+// One interpreter per process, initialized on first use.  The host
+// process controls module search via PYTHONPATH (must reach mxnet_tpu
+// and its deps) and device selection via JAX_PLATFORMS / MXNET_* env —
+// same knobs as a python consumer.
+bool ensure_python() {
+  // once-guarded: concurrent first calls from different host threads
+  // must not double-initialize (UB in CPython)
+  static std::once_flag flag;
+  static bool ok = false;
+  std::call_once(flag, [] {
+    if (Py_IsInitialized()) {  // host already embeds python
+      ok = true;
+      return;
+    }
+    Py_InitializeEx(0);  // no signal handlers: the host owns them
+    if (!Py_IsInitialized()) return;
+    // release the GIL acquired by initialization so PyGILState_Ensure
+    // works uniformly from any thread afterwards
+    PyEval_SaveThread();
+    ok = true;
+  });
+  if (!ok) g_last_error = "Py_InitializeEx failed";
+  return ok;
+}
+
+struct Handle {
+  PyObject *predictor;  // mxnet_tpu.predictor.Predictor
+};
+
+PyObject *shapes_dict(uint32_t n, const char **keys,
+                      const uint32_t **shape_data,
+                      const uint32_t *shape_ndim) {
+  PyObject *d = PyDict_New();
+  if (d == nullptr) return nullptr;
+  for (uint32_t i = 0; i < n; ++i) {
+    PyObject *t = PyTuple_New(shape_ndim[i]);
+    if (t == nullptr) {
+      Py_DECREF(d);
+      return nullptr;
+    }
+    for (uint32_t j = 0; j < shape_ndim[i]; ++j) {
+      PyTuple_SET_ITEM(t, j, PyLong_FromUnsignedLong(shape_data[i][j]));
+    }
+    if (PyDict_SetItemString(d, keys[i], t) != 0) {
+      Py_DECREF(t);
+      Py_DECREF(d);
+      return nullptr;
+    }
+    Py_DECREF(t);
+  }
+  return d;
+}
+
+class Gil {
+ public:
+  Gil() : state_(PyGILState_Ensure()) {}
+  ~Gil() { PyGILState_Release(state_); }
+
+ private:
+  PyGILState_STATE state_;
+};
+
+}  // namespace
+
+extern "C" {
+
+int MXTPredCreate(const char *symbol_json_str, const char *param_file,
+                  uint32_t num_input_nodes, const char **input_keys,
+                  const uint32_t **shape_data, const uint32_t *shape_ndim,
+                  MXTPredictorHandle *out) {
+  if (out == nullptr) return -1;
+  *out = nullptr;
+  if (!ensure_python()) return -1;
+  Gil gil;
+  PyObject *mod = PyImport_ImportModule("mxnet_tpu.predictor");
+  if (mod == nullptr) {
+    set_error("import mxnet_tpu.predictor failed (is PYTHONPATH set?)");
+    return -1;
+  }
+  PyObject *cls = PyObject_GetAttrString(mod, "Predictor");
+  Py_DECREF(mod);
+  if (cls == nullptr) {
+    set_error("Predictor class missing");
+    return -1;
+  }
+  PyObject *shapes =
+      shapes_dict(num_input_nodes, input_keys, shape_data, shape_ndim);
+  PyObject *pred = nullptr;
+  if (shapes != nullptr) {
+    pred = PyObject_CallFunction(cls, "ssO", symbol_json_str, param_file,
+                                 shapes);
+  }
+  Py_XDECREF(shapes);
+  Py_DECREF(cls);
+  if (pred == nullptr) {
+    set_error("MXTPredCreate");
+    return -1;
+  }
+  auto *h = new Handle{pred};
+  *out = h;
+  return 0;
+}
+
+int MXTPredSetInput(MXTPredictorHandle handle, const char *key,
+                    const float *data, uint64_t size) {
+  auto *h = static_cast<Handle *>(handle);
+  if (h == nullptr) return -1;
+  Gil gil;
+  // hand the buffer over as bytes; the python side reshapes to the
+  // declared input shape (frombuffer copies — the caller keeps ownership)
+  PyObject *np = PyImport_ImportModule("numpy");
+  if (np == nullptr) {
+    set_error("import numpy");
+    return -1;
+  }
+  PyObject *bytes = PyBytes_FromStringAndSize(
+      reinterpret_cast<const char *>(data),
+      static_cast<Py_ssize_t>(size * sizeof(float)));
+  PyObject *arr =
+      bytes ? PyObject_CallMethod(np, "frombuffer", "Os", bytes, "float32")
+            : nullptr;
+  Py_XDECREF(bytes);
+  Py_DECREF(np);
+  if (arr == nullptr) {
+    set_error("MXTPredSetInput: buffer conversion");
+    return -1;
+  }
+  PyObject *r = PyObject_CallMethod(h->predictor, "set_input", "sO", key, arr);
+  Py_DECREF(arr);
+  if (r == nullptr) {
+    set_error("MXTPredSetInput");
+    return -1;
+  }
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXTPredForward(MXTPredictorHandle handle) {
+  auto *h = static_cast<Handle *>(handle);
+  if (h == nullptr) return -1;
+  Gil gil;
+  PyObject *r = PyObject_CallMethod(h->predictor, "forward", nullptr);
+  if (r == nullptr) {
+    set_error("MXTPredForward");
+    return -1;
+  }
+  Py_DECREF(r);
+  return 0;
+}
+
+namespace {
+
+// fetch output `index` as a contiguous float32 numpy array (new ref)
+PyObject *get_output_f32(Handle *h, uint32_t index) {
+  PyObject *arr =
+      PyObject_CallMethod(h->predictor, "get_output", "I", index);
+  if (arr == nullptr) return nullptr;
+  PyObject *np = PyImport_ImportModule("numpy");
+  if (np == nullptr) {
+    Py_DECREF(arr);
+    return nullptr;
+  }
+  PyObject *cast = PyObject_CallMethod(
+      np, "ascontiguousarray", "Os", arr, "float32");
+  Py_DECREF(np);
+  Py_DECREF(arr);
+  return cast;
+}
+
+}  // namespace
+
+int MXTPredGetOutputShape(MXTPredictorHandle handle, uint32_t index,
+                          uint32_t *shape, uint32_t *ndim) {
+  auto *h = static_cast<Handle *>(handle);
+  if (h == nullptr || ndim == nullptr) return -1;
+  Gil gil;
+  PyObject *arr = get_output_f32(h, index);
+  if (arr == nullptr) {
+    set_error("MXTPredGetOutputShape");
+    return -1;
+  }
+  PyObject *shp = PyObject_GetAttrString(arr, "shape");
+  Py_DECREF(arr);
+  if (shp == nullptr) {
+    set_error("MXTPredGetOutputShape: shape attr");
+    return -1;
+  }
+  uint32_t rank = static_cast<uint32_t>(PyTuple_Size(shp));
+  if (shape != nullptr) {
+    for (uint32_t i = 0; i < rank && i < *ndim; ++i) {
+      shape[i] = static_cast<uint32_t>(
+          PyLong_AsUnsignedLong(PyTuple_GetItem(shp, i)));
+    }
+  }
+  *ndim = rank;
+  Py_DECREF(shp);
+  return 0;
+}
+
+int MXTPredGetOutput(MXTPredictorHandle handle, uint32_t index, float *data,
+                     uint64_t size) {
+  auto *h = static_cast<Handle *>(handle);
+  if (h == nullptr || data == nullptr) return -1;
+  Gil gil;
+  PyObject *arr = get_output_f32(h, index);
+  if (arr == nullptr) {
+    set_error("MXTPredGetOutput");
+    return -1;
+  }
+  PyObject *bytes = PyObject_CallMethod(arr, "tobytes", nullptr);
+  Py_DECREF(arr);
+  if (bytes == nullptr) {
+    set_error("MXTPredGetOutput: tobytes");
+    return -1;
+  }
+  Py_ssize_t nbytes = PyBytes_Size(bytes);
+  if (static_cast<uint64_t>(nbytes) != size * sizeof(float)) {
+    g_last_error = "MXTPredGetOutput: size mismatch (got " +
+                   std::to_string(nbytes / sizeof(float)) + " elements, " +
+                   "caller asked for " + std::to_string(size) + ")";
+    Py_DECREF(bytes);
+    return -1;
+  }
+  std::memcpy(data, PyBytes_AsString(bytes), nbytes);
+  Py_DECREF(bytes);
+  return 0;
+}
+
+int MXTPredReshape(MXTPredictorHandle handle, uint32_t num_input_nodes,
+                   const char **input_keys, const uint32_t **shape_data,
+                   const uint32_t *shape_ndim) {
+  auto *h = static_cast<Handle *>(handle);
+  if (h == nullptr) return -1;
+  Gil gil;
+  PyObject *shapes =
+      shapes_dict(num_input_nodes, input_keys, shape_data, shape_ndim);
+  if (shapes == nullptr) {
+    set_error("MXTPredReshape: shapes");
+    return -1;
+  }
+  // Predictor.reshape returns a NEW predictor (MXPredReshape returns a
+  // new handle in the reference; this C API swaps it in-place)
+  PyObject *fresh = PyObject_CallMethod(h->predictor, "reshape", "O", shapes);
+  Py_DECREF(shapes);
+  if (fresh == nullptr) {
+    set_error("MXTPredReshape");
+    return -1;
+  }
+  Py_DECREF(h->predictor);
+  h->predictor = fresh;
+  return 0;
+}
+
+void MXTPredFree(MXTPredictorHandle handle) {
+  auto *h = static_cast<Handle *>(handle);
+  if (h == nullptr) return;
+  if (Py_IsInitialized()) {
+    Gil gil;
+    Py_DECREF(h->predictor);
+  }
+  delete h;
+}
+
+const char *MXTPredGetLastError(void) { return g_last_error.c_str(); }
+
+}  // extern "C"
