@@ -895,3 +895,55 @@ def test_every_example_dir_is_ci_covered():
         if not any(n in this for n in needles):
             missing.append(d)
     assert not missing, f"example dirs without CI coverage: {missing}"
+
+
+def test_accnn_fc_and_conv_factorization(tmp_path):
+    """tools/accnn low-rank acceleration: full-rank factorization is
+    numerically exact; reduced rank shrinks weights (parity:
+    tools/accnn acc_fc/acc_conv Jaderberg scheme)."""
+    import sys as _sys
+    accnn = os.path.join(REPO, "tools", "accnn")
+    _sys.path.insert(0, accnn)
+    try:
+        import importlib
+        import acc_fc, acc_conv, utils as accnn_utils  # noqa: F401
+        importlib.reload(accnn_utils)
+        from acc_fc import factorize_fc
+        from acc_conv import factorize_conv
+        import mxnet_tpu as mx
+        from mxnet_tpu.io import DataDesc
+        rs = np.random.RandomState(0)
+        net = mx.sym.Convolution(mx.sym.Variable("data"), num_filter=8,
+                                 kernel=(3, 3), pad=(1, 1), name="c1")
+        net = mx.sym.Activation(net, act_type="relu")
+        net = mx.sym.SoftmaxOutput(
+            mx.sym.FullyConnected(mx.sym.Flatten(net), num_hidden=4,
+                                  name="f1"), name="softmax")
+        mod = mx.mod.Module(net)
+        mod.bind(data_shapes=[DataDesc("data", (2, 3, 12, 12),
+                                       np.float32)],
+                 label_shapes=[DataDesc("softmax_label", (2,),
+                                        np.float32)])
+        mod.init_params(mx.init.Xavier())
+        arg, aux = mod.get_params()
+        X = rs.normal(0, 1, (2, 3, 12, 12)).astype("f")
+
+        def fwd(sym_, args_):
+            ex = sym_.simple_bind(ctx=mx.cpu(), grad_req="null",
+                                  data=(2, 3, 12, 12))
+            for k, v in args_.items():
+                if k in ex.arg_dict:
+                    ex.arg_dict[k][:] = v.asnumpy()
+            ex.arg_dict["data"][:] = X
+            return ex.forward(is_train=False)[0].asnumpy()
+
+        base = fwd(net, arg)
+        s1, a1, _ = factorize_conv(net, arg, ranks={"c1": 9})  # full
+        s2, a2, _ = factorize_fc(s1, a1, ranks={"f1": 4})      # full
+        np.testing.assert_allclose(fwd(s2, a2), base, atol=1e-4)
+        s3, a3, r3 = factorize_conv(net, arg, energy=0.8)
+        assert r3["c1"] < 9  # genuinely reduced
+        out = fwd(s3, a3)
+        assert np.isfinite(out).all()
+    finally:
+        _sys.path.remove(accnn)

@@ -1,0 +1,113 @@
+"""Accelerate Convolution layers by spatial low-rank factorization
+(parity: tools/accnn/acc_conv.py, the Jaderberg et al. scheme the
+reference implements): W (N, C, kh, kw) ~= vertical V (K, C, kh, 1)
+followed by horizontal H (N, K, 1, kw).  Cost N*C*kh*kw ->
+K*(C*kh + N*kw) per output pixel; both factors are ordinary convs, so
+XLA tiles them onto the MXU unchanged.
+
+    python tools/accnn/acc_conv.py --model m --epoch 1 --save-model m-acc \
+        [--layers conv1] [--energy 0.9 | --ranks conv1:8]
+"""
+import argparse
+
+import numpy as np
+
+import utils
+from rank_selection import select_ranks
+
+
+def _conv_matrix(w):
+    """W (N,C,kh,kw) -> M (C*kh, N*kw) whose SVD gives the two factors."""
+    n, c, kh, kw = w.shape
+    return w.transpose(1, 2, 0, 3).reshape(c * kh, n * kw)
+
+
+def factorize_conv(sym, arg_params, layers=None, ranks=None, energy=0.9):
+    arg_params = dict(arg_params)
+    conv_info = {}
+    for node in utils.json.loads(sym.tojson())["nodes"]:
+        if node["op"] != "Convolution":
+            continue
+        if layers and node["name"] not in layers:
+            continue
+        w = arg_params.get(node["name"] + "_weight")
+        if w is None:
+            continue
+        attrs = node.get("attrs", {})
+        if attrs.get("num_group", "1") not in ("1",):
+            continue  # grouped/depthwise convs keep their native form
+        conv_info[node["name"]] = w.asnumpy()
+    if ranks is None:
+        ranks = select_ranks({n: _conv_matrix(w)
+                              for n, w in conv_info.items()},
+                             energy=energy)
+
+    def parse2(attrs, key, default):
+        v = attrs.get(key)
+        if v is None:
+            return default
+        v = v.strip("()[] ").split(",")
+        return (int(v[0]), int(v[1] if len(v) > 1 else v[0]))
+
+    def replace(node, inputs, emit):
+        name = node["name"]
+        if node["op"] != "Convolution" or name not in conv_info:
+            return None
+        w = conv_info[name]
+        n, c, kh, kw = w.shape
+        m = _conv_matrix(w)
+        k = min(ranks.get(name, n), min(m.shape))
+        u, s, vt = np.linalg.svd(m, full_matrices=False)
+        # vertical factor (K, C, kh, 1); horizontal factor (N, K, 1, kw)
+        v_fac = (u[:, :k] * np.sqrt(s)[None, :k]).T \
+            .reshape(k, c, kh, 1).astype(w.dtype)
+        h_fac = (np.sqrt(s)[:k, None] * vt[:k]) \
+            .reshape(k, n, kw).transpose(1, 0, 2) \
+            .reshape(n, k, 1, kw).astype(w.dtype)
+        arg_params[name + "_v_weight"] = utils.mx.nd.array(v_fac)
+        arg_params[name + "_h_weight"] = utils.mx.nd.array(h_fac)
+        arg_params.pop(name + "_weight", None)
+        attrs = dict(node.get("attrs", {}))
+        sh, sw = parse2(attrs, "stride", (1, 1))
+        ph, pw = parse2(attrs, "pad", (0, 0))
+        vw = emit("null", name + "_v_weight", {}, [])
+        v = emit("Convolution", name + "_v",
+                 {"num_filter": k, "kernel": (kh, 1), "stride": (sh, 1),
+                  "pad": (ph, 0), "no_bias": "True"}, [inputs[0], vw])
+        hw = emit("null", name + "_h_weight", {}, [])
+        h_in = [v, hw]
+        if attrs.get("no_bias", "False") not in ("True", "true", "1"):
+            h_in.append(inputs[2])
+        return emit("Convolution", name,
+                    {"num_filter": n, "kernel": (1, kw), "stride": (1, sw),
+                     "pad": (0, pw),
+                     "no_bias": attrs.get("no_bias", "False")}, h_in)
+
+    new_sym = utils.GraphEditor(sym).run(replace)
+    return new_sym, arg_params, ranks
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", required=True)
+    ap.add_argument("--epoch", type=int, default=1)
+    ap.add_argument("--save-model", required=True)
+    ap.add_argument("--layers", default=None)
+    ap.add_argument("--energy", type=float, default=0.9)
+    ap.add_argument("--ranks", default=None)
+    args = ap.parse_args()
+    sym, arg_params, aux_params = utils.load_model(args.model, args.epoch)
+    ranks = None
+    if args.ranks:
+        ranks = {kv.split(":")[0]: int(kv.split(":")[1])
+                 for kv in args.ranks.split(",")}
+    layers = set(args.layers.split(",")) if args.layers else None
+    new_sym, new_args, used = factorize_conv(
+        sym, arg_params, layers=layers, ranks=ranks, energy=args.energy)
+    utils.save_model(args.save_model, args.epoch, new_sym, new_args,
+                     aux_params)
+    print("factorized:", ", ".join(f"{n}:k={r}" for n, r in used.items()))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,91 @@
+"""Accelerate FullyConnected layers by SVD low-rank factorization
+(parity: tools/accnn/acc_fc.py): W (H, D) ~= U_k (H, k) @ V_k (k, D),
+so one FC becomes FC(num_hidden=k, no_bias) -> FC(num_hidden=H, bias).
+Cost drops from H*D to k*(H+D) multiply-adds per row — on the MXU both
+factors stay dense matmuls, so the speedup is architectural, not
+sparsity-dependent.
+
+    python tools/accnn/acc_fc.py --model m --epoch 1 --save-model m-acc \
+        [--layers fc1,fc2] [--energy 0.9 | --ranks fc1:32,fc2:16]
+"""
+import argparse
+
+import numpy as np
+
+import utils
+from rank_selection import select_ranks
+
+
+def factorize_fc(sym, arg_params, layers=None, ranks=None, energy=0.9):
+    """Return (new_sym, new_arg_params); `ranks` overrides `energy`."""
+    arg_params = dict(arg_params)
+    fc_weights = {}
+    for node in utils.json.loads(sym.tojson())["nodes"]:
+        if node["op"] != "FullyConnected":
+            continue
+        if layers and node["name"] not in layers:
+            continue
+        w = arg_params.get(node["name"] + "_weight")
+        if w is None:
+            continue
+        fc_weights[node["name"]] = w.asnumpy()
+    if ranks is None:
+        ranks = select_ranks(fc_weights, energy=energy)
+
+    def replace(node, inputs, emit):
+        name = node["name"]
+        if node["op"] != "FullyConnected" or name not in fc_weights:
+            return None
+        w = fc_weights[name]
+        h, d = w.shape
+        k = min(ranks.get(name, h), min(h, d))
+        u, s, vt = np.linalg.svd(w, full_matrices=False)
+        v_red = (np.sqrt(s)[:k, None] * vt[:k]).astype(w.dtype)   # (k, D)
+        u_rec = (u[:, :k] * np.sqrt(s)[None, :k]).astype(w.dtype)  # (H, k)
+        arg_params[name + "_red_weight"] = utils.mx.nd.array(v_red)
+        arg_params[name + "_rec_weight"] = utils.mx.nd.array(u_rec)
+        arg_params.pop(name + "_weight", None)
+        attrs = dict(node.get("attrs", {}))
+        red_w = emit("null", name + "_red_weight", {}, [])
+        red = emit("FullyConnected", name + "_red",
+                   {"num_hidden": k, "no_bias": "True",
+                    "flatten": attrs.get("flatten", "True")},
+                   [inputs[0], red_w])
+        rec_w = emit("null", name + "_rec_weight", {}, [])
+        rec_in = [red, rec_w]
+        if attrs.get("no_bias", "False") not in ("True", "true", "1"):
+            rec_in.append(inputs[2])
+        return emit("FullyConnected", name,
+                    {"num_hidden": attrs["num_hidden"],
+                     "no_bias": attrs.get("no_bias", "False")}, rec_in)
+
+    new_sym = utils.GraphEditor(sym).run(replace)
+    return new_sym, arg_params, ranks
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", required=True, help="checkpoint prefix")
+    ap.add_argument("--epoch", type=int, default=1)
+    ap.add_argument("--save-model", required=True)
+    ap.add_argument("--layers", default=None,
+                    help="comma list; default: every FC")
+    ap.add_argument("--energy", type=float, default=0.9)
+    ap.add_argument("--ranks", default=None,
+                    help="explicit name:rank comma list")
+    args = ap.parse_args()
+    sym, arg_params, aux_params = utils.load_model(args.model, args.epoch)
+    ranks = None
+    if args.ranks:
+        ranks = {kv.split(":")[0]: int(kv.split(":")[1])
+                 for kv in args.ranks.split(",")}
+    layers = set(args.layers.split(",")) if args.layers else None
+    new_sym, new_args, used = factorize_fc(
+        sym, arg_params, layers=layers, ranks=ranks, energy=args.energy)
+    utils.save_model(args.save_model, args.epoch, new_sym, new_args,
+                     aux_params)
+    print("factorized:", ", ".join(f"{n}:k={r}" for n, r in used.items()))
+
+
+if __name__ == "__main__":
+    main()

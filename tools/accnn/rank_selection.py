@@ -1,0 +1,52 @@
+"""Rank selection for low-rank factorization (parity:
+tools/accnn/rank_selection.py — the reference allocates per-layer ranks
+to meet a global speed budget via DP; this version allocates by
+singular-value energy, the criterion the DP's cost model is built on,
+with an optional flops budget).
+
+API: select_ranks(weights, energy=0.95, flops_ratio=None) ->
+{layer: rank}.  `weights` maps layer name -> the SVD spectrum's matrix
+(2-D, already reshaped by the caller).
+"""
+import numpy as np
+
+
+def energy_rank(s, energy):
+    """Smallest k whose cumulative squared-singular-value mass >= energy."""
+    c = np.cumsum(s ** 2)
+    total = c[-1] if c.size else 0.0
+    if total <= 0:
+        return 1
+    return int(np.searchsorted(c / total, energy) + 1)
+
+
+def layer_flops(shape, rank=None):
+    """Relative cost of the (factored) matrix multiply."""
+    n, m = shape
+    if rank is None:
+        return n * m
+    return rank * (n + m)
+
+
+def select_ranks(weights, energy=0.95, flops_ratio=None):
+    """Per-layer ranks.  With flops_ratio (0..1) the energy threshold is
+    lowered uniformly until the factored flops fit the budget."""
+    def ranks_at(e):
+        out = {}
+        for name, w in weights.items():
+            s = np.linalg.svd(np.asarray(w, np.float64),
+                              compute_uv=False)
+            out[name] = max(1, energy_rank(s, e))
+        return out
+
+    ranks = ranks_at(energy)
+    if flops_ratio is not None:
+        budget = flops_ratio * sum(layer_flops(w.shape)
+                                   for w in weights.values())
+        e = energy
+        while e > 0.05 and sum(
+                layer_flops(weights[n].shape, r)
+                for n, r in ranks.items()) > budget:
+            e *= 0.9
+            ranks = ranks_at(e)
+    return ranks

@@ -1,0 +1,66 @@
+"""Shared helpers for the accnn low-rank acceleration tools (parity:
+tools/accnn/utils.py — checkpoint IO + symbol-JSON graph surgery).
+
+The graph editor works on the nnvm-style JSON (nodes / arg_nodes /
+heads / node_row_ptr): a pass walks the node list in order, may replace
+one node with a small subgraph, and the builder renumbers everything.
+"""
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+import mxnet_tpu as mx  # noqa: E402
+
+
+def load_model(prefix, epoch):
+    sym, arg_params, aux_params = mx.model.load_checkpoint(prefix, epoch)
+    return sym, arg_params, aux_params
+
+
+def save_model(prefix, epoch, sym, arg_params, aux_params):
+    mx.model.save_checkpoint(prefix, epoch, sym, arg_params, aux_params)
+    return "%s-symbol.json" % prefix, "%s-%04d.params" % (prefix, epoch)
+
+
+class GraphEditor:
+    """Rebuilds a symbol JSON while letting a callback replace nodes.
+
+    replace(node, input_refs, emit) -> output ref or None
+      node: the original node dict (op/name/attrs)
+      input_refs: the node's inputs mapped into the NEW graph
+      emit(op, name, attrs, inputs) -> ref of a freshly added node
+      return None to keep the node unchanged.
+    """
+
+    def __init__(self, sym):
+        self.graph = json.loads(sym.tojson())
+        self.new_nodes = []
+        self.old2new = {}
+
+    def emit(self, op, name, attrs, inputs):
+        self.new_nodes.append({"op": op, "name": name,
+                               "attrs": {k: str(v) for k, v in attrs.items()},
+                               "inputs": [list(i) for i in inputs]})
+        return [len(self.new_nodes) - 1, 0, 0]
+
+    def run(self, replace):
+        for idx, node in enumerate(self.graph["nodes"]):
+            mapped = [[self.old2new[i[0]][0], i[1], i[2]]
+                      for i in node["inputs"]]
+            out = replace(node, mapped, self.emit)
+            if out is None:
+                out = self.emit(node["op"], node["name"],
+                                node.get("attrs", {}), mapped)
+            self.old2new[idx] = out
+        g = {
+            "nodes": self.new_nodes,
+            "arg_nodes": [i for i, n in enumerate(self.new_nodes)
+                          if n["op"] == "null"],
+            "node_row_ptr": list(range(len(self.new_nodes) + 1)),
+            "heads": [[self.old2new[h[0]][0], h[1], h[2]]
+                      for h in self.graph["heads"]],
+            "attrs": self.graph.get("attrs", {}),
+        }
+        return mx.sym.load_json(json.dumps(g))
