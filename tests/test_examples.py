@@ -905,9 +905,6 @@ def test_accnn_fc_and_conv_factorization(tmp_path):
     accnn = os.path.join(REPO, "tools", "accnn")
     _sys.path.insert(0, accnn)
     try:
-        import importlib
-        import acc_fc, acc_conv, utils as accnn_utils  # noqa: F401
-        importlib.reload(accnn_utils)
         from acc_fc import factorize_fc
         from acc_conv import factorize_conv
         import mxnet_tpu as mx
@@ -945,5 +942,57 @@ def test_accnn_fc_and_conv_factorization(tmp_path):
         assert r3["c1"] < 9  # genuinely reduced
         out = fwd(s3, a3)
         assert np.isfinite(out).all()
+    finally:
+        _sys.path.remove(accnn)
+
+
+def test_accnn_dilated_and_explicit_ranks(tmp_path):
+    """Dilation rides the factor pair it belongs to, and explicit
+    --ranks touches ONLY the named layers."""
+    import sys as _sys
+    accnn = os.path.join(REPO, "tools", "accnn")
+    _sys.path.insert(0, accnn)
+    try:
+        from acc_conv import factorize_conv
+        import json as _json
+        import mxnet_tpu as mx
+        from mxnet_tpu.io import DataDesc
+        rs = np.random.RandomState(1)
+        net = mx.sym.Convolution(mx.sym.Variable("data"), num_filter=6,
+                                 kernel=(3, 3), pad=(2, 2),
+                                 dilate=(2, 2), name="cd")
+        net = mx.sym.Convolution(net, num_filter=4, kernel=(3, 3),
+                                 pad=(1, 1), name="ck")
+        net = mx.sym.SoftmaxOutput(
+            mx.sym.FullyConnected(mx.sym.Flatten(net), num_hidden=3,
+                                  name="fx"), name="softmax")
+        mod = mx.mod.Module(net)
+        mod.bind(data_shapes=[DataDesc("data", (2, 3, 12, 12),
+                                       np.float32)],
+                 label_shapes=[DataDesc("softmax_label", (2,),
+                                        np.float32)])
+        mod.init_params(mx.init.Xavier())
+        arg, aux = mod.get_params()
+        X = rs.normal(0, 1, (2, 3, 12, 12)).astype("f")
+
+        def fwd(sym_, args_):
+            ex = sym_.simple_bind(ctx=mx.cpu(), grad_req="null",
+                                  data=(2, 3, 12, 12))
+            for k, v in args_.items():
+                if k in ex.arg_dict:
+                    ex.arg_dict[k][:] = v.asnumpy()
+            ex.arg_dict["data"][:] = X
+            return ex.forward(is_train=False)[0].asnumpy()
+
+        base = fwd(net, arg)
+        # full-rank factorization of ONLY the dilated conv stays exact
+        s1, a1, _ = factorize_conv(net, arg, ranks={"cd": 9})
+        np.testing.assert_allclose(fwd(s1, a1), base, atol=1e-4)
+        nodes = _json.loads(s1.tojson())["nodes"]
+        by_name = {n["name"]: n for n in nodes}
+        assert by_name["cd_v"]["attrs"]["dilate"] == "(2, 1)"
+        assert by_name["cd"]["attrs"]["dilate"] == "(1, 2)"
+        # the unnamed conv is untouched
+        assert "ck_v" not in by_name and "ck_weight" in a1
     finally:
         _sys.path.remove(accnn)

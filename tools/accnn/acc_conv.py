@@ -31,8 +31,8 @@ def factorize_conv(sym, arg_params, layers=None, ranks=None, energy=0.9):
         if layers and node["name"] not in layers:
             continue
         w = arg_params.get(node["name"] + "_weight")
-        if w is None:
-            continue
+        if w is None or len(w.shape) != 4:
+            continue  # 1-D/3-D convs keep their native form
         attrs = node.get("attrs", {})
         if attrs.get("num_group", "1") not in ("1",):
             continue  # grouped/depthwise convs keep their native form
@@ -41,6 +41,10 @@ def factorize_conv(sym, arg_params, layers=None, ranks=None, energy=0.9):
         ranks = select_ranks({n: _conv_matrix(w)
                               for n, w in conv_info.items()},
                              energy=energy)
+    else:
+        # explicit ranks name exactly the layers to touch; everything
+        # else keeps its original single conv
+        conv_info = {n: w for n, w in conv_info.items() if n in ranks}
 
     def parse2(attrs, key, default):
         v = attrs.get(key)
@@ -70,17 +74,19 @@ def factorize_conv(sym, arg_params, layers=None, ranks=None, energy=0.9):
         attrs = dict(node.get("attrs", {}))
         sh, sw = parse2(attrs, "stride", (1, 1))
         ph, pw = parse2(attrs, "pad", (0, 0))
+        dh, dw = parse2(attrs, "dilate", (1, 1))
         vw = emit("null", name + "_v_weight", {}, [])
         v = emit("Convolution", name + "_v",
                  {"num_filter": k, "kernel": (kh, 1), "stride": (sh, 1),
-                  "pad": (ph, 0), "no_bias": "True"}, [inputs[0], vw])
+                  "pad": (ph, 0), "dilate": (dh, 1), "no_bias": "True"},
+                 [inputs[0], vw])
         hw = emit("null", name + "_h_weight", {}, [])
         h_in = [v, hw]
         if attrs.get("no_bias", "False") not in ("True", "true", "1"):
             h_in.append(inputs[2])
         return emit("Convolution", name,
                     {"num_filter": n, "kernel": (1, kw), "stride": (1, sw),
-                     "pad": (0, pw),
+                     "pad": (0, pw), "dilate": (1, dw),
                      "no_bias": attrs.get("no_bias", "False")}, h_in)
 
     new_sym = utils.GraphEditor(sym).run(replace)

@@ -31,6 +31,9 @@ def factorize_fc(sym, arg_params, layers=None, ranks=None, energy=0.9):
         fc_weights[node["name"]] = w.asnumpy()
     if ranks is None:
         ranks = select_ranks(fc_weights, energy=energy)
+    else:
+        # explicit ranks name exactly the layers to touch
+        fc_weights = {n: w for n, w in fc_weights.items() if n in ranks}
 
     def replace(node, inputs, emit):
         name = node["name"]

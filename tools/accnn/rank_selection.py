@@ -31,13 +31,14 @@ def layer_flops(shape, rank=None):
 def select_ranks(weights, energy=0.95, flops_ratio=None):
     """Per-layer ranks.  With flops_ratio (0..1) the energy threshold is
     lowered uniformly until the factored flops fit the budget."""
+    # one SVD per layer; re-thresholding reuses the spectra
+    spectra = {name: np.linalg.svd(np.asarray(w, np.float64),
+                                   compute_uv=False)
+               for name, w in weights.items()}
+
     def ranks_at(e):
-        out = {}
-        for name, w in weights.items():
-            s = np.linalg.svd(np.asarray(w, np.float64),
-                              compute_uv=False)
-            out[name] = max(1, energy_rank(s, e))
-        return out
+        return {name: max(1, energy_rank(s, e))
+                for name, s in spectra.items()}
 
     ranks = ranks_at(energy)
     if flops_ratio is not None:
