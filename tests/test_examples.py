@@ -996,3 +996,23 @@ def test_accnn_dilated_and_explicit_ranks(tmp_path):
         assert "ck_v" not in by_name and "ck_weight" in a1
     finally:
         _sys.path.remove(accnn)
+
+
+def test_benchmark_sweep_driver(tmp_path):
+    """The training-throughput sweep driver (reference benchmark.py):
+    dry-run lists the planned cells; one tiny real cell produces a
+    parsed img/s row and a JSONL report."""
+    out = run_example("example/image-classification/benchmark.py",
+                      "--dry-run", "--networks", "resnet-18,mobilenet",
+                      "--batch-sizes", "8,16")
+    assert out.count("train_imagenet.py") == 4
+    report = str(tmp_path / "report.jsonl")
+    out = run_example("example/image-classification/benchmark.py",
+                      "--networks", "mlp", "--batch-sizes", "8",
+                      "--image-size", "28", "--batches", "3",
+                      "--timeout", "360", "--output", report,
+                      timeout=400)
+    assert "| mlp | 8 |" in out
+    import json as _json
+    rec = _json.loads(open(report).read().splitlines()[0])
+    assert rec["rc"] == 0 and rec["img_s"] > 0, rec
