@@ -57,6 +57,8 @@ def _emit(partial):
     v = _STATE["img_s"] or 0.0
     out = {"metric": "resnet50_train_throughput", "value": round(v, 2),
            "unit": "img/s", "vs_baseline": round(v / BASELINE_IMG_S, 2)}
+    if "fused_step" in _STATE:
+        out["fused_step"] = _STATE["fused_step"]
     if partial:
         out["partial"] = True
         out["phase"] = _STATE["phase"]
@@ -102,17 +104,41 @@ def _run():
     label_nd = mx.nd.array(labels, ctx=ctx)
     it = mx.io.NDArrayIter(data_nd, label_nd, batch_size=BATCH)
 
+    # fused single-program step: ON by default on the real chip (its CPU
+    # bit-identity is CI-pinned; program-boundary cost is the measured
+    # on-chip gap) — MXNET_FUSED_STEP=0/1 pins it for A/B runs, and a
+    # fused-path failure falls back to the standard step below so the
+    # driver's one bench run can never lose its number to the new path.
+    # MXNET_FUSED_STEP pins the path STRICTLY (the chip-window A/B needs
+    # a failing fused leg to fail loudly, not silently measure the
+    # standard step); MXT_BENCH_FUSED=0/1 is the bench-level choice that
+    # keeps the fallback safety net; default: fused on the real chip.
+    fused_pinned = "MXNET_FUSED_STEP" in os.environ
+    if fused_pinned:
+        fused = bool(int(os.environ["MXNET_FUSED_STEP"] or "0"))
+    elif "MXT_BENCH_FUSED" in os.environ:
+        fused = bool(int(os.environ["MXT_BENCH_FUSED"] or "0"))
+    else:
+        fused = on_tpu
+    _STATE["fused_step"] = fused
+
+    def build_module():
+        mod = mx.mod.Module(out, context=ctx)
+        mod.bind(data_shapes=[DataDesc("data", (BATCH, 3, IMG, IMG),
+                                       np.dtype("bfloat16"))],
+                 label_shapes=[DataDesc("softmax_label", (BATCH,),
+                                        np.float32)])
+        mod.init_params(mx.init.Xavier(rnd_type="gaussian",
+                                       factor_type="in", magnitude=2))
+        mod.init_optimizer(kvstore="tpu_sync", optimizer="sgd",
+                           optimizer_params={"learning_rate": LR,
+                                             "momentum": 0.9, "wd": 1e-4,
+                                             "multi_precision": True})
+        return mod
+
     _phase("bind_init", SETUP_S)
-    mod = mx.mod.Module(out, context=ctx)
-    mod.bind(data_shapes=[DataDesc("data", (BATCH, 3, IMG, IMG),
-                                   np.dtype("bfloat16"))],
-             label_shapes=[DataDesc("softmax_label", (BATCH,), np.float32)])
-    mod.init_params(mx.init.Xavier(rnd_type="gaussian", factor_type="in",
-                                   magnitude=2))
-    mod.init_optimizer(kvstore="tpu_sync", optimizer="sgd",
-                       optimizer_params={"learning_rate": LR,
-                                         "momentum": 0.9, "wd": 1e-4,
-                                         "multi_precision": True})
+    os.environ["MXNET_FUSED_STEP"] = "1" if fused else "0"
+    mod = build_module()
 
     class LossMetric(mx.metric.EvalMetric):
         """Per-batch NLL kept ON DEVICE as ONE jitted dispatch (each eager
@@ -162,8 +188,34 @@ def _run():
     _phase("compile_epoch_0", COMPILE_S)
     # params/optimizer already initialized above — fit() adopts the
     # prepared state and the loop runs the fused fwd+bwd / pushpull path
-    mod.fit(it, num_epoch=EPOCHS, eval_metric=metric,
-            epoch_end_callback=epoch_end)
+    try:
+        if fused and os.environ.get("MXT_BENCH_FAIL_FUSED_ONCE"):
+            raise RuntimeError("injected fused failure (CI fallback drill)")
+        mod.fit(it, num_epoch=EPOCHS, eval_metric=metric,
+                epoch_end_callback=epoch_end)
+    except Exception as e:  # noqa: BLE001
+        if not fused or fused_pinned or _STATE["epochs_timed"]:
+            raise  # pinned A/B legs and post-measurement failures fail loud
+        # the auto-enabled fused path failed on this backend before any
+        # timed epoch retired — rebuild on the standard step and retry
+        _STATE["error"] = "fused_step fell back: %s" % e
+        _STATE["fused_step"] = False
+        os.environ["MXNET_FUSED_STEP"] = "0"
+        # drop the failed module's device buffers BEFORE binding the
+        # second copy (params+grads+optimizer state would otherwise be
+        # resident twice — an OOM on a 256-batch resnet)
+        mod._exec = None
+        del mod
+        import gc
+        gc.collect()
+        metric._device_vals.clear()
+        epoch_times[:] = [time.perf_counter()]
+        it.reset()  # the failed run may have consumed the epoch
+        _phase("bind_init_fallback", SETUP_S)
+        mod = build_module()
+        _phase("compile_epoch_0", COMPILE_S)
+        mod.fit(it, num_epoch=EPOCHS, eval_metric=metric,
+                epoch_end_callback=epoch_end)
 
     _phase("finalize", EPOCH_S)
     losses = metric.materialize()

@@ -51,21 +51,34 @@ SPEED_RE = re.compile(r"Speed[:=]\s*([\d.]+)\s*samples")
 def run_cell(cell, args):
     cmd = cell_cmd(cell, args)
     t0 = time.time()
+    def scrape(text):
+        speeds = [float(m) for m in SPEED_RE.findall(text or "")]
+        # skip the first sample (pays compile); mean of the rest
+        steady = speeds[1:] if len(speeds) > 1 else speeds
+        return (round(sum(steady) / len(steady), 2) if steady else 0.0,
+                bool(steady))
+
     try:
         proc = subprocess.run(cmd, capture_output=True, text=True,
                               timeout=args.timeout, cwd=HERE)
         out = proc.stdout + proc.stderr
-        speeds = [float(m) for m in SPEED_RE.findall(out)]
-        # skip the first sample (pays compile); mean of the rest
-        steady = speeds[1:] if len(speeds) > 1 else speeds
-        return {**cell,
-                "img_s": round(sum(steady) / len(steady), 2) if steady
-                else 0.0,
-                "rc": proc.returncode,
-                "wall_s": round(time.time() - t0, 1),
-                "error": None if proc.returncode == 0 else out[-300:]}
-    except subprocess.TimeoutExpired:
-        return {**cell, "img_s": 0.0, "rc": "timeout",
+        img_s, parsed = scrape(out)
+        err = None
+        if proc.returncode != 0:
+            err = out[-300:]
+        elif not parsed:
+            # rc=0 with nothing scraped is a BAD cell, not a zero
+            err = ("no Speed lines parsed (need batches > disp-batches); "
+                   "tail: " + out[-200:])
+        return {**cell, "img_s": img_s, "rc": proc.returncode,
+                "wall_s": round(time.time() - t0, 1), "error": err}
+    except subprocess.TimeoutExpired as e:
+        # durable partial: speeds already printed before the timeout
+        # still count (the chip_window._run pattern)
+        partial = e.stdout.decode("utf-8", "replace") \
+            if isinstance(e.stdout, bytes) else (e.stdout or "")
+        img_s, _ = scrape(partial)
+        return {**cell, "img_s": img_s, "rc": "timeout",
                 "wall_s": round(time.time() - t0, 1),
                 "error": "timeout after %ss" % args.timeout}
 

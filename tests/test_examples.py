@@ -1016,3 +1016,43 @@ def test_benchmark_sweep_driver(tmp_path):
     import json as _json
     rec = _json.loads(open(report).read().splitlines()[0])
     assert rec["rc"] == 0 and rec["img_s"] > 0, rec
+
+
+def test_bench_fused_step_and_fallback():
+    """bench.py auto-fuses on TPU; forced-on CPU it must complete, and
+    an injected fused failure must fall back to the standard step and
+    still emit a clean full-run JSON (the driver's one bench run can
+    never lose its number to the fused path)."""
+    import json
+    env = {**ENV, "MXT_BENCH_BATCH": "8", "MXT_BENCH_IMG": "64",
+           "MXT_BENCH_BATCHES": "2", "MXT_BENCH_LR": "0.01",
+           "MXT_BENCH_FUSED": "1"}
+    proc = subprocess.run([sys.executable, os.path.join(REPO, "bench.py")],
+                          env=env, capture_output=True, text=True,
+                          timeout=560)
+    rec = json.loads([l for l in proc.stdout.splitlines()
+                      if l.startswith("{")][-1])
+    assert rec["fused_step"] is True and rec["value"] > 0
+    assert "partial" not in rec, rec
+
+    # bench-level fused choice: a failure falls back to the standard step
+    proc = subprocess.run([sys.executable, os.path.join(REPO, "bench.py")],
+                          env={**env, "MXT_BENCH_FAIL_FUSED_ONCE": "1"},
+                          capture_output=True, text=True, timeout=560)
+    rec = json.loads([l for l in proc.stdout.splitlines()
+                      if l.startswith("{")][-1])
+    assert rec["fused_step"] is False and rec["value"] > 0
+    assert "fell back" in rec.get("error", ""), rec
+    assert "partial" not in rec, rec
+
+    # PINNED path (the chip-window A/B leg): same failure must surface
+    # as a partial/error, never a silently-standard number
+    env_pin = {**env, "MXNET_FUSED_STEP": "1",
+               "MXT_BENCH_FAIL_FUSED_ONCE": "1"}
+    env_pin.pop("MXT_BENCH_FUSED")
+    proc = subprocess.run([sys.executable, os.path.join(REPO, "bench.py")],
+                          env=env_pin, capture_output=True, text=True,
+                          timeout=560)
+    rec = json.loads([l for l in proc.stdout.splitlines()
+                      if l.startswith("{")][-1])
+    assert rec.get("partial") and "injected" in rec.get("error", ""), rec

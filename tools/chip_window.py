@@ -249,9 +249,12 @@ def main():
                     pass
             return None
 
+        # pin both legs explicitly: bench.py now AUTO-enables the fused
+        # step on TPU, so the A/B's default leg must force it off
         SUMMARY["bench"] = _bench_json(
             _run("bench", [sys.executable, "bench.py"],
-                 args.step_timeout, summary_path, env=env))
+                 args.step_timeout, summary_path,
+                 env={**env, "MXNET_FUSED_STEP": "0"}))
         # A/B: the single-donated-program train step (MXNET_FUSED_STEP)
         SUMMARY["bench_fused"] = _bench_json(
             _run("bench_fused", [sys.executable, "bench.py"],
