@@ -354,3 +354,46 @@ def test_device_augment_consistency():
                                        rtol=TOL, atol=TOL)
             n += 1
         assert n == 2, n  # 8 records / batch 4 — no vacuous pass
+
+
+def test_csr_dot_consistency():
+    """The eager CSR-dot nnz kernels (searchsorted row-ids + gather +
+    scatter-add, ndarray/sparse.py:_csr_mm/_csr_t_rows) produce the same
+    forward values and rows-only gradients on the accelerator as on CPU
+    — these lower to dynamic-gather/scatter HLOs no other case covers."""
+    import os as _os
+    from mxnet_tpu import autograd
+    from mxnet_tpu.ndarray.sparse import csr_matrix, RowSparseNDArray
+    rs = np.random.RandomState(0)
+    dense = (rs.rand(9, 30) * (rs.rand(9, 30) < 0.15)).astype("f")
+    wv = rs.normal(0, 1, (30, 4)).astype("f")
+    dv = rs.normal(0, 1, (9, 4)).astype("f")
+    prev = _os.environ.get("MXNET_SPARSE_DOT")
+    _os.environ["MXNET_SPARSE_DOT"] = "nnz"
+    try:
+        outs = []
+        for ctx in (mx.cpu(), _accel()):
+            with mx.Context(ctx):
+                csr = csr_matrix(mx.nd.array(dense, ctx=ctx))
+                w = mx.nd.array(wv, ctx=ctx)
+                g = mx.nd.zeros((30, 4), ctx=ctx)
+                autograd.mark_variables([w], [g])
+                with autograd.record():
+                    y = mx.nd.dot(csr, w)
+                autograd.backward([y])
+                yt = mx.nd.dot(csr, mx.nd.array(dv, ctx=ctx),
+                               transpose_a=True)
+                assert isinstance(yt, RowSparseNDArray)
+                outs.append((y.asnumpy(), g.asnumpy(),
+                             np.asarray(yt._indices),
+                             np.asarray(yt._values)))
+        (y0, g0, i0, v0), (y1, g1, i1, v1) = outs
+        np.testing.assert_allclose(y0, y1, rtol=TOL, atol=TOL)
+        np.testing.assert_allclose(g0, g1, rtol=TOL, atol=TOL)
+        np.testing.assert_array_equal(i0, i1)
+        np.testing.assert_allclose(v0, v1, rtol=TOL, atol=TOL)
+    finally:
+        if prev is None:
+            _os.environ.pop("MXNET_SPARSE_DOT", None)
+        else:
+            _os.environ["MXNET_SPARSE_DOT"] = prev

@@ -135,6 +135,7 @@ def main():
             return rep.get("max_err")
         cases.append((name, op_case))
     for fname in ("test_fc_grad_consistency",
+                  "test_csr_dot_consistency",
                   "test_resnet50_fwd_bwd_consistency",
                   "test_gluon_lstm_consistency",
                   "test_transformer_lm_consistency",
