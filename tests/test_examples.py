@@ -158,7 +158,7 @@ def test_consistency_runner_artifact(tmp_path):
     # symbol cases carry max_err; function cases (\*_consistency, pulled
     # in here by the "dot" substring match) are pass/fail only
     assert all("max_err" in c for c in doc["cases"]
-               if not c["name"].endswith("_consistency"))
+               if not c["case"].endswith("_consistency"))
     # watchdog trip: impossible budget -> hang record, artifact valid, rc 0
     proc = subprocess.run(
         [sys.executable, os.path.join(REPO, "tools/run_tpu_consistency.py"),
