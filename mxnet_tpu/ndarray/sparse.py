@@ -220,12 +220,25 @@ class RowSparseNDArray(BaseSparseNDArray):
                 f"({self._indices.shape[0]} rows) @{self._ctx}>")
 
 
+def _host_row_ids(indptr_np, n_rows):
+    """Per-nonzero row id from host indptr fenceposts (the one shared
+    expansion — device-side twin: _csr_row_ids)."""
+    return _np.repeat(_np.arange(n_rows), _np.diff(indptr_np))
+
+
 class CSRNDArray(BaseSparseNDArray):
     """2-D (M, N) compressed-sparse-row; nnz-only storage, lazy dense."""
 
-    __slots__ = ("_indptr", "_indices_c", "_values", "_shape")
+    __slots__ = ("_indptr", "_indices_c", "_values", "_shape",
+                 "_host_triplet")
 
     def __init__(self, data, indptr, indices, shape, ctx=None):
+        # batches built from host data (LibSVMIter) keep the numpy
+        # triplet so the copyto feed path never downloads device arrays
+        # just to re-upload them padded
+        self._host_triplet = (data, indptr, indices) if all(
+            isinstance(a, _np.ndarray) for a in (data, indptr, indices)) \
+            else None
         self._indptr = jnp.asarray(indptr, jnp.int64)
         self._indices_c = jnp.asarray(indices, jnp.int64)
         self._values = jnp.asarray(data)
@@ -240,8 +253,7 @@ class CSRNDArray(BaseSparseNDArray):
     @property
     def _data(self):
         """Lazy dense view (uncached)."""
-        counts = _np.diff(_np.asarray(self._indptr))
-        rows = _np.repeat(_np.arange(self._shape[0]), counts)
+        rows = _host_row_ids(_np.asarray(self._indptr), self._shape[0])
         return jnp.zeros(self._shape, self._values.dtype).at[
             jnp.asarray(rows), self._indices_c].add(self._values)
 
@@ -289,6 +301,49 @@ class CSRNDArray(BaseSparseNDArray):
         if stype == "default":
             return NDArray(self._data, self._ctx)
         raise MXNetError(f"cannot convert csr to {stype}")
+
+    def copyto(self, other):
+        """Feed a dense buffer from csr storage with an O(nnz) transfer:
+        upload the nnz triplet (values, row-ids, cols — padded to a
+        power-of-two bucket so recompiles stay bounded) and scatter to
+        dense ON THE TARGET DEVICE.  This is the Module batch-feed path
+        for LibSVM-style csr data (`_load_arg` -> `arr.copyto(tgt)`):
+        through a thin host<->device link the dense upload is O(B·F)
+        while the batch's information is O(nnz) — same lever as
+        ImageRecordIter(device_augment=True).  Mesh-sharded targets and
+        non-dense destinations keep the base dense behavior."""
+        from ..context import Context
+        if isinstance(other, Context) or isinstance(other, BaseSparseNDArray) \
+                or getattr(other, "ndim", None) is None \
+                or tuple(other.shape) != self._shape \
+                or getattr(other._data, "sharding", None) is not None \
+                and len(other._data.sharding.device_set) > 1:
+            return NDArray.copyto(self, other)
+        nnz = int(self._values.shape[0])
+        bucket = max(16, 1 << (nnz - 1).bit_length()) if nnz else 16
+        vals = _np.zeros(bucket, _np.dtype(self._values.dtype))
+        rows = _np.zeros(bucket, _np.int32)
+        cols = _np.zeros(bucket, _np.int32)
+        if nnz:
+            if self._host_triplet is not None:
+                hvals, hindptr, hcols = self._host_triplet
+            else:  # device-built csr: one download of the O(nnz) triplet
+                hvals, hindptr, hcols = (_np.asarray(self._values),
+                                         _np.asarray(self._indptr),
+                                         _np.asarray(self._indices_c))
+            vals[:nnz] = hvals
+            rows[:nnz] = _host_row_ids(hindptr,
+                                       self._shape[0]).astype(_np.int32)
+            cols[:nnz] = hcols
+        dev = other._data.devices().pop() if hasattr(other._data, "devices") \
+            else None
+        put = (lambda a: _jax.device_put(a, dev)) if dev is not None \
+            else jnp.asarray
+        dense = _csr_scatter_dense(put(vals), put(rows), put(cols),
+                                   self._shape,
+                                   _np.dtype(other.dtype).name)
+        other._set_data(dense)
+        return other
 
     def __repr__(self):
         return (f"\n<CSRNDArray {'x'.join(map(str, self._shape))} "
@@ -405,6 +460,15 @@ def dot(lhs, rhs, transpose_a=False, transpose_b=False):
 # O(M·K·N): per-nonzero gather of the dense rows, scaled, scatter-added
 # — the dense (M,K) form of the csr operand never exists.
 # ---------------------------------------------------------------------------
+@_functools.partial(_jax.jit, static_argnums=(3, 4))
+def _csr_scatter_dense(vals, rows, cols, shape, dtype):
+    """Padded nnz triplet -> dense, on whatever device the inputs live
+    (CSRNDArray.copyto's O(nnz)-transfer feed).  Pad slots carry value
+    0 at (0, 0) — additive no-ops."""
+    return jnp.zeros(shape, dtype).at[rows, cols].add(
+        vals.astype(dtype))
+
+
 def _csr_row_ids(indptr, nnz):
     """Per-nonzero row id from the indptr fenceposts (device, jittable)."""
     return jnp.searchsorted(indptr, jnp.arange(nnz, dtype=indptr.dtype),

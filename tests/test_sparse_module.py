@@ -197,6 +197,8 @@ def test_sparse_grad_removes_vocab_buffer_from_xla_peak():
     sparse_mod = _build(sparse_grad=True)
     d = dense_mod._exec.memory_analysis(train=True)
     s = sparse_mod._exec.memory_analysis(train=True)
+    if not d or not s:
+        pytest.skip("backend reports no memory analysis (older PJRT)")
     vocab_bytes = VOCAB * DIM * 4
     # the dense path EMITS the (vocab, dim) grad (output_bytes) and
     # holds it at peak; the sparse program's outputs are O(tokens)
