@@ -44,6 +44,10 @@ EPOCHS = 3  # epoch 0 compiles+warms; epochs 1..2 are timed
 # per-phase watchdog budgets (seconds); generous but finite — the round-2
 # failure mode was a backend call that never returned
 PROBE_S = float(os.environ.get("MXT_BENCH_PROBE_S", 240))
+# one backend-contact attempt inside the probe budget (each runs in a
+# subprocess: a dead tunnel HANGS rather than errors, so in-process
+# retries would never get a second chance)
+PROBE_TRY_S = float(os.environ.get("MXT_BENCH_PROBE_TRY_S", 55))
 SETUP_S = float(os.environ.get("MXT_BENCH_SETUP_S", 420))
 COMPILE_S = float(os.environ.get("MXT_BENCH_COMPILE_S", 900))
 EPOCH_S = float(os.environ.get("MXT_BENCH_EPOCH_S", 420))
@@ -57,6 +61,11 @@ def _emit(partial):
     v = _STATE["img_s"] or 0.0
     out = {"metric": "resnet50_train_throughput", "value": round(v, 2),
            "unit": "img/s", "vs_baseline": round(v / BASELINE_IMG_S, 2)}
+    if v and _STATE.get("chip") is not None:
+        # MFU is the north-star axis (BASELINE.md: >=60%); report it
+        # next to img/s so the scoring artifact carries it first-class
+        from mxnet_tpu.chip import mfu
+        out.update(mfu(v, kind=_STATE["chip"]))
     if "fused_step" in _STATE:
         out["fused_step"] = _STATE["fused_step"]
     if partial:
@@ -80,9 +89,53 @@ def _run():
     from mxnet_tpu.io import DataDesc
 
     _phase("device_probe", PROBE_S)
-    # first real backend contact: hangs here == unreachable tunnel
-    on_tpu = bool(mx.context.num_tpus())
+    # First real backend contact: hangs here == unreachable tunnel.
+    # VERDICT r4 weak #1: a single attempt let one transient outage
+    # minute zero three consecutive rounds' official bench.  Probe in
+    # SUBPROCESSES (a dead tunnel hangs, so an in-process retry never
+    # gets a second chance) and retry until the budget is spent.
+    import subprocess
+    # import mxnet_tpu first: it applies the cpu-only guard (base.py),
+    # without which a JAX_PLATFORMS=cpu run still contacts the tunnel
+    snippet = ("import mxnet_tpu, jax; d = jax.devices()[0]; "
+               "print(d.platform + '|' + str(getattr(d, 'device_kind', '')))")
+    deadline = time.monotonic() + PROBE_S - 5
+    plat, kind, attempts = None, "", 0
+    try_s = PROBE_TRY_S
+    while True:
+        attempts += 1
+        # escalating per-attempt timeout (55 -> 110 -> residue): a
+        # healthy-but-SLOW first contact (~90s cold tunnel) must not be
+        # starved by the retry slicing — the old single-attempt design
+        # gave it the whole 240s budget
+        budget = min(try_s, max(5.0, deadline - time.monotonic()))
+        try:
+            r = subprocess.run(
+                [sys.executable, "-c", snippet], timeout=budget,
+                capture_output=True, text=True,
+                cwd=os.path.dirname(os.path.abspath(__file__)))
+            if r.returncode == 0 and r.stdout.strip():
+                plat, _, kind = r.stdout.strip().splitlines()[-1].partition("|")
+                break
+        except subprocess.TimeoutExpired:
+            pass
+        if time.monotonic() >= deadline - 5:
+            break
+        try_s *= 2
+        print("bench: device probe attempt %d failed; retrying (next "
+              "timeout %.0fs)" % (attempts, try_s),
+              file=sys.stderr, flush=True)
+    _STATE["probe_attempts"] = attempts
+    # the tunnel answered a subprocess (or CI runs on cpu): in-process
+    # first contact now, under a FRESH watchdog budget (the retry loop
+    # may have consumed most of the probe phase; a successful probe has
+    # earned the attach its own time slice)
+    if plat is not None:
+        _phase("device_attach", PROBE_S)
+    on_tpu = bool(mx.context.num_tpus()) if plat != "cpu" else False
     ctx = mx.tpu() if on_tpu else mx.cpu()
+    from mxnet_tpu.chip import device_kind
+    _STATE["chip"] = kind or device_kind()
 
     _phase("build", SETUP_S)
     net = vision.resnet50_v1()

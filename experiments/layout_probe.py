@@ -164,5 +164,12 @@ if __name__ == "__main__":
     ap.add_argument("--batch", type=int, default=256)
     a = ap.parse_args()
     r = run(a.layout, a.bn, a.resident, a.batch)
+    from mxnet_tpu.chip import mfu
+    m = mfu(r)
+    if m["mfu"] is not None:
+        tail = f"{m['mfu']*100:.1f}% MFU on {m['chip']}"
+    else:
+        tail = (f"~{m['mfu_if_v5e']*100:.0f}% MFU v5e-class / "
+                f"~{m['mfu_if_v5p']*100:.0f}% v5p-class ({m['chip']!r})")
     print(f"layout={a.layout} bn={a.bn} resident={a.resident} batch={a.batch}: "
-          f"{r:.1f} img/s  (~{r*24.6e9/197e12*100:.0f}% MFU v5e)")
+          f"{r:.1f} img/s  ({tail})")

@@ -1,6 +1,14 @@
 """Phase-level timing of the Module.fit hot path on the real chip:
-forward_backward vs update vs metric, to find where the 100 img/s
-collapse comes from."""
+forward_backward vs update vs metric, to find where the throughput goes.
+
+Timing hygiene (VERDICT r4 weak #3 — PROFILE_r04.txt showed phases
+SPEEDING UP as work was added, 50 -> 528 img/s, which is impossible):
+each phase body can trigger a fresh XLA compile on its first iteration
+(fb-without-update is a different program variant than the warmed
+fb+update), so every phase now runs its OWN untimed warmup iterations,
+force-drains the async queue (scalar materialization — block_until_ready
+is a no-op under the axon tunnel), and only then times N iterations
+ending in another drain.  Phase timings are monotone by construction."""
 import os
 import sys
 import time
@@ -13,7 +21,7 @@ from mxnet_tpu.gluon.model_zoo import vision
 from mxnet_tpu.io import DataDesc
 
 BATCH = int(os.environ.get("B", 256))
-IMG = 224
+IMG = int(os.environ.get("IMG", 224))  # CPU smoke runs set IMG=64
 
 
 def sync(x):
@@ -44,56 +52,70 @@ def main():
     from mxnet_tpu.io import DataBatch
     batch = DataBatch(data=[data], label=[label], pad=0, index=None)
 
-    # warm up (compile), then drain the async queue: the r04 window
-    # showed phase-1 timings absorbing leftover compile/dispatch tail
-    # (PROFILE_r04.txt's 5169 ms/step "fb" was warmup contamination)
+    def drain():
+        """Force the dispatched queue to retire: materialize one scalar
+        from the last output AND one parameter (covers both the fb
+        program and the update program's write-backs)."""
+        sync(mod.get_outputs()[0])
+        sync(next(iter(mod._exec.arg_dict.values())))
+
+    def timed(name, body, n, warmup=2):
+        """Per-phase warmup (absorbs any variant compile) -> drain ->
+        timed n iterations -> drain.  Returns s/step."""
+        t = time.perf_counter()
+        for _ in range(warmup):
+            body()
+        drain()
+        wu = time.perf_counter() - t
+        t = time.perf_counter()
+        for _ in range(n):
+            body()
+        drain()
+        per = (time.perf_counter() - t) / n
+        print(f"{name:<18} {per*1e3:8.1f} ms/step  ({BATCH/per:6.0f} img/s)"
+              f"   [warmup {wu:.1f}s]", flush=True)
+        return per
+
     t = time.perf_counter()
     mod.forward_backward(batch)
     mod.update()
-    sync(mod.get_outputs()[0])
+    drain()
     print(f"compile+first step: {time.perf_counter()-t:.1f}s", flush=True)
-    for _ in range(6):
-        mod.forward_backward(batch)
-        mod.update()
-    sync(mod.get_outputs()[0])
-    sync(next(iter(mod._exec.arg_dict.values())))
 
     # 12 steps/phase keeps the whole probe ~3 min after compile — r04g's
     # N=30 run outlived its degraded-tunnel window at the 900s budget
     N = int(os.environ.get("N", 12))
-    # phase 1: forward_backward only
-    t = time.perf_counter()
-    for _ in range(N):
-        mod.forward_backward(batch)
-    sync(mod.get_outputs()[0])
-    fb = (time.perf_counter() - t) / N
-    print(f"forward_backward: {fb*1e3:.1f} ms/step "
-          f"({BATCH/fb:.0f} img/s)", flush=True)
 
-    # phase 2: fb + update
-    t = time.perf_counter()
-    for _ in range(N):
+    def fb_only():
+        mod.forward_backward(batch)
+
+    def fb_update():
         mod.forward_backward(batch)
         mod.update()
-    sync(mod.get_outputs()[0])
-    sync(next(iter(mod._exec.arg_dict.values())))
-    fbu = (time.perf_counter() - t) / N
-    print(f"fb+update:        {fbu*1e3:.1f} ms/step "
-          f"({BATCH/fbu:.0f} img/s)", flush=True)
 
-    # phase 3: fb + update + metric (the bench's LossMetric ops)
-    t = time.perf_counter()
     vals = []
-    for _ in range(N):
+
+    def fb_update_metric():
         mod.forward_backward(batch)
         mod.update()
         preds = mod.get_outputs()[0]
         picked = mx.nd.pick(preds.astype(np.float32), label, axis=1)
         vals.append(0.0 - mx.nd.log(picked + 1e-8).mean())
+
+    fb = timed("forward_backward:", fb_only, N)
+    fbu = timed("fb+update:", fb_update, N)
+    fbm = timed("fb+update+metric:", fb_update_metric, N)
     sync(vals[-1])
-    fbm = (time.perf_counter() - t) / N
-    print(f"fb+update+metric: {fbm*1e3:.1f} ms/step "
-          f"({BATCH/fbm:.0f} img/s)", flush=True)
+    # the invariant the r04 artifact violated — fail loudly, not quietly
+    if not (fbm >= fbu * 0.95 and fbu >= fb * 0.95):
+        print(f"WARNING: non-monotone phases (fb={fb*1e3:.1f} "
+              f"fbu={fbu*1e3:.1f} fbm={fbm*1e3:.1f} ms) — timings "
+              f"are dispatch artifacts, do not publish", flush=True)
+    from mxnet_tpu.chip import mfu
+    m = mfu(BATCH / fbu)
+    if m.get("mfu") is not None:
+        print(f"fb+update MFU: {m['mfu']*100:.1f}% on {m['chip']}",
+              flush=True)
 
     # phase 4: dispatch-count probe — how many device calls does update() do?
     import jax

@@ -154,10 +154,11 @@ def main():
     ap.add_argument("--probe-timeout", type=float, default=120.0)
     ap.add_argument("--step-timeout", type=float, default=900.0)
     ap.add_argument("--batch", type=int, default=256)
-    ap.add_argument("--steps", default="consistency,layout,nhwc,bench,"
-                    "score,profile,fusedprobe",
+    ap.add_argument("--steps", default="bench,score,consistency,layout,"
+                    "nhwc,benchnhwc,r01cfg,profile,fusedprobe",
                     help="which steps to run, in this fixed order "
-                         "(bench/score before the profile diagnostics) — "
+                         "(VERDICT r4 #2: the first minutes of any window "
+                         "belong to the bench; diagnostics after) — "
                          "lets a re-armed poller skip artifacts already "
                          "harvested in an earlier window this round")
     ap.add_argument("--conv-layout", default=None,
@@ -173,7 +174,7 @@ def main():
     args = ap.parse_args()
     steps = {s.strip() for s in args.steps.split(",") if s.strip()}
     known = {"consistency", "layout", "nhwc", "profile", "fusedprobe",
-             "bench", "score"}
+             "bench", "score", "benchnhwc", "r01cfg"}
     if steps - known:
         # a typo must not silently skip a step a rare window exists for
         ap.error(f"unknown --steps {sorted(steps - known)}; "
@@ -211,63 +212,43 @@ def main():
     _write_summary(summary_path)
     print(f"WINDOW OPEN: {plat}", flush=True)
 
-    # 1. correctness first — the artifact no round has ever produced
-    if "consistency" in steps:
-        cmd = [sys.executable, "tools/run_tpu_consistency.py",
-               "--out", os.path.join(REPO, f"CONSISTENCY_{tag}.json")]
-        if args.consistency_subset:
-            cmd += ["--only", args.consistency_subset]
-        _run("consistency", cmd, args.step_timeout * 2, summary_path)
+    def _bench_json(rec):
+        m = re.search(r"(\{.*\})", rec.get("tail", ""))
+        if m:
+            try:
+                return json.loads(m.group(1))
+            except ValueError:
+                pass
+        return None
 
-    # 2. layout/precision A/B (raw JAX ceiling probe)
-    winner = (layout_ab(summary_path, args.batch, args.step_timeout)
-              if "layout" in steps else None)
+    bench_doc = {}
 
-    # 3. the framework's own NHWC lowering, on-chip, resnet-path subset
-    if "nhwc" in steps:
-        _run("consistency_nhwc",
-             [sys.executable, "tools/run_tpu_consistency.py",
-              "--layout", "NHWC", "--only", "conv,pool,batchnorm,resnet",
-              "--out", os.path.join(REPO, f"CONSISTENCY_{tag}_nhwc.json")],
-             args.step_timeout, summary_path)
+    def _write_bench_window():
+        with open(os.path.join(REPO, f"BENCH_WINDOW_{tag}.json"), "w") as f:
+            json.dump(bench_doc, f, indent=1)
 
-    # 4. the product-path bench under the winning config (runs BEFORE the
-    # diagnostic steps: windows close without warning — r04g lost its
-    # bench to a 15-minute profile_fit that the window outlived)
+    # 1. THE BENCH FIRST (VERDICT r4 #2: three rounds shipped 0.0 while
+    # diagnostics ate the window — the headline number now owns the
+    # first minutes; windows close without warning)
     env = {}
     if args.conv_layout:
         env["MXNET_TPU_CONV_LAYOUT"] = args.conv_layout
-    elif winner and winner["img_s"] > 0 and winner["layout"] == "NHWC":
-        env["MXNET_TPU_CONV_LAYOUT"] = "NHWC"
     if "bench" in steps:
-        def _bench_json(rec):
-            m = re.search(r"(\{.*\})", rec.get("tail", ""))
-            if m:
-                try:
-                    return json.loads(m.group(1))
-                except ValueError:
-                    pass
-            return None
-
-        # pin both legs explicitly: bench.py now AUTO-enables the fused
+        # pin both legs explicitly: bench.py AUTO-enables the fused
         # step on TPU, so the A/B's default leg must force it off
-        SUMMARY["bench"] = _bench_json(
+        SUMMARY["bench"] = bench_doc["default"] = _bench_json(
             _run("bench", [sys.executable, "bench.py"],
                  args.step_timeout, summary_path,
                  env={**env, "MXNET_FUSED_STEP": "0"}))
+        _write_bench_window()
         # A/B: the single-donated-program train step (MXNET_FUSED_STEP)
-        SUMMARY["bench_fused"] = _bench_json(
+        SUMMARY["bench_fused"] = bench_doc["fused_step"] = _bench_json(
             _run("bench_fused", [sys.executable, "bench.py"],
                  args.step_timeout, summary_path,
                  env={**env, "MXNET_FUSED_STEP": "1"}))
-        # ONE schema regardless of which legs parsed
-        with open(os.path.join(REPO, f"BENCH_WINDOW_{tag}.json"),
-                  "w") as f:
-            json.dump({"default": SUMMARY["bench"],
-                       "fused_step": SUMMARY["bench_fused"]},
-                      f, indent=1)
+        _write_bench_window()
 
-    # 5. zoo inference throughput (reference benchmark_score parity)
+    # 2. zoo inference throughput (reference benchmark_score parity)
     if "score" in steps:
         _run("benchmark_score",
              [sys.executable,
@@ -277,7 +258,48 @@ def main():
              args.step_timeout, summary_path, env=env,
              capture_to=f"SCORE_{tag}.txt")
 
-    # 6. diagnostics, cheapest-to-lose last: where does fit() time go
+    # 3. correctness tier
+    if "consistency" in steps:
+        cmd = [sys.executable, "tools/run_tpu_consistency.py",
+               "--out", os.path.join(REPO, f"CONSISTENCY_{tag}.json")]
+        if args.consistency_subset:
+            cmd += ["--only", args.consistency_subset]
+        _run("consistency", cmd, args.step_timeout * 2, summary_path)
+
+    # 4. layout/precision A/B (raw JAX ceiling probe)
+    winner = (layout_ab(summary_path, args.batch, args.step_timeout)
+              if "layout" in steps else None)
+
+    # 5. the framework's own NHWC lowering, on-chip, resnet-path subset
+    if "nhwc" in steps:
+        _run("consistency_nhwc",
+             [sys.executable, "tools/run_tpu_consistency.py",
+              "--layout", "NHWC", "--only", "conv,pool,batchnorm,resnet",
+              "--out", os.path.join(REPO, f"CONSISTENCY_{tag}_nhwc.json")],
+             args.step_timeout, summary_path)
+
+    # 6. if the raw probe says NHWC wins and the step-1 bench did not
+    # already run NHWC, measure the product path under it (fused leg) —
+    # the framework-vs-raw layout question needs both points on-chip
+    if "benchnhwc" in steps and args.conv_layout != "NHWC" and (
+            winner is None or
+            (winner["img_s"] > 0 and winner["layout"] == "NHWC")):
+        SUMMARY["bench_nhwc"] = bench_doc["nhwc_fused"] = _bench_json(
+            _run("bench_nhwc", [sys.executable, "bench.py"],
+                 args.step_timeout, summary_path,
+                 env={"MXNET_TPU_CONV_LAYOUT": "NHWC",
+                      "MXNET_FUSED_STEP": "1"}))
+        _write_bench_window()
+
+    # 7. r01-vs-now reconciliation (VERDICT r4 weak #7): the thin
+    # hand-jitted GraphPlan step r01 measured, on today's stack
+    if "r01cfg" in steps:
+        SUMMARY["r01cfg"] = _bench_json(
+            _run("bench_r01_config",
+                 [sys.executable, "experiments/bench_r01_config.py"],
+                 args.step_timeout, summary_path))
+
+    # 8. diagnostics, cheapest-to-lose last: where does fit() time go
     if "profile" in steps:
         _run("profile_fit",
              [sys.executable, "experiments/profile_fit.py"],
@@ -285,7 +307,7 @@ def main():
              env={"B": str(args.batch)},
              capture_to=f"PROFILE_{tag}.txt")
 
-    # 6b. would a single fused donated train-step close the gap?
+    # 8b. would a single fused donated train-step close the gap?
     if "fusedprobe" in steps:
         _run("fused_step_probe",
              [sys.executable, "experiments/fused_step_probe.py"],
