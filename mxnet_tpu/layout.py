@@ -54,6 +54,14 @@ def channels_last() -> bool:
     return _LAYOUT == "NHWC"
 
 
+def whole_graph() -> bool:
+    """Whether NHWC mode uses the GraphPlan-level propagation pass
+    (transposes only at true graph edges — VERDICT r4 #1b) instead of
+    per-op boundary transposes.  Default on; MXNET_TPU_CL_WHOLEGRAPH=0
+    pins the old per-op mode for A/B runs."""
+    return os.environ.get("MXNET_TPU_CL_WHOLEGRAPH", "1") != "0"
+
+
 def to_cl(x):
     """NC[spatial] → N[spatial]C (no-op for rank<3)."""
     if x.ndim < 3:

@@ -91,11 +91,18 @@ def _convolution(p, data, weight, bias=None):
     """
     k = p["kernel"]
     n = len(k)
-    cl = _layout.channels_last() and data.ndim == n + 2
+    # __io_layout__ == "NHWC": GraphPlan's whole-graph layout pass says
+    # the data input is ALREADY channels-last and the consumer wants a
+    # channels-last output — no boundary transposes here (they exist
+    # only at true graph edges).  Without it, the per-op global-flag
+    # behavior stands (eager mx.nd.* calls).
+    pre_cl = p.get("__io_layout__") == "NHWC"
+    cl = pre_cl or (_layout.channels_last() and data.ndim == n + 2)
     if cl:
         # NCHW semantics, channels-last compute: boundary transposes
         # cancel pairwise across conv→BN→relu→conv chains (layout.py)
-        data = _layout.to_cl(data)
+        if not pre_cl:
+            data = _layout.to_cl(data)
         weight = _w_to_cl(weight, n)
     dn = lax.conv_dimension_numbers(
         data.shape, weight.shape, _conv_dims_cl(k) if cl else _conv_dims(k))
@@ -113,7 +120,7 @@ def _convolution(p, data, weight, bias=None):
     )
     if not p["no_bias"]:
         out = out + (bias if cl else bias.reshape((1, -1) + (1,) * n))
-    return _layout.from_cl(out) if cl else out
+    return out if pre_cl else (_layout.from_cl(out) if cl else out)
 
 
 @register("Deconvolution", input_names=("data", "weight", "bias"),
@@ -142,9 +149,11 @@ def _deconvolution(p, data, weight, bias=None):
         w = w.reshape((-1,) + w.shape[2:])
     else:
         w = jnp.swapaxes(w, 0, 1)
-    cl = _layout.channels_last() and data.ndim == n + 2
+    pre_cl = p.get("__io_layout__") == "NHWC"
+    cl = pre_cl or (_layout.channels_last() and data.ndim == n + 2)
     if cl:
-        data = _layout.to_cl(data)
+        if not pre_cl:
+            data = _layout.to_cl(data)
         w = _w_to_cl(w, n)
     dn = lax.conv_dimension_numbers(
         data.shape, w.shape, _conv_dims_cl(k) if cl else _conv_dims(k))
@@ -158,7 +167,7 @@ def _deconvolution(p, data, weight, bias=None):
         feature_group_count=p["num_group"])
     if not p["no_bias"] and bias is not None:
         out = out + (bias if cl else bias.reshape((1, -1) + (1,) * n))
-    return _layout.from_cl(out) if cl else out
+    return out if pre_cl else (_layout.from_cl(out) if cl else out)
 
 
 # ---------------------------------------------------------------------------
@@ -171,14 +180,16 @@ def _deconvolution(p, data, weight, bias=None):
                 Arg("cudnn_off", bool, False)])
 def _pooling(p, x):
     n = x.ndim - 2
+    pre_cl = p.get("__io_layout__") == "NHWC"
     if p["global_pool"]:
-        axes = tuple(range(2, x.ndim))
+        axes = (tuple(range(1, x.ndim - 1)) if pre_cl
+                else tuple(range(2, x.ndim)))
         red = jnp.max if p["pool_type"] == "max" else jnp.mean
         if p["pool_type"] == "sum":
             red = jnp.sum
         return red(x, axis=axes, keepdims=True)
-    cl = _layout.channels_last() and x.ndim >= 3
-    if cl:
+    cl = pre_cl or (_layout.channels_last() and x.ndim >= 3)
+    if cl and not pre_cl:
         x = _layout.to_cl(x)
     sp = 1 if cl else 2  # first spatial axis
     k = _tup(p["kernel"], n)
@@ -203,7 +214,7 @@ def _pooling(p, x):
         padding = ((0, 0), (0, 0)) + tuple(lo_hi)
     out = _pool_impl(p, x, n, sp, k, stride, lo_hi, window, strides,
                      padding, cl)
-    return _layout.from_cl(out) if cl else out
+    return out if pre_cl else (_layout.from_cl(out) if cl else out)
 
 
 def _pool_impl(p, x, n, sp, k, stride, lo_hi, window, strides, padding, cl):
@@ -282,11 +293,13 @@ def _batch_norm(p, x, gamma, beta, mov_mean, mov_var):
     moving_var) which the runtime writes back into the aux NDArrays.
     """
     ax = p["axis"] % x.ndim
-    cl = _layout.channels_last() and ax == 1 and x.ndim >= 3
+    pre_cl = p.get("__io_layout__") == "NHWC"  # logical axis 1, already CL
+    cl = pre_cl or (_layout.channels_last() and ax == 1 and x.ndim >= 3)
     if cl:
         # channels-last compute: the normalize chain stays in the same
         # layout as the surrounding convs (boundary transposes cancel)
-        x = _layout.to_cl(x)
+        if not pre_cl:
+            x = _layout.to_cl(x)
         ax = x.ndim - 1
     red = tuple(i for i in range(x.ndim) if i != ax)
     bshape = tuple(x.shape[ax] if i == ax else 1 for i in range(x.ndim))
@@ -309,7 +322,7 @@ def _batch_norm(p, x, gamma, beta, mov_mean, mov_var):
         inv_std.reshape(bshape).astype(x.dtype)) * \
         g.reshape(bshape).astype(x.dtype) + \
         beta.reshape(bshape).astype(x.dtype)
-    if cl:
+    if cl and not pre_cl:
         out = _layout.from_cl(out)
     return (out, mean.astype(x.dtype), var.astype(x.dtype),
             lax.stop_gradient(new_mm), lax.stop_gradient(new_mv))

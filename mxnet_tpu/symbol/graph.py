@@ -17,9 +17,32 @@ import jax.numpy as jnp
 import numpy as _np
 
 from ..base import MXNetError, np_dtype
+from .. import layout as _layout
 from ..ops import registry as _reg
+from ..ops.elemwise import _BINARY as _EW_BINARY, _SCALAR as _EW_SCALAR, \
+    _UNARY as _EW_UNARY
 from ..ops.sequence import rnn_param_size, _GATES
 from .symbol import Symbol, _Node, _truthy
+
+
+# -- whole-graph channels-last propagation (VERDICT r4 #1b) -----------------
+# Per-op boundary transposes (layout.py to_cl/from_cl inside each spatial
+# op) measured SLOWER than NCHW on-chip (LAYOUT_r04: framework NHWC 1540
+# vs NCHW 1577) even though raw-JAX NHWC wins (1929 vs 1860): XLA does
+# not reliably cancel the transpose pairs across conv→BN→relu→conv
+# chains once bf16 converts/broadcasts sit between them.  This pass
+# moves the layout decision to the GRAPH level: spatial ops exchange
+# channels-last values directly (ops/nn.py `__io_layout__`), elementwise
+# ops pass the tag through, and a real transpose is materialized only
+# where a layout-sensitive consumer (FC, reshape, softmax, ...) or a
+# graph output needs NCHW — i.e. at true graph edges.
+
+# ops that are layout-transparent on their single array input
+_CL_EW_ONE = (set(_EW_UNARY) | set(_EW_SCALAR) |
+              {"Activation", "Dropout", "_copy", "BlockGrad",
+               "make_loss", "clip", "Cast", "smooth_l1"})
+# binary elemwise: transparent when both inputs have the same shape
+_CL_EW_TWO = {"broadcast_" + k for k in _EW_BINARY}
 
 
 def _prod(xs):
@@ -248,6 +271,49 @@ class GraphPlan:
         for si, p in self.init_overrides.items():
             self.steps[si].params.update(p)
 
+    # -- whole-graph channels-last pass --------------------------------
+    def _apply_cl(self, step, ins, in_cl, overridden):
+        """One step of the layout propagation: given resolved inputs and
+        their channels-last tags, return (ins', extra_params, out_cl).
+        out_cl tags OUTPUT 0 only (spatial ops' secondary outputs — BN
+        saved mean/var — are per-channel vectors, never CL)."""
+        name = step.op.name
+        p = step.params
+
+        def demote():
+            return ([_layout.from_cl(v) if f else v
+                     for v, f in zip(ins, in_cl)], None, False)
+
+        if overridden:
+            return demote()
+        x = ins[0] if ins else None
+        nd = getattr(x, "ndim", 0)
+        if name in ("Convolution", "Deconvolution"):
+            if nd == len(p["kernel"]) + 2:
+                out = [_layout.from_cl(v) if f and i else v
+                       for i, (v, f) in enumerate(zip(ins, in_cl))]
+                if not in_cl[0]:
+                    out[0] = _layout.to_cl(x)
+                return out, {"__io_layout__": "NHWC"}, True
+            return demote()
+        if name == "Pooling" and nd >= 3:
+            return ([x if in_cl[0] else _layout.to_cl(x)],
+                    {"__io_layout__": "NHWC"}, True)
+        if name == "BatchNorm" and nd >= 3 and p.get("axis", 1) % nd == 1:
+            out = list(ins)
+            out[0] = x if in_cl[0] else _layout.to_cl(x)
+            return out, {"__io_layout__": "NHWC"}, True
+        if name in _CL_EW_ONE and len(ins) == 1:
+            if name == "LeakyReLU" and p.get("act_type") == "prelu":
+                return demote()
+            return list(ins), None, bool(in_cl[0])
+        if name in _CL_EW_TWO and len(ins) == 2 and any(in_cl):
+            s0, s1 = (getattr(v, "shape", None) for v in ins)
+            if s0 is not None and s0 == s1:
+                return [v if f else _layout.to_cl(v)
+                        for v, f in zip(ins, in_cl)], None, True
+        return demote()
+
     # -- execution (pure; call under jit) -----------------------------------
     def run(self, arg_values: Dict[str, Any], aux_values: Dict[str, Any],
             key, is_train: bool, step_overrides=None, segments: int = 1):
@@ -271,6 +337,8 @@ class GraphPlan:
                                        is_train, int(segments))
         values: List[Tuple] = [None] * len(self.steps)
         new_aux = dict(aux_values)
+        use_cl = _layout.channels_last() and _layout.whole_graph()
+        cl_flags: Dict[tuple, bool] = {}
 
         def resolve(ref):
             if ref[0] == "var":
@@ -283,9 +351,21 @@ class GraphPlan:
             si, oi = ref[1]
             return values[si][oi]
 
+        def cl_of(ref):
+            return ref[0] == "val" and cl_flags.get(ref[1], False)
+
         for si, step in enumerate(self.steps):
             ins = [resolve(r) for r in step.in_refs]
+            if use_cl:
+                ins, extra, out_cl = self._apply_cl(
+                    step, ins, [cl_of(r) for r in step.in_refs],
+                    bool(step_overrides and si in step_overrides))
+                cl_flags[(si, 0)] = out_cl
+            else:
+                extra = None
             p = dict(step.params)
+            if extra:
+                p.update(extra)
             if step.op.takes_is_train:
                 p["__is_train__"] = is_train
             if step.op.needs_rng:
@@ -299,7 +379,8 @@ class GraphPlan:
             values[si] = out[:n_vis]
             for pos, nm in step.aux_var_names.items():
                 new_aux[nm] = out[n_vis + pos]
-        outputs = [resolve(r) for r in self.out_refs]
+        outputs = [_layout.from_cl(resolve(r)) if cl_of(r) else resolve(r)
+                   for r in self.out_refs]
         return outputs, new_aux
 
     def _segment_layout(self, k: int):
@@ -332,6 +413,11 @@ class GraphPlan:
 
     def _run_segmented(self, arg_values, aux_values, key, is_train, k):
         segs = self._segment_layout(k)
+        use_cl = _layout.channels_last() and _layout.whole_graph()
+        # tags persist across segment traces (values crossing a
+        # checkpoint boundary keep their physical layout; the dict is
+        # filled in execution order, segment i before i+1)
+        cl_flags: Dict[tuple, bool] = {}
 
         def make_seg(b0, b1, live_out_keys):
             def seg(args, live_in, aux_in, key_):
@@ -348,10 +434,22 @@ class GraphPlan:
                         raise MXNetError(f"unbound variable '{nm}'")
                     return local[ref[1]]
 
+                def cl_of(ref):
+                    return ref[0] == "val" and cl_flags.get(ref[1], False)
+
                 for si in range(b0, b1):
                     step = self.steps[si]
                     ins = [resolve(r) for r in step.in_refs]
+                    if use_cl:
+                        ins, extra, out_cl = self._apply_cl(
+                            step, ins, [cl_of(r) for r in step.in_refs],
+                            False)
+                        cl_flags[(si, 0)] = out_cl
+                    else:
+                        extra = None
                     p = dict(step.params)
+                    if extra:
+                        p.update(extra)
                     if step.op.takes_is_train:
                         p["__is_train__"] = is_train
                     if step.op.needs_rng:
@@ -375,7 +473,9 @@ class GraphPlan:
             live, aux = make_seg(b0, b1, nxt)(arg_values, live, aux, key)
         outputs = [arg_values[r[1]] if r[0] == "var" and r[1] in arg_values
                    else aux[r[1]] if r[0] == "var"
-                   else live[r[1]] for r in self.out_refs]
+                   else (_layout.from_cl(live[r[1]])
+                         if cl_flags.get(r[1], False) else live[r[1]])
+                   for r in self.out_refs]
         return outputs, aux
 
 
