@@ -173,14 +173,23 @@ def test_legacy_aliases():
 
 
 def test_rand_sparse_powerlaw_and_validation():
+    # reference semantics (test_utils.py:164-210): exponentially
+    # INCREASING per-row occupancy, and no empty rows
     csr, (data, cols, indptr) = tu.rand_sparse_ndarray(
         (16, 32), "csr", density=0.2, distribution="powerlaw")
     per_row = np.diff(indptr)
-    assert per_row[0] >= per_row[-1]  # decaying row occupancy
+    assert (per_row >= 1).all()            # every row seeded
+    assert per_row[1] <= per_row[4]        # occupancy grows down the rows
+    assert (data >= 1.0).all()             # values are 1 + U(0.001, 2)
+    nnz = int(per_row.sum())
+    assert nnz == int(16 * 32 * 0.2)       # exact budget
     with pytest.raises(MXNetError):
         tu.rand_sparse_ndarray((4, 4), "csr", distribution="zipfian")
     with pytest.raises(MXNetError):
         tu.rand_sparse_ndarray((4, 4), "row_sparse",
+                               distribution="powerlaw")
+    with pytest.raises(MXNetError):  # nnz < 2*nrows guard (reference :111)
+        tu.rand_sparse_ndarray((16, 32), "csr", density=0.01,
                                distribution="powerlaw")
 
 
