@@ -18,7 +18,9 @@ $(LIB): $(SRCS) src/runtime/mxt_runtime.h
 # embedded CPython driving mxnet_tpu.predictor.Predictor.
 PY_INC = $(shell python3 -c "import sysconfig; print(sysconfig.get_paths()['include'])")
 PY_LIBDIR = $(shell python3 -c "import sysconfig; print(sysconfig.get_config_var('LIBDIR'))")
-PY_LIB = $(shell python3 -c "import sysconfig; print('python' + sysconfig.get_config_var('VERSION'))")
+# LDVERSION includes ABI flags (e.g. '3.11d' for debug builds) where
+# plain VERSION would link a nonexistent libpython; fall back to VERSION
+PY_LIB = $(shell python3 -c "import sysconfig; print('python' + (sysconfig.get_config_var('LDVERSION') or sysconfig.get_config_var('VERSION')))")
 PRED_LIB := mxnet_tpu/_native/libmxt_predict.so
 
 predict_capi: $(PRED_LIB)

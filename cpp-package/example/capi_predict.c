@@ -22,8 +22,16 @@ static char *read_file(const char *path, long *len) {
   if (!f) return NULL;
   fseek(f, 0, SEEK_END);
   *len = ftell(f);
+  if (*len < 0) { /* ftell failure (e.g. path is a pipe) */
+    fclose(f);
+    return NULL;
+  }
   fseek(f, 0, SEEK_SET);
-  char *buf = (char *)malloc(*len + 1);
+  char *buf = (char *)malloc((size_t)*len + 1);
+  if (!buf) {
+    fclose(f);
+    return NULL;
+  }
   if (fread(buf, 1, *len, f) != (size_t)*len) {
     fclose(f);
     free(buf);
@@ -44,9 +52,12 @@ int main(int argc, char **argv) {
   char *json = read_file(argv[1], &json_len);
   char *raw = read_file(argv[3], &data_len);
   uint32_t n = (uint32_t)atoi(argv[4]), d = (uint32_t)atoi(argv[5]);
-  if (!json || !raw || data_len != (long)(n * d * sizeof(float))) {
-    fprintf(stderr, "bad inputs (data %ld bytes, want %lu)\n", data_len,
-            (unsigned long)(n * d * sizeof(float)));
+  /* widen BEFORE multiplying: n*d in 32-bit wraps for huge N*D and a
+   * wrapped product could pass the size check */
+  uint64_t want = (uint64_t)n * d * sizeof(float);
+  if (!json || !raw || (uint64_t)data_len != want) {
+    fprintf(stderr, "bad inputs (data %ld bytes, want %llu)\n", data_len,
+            (unsigned long long)want);
     return 2;
   }
 
@@ -80,6 +91,11 @@ int main(int argc, char **argv) {
   printf("\n");
 
   float *out = (float *)malloc(total * sizeof(float));
+  if (!out) {
+    fprintf(stderr, "out of memory (%llu floats)\n",
+            (unsigned long long)total);
+    return 1;
+  }
   if (MXTPredGetOutput(h, 0, out, total) != 0) {
     fprintf(stderr, "output failed: %s\n", MXTPredGetLastError());
     return 1;
