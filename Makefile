@@ -25,11 +25,17 @@ PRED_LIB := mxnet_tpu/_native/libmxt_predict.so
 
 predict_capi: $(PRED_LIB)
 
+# the lib re-dlopens libpython RTLD_GLOBAL at init (predict_capi.cc
+# ensure_python) so RTLD_LOCAL hosts (perl/R/JNI bindings) can import
+# python C-extensions; pass the soname the link resolves to
+PY_SONAME = $(shell python3 -c "import sysconfig; print(sysconfig.get_config_var('INSTSONAME') or 'lib' + 'python' + sysconfig.get_config_var('LDVERSION') + '.so')")
+
 $(PRED_LIB): src/runtime/predict_capi.cc src/runtime/mxt_predict.h
 	@mkdir -p mxnet_tpu/_native
 	$(CXX) $(CXXFLAGS) -I$(PY_INC) -shared -o $@ \
+	    -DMXT_LIBPYTHON_SO='"$(PY_SONAME)"' \
 	    src/runtime/predict_capi.cc \
-	    -L$(PY_LIBDIR) -l$(PY_LIB) -Wl,-rpath,$(PY_LIBDIR)
+	    -L$(PY_LIBDIR) -l$(PY_LIB) -ldl -Wl,-rpath,$(PY_LIBDIR)
 
 # C++ consumer of the native runtime (cpp-package analog): predict-only
 # MLP from a python-trained checkpoint, streamed via the batch loader.

@@ -1,0 +1,112 @@
+package AI::MXNetTPU;
+
+# AI::MXNetTPU — Perl binding for the mxnet_tpu inference C ABI.
+#
+# Parity model: the reference's perl-package (AI::MXNet) wraps the full
+# C API; this package carries the predict surface (the workflow of
+# example/image-classification/predict-cpp) over libmxt_predict.so:
+#
+#   my $p = AI::MXNetTPU::Predictor->new(
+#       symbol_file => "model-symbol.json",
+#       param_file  => "model-0001.params",
+#       shapes      => { data => [16, 12] });
+#   $p->set_input(data => @floats);     # or a packed "f*" string
+#   $p->forward;
+#   my @shape  = $p->output_shape(0);
+#   my @logits = $p->get_output(0);
+
+use strict;
+use warnings;
+
+require DynaLoader;
+our @ISA     = ('DynaLoader');
+our $VERSION = '0.01';
+
+__PACKAGE__->bootstrap($VERSION);
+
+package AI::MXNetTPU::Predictor;
+
+use strict;
+use warnings;
+use Carp qw(croak);
+
+sub new {
+    my ($class, %args) = @_;
+    my $json = $args{symbol_json};
+    if (!defined $json) {
+        my $file = $args{symbol_file}
+            or croak "Predictor->new needs symbol_json or symbol_file";
+        open my $fh, '<', $file or croak "cannot open $file: $!";
+        local $/;
+        $json = <$fh>;
+        close $fh;
+    }
+    my $params = $args{param_file}
+        or croak "Predictor->new needs param_file";
+    my $shapes = $args{shapes}
+        or croak "Predictor->new needs shapes => { name => [dims...] }";
+    my @names  = sort keys %$shapes;
+    my @dims   = map { $shapes->{$_} } @names;
+    my $handle = AI::MXNetTPU::_create($json, $params, \@names, \@dims);
+    return bless { handle => $handle }, $class;
+}
+
+sub set_input {
+    my ($self, $key, @vals) = @_;
+    # Unambiguous by construction (no byte-sniffing — packed floats can
+    # be all-ASCII): an array ref is a list of numbers, exactly one
+    # plain scalar is an already-packed "f*" string, several scalars
+    # are a list of numbers.  A single number must be passed as [$x].
+    my $packed;
+    if (@vals == 1 && ref $vals[0] eq 'ARRAY') {
+        $packed = pack('f*', @{ $vals[0] });
+    }
+    elsif (@vals == 1 && !ref $vals[0]) {
+        $packed = $vals[0];
+    }
+    elsif (@vals > 1) {
+        $packed = pack('f*', @vals);
+    }
+    else {
+        croak 'set_input needs a packed "f*" string, an array ref, '
+            . 'or a list of numbers';
+    }
+    AI::MXNetTPU::_set_input($self->{handle}, $key, $packed);
+    return $self;
+}
+
+sub forward {
+    my ($self) = @_;
+    AI::MXNetTPU::_forward($self->{handle});
+    return $self;
+}
+
+sub output_shape {
+    my ($self, $index) = @_;
+    return AI::MXNetTPU::_output_shape($self->{handle}, $index // 0);
+}
+
+sub get_output {
+    my ($self, $index) = @_;
+    $index //= 0;
+    my $n = 1;
+    $n *= $_ for $self->output_shape($index);
+    my $packed = AI::MXNetTPU::_get_output($self->{handle}, $index, $n);
+    return unpack('f*', $packed);
+}
+
+sub reshape {
+    my ($self, %shapes) = @_;
+    my @names = sort keys %shapes;
+    my @dims  = map { $shapes{$_} } @names;
+    AI::MXNetTPU::_reshape($self->{handle}, \@names, \@dims);
+    return $self;
+}
+
+sub DESTROY {
+    my ($self) = @_;
+    AI::MXNetTPU::_free($self->{handle}) if defined $self->{handle};
+    delete $self->{handle};
+}
+
+1;

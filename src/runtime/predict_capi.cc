@@ -11,6 +11,8 @@
 
 #include <Python.h>
 
+#include <dlfcn.h>
+
 #include <cstdio>
 #include <cstring>
 #include <mutex>
@@ -52,6 +54,17 @@ bool ensure_python() {
       ok = true;
       return;
     }
+    // Promote the already-loaded libpython's symbols to the GLOBAL
+    // namespace before initializing.  Hosts that dlopen a binding
+    // built on this library (perl XS, R dyn.load, JNI) default to
+    // RTLD_LOCAL, and python C-extension modules (numpy's core, jaxlib)
+    // do NOT link libpython themselves — they expect its symbols to be
+    // globally visible, and fail to import otherwise.  RTLD_NOLOAD
+    // re-opens the copy this library is linked against; a plain-C host
+    // that linked libpython normally is unaffected.
+#ifdef MXT_LIBPYTHON_SO
+    dlopen(MXT_LIBPYTHON_SO, RTLD_NOW | RTLD_GLOBAL | RTLD_NOLOAD);
+#endif
     Py_InitializeEx(0);  // no signal handlers: the host owns them
     if (!Py_IsInitialized()) return;
     // release the GIL acquired by initialization so PyGILState_Ensure
