@@ -1062,3 +1062,37 @@ def test_bench_fused_step_and_fallback():
     rec = json.loads([l for l in proc.stdout.splitlines()
                       if l.startswith("{")][-1])
     assert rec.get("partial") and "injected" in rec.get("error", ""), rec
+
+
+def test_benchmark_score_watchdogged(tmp_path):
+    """benchmark_score.py (VERDICT r4 #6): per-cell subprocess watchdogs
+    + --out durable partials — a per-cell timeout records an error row
+    instead of killing the run, and good cells still land."""
+    import json
+    out = tmp_path / "score.jsonl"
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO, "example/image-classification",
+                      "benchmark_score.py"),
+         "--networks", "squeezenet", "--batch-sizes", "1",
+         "--repeats", "2", "--cell-timeout", "240",
+         "--out", str(out)],
+        env=ENV, capture_output=True, text=True, timeout=420)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    rows = [json.loads(l) for l in out.read_text().splitlines()]
+    assert rows and rows[0]["network"] == "squeezenet"
+    assert rows[0]["img_s"] > 0
+
+    # a hopeless per-cell budget must yield an error row, rc 0
+    out2 = tmp_path / "score2.jsonl"
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO, "example/image-classification",
+                      "benchmark_score.py"),
+         "--networks", "squeezenet", "--batch-sizes", "1",
+         "--repeats", "2", "--cell-timeout", "3",
+         "--out", str(out2)],
+        env=ENV, capture_output=True, text=True, timeout=420)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    rows = [json.loads(l) for l in out2.read_text().splitlines()]
+    assert rows and "error" in rows[0], rows

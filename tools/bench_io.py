@@ -44,6 +44,11 @@ def main():
     ap.add_argument("--device-augment", action="store_true",
                     help="host decodes to uint8; mirror/normalize/"
                          "transpose fuse into one on-device program")
+    ap.add_argument("--sweep", type=str, default="",
+                    help="comma list of thread counts: measure each and "
+                         "report the scaling curve + the thread count "
+                         "needed for the MFU-derived target (run on a "
+                         "real multi-core host; 1 thread == 1 vCPU here)")
     args = ap.parse_args()
 
     import mxnet_tpu as mx
@@ -54,33 +59,56 @@ def main():
         print(f"packed {args.num_images} imgs in "
               f"{time.perf_counter() - t0:.1f}s -> {rec}")
 
-    it = mx.io.ImageRecordIter(
-        path_imgrec=rec, data_shape=(3, args.image_size, args.image_size),
-        batch_size=args.batch_size, preprocess_threads=args.threads,
-        rand_mirror=True, mean_r=123.7, mean_g=116.3, mean_b=103.5,
-        std_r=58.4, std_g=57.1, std_b=57.4,
-        device_augment=args.device_augment)
-    # warm epoch (thread pool spin-up, file cache, XLA compile for the
-    # device_augment program)
-    n = 0
-    for b in it:
-        n += b.data[0].shape[0]
-    it.reset()
-    t0 = time.perf_counter()
-    total = 0
-    last = None
-    for _ in range(args.epochs):
+    def measure(threads):
+        it = mx.io.ImageRecordIter(
+            path_imgrec=rec,
+            data_shape=(3, args.image_size, args.image_size),
+            batch_size=args.batch_size, preprocess_threads=threads,
+            rand_mirror=True, mean_r=123.7, mean_g=116.3, mean_b=103.5,
+            std_r=58.4, std_g=57.1, std_b=57.4,
+            device_augment=args.device_augment)
+        # warm epoch (thread pool spin-up, file cache, XLA compile for
+        # the device_augment program)
         for b in it:
-            total += b.data[0].shape[0]
-            last = b.data[0]
-        # fair under async dispatch: execution is FIFO per device, so a
-        # host fetch of the LAST batch proves every queued augmentation
-        # program retired before the clock stops
-        float(np.asarray(last.asnumpy()).ravel()[0])
+            pass
         it.reset()
-    dt = time.perf_counter() - t0
-    print(f"decode+augment throughput: {total / dt:.1f} img/s "
-          f"({args.threads} threads, {args.image_size}px)")
+        t0 = time.perf_counter()
+        total = 0
+        last = None
+        for _ in range(args.epochs):
+            for b in it:
+                total += b.data[0].shape[0]
+                last = b.data[0]
+            # fair under async dispatch: execution is FIFO per device,
+            # so a host fetch of the LAST batch proves every queued
+            # augmentation program retired before the clock stops
+            float(np.asarray(last.asnumpy()).ravel()[0])
+            it.reset()
+        return total / (time.perf_counter() - t0)
+
+    if args.sweep:
+        counts = [int(x) for x in args.sweep.split(",") if x.strip()]
+        rates = []
+        for t in counts:
+            r = measure(t)
+            rates.append(r)
+            print(f"threads={t:3d}: {r:.1f} img/s "
+                  f"({r / t:.1f} img/s/thread)")
+        # the budget the pipeline must clear, derived from the MFU
+        # north star (BASELINE.md): img/s = MFU * peak / flops-per-img
+        from mxnet_tpu.chip import (RESNET50_TRAIN_FLOPS_PER_IMG,
+                                    peak_bf16_tflops)
+        per_thread = max(r / t for r, t in zip(rates, counts))
+        for kind in ("TPU v5e", "TPU v5p"):
+            need = 0.6 * peak_bf16_tflops(kind) * 1e12 \
+                / RESNET50_TRAIN_FLOPS_PER_IMG
+            print(f"60% MFU on {kind}: need {need:.0f} img/s "
+                  f"≈ {need / per_thread:.0f} threads at the best "
+                  f"measured per-thread rate")
+    else:
+        r = measure(args.threads)
+        print(f"decode+augment throughput: {r:.1f} img/s "
+              f"({args.threads} threads, {args.image_size}px)")
 
 
 if __name__ == "__main__":

@@ -248,14 +248,22 @@ def main():
                  env={**env, "MXNET_FUSED_STEP": "1"}))
         _write_bench_window()
 
-    # 2. zoo inference throughput (reference benchmark_score parity)
+    # 2. zoo inference throughput (reference benchmark_score parity);
+    # per-cell subprocess watchdogs + --out append so a hang costs one
+    # (network, batch) cell and the partial artifact survives
     if "score" in steps:
+        score_jsonl = os.path.join(REPO, f"SCORE_{tag}.jsonl")
+        # truncate: --out appends per cell, and a re-armed poller with
+        # the same tag must not mix stale rows from an earlier attempt
+        open(score_jsonl, "w").close()
         _run("benchmark_score",
              [sys.executable,
               "example/image-classification/benchmark_score.py",
               "--networks", "resnet-18,resnet-50,mobilenet,inception-v3",
-              "--batch-sizes", "1,64", "--repeats", "20"],
-             args.step_timeout, summary_path, env=env,
+              "--batch-sizes", "1,64", "--repeats", "20",
+              "--cell-timeout", "180",
+              "--out", score_jsonl],
+             args.step_timeout * 2, summary_path, env=env,
              capture_to=f"SCORE_{tag}.txt")
 
     # 3. correctness tier
