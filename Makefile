@@ -30,11 +30,14 @@ predict_capi: $(PRED_LIB)
 # python C-extensions; pass the soname the link resolves to
 PY_SONAME = $(shell python3 -c "import sysconfig; print(sysconfig.get_config_var('INSTSONAME') or 'lib' + 'python' + sysconfig.get_config_var('LDVERSION') + '.so')")
 
-$(PRED_LIB): src/runtime/predict_capi.cc src/runtime/mxt_predict.h
+$(PRED_LIB): src/runtime/predict_capi.cc src/runtime/capi.cc \
+	     src/runtime/py_embed.cc src/runtime/mxt_predict.h \
+	     src/runtime/mxt_capi.h src/runtime/py_embed.h
 	@mkdir -p mxnet_tpu/_native
 	$(CXX) $(CXXFLAGS) -I$(PY_INC) -shared -o $@ \
 	    -DMXT_LIBPYTHON_SO='"$(PY_SONAME)"' \
-	    src/runtime/predict_capi.cc \
+	    src/runtime/predict_capi.cc src/runtime/capi.cc \
+	    src/runtime/py_embed.cc \
 	    -L$(PY_LIBDIR) -l$(PY_LIB) -ldl -Wl,-rpath,$(PY_LIBDIR)
 
 # C++ consumer of the native runtime (cpp-package analog): predict-only
@@ -50,13 +53,20 @@ $(CPP_EX): cpp-package/example/mlp_predict.cc $(LIB) \
 	    -Wl,-rpath,'$$ORIGIN/../../mxnet_tpu/_native'
 
 CAPI_EX := cpp-package/example/capi_predict
+CAPI_TRAIN_EX := cpp-package/example/capi_train
 
-capi_example: $(CAPI_EX)
+capi_example: $(CAPI_EX) $(CAPI_TRAIN_EX)
 
 $(CAPI_EX): cpp-package/example/capi_predict.c $(PRED_LIB) \
             src/runtime/mxt_predict.h
 	$(CC) -O2 -Wall -o $@ $< \
 	    -Lmxnet_tpu/_native -lmxt_predict \
+	    -Wl,-rpath,'$$ORIGIN/../../mxnet_tpu/_native'
+
+$(CAPI_TRAIN_EX): cpp-package/example/capi_train.c $(PRED_LIB) \
+            src/runtime/mxt_capi.h
+	$(CC) -O2 -Wall -o $@ $< \
+	    -Lmxnet_tpu/_native -lmxt_predict -lm \
 	    -Wl,-rpath,'$$ORIGIN/../../mxnet_tpu/_native'
 
 test: native

@@ -1,0 +1,188 @@
+/* capi_train — TRAIN a model from plain C over the core C API
+ * (src/runtime/mxt_capi.h; parity: the c_api.h surface bindings build
+ * on — MXNDArray* + MXImperativeInvoke + MXSymbolCreateFromJSON +
+ * MXExecutorSimpleBind/Forward/Backward).
+ *
+ * Workflow (the cpp-package MLP training loop, reduced to flat C):
+ *   1. load symbol JSON + python-initialized params (.params container)
+ *   2. simple-bind a training executor (grad_req=write)
+ *   3. copy the init params into the bound arg arrays (op invoke _copy)
+ *   4. epochs: upload batch -> forward(train) -> backward ->
+ *      sgd_update(w, g, out=w) per parameter (the in-place fused
+ *      optimizer op, reference optimizer_op.cc:39)
+ *   5. eval: forward(is_train=0), argmax accuracy, print
+ *
+ *   capi_train <symbol.json> <init.params> <X.f32> <Y.f32> N D C epochs lr
+ *
+ * Prints "epoch <i> loss <nll>" lines and a final "accuracy <frac>"
+ * (parsed by tests/test_cpp_package.py, which asserts real learning).
+ */
+#include <math.h>
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+#include "../../src/runtime/mxt_capi.h"
+
+static float *read_f32(const char *path, uint64_t count) {
+  FILE *f = fopen(path, "rb");
+  if (!f) return NULL;
+  float *buf = (float *)malloc(count * sizeof(float));
+  if (!buf) {
+    fclose(f);
+    return NULL;
+  }
+  if (fread(buf, sizeof(float), count, f) != count) {
+    free(buf);
+    fclose(f);
+    return NULL;
+  }
+  fclose(f);
+  return buf;
+}
+
+#define CHECK(call)                                                   \
+  do {                                                                \
+    if ((call) != 0) {                                                \
+      fprintf(stderr, "%s failed: %s\n", #call, MXTGetLastError());   \
+      return 1;                                                       \
+    }                                                                 \
+  } while (0)
+
+int main(int argc, char **argv) {
+  if (argc != 10) {
+    fprintf(stderr,
+            "usage: %s <symbol.json> <init.params> <X.f32> <Y.f32> "
+            "N D C epochs lr\n", argv[0]);
+    return 2;
+  }
+  const char *sym_path = argv[1], *params_path = argv[2];
+  uint32_t N = (uint32_t)atoi(argv[5]), D = (uint32_t)atoi(argv[6]);
+  uint32_t C = (uint32_t)atoi(argv[7]);
+  int epochs = atoi(argv[8]);
+  const char *lr = argv[9];
+
+  float *X = read_f32(argv[3], (uint64_t)N * D);
+  float *Y = read_f32(argv[4], N);
+  if (!X || !Y) {
+    fprintf(stderr, "bad input files\n");
+    return 2;
+  }
+
+  /* 1. symbol + executor */
+  MXTSymbolHandle sym = NULL;
+  CHECK(MXTSymbolCreateFromFile(sym_path, &sym));
+  uint32_t n_args = 0;
+  const char **arg_names = NULL;
+  CHECK(MXTSymbolListArguments(sym, &n_args, &arg_names));
+
+  const char *keys[] = {"data", "softmax_label"};
+  uint32_t dshape[] = {N, D}, lshape[] = {N};
+  const uint32_t *shapes[] = {dshape, lshape};
+  uint32_t ndims[] = {2, 1};
+  MXTExecutorHandle ex = NULL;
+  CHECK(MXTExecutorSimpleBind(sym, 2, keys, shapes, ndims, "write", &ex));
+
+  /* 2. load python-initialized params, copy into the bound args via the
+   * generic op invoke (_copy with out=) — proves invoke + live arg
+   * bindings in one step */
+  uint32_t n_loaded = 0;
+  MXTNDArrayHandle *loaded = NULL;
+  const char **loaded_keys = NULL;
+  void *tok = NULL;
+  CHECK(MXTNDArrayLoad(params_path, &n_loaded, &loaded, &loaded_keys, &tok));
+  for (uint32_t i = 0; i < n_loaded; ++i) {
+    /* checkpoint keys carry the arg:/aux: prefix convention */
+    const char *name = loaded_keys[i];
+    if (strncmp(name, "arg:", 4) == 0 || strncmp(name, "aux:", 4) == 0)
+      name += 4;
+    MXTNDArrayHandle dst = NULL;
+    if (MXTExecutorArgArray(ex, name, &dst) != 0) continue; /* aux etc. */
+    MXTNDArrayHandle outs[1] = {dst};
+    uint32_t n_out = 1;
+    CHECK(MXTImperativeInvoke("_copy", &loaded[i], 1, NULL, NULL, 0,
+                              outs, &n_out));
+    MXTNDArrayFree(dst);
+  }
+
+  /* 3. the bound data/label arrays */
+  MXTNDArrayHandle a_data = NULL, a_label = NULL;
+  CHECK(MXTExecutorArgArray(ex, "data", &a_data));
+  CHECK(MXTExecutorArgArray(ex, "softmax_label", &a_label));
+  CHECK(MXTNDArraySyncCopyFromCPU(a_data, X, (uint64_t)N * D));
+  CHECK(MXTNDArraySyncCopyFromCPU(a_label, Y, N));
+
+  /* probs buffer for loss/accuracy readback */
+  float *probs = (float *)malloc((uint64_t)N * C * sizeof(float));
+  if (!probs) return 1;
+
+  /* 4. train: full-batch steps.  rescale_grad=1/N: SoftmaxOutput grads
+   * are per-example sums (reference normalization='null'); the Module
+   * path sets the same factor on its optimizer (model.py rescale_grad) */
+  char rescale[32];
+  snprintf(rescale, sizeof rescale, "%.10f", 1.0 / N);
+  const char *upd_keys[] = {"lr", "wd", "rescale_grad"};
+  const char *upd_vals[] = {lr, "0.0", rescale};
+  for (int e = 0; e < epochs; ++e) {
+    CHECK(MXTExecutorForward(ex, 1));
+    CHECK(MXTExecutorBackward(ex));
+    for (uint32_t i = 0; i < n_args; ++i) {
+      if (strcmp(arg_names[i], "data") == 0 ||
+          strcmp(arg_names[i], "softmax_label") == 0)
+        continue;
+      MXTNDArrayHandle w = NULL, g = NULL;
+      CHECK(MXTExecutorArgArray(ex, arg_names[i], &w));
+      CHECK(MXTExecutorGradArray(ex, arg_names[i], &g));
+      MXTNDArrayHandle wg[2] = {w, g};
+      MXTNDArrayHandle outs[1] = {w};
+      uint32_t n_out = 1;
+      CHECK(MXTImperativeInvoke("sgd_update", wg, 2, upd_keys, upd_vals, 3,
+                                outs, &n_out));
+      MXTNDArrayFree(w);
+      MXTNDArrayFree(g);
+    }
+    /* epoch loss from the (pre-update) forward's softmax probs */
+    MXTNDArrayHandle out0 = NULL;
+    CHECK(MXTExecutorOutput(ex, 0, &out0));
+    CHECK(MXTNDArraySyncCopyToCPU(out0, probs, (uint64_t)N * C));
+    MXTNDArrayFree(out0);
+    double nll = 0.0;
+    for (uint32_t i = 0; i < N; ++i) {
+      float p = probs[i * C + (uint32_t)Y[i]];
+      nll -= log(p > 1e-8f ? p : 1e-8f);
+    }
+    printf("epoch %d loss %.6f\n", e, nll / N);
+  }
+
+  /* 5. eval accuracy */
+  CHECK(MXTExecutorForward(ex, 0));
+  MXTNDArrayHandle out0 = NULL;
+  CHECK(MXTExecutorOutput(ex, 0, &out0));
+  uint32_t oshape[MXT_MAX_NDIM], ondim = 0;
+  CHECK(MXTNDArrayGetShape(out0, &ondim, oshape));
+  if (ondim != 2 || oshape[0] != N || oshape[1] != C) {
+    fprintf(stderr, "unexpected output shape\n");
+    return 1;
+  }
+  CHECK(MXTNDArraySyncCopyToCPU(out0, probs, (uint64_t)N * C));
+  MXTNDArrayFree(out0);
+  uint32_t correct = 0;
+  for (uint32_t i = 0; i < N; ++i) {
+    uint32_t best = 0;
+    for (uint32_t c = 1; c < C; ++c)
+      if (probs[i * C + c] > probs[i * C + best]) best = c;
+    if (best == (uint32_t)Y[i]) correct++;
+  }
+  printf("accuracy %.4f\n", (double)correct / N);
+
+  MXTNDArrayFree(a_data);
+  MXTNDArrayFree(a_label);
+  MXTNDArrayLoadFree(tok);
+  MXTExecutorFree(ex);
+  MXTSymbolFree(sym);
+  free(X);
+  free(Y);
+  free(probs);
+  return 0;
+}

@@ -1,0 +1,103 @@
+"""Support shims for the flat C API (src/runtime/mxt_capi.h).
+
+The C layer (src/runtime/capi.cc) is a thin marshaling bridge over an
+embedded CPython; the semantics live here where they are directly
+testable.  Parity targets: c_api.cc NDArray block (:153-361),
+c_api_ndarray.cc MXImperativeInvoke (:80-142), c_api_executor.cc
+simple-bind (:220).
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from . import ndarray as _nd_pkg
+from . import nd as _nd
+from .base import MXNetError
+from .context import current_context
+from .ndarray import NDArray
+
+
+def _np_dtype(name):
+    if name == "bfloat16":
+        import ml_dtypes
+        return _np.dtype(ml_dtypes.bfloat16)
+    return _np.dtype(name)
+
+
+def nd_itemsize(arr: NDArray) -> int:
+    """Bytes per element of the array's dtype — the single source of
+    dtype knowledge for the C layer's size checks."""
+    return int(_np_dtype(str(arr.dtype)).itemsize)
+
+
+def nd_create(shape, dtype="float32"):
+    """Zero-filled NDArray (MXTNDArrayCreate)."""
+    return _nd.zeros(tuple(int(d) for d in shape), dtype=dtype)
+
+
+def nd_from_bytes(arr: NDArray, raw: bytes) -> None:
+    """Raw-byte upload into an existing NDArray (SyncCopyFromCPU).
+    Byte length must equal size * itemsize of the array's dtype."""
+    dt = _np_dtype(str(arr.dtype))
+    expect = int(arr.size) * dt.itemsize
+    if len(raw) != expect:
+        raise MXNetError(
+            f"SyncCopyFromCPU: got {len(raw)} bytes, array wants {expect} "
+            f"({arr.size} x {dt})")
+    vals = _np.frombuffer(raw, dtype=dt).reshape(arr.shape)
+    arr[:] = vals
+
+
+def nd_to_bytes(arr: NDArray) -> bytes:
+    """Raw-byte download (SyncCopyToCPU)."""
+    return _np.ascontiguousarray(
+        arr.asnumpy().astype(_np_dtype(str(arr.dtype)), copy=False)
+    ).tobytes()
+
+
+def invoke(op_name, inputs, params, outputs=None):
+    """Generic op invoke (MXTImperativeInvoke).  `params` values arrive
+    as strings from C; the op schema's Arg coercion parses them (same
+    contract as the reference's dmlc::Parameter::Init over char**).
+    `outputs` (when given) become the in-place `out=` target — the
+    fused optimizer-update path."""
+    fn = getattr(_nd, op_name, None)
+    if fn is None or not callable(fn):
+        raise MXNetError(f"unknown operator '{op_name}'")
+    kw = dict(params or {})
+    if outputs:
+        kw["out"] = outputs[0] if len(outputs) == 1 else tuple(outputs)
+    res = fn(*inputs, **kw)
+    if res is None:
+        return list(outputs or [])
+    if isinstance(res, (list, tuple)):
+        return list(res)
+    return [res]
+
+
+def symbol_from_json(json_str):
+    from . import sym as _sym
+    return _sym.load_json(json_str)
+
+
+def simple_bind(sym, shapes, grad_req="write"):
+    """simple_bind on the current context; missing params are created
+    zero-filled by the executor machinery (MXTExecutorSimpleBind)."""
+    return sym.simple_bind(current_context(), grad_req=grad_req,
+                           **{k: tuple(int(d) for d in v)
+                              for k, v in shapes.items()})
+
+
+def save(fname, keys, arrays):
+    _nd.save(fname, dict(zip(keys, arrays)))
+
+
+def load(fname):
+    """Returns (keys, arrays) with deterministic order; list-form files
+    get stringified indices as keys (reference MXNDArrayLoad returns an
+    optional name table the same way)."""
+    d = _nd.load(fname)
+    if isinstance(d, dict):
+        keys = sorted(d)
+        return keys, [d[k] for k in keys]
+    return [str(i) for i in range(len(d))], list(d)

@@ -1,0 +1,569 @@
+// capi.cc — core C API (NDArray / op invoke / Symbol / Executor) over
+// the embedded CPython runtime.  See mxt_capi.h for the contract and
+// mxnet_tpu/capi_support.py for the semantics; this file is marshaling
+// only: every handle is a PyObject* (NDArray / Symbol / Executor), the
+// GIL is taken around each call, and errors land in the shared
+// thread-local ring (py_embed.h).
+#include "mxt_capi.h"
+
+#include <Python.h>
+
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include "py_embed.h"
+
+namespace {
+
+using mxt_embed::Gil;
+using mxt_embed::ensure_python;
+using mxt_embed::g_last_error;
+using mxt_embed::set_error;
+
+PyObject *support() {
+  // borrowed from the module cache after first import
+  PyObject *m = PyImport_ImportModule("mxnet_tpu.capi_support");
+  if (m == nullptr) {
+    set_error("import mxnet_tpu.capi_support failed (is PYTHONPATH set?)");
+  }
+  return m;
+}
+
+// call capi_support.<fn>(args...); returns new ref or nullptr+error set
+PyObject *call_support(const char *fn, PyObject *args) {
+  PyObject *m = support();
+  if (m == nullptr) {
+    Py_XDECREF(args);
+    return nullptr;
+  }
+  PyObject *f = PyObject_GetAttrString(m, fn);
+  Py_DECREF(m);
+  if (f == nullptr) {
+    Py_XDECREF(args);
+    set_error(fn);
+    return nullptr;
+  }
+  PyObject *r = args ? PyObject_CallObject(f, args) : PyObject_CallObject(f, nullptr);
+  Py_DECREF(f);
+  Py_XDECREF(args);
+  if (r == nullptr) set_error(fn);
+  return r;
+}
+
+PyObject *shape_tuple(const uint32_t *shape, uint32_t ndim) {
+  PyObject *t = PyTuple_New(ndim);
+  if (t == nullptr) return nullptr;
+  for (uint32_t i = 0; i < ndim; ++i)
+    PyTuple_SET_ITEM(t, i, PyLong_FromUnsignedLong(shape[i]));
+  return t;
+}
+
+// string-table owner for list-returning calls (symbol arg names, load
+// keys): C sees const char** valid until the owning handle is freed
+struct StringTable {
+  std::vector<std::string> store;
+  std::vector<const char *> ptrs;
+
+  void fill(PyObject *list_of_str) {
+    store.clear();
+    ptrs.clear();
+    Py_ssize_t n = PySequence_Size(list_of_str);
+    store.reserve(n);
+    for (Py_ssize_t i = 0; i < n; ++i) {
+      PyObject *it = PySequence_GetItem(list_of_str, i);
+      const char *s = it ? PyUnicode_AsUTF8(it) : nullptr;
+      store.emplace_back(s ? s : "");
+      Py_XDECREF(it);
+    }
+    for (auto &s : store) ptrs.push_back(s.c_str());
+  }
+};
+
+struct SymHandle {
+  PyObject *sym;
+  StringTable args, auxs, outs;
+};
+
+struct LoadToken {
+  std::vector<PyObject *> arrays;
+  std::vector<MXTNDArrayHandle> handles;
+  StringTable keys;
+};
+
+int list_names(SymHandle *h, const char *method, StringTable *table,
+               uint32_t *out_num, const char ***out_names) {
+  Gil gil;
+  // symbols are immutable: fill once, serve the cached table on
+  // repeat calls (the header promises pointers stay valid until the
+  // symbol is freed — a refill would dangle an earlier caller's table)
+  if (table->store.empty()) {
+    PyObject *r = PyObject_CallMethod(h->sym, method, nullptr);
+    if (r == nullptr) {
+      set_error(method);
+      return -1;
+    }
+    table->fill(r);
+    Py_DECREF(r);
+  }
+  *out_num = (uint32_t)table->ptrs.size();
+  *out_names = table->ptrs.data();
+  return 0;
+}
+
+}  // namespace
+
+extern "C" {
+
+/* ---------------- NDArray ---------------- */
+
+int MXTNDArrayCreate(const uint32_t *shape, uint32_t ndim,
+                     const char *dtype, MXTNDArrayHandle *out) {
+  if (out == nullptr || (ndim > 0 && shape == nullptr)) return -1;
+  *out = nullptr;
+  if (!ensure_python()) return -1;
+  Gil gil;
+  PyObject *t = shape_tuple(shape, ndim);
+  if (t == nullptr) return -1;
+  PyObject *r = call_support(
+      "nd_create", Py_BuildValue("(Os)", t, dtype ? dtype : "float32"));
+  Py_DECREF(t);
+  if (r == nullptr) return -1;
+  *out = r;  // handle owns the ref
+  return 0;
+}
+
+// bytes per element via capi_support.nd_itemsize (python owns dtype
+// knowledge — one source of truth for Create/CopyFrom/CopyTo)
+static int64_t nd_itemsize(PyObject *arr) {
+  PyObject *r = call_support("nd_itemsize", Py_BuildValue("(O)", arr));
+  if (r == nullptr) return -1;
+  int64_t v = PyLong_AsLongLong(r);
+  Py_DECREF(r);
+  if (v <= 0 && PyErr_Occurred()) {
+    set_error("nd_itemsize");
+    return -1;
+  }
+  return v;
+}
+
+int MXTNDArraySyncCopyFromCPU(MXTNDArrayHandle h, const void *data,
+                              uint64_t size) {
+  if (h == nullptr || data == nullptr) return -1;
+  Gil gil;
+  PyObject *arr = (PyObject *)h;
+  int64_t itemsize = nd_itemsize(arr);
+  if (itemsize <= 0) return -1;
+  PyObject *raw = PyBytes_FromStringAndSize(
+      (const char *)data, (Py_ssize_t)(size * (uint64_t)itemsize));
+  if (raw == nullptr) {
+    set_error("SyncCopyFromCPU");
+    return -1;
+  }
+  PyObject *r = call_support("nd_from_bytes",
+                             Py_BuildValue("(ON)", arr, raw));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXTNDArraySyncCopyToCPU(MXTNDArrayHandle h, void *data, uint64_t size) {
+  if (h == nullptr || data == nullptr) return -1;
+  Gil gil;
+  int64_t itemsize = nd_itemsize((PyObject *)h);
+  if (itemsize <= 0) return -1;
+  PyObject *r = call_support("nd_to_bytes",
+                             Py_BuildValue("(O)", (PyObject *)h));
+  if (r == nullptr) return -1;
+  char *buf = nullptr;
+  Py_ssize_t len = 0;
+  if (PyBytes_AsStringAndSize(r, &buf, &len) != 0) {
+    Py_DECREF(r);
+    set_error("SyncCopyToCPU");
+    return -1;
+  }
+  // size is the ELEMENT count and must match the array exactly — a
+  // divisor-sized caller buffer would be overflowed by a full copy
+  if ((uint64_t)len != size * (uint64_t)itemsize) {
+    g_last_error = "SyncCopyToCPU: size does not match array";
+    Py_DECREF(r);
+    return -1;
+  }
+  std::memcpy(data, buf, (size_t)len);
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXTNDArrayGetShape(MXTNDArrayHandle h, uint32_t *ndim, uint32_t *shape) {
+  if (h == nullptr || ndim == nullptr || shape == nullptr) return -1;
+  Gil gil;
+  PyObject *s = PyObject_GetAttrString((PyObject *)h, "shape");
+  if (s == nullptr) {
+    set_error("GetShape");
+    return -1;
+  }
+  Py_ssize_t n = PyTuple_Check(s) ? PyTuple_GET_SIZE(s) : -1;
+  if (n < 0 || n > MXT_MAX_NDIM) {
+    Py_DECREF(s);
+    g_last_error = "GetShape: bad rank";
+    return -1;
+  }
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    unsigned long d = PyLong_AsUnsignedLong(PyTuple_GET_ITEM(s, i));
+    if (d == (unsigned long)-1 && PyErr_Occurred()) {
+      // never leave a pending exception to poison the next call
+      set_error("GetShape: non-integer dim");
+      Py_DECREF(s);
+      return -1;
+    }
+    shape[i] = (uint32_t)d;
+  }
+  Py_DECREF(s);
+  *ndim = (uint32_t)n;
+  return 0;
+}
+
+int MXTNDArrayGetDType(MXTNDArrayHandle h, char *buf, uint32_t len) {
+  if (h == nullptr || buf == nullptr || len == 0) return -1;
+  Gil gil;
+  PyObject *dt = PyObject_GetAttrString((PyObject *)h, "dtype");
+  if (dt == nullptr) {
+    set_error("GetDType");
+    return -1;
+  }
+  PyObject *s = PyObject_Str(dt);
+  Py_DECREF(dt);
+  const char *name = s ? PyUnicode_AsUTF8(s) : nullptr;
+  if (name == nullptr) {
+    Py_XDECREF(s);
+    set_error("GetDType");
+    return -1;
+  }
+  std::strncpy(buf, name, len - 1);
+  buf[len - 1] = '\0';
+  Py_DECREF(s);
+  return 0;
+}
+
+void MXTNDArrayFree(MXTNDArrayHandle h) {
+  if (h == nullptr || !Py_IsInitialized()) return;
+  Gil gil;
+  Py_DECREF((PyObject *)h);
+}
+
+int MXTNDArraySave(const char *fname, uint32_t num,
+                   MXTNDArrayHandle *handles, const char **keys) {
+  if (fname == nullptr || (num > 0 && (handles == nullptr || keys == nullptr)))
+    return -1;
+  Gil gil;
+  PyObject *klist = PyList_New(num), *alist = PyList_New(num);
+  if (klist == nullptr || alist == nullptr) {
+    Py_XDECREF(klist);
+    Py_XDECREF(alist);
+    return -1;
+  }
+  for (uint32_t i = 0; i < num; ++i) {
+    PyList_SET_ITEM(klist, i, PyUnicode_FromString(keys[i]));
+    Py_INCREF((PyObject *)handles[i]);
+    PyList_SET_ITEM(alist, i, (PyObject *)handles[i]);
+  }
+  PyObject *r = call_support("save",
+                             Py_BuildValue("(sNN)", fname, klist, alist));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXTNDArrayLoad(const char *fname, uint32_t *out_num,
+                   MXTNDArrayHandle **out_handles, const char ***out_keys,
+                   void **token) {
+  if (fname == nullptr || out_num == nullptr || out_handles == nullptr ||
+      out_keys == nullptr || token == nullptr)
+    return -1;
+  if (!ensure_python()) return -1;
+  Gil gil;
+  PyObject *r = call_support("load", Py_BuildValue("(s)", fname));
+  if (r == nullptr) return -1;
+  PyObject *keys = PyTuple_GetItem(r, 0);    // borrowed
+  PyObject *arrays = PyTuple_GetItem(r, 1);  // borrowed
+  if (keys == nullptr || arrays == nullptr) {
+    Py_DECREF(r);
+    set_error("Load");
+    return -1;
+  }
+  LoadToken *tok = new LoadToken();
+  tok->keys.fill(keys);
+  Py_ssize_t n = PySequence_Size(arrays);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject *a = PySequence_GetItem(arrays, i);  // new ref, owned by tok
+    tok->arrays.push_back(a);
+    tok->handles.push_back((MXTNDArrayHandle)a);
+  }
+  Py_DECREF(r);
+  *out_num = (uint32_t)n;
+  *out_handles = tok->handles.data();
+  *out_keys = tok->keys.ptrs.data();
+  *token = tok;
+  return 0;
+}
+
+void MXTNDArrayLoadFree(void *token) {
+  if (token == nullptr) return;
+  LoadToken *tok = (LoadToken *)token;
+  if (Py_IsInitialized()) {
+    Gil gil;
+    for (PyObject *a : tok->arrays) Py_DECREF(a);
+  }
+  delete tok;
+}
+
+/* ---------------- generic op invoke ---------------- */
+
+int MXTImperativeInvoke(const char *op_name, MXTNDArrayHandle *inputs,
+                        uint32_t num_inputs, const char **param_keys,
+                        const char **param_vals, uint32_t num_params,
+                        MXTNDArrayHandle *outputs, uint32_t *num_outputs) {
+  if (op_name == nullptr || num_outputs == nullptr ||
+      (num_inputs > 0 && inputs == nullptr))
+    return -1;
+  if (!ensure_python()) return -1;
+  Gil gil;
+  PyObject *ins = PyList_New(num_inputs);
+  if (ins == nullptr) return -1;
+  for (uint32_t i = 0; i < num_inputs; ++i) {
+    Py_INCREF((PyObject *)inputs[i]);
+    PyList_SET_ITEM(ins, i, (PyObject *)inputs[i]);
+  }
+  PyObject *params = PyDict_New();
+  for (uint32_t i = 0; i < num_params; ++i) {
+    PyObject *v = PyUnicode_FromString(param_vals[i]);
+    PyDict_SetItemString(params, param_keys[i], v);  // INCREFs v
+    Py_XDECREF(v);
+  }
+  PyObject *outs;
+  uint32_t n_prealloc = *num_outputs;
+  if (n_prealloc > 0 && outputs != nullptr && outputs[0] != nullptr) {
+    outs = PyList_New(n_prealloc);
+    for (uint32_t i = 0; i < n_prealloc; ++i) {
+      Py_INCREF((PyObject *)outputs[i]);
+      PyList_SET_ITEM(outs, i, (PyObject *)outputs[i]);
+    }
+  } else {
+    n_prealloc = 0;
+    outs = Py_None;
+    Py_INCREF(Py_None);
+  }
+  PyObject *r = call_support(
+      "invoke", Py_BuildValue("(sNNN)", op_name, ins, params, outs));
+  if (r == nullptr) return -1;
+  Py_ssize_t n = PySequence_Size(r);
+  if (n < 0) {
+    Py_DECREF(r);
+    set_error("Invoke");
+    return -1;
+  }
+  if (n_prealloc == 0) {
+    if (outputs == nullptr) {
+      Py_DECREF(r);
+      g_last_error = "Invoke: outputs table is NULL";
+      return -1;
+    }
+    for (Py_ssize_t i = 0; i < n; ++i)
+      outputs[i] = (MXTNDArrayHandle)PySequence_GetItem(r, i);  // new refs
+  }
+  *num_outputs = (uint32_t)n;
+  Py_DECREF(r);
+  return 0;
+}
+
+/* ---------------- Symbol ---------------- */
+
+int MXTSymbolCreateFromJSON(const char *json, MXTSymbolHandle *out) {
+  if (json == nullptr || out == nullptr) return -1;
+  *out = nullptr;
+  if (!ensure_python()) return -1;
+  Gil gil;
+  PyObject *r = call_support("symbol_from_json", Py_BuildValue("(s)", json));
+  if (r == nullptr) return -1;
+  SymHandle *h = new SymHandle();
+  h->sym = r;
+  *out = h;
+  return 0;
+}
+
+int MXTSymbolCreateFromFile(const char *path, MXTSymbolHandle *out) {
+  if (path == nullptr || out == nullptr) return -1;
+  FILE *f = std::fopen(path, "rb");
+  if (f == nullptr) {
+    g_last_error = std::string("cannot open ") + path;
+    return -1;
+  }
+  std::fseek(f, 0, SEEK_END);
+  long len = std::ftell(f);
+  if (len < 0) {
+    std::fclose(f);
+    return -1;
+  }
+  std::fseek(f, 0, SEEK_SET);
+  std::string buf(len, '\0');
+  size_t got = std::fread(&buf[0], 1, len, f);
+  std::fclose(f);
+  if (got != (size_t)len) {
+    g_last_error = std::string("short read on ") + path;
+    return -1;
+  }
+  return MXTSymbolCreateFromJSON(buf.c_str(), out);
+}
+
+int MXTSymbolListArguments(MXTSymbolHandle h, uint32_t *out_num,
+                           const char ***out_names) {
+  if (h == nullptr || out_num == nullptr || out_names == nullptr) return -1;
+  SymHandle *sh = (SymHandle *)h;
+  return list_names(sh, "list_arguments", &sh->args, out_num, out_names);
+}
+
+int MXTSymbolListAuxiliaryStates(MXTSymbolHandle h, uint32_t *out_num,
+                                 const char ***out_names) {
+  if (h == nullptr || out_num == nullptr || out_names == nullptr) return -1;
+  SymHandle *sh = (SymHandle *)h;
+  return list_names(sh, "list_auxiliary_states", &sh->auxs, out_num,
+                    out_names);
+}
+
+int MXTSymbolListOutputs(MXTSymbolHandle h, uint32_t *out_num,
+                         const char ***out_names) {
+  if (h == nullptr || out_num == nullptr || out_names == nullptr) return -1;
+  SymHandle *sh = (SymHandle *)h;
+  return list_names(sh, "list_outputs", &sh->outs, out_num, out_names);
+}
+
+void MXTSymbolFree(MXTSymbolHandle h) {
+  if (h == nullptr) return;
+  SymHandle *sh = (SymHandle *)h;
+  if (Py_IsInitialized()) {
+    Gil gil;
+    Py_DECREF(sh->sym);
+  }
+  delete sh;
+}
+
+/* ---------------- Executor ---------------- */
+
+int MXTExecutorSimpleBind(MXTSymbolHandle sym, uint32_t num_input_nodes,
+                          const char **input_keys,
+                          const uint32_t **shape_data,
+                          const uint32_t *shape_ndim, const char *grad_req,
+                          MXTExecutorHandle *out) {
+  if (sym == nullptr || out == nullptr) return -1;
+  *out = nullptr;
+  Gil gil;
+  PyObject *shapes = mxt_embed::shapes_dict(num_input_nodes, input_keys,
+                                            shape_data, shape_ndim);
+  if (shapes == nullptr) return -1;
+  PyObject *r = call_support(
+      "simple_bind", Py_BuildValue("(ONs)", ((SymHandle *)sym)->sym, shapes,
+                                   grad_req ? grad_req : "write"));
+  if (r == nullptr) return -1;
+  *out = r;  // executor handle owns the ref
+  return 0;
+}
+
+int MXTExecutorForward(MXTExecutorHandle h, int is_train) {
+  if (h == nullptr) return -1;
+  Gil gil;
+  PyObject *r = PyObject_CallMethod((PyObject *)h, "forward", "(i)",
+                                    is_train);
+  if (r == nullptr) {
+    set_error("Forward");
+    return -1;
+  }
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXTExecutorBackward(MXTExecutorHandle h) {
+  if (h == nullptr) return -1;
+  Gil gil;
+  PyObject *r = PyObject_CallMethod((PyObject *)h, "backward", nullptr);
+  if (r == nullptr) {
+    set_error("Backward");
+    return -1;
+  }
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXTExecutorNumOutputs(MXTExecutorHandle h, uint32_t *out_num) {
+  if (h == nullptr || out_num == nullptr) return -1;
+  Gil gil;
+  PyObject *outs = PyObject_GetAttrString((PyObject *)h, "outputs");
+  if (outs == nullptr) {
+    set_error("NumOutputs");
+    return -1;
+  }
+  Py_ssize_t n = PySequence_Size(outs);
+  Py_DECREF(outs);
+  if (n < 0) return -1;
+  *out_num = (uint32_t)n;
+  return 0;
+}
+
+int MXTExecutorOutput(MXTExecutorHandle h, uint32_t index,
+                      MXTNDArrayHandle *out) {
+  if (h == nullptr || out == nullptr) return -1;
+  Gil gil;
+  PyObject *outs = PyObject_GetAttrString((PyObject *)h, "outputs");
+  if (outs == nullptr) {
+    set_error("Output");
+    return -1;
+  }
+  PyObject *a = PySequence_GetItem(outs, index);  // new ref
+  Py_DECREF(outs);
+  if (a == nullptr) {
+    set_error("Output");
+    return -1;
+  }
+  *out = a;
+  return 0;
+}
+
+static int dict_lookup(MXTExecutorHandle h, const char *attr,
+                       const char *name, MXTNDArrayHandle *out) {
+  Gil gil;
+  PyObject *d = PyObject_GetAttrString((PyObject *)h, attr);
+  if (d == nullptr) {
+    set_error(attr);
+    return -1;
+  }
+  PyObject *a = PyMapping_GetItemString(d, name);  // new ref
+  Py_DECREF(d);
+  if (a == nullptr) {
+    set_error(attr);
+    return -1;
+  }
+  *out = a;
+  return 0;
+}
+
+int MXTExecutorArgArray(MXTExecutorHandle h, const char *name,
+                        MXTNDArrayHandle *out) {
+  if (h == nullptr || name == nullptr || out == nullptr) return -1;
+  return dict_lookup(h, "arg_dict", name, out);
+}
+
+int MXTExecutorGradArray(MXTExecutorHandle h, const char *name,
+                         MXTNDArrayHandle *out) {
+  if (h == nullptr || name == nullptr || out == nullptr) return -1;
+  return dict_lookup(h, "grad_dict", name, out);
+}
+
+void MXTExecutorFree(MXTExecutorHandle h) {
+  if (h == nullptr || !Py_IsInitialized()) return;
+  Gil gil;
+  Py_DECREF((PyObject *)h);
+}
+
+const char *MXTGetLastError(void) { return g_last_error.c_str(); }
+
+}  // extern "C"

@@ -1,0 +1,86 @@
+// py_embed.cc — shared embedded-CPython plumbing (see py_embed.h).
+#include "py_embed.h"
+
+#include <dlfcn.h>
+
+#include <mutex>
+
+namespace mxt_embed {
+
+thread_local std::string g_last_error;
+
+void set_error(const char *where) {
+  PyObject *type = nullptr, *value = nullptr, *tb = nullptr;
+  PyErr_Fetch(&type, &value, &tb);
+  std::string msg = where;
+  if (value != nullptr) {
+    PyObject *s = PyObject_Str(value);
+    if (s != nullptr) {
+      msg += ": ";
+      msg += PyUnicode_AsUTF8(s) ? PyUnicode_AsUTF8(s) : "<unprintable>";
+      Py_DECREF(s);
+    }
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+  g_last_error = msg;
+}
+
+bool ensure_python() {
+  // once-guarded: concurrent first calls from different host threads
+  // must not double-initialize (UB in CPython)
+  static std::once_flag flag;
+  static bool ok = false;
+  std::call_once(flag, [] {
+    if (Py_IsInitialized()) {  // host already embeds python
+      ok = true;
+      return;
+    }
+    // Promote the already-loaded libpython's symbols to the GLOBAL
+    // namespace before initializing.  Hosts that dlopen a binding
+    // built on this library (perl XS, R dyn.load, JNI) default to
+    // RTLD_LOCAL, and python C-extension modules (numpy's core, jaxlib)
+    // do NOT link libpython themselves — they expect its symbols to be
+    // globally visible, and fail to import otherwise.  RTLD_NOLOAD
+    // re-opens the copy this library is linked against; a plain-C host
+    // that linked libpython normally is unaffected.
+#ifdef MXT_LIBPYTHON_SO
+    dlopen(MXT_LIBPYTHON_SO, RTLD_NOW | RTLD_GLOBAL | RTLD_NOLOAD);
+#endif
+    Py_InitializeEx(0);  // no signal handlers: the host owns them
+    if (!Py_IsInitialized()) return;
+    // release the GIL acquired by initialization so PyGILState_Ensure
+    // works uniformly from any thread afterwards
+    PyEval_SaveThread();
+    ok = true;
+  });
+  if (!ok) g_last_error = "Py_InitializeEx failed";
+  return ok;
+}
+
+PyObject *shapes_dict(uint32_t n, const char **keys,
+                      const uint32_t **shape_data,
+                      const uint32_t *shape_ndim) {
+  PyObject *d = PyDict_New();
+  if (d == nullptr) return nullptr;
+  for (uint32_t i = 0; i < n; ++i) {
+    PyObject *t = PyTuple_New(shape_ndim[i]);
+    if (t == nullptr) {
+      Py_DECREF(d);
+      return nullptr;
+    }
+    for (uint32_t j = 0; j < shape_ndim[i]; ++j) {
+      PyTuple_SET_ITEM(t, j, PyLong_FromUnsignedLong(shape_data[i][j]));
+    }
+    if (PyDict_SetItemString(d, keys[i], t) != 0) {
+      Py_DECREF(t);
+      Py_DECREF(d);
+      return nullptr;
+    }
+    Py_DECREF(t);
+  }
+  return d;
+}
+
+}  // namespace mxt_embed

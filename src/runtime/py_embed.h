@@ -1,0 +1,45 @@
+// py_embed.h — internal helpers shared by the embedded-CPython C APIs
+// (predict_capi.cc + capi.cc, both linked into libmxt_predict.so).
+// One interpreter per process; thread-local last-error; GIL guard.
+#ifndef MXT_PY_EMBED_H_
+#define MXT_PY_EMBED_H_
+
+#include <Python.h>
+
+#include <string>
+
+namespace mxt_embed {
+
+// thread-local error message shared by MXTPredGetLastError and
+// MXTGetLastError (the reference keeps one ring per thread too,
+// c_api_error.cc)
+extern thread_local std::string g_last_error;
+
+// capture the pending python exception (if any) into g_last_error,
+// prefixed with `where`
+void set_error(const char *where);
+
+// One interpreter per process, initialized on first use.  The host
+// process controls module search via PYTHONPATH (must reach mxnet_tpu
+// and its deps) and device selection via JAX_PLATFORMS / MXNET_* env.
+// Also promotes libpython's symbols to the global namespace for
+// RTLD_LOCAL hosts (perl XS / R / JNI) so python C-extensions import.
+bool ensure_python();
+
+// build {key: (d0, d1, ...)} from c_predict_api-style shape tables
+PyObject *shapes_dict(uint32_t n, const char **keys,
+                      const uint32_t **shape_data,
+                      const uint32_t *shape_ndim);
+
+class Gil {
+ public:
+  Gil() : state_(PyGILState_Ensure()) {}
+  ~Gil() { PyGILState_Release(state_); }
+
+ private:
+  PyGILState_STATE state_;
+};
+
+}  // namespace mxt_embed
+
+#endif  // MXT_PY_EMBED_H_

@@ -189,3 +189,83 @@ def test_capi_predict_set_input_size_validation(tmp_path):
     p.set_input("data", np.zeros(24, "f"))  # flat but size-matching: ok
     with pytest.raises(MXNetError):
         p.set_input("data", np.zeros(23, "f"))
+
+
+CAPI_TRAIN_BIN = os.path.join(REPO, "cpp-package", "example", "capi_train")
+
+
+def test_capi_train_matches_python(tmp_path):
+    """Core C API (mxt_capi.h; VERDICT r4 #9 — parity c_api.h:153-361 +
+    MXImperativeInvoke + simple_bind): a plain-C program TRAINS an MLP —
+    symbol load, simple-bind, param upload via op-invoke _copy,
+    forward/backward, in-place sgd_update per parameter — and its loss
+    trajectory matches the python executor running the identical recipe
+    step for step."""
+    subprocess.run(["make", "predict_capi", "capi_example"], cwd=REPO,
+                   check=True, capture_output=True)
+    N, D, C = 128, 12, 3
+    rs = np.random.RandomState(7)
+    centers = rs.normal(0, 2.0, (C, D)).astype("f")
+    y = rs.randint(0, C, N)
+    X = (centers[y] + rs.normal(0, 0.4, (N, D))).astype("f")
+
+    net = sym.FullyConnected(sym.Variable("data"), num_hidden=16,
+                             name="fc1")
+    net = sym.Activation(net, act_type="relu")
+    net = sym.SoftmaxOutput(
+        sym.FullyConnected(net, num_hidden=C, name="fc2"), name="softmax")
+    mod = mx.mod.Module(net)
+    from mxnet_tpu.io import DataDesc
+    mod.bind(data_shapes=[DataDesc("data", (N, D), np.float32)],
+             label_shapes=[DataDesc("softmax_label", (N,), np.float32)])
+    mod.init_params(mx.init.Xavier())
+    arg, aux = mod.get_params()
+    prefix = str(tmp_path / "ct")
+    mx.model.save_checkpoint(prefix, 1, net, arg, aux)
+    X.tofile(str(tmp_path / "X.f32"))
+    y.astype("f").tofile(str(tmp_path / "Y.f32"))
+
+    # python reference: the SAME recipe through capi_support
+    from mxnet_tpu import capi_support as cs
+    ex = cs.simple_bind(cs.symbol_from_json(open(prefix + "-symbol.json")
+                                            .read()),
+                        {"data": (N, D), "softmax_label": (N,)})
+    keys, arrs = cs.load(prefix + "-0001.params")
+    for k, a in zip(keys, arrs):
+        name = k.split(":", 1)[1] if ":" in k else k
+        if name in ex.arg_dict:
+            cs.invoke("_copy", [a], {}, outputs=[ex.arg_dict[name]])
+    cs.nd_from_bytes(ex.arg_dict["data"], X.tobytes())
+    cs.nd_from_bytes(ex.arg_dict["softmax_label"],
+                     y.astype("f").tobytes())
+    ref_losses = []
+    for _ in range(6):
+        ex.forward(True)
+        ex.backward()
+        for n in ex.arg_dict:
+            if n in ("data", "softmax_label"):
+                continue
+            cs.invoke("sgd_update", [ex.arg_dict[n], ex.grad_dict[n]],
+                      {"lr": "0.2", "wd": "0.0",
+                       "rescale_grad": str(1.0 / N)},
+                      outputs=[ex.arg_dict[n]])
+        p = ex.outputs[0].asnumpy()
+        ref_losses.append(float(-np.log(np.maximum(
+            p[np.arange(N), y], 1e-8)).mean()))
+
+    env = {**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONPATH": REPO}
+    proc = subprocess.run(
+        [CAPI_TRAIN_BIN, prefix + "-symbol.json", prefix + "-0001.params",
+         str(tmp_path / "X.f32"), str(tmp_path / "Y.f32"),
+         str(N), str(D), str(C), "6", "0.2"],
+        env=env, capture_output=True, text=True, timeout=420)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    lines = proc.stdout.strip().splitlines()
+    c_losses = [float(ln.split()[-1]) for ln in lines[:-1]]
+    acc = float(lines[-1].split()[-1])
+    # real learning through the C ABI...
+    assert c_losses[0] > 1.0 and c_losses[-1] < 0.1, c_losses
+    assert acc > 0.95, acc
+    # ...and the exact trajectory the python executor produces
+    np.testing.assert_allclose(c_losses, ref_losses, rtol=1e-4,
+                               atol=1e-5)
