@@ -1,0 +1,136 @@
+"""Flash-attention root-cause matrix (VERDICT r4 #5).
+
+The r04c window showed `mha_flash` failing with an HTTP 500 from the
+tunnel's remote Mosaic helper, so ops/flash_attention.py has only ever
+been validated in CPU interpreter mode.  This probe separates the
+possible causes when run on the real chip, each leg in a watchdogged
+subprocess:
+
+  1. trivial-kernel: a 1-line Pallas add kernel.  Fails => the Mosaic
+     toolchain itself is down (infra, outside this repo).
+  2. mini-flash: the miniature of the real kernel (same scratch shapes,
+     3-D grid).  Fails while (1) passes => OUR kernel trips the
+     compiler — a repo bug worth chasing.
+  3. flash-interpret on-chip shapes: the real kernel, interpret=True
+     (pure XLA, no Mosaic) at B1 H4 T1024 D64, checked against dense
+     attention to 2e-2.  Passes => the kernel's math is right at real
+     sizes even when the Mosaic path is blocked.
+  4. dense-fallback: the user-facing MultiHeadAttention path with the
+     probe forced unavailable — the degradation users actually get.
+
+Prints one PASS/FAIL line per leg + verbatim tails; chip_window
+captures the whole output as FLASHPROBE_<tag>.txt.  On CPU all four
+legs run (1 and 2 compile in interpret mode) — CI smoke covers the
+harness itself.
+"""
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+TIMEOUT = float(os.environ.get("MXT_FLASH_PROBE_TIMEOUT", 240))
+
+LEGS = {
+    "trivial-kernel": """
+import jax, jax.numpy as jnp
+from jax.experimental import pallas as pl
+def add_one(x_ref, o_ref):
+    o_ref[...] = x_ref[...] + 1.0
+x = jnp.zeros((8, 128), jnp.float32)
+interp = jax.default_backend() != "tpu"
+out = pl.pallas_call(
+    add_one, out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+    interpret=interp)(x)
+assert float(out.sum()) == 8 * 128
+print("LEG_OK trivial-kernel (interpret=%s)" % interp)
+""",
+    "mini-flash": """
+from mxnet_tpu.ops import flash_attention as fa
+import jax, jax.numpy as jnp
+q = jnp.ones((1, 1, 128, 64), jnp.float32)
+out = fa._flash_attention(q, q, q, 1.0, False, 128, 128)
+float(out.sum())
+print("LEG_OK mini-flash")
+""",
+    "flash-interpret-onchip-shapes": """
+import os
+os.environ["MXT_FLASH_INTERPRET"] = "1"  # real kernel, pure-XLA lowering
+import numpy as np
+import jax, jax.numpy as jnp
+from mxnet_tpu.ops import flash_attention as fa
+rs = np.random.RandomState(0)
+B, H, T, D = 1, 4, 1024, 64
+q, k, v = (jnp.asarray(rs.normal(0, 1, (B, H, T, D)).astype("f"))
+           for _ in range(3))
+scale = D ** -0.5
+out = fa._flash_attention(q, k, v, scale, True, 128, 128)
+s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+mask = jnp.arange(T)[:, None] >= jnp.arange(T)[None, :]
+s = jnp.where(mask[None, None], s, -1e30)
+ref = jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(s, -1), v)
+err = float(jnp.max(jnp.abs(out - ref)))
+assert err < 2e-2, err
+print("LEG_OK flash-interpret-onchip-shapes max_err=%.2e" % err)
+""",
+    "dense-fallback": """
+import numpy as np
+from mxnet_tpu.ops import flash_attention as fa
+fa._PALLAS_OK = False  # force the degraded path users would see
+from mxnet_tpu import nd
+rs = np.random.RandomState(1)
+q = nd.array(rs.normal(0, 1, (2, 2, 64, 16)).astype("f"))
+out = nd._contrib_flash_attention(q, q, q, causal=True)
+assert np.isfinite(out.asnumpy()).all()
+print("LEG_OK dense-fallback")
+""",
+}
+
+
+def main():
+    results = {}
+    for name, body in LEGS.items():
+        # importing mxnet_tpu first applies the cpu-only axon guard
+        # (base.py) — a bare `import jax` under JAX_PLATFORMS=cpu would
+        # still contact a dead tunnel and hang the leg
+        snippet = ("import sys; sys.path.insert(0, %r); "
+                   "import mxnet_tpu\n%s" % (REPO, body))
+        env = {k: v for k, v in os.environ.items()
+               if not k.startswith("DMLC_")}
+        env["MXT_PALLAS_PROBE"] = "1"  # children never re-probe
+        t0 = time.perf_counter()
+        try:
+            r = subprocess.run([sys.executable, "-c", snippet],
+                               capture_output=True, text=True,
+                               timeout=TIMEOUT, env=env)
+            ok = r.returncode == 0 and "LEG_OK" in r.stdout
+            tail = "" if ok else (r.stdout + r.stderr)[-1500:]
+        except subprocess.TimeoutExpired:
+            ok, tail = False, "TIMEOUT after %.0fs" % TIMEOUT
+        dt = time.perf_counter() - t0
+        results[name] = ok
+        print("%s: %s (%.1fs)" % (name, "PASS" if ok else "FAIL", dt),
+              flush=True)
+        if tail:
+            print("--- %s output tail ---\n%s\n---" % (name, tail),
+                  flush=True)
+
+    # the attribution line the VERDICT asked for
+    if results.get("trivial-kernel") is False:
+        print("VERDICT: Mosaic toolchain itself is unavailable on this "
+              "backend (trivial kernel fails) — blocker is OUTSIDE the "
+              "repo; flash kernel validated via interpret leg:",
+              results.get("flash-interpret-onchip-shapes"), flush=True)
+    elif results.get("mini-flash") is False:
+        print("VERDICT: Mosaic works but OUR kernel fails to compile — "
+              "repo-side bug, see mini-flash tail above", flush=True)
+    else:
+        print("VERDICT: full Pallas flash path compiles on this backend",
+              flush=True)
+    return 0 if all(results.values()) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -84,10 +84,18 @@ def pallas_available(timeout=150.0):
         out = subprocess.run([_sys.executable, "-c", snippet],
                              capture_output=True, text=True,
                              timeout=timeout, env=child_env)
+        log_path = os.environ.get("MXT_PALLAS_PROBE_LOG")
+        if log_path:
+            # VERBATIM toolchain output for the window artifact (the
+            # r4 consistency record only kept a 300-char tail — not
+            # enough to attribute the remote Mosaic 500 to infra)
+            with open(log_path, "w") as f:
+                f.write("rc=%s\n--- stdout ---\n%s\n--- stderr ---\n%s"
+                        % (out.returncode, out.stdout, out.stderr))
         if out.returncode == 0 and "PALLAS_PROBE_OK" in out.stdout:
             _PALLAS_OK = True
             return True
-        tail = (out.stdout + out.stderr)[-400:]
+        tail = (out.stdout + out.stderr)[-1200:]
         low = tail.lower()
         if ("already in use" in low or "libtpu" in low and "lock" in low
                 or "resource busy" in low):
@@ -97,7 +105,7 @@ def pallas_available(timeout=150.0):
             # the probe existed
             _PALLAS_OK = True
             return True
-        _PALLAS_ERR = tail[-300:]
+        _PALLAS_ERR = tail[-1000:]
     except subprocess.TimeoutExpired:
         _PALLAS_ERR = "probe timed out after %.0fs (hung toolchain)" \
             % timeout
@@ -170,7 +178,13 @@ def _dense_reference(q, k, v, scale, causal):
 def _flash_attention(q, k, v, scale, causal, blk_q, blk_k):
     B, H, Tq, D = q.shape
     Tk = k.shape[2]
-    if Tq % blk_q or Tk % blk_k or not pallas_available():
+    # MXT_FLASH_INTERPRET=1 forces the interpret lowering (pure XLA, no
+    # Mosaic) even on TPU — the kernel stays validatable at real shapes
+    # when the tunnel's remote Mosaic helper is down (VERDICT r4 #5)
+    import os as _os
+    interp = (jax.default_backend() != "tpu"
+              or bool(_os.environ.get("MXT_FLASH_INTERPRET")))
+    if Tq % blk_q or Tk % blk_k or (not interp and not pallas_available()):
         return _dense_reference(q, k, v, scale, causal)
     from jax.experimental.pallas import tpu as pltpu
     qr = q.reshape(B * H, Tq, D)
@@ -195,7 +209,7 @@ def _flash_attention(q, k, v, scale, causal, blk_q, blk_k):
         ],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
-        interpret=jax.default_backend() != "tpu",
+        interpret=interp,
     )(qr, kr, vr)
     return out.reshape(B, H, Tq, D)
 

@@ -155,7 +155,7 @@ def main():
     ap.add_argument("--step-timeout", type=float, default=900.0)
     ap.add_argument("--batch", type=int, default=256)
     ap.add_argument("--steps", default="bench,score,consistency,layout,"
-                    "nhwc,benchnhwc,r01cfg,profile,fusedprobe",
+                    "nhwc,benchnhwc,r01cfg,flashprobe,profile,fusedprobe",
                     help="which steps to run, in this fixed order "
                          "(VERDICT r4 #2: the first minutes of any window "
                          "belong to the bench; diagnostics after) — "
@@ -174,7 +174,7 @@ def main():
     args = ap.parse_args()
     steps = {s.strip() for s in args.steps.split(",") if s.strip()}
     known = {"consistency", "layout", "nhwc", "profile", "fusedprobe",
-             "bench", "score", "benchnhwc", "r01cfg"}
+             "bench", "score", "benchnhwc", "r01cfg", "flashprobe"}
     if steps - known:
         # a typo must not silently skip a step a rare window exists for
         ap.error(f"unknown --steps {sorted(steps - known)}; "
@@ -266,13 +266,16 @@ def main():
              args.step_timeout * 2, summary_path, env=env,
              capture_to=f"SCORE_{tag}.txt")
 
-    # 3. correctness tier
+    # 3. correctness tier (the flash case's Mosaic probe writes its
+    # verbatim toolchain output to a durable artifact, VERDICT r4 #5)
     if "consistency" in steps:
         cmd = [sys.executable, "tools/run_tpu_consistency.py",
                "--out", os.path.join(REPO, f"CONSISTENCY_{tag}.json")]
         if args.consistency_subset:
             cmd += ["--only", args.consistency_subset]
-        _run("consistency", cmd, args.step_timeout * 2, summary_path)
+        _run("consistency", cmd, args.step_timeout * 2, summary_path,
+             env={"MXT_PALLAS_PROBE_LOG":
+                  os.path.join(REPO, f"MOSAIC_PROBE_{tag}.txt")})
 
     # 4. layout/precision A/B (raw JAX ceiling probe)
     winner = (layout_ab(summary_path, args.batch, args.step_timeout)
@@ -306,6 +309,15 @@ def main():
             _run("bench_r01_config",
                  [sys.executable, "experiments/bench_r01_config.py"],
                  args.step_timeout, summary_path))
+
+    # 7b. flash-attention root-cause matrix (VERDICT r4 #5): trivial
+    # Pallas kernel vs our kernel vs interpret-at-real-shapes vs dense
+    # fallback — attributes the remote-Mosaic 500 to infra or repo
+    if "flashprobe" in steps:
+        _run("flash_probe",
+             [sys.executable, "experiments/flash_probe.py"],
+             args.step_timeout * 2, summary_path,
+             capture_to=f"FLASHPROBE_{tag}.txt")
 
     # 8. diagnostics, cheapest-to-lose last: where does fit() time go
     if "profile" in steps:
