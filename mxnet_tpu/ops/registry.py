@@ -86,9 +86,26 @@ def register(name, input_names=("data",), args: Sequence[Arg] = (),
         OP_REGISTRY[name] = op
         for a in aliases:
             OP_ALIASES[a] = name
+        _attach_frontends(name, aliases)
         return fn
 
     return _reg
+
+
+# Frontend attach hooks: the nd/sym register modules append a
+# callback(op_name) here at import time; late registrations (a user op
+# registered AFTER import — the docs/faq/new_op.md workflow; parity
+# with the reference, where custom creators appear in the enumerated
+# op list immediately) replay through them so mx.nd.*/mx.sym.* pick
+# the new op up.  Empty during the initial import pass (populate()
+# builds the full table then).
+FRONTEND_ATTACH_HOOKS: List = []
+
+
+def _attach_frontends(name, aliases):
+    for hook in FRONTEND_ATTACH_HOOKS:
+        for nm in (name, *aliases):
+            hook(nm)
 
 
 def get_op(name: str) -> Operator:

