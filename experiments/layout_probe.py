@@ -8,12 +8,29 @@ designed to hit it.  Variants:
 """
 import argparse
 import functools
+import os
 import time
 
 import numpy as np
 import jax
 import jax.numpy as jnp
 from jax import lax
+
+# CPU smoke runs (JAX_PLATFORMS=cpu): deregister the axon factory or a
+# dead tunnel hangs the first backend call.  Inlined rather than
+# importing mxnet_tpu — this probe is RAW jax by design (no x64 flag,
+# no framework imports) so it measures the ceiling, not the package.
+if [x for x in os.environ.get("JAX_PLATFORMS", "").split(",")
+        if x.strip()] == ["cpu"]:
+    try:
+        from jax._src import xla_bridge as _xb
+        _xb._backend_factories.pop("axon", None)
+    except Exception:
+        pass
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass
 
 L = [3, 4, 6, 3]
 WIDTHS = [64, 128, 256, 512]
@@ -125,11 +142,11 @@ def make_params(layout, dtype):
     return p
 
 
-def run(layout, bn_dtype, resident, batch, steps=10):
+def run(layout, bn_dtype, resident, batch, steps=10, img=224):
     dtype = jnp.bfloat16 if resident == "bf16" else jnp.float32
     p = make_params(layout, dtype)
     rs = np.random.RandomState(1)
-    shape = (batch, 3, 224, 224) if layout == "NCHW" else (batch, 224, 224, 3)
+    shape = (batch, 3, img, img) if layout == "NCHW" else (batch, img, img, 3)
     x = jnp.asarray(rs.normal(0, 1, shape), jnp.bfloat16)
     y = jnp.asarray(rs.randint(0, 1000, (batch,)), jnp.int32)
     bnd = jnp.float32 if bn_dtype == "f32" else jnp.bfloat16
@@ -162,14 +179,23 @@ if __name__ == "__main__":
     ap.add_argument("--bn", default="f32")
     ap.add_argument("--resident", default="bf16")
     ap.add_argument("--batch", type=int, default=256)
+    ap.add_argument("--img", type=int, default=224)  # CPU smoke: 64
     a = ap.parse_args()
-    r = run(a.layout, a.bn, a.resident, a.batch)
+    r = run(a.layout, a.bn, a.resident, a.batch, img=a.img)
+    import sys
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
     from mxnet_tpu.chip import mfu
-    m = mfu(r)
-    if m["mfu"] is not None:
-        tail = f"{m['mfu']*100:.1f}% MFU on {m['chip']}"
+    # the FLOPs-per-image constant assumes 224^2 — no MFU line for
+    # smoke-sized images
+    if a.img != 224:
+        tail = "smoke size; no MFU"
     else:
-        tail = (f"~{m['mfu_if_v5e']*100:.0f}% MFU v5e-class / "
-                f"~{m['mfu_if_v5p']*100:.0f}% v5p-class ({m['chip']!r})")
+        m = mfu(r)
+        if m["mfu"] is not None:
+            tail = f"{m['mfu']*100:.1f}% MFU on {m['chip']}"
+        else:
+            tail = (f"~{m['mfu_if_v5e']*100:.0f}% MFU v5e-class / "
+                    f"~{m['mfu_if_v5p']*100:.0f}% v5p-class ({m['chip']!r})")
     print(f"layout={a.layout} bn={a.bn} resident={a.resident} batch={a.batch}: "
           f"{r:.1f} img/s  ({tail})")

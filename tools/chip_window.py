@@ -155,7 +155,8 @@ def main():
     ap.add_argument("--step-timeout", type=float, default=900.0)
     ap.add_argument("--batch", type=int, default=256)
     ap.add_argument("--steps", default="bench,score,consistency,layout,"
-                    "nhwc,benchnhwc,r01cfg,flashprobe,profile,fusedprobe",
+                    "nhwc,benchnhwc,r01cfg,flashprobe,flagsweep,profile,"
+                    "fusedprobe",
                     help="which steps to run, in this fixed order "
                          "(VERDICT r4 #2: the first minutes of any window "
                          "belong to the bench; diagnostics after) — "
@@ -174,7 +175,8 @@ def main():
     args = ap.parse_args()
     steps = {s.strip() for s in args.steps.split(",") if s.strip()}
     known = {"consistency", "layout", "nhwc", "profile", "fusedprobe",
-             "bench", "score", "benchnhwc", "r01cfg", "flashprobe"}
+             "bench", "score", "benchnhwc", "r01cfg", "flashprobe",
+             "flagsweep"}
     if steps - known:
         # a typo must not silently skip a step a rare window exists for
         ap.error(f"unknown --steps {sorted(steps - known)}; "
@@ -318,6 +320,19 @@ def main():
              [sys.executable, "experiments/flash_probe.py"],
              args.step_timeout * 2, summary_path,
              capture_to=f"FLASHPROBE_{tag}.txt")
+
+    # 7c. XLA flag sweep at the raw ceiling (latency-hiding scheduler,
+    # scoped-VMEM) under the winning layout
+    if "flagsweep" in steps:
+        _run("xla_flag_sweep",
+             [sys.executable, "experiments/xla_flag_sweep.py"],
+             args.step_timeout * 2, summary_path,
+             env={"B": str(args.batch),
+                  "MXT_FLAG_SWEEP_LAYOUT":
+                      (args.conv_layout or
+                       (winner["layout"] if winner and winner["img_s"] > 0
+                        else "NHWC"))},
+             capture_to=f"FLAGSWEEP_{tag}.txt")
 
     # 8. diagnostics, cheapest-to-lose last: where does fit() time go
     if "profile" in steps:
