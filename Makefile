@@ -54,8 +54,9 @@ $(CPP_EX): cpp-package/example/mlp_predict.cc $(LIB) \
 
 CAPI_EX := cpp-package/example/capi_predict
 CAPI_TRAIN_EX := cpp-package/example/capi_train
+CAPI_KV_EX := cpp-package/example/capi_kv_iter
 
-capi_example: $(CAPI_EX) $(CAPI_TRAIN_EX)
+capi_example: $(CAPI_EX) $(CAPI_TRAIN_EX) $(CAPI_KV_EX)
 
 $(CAPI_EX): cpp-package/example/capi_predict.c $(PRED_LIB) \
             src/runtime/mxt_predict.h
@@ -67,6 +68,12 @@ $(CAPI_TRAIN_EX): cpp-package/example/capi_train.c $(PRED_LIB) \
             src/runtime/mxt_capi.h
 	$(CC) -O2 -Wall -o $@ $< \
 	    -Lmxnet_tpu/_native -lmxt_predict -lm \
+	    -Wl,-rpath,'$$ORIGIN/../../mxnet_tpu/_native'
+
+$(CAPI_KV_EX): cpp-package/example/capi_kv_iter.c $(PRED_LIB) \
+            src/runtime/mxt_capi.h
+	$(CC) -O2 -Wall -o $@ $< \
+	    -Lmxnet_tpu/_native -lmxt_predict \
 	    -Wl,-rpath,'$$ORIGIN/../../mxnet_tpu/_native'
 
 test: native

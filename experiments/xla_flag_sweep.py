@@ -62,6 +62,12 @@ def main():
         print("%-16s %8.1f img/s  (%.0fs)%s"
               % (name, rate, time.perf_counter() - t0,
                  ("  [" + tail + "]") if tail else ""), flush=True)
+    if not results:
+        # a typo'd MXT_FLAG_SWEEP_ONLY must fail loudly, not traceback
+        known = ", ".join(n for n, _ in CONFIGS)
+        print("no configs matched MXT_FLAG_SWEEP_ONLY=%r (known: %s)"
+              % (",".join(sorted(ONLY)), known), flush=True)
+        return 1
     best = max(results, key=lambda x: x[1])
     base_rate = dict(results).get("baseline", 0.0)
     if best[1] > 0:

@@ -101,3 +101,53 @@ def load(fname):
         keys = sorted(d)
         return keys, [d[k] for k in keys]
     return [str(i) for i in range(len(d))], list(d)
+
+
+def _coerce_str(v: str):
+    """Literal-coerce a string kwarg for iterator creation ("32" -> 32,
+    "(3, 8, 8)" -> tuple, "true" -> True, else the string itself)."""
+    low = v.strip().lower()
+    if low in ("true", "false"):
+        return low == "true"
+    import ast
+    try:
+        return ast.literal_eval(v)
+    except (ValueError, SyntaxError):
+        return v
+
+
+def kv_create(kind):
+    from . import kvstore
+    return kvstore.create(kind)
+
+
+def kv_init(kv, key, value):
+    kv.init(key, value)
+
+
+def kv_push(kv, key, value, priority=0):
+    kv.push(key, value, priority=priority)
+
+
+def kv_pull(kv, key, out, priority=0):
+    kv.pull(key, out=out, priority=priority)
+
+
+def iter_create(name, params):
+    """Create a mx.io iterator by class name with string kwargs
+    (MXTDataIterCreate; parity: MXDataIterCreateIter over the iterator
+    registry with char** params)."""
+    from . import io as _io
+    cls = getattr(_io, name, None)
+    if cls is None or not callable(cls):
+        raise MXNetError(f"unknown data iterator '{name}'")
+    return cls(**{k: _coerce_str(v) for k, v in params.items()})
+
+
+def iter_next(it):
+    """Advance; returns the DataBatch or None at epoch end (the C layer
+    turns this into the has-next flag + cached current batch)."""
+    try:
+        return next(it)
+    except StopIteration:
+        return None

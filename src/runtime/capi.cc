@@ -338,8 +338,14 @@ int MXTImperativeInvoke(const char *op_name, MXTNDArrayHandle *inputs,
   PyObject *params = PyDict_New();
   for (uint32_t i = 0; i < num_params; ++i) {
     PyObject *v = PyUnicode_FromString(param_vals[i]);
+    if (v == nullptr) {  // non-UTF-8 attr value: error, not a crash
+      set_error("Invoke: bad param string");
+      Py_DECREF(params);
+      Py_DECREF(ins);
+      return -1;
+    }
     PyDict_SetItemString(params, param_keys[i], v);  // INCREFs v
-    Py_XDECREF(v);
+    Py_DECREF(v);
   }
   PyObject *outs;
   uint32_t n_prealloc = *num_outputs;
@@ -562,6 +568,208 @@ void MXTExecutorFree(MXTExecutorHandle h) {
   if (h == nullptr || !Py_IsInitialized()) return;
   Gil gil;
   Py_DECREF((PyObject *)h);
+}
+
+/* ---------------- KVStore ---------------- */
+
+int MXTKVStoreCreate(const char *type, MXTKVStoreHandle *out) {
+  if (type == nullptr || out == nullptr) return -1;
+  *out = nullptr;
+  if (!ensure_python()) return -1;
+  Gil gil;
+  PyObject *r = call_support("kv_create", Py_BuildValue("(s)", type));
+  if (r == nullptr) return -1;
+  *out = r;
+  return 0;
+}
+
+static int kv_call(const char *fn, MXTKVStoreHandle h, const char *key,
+                   MXTNDArrayHandle value, int priority, int with_prio) {
+  if (h == nullptr || key == nullptr || value == nullptr) return -1;
+  Gil gil;
+  PyObject *args = with_prio
+      ? Py_BuildValue("(OsOi)", (PyObject *)h, key, (PyObject *)value,
+                      priority)
+      : Py_BuildValue("(OsO)", (PyObject *)h, key, (PyObject *)value);
+  PyObject *r = call_support(fn, args);
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXTKVStoreInit(MXTKVStoreHandle h, const char *key,
+                   MXTNDArrayHandle value) {
+  return kv_call("kv_init", h, key, value, 0, 0);
+}
+
+int MXTKVStorePush(MXTKVStoreHandle h, const char *key,
+                   MXTNDArrayHandle value, int priority) {
+  return kv_call("kv_push", h, key, value, priority, 1);
+}
+
+int MXTKVStorePull(MXTKVStoreHandle h, const char *key,
+                   MXTNDArrayHandle out, int priority) {
+  return kv_call("kv_pull", h, key, out, priority, 1);
+}
+
+static int int_attr(PyObject *obj, const char *attr, int *out) {
+  Gil gil;
+  PyObject *v = PyObject_GetAttrString(obj, attr);
+  if (v == nullptr) {
+    set_error(attr);
+    return -1;
+  }
+  long n = PyLong_AsLong(v);
+  Py_DECREF(v);
+  if (n == -1 && PyErr_Occurred()) {
+    set_error(attr);
+    return -1;
+  }
+  *out = (int)n;
+  return 0;
+}
+
+int MXTKVStoreGetRank(MXTKVStoreHandle h, int *rank) {
+  if (h == nullptr || rank == nullptr) return -1;
+  return int_attr((PyObject *)h, "rank", rank);
+}
+
+int MXTKVStoreGetGroupSize(MXTKVStoreHandle h, int *size) {
+  if (h == nullptr || size == nullptr) return -1;
+  return int_attr((PyObject *)h, "num_workers", size);
+}
+
+void MXTKVStoreFree(MXTKVStoreHandle h) {
+  if (h == nullptr || !Py_IsInitialized()) return;
+  Gil gil;
+  Py_DECREF((PyObject *)h);
+}
+
+/* ---------------- DataIter ---------------- */
+
+namespace {
+// iterator handle: the python iterator + the cached current batch
+struct IterHandle {
+  PyObject *it;
+  PyObject *batch;  // current DataBatch or nullptr
+};
+}  // namespace
+
+int MXTDataIterCreate(const char *name, const char **keys,
+                      const char **vals, uint32_t num,
+                      MXTDataIterHandle *out) {
+  if (name == nullptr || out == nullptr ||
+      (num > 0 && (keys == nullptr || vals == nullptr)))
+    return -1;
+  *out = nullptr;
+  if (!ensure_python()) return -1;
+  Gil gil;
+  PyObject *params = PyDict_New();
+  if (params == nullptr) return -1;
+  for (uint32_t i = 0; i < num; ++i) {
+    PyObject *v = PyUnicode_FromString(vals[i]);
+    if (v == nullptr) {  // e.g. non-UTF-8 path bytes: error, not a crash
+      set_error("DataIterCreate: bad param string");
+      Py_DECREF(params);
+      return -1;
+    }
+    PyDict_SetItemString(params, keys[i], v);
+    Py_DECREF(v);
+  }
+  PyObject *r = call_support("iter_create",
+                             Py_BuildValue("(sN)", name, params));
+  if (r == nullptr) return -1;
+  IterHandle *ih = new IterHandle{r, nullptr};
+  *out = ih;
+  return 0;
+}
+
+int MXTDataIterNext(MXTDataIterHandle h, int *out_has_next) {
+  if (h == nullptr || out_has_next == nullptr) return -1;
+  IterHandle *ih = (IterHandle *)h;
+  Gil gil;
+  PyObject *b = call_support("iter_next",
+                             Py_BuildValue("(O)", ih->it));
+  if (b == nullptr) return -1;
+  Py_XDECREF(ih->batch);
+  if (b == Py_None) {
+    Py_DECREF(b);
+    ih->batch = nullptr;
+    *out_has_next = 0;
+  } else {
+    ih->batch = b;
+    *out_has_next = 1;
+  }
+  return 0;
+}
+
+int MXTDataIterBeforeFirst(MXTDataIterHandle h) {
+  if (h == nullptr) return -1;
+  IterHandle *ih = (IterHandle *)h;
+  Gil gil;
+  PyObject *r = PyObject_CallMethod(ih->it, "reset", nullptr);
+  if (r == nullptr) {
+    set_error("BeforeFirst");
+    return -1;
+  }
+  Py_DECREF(r);
+  Py_XDECREF(ih->batch);
+  ih->batch = nullptr;
+  return 0;
+}
+
+static int batch_piece(MXTDataIterHandle h, const char *attr,
+                       MXTNDArrayHandle *out) {
+  IterHandle *ih = (IterHandle *)h;
+  if (ih->batch == nullptr) {
+    g_last_error = "no current batch (call MXTDataIterNext first)";
+    return -1;
+  }
+  Gil gil;
+  PyObject *lst = PyObject_GetAttrString(ih->batch, attr);
+  if (lst == nullptr) {
+    set_error(attr);
+    return -1;
+  }
+  PyObject *a = PySequence_GetItem(lst, 0);  // new ref
+  Py_DECREF(lst);
+  if (a == nullptr) {
+    set_error(attr);
+    return -1;
+  }
+  *out = a;
+  return 0;
+}
+
+int MXTDataIterGetData(MXTDataIterHandle h, MXTNDArrayHandle *out) {
+  if (h == nullptr || out == nullptr) return -1;
+  return batch_piece(h, "data", out);
+}
+
+int MXTDataIterGetLabel(MXTDataIterHandle h, MXTNDArrayHandle *out) {
+  if (h == nullptr || out == nullptr) return -1;
+  return batch_piece(h, "label", out);
+}
+
+int MXTDataIterGetPadNum(MXTDataIterHandle h, int *out_pad) {
+  if (h == nullptr || out_pad == nullptr) return -1;
+  IterHandle *ih = (IterHandle *)h;
+  if (ih->batch == nullptr) {
+    g_last_error = "no current batch (call MXTDataIterNext first)";
+    return -1;
+  }
+  return int_attr(ih->batch, "pad", out_pad);
+}
+
+void MXTDataIterFree(MXTDataIterHandle h) {
+  if (h == nullptr) return;
+  IterHandle *ih = (IterHandle *)h;
+  if (Py_IsInitialized()) {
+    Gil gil;
+    Py_XDECREF(ih->batch);
+    Py_DECREF(ih->it);
+  }
+  delete ih;
 }
 
 const char *MXTGetLastError(void) { return g_last_error.c_str(); }

@@ -141,3 +141,52 @@ MXT_API const char *MXTGetLastError(void);
 }
 #endif
 #endif /* MXT_CAPI_H_ */
+
+/* ---- KVStore (c_api.h MXKVStore* subset; kvstore.py semantics) ---- */
+/* Re-declared guard: this block appends to the same header. */
+#ifndef MXT_CAPI_KV_H_
+#define MXT_CAPI_KV_H_
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef void *MXTKVStoreHandle;
+typedef void *MXTDataIterHandle;
+
+/* type: "local" / "device" / "tpu_sync" / "dist_sync" */
+MXT_API int MXTKVStoreCreate(const char *type, MXTKVStoreHandle *out);
+MXT_API int MXTKVStoreInit(MXTKVStoreHandle h, const char *key,
+                           MXTNDArrayHandle value);
+MXT_API int MXTKVStorePush(MXTKVStoreHandle h, const char *key,
+                           MXTNDArrayHandle value, int priority);
+/* pulls into the caller's preallocated array (live write) */
+MXT_API int MXTKVStorePull(MXTKVStoreHandle h, const char *key,
+                           MXTNDArrayHandle out, int priority);
+MXT_API int MXTKVStoreGetRank(MXTKVStoreHandle h, int *rank);
+MXT_API int MXTKVStoreGetGroupSize(MXTKVStoreHandle h, int *size);
+MXT_API void MXTKVStoreFree(MXTKVStoreHandle h);
+
+/* ---- DataIter (c_api.h MXDataIter* subset; io.py iterators) ---- */
+
+/* name: a mx.io iterator class ("CSVIter", "NDArrayIter",
+ * "ImageRecordIter", "LibSVMIter", "MNISTIter", ...); keys/vals are
+ * string kwargs, literal-coerced ("(3, 8, 8)" shapes, "32" ints). */
+MXT_API int MXTDataIterCreate(const char *name, const char **keys,
+                              const char **vals, uint32_t num,
+                              MXTDataIterHandle *out);
+/* *out_has_next=1 and advances, or 0 at epoch end. */
+MXT_API int MXTDataIterNext(MXTDataIterHandle h, int *out_has_next);
+MXT_API int MXTDataIterBeforeFirst(MXTDataIterHandle h);  /* reset */
+/* current batch pieces (caller frees the NDArray handles) */
+MXT_API int MXTDataIterGetData(MXTDataIterHandle h,
+                               MXTNDArrayHandle *out);
+MXT_API int MXTDataIterGetLabel(MXTDataIterHandle h,
+                                MXTNDArrayHandle *out);
+MXT_API int MXTDataIterGetPadNum(MXTDataIterHandle h, int *out_pad);
+MXT_API void MXTDataIterFree(MXTDataIterHandle h);
+
+#ifdef __cplusplus
+}
+#endif
+#endif /* MXT_CAPI_KV_H_ */

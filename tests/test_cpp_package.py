@@ -269,3 +269,34 @@ def test_capi_train_matches_python(tmp_path):
     # ...and the exact trajectory the python executor produces
     np.testing.assert_allclose(c_losses, ref_losses, rtol=1e-4,
                                atol=1e-5)
+
+
+CAPI_KV_BIN = os.path.join(REPO, "cpp-package", "example", "capi_kv_iter")
+
+
+def test_capi_kvstore_and_dataiter(tmp_path):
+    """KVStore + DataIter C API (mxt_capi.h MXTKVStore*/MXTDataIter*;
+    parity: c_api.h MXKVStore*/MXDataIter* blocks): a plain-C program
+    streams a CSVIter for two epochs (reset + pad accounting) and runs
+    init/push/pull with values matching the python kvstore."""
+    subprocess.run(["make", "predict_capi", "capi_example"], cwd=REPO,
+                   check=True, capture_output=True)
+    N, D, B = 10, 3, 4
+    X = np.arange(N * D, dtype="f").reshape(N, D)
+    csv = tmp_path / "data.csv"
+    np.savetxt(csv, X, delimiter=",", fmt="%.1f")
+
+    env = {**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONPATH": REPO}
+    proc = subprocess.run(
+        [CAPI_KV_BIN, str(csv), str(N), str(D), str(B)],
+        env=env, capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    lines = proc.stdout.strip().splitlines()
+    # 10 rows at batch 4 -> 3 batches/epoch (last padded by 2), 2 epochs;
+    # the pad rows are excluded from the element sum
+    n_batches, total = int(lines[0].split()[1]), float(lines[0].split()[3])
+    assert n_batches == 6, lines
+    assert total == 2 * float(X.sum()), (total, X.sum())
+    assert lines[1] == "rank 0 of 1", lines
+    # python-parity for two sequential pushes then pull (assign updater)
+    assert lines[2] == "pulled 2.0 2.0", lines
