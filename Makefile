@@ -80,4 +80,5 @@ test: native
 	python -m pytest tests/ -x -q
 
 clean:
-	rm -f $(LIB) $(CPP_EX) $(PRED_LIB) $(CAPI_EX)
+	rm -f $(LIB) $(CPP_EX) $(PRED_LIB) $(CAPI_EX) $(CAPI_TRAIN_EX) \
+	    $(CAPI_KV_EX)
