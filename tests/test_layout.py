@@ -320,3 +320,51 @@ def test_whole_graph_cl_segmented_remat():
         layout.set_conv_layout(prev)
     np.testing.assert_allclose(got1, ref, rtol=1e-5, atol=1e-6)
     np.testing.assert_allclose(got3, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_whole_graph_cl_mixed_paths_1d():
+    """Demote + transparent paths composed: 1D convs (NWC dimension
+    numbers), BN/relu riding the CL tag, a Concat(dim=1) that forces
+    demotion, global pooling, FC — identical to NCHW."""
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu.symbol.graph import GraphPlan
+
+    d = mx.sym.Variable("data")
+    c = mx.sym.Convolution(d, kernel=(3,), num_filter=6, pad=(1,),
+                           name="m1c1")
+    c = mx.sym.BatchNorm(c, name="m1bn")
+    c = mx.sym.Activation(c, act_type="relu")
+    c2 = mx.sym.Convolution(c, kernel=(3,), num_filter=6, pad=(1,),
+                            name="m1c2")
+    s = mx.sym.Concat(c, c2, dim=1)          # layout-sensitive: demotes
+    p = mx.sym.Pooling(s, global_pool=True, pool_type="avg")
+    out = mx.sym.FullyConnected(mx.sym.Flatten(p), num_hidden=3)
+    plan = GraphPlan(out)
+    arg_shapes, _, aux_shapes = out.infer_shape(data=(2, 4, 16))
+    rs2 = np.random.RandomState(0)
+    args = {n: jnp.asarray(rs2.normal(0, 0.1, sh).astype("f"))
+            for n, sh in zip(out.list_arguments(), arg_shapes)
+            if n != "data"}
+    aux = {n: (jnp.ones if n.endswith(("var", "gamma"))
+               else jnp.zeros)(sh, jnp.float32)
+           for n, sh in zip(out.list_auxiliary_states(), aux_shapes)}
+    x = jnp.asarray(rs2.normal(0, 1, (2, 4, 16)).astype("f"))
+    key = jax.random.PRNGKey(0)
+
+    def make(tag):
+        def f(a, xx, _t=tag):
+            dd = dict(a)
+            dd["data"] = xx
+            o, _ = plan.run(dd, aux, key, True)
+            return o[0]
+        return f
+
+    prev = layout.set_conv_layout("NCHW")
+    try:
+        ref = np.asarray(jax.jit(make("nchw"))(args, x))
+        layout.set_conv_layout("NHWC")
+        got = np.asarray(jax.jit(make("nhwc"))(args, x))
+    finally:
+        layout.set_conv_layout(prev)
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
