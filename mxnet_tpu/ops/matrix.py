@@ -153,6 +153,12 @@ def _slice_like(p, x, y):
 @register("Concat", input_names=("args",), variadic=True, aliases=("concat",),
           args=[Arg("dim", int, 1), Arg("num_args", int, 0)])
 def _concat(p, *xs):
+    # __io_layout__ == "NHWC" (GraphPlan whole-graph layout pass):
+    # inputs are physically channels-last and dim names the LOGICAL
+    # (NCHW) channel axis 1 — concat over the last axis instead, so
+    # densenet/inception-style concat chains stay channels-last
+    if p.get("__io_layout__") == "NHWC":
+        return jnp.concatenate(xs, axis=xs[0].ndim - 1)
     return jnp.concatenate(xs, axis=p["dim"])
 
 

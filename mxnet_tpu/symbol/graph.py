@@ -312,6 +312,14 @@ class GraphPlan:
             if s0 is not None and s0 == s1:
                 return [v if f else _layout.to_cl(v)
                         for v, f in zip(ins, in_cl)], None, True
+        if (name == "Concat" and p.get("dim", 1) == 1 and any(in_cl)
+                and all(getattr(v, "ndim", 0) >= 3 for v in ins)):
+            # channel-axis concat stays channels-last (the axis moves to
+            # the minor position — ops/matrix.py honors __io_layout__);
+            # densenet/inception concat chains keep the CL region intact
+            return ([v if f else _layout.to_cl(v)
+                     for v, f in zip(ins, in_cl)],
+                    {"__io_layout__": "NHWC"}, True)
         return demote()
 
     # -- execution (pure; call under jit) -----------------------------------

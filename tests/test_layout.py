@@ -323,9 +323,10 @@ def test_whole_graph_cl_segmented_remat():
 
 
 def test_whole_graph_cl_mixed_paths_1d():
-    """Demote + transparent paths composed: 1D convs (NWC dimension
-    numbers), BN/relu riding the CL tag, a Concat(dim=1) that forces
-    demotion, global pooling, FC — identical to NCHW."""
+    """Mixed paths composed: 1D convs (NWC dimension numbers), BN/relu
+    riding the CL tag, a channel-axis Concat that STAYS channels-last
+    (the pass remaps dim=1 to the minor axis), global pooling, FC —
+    identical to NCHW."""
     import jax
     import jax.numpy as jnp
     from mxnet_tpu.symbol.graph import GraphPlan
@@ -337,7 +338,7 @@ def test_whole_graph_cl_mixed_paths_1d():
     c = mx.sym.Activation(c, act_type="relu")
     c2 = mx.sym.Convolution(c, kernel=(3,), num_filter=6, pad=(1,),
                             name="m1c2")
-    s = mx.sym.Concat(c, c2, dim=1)          # layout-sensitive: demotes
+    s = mx.sym.Concat(c, c2, dim=1)      # stays CL (dim remapped)
     p = mx.sym.Pooling(s, global_pool=True, pool_type="avg")
     out = mx.sym.FullyConnected(mx.sym.Flatten(p), num_hidden=3)
     plan = GraphPlan(out)
