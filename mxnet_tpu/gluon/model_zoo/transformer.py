@@ -26,13 +26,21 @@ from ..block import HybridBlock
 class MultiHeadSelfAttention(HybridBlock):
     """Causal multi-head self-attention over (B, T, D) activations.
 
-    attn_type: 'dense' | 'flash' (Pallas kernel, TPU hot path).
+    attn_type: 'dense' | 'flash' (Pallas kernel, TPU hot path) |
+    'ring' / 'ulysses' (sequence parallelism over the ambient
+    `parallel.sp_scope(mesh)` — trace/call the model inside the scope).
+    The sp types compose with eager blocks out of the box (the op
+    reshards to the mesh and back); under a jitted executor the whole
+    step must run over the same mesh (sharded inputs/params), which is
+    how a real sp training step executes anyway.
     """
 
     def __init__(self, dim, num_heads, attn_type="dense", dropout=0.0,
                  **kw):
         super().__init__(**kw)
         assert dim % num_heads == 0
+        if attn_type not in ("dense", "flash", "ring", "ulysses"):
+            raise ValueError(f"unknown attn_type {attn_type!r}")
         self._h = num_heads
         self._dh = dim // num_heads
         self._type = attn_type
@@ -48,9 +56,10 @@ class MultiHeadSelfAttention(HybridBlock):
         # fused `_contrib_multihead_attention` op (ops always see
         # concrete shapes) — so this block hybridizes to a symbol graph
         qkv = self.qkv(x)                                   # (B,T,3D)
+        # 'ring'/'ulysses' shard the sequence over the ambient
+        # parallel.sp_scope mesh — trace the model inside the scope
         out = F.multihead_attention(qkv, num_heads=self._h, causal=True,
-                                    impl="flash" if self._type == "flash"
-                                    else "dense")
+                                    impl=self._type)
         out = self.proj(out)
         return self.drop(out) if self.drop is not None else out
 

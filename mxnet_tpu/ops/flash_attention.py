@@ -21,7 +21,7 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
-from ..base import Arg
+from ..base import Arg, MXNetError
 from .registry import register
 
 NEG_INF = -1e30
@@ -312,6 +312,35 @@ def _multihead_attention_op(p, qkv):
     if p["impl"] == "flash":
         out = _flash_attention(q, k, v, float(scale), bool(p["causal"]),
                                min(128, T), min(128, T))
+    elif p["impl"] in ("ring", "ulysses"):
+        # sequence parallelism as a first-class impl: the mesh comes
+        # from the ambient parallel.sp_scope (captured at trace time);
+        # K/V rotate over ICI (ring) or heads re-shard via all-to-all
+        # (ulysses) — SURVEY.md §5's "exposed through the same
+        # Module/Gluon APIs" leg
+        if p["scale"] > 0:
+            raise MXNetError("impl='ring'/'ulysses' uses the standard "
+                             "1/sqrt(dh) scale; custom scale is not "
+                             "plumbed through the sharded kernels")
+        from ..parallel import sequence_parallel as _sp
+        mesh, axis = _sp.current_sp_scope()
+        eager = not isinstance(q, jax.core.Tracer)
+        orig_dev = None
+        if eager:
+            # eager arrays arrive committed to one device; place them
+            # sequence-sharded on the scope's mesh for shard_map, and
+            # bring the result back so downstream single-device eager
+            # ops compose (a jitted sp model runs fully on the mesh)
+            from jax.sharding import NamedSharding, PartitionSpec as _P
+            devs = list(q.devices()) if hasattr(q, "devices") else []
+            orig_dev = devs[0] if len(devs) == 1 else None
+            sh = NamedSharding(mesh, _P(None, None, axis, None))
+            q, k, v = (jax.device_put(a, sh) for a in (q, k, v))
+        fn = (_sp.ring_attention_sharded if p["impl"] == "ring"
+              else _sp.ulysses_attention_sharded)
+        out = fn(q, k, v, mesh, axis_name=axis, causal=bool(p["causal"]))
+        if eager and orig_dev is not None:
+            out = jax.device_put(out, orig_dev)
     else:
         out = _dense_reference(q, k, v, float(scale), bool(p["causal"]))
     return out.transpose(0, 2, 1, 3).reshape(B, T, D)

@@ -155,6 +155,13 @@ def apply_op(op: Operator, params: Tuple[Tuple[str, Any], ...], inputs) -> Tuple
         pd = dict(params)
         out = op.fn(pd, *inputs)
         return out if isinstance(out, tuple) else (out,)
+    pd = dict(params)
+    if pd.get("impl") in ("ring", "ulysses"):
+        # sequence-parallel impls shard over the ambient sp mesh: run
+        # the fn EAGERLY (shard_map places its own devices) — the
+        # single-device _jitted wrapper would conflict with the mesh
+        out = op.fn(pd, *inputs)
+        return out if isinstance(out, tuple) else (out,)
     from .. import layout as _layout
     return _jitted(op.name, params, _layout.conv_layout())(*inputs)
 

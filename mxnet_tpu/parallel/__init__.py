@@ -16,7 +16,8 @@ from . import collectives
 from . import data_parallel
 from .data_parallel import shard_batch, replicate, DataParallelStep
 from . import sequence_parallel
-from .sequence_parallel import ring_attention, ulysses_attention
+from .sequence_parallel import (ring_attention, sp_scope,
+                               ulysses_attention)
 from . import pipeline
 from .pipeline import (gpipe, gpipe_sharded, pipeline_1f1b,
                        pipeline_train_step)

@@ -131,3 +131,47 @@ def ulysses_attention_sharded(q, k, v, mesh: Mesh, axis_name: str = "sp",
         functools.partial(ulysses_attention, axis_name=axis_name, causal=causal),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec, check_vma=False)
     return fn(q, k, v)
+
+
+# -- ambient sequence-parallel scope (user-facing product surface) ---------
+# The gluon/symbol route into sequence parallelism: ops can't take a Mesh
+# as an attribute, so the mesh is ambient — set it around model CALLS
+# (trace time; CachedOp/executors capture it in the compiled program):
+#
+#     with parallel.sp_scope(mesh):
+#         net = TransformerLM(..., attn_type="ring")
+#         out = net(tokens)          # attention runs ring over 'sp'
+#
+_SP_SCOPE = []
+
+
+class sp_scope:
+    """Context manager declaring the mesh (and axis name) that
+    impl='ring'/'ulysses' attention ops shard the sequence over."""
+
+    def __init__(self, mesh: Mesh, axis_name: str = "sp"):
+        if axis_name not in mesh.axis_names:
+            raise MXNetError(
+                f"sp_scope: mesh has axes {mesh.axis_names}, no "
+                f"'{axis_name}'")
+        self._entry = (mesh, axis_name)
+
+    def __enter__(self):
+        _SP_SCOPE.append(self._entry)
+        return self._entry[0]
+
+    def __exit__(self, *exc):
+        _SP_SCOPE.pop()
+        return False
+
+
+def current_sp_scope():
+    """The innermost (mesh, axis_name), or a loud error — the op-level
+    route (ops/flash_attention.py impl='ring'/'ulysses') calls this at
+    trace time."""
+    if not _SP_SCOPE:
+        raise MXNetError(
+            "sequence-parallel attention (impl='ring'/'ulysses') needs "
+            "an active parallel.sp_scope(mesh) around the model call "
+            "that traces the graph")
+    return _SP_SCOPE[-1]
