@@ -21,7 +21,7 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
-from ..base import Arg, MXNetError
+from ..base import Arg
 from .registry import register
 
 NEG_INF = -1e30
@@ -291,7 +291,8 @@ def _flash_attention_op(p, q, k, v):
           aliases=("multihead_attention",),
           args=[Arg("num_heads", int, required=True),
                 Arg("causal", bool, True), Arg("impl", str, "dense"),
-                Arg("scale", float, -1.0)])
+                Arg("scale", float, -1.0)],
+          sp_impls=("ring", "ulysses"))
 def _multihead_attention_op(p, qkv):
     """Fused causal multi-head self-attention over packed projections.
 
@@ -318,10 +319,6 @@ def _multihead_attention_op(p, qkv):
         # K/V rotate over ICI (ring) or heads re-shard via all-to-all
         # (ulysses) — SURVEY.md §5's "exposed through the same
         # Module/Gluon APIs" leg
-        if p["scale"] > 0:
-            raise MXNetError("impl='ring'/'ulysses' uses the standard "
-                             "1/sqrt(dh) scale; custom scale is not "
-                             "plumbed through the sharded kernels")
         from ..parallel import sequence_parallel as _sp
         mesh, axis = _sp.current_sp_scope()
         eager = not isinstance(q, jax.core.Tracer)
@@ -338,7 +335,8 @@ def _multihead_attention_op(p, qkv):
             q, k, v = (jax.device_put(a, sh) for a in (q, k, v))
         fn = (_sp.ring_attention_sharded if p["impl"] == "ring"
               else _sp.ulysses_attention_sharded)
-        out = fn(q, k, v, mesh, axis_name=axis, causal=bool(p["causal"]))
+        out = fn(q, k, v, mesh, axis_name=axis, causal=bool(p["causal"]),
+                 scale=float(scale))
         if eager and orig_dev is not None:
             out = jax.device_put(out, orig_dev)
     else:

@@ -58,6 +58,12 @@ class Operator:
     f32_inputs: Tuple[int, ...] = ()
     # optional custom vjp: bwd(params, primals, out_grads) -> input grads
     docstring: str = ""
+    # `impl` values for which this op runs sequence-parallel shard_map
+    # over the ambient sp mesh: eager dispatch and make_vjp must place
+    # arrays on the mesh instead of the single-device jit wrapper.
+    # Declared by the op itself (flash_attention.py), so a future op
+    # whose unrelated 'impl' param happens to say "ring" is unaffected.
+    sp_impls: Tuple[str, ...] = ()
 
     def normalize(self, kwargs) -> Tuple[Tuple[str, Any], ...]:
         return self.schema.normalize(kwargs)
@@ -156,7 +162,7 @@ def apply_op(op: Operator, params: Tuple[Tuple[str, Any], ...], inputs) -> Tuple
         out = op.fn(pd, *inputs)
         return out if isinstance(out, tuple) else (out,)
     pd = dict(params)
-    if pd.get("impl") in ("ring", "ulysses"):
+    if op.sp_impls and pd.get("impl") in op.sp_impls:
         # sequence-parallel impls shard over the ambient sp mesh: run
         # the fn EAGERLY (shard_map places its own devices) — the
         # single-device _jitted wrapper would conflict with the mesh
@@ -173,6 +179,35 @@ def make_vjp(op: Operator, params: Tuple[Tuple[str, Any], ...], inputs):
     def run(*ins):
         out = op.fn(pd, *ins)
         return out if isinstance(out, tuple) else (out,)
+
+    if op.sp_impls and pd.get("impl") in op.sp_impls:
+        # Sequence-parallel op under eager autograd: jax.vjp traces
+        # op.fn, so the fn's own concrete-input resharding never runs —
+        # place primals on the ambient sp mesh (replicated: valid for
+        # any op semantics; the inner shard_map re-shards to its specs)
+        # BEFORE tracing, and round-trip outputs / cotangents / grads
+        # so single-device eager neighbors compose.
+        from ..parallel import sequence_parallel as _sp
+        from jax.sharding import NamedSharding, PartitionSpec as _P
+        mesh, _axis = _sp.current_sp_scope()
+        repl = NamedSharding(mesh, _P())
+        devs = [list(a.devices()) for a in inputs
+                if hasattr(a, "devices")]
+        orig = devs[0][0] if devs and len(devs[0]) == 1 else None
+
+        def to_mesh(a):
+            return jax.device_put(a, repl) if hasattr(a, "devices") else a
+
+        outs, vjp_fn = jax.vjp(run, *(to_mesh(a) for a in inputs))
+        if orig is not None:
+            outs = tuple(jax.device_put(o, orig) for o in outs)
+
+            def vjp_back(cts):
+                grads = vjp_fn(tuple(to_mesh(c) for c in cts))
+                return tuple(jax.device_put(g, orig) for g in grads)
+
+            return outs, vjp_back
+        return outs, vjp_fn
 
     return jax.vjp(run, *inputs)
 

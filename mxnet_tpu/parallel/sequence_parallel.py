@@ -87,11 +87,13 @@ def ring_attention(q, k, v, axis_name: str = "sp", causal: bool = False,
 
 
 def ring_attention_sharded(q, k, v, mesh: Mesh, axis_name: str = "sp",
-                           causal: bool = False):
+                           causal: bool = False,
+                           scale: Optional[float] = None):
     """Convenience wrapper: shard (B,H,T,D) arrays on T and run the ring."""
     spec = P(None, None, axis_name, None)
     fn = shard_map(
-        functools.partial(ring_attention, axis_name=axis_name, causal=causal),
+        functools.partial(ring_attention, axis_name=axis_name, causal=causal,
+                          scale=scale),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec, check_vma=False)
     return fn(q, k, v)
 
@@ -125,10 +127,12 @@ def ulysses_attention(q, k, v, axis_name: str = "sp", causal: bool = False,
 
 
 def ulysses_attention_sharded(q, k, v, mesh: Mesh, axis_name: str = "sp",
-                              causal: bool = False):
+                              causal: bool = False,
+                              scale: Optional[float] = None):
     spec = P(None, None, axis_name, None)
     fn = shard_map(
-        functools.partial(ulysses_attention, axis_name=axis_name, causal=causal),
+        functools.partial(ulysses_attention, axis_name=axis_name, causal=causal,
+                          scale=scale),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec, check_vma=False)
     return fn(q, k, v)
 
@@ -142,7 +146,16 @@ def ulysses_attention_sharded(q, k, v, mesh: Mesh, axis_name: str = "sp",
 #         net = TransformerLM(..., attn_type="ring")
 #         out = net(tokens)          # attention runs ring over 'sp'
 #
-_SP_SCOPE = []
+import threading
+
+_SP_TLS = threading.local()  # per-thread scope stack (concurrent traces
+                             # must not observe each other's mesh)
+
+
+def _sp_stack():
+    if not hasattr(_SP_TLS, "stack"):
+        _SP_TLS.stack = []
+    return _SP_TLS.stack
 
 
 class sp_scope:
@@ -157,11 +170,11 @@ class sp_scope:
         self._entry = (mesh, axis_name)
 
     def __enter__(self):
-        _SP_SCOPE.append(self._entry)
+        _sp_stack().append(self._entry)
         return self._entry[0]
 
     def __exit__(self, *exc):
-        _SP_SCOPE.pop()
+        _sp_stack().pop()
         return False
 
 
@@ -169,9 +182,10 @@ def current_sp_scope():
     """The innermost (mesh, axis_name), or a loud error — the op-level
     route (ops/flash_attention.py impl='ring'/'ulysses') calls this at
     trace time."""
-    if not _SP_SCOPE:
+    stack = _sp_stack()
+    if not stack:
         raise MXNetError(
             "sequence-parallel attention (impl='ring'/'ulysses') needs "
             "an active parallel.sp_scope(mesh) around the model call "
             "that traces the graph")
-    return _SP_SCOPE[-1]
+    return stack[-1]

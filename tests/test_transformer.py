@@ -170,3 +170,57 @@ def test_sequence_parallel_attn_types():
     assert_almost_equal(got16, ref16, rtol=1e-4, atol=1e-5,
                         names=("ring-lm", "dense-lm"))
     assert ref_out.shape == (B, T, V)
+
+
+def test_sequence_parallel_training_step():
+    """The review-found gap: eager autograd THROUGH a ring-attention
+    model (make_vjp places primals on the sp mesh and round-trips
+    outputs/cotangents/grads).  One training step must run, produce
+    finite grads matching the dense net's, and a custom scale must
+    plumb through to the sharded kernels."""
+    import jax
+    from jax.sharding import Mesh
+    from mxnet_tpu import nd, parallel
+    from mxnet_tpu.test_utils import assert_almost_equal
+
+    devs = np.array(jax.devices("cpu")[:4])
+    mesh = Mesh(devs, ("sp",))
+    rs = np.random.RandomState(2)
+    x = mx.nd.array(rs.randint(0, V, (B, 16)).astype("f"))
+    y = mx.nd.array(rs.randint(0, V, (B, 16)).astype("f"))
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    def step(net, scoped):
+        with autograd.record():
+            out = net(x)
+            loss = loss_fn(out.reshape((-1, V)), y.reshape((-1,)))
+        loss.backward()
+        grads = {k: p.grad().asnumpy()
+                 for k, p in net.collect_params().items()}
+        return float(loss.mean().asnumpy()), grads
+
+    # gluon params initialize lazily at first forward: seed -> build ->
+    # STEP for each net, so both first-draws start from the same state
+    dense_net = make_net("dense", seed=9)
+    l_ref, g_ref = step(dense_net, False)
+    ring_net = make_net("ring", seed=9)
+    with parallel.sp_scope(mesh):
+        l_ring, g_ring = step(ring_net, True)
+    assert abs(l_ring - l_ref) < 1e-4, (l_ring, l_ref)
+    assert set(g_ring) == {k.replace("transformerlm1", "transformerlm0")
+                           for k in g_ref} or len(g_ring) == len(g_ref)
+    # param names differ only by the auto prefix counter; compare sorted
+    for (ka, ga), (kb, gb) in zip(sorted(g_ring.items()),
+                                  sorted(g_ref.items())):
+        assert_almost_equal(ga, gb, rtol=1e-3, atol=1e-5,
+                            names=(f"ring:{ka}", f"dense:{kb}"))
+
+    # custom scale is honored by the sharded kernels
+    qkv = nd.array(rs.normal(0, 1, (2, 16, 3 * 32)).astype("f"))
+    ref = nd._contrib_multihead_attention(qkv, num_heads=4, impl="dense",
+                                          scale=0.125).asnumpy()
+    with parallel.sp_scope(mesh):
+        got = nd._contrib_multihead_attention(
+            qkv, num_heads=4, impl="ring", scale=0.125).asnumpy()
+    assert_almost_equal(got, ref, rtol=1e-4, atol=1e-5,
+                        names=("ring-scale", "dense-scale"))
