@@ -157,22 +157,24 @@ def _run():
     label_nd = mx.nd.array(labels, ctx=ctx)
     it = mx.io.NDArrayIter(data_nd, label_nd, batch_size=BATCH)
 
-    # fused single-program step: ON by default on the real chip (its CPU
-    # bit-identity is CI-pinned; program-boundary cost is the measured
-    # on-chip gap) — MXNET_FUSED_STEP=0/1 pins it for A/B runs, and a
-    # fused-path failure falls back to the standard step below so the
-    # driver's one bench run can never lose its number to the new path.
+    # fused single-program step: OFF by default everywhere.  The round-5
+    # on-chip A/B (BENCH_WINDOW_r05.json) measured the standard
+    # multi-program step FASTER: 1830.85 img/s (22.9% MFU) vs 1566.14
+    # (19.6%) fused — the one big program denies XLA the async overlap
+    # between fwd+bwd, optimizer, and metric dispatches that the
+    # standard path gets for free, and costs more than the ~4-5 ms/step
+    # of program boundaries it saves (experiments/dispatch_latency.py).
     # MXNET_FUSED_STEP pins the path STRICTLY (the chip-window A/B needs
     # a failing fused leg to fail loudly, not silently measure the
     # standard step); MXT_BENCH_FUSED=0/1 is the bench-level choice that
-    # keeps the fallback safety net; default: fused on the real chip.
+    # keeps the fallback safety net.
     fused_pinned = "MXNET_FUSED_STEP" in os.environ
     if fused_pinned:
         fused = bool(int(os.environ["MXNET_FUSED_STEP"] or "0"))
     elif "MXT_BENCH_FUSED" in os.environ:
         fused = bool(int(os.environ["MXT_BENCH_FUSED"] or "0"))
     else:
-        fused = on_tpu
+        fused = False
     _STATE["fused_step"] = fused
 
     def build_module():

@@ -1025,10 +1025,11 @@ def test_benchmark_sweep_driver(tmp_path):
 
 
 def test_bench_fused_step_and_fallback():
-    """bench.py auto-fuses on TPU; forced-on CPU it must complete, and
-    an injected fused failure must fall back to the standard step and
-    still emit a clean full-run JSON (the driver's one bench run can
-    never lose its number to the fused path)."""
+    """bench.py's fused step is off by default (slower on-chip,
+    BENCH_WINDOW_r05.json); forced on via MXT_BENCH_FUSED it must
+    complete, and an injected fused failure must fall back to the
+    standard step and still emit a clean full-run JSON (the driver's
+    one bench run can never lose its number to the fused path)."""
     import json
     env = {**ENV, "MXT_BENCH_BATCH": "8", "MXT_BENCH_IMG": "64",
            "MXT_BENCH_BATCHES": "2", "MXT_BENCH_LR": "0.01",
