@@ -1024,6 +1024,24 @@ def test_benchmark_sweep_driver(tmp_path):
     assert rec["rc"] == 0 and rec["img_s"] > 0, rec
 
 
+def test_lm_mfu_probe_smoke():
+    """experiments/lm_mfu_probe.py (transformer-LM MFU window leg):
+    smoke config must train (finite decreasing-ish loss) and emit one
+    JSON line with the tok/s + FLOPs accounting fields."""
+    import json
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "experiments/lm_mfu_probe.py")],
+        env={**ENV, "MXT_LM_PROBE_SMOKE": "1"}, cwd=REPO,
+        capture_output=True, text=True, timeout=420)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    rec = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert rec["metric"] == "transformer_lm_train_throughput"
+    assert rec["value"] > 0 and rec["train_tflops_per_step"] >= 0
+    assert np.isfinite(rec["loss_first"]) and np.isfinite(rec["loss_final"])
+    # 2 smoke steps on random tokens: loss must move and not blow up
+    assert rec["loss_final"] < rec["loss_first"] + 1.0
+
+
 def test_bench_fused_step_and_fallback():
     """bench.py's fused step is off by default (slower on-chip,
     BENCH_WINDOW_r05.json); forced on via MXT_BENCH_FUSED it must

@@ -3,20 +3,20 @@
 Rounds 2-3 proved TPU-tunnel windows cannot be assumed: both rounds
 ended with zero on-chip evidence.  This tool turns ANY window — even a
 15-minute one — into durable artifacts automatically.  On the first
-successful device probe it runs, in value order:
+successful device probe it runs, in value order (r05 ordering —
+windows can last ~13 min, so cheap high-value legs ride first):
 
-  1. tools/run_tpu_consistency.py        -> CONSISTENCY_<tag>.json
-     (the 82-case TPU-vs-CPU tier: correctness evidence first)
-  2. experiments/layout_probe.py A/B     -> LAYOUT_<tag>.json
+  1. bench.py standard + fused A/B       -> BENCH_WINDOW_<tag>.json
+  2. tools/run_tpu_consistency.py        -> CONSISTENCY_<tag>.json
+     (the TPU-vs-CPU correctness tier)
+  3. experiments/layout_probe.py A/B     -> LAYOUT_<tag>.json
      (raw-JAX NCHW/NHWC x residency sweep; picks the winning config)
-  3. tools/run_tpu_consistency.py --layout NHWC (resnet subset)
-     (validates the framework's channels-last lowering on-chip)
-  4. bench.py with the winning layout    -> BENCH_WINDOW_<tag>.json
-     (default vs MXNET_FUSED_STEP=1 A/B — the headline number rides
-     earlier than the diagnostics: windows close without warning)
-  5. benchmark_score.py zoo inference    -> SCORE_<tag>.txt
-  6. experiments/profile_fit.py          -> PROFILE_<tag>.txt
-     (phase-level fit() timing: where does the throughput go)
+  4. consistency --layout NHWC subset, product NHWC + batch-sweep
+     bench legs, r01-config reconciliation, flash probe, flag sweep
+  5. benchmark_score.py zoo inference    -> SCORE_<tag>.jsonl
+     (six 480s cells — after the cheap legs so a short window keeps
+     the correctness + layout evidence)
+  6. experiments/profile_fit.py / fused_step_probe  -> PROFILE/FUSEDPROBE
 
 Every step is a subprocess with its own timeout, so one hang cannot eat
 the window; the summary (CHIP_WINDOW_<tag>.json) is rewritten atomically
@@ -154,9 +154,9 @@ def main():
     ap.add_argument("--probe-timeout", type=float, default=120.0)
     ap.add_argument("--step-timeout", type=float, default=900.0)
     ap.add_argument("--batch", type=int, default=256)
-    ap.add_argument("--steps", default="bench,score,consistency,layout,"
-                    "nhwc,benchnhwc,r01cfg,flashprobe,flagsweep,profile,"
-                    "fusedprobe",
+    ap.add_argument("--steps", default="bench,consistency,layout,nhwc,"
+                    "benchnhwc,benchbatch,lmbench,r01cfg,flashprobe,"
+                    "flagsweep,score,profile,fusedprobe",
                     help="which steps to run, in this fixed order "
                          "(VERDICT r4 #2: the first minutes of any window "
                          "belong to the bench; diagnostics after) — "
@@ -175,8 +175,8 @@ def main():
     args = ap.parse_args()
     steps = {s.strip() for s in args.steps.split(",") if s.strip()}
     known = {"consistency", "layout", "nhwc", "profile", "fusedprobe",
-             "bench", "score", "benchnhwc", "r01cfg", "flashprobe",
-             "flagsweep"}
+             "bench", "score", "benchnhwc", "benchbatch", "lmbench",
+             "r01cfg", "flashprobe", "flagsweep"}
     if steps - known:
         # a typo must not silently skip a step a rare window exists for
         ap.error(f"unknown --steps {sorted(steps - known)}; "
@@ -251,27 +251,6 @@ def main():
                  env={**env, "MXNET_FUSED_STEP": "1"}))
         _write_bench_window()
 
-    # 2. zoo inference throughput (reference benchmark_score parity);
-    # per-cell subprocess watchdogs + --out append so a hang costs one
-    # (network, batch) cell and the partial artifact survives
-    if "score" in steps:
-        score_jsonl = os.path.join(REPO, f"SCORE_{tag}.jsonl")
-        # truncate: --out appends per cell, and a re-armed poller with
-        # the same tag must not mix stale rows from an earlier attempt
-        open(score_jsonl, "w").close()
-        _run("benchmark_score",
-             [sys.executable,
-              "example/image-classification/benchmark_score.py",
-              "--networks", "resnet-18,resnet-50,mobilenet,inception-v3",
-              "--batch-sizes", "1,64", "--repeats", "20",
-              # 180s lost every cell in the r05 window: a cold cell is
-              # import + model build + tunnel compile + 20 repeats, and
-              # the tunnel compile alone can run minutes
-              "--cell-timeout", "480",
-              "--out", score_jsonl],
-             args.step_timeout * 2, summary_path, env=env,
-             capture_to=f"SCORE_{tag}.txt")
-
     # 3. correctness tier (the flash case's Mosaic probe writes its
     # verbatim toolchain output to a durable artifact, VERDICT r4 #5)
     if "consistency" in steps:
@@ -309,6 +288,35 @@ def main():
                       "MXNET_FUSED_STEP": "0"}))
         _write_bench_window()
 
+    # 6b. batch-size sweep at the product path (standard step): MFU at
+    # BS=256 measured 22.9% (r05) — a bigger global batch is the
+    # cheapest lever to test for MXU utilisation; each leg is a full
+    # bench.py run so the numbers are directly comparable
+    if "benchbatch" in steps:
+        bench_doc.setdefault("batch_sweep", {})
+        for bs in (384, 512):
+            rec = _bench_json(
+                _run(f"bench_bs{bs}", [sys.executable, "bench.py"],
+                     args.step_timeout, summary_path,
+                     env={**env, "MXNET_FUSED_STEP": "0",
+                          "MXT_BENCH_BATCH": str(bs)}))
+            bench_doc["batch_sweep"][str(bs)] = rec
+            _write_bench_window()
+        SUMMARY["batch_sweep"] = bench_doc["batch_sweep"]
+        _write_summary(summary_path)
+
+    # 6c. transformer-LM MFU probe: the matmul-dominated flagship —
+    # tells the MFU story the conv-bound ResNet cannot (its raw-JAX
+    # ceiling is ~24%); product path (CachedOp + tape vjp + fused
+    # optimizer), exact matmul-FLOPs accounting
+    if "lmbench" in steps:
+        SUMMARY["lmbench"] = bench_doc["transformer_lm"] = _bench_json(
+            _run("lm_mfu_probe",
+                 [sys.executable, "experiments/lm_mfu_probe.py"],
+                 args.step_timeout, summary_path,
+                 capture_to=f"LMBENCH_{tag}.txt"))
+        _write_bench_window()
+
     # 7. r01-vs-now reconciliation (VERDICT r4 weak #7): the thin
     # hand-jitted GraphPlan step r01 measured, on today's stack
     if "r01cfg" in steps:
@@ -339,7 +347,32 @@ def main():
                         else "NHWC"))},
              capture_to=f"FLAGSWEEP_{tag}.txt")
 
-    # 8. diagnostics, cheapest-to-lose last: where does fit() time go
+    # 8. zoo inference throughput (reference benchmark_score parity);
+    # runs AFTER the cheap high-value legs: windows last ~13 min (r05)
+    # and six 480s cells can eat one whole — per-cell subprocess
+    # watchdogs + --out append keep every retired cell durable.
+    # inception-v3 dropped from the window set (VERDICT r4 #6 needs
+    # resnet-18/50 + mobilenet; run it manually in a long window).
+    if "score" in steps:
+        score_jsonl = os.path.join(REPO, f"SCORE_{tag}.jsonl")
+        # truncate: --out appends per cell, and a re-armed poller with
+        # the same tag must not mix stale rows from an earlier attempt
+        open(score_jsonl, "w").close()
+        _run("benchmark_score",
+             [sys.executable,
+              "example/image-classification/benchmark_score.py",
+              "--networks", "resnet-50,resnet-18,mobilenet",
+              "--batch-sizes", "64,1", "--repeats", "20",
+              # 180s lost every cell in the r05 window: a cold cell is
+              # import + model build + tunnel compile + 20 repeats, and
+              # the tunnel compile alone can run minutes
+              "--cell-timeout", "480",
+              "--out", score_jsonl],
+             # outer watchdog must cover six worst-case 480s cells
+             args.step_timeout * 4, summary_path, env=env,
+             capture_to=f"SCORE_{tag}.txt")
+
+    # 9. diagnostics, cheapest-to-lose last: where does fit() time go
     if "profile" in steps:
         _run("profile_fit",
              [sys.executable, "experiments/profile_fit.py"],
@@ -347,7 +380,7 @@ def main():
              env={"B": str(args.batch)},
              capture_to=f"PROFILE_{tag}.txt")
 
-    # 8b. would a single fused donated train-step close the gap?
+    # 9b. would a single fused donated train-step close the gap?
     if "fusedprobe" in steps:
         _run("fused_step_probe",
              [sys.executable, "experiments/fused_step_probe.py"],
