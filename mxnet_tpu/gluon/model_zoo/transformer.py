@@ -117,33 +117,128 @@ class TransformerLM(HybridBlock):
         return self.head(self.ln_f(x))
 
 
-    def generate(self, prompt, max_new, temperature=0.0, rng=None):
+    def generate(self, prompt, max_new, temperature=0.0, rng=None,
+                 static_shapes=True):
         """Autoregressive decoding from `prompt` (B, T0) token ids.
 
-        Greedy when temperature==0, else softmax sampling.  Each step
-        re-runs the (hybridized, cached) forward on the growing prefix —
-        correct-by-construction causal decoding; a KV-cache fast path is
-        a TPU-side optimization that does not change this API.
+        Greedy when temperature==0, else softmax sampling.
+
+        static_shapes=True (default — the TPU path): tokens live in a
+        fixed (B, max_len) buffer and every decode step is ONE cached
+        hybridized program whose shapes never change, so XLA compiles
+        once for the whole generation (greedy stays entirely on
+        device).  Causality makes this exact: positions beyond the
+        frontier hold zeros and cannot influence earlier logits
+        (pinned by tests/test_transformer.py::test_causal_masking).
+
+        static_shapes=False re-runs the forward on the growing prefix
+        — one fresh XLA program PER LENGTH (catastrophic through a
+        tunneled chip; kept as the debugging/parity reference).
         """
         import numpy as np
         from ... import ndarray as F
-        if prompt.shape[1] + max_new > self._max_len:
+        B, t0 = prompt.shape
+        if t0 + max_new > self._max_len:
             raise ValueError(
-                f"prompt length {prompt.shape[1]} + max_new {max_new} "
+                f"prompt length {t0} + max_new {max_new} "
                 f"exceeds max_len {self._max_len}")
-        toks = prompt
-        for _ in range(max_new):
-            logits = self(toks)                      # (B, T, V)
-            last = logits[:, -1, :]
-            if temperature > 0:
-                p = F.softmax(last / temperature, axis=-1).asnumpy()
-                nxt = np.array([
-                    (rng or np.random).choice(p.shape[-1], p=row / row.sum())
-                    for row in p], dtype=np.float32)[:, None]
+        if not static_shapes:
+            toks = prompt
+            for _ in range(max_new):
+                logits = self(toks)                  # (B, T, V)
+                last = logits[:, -1, :]
+                nxt = self._sample(last, temperature, rng)
+                toks = F.concat(toks, F.array(nxt, ctx=toks.context),
+                                dim=1)
+            return toks
+
+        steps = self._decode_steps()
+        pad = self._max_len - t0
+        buf = prompt if pad == 0 else F.concat(
+            prompt, F.zeros((B, pad), ctx=prompt.context), dim=1)
+        for t in range(t0, t0 + max_new):
+            pos = F.array([t - 1.0], ctx=prompt.context)
+            if temperature == 0:
+                buf = steps["greedy"](buf, pos)      # fully on device
             else:
-                nxt = last.asnumpy().argmax(-1).astype(np.float32)[:, None]
-            toks = F.concat(toks, F.array(nxt, ctx=toks.context), dim=1)
-        return toks
+                last = steps["logits"](buf, pos)     # (B, V)
+                nxt = self._sample(last, temperature, rng)
+                buf = steps["write"](buf, pos,
+                                     F.array(nxt, ctx=prompt.context))
+        return F.slice_axis(buf, axis=1, begin=0, end=t0 + max_new)
+
+    @staticmethod
+    def _sample(last, temperature, rng):
+        """Host-side next-token choice from (B, V) logits -> (B, 1)."""
+        import numpy as np
+        from ... import ndarray as F
+        if temperature > 0:
+            p = F.softmax(last / temperature, axis=-1).asnumpy()
+            return np.array([
+                (rng or np.random).choice(p.shape[-1], p=row / row.sum())
+                for row in p], dtype=np.float32)[:, None]
+        return last.asnumpy().argmax(-1).astype(np.float32)[:, None]
+
+    def _decode_steps(self):
+        """Build (once) the three hybridized decode-step blocks.
+
+        Stored in __dict__ via a plain dict so Block.__setattr__ does
+        not register them as children (the wrapper holds `self` as its
+        sub-block — registration would create a parent<->child cycle).
+        Only each wrapper's OWN hybrid flag is set: Block.hybridize()
+        would recurse into the wrapped model and silently flip a
+        deliberately-eager net into hybrid mode (symbol tracing routes
+        through hybrid_forward regardless of the net's flag, so the
+        wrapper's CachedOp doesn't need it).
+        """
+        cached = self.__dict__.get("_decode_step_cache")
+        if cached is not None:
+            return cached
+        from ..block import HybridBlock
+
+        outer = self
+
+        def _write_at(F, tokens, pos, nxt):
+            """Scatter nxt (B,1) into tokens (B,Tmax) at column pos+1 —
+            the ONE frontier-write implementation (greedy + sampled)."""
+            oh = F.one_hot(pos + 1.0, depth=outer._max_len)
+            return tokens * (1.0 - oh) + nxt * oh
+
+        class _LogitsStep(HybridBlock):
+            """(tokens (B,Tmax), pos (1,)) -> logits at pos, (B, V)."""
+
+            def __init__(self, **kw):
+                super().__init__(**kw)
+                with self.name_scope():
+                    self.net = outer
+
+            def hybrid_forward(self, F, tokens, pos):
+                logits = self.net(tokens)            # (B, Tmax, V)
+                last = F.take(logits, pos, axis=1)   # (B, 1, V)
+                return F.reshape(last, (0, -1))
+
+        class _GreedyStep(_LogitsStep):
+            """One whole greedy step on device: read logits at pos,
+            argmax, write the winner at pos+1; returns the updated
+            (B, Tmax) buffer."""
+
+            def hybrid_forward(self, F, tokens, pos):
+                last = super().hybrid_forward(F, tokens, pos)
+                nxt = F.argmax(last, axis=-1, keepdims=True)  # (B, 1)
+                return _write_at(F, tokens, pos, nxt)
+
+        class _WriteStep(HybridBlock):
+            """(tokens, pos, nxt (B,1)) -> tokens with nxt at pos+1."""
+
+            def hybrid_forward(self, F, tokens, pos, nxt):
+                return _write_at(F, tokens, pos, nxt)
+
+        steps = {"logits": _LogitsStep(), "greedy": _GreedyStep(),
+                 "write": _WriteStep()}
+        for blk in steps.values():
+            blk._active = True                 # this wrapper only
+        self.__dict__["_decode_step_cache"] = steps
+        return steps
 
 
 def transformer_lm(vocab, **kwargs):

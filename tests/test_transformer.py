@@ -124,6 +124,56 @@ def test_generate_memorizes_sequence():
     assert (out[4:] == seq[0, 4:]).mean() > 0.7, (out, seq)
 
 
+def test_generate_static_matches_eager():
+    """static_shapes decoding (fixed (B, max_len) buffer, one cached
+    program per step kind) must produce the same greedy tokens as the
+    growing-prefix eager reference, and must not recompile per step."""
+    rs = np.random.RandomState(7)
+    net = make_net(seed=3)
+    prefix = mx.nd.array(rs.randint(0, V, (2, 5)).astype("f"))
+    out_static = net.generate(prefix, 8, static_shapes=True).asnumpy()
+    out_eager = net.generate(prefix, 8, static_shapes=False).asnumpy()
+    assert out_static.shape == (2, 13)
+    assert (out_static == out_eager).all(), (out_static, out_eager)
+    # one compiled forward reused across all greedy steps: the step
+    # block's CachedOp must hold exactly one shape specialization
+    steps = net._decode_steps()
+    cached_op = getattr(steps["greedy"], "_cached_op", None)
+    if cached_op is not None and hasattr(cached_op._fwd, "_cache_size"):
+        assert cached_op._fwd._cache_size() == 1
+
+
+def test_generate_static_sampling():
+    """temperature>0: the static path must draw the SAME tokens as the
+    eager reference under a same-seeded rng (identical logits ->
+    identical softmax -> identical draws), catching any off-by-one in
+    the static read/write positions."""
+    rs = np.random.RandomState(11)
+    net = make_net(seed=4)
+    prefix = mx.nd.array(rs.randint(0, V, (2, 4)).astype("f"))
+    out_s = net.generate(prefix, 6, temperature=1.0,
+                         rng=np.random.RandomState(0),
+                         static_shapes=True).asnumpy()
+    out_e = net.generate(prefix, 6, temperature=1.0,
+                         rng=np.random.RandomState(0),
+                         static_shapes=False).asnumpy()
+    assert out_s.shape == (2, 10)
+    assert (out_s[:, :4] == prefix.asnumpy()).all()
+    assert ((out_s >= 0) & (out_s < V)).all()
+    assert (out_s == out_e).all(), (out_s, out_e)
+
+
+def test_generate_leaves_hybrid_state_alone():
+    """generate() must not flip a deliberately-eager net into hybrid
+    mode (the decode wrappers activate only their own flag)."""
+    rs = np.random.RandomState(13)
+    net = make_net(seed=5)
+    assert net._active is False
+    net.generate(mx.nd.array(rs.randint(0, V, (1, 3)).astype("f")), 2)
+    assert net._active is False
+    assert all(not b._active for b in net.blocks._children)
+
+
 def test_sequence_parallel_attn_types():
     """impl='ring'/'ulysses' as FIRST-CLASS attn types (SURVEY §5:
     sequence parallelism exposed through the same Gluon APIs): under
