@@ -118,7 +118,7 @@ class TransformerLM(HybridBlock):
 
 
     def generate(self, prompt, max_new, temperature=0.0, rng=None,
-                 static_shapes=True):
+                 static_shapes=None, kv_cache=False):
         """Autoregressive decoding from `prompt` (B, T0) token ids.
 
         Greedy when temperature==0, else softmax sampling.
@@ -134,6 +134,11 @@ class TransformerLM(HybridBlock):
         static_shapes=False re-runs the forward on the growing prefix
         — one fresh XLA program PER LENGTH (catastrophic through a
         tunneled chip; kept as the debugging/parity reference).
+
+        kv_cache=True decodes through per-layer K/V caches
+        (`mha_decode_step`): O(Tmax*D) work per token instead of the
+        full re-forward's O(Tmax^2*D) — the long-context decode path.
+        One cached program per step; position and caches ride as data.
         """
         import numpy as np
         from ... import ndarray as F
@@ -142,6 +147,21 @@ class TransformerLM(HybridBlock):
             raise ValueError(
                 f"prompt length {t0} + max_new {max_new} "
                 f"exceeds max_len {self._max_len}")
+        if kv_cache:
+            if static_shapes is not None:
+                raise ValueError(
+                    "kv_cache=True selects its own decode strategy; "
+                    "combining it with an explicit static_shapes "
+                    "would be silently ignored — pass one or the other")
+            for blk in self.blocks._children:
+                if blk.attn._type in ("ring", "ulysses"):
+                    raise NotImplementedError(
+                        "kv_cache decoding allocates full-length "
+                        "caches on one device; sequence-parallel "
+                        f"attn_type {blk.attn._type!r} needs sharded "
+                        "caches — decode with static_shapes instead")
+            return self._generate_kv(prompt, max_new, temperature, rng)
+        static_shapes = True if static_shapes is None else static_shapes
         if not static_shapes:
             toks = prompt
             for _ in range(max_new):
@@ -239,6 +259,100 @@ class TransformerLM(HybridBlock):
             blk._active = True                 # this wrapper only
         self.__dict__["_decode_step_cache"] = steps
         return steps
+
+    def _kv_step(self):
+        """Build (once) the KV-cache decode cell: ONE hybridized
+        program computing (token_t, pos, *caches) -> (logits_t,
+        *updated caches).  Re-composes the stack from the SAME
+        sub-blocks/parameters as the training forward — LN, fused QKV,
+        `mha_decode_step` (cache write + masked attention over the
+        cache), projection, FFN, head — so decode weights can never
+        drift from training weights.  Same child-registration and
+        hybrid-flag rules as _decode_steps."""
+        cached = self.__dict__.get("_kv_step_cache")
+        if cached is not None:
+            return cached
+        from ..block import HybridBlock
+
+        outer = self
+
+        class _KVStep(HybridBlock):
+            """(token_t (B,1), pos (1,), *caches) -> [head, *caches].
+            greedy=True emits the argmax NEXT TOKEN as the head output
+            (the whole step stays on device and its output feeds the
+            next step without a host sync); greedy=False emits the
+            (B, V) logits for host-side sampling."""
+
+            def __init__(self, greedy, **kw):
+                super().__init__(**kw)
+                self._greedy = greedy
+                with self.name_scope():
+                    self.net = outer
+
+            def hybrid_forward(self, F, tok, pos, *caches):
+                net = self.net
+                # tok (B, 1) ids; pos (1,) position t of this token
+                x = net.tok(tok) + F.expand_dims(net.pos(pos), axis=0)
+                new_caches = []
+                for i, blk in enumerate(net.blocks._children):
+                    h = blk.ln1(x)
+                    qkv = blk.attn.qkv(h)               # (B, 1, 3D)
+                    att, kc, vc = F.mha_decode_step(
+                        qkv, caches[2 * i], caches[2 * i + 1], pos,
+                        num_heads=blk.attn._h)
+                    new_caches += [kc, vc]
+                    x = x + blk.attn.proj(att)
+                    x = x + blk.ffn2(blk.ffn1(blk.ln2(x)))
+                logits = net.head(net.ln_f(x))          # (B, 1, V)
+                logits = F.reshape(logits, (0, -1))
+                head = (F.argmax(logits, axis=-1, keepdims=True)
+                        if self._greedy else logits)
+                return [head] + new_caches
+
+        steps = {"sample": _KVStep(False), "greedy": _KVStep(True)}
+        for blk in steps.values():
+            blk._active = True                  # this wrapper only
+        self.__dict__["_kv_step_cache"] = steps
+        return steps
+
+    def _generate_kv(self, prompt, max_new, temperature, rng):
+        """KV-cache decode loop: prefill feeds prompt tokens through
+        the same one-token cell that generates (cache fills as a side
+        effect); every step reuses one compiled program.  Greedy keeps
+        the whole loop on device — generated tokens come back as
+        (B, 1) handles chained step-to-step and are fetched ONCE at
+        the end (async dispatch: no per-token sync)."""
+        import numpy as np
+        from ... import ndarray as F
+        B, t0 = prompt.shape
+        ctx = prompt.context
+        greedy = temperature == 0
+        step = self._kv_step()["greedy" if greedy else "sample"]
+        blocks = self.blocks._children
+        h = blocks[0].attn._h
+        dh = blocks[0].attn._dh
+        dtype = self.head.weight.dtype
+        caches = []
+        for _ in range(2 * len(blocks)):
+            caches.append(F.zeros((B, h, self._max_len, dh), ctx=ctx,
+                                  dtype=dtype))
+        toks_np = prompt.asnumpy()
+        pieces = [prompt]                  # (B, k) device-side chunks
+        cur = F.array(toks_np[:, 0:1], ctx=ctx)
+        for t in range(t0 + max_new - 1):
+            pos = F.array([float(t)], ctx=ctx)
+            outs = step(cur, pos, *caches)
+            head, caches = outs[0], outs[1:]
+            if t + 1 < t0:                 # prefill: next prompt column
+                cur = F.array(toks_np[:, t + 1:t + 2], ctx=ctx)
+            elif greedy:
+                cur = head                 # stays on device
+                pieces.append(cur)
+            else:
+                nxt = self._sample(head, temperature, rng)
+                cur = F.array(nxt, ctx=ctx)
+                pieces.append(cur)
+        return F.concat(*pieces, dim=1)
 
 
 def transformer_lm(vocab, **kwargs):

@@ -287,6 +287,49 @@ def _flash_attention_op(p, q, k, v):
                             int(blk_q), int(blk_k))
 
 
+@register("_contrib_mha_decode_step",
+          input_names=("qkv", "k_cache", "v_cache", "pos"),
+          aliases=("mha_decode_step",), f32_inputs=(3,),
+          args=[Arg("num_heads", int, required=True),
+                Arg("scale", float, -1.0)],
+          num_outputs=3, differentiable=False)
+def _mha_decode_step_op(p, qkv, kc, vc, pos):
+    """One autoregressive attention step over a KV cache (inference).
+
+    qkv: (B, 1, 3*D) — the current token's fused projections;
+    k_cache/v_cache: (B, H, Tmax, dh) rolling caches; pos: (1,) the
+    current position t.  Writes this token's K/V at column t
+    (lax.dynamic_update_slice — the position is DATA, so one compiled
+    program serves every step) and attends over columns <= t.  Returns
+    (out (B, 1, D), new_k_cache, new_v_cache).  O(Tmax*D) per token vs
+    the full re-forward's O(Tmax^2*D) — the long-context decode path
+    the 2017 reference never needed (its RNNs carry state natively;
+    for attention the cache IS that recurrent state).
+    """
+    B, _, D3 = qkv.shape
+    H = p["num_heads"]
+    D = D3 // 3
+    dh = D // H
+    x = qkv.reshape(B, 3, H, dh)                    # T=1 folded away
+    q, k, v = x[:, 0], x[:, 1], x[:, 2]             # (B, H, dh)
+    t = pos.astype(jnp.int32).reshape(())
+    zero = jnp.zeros((), jnp.int32)
+    kc = jax.lax.dynamic_update_slice(
+        kc, k[:, :, None, :].astype(kc.dtype), (zero, zero, t, zero))
+    vc = jax.lax.dynamic_update_slice(
+        vc, v[:, :, None, :].astype(vc.dtype), (zero, zero, t, zero))
+    scale = p["scale"] if p["scale"] > 0 else dh ** -0.5
+    # scores + softmax in f32 like every other attention path (the
+    # flash kernel and the dense reference): bf16 near-ties must not
+    # flip the greedy argmax vs the training forward
+    s = jnp.einsum("bhd,bhtd->bht", q.astype(jnp.float32) * scale,
+                   kc.astype(jnp.float32))
+    s = jnp.where(jnp.arange(kc.shape[2])[None, None, :] <= t, s, NEG_INF)
+    w = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bht,bhtd->bhd", w, vc.astype(jnp.float32))
+    return out.reshape(B, 1, D).astype(qkv.dtype), kc, vc
+
+
 @register("_contrib_multihead_attention", input_names=("qkv",),
           aliases=("multihead_attention",),
           args=[Arg("num_heads", int, required=True),

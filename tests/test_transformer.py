@@ -163,6 +163,36 @@ def test_generate_static_sampling():
     assert (out_s == out_e).all(), (out_s, out_e)
 
 
+def test_generate_kv_cache_matches_eager():
+    """kv_cache=True (mha_decode_step: O(Tmax*D)/token over per-layer
+    K/V caches) must reproduce the eager reference exactly — greedy
+    AND same-seeded sampling — catching cache-write position errors,
+    mask off-by-ones, and any decode/training weight drift (the cell
+    re-composes the same sub-blocks)."""
+    rs = np.random.RandomState(17)
+    net = make_net(seed=6)
+    prefix = mx.nd.array(rs.randint(0, V, (2, 5)).astype("f"))
+    out_kv = net.generate(prefix, 8, kv_cache=True).asnumpy()
+    out_eager = net.generate(prefix, 8, static_shapes=False).asnumpy()
+    assert (out_kv == out_eager).all(), (out_kv, out_eager)
+    s_kv = net.generate(prefix, 5, temperature=1.0, kv_cache=True,
+                        rng=np.random.RandomState(2)).asnumpy()
+    s_eager = net.generate(prefix, 5, temperature=1.0,
+                           static_shapes=False,
+                           rng=np.random.RandomState(2)).asnumpy()
+    assert (s_kv == s_eager).all(), (s_kv, s_eager)
+    # conflicting strategy flags are an error, not a silent choice
+    import pytest
+    with pytest.raises(ValueError):
+        net.generate(prefix, 2, kv_cache=True, static_shapes=False)
+    # sp attention types need sharded caches — documented refusal
+    sp_net = make_net()
+    for blk in sp_net.blocks._children:
+        blk.attn._type = "ring"
+    with pytest.raises(NotImplementedError):
+        sp_net.generate(prefix, 2, kv_cache=True)
+
+
 def test_generate_leaves_hybrid_state_alone():
     """generate() must not flip a deliberately-eager net into hybrid
     mode (the decode wrappers activate only their own flag)."""
