@@ -60,6 +60,10 @@ def main():
                     choices=["dense", "flash"])
     ap.add_argument("--max-batches", type=int, default=0,
                     help="cap batches/epoch (0 = all)")
+    ap.add_argument("--gen-tokens", type=int, default=16,
+                    help="after training, greedy-decode this many "
+                         "tokens from a corpus prefix via the KV-cache "
+                         "path (0 disables)")
     args = ap.parse_args()
     logging.basicConfig(level=logging.INFO)
     ctx = mx.tpu() if mx.context.num_tpus() else mx.cpu()
@@ -95,6 +99,17 @@ def main():
                      time.time() - t0)
     uniform_ppl = args.vocab
     print("final ppl %.3f (uniform %.1f)" % (ppl, uniform_ppl))
+
+    if args.gen_tokens:
+        # KV-cache greedy decode (O(T) per token; the whole loop stays
+        # on device) from a real corpus prefix; clamp to the model's
+        # max_len so an unusual --seq-len never discards the session
+        plen = min(8, max(1, args.seq_len - 1))
+        gen = min(args.gen_tokens, args.seq_len - plen)
+        prefix = mx.nd.array(corpus[None, :plen].astype("f"), ctx=ctx)
+        toks = net.generate(prefix, gen, kv_cache=True)
+        print("generated:", " ".join(
+            str(int(t)) for t in toks.asnumpy()[0][plen:]))
 
 
 if __name__ == "__main__":

@@ -1,0 +1,89 @@
+"""Autoregressive decode throughput: static-buffer vs KV-cache (round 5).
+
+Measures `TransformerLM.generate` tokens/s for the two TPU decode
+strategies on the same model and prompt:
+
+  - static: fixed (B, max_len) buffer, full re-forward per token
+    (O(max_len^2 * D) work/token, one cached program, zero host syncs
+    for greedy)
+  - kv_cache: per-layer K/V caches via `mha_decode_step`
+    (O(max_len * D) work/token, one cached program, tokens chained on
+    device and fetched once)
+
+The crossover is expected at modest max_len: the static path re-runs
+the whole stack over max_len positions for every emitted token, while
+the cache path touches one position.  Prints one JSON line per mode.
+
+Run:  python experiments/decode_probe.py [--dim 512 --layers 8 ...]
+CPU smoke:  MXT_DECODE_PROBE_SMOKE=1 (tiny config)
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main():
+    ap = argparse.ArgumentParser(description="decode throughput probe")
+    ap.add_argument("--dim", type=int, default=512)
+    ap.add_argument("--layers", type=int, default=8)
+    ap.add_argument("--heads", type=int, default=8)
+    ap.add_argument("--vocab", type=int, default=32768)
+    ap.add_argument("--max-len", type=int, default=1024)
+    ap.add_argument("--prompt", type=int, default=32)
+    ap.add_argument("--new", type=int, default=128)
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--dtype", default="bfloat16",
+                    choices=("bfloat16", "float32"))
+    args = ap.parse_args()
+    if os.environ.get("MXT_DECODE_PROBE_SMOKE"):
+        args.dim, args.layers, args.heads, args.vocab = 64, 2, 4, 128
+        args.max_len, args.prompt, args.new, args.batch = 48, 4, 8, 2
+
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon.model_zoo.transformer import TransformerLM
+
+    ctx = mx.tpu() if mx.context.num_tpus() else mx.cpu()
+    mx.random.seed(0)
+    net = TransformerLM(args.vocab, dim=args.dim, num_layers=args.layers,
+                        num_heads=args.heads, max_len=args.max_len)
+    net.initialize(mx.init.Xavier(), ctx=ctx)
+    if args.dtype != "float32":
+        net.cast(args.dtype)
+    rs = np.random.RandomState(0)
+    prompt = mx.nd.array(
+        rs.randint(0, args.vocab, (args.batch, args.prompt)).astype("f"),
+        ctx=ctx)
+
+    results = {}
+    for mode, kw in (("static", {"static_shapes": True}),
+                     ("kv_cache", {"kv_cache": True})):
+        out = net.generate(prompt, args.new, **kw)   # warmup + compile
+        out.wait_to_read()
+        t0 = time.time()
+        out = net.generate(prompt, args.new, **kw)
+        tail = out.asnumpy()                          # force-drain
+        dt = time.time() - t0
+        tok_s = args.batch * args.new / dt
+        results[mode] = tail
+        print(json.dumps({
+            "metric": f"decode_{mode}_throughput",
+            "value": round(tok_s, 1), "unit": "tok/s",
+            "ms_per_token": round(1e3 * dt / args.new, 2),
+            "config": {"dim": args.dim, "layers": args.layers,
+                       "heads": args.heads, "vocab": args.vocab,
+                       "max_len": args.max_len, "prompt": args.prompt,
+                       "new": args.new, "batch": args.batch,
+                       "dtype": args.dtype}}))
+    agree = bool((results["static"] == results["kv_cache"]).all())
+    print(json.dumps({"metric": "decode_paths_agree", "value": agree}))
+
+
+if __name__ == "__main__":
+    main()

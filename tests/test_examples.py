@@ -450,6 +450,9 @@ def test_transformer_lm_example():
     ppl = float(line.split()[2])
     # must beat the uniform baseline (vocab=32) after 2 epochs
     assert ppl < 30.0, out
+    # and the KV-cache decode demo emitted tokens
+    gen = [l for l in out.splitlines() if l.startswith("generated:")][0]
+    assert len(gen.split()) == 17, gen  # 'generated:' + 16 tokens
 
 
 def test_bi_lstm_sort_example():
@@ -1040,6 +1043,23 @@ def test_lm_mfu_probe_smoke():
     assert np.isfinite(rec["loss_first"]) and np.isfinite(rec["loss_final"])
     # 2 smoke steps on random tokens: loss must move and not blow up
     assert rec["loss_final"] < rec["loss_first"] + 1.0
+
+
+def test_decode_probe_smoke():
+    """experiments/decode_probe.py (decode window leg): both decode
+    strategies must run, agree token-for-token, and emit JSON rows."""
+    import json
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "experiments/decode_probe.py")],
+        env={**ENV, "MXT_DECODE_PROBE_SMOKE": "1"}, cwd=REPO,
+        capture_output=True, text=True, timeout=420)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    rows = [json.loads(ln) for ln in proc.stdout.strip().splitlines()
+            if ln.startswith("{")]
+    metrics = {r["metric"]: r for r in rows}
+    assert metrics["decode_static_throughput"]["value"] > 0
+    assert metrics["decode_kv_cache_throughput"]["value"] > 0
+    assert metrics["decode_paths_agree"]["value"] is True
 
 
 def test_bench_fused_step_and_fallback():

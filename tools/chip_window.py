@@ -155,8 +155,8 @@ def main():
     ap.add_argument("--step-timeout", type=float, default=900.0)
     ap.add_argument("--batch", type=int, default=256)
     ap.add_argument("--steps", default="bench,consistency,layout,nhwc,"
-                    "benchnhwc,benchbatch,lmbench,r01cfg,flashprobe,"
-                    "flagsweep,score,profile,fusedprobe",
+                    "benchnhwc,benchbatch,lmbench,decodebench,r01cfg,"
+                    "flashprobe,flagsweep,score,profile,fusedprobe",
                     help="which steps to run, in this fixed order "
                          "(VERDICT r4 #2: the first minutes of any window "
                          "belong to the bench; diagnostics after) — "
@@ -176,7 +176,7 @@ def main():
     steps = {s.strip() for s in args.steps.split(",") if s.strip()}
     known = {"consistency", "layout", "nhwc", "profile", "fusedprobe",
              "bench", "score", "benchnhwc", "benchbatch", "lmbench",
-             "r01cfg", "flashprobe", "flagsweep"}
+             "decodebench", "r01cfg", "flashprobe", "flagsweep"}
     if steps - known:
         # a typo must not silently skip a step a rare window exists for
         ap.error(f"unknown --steps {sorted(steps - known)}; "
@@ -193,6 +193,10 @@ def main():
     if selftest:
         SUMMARY["mode"] = "selftest"
         os.environ["MXT_CONSISTENCY_SELFTEST"] = "1"
+        # the round-5 probe legs are chip-sized; on the CPU selftest
+        # they run their smoke configs (orchestration is what's tested)
+        os.environ["MXT_LM_PROBE_SMOKE"] = "1"
+        os.environ["MXT_DECODE_PROBE_SMOKE"] = "1"
         # force, don't setdefault: the driver environment exports
         # JAX_PLATFORMS=axon, and a selftest that inherits it hangs on
         # a dead tunnel instead of exercising the cpu path
@@ -316,6 +320,28 @@ def main():
                  args.step_timeout, summary_path,
                  capture_to=f"LMBENCH_{tag}.txt"))
         _write_bench_window()
+
+    # 6d. decode throughput: static-buffer vs KV-cache generate()
+    # (round-5 feature) — tokens/s for both strategies + agreement bit;
+    # the probe emits one JSON row per mode, so collect them ALL into
+    # the window bench doc (not just the last-object _bench_json match)
+    if "decodebench" in steps:
+        rec = _run("decode_probe",
+                   [sys.executable, "experiments/decode_probe.py"],
+                   args.step_timeout, summary_path,
+                   capture_to=f"DECODE_{tag}.txt")
+        rows = []
+        for ln in rec.get("tail", "").splitlines():
+            if ln.startswith("{"):
+                try:
+                    rows.append(json.loads(ln))
+                except ValueError:
+                    pass
+        if rows:
+            SUMMARY["decode"] = bench_doc["decode"] = {
+                r["metric"]: r for r in rows}
+            _write_bench_window()
+            _write_summary(summary_path)
 
     # 7. r01-vs-now reconciliation (VERDICT r4 weak #7): the thin
     # hand-jitted GraphPlan step r01 measured, on today's stack
