@@ -146,3 +146,44 @@ def test_pallas_available_fallback_paths(monkeypatch):
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=1e-6, atol=1e-6)
     fa._PALLAS_OK = None  # leave clean for other tests
+
+
+def test_mha_decode_step_matches_full_attention():
+    """Feeding a sequence token-by-token through mha_decode_step (cache
+    write at t + masked attention over columns <= t) must reproduce the
+    full-sequence fused multihead_attention output at every position —
+    the op-level pin under the gluon KV-decode path."""
+    rs = np.random.RandomState(3)
+    B, H, T, D = 2, 4, 10, 32       # D = model dim; dh = D // H
+    dh = D // H
+    qkv = nd.array(rs.normal(0, 1, (B, T, 3 * D)).astype("f"))
+    full = nd.multihead_attention(qkv, num_heads=H, causal=True).asnumpy()
+
+    kc = nd.zeros((B, H, T, dh))
+    vc = nd.zeros((B, H, T, dh))
+    for t in range(T):
+        step_qkv = nd.slice_axis(qkv, axis=1, begin=t, end=t + 1)
+        out, kc, vc = nd.mha_decode_step(
+            step_qkv, kc, vc, nd.array([float(t)]), num_heads=H)
+        assert_almost_equal(out.asnumpy()[:, 0], full[:, t],
+                            rtol=1e-4, atol=1e-5)
+
+
+def test_mha_decode_step_mask_excludes_future():
+    """Garbage already sitting beyond position t in the cache must not
+    influence the step output (the iota<=t mask is the causal frontier)."""
+    rs = np.random.RandomState(4)
+    B, H, T, D = 1, 2, 8, 16
+    dh = D // H
+    qkv = nd.array(rs.normal(0, 1, (B, 1, 3 * D)).astype("f"))
+    clean_k = nd.zeros((B, H, T, dh))
+    clean_v = nd.zeros((B, H, T, dh))
+    dirty_k = nd.array(rs.normal(0, 1, (B, H, T, dh)).astype("f"))
+    dirty_v = nd.array(rs.normal(0, 1, (B, H, T, dh)).astype("f"))
+    # position 0: only column 0 (this token's own K/V) may matter
+    o_clean, _, _ = nd.mha_decode_step(qkv, clean_k, clean_v,
+                                       nd.array([0.0]), num_heads=H)
+    o_dirty, _, _ = nd.mha_decode_step(qkv, dirty_k, dirty_v,
+                                       nd.array([0.0]), num_heads=H)
+    assert_almost_equal(o_clean.asnumpy(), o_dirty.asnumpy(),
+                        rtol=1e-5, atol=1e-6)

@@ -204,6 +204,24 @@ def test_generate_leaves_hybrid_state_alone():
     assert all(not b._active for b in net.blocks._children)
 
 
+def test_save_load_roundtrip_with_decode_wrappers(tmp_path):
+    """save_params/load_params must round-trip a net whose decode
+    wrappers were already built (the wrappers share the net's
+    parameters — building them must not add/rename anything), and the
+    reloaded net must decode identically."""
+    rs = np.random.RandomState(19)
+    net = make_net(seed=8)
+    prefix = mx.nd.array(rs.randint(0, V, (1, 4)).astype("f"))
+    out1 = net.generate(prefix, 6, kv_cache=True).asnumpy()
+    _ = net.generate(prefix, 2)               # static wrappers built too
+    path = str(tmp_path / "lm.params")
+    net.save_params(path)
+    net2 = make_net(seed=9)                   # different init
+    net2.load_params(path)
+    out2 = net2.generate(prefix, 6, kv_cache=True).asnumpy()
+    assert (out1 == out2).all(), (out1, out2)
+
+
 def test_sequence_parallel_attn_types():
     """impl='ring'/'ulysses' as FIRST-CLASS attn types (SURVEY §5:
     sequence parallelism exposed through the same Gluon APIs): under
