@@ -23,6 +23,34 @@ from .. import nn
 from ..block import HybridBlock
 
 
+def _write_frontier(F, tokens, pos, nxt, depth):
+    """Scatter nxt (N, 1) into tokens (N, Tmax) at column pos+1 — the
+    ONE frontier-write implementation (static greedy/sampled decode and
+    the beam step all share it)."""
+    oh = F.one_hot(pos + 1.0, depth=depth)
+    return tokens * (1.0 - oh) + nxt * oh
+
+
+def _kv_forward(F, net, tok, pos, caches):
+    """The one-token decode stack walk shared by the KV and beam cells:
+    (tok (N,1) ids, pos (1,), 2L caches (N,H,Tmax,dh)) -> (logits
+    (N, V), updated caches).  Re-composes the SAME sub-blocks and
+    parameters as the training forward."""
+    x = net.tok(tok) + F.expand_dims(net.pos(pos), axis=0)
+    new_caches = []
+    for i, blk in enumerate(net.blocks._children):
+        h = blk.ln1(x)
+        qkv = blk.attn.qkv(h)                       # (N, 1, 3D)
+        att, kc, vc = F.mha_decode_step(
+            qkv, caches[2 * i], caches[2 * i + 1], pos,
+            num_heads=blk.attn._h)
+        new_caches += [kc, vc]
+        x = x + blk.attn.proj(att)
+        x = x + blk.ffn2(blk.ffn1(blk.ln2(x)))
+    logits = net.head(net.ln_f(x))                  # (N, 1, V)
+    return F.reshape(logits, (0, -1)), new_caches
+
+
 class MultiHeadSelfAttention(HybridBlock):
     """Causal multi-head self-attention over (B, T, D) activations.
 
@@ -153,13 +181,7 @@ class TransformerLM(HybridBlock):
                     "kv_cache=True selects its own decode strategy; "
                     "combining it with an explicit static_shapes "
                     "would be silently ignored — pass one or the other")
-            for blk in self.blocks._children:
-                if blk.attn._type in ("ring", "ulysses"):
-                    raise NotImplementedError(
-                        "kv_cache decoding allocates full-length "
-                        "caches on one device; sequence-parallel "
-                        f"attn_type {blk.attn._type!r} needs sharded "
-                        "caches — decode with static_shapes instead")
+            self._check_kv_supported()
             return self._generate_kv(prompt, max_new, temperature, rng)
         static_shapes = True if static_shapes is None else static_shapes
         if not static_shapes:
@@ -186,6 +208,15 @@ class TransformerLM(HybridBlock):
                 buf = steps["write"](buf, pos,
                                      F.array(nxt, ctx=prompt.context))
         return F.slice_axis(buf, axis=1, begin=0, end=t0 + max_new)
+
+    def _check_kv_supported(self):
+        for blk in self.blocks._children:
+            if blk.attn._type in ("ring", "ulysses"):
+                raise NotImplementedError(
+                    "kv_cache decoding allocates full-length "
+                    "caches on one device; sequence-parallel "
+                    f"attn_type {blk.attn._type!r} needs sharded "
+                    "caches — decode with static_shapes instead")
 
     @staticmethod
     def _sample(last, temperature, rng):
@@ -219,10 +250,7 @@ class TransformerLM(HybridBlock):
         outer = self
 
         def _write_at(F, tokens, pos, nxt):
-            """Scatter nxt (B,1) into tokens (B,Tmax) at column pos+1 —
-            the ONE frontier-write implementation (greedy + sampled)."""
-            oh = F.one_hot(pos + 1.0, depth=outer._max_len)
-            return tokens * (1.0 - oh) + nxt * oh
+            return _write_frontier(F, tokens, pos, nxt, outer._max_len)
 
         class _LogitsStep(HybridBlock):
             """(tokens (B,Tmax), pos (1,)) -> logits at pos, (B, V)."""
@@ -290,21 +318,8 @@ class TransformerLM(HybridBlock):
                     self.net = outer
 
             def hybrid_forward(self, F, tok, pos, *caches):
-                net = self.net
-                # tok (B, 1) ids; pos (1,) position t of this token
-                x = net.tok(tok) + F.expand_dims(net.pos(pos), axis=0)
-                new_caches = []
-                for i, blk in enumerate(net.blocks._children):
-                    h = blk.ln1(x)
-                    qkv = blk.attn.qkv(h)               # (B, 1, 3D)
-                    att, kc, vc = F.mha_decode_step(
-                        qkv, caches[2 * i], caches[2 * i + 1], pos,
-                        num_heads=blk.attn._h)
-                    new_caches += [kc, vc]
-                    x = x + blk.attn.proj(att)
-                    x = x + blk.ffn2(blk.ffn1(blk.ln2(x)))
-                logits = net.head(net.ln_f(x))          # (B, 1, V)
-                logits = F.reshape(logits, (0, -1))
+                logits, new_caches = _kv_forward(F, self.net, tok, pos,
+                                                 caches)
                 head = (F.argmax(logits, axis=-1, keepdims=True)
                         if self._greedy else logits)
                 return [head] + new_caches
@@ -353,6 +368,123 @@ class TransformerLM(HybridBlock):
                 cur = F.array(nxt, ctx=ctx)
                 pieces.append(cur)
         return F.concat(*pieces, dim=1)
+
+    def _beam_step(self, width):
+        """Build (once per width) the beam-search step cell: ONE
+        hybridized program that advances every beam one token —
+        decode-stack logits, log-softmax, combined scores, top-k over
+        (width*vocab), beam/cache reindex via gather, frontier write.
+        Inputs: (cur (B*W,1), pos (1,), cum (B,W), buf (B*W,Tmax),
+        offsets (B,W) = arange(B)*W, *caches); outputs: [cur', cum',
+        buf', *caches'].  Same child-registration/hybrid-flag rules as
+        the other decode wrappers."""
+        cache = self.__dict__.setdefault("_beam_step_cache", {})
+        if width in cache:
+            return cache[width]
+        from ..block import HybridBlock
+
+        outer = self
+        vocab = self.head._units
+
+        class _BeamStep(HybridBlock):
+            def __init__(self, **kw):
+                super().__init__(**kw)
+                with self.name_scope():
+                    self.net = outer
+
+            def hybrid_forward(self, F, cur, pos, cum, buf, offsets,
+                               *caches):
+                W = width
+                logits, new_caches = _kv_forward(F, self.net, cur, pos,
+                                                 caches)        # (BW, V)
+                V = vocab
+                logp = F.log_softmax(logits, axis=-1)
+                scores = F.reshape(cum, (-1, 1)) + logp         # (BW, V)
+                scores = F.reshape(scores, (-1, W * V))         # (B, W*V)
+                idx = F.topk(scores, k=W, ret_typ="indices", axis=-1,
+                             is_ascend=False)                   # (B, W)
+                # the value call re-sorts the same tensor inside the
+                # same traced program — XLA CSE merges the two argsorts
+                # into one, so this costs nothing at runtime
+                new_cum = F.topk(scores, k=W, ret_typ="value", axis=-1,
+                                 is_ascend=False)               # (B, W)
+                beam_src = F.floor(idx / V)                     # (B, W)
+                tok = idx - beam_src * V                        # (B, W)
+                flat_src = F.reshape(beam_src + offsets, (-1,))  # (BW,)
+                buf = F.take(buf, flat_src, axis=0)
+                new_caches = [F.take(c, flat_src, axis=0)
+                              for c in new_caches]
+                tokcol = F.reshape(tok, (-1, 1))                # (BW, 1)
+                buf = _write_frontier(F, buf, pos, tokcol,
+                                      outer._max_len)
+                return [tokcol, new_cum, buf] + new_caches
+
+        step = _BeamStep()
+        step._active = True                     # this wrapper only
+        cache[width] = step
+        return step
+
+    def beam_search(self, prompt, max_new, beam=4):
+        """Beam-search decoding over the KV-cache cell.
+
+        Returns (sequences (B, T0+max_new), log_probs (B,)): the
+        highest-scoring beam per example and its total log-probability
+        over the generated positions.  Every step is one cached
+        program: beams ride as batch rows (B*beam), the top-k over
+        combined scores, the beam/cache reindex (gather) and the
+        frontier write all stay on device; the host fetches once at
+        the end.  No EOS handling — the toy LM family has no reserved
+        ids; all beams run the full max_new (document-level parity:
+        the 2017 reference has no decoder at all).
+        """
+        import numpy as np
+        from ... import ndarray as F
+        if beam < 1:
+            raise ValueError("beam must be >= 1")
+        B, t0 = prompt.shape
+        if t0 + max_new > self._max_len:
+            raise ValueError(
+                f"prompt length {t0} + max_new {max_new} "
+                f"exceeds max_len {self._max_len}")
+        self._check_kv_supported()
+        W = beam
+        ctx = prompt.context
+        prefill = self._kv_step()["sample"]
+        step = self._beam_step(W)
+        blocks = self.blocks._children
+        h, dh = blocks[0].attn._h, blocks[0].attn._dh
+        dtype = self.head.weight.dtype
+        # prefill at B rows (beams are identical over the prompt), then
+        # tile the caches to B*W — prompt-dominated decodes must not pay
+        # the beam width during prefill
+        caches = [F.zeros((B, h, self._max_len, dh), ctx=ctx,
+                          dtype=dtype) for _ in range(2 * len(blocks))]
+        prompt_np = prompt.asnumpy()             # (B, t0)
+        cur = F.array(prompt_np[:, 0:1], ctx=ctx)
+        for t in range(t0 - 1):                  # prefill prompt tokens
+            outs = prefill(cur, F.array([float(t)], ctx=ctx), *caches)
+            caches = outs[1:]
+            cur = F.array(prompt_np[:, t + 1:t + 2], ctx=ctx)
+        caches = [F.repeat(c, repeats=W, axis=0) for c in caches]
+        toks_np = np.repeat(prompt_np, W, axis=0)          # (BW, t0)
+        pad = self._max_len - t0
+        buf = F.array(np.concatenate(
+            [toks_np, np.zeros((B * W, pad), "f")], axis=1)
+            if pad else toks_np, ctx=ctx)
+        # only beam 0 contributes until beams diverge
+        cum = F.array(np.tile([0.0] + [-1e30] * (W - 1), (B, 1)), ctx=ctx)
+        offsets = F.array(np.arange(B)[:, None] * W *
+                          np.ones((1, W), "f"), ctx=ctx)
+        cur = F.array(toks_np[:, t0 - 1:t0], ctx=ctx)
+        for t in range(t0 - 1, t0 + max_new - 1):
+            outs = step(cur, F.array([float(t)], ctx=ctx), cum, buf,
+                        offsets, *caches)
+            cur, cum, buf, caches = outs[0], outs[1], outs[2], outs[3:]
+        buf_np = buf.asnumpy()[:, :t0 + max_new].reshape(B, W, -1)
+        cum_np = cum.asnumpy()                   # (B, W), sorted desc
+        best = buf_np[:, 0, :]                   # topk is descending
+        return (F.array(best, ctx=ctx),
+                F.array(cum_np[:, 0], ctx=ctx))
 
 
 def transformer_lm(vocab, **kwargs):

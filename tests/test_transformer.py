@@ -204,6 +204,37 @@ def test_generate_leaves_hybrid_state_alone():
     assert all(not b._active for b in net.blocks._children)
 
 
+def test_beam_search_width1_is_greedy_and_scores_are_exact():
+    """beam=1 must reproduce greedy KV decoding exactly, and the
+    returned log-prob must equal the teacher-forced rescoring of the
+    returned sequence (pins the combined-score/top-k/reindex
+    bookkeeping inside the on-device beam step)."""
+    rs = np.random.RandomState(23)
+    net = make_net(seed=10)
+    t0, new = 4, 7
+    prompt = mx.nd.array(rs.randint(0, V, (2, t0)).astype("f"))
+    greedy = net.generate(prompt, new, kv_cache=True).asnumpy()
+    b1, s1 = net.beam_search(prompt, new, beam=1)
+    assert (b1.asnumpy() == greedy).all()
+    b3, s3 = net.beam_search(prompt, new, beam=3)
+    # (no width-monotonicity assert: beam search keeps the W best
+    # PREFIXES, so a wider beam is not provably >= greedy in score)
+    # exact-score pin: rescore the winning sequences teacher-forced
+    seq = b3.asnumpy()
+    logits = net(b3).asnumpy()
+    m = logits.max(-1, keepdims=True)
+    lp = logits - m - np.log(np.exp(logits - m).sum(-1, keepdims=True))
+    resc = np.array([
+        sum(lp[b, t, int(seq[b, t + 1])] for t in range(t0 - 1,
+                                                        t0 + new - 1))
+        for b in range(seq.shape[0])])
+    assert np.allclose(s3.asnumpy(), resc, atol=1e-3), (s3.asnumpy(),
+                                                        resc)
+    import pytest
+    with pytest.raises(ValueError):
+        net.beam_search(prompt, new, beam=0)
+
+
 def test_save_load_roundtrip_with_decode_wrappers(tmp_path):
     """save_params/load_params must round-trip a net whose decode
     wrappers were already built (the wrappers share the net's
