@@ -55,8 +55,9 @@ $(CPP_EX): cpp-package/example/mlp_predict.cc $(LIB) \
 CAPI_EX := cpp-package/example/capi_predict
 CAPI_TRAIN_EX := cpp-package/example/capi_train
 CAPI_KV_EX := cpp-package/example/capi_kv_iter
+CAPI_LM_EX := cpp-package/example/capi_lm_decode
 
-capi_example: $(CAPI_EX) $(CAPI_TRAIN_EX) $(CAPI_KV_EX)
+capi_example: $(CAPI_EX) $(CAPI_TRAIN_EX) $(CAPI_KV_EX) $(CAPI_LM_EX)
 
 $(CAPI_EX): cpp-package/example/capi_predict.c $(PRED_LIB) \
             src/runtime/mxt_predict.h
@@ -76,9 +77,15 @@ $(CAPI_KV_EX): cpp-package/example/capi_kv_iter.c $(PRED_LIB) \
 	    -Lmxnet_tpu/_native -lmxt_predict \
 	    -Wl,-rpath,'$$ORIGIN/../../mxnet_tpu/_native'
 
+$(CAPI_LM_EX): cpp-package/example/capi_lm_decode.c $(PRED_LIB) \
+            src/runtime/mxt_predict.h
+	$(CC) -O2 -Wall -o $@ $< \
+	    -Lmxnet_tpu/_native -lmxt_predict \
+	    -Wl,-rpath,'$$ORIGIN/../../mxnet_tpu/_native'
+
 test: native
 	python -m pytest tests/ -x -q
 
 clean:
 	rm -f $(LIB) $(CPP_EX) $(PRED_LIB) $(CAPI_EX) $(CAPI_TRAIN_EX) \
-	    $(CAPI_KV_EX)
+	    $(CAPI_KV_EX) $(CAPI_LM_EX)

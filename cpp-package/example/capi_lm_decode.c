@@ -1,0 +1,175 @@
+/* capi_lm_decode — autoregressive LM decoding from plain C over the
+ * MXTPred* inference ABI: load the KV decode cell exported by
+ * TransformerLM.export_decode_step (symbol JSON + params), then loop
+ * SetInput(token, pos, caches) / Forward / GetOutput(logits, caches),
+ * feeding the cache outputs back in — greedy decoding with O(T) work
+ * per token and one compiled program for every step.
+ *
+ * Beyond-reference serving path: the 2017 reference's predict-cpp
+ * example classifies images; this is the same flat-C workflow carried
+ * to the transformer era.
+ *
+ *   capi_lm_decode <symbol.json> <params> <prompt.f32> B T0 MAXNEW L H TMAX DH
+ *
+ * prompt.f32 holds B*T0 little-endian float32 token ids.  Prints one
+ * "generated: ..." line per batch row (parsed by
+ * tests/test_cpp_package.py against python generate(kv_cache=True)).
+ */
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+#include "../../src/runtime/mxt_predict.h"
+
+static char *read_file(const char *path, long *len) {
+  FILE *f = fopen(path, "rb");
+  if (!f) return NULL;
+  fseek(f, 0, SEEK_END);
+  *len = ftell(f);
+  if (*len < 0) {
+    fclose(f);
+    return NULL;
+  }
+  fseek(f, 0, SEEK_SET);
+  char *buf = (char *)malloc((size_t)*len + 1);
+  if (!buf) {
+    fclose(f);
+    return NULL;
+  }
+  if (fread(buf, 1, *len, f) != (size_t)*len) {
+    fclose(f);
+    free(buf);
+    return NULL;
+  }
+  buf[*len] = 0;
+  fclose(f);
+  return buf;
+}
+
+int main(int argc, char **argv) {
+  if (argc != 11) {
+    fprintf(stderr,
+            "usage: %s <symbol.json> <params> <prompt.f32> B T0 MAXNEW "
+            "L H TMAX DH\n",
+            argv[0]);
+    return 2;
+  }
+  long json_len = 0, prompt_len = 0;
+  char *json = read_file(argv[1], &json_len);
+  char *raw = read_file(argv[3], &prompt_len);
+  uint32_t b = (uint32_t)atoi(argv[4]), t0 = (uint32_t)atoi(argv[5]);
+  uint32_t max_new = (uint32_t)atoi(argv[6]), nl = (uint32_t)atoi(argv[7]);
+  uint32_t nh = (uint32_t)atoi(argv[8]), tmax = (uint32_t)atoi(argv[9]);
+  uint32_t dh = (uint32_t)atoi(argv[10]);
+  uint64_t want = (uint64_t)b * t0 * sizeof(float);
+  if (!json || !raw || (uint64_t)prompt_len != want || nl == 0 ||
+      t0 == 0 || t0 + max_new > tmax) {
+    fprintf(stderr, "bad inputs (prompt %ld bytes, want %llu)\n",
+            prompt_len, (unsigned long long)want);
+    return 2;
+  }
+  const float *prompt = (const float *)raw;
+  uint32_t ncache = 2 * nl, nin = 2 + ncache;
+
+  /* input descriptors: data0 token (B,1), data1 pos (1,), then caches */
+  char **keys = (char **)malloc(nin * sizeof(char *));
+  const uint32_t **shapes =
+      (const uint32_t **)malloc(nin * sizeof(uint32_t *));
+  uint32_t *ndims = (uint32_t *)malloc(nin * sizeof(uint32_t));
+  uint32_t tok_shape[] = {b, 1}, pos_shape[] = {1};
+  uint32_t cache_shape[] = {b, nh, tmax, dh};
+  for (uint32_t i = 0; i < nin; i++) {
+    keys[i] = (char *)malloc(16);
+    snprintf(keys[i], 16, "data%u", i);
+    if (i == 0) {
+      shapes[i] = tok_shape;
+      ndims[i] = 2;
+    } else if (i == 1) {
+      shapes[i] = pos_shape;
+      ndims[i] = 1;
+    } else {
+      shapes[i] = cache_shape;
+      ndims[i] = 4;
+    }
+  }
+
+  MXTPredictorHandle h = NULL;
+  if (MXTPredCreate(json, argv[2], nin, (const char **)keys, shapes, ndims,
+                    &h) != 0) {
+    fprintf(stderr, "create failed: %s\n", MXTPredGetLastError());
+    return 1;
+  }
+
+  /* vocab size from the logits output shape after one dry forward */
+  uint64_t cache_n = (uint64_t)b * nh * tmax * dh;
+  float **caches = (float **)malloc(ncache * sizeof(float *));
+  for (uint32_t i = 0; i < ncache; i++)
+    caches[i] = (float *)calloc(cache_n, sizeof(float));
+  float *cur = (float *)malloc(b * sizeof(float));
+  float *out_toks = (float *)malloc((uint64_t)b * (t0 + max_new) *
+                                    sizeof(float));
+  for (uint32_t r = 0; r < b; r++) {
+    for (uint32_t t = 0; t < t0; t++)
+      out_toks[r * (t0 + max_new) + t] = prompt[r * t0 + t];
+    cur[r] = prompt[r * t0];
+  }
+
+  uint32_t vocab = 0;
+  for (uint32_t t = 0; t + 1 < t0 + max_new; t++) {
+    float pos = (float)t;
+    if (MXTPredSetInput(h, "data0", cur, b) != 0 ||
+        MXTPredSetInput(h, "data1", &pos, 1) != 0) {
+      fprintf(stderr, "set input failed: %s\n", MXTPredGetLastError());
+      return 1;
+    }
+    for (uint32_t i = 0; i < ncache; i++)
+      if (MXTPredSetInput(h, keys[2 + i], caches[i], cache_n) != 0) {
+        fprintf(stderr, "set cache failed: %s\n", MXTPredGetLastError());
+        return 1;
+      }
+    if (MXTPredForward(h) != 0) {
+      fprintf(stderr, "forward failed: %s\n", MXTPredGetLastError());
+      return 1;
+    }
+    if (!vocab) {
+      uint32_t shp[8], rank = 8;
+      if (MXTPredGetOutputShape(h, 0, shp, &rank) != 0 || rank != 2) {
+        fprintf(stderr, "logits shape query failed\n");
+        return 1;
+      }
+      vocab = shp[1];
+    }
+    for (uint32_t i = 0; i < ncache; i++)
+      if (MXTPredGetOutput(h, 1 + i, caches[i], cache_n) != 0) {
+        fprintf(stderr, "cache out failed: %s\n", MXTPredGetLastError());
+        return 1;
+      }
+    if (t + 1 < t0) { /* prefill: feed the next prompt column */
+      for (uint32_t r = 0; r < b; r++) cur[r] = prompt[r * t0 + t + 1];
+    } else { /* greedy: argmax the logits in plain C */
+      float *logits = (float *)malloc((uint64_t)b * vocab * sizeof(float));
+      if (MXTPredGetOutput(h, 0, logits, (uint64_t)b * vocab) != 0) {
+        fprintf(stderr, "logits out failed: %s\n", MXTPredGetLastError());
+        return 1;
+      }
+      for (uint32_t r = 0; r < b; r++) {
+        uint32_t best = 0;
+        for (uint32_t v = 1; v < vocab; v++)
+          if (logits[r * vocab + v] > logits[r * vocab + best]) best = v;
+        cur[r] = (float)best;
+        out_toks[r * (t0 + max_new) + t + 1] = (float)best;
+      }
+      free(logits);
+    }
+  }
+
+  for (uint32_t r = 0; r < b; r++) {
+    printf("generated:");
+    for (uint32_t t = 0; t < t0 + max_new; t++)
+      printf(" %d", (int)out_toks[r * (t0 + max_new) + t]);
+    printf("\n");
+  }
+  MXTPredFree(h);
+  return 0;
+}

@@ -209,6 +209,21 @@ class TransformerLM(HybridBlock):
                                      F.array(nxt, ctx=prompt.context))
         return F.slice_axis(buf, axis=1, begin=0, end=t0 + max_new)
 
+    def _init_caches(self, batch, ctx=None, dtype=None):
+        """Zero per-layer K/V caches, (batch, H, max_len, dh) x 2L —
+        the ONE cache-construction site (KV decode, beam search, and
+        the decode-step export all share it)."""
+        from ... import ndarray as F
+        blocks = self.blocks._children
+        h, dh = blocks[0].attn._h, blocks[0].attn._dh
+        kw = {}
+        if ctx is not None:
+            kw["ctx"] = ctx
+        if dtype is not None:
+            kw["dtype"] = dtype
+        return [F.zeros((batch, h, self._max_len, dh), **kw)
+                for _ in range(2 * len(blocks))]
+
     def _check_kv_supported(self):
         for blk in self.blocks._children:
             if blk.attn._type in ("ring", "ulysses"):
@@ -343,14 +358,8 @@ class TransformerLM(HybridBlock):
         ctx = prompt.context
         greedy = temperature == 0
         step = self._kv_step()["greedy" if greedy else "sample"]
-        blocks = self.blocks._children
-        h = blocks[0].attn._h
-        dh = blocks[0].attn._dh
-        dtype = self.head.weight.dtype
-        caches = []
-        for _ in range(2 * len(blocks)):
-            caches.append(F.zeros((B, h, self._max_len, dh), ctx=ctx,
-                                  dtype=dtype))
+        caches = self._init_caches(B, ctx=ctx,
+                                   dtype=self.head.weight.dtype)
         toks_np = prompt.asnumpy()
         pieces = [prompt]                  # (B, k) device-side chunks
         cur = F.array(toks_np[:, 0:1], ctx=ctx)
@@ -424,6 +433,37 @@ class TransformerLM(HybridBlock):
         cache[width] = step
         return step
 
+    def export_decode_step(self, prefix, batch_size=1):
+        """Write the KV decode cell as a standalone predict artifact —
+        `{prefix}-symbol.json` + `{prefix}-0000.params` — loadable by
+        `mxnet_tpu.predictor.Predictor` AND the flat-C inference ABI
+        (`libmxt_predict.so`, parity c_predict_api.h): a plain-C
+        program can run LM decoding by looping SetInput(token, pos,
+        caches) / Forward / GetOutput(logits, caches), feeding the
+        cache outputs back in.
+
+        Inputs (in order): data0 token (B, 1), data1 pos (1,),
+        data2..data{2L+1} per-layer K/V caches (B, H, max_len, dh).
+        Outputs: [logits (B, vocab), *updated caches].  Returns the
+        input-name list.
+        """
+        from ... import ndarray as F
+        from ...model import save_checkpoint
+        self._check_kv_supported()
+        step = self._kv_step()["sample"]
+        tok = F.zeros((batch_size, 1))
+        pos = F.array([0.0])
+        caches = self._init_caches(batch_size)
+        inputs, out = step._get_graph(tok, pos, *caches)
+        aux_names = set(out.list_auxiliary_states())
+        params = {name: p.data()
+                  for name, p in step.collect_params().items()}
+        save_checkpoint(
+            prefix, 0, out,
+            {k: v for k, v in params.items() if k not in aux_names},
+            {k: v for k, v in params.items() if k in aux_names})
+        return [i.name for i in inputs]
+
     def beam_search(self, prompt, max_new, beam=4):
         """Beam-search decoding over the KV-cache cell.
 
@@ -451,14 +491,11 @@ class TransformerLM(HybridBlock):
         ctx = prompt.context
         prefill = self._kv_step()["sample"]
         step = self._beam_step(W)
-        blocks = self.blocks._children
-        h, dh = blocks[0].attn._h, blocks[0].attn._dh
-        dtype = self.head.weight.dtype
         # prefill at B rows (beams are identical over the prompt), then
         # tile the caches to B*W — prompt-dominated decodes must not pay
         # the beam width during prefill
-        caches = [F.zeros((B, h, self._max_len, dh), ctx=ctx,
-                          dtype=dtype) for _ in range(2 * len(blocks))]
+        caches = self._init_caches(B, ctx=ctx,
+                                   dtype=self.head.weight.dtype)
         prompt_np = prompt.asnumpy()             # (B, t0)
         cur = F.array(prompt_np[:, 0:1], ctx=ctx)
         for t in range(t0 - 1):                  # prefill prompt tokens

@@ -300,3 +300,44 @@ def test_capi_kvstore_and_dataiter(tmp_path):
     assert lines[1] == "rank 0 of 1", lines
     # python-parity for two sequential pushes then pull (assign updater)
     assert lines[2] == "pulled 2.0 2.0", lines
+
+
+def test_capi_lm_decode_matches_python(tmp_path):
+    """Plain-C autoregressive LM decoding over the predict ABI: the
+    exported KV decode cell (TransformerLM.export_decode_step) driven
+    from capi_lm_decode.c — SetInput(token/pos/caches) / Forward /
+    GetOutput(logits/caches) loop with C-side greedy argmax — must
+    emit the exact token sequence of python generate(kv_cache=True).
+    Beyond-reference serving path (the reference's predict-cpp serves
+    image classifiers; same flat-C workflow, transformer era)."""
+    subprocess.run(["make", "predict_capi", "capi_example"], cwd=REPO,
+                   check=True, capture_output=True)
+    from mxnet_tpu.gluon.model_zoo.transformer import TransformerLM
+    V, TMAX, L, H, DIMS = 30, 16, 2, 4, 32
+    mx.random.seed(11)
+    net = TransformerLM(vocab=V, dim=DIMS, num_layers=L, num_heads=H,
+                        max_len=TMAX)
+    net.initialize(mx.init.Xavier(), ctx=mx.cpu())
+    rs = np.random.RandomState(1)
+    B, T0, NEW = 2, 4, 6
+    prompt = mx.nd.array(rs.randint(0, V, (B, T0)).astype("f"))
+    expected = net.generate(prompt, NEW, kv_cache=True).asnumpy()
+
+    prefix = str(tmp_path / "lm")
+    names = net.export_decode_step(prefix, batch_size=B)
+    assert names[0] == "data0" and len(names) == 2 + 2 * L
+    prompt.asnumpy().astype("f").tofile(str(tmp_path / "prompt.f32"))
+
+    dh = DIMS // H
+    env = {**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONPATH": REPO}
+    bin_ = os.path.join(REPO, "cpp-package", "example", "capi_lm_decode")
+    proc = subprocess.run(
+        [bin_, prefix + "-symbol.json", prefix + "-0000.params",
+         str(tmp_path / "prompt.f32"), str(B), str(T0), str(NEW),
+         str(L), str(H), str(TMAX), str(dh)],
+        env=env, capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    rows = [ln.split()[1:] for ln in proc.stdout.strip().splitlines()
+            if ln.startswith("generated:")]
+    got = np.array([[float(v) for v in r] for r in rows])
+    assert (got == expected).all(), (got, expected)
