@@ -59,28 +59,12 @@ CAPI_LM_EX := cpp-package/example/capi_lm_decode
 
 capi_example: $(CAPI_EX) $(CAPI_TRAIN_EX) $(CAPI_KV_EX) $(CAPI_LM_EX)
 
-$(CAPI_EX): cpp-package/example/capi_predict.c $(PRED_LIB) \
-            src/runtime/mxt_predict.h
-	$(CC) -O2 -Wall -o $@ $< \
-	    -Lmxnet_tpu/_native -lmxt_predict \
-	    -Wl,-rpath,'$$ORIGIN/../../mxnet_tpu/_native'
-
-$(CAPI_TRAIN_EX): cpp-package/example/capi_train.c $(PRED_LIB) \
-            src/runtime/mxt_capi.h
+# one link recipe for every plain-C capi example (predict ABI; -lm is
+# harmless where unused, and both headers are cheap prereqs)
+cpp-package/example/capi_%: cpp-package/example/capi_%.c $(PRED_LIB) \
+            src/runtime/mxt_predict.h src/runtime/mxt_capi.h
 	$(CC) -O2 -Wall -o $@ $< \
 	    -Lmxnet_tpu/_native -lmxt_predict -lm \
-	    -Wl,-rpath,'$$ORIGIN/../../mxnet_tpu/_native'
-
-$(CAPI_KV_EX): cpp-package/example/capi_kv_iter.c $(PRED_LIB) \
-            src/runtime/mxt_capi.h
-	$(CC) -O2 -Wall -o $@ $< \
-	    -Lmxnet_tpu/_native -lmxt_predict \
-	    -Wl,-rpath,'$$ORIGIN/../../mxnet_tpu/_native'
-
-$(CAPI_LM_EX): cpp-package/example/capi_lm_decode.c $(PRED_LIB) \
-            src/runtime/mxt_predict.h
-	$(CC) -O2 -Wall -o $@ $< \
-	    -Lmxnet_tpu/_native -lmxt_predict \
 	    -Wl,-rpath,'$$ORIGIN/../../mxnet_tpu/_native'
 
 test: native

@@ -63,8 +63,8 @@ int main(int argc, char **argv) {
   uint32_t nh = (uint32_t)atoi(argv[8]), tmax = (uint32_t)atoi(argv[9]);
   uint32_t dh = (uint32_t)atoi(argv[10]);
   uint64_t want = (uint64_t)b * t0 * sizeof(float);
-  if (!json || !raw || (uint64_t)prompt_len != want || nl == 0 ||
-      t0 == 0 || t0 + max_new > tmax) {
+  if (!json || !raw || (uint64_t)prompt_len != want || b == 0 ||
+      nl == 0 || nh == 0 || dh == 0 || t0 == 0 || t0 + max_new > tmax) {
     fprintf(stderr, "bad inputs (prompt %ld bytes, want %llu)\n",
             prompt_len, (unsigned long long)want);
     return 2;

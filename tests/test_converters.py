@@ -263,3 +263,65 @@ def test_amalgamation_single_file_predictor(tmp_path):
     import json as _json
     got = np.array(_json.loads(proc.stdout.strip().splitlines()[-1]))
     assert_almost_equal(got.astype("f"), want, rtol=1e-5, atol=1e-6)
+
+
+def test_amalgamation_lm_decode_cell(tmp_path):
+    """The multi-input amalgamation form (--input NAME:SHAPE, repeat)
+    carries the TransformerLM KV decode cell: ONE .py (jax+numpy only)
+    whose decode loop emits the same greedy tokens as python
+    generate(kv_cache=True) — single-file LM serving."""
+    import subprocess
+    from mxnet_tpu.gluon.model_zoo.transformer import TransformerLM
+    V, TMAX, L, H, DIM = 20, 12, 2, 4, 32
+    mx.random.seed(13)
+    net = TransformerLM(vocab=V, dim=DIM, num_layers=L, num_heads=H,
+                        max_len=TMAX)
+    net.initialize(mx.init.Xavier(), ctx=mx.cpu())
+    rs = np.random.RandomState(2)
+    B, T0, NEW = 1, 3, 5
+    prompt = mx.nd.array(rs.randint(0, V, (B, T0)).astype("f"))
+    want = net.generate(prompt, NEW, kv_cache=True).asnumpy()
+
+    prefix = str(tmp_path / "lmd")
+    names = net.export_decode_step(prefix, batch_size=B)
+    dh = DIM // H
+    specs = [f"--input=data0:{B},1", "--input=data1:1"] + [
+        f"--input=data{i + 2}:{B},{H},{TMAX},{dh}" for i in range(2 * L)]
+    out_py = str(tmp_path / "lm_decode_cell.py")
+    env = {**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONPATH": REPO}
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "amalgamation/amalgamate.py"),
+         "--prefix", prefix, "--epoch", "0", "--out", out_py] + specs,
+        env=env, capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+    # decode loop against the generated single file, repo NOT on path
+    code = f"""
+import sys, json
+import numpy as np
+sys.path.insert(0, {str(tmp_path)!r})
+import lm_decode_cell as cell
+L2, B, T0, NEW = {2 * L}, {B}, {T0}, {NEW}
+prompt = np.load({str(tmp_path / 'prompt.npy')!r})
+caches = [np.zeros(({B}, {H}, {TMAX}, {dh}), 'f') for _ in range(L2)]
+out = np.zeros((B, T0 + NEW), 'f'); out[:, :T0] = prompt
+cur = prompt[:, 0:1]
+for t in range(T0 + NEW - 1):
+    res = cell.predict(cur, np.array([float(t)], 'f'), *caches)
+    logits, caches = res[0], list(res[1:])
+    if t + 1 < T0:
+        cur = prompt[:, t + 1:t + 2]
+    else:
+        cur = np.argmax(np.asarray(logits), -1).astype('f')[:, None]
+        out[:, t + 1] = cur[:, 0]
+print(json.dumps(out.tolist()))
+"""
+    np.save(str(tmp_path / "prompt.npy"), prompt.asnumpy())
+    proc = subprocess.run(
+        [sys.executable, "-c", code],
+        env={**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONPATH": ""},
+        cwd=str(tmp_path), capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    import json as _json
+    got = np.array(_json.loads(proc.stdout.strip().splitlines()[-1]))
+    assert (got == want).all(), (got, want)
