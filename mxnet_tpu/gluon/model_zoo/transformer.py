@@ -43,7 +43,8 @@ def _kv_forward(F, net, tok, pos, caches):
         qkv = blk.attn.qkv(h)                       # (N, 1, 3D)
         att, kc, vc = F.mha_decode_step(
             qkv, caches[2 * i], caches[2 * i + 1], pos,
-            num_heads=blk.attn._h)
+            num_heads=blk.attn._h,
+            impl="ring" if blk.attn._type == "ring" else "dense")
         new_caches += [kc, vc]
         x = x + blk.attn.proj(att)
         x = x + blk.ffn2(blk.ffn1(blk.ln2(x)))
@@ -209,29 +210,57 @@ class TransformerLM(HybridBlock):
                                      F.array(nxt, ctx=prompt.context))
         return F.slice_axis(buf, axis=1, begin=0, end=t0 + max_new)
 
-    def _init_caches(self, batch, ctx=None, dtype=None):
+    def _init_caches(self, batch, ctx=None, dtype=None, sharded=None):
         """Zero per-layer K/V caches, (batch, H, max_len, dh) x 2L —
         the ONE cache-construction site (KV decode, beam search, and
-        the decode-step export all share it)."""
+        the decode-step export all share it).  sharded=(mesh, axis)
+        allocates each cache host->shards directly (sequence axis
+        split over the mesh), so a cache larger than one device's
+        memory is never materialized on one device."""
         from ... import ndarray as F
         blocks = self.blocks._children
         h, dh = blocks[0].attn._h, blocks[0].attn._dh
+        shape = (batch, h, self._max_len, dh)
+        if sharded is not None:
+            import jax
+            import numpy as np
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            from ...ndarray import NDArray
+            mesh, axis = sharded
+            sh = NamedSharding(mesh, P(None, None, axis, None))
+            host = np.zeros(shape, np.dtype(dtype or "float32"))
+            return [NDArray(jax.device_put(host, sh))
+                    for _ in range(2 * len(blocks))]
         kw = {}
         if ctx is not None:
             kw["ctx"] = ctx
         if dtype is not None:
             kw["dtype"] = dtype
-        return [F.zeros((batch, h, self._max_len, dh), **kw)
-                for _ in range(2 * len(blocks))]
+        return [F.zeros(shape, **kw) for _ in range(2 * len(blocks))]
 
-    def _check_kv_supported(self):
+    def _check_kv_supported(self, allow_ring=True):
+        """kv_cache decode support by attention type.  'ring' decodes
+        over SEQUENCE-SHARDED caches (ring_decode_step; requires an
+        active parallel.sp_scope and max_len divisible by the axis
+        size).  'ulysses' would need head-sharded caches — decode
+        those models with static_shapes (the full sp forward).  Beam
+        search and the decode-step export are dense-cache paths
+        (allow_ring=False)."""
+        from ...parallel.sequence_parallel import current_sp_scope
         for blk in self.blocks._children:
-            if blk.attn._type in ("ring", "ulysses"):
+            t = blk.attn._type
+            if t == "ulysses" or (t == "ring" and not allow_ring):
                 raise NotImplementedError(
-                    "kv_cache decoding allocates full-length "
-                    "caches on one device; sequence-parallel "
-                    f"attn_type {blk.attn._type!r} needs sharded "
-                    "caches — decode with static_shapes instead")
+                    f"attn_type {t!r} is not supported on this decode "
+                    "path — decode with static_shapes instead")
+            if t == "ring":
+                mesh, axis = current_sp_scope()   # loud error if absent
+                n = mesh.shape[axis]
+                if self._max_len % n:
+                    raise ValueError(
+                        f"ring kv decode shards the cache over "
+                        f"'{axis}' (size {n}); max_len "
+                        f"{self._max_len} must be divisible by it")
 
     @staticmethod
     def _sample(last, temperature, rng):
@@ -357,16 +386,39 @@ class TransformerLM(HybridBlock):
         B, t0 = prompt.shape
         ctx = prompt.context
         greedy = temperature == 0
-        step = self._kv_step()["greedy" if greedy else "sample"]
-        caches = self._init_caches(B, ctx=ctx,
-                                   dtype=self.head.weight.dtype)
+        ring = any(blk.attn._type == "ring"
+                   for blk in self.blocks._children)
+        if ring:
+            # sequence-sharded caches: run the stack walk eagerly so
+            # the ring decode op shards over the ambient sp mesh per
+            # call (a jitted cell would need the whole step — params
+            # included — placed on the mesh, the same rule as the sp
+            # training forward)
+            def run_step(cur, pos, caches):
+                logits, nc = _kv_forward(F, self, cur, pos, caches)
+                head = (F.argmax(logits, axis=-1, keepdims=True)
+                        if greedy else logits)
+                return head, nc
+        else:
+            cell = self._kv_step()["greedy" if greedy else "sample"]
+
+            def run_step(cur, pos, caches):
+                outs = cell(cur, pos, *caches)
+                return outs[0], outs[1:]
+        if ring:
+            from ...parallel.sequence_parallel import current_sp_scope
+            caches = self._init_caches(
+                B, dtype=self.head.weight.dtype,
+                sharded=current_sp_scope())
+        else:
+            caches = self._init_caches(B, ctx=ctx,
+                                       dtype=self.head.weight.dtype)
         toks_np = prompt.asnumpy()
         pieces = [prompt]                  # (B, k) device-side chunks
         cur = F.array(toks_np[:, 0:1], ctx=ctx)
         for t in range(t0 + max_new - 1):
             pos = F.array([float(t)], ctx=ctx)
-            outs = step(cur, pos, *caches)
-            head, caches = outs[0], outs[1:]
+            head, caches = run_step(cur, pos, caches)
             if t + 1 < t0:                 # prefill: next prompt column
                 cur = F.array(toks_np[:, t + 1:t + 2], ctx=ctx)
             elif greedy:
@@ -449,7 +501,7 @@ class TransformerLM(HybridBlock):
         """
         from ... import ndarray as F
         from ...model import save_checkpoint
-        self._check_kv_supported()
+        self._check_kv_supported(allow_ring=False)
         step = self._kv_step()["sample"]
         tok = F.zeros((batch_size, 1))
         pos = F.array([0.0])
@@ -486,7 +538,7 @@ class TransformerLM(HybridBlock):
             raise ValueError(
                 f"prompt length {t0} + max_new {max_new} "
                 f"exceeds max_len {self._max_len}")
-        self._check_kv_supported()
+        self._check_kv_supported(allow_ring=False)
         W = beam
         ctx = prompt.context
         prefill = self._kv_step()["sample"]

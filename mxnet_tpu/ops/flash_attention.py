@@ -291,8 +291,8 @@ def _flash_attention_op(p, q, k, v):
           input_names=("qkv", "k_cache", "v_cache", "pos"),
           aliases=("mha_decode_step",), f32_inputs=(3,),
           args=[Arg("num_heads", int, required=True),
-                Arg("scale", float, -1.0)],
-          num_outputs=3, differentiable=False)
+                Arg("scale", float, -1.0), Arg("impl", str, "dense")],
+          num_outputs=3, differentiable=False, sp_impls=("ring",))
 def _mha_decode_step_op(p, qkv, kc, vc, pos):
     """One autoregressive attention step over a KV cache (inference).
 
@@ -312,6 +312,36 @@ def _mha_decode_step_op(p, qkv, kc, vc, pos):
     dh = D // H
     x = qkv.reshape(B, 3, H, dh)                    # T=1 folded away
     q, k, v = x[:, 0], x[:, 1], x[:, 2]             # (B, H, dh)
+    if p["impl"] not in ("dense", "ring"):
+        raise ValueError(
+            f"mha_decode_step impl={p['impl']!r}: choose 'dense' or "
+            "'ring' (ulysses decode needs head-sharded caches — use "
+            "the static decode strategy)")
+    if p["impl"] == "ring":
+        # sequence-sharded caches over the ambient sp mesh: the cache
+        # never leaves its shard; only (B,H) softmax reductions ride
+        # the axis (parallel/sequence_parallel.py ring_decode_step)
+        from ..parallel import sequence_parallel as _sp
+        mesh, axis = _sp.current_sp_scope()
+        scale = p["scale"] if p["scale"] > 0 else dh ** -0.5
+        eager = not isinstance(qkv, jax.core.Tracer)
+        orig_dev = None
+        if eager:
+            orig_dev = _sp.single_device_of(qkv)
+            q, k, v, pos = _sp.place_on_mesh(mesh, (q, k, v, pos))
+            kc, vc = _sp.place_on_mesh(
+                mesh, (kc, vc), spec=(None, None, axis, None))
+        out, kc, vc = _sp.ring_decode_step_sharded(
+            q, k, v, kc, vc, pos, mesh, axis_name=axis,
+            scale=float(scale))
+        if eager and orig_dev is not None:
+            # only the attention OUTPUT returns to the caller's device
+            # (it feeds single-device eager neighbors); the caches stay
+            # SHARDED — they are the recurrent state of the decode
+            # loop, and gathering them back each step would both defeat
+            # the memory scaling and pay O(cache) transfers per token
+            out = jax.device_put(out, orig_dev)
+        return out.reshape(B, 1, D).astype(qkv.dtype), kc, vc
     t = pos.astype(jnp.int32).reshape(())
     zero = jnp.zeros((), jnp.int32)
     kc = jax.lax.dynamic_update_slice(
@@ -371,11 +401,9 @@ def _multihead_attention_op(p, qkv):
             # sequence-sharded on the scope's mesh for shard_map, and
             # bring the result back so downstream single-device eager
             # ops compose (a jitted sp model runs fully on the mesh)
-            from jax.sharding import NamedSharding, PartitionSpec as _P
-            devs = list(q.devices()) if hasattr(q, "devices") else []
-            orig_dev = devs[0] if len(devs) == 1 else None
-            sh = NamedSharding(mesh, _P(None, None, axis, None))
-            q, k, v = (jax.device_put(a, sh) for a in (q, k, v))
+            orig_dev = _sp.single_device_of(q)
+            q, k, v = _sp.place_on_mesh(
+                mesh, (q, k, v), spec=(None, None, axis, None))
         fn = (_sp.ring_attention_sharded if p["impl"] == "ring"
               else _sp.ulysses_attention_sharded)
         out = fn(q, k, v, mesh, axis_name=axis, causal=bool(p["causal"]),

@@ -98,6 +98,81 @@ def ring_attention_sharded(q, k, v, mesh: Mesh, axis_name: str = "sp",
     return fn(q, k, v)
 
 
+def single_device_of(a):
+    """The one device an eager array is committed to, else None."""
+    devs = list(a.devices()) if hasattr(a, "devices") else []
+    return devs[0] if len(devs) == 1 else None
+
+
+def place_on_mesh(mesh: Mesh, arrays, spec=None):
+    """device_put each array onto `mesh` under PartitionSpec(*spec)
+    (replicated when spec is None) — the one eager-placement
+    implementation the sp ops share."""
+    sh = NamedSharding(mesh, P(*spec) if spec else P())
+    return tuple(jax.device_put(a, sh) if hasattr(a, "devices") else a
+                 for a in arrays)
+
+
+def ring_decode_step(q, k, v, kc, vc, pos, axis_name: str = "sp",
+                     scale: Optional[float] = None):
+    """One autoregressive decode step over SEQUENCE-SHARDED K/V caches
+    (call inside shard_map) — the long-context decode counterpart of
+    ring_attention: a context too large for one device's cache decodes
+    without ever materializing it on one chip.
+
+    Per device: q/k/v (B, H, dh) replicated — the current token's
+    projections; kc/vc (B, H, T_local, dh) this device's cache columns
+    (global sequence = concatenation over the axis in index order);
+    pos (1,) the current position t.  The owner shard writes K/V at
+    its local column; attention over all columns <= t runs as a
+    distributed softmax — lax.pmax for the global row max, lax.psum
+    for numerator/denominator — so ICI carries only (B, H)-sized
+    reductions, never cache blocks.
+    """
+    my = lax.axis_index(axis_name)
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+    Tl = kc.shape[2]
+    t = pos.astype(jnp.int32).reshape(())
+    loc = t - my * Tl
+    in_range = jnp.logical_and(loc >= 0, loc < Tl)
+    locc = jnp.clip(loc, 0, Tl - 1)
+    zero = jnp.zeros((), jnp.int32)
+    upd_k = lax.dynamic_update_slice(
+        kc, k[:, :, None, :].astype(kc.dtype), (zero, zero, locc, zero))
+    upd_v = lax.dynamic_update_slice(
+        vc, v[:, :, None, :].astype(vc.dtype), (zero, zero, locc, zero))
+    kc = jnp.where(in_range, upd_k, kc)
+    vc = jnp.where(in_range, upd_v, vc)
+    col = my * Tl + jnp.arange(Tl)
+    s = jnp.einsum("bhd,bhtd->bht", q.astype(jnp.float32) * scale,
+                   kc.astype(jnp.float32))
+    s = jnp.where(col[None, None, :] <= t, s, NEG_INF)
+    m = lax.pmax(jnp.max(s, axis=-1), axis_name)          # (B, H)
+    p = jnp.exp(s - m[..., None])
+    denom = lax.psum(jnp.sum(p, axis=-1), axis_name)      # (B, H)
+    num = lax.psum(jnp.einsum("bht,bhtd->bhd", p,
+                              vc.astype(jnp.float32)), axis_name)
+    out = num / jnp.maximum(denom[..., None], 1e-30)
+    return out.astype(q.dtype), kc, vc
+
+
+def ring_decode_step_sharded(q, k, v, kc, vc, pos, mesh: Mesh,
+                             axis_name: str = "sp",
+                             scale: Optional[float] = None):
+    """Convenience wrapper: caches sharded on their T axis, q/k/v/pos
+    replicated; returns (out (B,H,dh), new kc, new vc) with the caches
+    still sharded."""
+    cspec = P(None, None, axis_name, None)
+    rspec = P()
+    fn = shard_map(
+        functools.partial(ring_decode_step, axis_name=axis_name,
+                          scale=scale),
+        mesh=mesh,
+        in_specs=(rspec, rspec, rspec, cspec, cspec, rspec),
+        out_specs=(rspec, cspec, cspec), check_vma=False)
+    return fn(q, k, v, kc, vc, pos)
+
+
 def ulysses_attention(q, k, v, axis_name: str = "sp", causal: bool = False,
                       scale: Optional[float] = None):
     """Ulysses sequence parallelism (call inside shard_map).

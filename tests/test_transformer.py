@@ -185,12 +185,19 @@ def test_generate_kv_cache_matches_eager():
     import pytest
     with pytest.raises(ValueError):
         net.generate(prefix, 2, kv_cache=True, static_shapes=False)
-    # sp attention types need sharded caches — documented refusal
+    # ulysses needs head-sharded caches — documented refusal; ring
+    # without an active sp_scope fails loudly (see the ring tests)
     sp_net = make_net()
     for blk in sp_net.blocks._children:
-        blk.attn._type = "ring"
+        blk.attn._type = "ulysses"
     with pytest.raises(NotImplementedError):
         sp_net.generate(prefix, 2, kv_cache=True)
+    from mxnet_tpu.base import MXNetError
+    ring_net = make_net()
+    for blk in ring_net.blocks._children:
+        blk.attn._type = "ring"
+    with pytest.raises(MXNetError):
+        ring_net.generate(prefix, 2, kv_cache=True)
 
 
 def test_generate_leaves_hybrid_state_alone():
@@ -353,3 +360,73 @@ def test_sequence_parallel_training_step():
             qkv, num_heads=4, impl="ring", scale=0.125).asnumpy()
     assert_almost_equal(got, ref, rtol=1e-4, atol=1e-5,
                         names=("ring-scale", "dense-scale"))
+
+
+def test_ring_kv_decode_op_matches_dense():
+    """impl='ring' mha_decode_step (sequence-sharded caches, distributed
+    softmax via pmax/psum) must reproduce the dense decode step at every
+    position when fed a sequence token-by-token on a CPU mesh."""
+    import jax
+    from jax.sharding import Mesh
+    from mxnet_tpu import nd, parallel
+
+    devs = np.array(jax.devices("cpu")[:4])
+    mesh = Mesh(devs, ("sp",))
+    rs = np.random.RandomState(29)
+    Bq, H, Tmax, D = 2, 4, 8, 32        # Tmax divisible by the axis
+    dh = D // H
+    qkv_seq = nd.array(rs.normal(0, 1, (Bq, Tmax, 3 * D)).astype("f"))
+    kc_d = nd.zeros((Bq, H, Tmax, dh))
+    vc_d = nd.zeros((Bq, H, Tmax, dh))
+    kc_r = nd.zeros((Bq, H, Tmax, dh))
+    vc_r = nd.zeros((Bq, H, Tmax, dh))
+    for t in range(Tmax):
+        step_qkv = nd.slice_axis(qkv_seq, axis=1, begin=t, end=t + 1)
+        pos = nd.array([float(t)])
+        od, kc_d, vc_d = nd.mha_decode_step(step_qkv, kc_d, vc_d, pos,
+                                            num_heads=H)
+        with parallel.sp_scope(mesh):
+            orr, kc_r, vc_r = nd.mha_decode_step(step_qkv, kc_r, vc_r,
+                                                 pos, num_heads=H,
+                                                 impl="ring")
+        assert_almost_equal(orr.asnumpy(), od.asnumpy(),
+                            rtol=1e-4, atol=1e-5)
+    assert_almost_equal(kc_r.asnumpy(), kc_d.asnumpy(), rtol=1e-5,
+                        atol=1e-6)
+    assert_almost_equal(vc_r.asnumpy(), vc_d.asnumpy(), rtol=1e-5,
+                        atol=1e-6)
+
+
+def test_ring_kv_decode_generate():
+    """A ring-attention TransformerLM decodes with kv_cache=True under
+    an sp_scope — sequence-sharded caches end to end — and emits the
+    same greedy tokens as an identically-initialized dense model's KV
+    decode (max_len divisible by the mesh axis)."""
+    import jax
+    import pytest
+    from jax.sharding import Mesh
+    from mxnet_tpu import parallel
+
+    devs = np.array(jax.devices("cpu")[:4])
+    mesh = Mesh(devs, ("sp",))
+    dense = TransformerLM(vocab=V, dim=32, num_layers=2, num_heads=4,
+                          max_len=16, attn_type="dense")
+    ring = TransformerLM(vocab=V, dim=32, num_layers=2, num_heads=4,
+                         max_len=16, attn_type="ring")
+    mx.random.seed(31)
+    dense.initialize(mx.init.Xavier(), ctx=mx.cpu())
+    ring.initialize(mx.init.Xavier(), ctx=mx.cpu())
+    with parallel.sp_scope(mesh):      # ring's probe forward needs it
+        copy_params(ring, dense)
+    rs = np.random.RandomState(33)
+    prompt = mx.nd.array(rs.randint(0, V, (2, 4)).astype("f"))
+    want = dense.generate(prompt, 8, kv_cache=True).asnumpy()
+    with parallel.sp_scope(mesh):
+        got = ring.generate(prompt, 8, kv_cache=True).asnumpy()
+    assert (got == want).all(), (got, want)
+    # max_len not divisible by the axis -> loud error
+    bad = TransformerLM(vocab=V, dim=32, num_layers=1, num_heads=4,
+                        max_len=15, attn_type="ring")
+    bad.initialize(mx.init.Xavier(), ctx=mx.cpu())
+    with parallel.sp_scope(mesh), pytest.raises(ValueError):
+        bad.generate(prompt, 2, kv_cache=True)
