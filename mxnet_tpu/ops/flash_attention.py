@@ -319,8 +319,8 @@ def _mha_decode_step_op(p, qkv, kc, vc, pos):
             "the static decode strategy)")
     if p["impl"] == "ring":
         # sequence-sharded caches over the ambient sp mesh: the cache
-        # never leaves its shard; only (B,H) softmax reductions ride
-        # the axis (parallel/sequence_parallel.py ring_decode_step)
+        # never leaves its shard; only softmax stats (B,H) + combined
+        # values (B,H,dh) ride the axis (ring_decode_step)
         from ..parallel import sequence_parallel as _sp
         mesh, axis = _sp.current_sp_scope()
         scale = p["scale"] if p["scale"] > 0 else dh ** -0.5

@@ -86,16 +86,38 @@ def ring_attention(q, k, v, axis_name: str = "sp", causal: bool = False,
     return out.astype(q.dtype)
 
 
+@functools.lru_cache(maxsize=64)
+def _sharded_fn(kind, mesh: Mesh, axis_name: str, causal, scale):
+    """Build (and CACHE) the shard_map'd callable: jax's dispatch cache
+    is keyed on callable identity, so a fresh partial per call would
+    retrace every step of a decode loop."""
+    spec = P(None, None, axis_name, None)
+    if kind == "ring":
+        return shard_map(
+            functools.partial(ring_attention, axis_name=axis_name,
+                              causal=causal, scale=scale),
+            mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+            check_vma=False)
+    if kind == "ulysses":
+        return shard_map(
+            functools.partial(ulysses_attention, axis_name=axis_name,
+                              causal=causal, scale=scale),
+            mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+            check_vma=False)
+    rspec = P()
+    return shard_map(
+        functools.partial(ring_decode_step, axis_name=axis_name,
+                          scale=scale),
+        mesh=mesh,
+        in_specs=(rspec, rspec, rspec, spec, spec, rspec),
+        out_specs=(rspec, spec, spec), check_vma=False)
+
+
 def ring_attention_sharded(q, k, v, mesh: Mesh, axis_name: str = "sp",
                            causal: bool = False,
                            scale: Optional[float] = None):
     """Convenience wrapper: shard (B,H,T,D) arrays on T and run the ring."""
-    spec = P(None, None, axis_name, None)
-    fn = shard_map(
-        functools.partial(ring_attention, axis_name=axis_name, causal=causal,
-                          scale=scale),
-        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec, check_vma=False)
-    return fn(q, k, v)
+    return _sharded_fn("ring", mesh, axis_name, bool(causal), scale)(q, k, v)
 
 
 def single_device_of(a):
@@ -126,8 +148,8 @@ def ring_decode_step(q, k, v, kc, vc, pos, axis_name: str = "sp",
     pos (1,) the current position t.  The owner shard writes K/V at
     its local column; attention over all columns <= t runs as a
     distributed softmax — lax.pmax for the global row max, lax.psum
-    for numerator/denominator — so ICI carries only (B, H)-sized
-    reductions, never cache blocks.
+    for numerator/denominator — so ICI carries only the softmax stats
+    (B, H) and the combined values (B, H, dh), never cache blocks.
     """
     my = lax.axis_index(axis_name)
     scale = scale if scale is not None else q.shape[-1] ** -0.5
@@ -162,15 +184,8 @@ def ring_decode_step_sharded(q, k, v, kc, vc, pos, mesh: Mesh,
     """Convenience wrapper: caches sharded on their T axis, q/k/v/pos
     replicated; returns (out (B,H,dh), new kc, new vc) with the caches
     still sharded."""
-    cspec = P(None, None, axis_name, None)
-    rspec = P()
-    fn = shard_map(
-        functools.partial(ring_decode_step, axis_name=axis_name,
-                          scale=scale),
-        mesh=mesh,
-        in_specs=(rspec, rspec, rspec, cspec, cspec, rspec),
-        out_specs=(rspec, cspec, cspec), check_vma=False)
-    return fn(q, k, v, kc, vc, pos)
+    return _sharded_fn("ring_decode", mesh, axis_name, False,
+                       scale)(q, k, v, kc, vc, pos)
 
 
 def ulysses_attention(q, k, v, axis_name: str = "sp", causal: bool = False,
@@ -204,12 +219,8 @@ def ulysses_attention(q, k, v, axis_name: str = "sp", causal: bool = False,
 def ulysses_attention_sharded(q, k, v, mesh: Mesh, axis_name: str = "sp",
                               causal: bool = False,
                               scale: Optional[float] = None):
-    spec = P(None, None, axis_name, None)
-    fn = shard_map(
-        functools.partial(ulysses_attention, axis_name=axis_name, causal=causal,
-                          scale=scale),
-        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec, check_vma=False)
-    return fn(q, k, v)
+    return _sharded_fn("ulysses", mesh, axis_name, bool(causal),
+                       scale)(q, k, v)
 
 
 # -- ambient sequence-parallel scope (user-facing product surface) ---------
