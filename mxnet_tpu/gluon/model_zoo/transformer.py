@@ -147,7 +147,8 @@ class TransformerLM(HybridBlock):
 
 
     def generate(self, prompt, max_new, temperature=0.0, rng=None,
-                 static_shapes=None, kv_cache=False):
+                 static_shapes=None, kv_cache=False, top_k=0,
+                 top_p=0.0):
         """Autoregressive decoding from `prompt` (B, T0) token ids.
 
         Greedy when temperature==0, else softmax sampling.
@@ -183,14 +184,15 @@ class TransformerLM(HybridBlock):
                     "combining it with an explicit static_shapes "
                     "would be silently ignored — pass one or the other")
             self._check_kv_supported()
-            return self._generate_kv(prompt, max_new, temperature, rng)
+            return self._generate_kv(prompt, max_new, temperature, rng,
+                                     top_k, top_p)
         static_shapes = True if static_shapes is None else static_shapes
         if not static_shapes:
             toks = prompt
             for _ in range(max_new):
                 logits = self(toks)                  # (B, T, V)
                 last = logits[:, -1, :]
-                nxt = self._sample(last, temperature, rng)
+                nxt = self._sample(last, temperature, rng, top_k, top_p)
                 toks = F.concat(toks, F.array(nxt, ctx=toks.context),
                                 dim=1)
             return toks
@@ -205,7 +207,7 @@ class TransformerLM(HybridBlock):
                 buf = steps["greedy"](buf, pos)      # fully on device
             else:
                 last = steps["logits"](buf, pos)     # (B, V)
-                nxt = self._sample(last, temperature, rng)
+                nxt = self._sample(last, temperature, rng, top_k, top_p)
                 buf = steps["write"](buf, pos,
                                      F.array(nxt, ctx=prompt.context))
         return F.slice_axis(buf, axis=1, begin=0, end=t0 + max_new)
@@ -263,16 +265,45 @@ class TransformerLM(HybridBlock):
                         f"{self._max_len} must be divisible by it")
 
     @staticmethod
-    def _sample(last, temperature, rng):
-        """Host-side next-token choice from (B, V) logits -> (B, 1)."""
+    def _sample(last, temperature, rng, top_k=0, top_p=0.0):
+        """Host-side next-token choice from (B, V) logits -> (B, 1).
+
+        top_k > 0 keeps only the k most likely tokens; 0 < top_p <= 1
+        keeps the smallest set whose cumulative probability reaches
+        top_p (nucleus sampling, always at least the argmax); both
+        filters compose (top-k first, then top-p)."""
         import numpy as np
-        from ... import ndarray as F
-        if temperature > 0:
-            p = F.softmax(last / temperature, axis=-1).asnumpy()
-            return np.array([
-                (rng or np.random).choice(p.shape[-1], p=row / row.sum())
-                for row in p], dtype=np.float32)[:, None]
-        return last.asnumpy().argmax(-1).astype(np.float32)[:, None]
+        if top_k < 0:
+            raise ValueError(f"top_k must be >= 0, got {top_k}")
+        if not 0.0 <= top_p <= 1.0:
+            raise ValueError(f"top_p must be in [0, 1], got {top_p}")
+        if temperature <= 0:
+            return last.asnumpy().argmax(-1).astype(np.float32)[:, None]
+        logits = last.asnumpy().astype(np.float64) / temperature
+        out = np.empty((logits.shape[0], 1), np.float32)
+        r = rng or np.random
+        for b, row in enumerate(logits):
+            if top_k and top_k < row.size:
+                # exactly k survivors even under ties, chosen in
+                # stable (first-occurrence) order so top_k=1 keeps
+                # precisely the greedy argmax token
+                keep = np.argsort(-row, kind="stable")[:top_k]
+                masked = np.full_like(row, -np.inf)
+                masked[keep] = row[keep]
+                row = masked
+            p = np.exp(row - row.max())
+            p /= p.sum()
+            if 0.0 < top_p < 1.0:
+                order = np.argsort(-p)
+                cum = np.cumsum(p[order])
+                # keep the minimal prefix reaching top_p (>= 1 token)
+                cut = int(np.searchsorted(cum, top_p)) + 1
+                mask = np.zeros_like(p, bool)
+                mask[order[:cut]] = True
+                p = np.where(mask, p, 0.0)
+                p /= p.sum()
+            out[b, 0] = r.choice(p.size, p=p)
+        return out
 
     def _decode_steps(self):
         """Build (once) the three hybridized decode-step blocks.
@@ -374,7 +405,8 @@ class TransformerLM(HybridBlock):
         self.__dict__["_kv_step_cache"] = steps
         return steps
 
-    def _generate_kv(self, prompt, max_new, temperature, rng):
+    def _generate_kv(self, prompt, max_new, temperature, rng,
+                     top_k=0, top_p=0.0):
         """KV-cache decode loop: prefill feeds prompt tokens through
         the same one-token cell that generates (cache fills as a side
         effect); every step reuses one compiled program.  Greedy keeps
@@ -425,7 +457,7 @@ class TransformerLM(HybridBlock):
                 cur = head                 # stays on device
                 pieces.append(cur)
             else:
-                nxt = self._sample(head, temperature, rng)
+                nxt = self._sample(head, temperature, rng, top_k, top_p)
                 cur = F.array(nxt, ctx=ctx)
                 pieces.append(cur)
         return F.concat(*pieces, dim=1)

@@ -362,6 +362,31 @@ def test_sequence_parallel_training_step():
                         names=("ring-scale", "dense-scale"))
 
 
+def test_generate_top_k_top_p():
+    """top_k=1 sampling must equal greedy on every strategy; nucleus
+    filtering keeps tokens in-vocab and respects the prefix; the
+    filtered distribution is renormalized (tiny top_p ~ greedy)."""
+    rs = np.random.RandomState(37)
+    net = make_net(seed=12)
+    prefix = mx.nd.array(rs.randint(0, V, (2, 4)).astype("f"))
+    greedy = net.generate(prefix, 6, kv_cache=True).asnumpy()
+    for kw in ({"static_shapes": True}, {"static_shapes": False},
+               {"kv_cache": True}):
+        topk1 = net.generate(prefix, 6, temperature=1.0, top_k=1,
+                             rng=np.random.RandomState(3), **kw).asnumpy()
+        assert (topk1 == greedy).all(), (kw, topk1, greedy)
+    tiny_p = net.generate(prefix, 6, temperature=1.0, top_p=1e-9,
+                          rng=np.random.RandomState(4),
+                          kv_cache=True).asnumpy()
+    assert (tiny_p == greedy).all()
+    out = net.generate(prefix, 6, temperature=1.2, top_k=5, top_p=0.9,
+                       rng=np.random.RandomState(5),
+                       kv_cache=True).asnumpy()
+    assert out.shape == (2, 10)
+    assert (out[:, :4] == prefix.asnumpy()).all()
+    assert ((out >= 0) & (out < V)).all()
+
+
 def test_ring_kv_decode_op_matches_dense():
     """impl='ring' mha_decode_step (sequence-sharded caches, distributed
     softmax via pmax/psum) must reproduce the dense decode step at every
@@ -430,3 +455,18 @@ def test_ring_kv_decode_generate():
     bad.initialize(mx.init.Xavier(), ctx=mx.cpu())
     with parallel.sp_scope(mesh), pytest.raises(ValueError):
         bad.generate(prompt, 2, kv_cache=True)
+
+
+def test_sample_top_k_ties_and_validation():
+    """top_k keeps exactly k survivors under ties (top_k=1 == argmax
+    even with duplicated maxima); invalid top_k/top_p raise."""
+    import pytest
+    tied = mx.nd.array(np.array([[3.0, 3.0, 1.0, 0.0]], "f"))
+    for _ in range(5):
+        nxt = TransformerLM._sample(tied, 1.0, np.random.RandomState(0),
+                                    top_k=1)
+        assert nxt[0, 0] == 0.0          # first-occurrence max, = argmax
+    with pytest.raises(ValueError):
+        TransformerLM._sample(tied, 1.0, None, top_k=-1)
+    with pytest.raises(ValueError):
+        TransformerLM._sample(tied, 1.0, None, top_p=1.5)
