@@ -79,6 +79,9 @@ def main():
     p.add_argument("--num-layers", type=int, default=2)
     p.add_argument("--lr", type=float, default=1.0)
     p.add_argument("--clip", type=float, default=0.25)
+    p.add_argument("--gen-tokens", type=int, default=20,
+                   help="after training, greedy-decode this many tokens "
+                        "carrying the LSTM state (0 disables)")
     args = p.parse_args()
 
     tokens, vocab_size = synthetic_tokens()
@@ -115,6 +118,22 @@ def main():
         ppl = math.exp(total_L / max(n_batch, 1))
         logging.info("Epoch[%d] perplexity=%.1f time=%.1fs", epoch, ppl,
                      time.time() - tic)
+
+    # stateful greedy decoding: the RNN carries its hidden state, so
+    # incremental generation is O(1) per token natively — the recurrent
+    # counterpart of the transformer's KV cache (one (1, B) step per
+    # token, same cached program every step)
+    gen = args.gen_tokens
+    if gen:
+        hidden = model.begin_state(batch_size=1)
+        cur = nd.array([[float(tokens[0])]])        # (T=1, B=1)
+        out_toks = [int(tokens[0])]
+        for _ in range(gen):
+            logits, hidden = model(cur, hidden)
+            nxt = int(logits.asnumpy().argmax(-1)[0])
+            out_toks.append(nxt)
+            cur = nd.array([[float(nxt)]])
+        print("generated:", " ".join(str(t) for t in out_toks[1:]))
 
 
 if __name__ == "__main__":

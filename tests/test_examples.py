@@ -260,6 +260,9 @@ def test_word_lm_example():
                       "--num-hidden", "16", "--num-embed", "16",
                       "--num-layers", "1", "--bptt", "10", timeout=420)
     assert "perplexity" in out
+    # and the stateful (hidden-carrying) greedy decode demo emitted
+    gen = [l for l in out.splitlines() if l.startswith("generated:")][0]
+    assert len(gen.split()) == 21, gen  # 'generated:' + 20 tokens
 
 
 def test_ssd_example():
