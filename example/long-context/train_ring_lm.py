@@ -1,0 +1,146 @@
+"""Long-context training + decoding with sequence parallelism.
+
+Beyond-reference showcase (SURVEY.md §5: the 2017 reference has no
+long-context parallelism — no attention at all): the SAME gluon
+TransformerLM trains and decodes with its attention sharded over a
+mesh axis, so sequence length scales with device count:
+
+  - training: `attn_type="ring"` (K/V rotate over the axis via
+    lax.ppermute, online softmax) or `"ulysses"` (all-to-all head
+    re-sharding) under an ambient `parallel.sp_scope(mesh)`; eager
+    autograd round-trips through the sharded kernels.
+  - decoding (ring): `generate(kv_cache=True)` runs over
+    SEQUENCE-SHARDED caches (`ring_decode_step`) — each device holds
+    max_len/n cache columns; ICI carries softmax stats, never cache
+    blocks.
+
+On real hardware the mesh axis spans TPU chips over ICI; here it runs
+on any device set (CI uses the 8-virtual-CPU mesh).  The sequence
+length must be divisible by the axis size.
+
+    python example/long-context/train_ring_lm.py --devices 4 \
+        --seq-len 64 --attn ring
+"""
+import argparse
+import logging
+import math
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, parallel
+from mxnet_tpu.gluon.model_zoo.transformer import TransformerLM
+
+
+def make_corpus(rs, vocab, length, sharpness=6.0):
+    """2nd-order Markov chain (structure for the model to learn)."""
+    logits = rs.normal(0, 1, (vocab, vocab, vocab)) * sharpness
+    probs = np.exp(logits - logits.max(-1, keepdims=True))
+    probs /= probs.sum(-1, keepdims=True)
+    toks = [0, 1]
+    for _ in range(length - 2):
+        toks.append(int(rs.choice(vocab, p=probs[toks[-2], toks[-1]])))
+    return np.asarray(toks, np.int32)
+
+
+def main():
+    ap = argparse.ArgumentParser(description="sequence-parallel LM")
+    ap.add_argument("--devices", type=int, default=0,
+                    help="sp axis size (0 = all available, capped at 4)")
+    ap.add_argument("--seq-len", type=int, default=64)
+    ap.add_argument("--batch-size", type=int, default=8)
+    ap.add_argument("--vocab", type=int, default=32)
+    ap.add_argument("--dim", type=int, default=64)
+    ap.add_argument("--layers", type=int, default=2)
+    ap.add_argument("--heads", type=int, default=4)
+    ap.add_argument("--epochs", type=int, default=2)
+    ap.add_argument("--lr", type=float, default=3e-3)
+    ap.add_argument("--corpus-len", type=int, default=8000)
+    ap.add_argument("--max-batches", type=int, default=0)
+    ap.add_argument("--attn", default="ring", choices=("ring", "ulysses"))
+    ap.add_argument("--gen-tokens", type=int, default=12,
+                    help="ring only: sharded-cache greedy decode demo")
+    args = ap.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    import jax
+    from jax.sharding import Mesh
+    devs = jax.devices()
+    n = args.devices or min(4, len(devs))
+    if len(devs) < n:
+        raise SystemExit(f"need {n} devices, have {len(devs)} "
+                         "(set XLA_FLAGS=--xla_force_host_platform_"
+                         "device_count=N for a virtual CPU mesh)")
+    if args.seq_len % n:
+        raise SystemExit(f"--seq-len {args.seq_len} must divide by the "
+                         f"sp axis size {n}")
+    if args.attn == "ulysses" and args.heads % n:
+        raise SystemExit(f"ulysses re-shards heads: --heads {args.heads} "
+                         f"must divide by {n}")
+    mesh = Mesh(np.array(devs[:n]), ("sp",))
+    logging.info("sp mesh: %d x %s", n, devs[0].platform)
+
+    rs = np.random.RandomState(0)
+    corpus = make_corpus(rs, args.vocab, args.corpus_len)
+    net = TransformerLM(args.vocab, dim=args.dim, num_layers=args.layers,
+                        num_heads=args.heads, max_len=args.seq_len,
+                        attn_type=args.attn)
+    net.initialize(mx.init.Xavier(), ctx=mx.cpu())
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": args.lr})
+    sce = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    T, Bs = args.seq_len, args.batch_size
+    nwin = len(corpus) - T - 1
+    with parallel.sp_scope(mesh):          # attention shards over 'sp'
+        for epoch in range(args.epochs):
+            tot, nb = 0.0, 0
+            starts = rs.permutation(nwin)[:(nwin // Bs) * Bs]
+            last = None
+            for i in range(0, len(starts), Bs):
+                idx = starts[i:i + Bs]
+                x = mx.nd.array(np.stack(
+                    [corpus[j:j + T] for j in idx]).astype("f"))
+                y = mx.nd.array(np.stack(
+                    [corpus[j + 1:j + T + 1] for j in idx]).astype("f"))
+                with autograd.record():
+                    logits = net(x)
+                    loss = sce(logits.reshape((-1, args.vocab)),
+                               y.reshape((-1,)))
+                loss.backward()
+                trainer.step(Bs)
+                last = float(loss.mean().asnumpy())
+                tot += last
+                nb += 1
+                if args.max_batches and nb >= args.max_batches:
+                    break
+            logging.info("Epoch[%d] mean ppl=%.2f", epoch,
+                         math.exp(tot / max(nb, 1)))
+        # the mean is dominated by the first (untrained) batches; the
+        # last batch is the learning signal
+        print("final ppl %.3f last-batch ppl %.3f (uniform %.1f)"
+              % (math.exp(tot / max(nb, 1)), math.exp(last or 0.0),
+                 args.vocab))
+
+        if args.attn == "ring" and args.gen_tokens:
+            if args.gen_tokens >= args.seq_len:
+                raise SystemExit(
+                    f"--gen-tokens {args.gen_tokens} must be < "
+                    f"--seq-len {args.seq_len} (the fixed decode "
+                    "buffer holds prompt + generation)")
+            # sequence-sharded KV decode: caches live max_len/n per
+            # device and never gather (ring_decode_step)
+            plen = max(1, min(8, args.seq_len - args.gen_tokens))
+            prefix = mx.nd.array(corpus[None, :plen].astype("f"))
+            toks = net.generate(prefix, args.gen_tokens, kv_cache=True)
+            print("generated:", " ".join(
+                str(int(t)) for t in toks.asnumpy()[0][plen:]))
+
+
+if __name__ == "__main__":
+    main()
