@@ -82,6 +82,17 @@ def main():
     if args.attn == "ulysses" and args.heads % n:
         raise SystemExit(f"ulysses re-shards heads: --heads {args.heads} "
                          f"must divide by {n}")
+    if args.attn == "ring" and args.gen_tokens >= args.seq_len:
+        raise SystemExit(
+            f"--gen-tokens {args.gen_tokens} must be < --seq-len "
+            f"{args.seq_len} (the fixed decode buffer holds prompt + "
+            "generation)")
+    nwin_check = args.corpus_len - args.seq_len - 1
+    if nwin_check < args.batch_size:
+        raise SystemExit(
+            f"--corpus-len {args.corpus_len} gives {max(nwin_check, 0)} "
+            f"training windows < --batch-size {args.batch_size} — "
+            "nothing would train")
     mesh = Mesh(np.array(devs[:n]), ("sp",))
     logging.info("sp mesh: %d x %s", n, devs[0].platform)
 
@@ -128,11 +139,6 @@ def main():
                  args.vocab))
 
         if args.attn == "ring" and args.gen_tokens:
-            if args.gen_tokens >= args.seq_len:
-                raise SystemExit(
-                    f"--gen-tokens {args.gen_tokens} must be < "
-                    f"--seq-len {args.seq_len} (the fixed decode "
-                    "buffer holds prompt + generation)")
             # sequence-sharded KV decode: caches live max_len/n per
             # device and never gather (ring_decode_step)
             plen = max(1, min(8, args.seq_len - args.gen_tokens))

@@ -172,6 +172,28 @@ def apply_op(op: Operator, params: Tuple[Tuple[str, Any], ...], inputs) -> Tuple
     return _jitted(op.name, params, _layout.conv_layout())(*inputs)
 
 
+@functools.lru_cache(maxsize=None)
+def _sp_fwd_bwd(op_name: str, params: Tuple[Tuple[str, Any], ...],
+                mesh, axis_name: str):
+    """Cached jitted forward + vjp-backward for a sequence-parallel op
+    under eager autograd (same idiom as _jitted).  The ambient scope's
+    (mesh, axis) pair is captured at trace time inside op.fn, so BOTH
+    are cache keys — the same mesh under a different sp axis must
+    trace fresh.  jax.jit caches per input shape under each entry."""
+    op = OP_REGISTRY[op_name]
+    pd = dict(params)
+
+    def run(*ins):
+        out = op.fn(pd, *ins)
+        return out if isinstance(out, tuple) else (out,)
+
+    def bwd(ins, cts):
+        _, vjp_fn = jax.vjp(run, *ins)
+        return vjp_fn(tuple(cts))
+
+    return jax.jit(run), jax.jit(bwd)
+
+
 def make_vjp(op: Operator, params: Tuple[Tuple[str, Any], ...], inputs):
     """Forward + vjp closure for autograd (replaces hand-written Backwards)."""
     pd = dict(params)
@@ -186,7 +208,12 @@ def make_vjp(op: Operator, params: Tuple[Tuple[str, Any], ...], inputs):
         # place primals on the ambient sp mesh (replicated: valid for
         # any op semantics; the inner shard_map re-shards to its specs)
         # BEFORE tracing, and round-trip outputs / cotangents / grads
-        # so single-device eager neighbors compose.
+        # so single-device eager neighbors compose.  The fwd and bwd
+        # are CACHED jits keyed on (op, params, mesh): a fresh
+        # jax.vjp per call re-traced the shard_map every training step
+        # (~13s/step on the CPU mesh for the sp LM example); the bwd
+        # recomputes the forward inside one compiled program — the
+        # standard remat trade for cacheability.
         from ..parallel import sequence_parallel as _sp
         from jax.sharding import NamedSharding, PartitionSpec as _P
         mesh, _axis = _sp.current_sp_scope()
@@ -198,16 +225,18 @@ def make_vjp(op: Operator, params: Tuple[Tuple[str, Any], ...], inputs):
         def to_mesh(a):
             return jax.device_put(a, repl) if hasattr(a, "devices") else a
 
-        outs, vjp_fn = jax.vjp(run, *(to_mesh(a) for a in inputs))
+        fwd, bwd = _sp_fwd_bwd(op.name, params, mesh, _axis)
+        mesh_ins = tuple(to_mesh(a) for a in inputs)
+        outs = fwd(*mesh_ins)
         if orig is not None:
             outs = tuple(jax.device_put(o, orig) for o in outs)
 
             def vjp_back(cts):
-                grads = vjp_fn(tuple(to_mesh(c) for c in cts))
+                grads = bwd(mesh_ins, tuple(to_mesh(c) for c in cts))
                 return tuple(jax.device_put(g, orig) for g in grads)
 
             return outs, vjp_back
-        return outs, vjp_fn
+        return outs, lambda cts: bwd(mesh_ins, tuple(cts))
 
     return jax.vjp(run, *inputs)
 
