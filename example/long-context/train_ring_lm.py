@@ -64,7 +64,7 @@ def main():
     ap.add_argument("--max-batches", type=int, default=0)
     ap.add_argument("--attn", default="ring", choices=("ring", "ulysses"))
     ap.add_argument("--gen-tokens", type=int, default=12,
-                    help="ring only: sharded-cache greedy decode demo")
+                    help="sharded-cache greedy decode demo (0 disables)")
     args = ap.parse_args()
     logging.basicConfig(level=logging.INFO)
 
@@ -82,7 +82,7 @@ def main():
     if args.attn == "ulysses" and args.heads % n:
         raise SystemExit(f"ulysses re-shards heads: --heads {args.heads} "
                          f"must divide by {n}")
-    if args.attn == "ring" and args.gen_tokens >= args.seq_len:
+    if args.gen_tokens >= args.seq_len:
         raise SystemExit(
             f"--gen-tokens {args.gen_tokens} must be < --seq-len "
             f"{args.seq_len} (the fixed decode buffer holds prompt + "
@@ -138,9 +138,10 @@ def main():
               % (math.exp(tot / max(nb, 1)), math.exp(last or 0.0),
                  args.vocab))
 
-        if args.attn == "ring" and args.gen_tokens:
-            # sequence-sharded KV decode: caches live max_len/n per
-            # device and never gather (ring_decode_step)
+        if args.gen_tokens:
+            # sharded KV decode: ring = sequence-sharded columns,
+            # ulysses = head-sharded full-length caches; either way the
+            # cache never gathers onto one device
             plen = max(1, min(8, args.seq_len - args.gen_tokens))
             prefix = mx.nd.array(corpus[None, :plen].astype("f"))
             toks = net.generate(prefix, args.gen_tokens, kv_cache=True)

@@ -44,7 +44,8 @@ def _kv_forward(F, net, tok, pos, caches):
         att, kc, vc = F.mha_decode_step(
             qkv, caches[2 * i], caches[2 * i + 1], pos,
             num_heads=blk.attn._h,
-            impl="ring" if blk.attn._type == "ring" else "dense")
+            impl=(blk.attn._type
+                  if blk.attn._type in ("ring", "ulysses") else "dense"))
         new_caches += [kc, vc]
         x = x + blk.attn.proj(att)
         x = x + blk.ffn2(blk.ffn1(blk.ln2(x)))
@@ -215,10 +216,11 @@ class TransformerLM(HybridBlock):
     def _init_caches(self, batch, ctx=None, dtype=None, sharded=None):
         """Zero per-layer K/V caches, (batch, H, max_len, dh) x 2L —
         the ONE cache-construction site (KV decode, beam search, and
-        the decode-step export all share it).  sharded=(mesh, axis)
-        allocates each cache host->shards directly (sequence axis
-        split over the mesh), so a cache larger than one device's
-        memory is never materialized on one device."""
+        the decode-step export all share it).  sharded=(mesh, axis,
+        kind) allocates each cache host->shards directly — 'ring'
+        splits the sequence axis, 'ulysses' the head axis — so a
+        cache larger than one device's memory is never materialized
+        on one device."""
         from ... import ndarray as F
         blocks = self.blocks._children
         h, dh = blocks[0].attn._h, blocks[0].attn._dh
@@ -228,8 +230,10 @@ class TransformerLM(HybridBlock):
             import numpy as np
             from jax.sharding import NamedSharding, PartitionSpec as P
             from ...ndarray import NDArray
-            mesh, axis = sharded
-            sh = NamedSharding(mesh, P(None, None, axis, None))
+            mesh, axis, kind = sharded
+            sh = NamedSharding(mesh, P(None, None, axis, None)
+                               if kind == "ring"
+                               else P(None, axis, None, None))
             host = np.zeros(shape, np.dtype(dtype or "float32"))
             return [NDArray(jax.device_put(host, sh))
                     for _ in range(2 * len(blocks))]
@@ -240,29 +244,34 @@ class TransformerLM(HybridBlock):
             kw["dtype"] = dtype
         return [F.zeros(shape, **kw) for _ in range(2 * len(blocks))]
 
-    def _check_kv_supported(self, allow_ring=True):
+    def _check_kv_supported(self, allow_sp=True):
         """kv_cache decode support by attention type.  'ring' decodes
-        over SEQUENCE-SHARDED caches (ring_decode_step; requires an
-        active parallel.sp_scope and max_len divisible by the axis
-        size).  'ulysses' would need head-sharded caches — decode
-        those models with static_shapes (the full sp forward).  Beam
-        search and the decode-step export are dense-cache paths
-        (allow_ring=False)."""
+        over SEQUENCE-SHARDED caches (ring_decode_step; max_len must
+        divide by the axis size) and 'ulysses' over HEAD-SHARDED
+        caches (ulysses_decode_step; num_heads must divide) — both
+        require an active parallel.sp_scope.  Beam search and the
+        decode-step export are dense-cache paths (allow_sp=False)."""
         from ...parallel.sequence_parallel import current_sp_scope
         for blk in self.blocks._children:
             t = blk.attn._type
-            if t == "ulysses" or (t == "ring" and not allow_ring):
+            if t not in ("ring", "ulysses"):
+                continue
+            if not allow_sp:
                 raise NotImplementedError(
                     f"attn_type {t!r} is not supported on this decode "
                     "path — decode with static_shapes instead")
-            if t == "ring":
-                mesh, axis = current_sp_scope()   # loud error if absent
-                n = mesh.shape[axis]
-                if self._max_len % n:
-                    raise ValueError(
-                        f"ring kv decode shards the cache over "
-                        f"'{axis}' (size {n}); max_len "
-                        f"{self._max_len} must be divisible by it")
+            mesh, axis = current_sp_scope()       # loud error if absent
+            n = mesh.shape[axis]
+            if t == "ring" and self._max_len % n:
+                raise ValueError(
+                    f"ring kv decode shards the cache over '{axis}' "
+                    f"(size {n}); max_len {self._max_len} must be "
+                    "divisible by it")
+            if t == "ulysses" and blk.attn._h % n:
+                raise ValueError(
+                    f"ulysses kv decode shards heads over '{axis}' "
+                    f"(size {n}); num_heads {blk.attn._h} must be "
+                    "divisible by it")
 
     @staticmethod
     def _sample(last, temperature, rng, top_k=0, top_p=0.0):
@@ -418,9 +427,9 @@ class TransformerLM(HybridBlock):
         B, t0 = prompt.shape
         ctx = prompt.context
         greedy = temperature == 0
-        ring = any(blk.attn._type == "ring"
-                   for blk in self.blocks._children)
-        if ring:
+        sp_type = next((blk.attn._type for blk in self.blocks._children
+                        if blk.attn._type in ("ring", "ulysses")), None)
+        if sp_type:
             # sequence-sharded caches: run the stack walk eagerly so
             # the ring decode op shards over the ambient sp mesh per
             # call (a jitted cell would need the whole step — params
@@ -437,11 +446,11 @@ class TransformerLM(HybridBlock):
             def run_step(cur, pos, caches):
                 outs = cell(cur, pos, *caches)
                 return outs[0], outs[1:]
-        if ring:
+        if sp_type:
             from ...parallel.sequence_parallel import current_sp_scope
             caches = self._init_caches(
                 B, dtype=self.head.weight.dtype,
-                sharded=current_sp_scope())
+                sharded=current_sp_scope() + (sp_type,))
         else:
             caches = self._init_caches(B, ctx=ctx,
                                        dtype=self.head.weight.dtype)
@@ -533,7 +542,7 @@ class TransformerLM(HybridBlock):
         """
         from ... import ndarray as F
         from ...model import save_checkpoint
-        self._check_kv_supported(allow_ring=False)
+        self._check_kv_supported(allow_sp=False)
         step = self._kv_step()["sample"]
         tok = F.zeros((batch_size, 1))
         pos = F.array([0.0])
@@ -570,7 +579,7 @@ class TransformerLM(HybridBlock):
             raise ValueError(
                 f"prompt length {t0} + max_new {max_new} "
                 f"exceeds max_len {self._max_len}")
-        self._check_kv_supported(allow_ring=False)
+        self._check_kv_supported(allow_sp=False)
         W = beam
         ctx = prompt.context
         prefill = self._kv_step()["sample"]

@@ -292,7 +292,8 @@ def _flash_attention_op(p, q, k, v):
           aliases=("mha_decode_step",), f32_inputs=(3,),
           args=[Arg("num_heads", int, required=True),
                 Arg("scale", float, -1.0), Arg("impl", str, "dense")],
-          num_outputs=3, differentiable=False, sp_impls=("ring",))
+          num_outputs=3, differentiable=False,
+          sp_impls=("ring", "ulysses"))
 def _mha_decode_step_op(p, qkv, kc, vc, pos):
     """One autoregressive attention step over a KV cache (inference).
 
@@ -312,28 +313,31 @@ def _mha_decode_step_op(p, qkv, kc, vc, pos):
     dh = D // H
     x = qkv.reshape(B, 3, H, dh)                    # T=1 folded away
     q, k, v = x[:, 0], x[:, 1], x[:, 2]             # (B, H, dh)
-    if p["impl"] not in ("dense", "ring"):
+    if p["impl"] not in ("dense", "ring", "ulysses"):
         raise ValueError(
-            f"mha_decode_step impl={p['impl']!r}: choose 'dense' or "
-            "'ring' (ulysses decode needs head-sharded caches — use "
-            "the static decode strategy)")
-    if p["impl"] == "ring":
-        # sequence-sharded caches over the ambient sp mesh: the cache
-        # never leaves its shard; only softmax stats (B,H) + combined
-        # values (B,H,dh) ride the axis (ring_decode_step)
+            f"mha_decode_step impl={p['impl']!r}: choose 'dense', "
+            "'ring' (sequence-sharded caches) or 'ulysses' "
+            "(head-sharded caches)")
+    if p["impl"] in ("ring", "ulysses"):
+        # sharded caches over the ambient sp mesh: the cache never
+        # leaves its shard.  ring = sequence-sharded columns with a
+        # pmax/psum distributed softmax; ulysses = head-sharded
+        # full-length caches with purely local attention per head
         from ..parallel import sequence_parallel as _sp
         mesh, axis = _sp.current_sp_scope()
         scale = p["scale"] if p["scale"] > 0 else dh ** -0.5
+        cache_spec = ((None, None, axis, None) if p["impl"] == "ring"
+                      else (None, axis, None, None))
+        step_fn = (_sp.ring_decode_step_sharded if p["impl"] == "ring"
+                   else _sp.ulysses_decode_step_sharded)
         eager = not isinstance(qkv, jax.core.Tracer)
         orig_dev = None
         if eager:
             orig_dev = _sp.single_device_of(qkv)
             q, k, v, pos = _sp.place_on_mesh(mesh, (q, k, v, pos))
-            kc, vc = _sp.place_on_mesh(
-                mesh, (kc, vc), spec=(None, None, axis, None))
-        out, kc, vc = _sp.ring_decode_step_sharded(
-            q, k, v, kc, vc, pos, mesh, axis_name=axis,
-            scale=float(scale))
+            kc, vc = _sp.place_on_mesh(mesh, (kc, vc), spec=cache_spec)
+        out, kc, vc = step_fn(q, k, v, kc, vc, pos, mesh,
+                              axis_name=axis, scale=float(scale))
         if eager and orig_dev is not None:
             # only the attention OUTPUT returns to the caller's device
             # (it feeds single-device eager neighbors); the caches stay

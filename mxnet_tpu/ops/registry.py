@@ -191,7 +191,27 @@ def _sp_fwd_bwd(op_name: str, params: Tuple[Tuple[str, Any], ...],
         _, vjp_fn = jax.vjp(run, *ins)
         return vjp_fn(tuple(cts))
 
-    return jax.jit(run), jax.jit(bwd)
+    fwd_j, bwd_j = jax.jit(run), jax.jit(bwd)
+
+    # jax.jit traces LAZILY (first call, and again per new input
+    # shape) and op.fn reads the AMBIENT scope at trace time — so a
+    # backward() issued after the user's `with sp_scope(...)` exited
+    # (or under a different scope) would trace against the wrong/no
+    # mesh and poison this cache entry.  Re-enter the KEYED scope
+    # around every call: traces always see exactly the (mesh, axis)
+    # this entry is keyed on; the push/pop is a list append when no
+    # trace happens.
+    from ..parallel.sequence_parallel import sp_scope
+
+    def fwd_scoped(*ins):
+        with sp_scope(mesh, axis_name):
+            return fwd_j(*ins)
+
+    def bwd_scoped(ins, cts):
+        with sp_scope(mesh, axis_name):
+            return bwd_j(ins, cts)
+
+    return fwd_scoped, bwd_scoped
 
 
 def make_vjp(op: Operator, params: Tuple[Tuple[str, Any], ...], inputs):

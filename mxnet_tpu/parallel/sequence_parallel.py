@@ -105,6 +105,15 @@ def _sharded_fn(kind, mesh: Mesh, axis_name: str, causal, scale):
             mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
             check_vma=False)
     rspec = P()
+    if kind == "ulysses_decode":
+        hspec = P(None, axis_name, None, None)    # head-sharded caches
+        return shard_map(
+            functools.partial(ulysses_decode_step, axis_name=axis_name,
+                              scale=scale),
+            mesh=mesh,
+            in_specs=(rspec, rspec, rspec, hspec, hspec, rspec),
+            out_specs=(P(None, axis_name, None), hspec, hspec),
+            check_vma=False)
     return shard_map(
         functools.partial(ring_decode_step, axis_name=axis_name,
                           scale=scale),
@@ -185,6 +194,49 @@ def ring_decode_step_sharded(q, k, v, kc, vc, pos, mesh: Mesh,
     replicated; returns (out (B,H,dh), new kc, new vc) with the caches
     still sharded."""
     return _sharded_fn("ring_decode", mesh, axis_name, False,
+                       scale)(q, k, v, kc, vc, pos)
+
+
+def ulysses_decode_step(q, k, v, kc, vc, pos, axis_name: str = "sp",
+                        scale: Optional[float] = None):
+    """One autoregressive decode step over HEAD-SHARDED K/V caches
+    (call inside shard_map) — the Ulysses decode counterpart: each
+    device owns H/n full-length head caches, so attention is entirely
+    local per head (ordinary softmax, no distributed combine); the
+    mesh reassembles the head axis in the outputs.
+
+    Per device: q/k/v (B, H, dh) replicated; kc/vc (B, H/n, Tmax, dh)
+    this device's head block (heads = concatenation over the axis in
+    index order); pos (1,).
+    """
+    my = lax.axis_index(axis_name)
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+    Hl = kc.shape[1]
+    t = pos.astype(jnp.int32).reshape(())
+    zero = jnp.zeros((), jnp.int32)
+    start = my * Hl
+    qh = lax.dynamic_slice_in_dim(q, start, Hl, axis=1)   # (B, Hl, dh)
+    kh = lax.dynamic_slice_in_dim(k, start, Hl, axis=1)
+    vh = lax.dynamic_slice_in_dim(v, start, Hl, axis=1)
+    kc = lax.dynamic_update_slice(
+        kc, kh[:, :, None, :].astype(kc.dtype), (zero, zero, t, zero))
+    vc = lax.dynamic_update_slice(
+        vc, vh[:, :, None, :].astype(vc.dtype), (zero, zero, t, zero))
+    s = jnp.einsum("bhd,bhtd->bht", qh.astype(jnp.float32) * scale,
+                   kc.astype(jnp.float32))
+    s = jnp.where(jnp.arange(kc.shape[2])[None, None, :] <= t, s,
+                  NEG_INF)
+    w = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bht,bhtd->bhd", w, vc.astype(jnp.float32))
+    return out.astype(q.dtype), kc, vc
+
+
+def ulysses_decode_step_sharded(q, k, v, kc, vc, pos, mesh: Mesh,
+                                axis_name: str = "sp",
+                                scale: Optional[float] = None):
+    """Caches sharded on their HEAD axis, q/k/v/pos replicated; the
+    out_spec reassembles (B, H, dh) from the per-shard head blocks."""
+    return _sharded_fn("ulysses_decode", mesh, axis_name, False,
                        scale)(q, k, v, kc, vc, pos)
 
 

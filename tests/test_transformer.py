@@ -185,19 +185,16 @@ def test_generate_kv_cache_matches_eager():
     import pytest
     with pytest.raises(ValueError):
         net.generate(prefix, 2, kv_cache=True, static_shapes=False)
-    # ulysses needs head-sharded caches — documented refusal; ring
-    # without an active sp_scope fails loudly (see the ring tests)
-    sp_net = make_net()
-    for blk in sp_net.blocks._children:
-        blk.attn._type = "ulysses"
-    with pytest.raises(NotImplementedError):
-        sp_net.generate(prefix, 2, kv_cache=True)
+    # sp attention types decode over SHARDED caches and need an active
+    # sp_scope — without one, both fail loudly (see the ring/ulysses
+    # decode tests for the working sharded paths)
     from mxnet_tpu.base import MXNetError
-    ring_net = make_net()
-    for blk in ring_net.blocks._children:
-        blk.attn._type = "ring"
-    with pytest.raises(MXNetError):
-        ring_net.generate(prefix, 2, kv_cache=True)
+    for sp_type in ("ring", "ulysses"):
+        sp_net = make_net()
+        for blk in sp_net.blocks._children:
+            blk.attn._type = sp_type
+        with pytest.raises(MXNetError):
+            sp_net.generate(prefix, 2, kv_cache=True)
 
 
 def test_generate_leaves_hybrid_state_alone():
@@ -470,3 +467,85 @@ def test_sample_top_k_ties_and_validation():
         TransformerLM._sample(tied, 1.0, None, top_k=-1)
     with pytest.raises(ValueError):
         TransformerLM._sample(tied, 1.0, None, top_p=1.5)
+
+
+def test_ulysses_kv_decode_matches_dense():
+    """impl='ulysses' mha_decode_step (HEAD-sharded full-length caches,
+    purely local attention per head shard) must match the dense decode
+    step token-by-token, and a ulysses TransformerLM must generate
+    kv_cache=True under an sp_scope with the same greedy tokens as an
+    identically-initialized dense model."""
+    import jax
+    import pytest
+    from jax.sharding import Mesh
+    from mxnet_tpu import nd, parallel
+
+    devs = np.array(jax.devices("cpu")[:4])
+    mesh = Mesh(devs, ("sp",))
+    rs = np.random.RandomState(43)
+    Bq, H, Tmax, D = 2, 4, 8, 32          # H divisible by the axis
+    dh = D // H
+    qkv_seq = nd.array(rs.normal(0, 1, (Bq, Tmax, 3 * D)).astype("f"))
+    kc_d = nd.zeros((Bq, H, Tmax, dh))
+    vc_d = nd.zeros((Bq, H, Tmax, dh))
+    kc_u = nd.zeros((Bq, H, Tmax, dh))
+    vc_u = nd.zeros((Bq, H, Tmax, dh))
+    for t in range(Tmax):
+        step_qkv = nd.slice_axis(qkv_seq, axis=1, begin=t, end=t + 1)
+        pos = nd.array([float(t)])
+        od, kc_d, vc_d = nd.mha_decode_step(step_qkv, kc_d, vc_d, pos,
+                                            num_heads=H)
+        with parallel.sp_scope(mesh):
+            ou, kc_u, vc_u = nd.mha_decode_step(step_qkv, kc_u, vc_u,
+                                                pos, num_heads=H,
+                                                impl="ulysses")
+        assert_almost_equal(ou.asnumpy(), od.asnumpy(),
+                            rtol=1e-4, atol=1e-5)
+    assert_almost_equal(kc_u.asnumpy(), kc_d.asnumpy(), rtol=1e-5,
+                        atol=1e-6)
+    assert_almost_equal(vc_u.asnumpy(), vc_d.asnumpy(), rtol=1e-5,
+                        atol=1e-6)
+
+    # model level
+    dense = TransformerLM(vocab=V, dim=32, num_layers=2, num_heads=4,
+                          max_len=16, attn_type="dense")
+    uly = TransformerLM(vocab=V, dim=32, num_layers=2, num_heads=4,
+                        max_len=16, attn_type="ulysses")
+    mx.random.seed(47)
+    dense.initialize(mx.init.Xavier(), ctx=mx.cpu())
+    uly.initialize(mx.init.Xavier(), ctx=mx.cpu())
+    with parallel.sp_scope(mesh):
+        copy_params(uly, dense)
+    rs2 = np.random.RandomState(49)
+    prompt = mx.nd.array(rs2.randint(0, V, (2, 4)).astype("f"))
+    want = dense.generate(prompt, 8, kv_cache=True).asnumpy()
+    with parallel.sp_scope(mesh):
+        got = uly.generate(prompt, 8, kv_cache=True).asnumpy()
+    assert (got == want).all(), (got, want)
+    # heads not divisible by the axis -> loud error (3 heads, 4 devs)
+    bad = TransformerLM(vocab=V, dim=33, num_layers=1, num_heads=3,
+                        max_len=16, attn_type="ulysses")
+    bad.initialize(mx.init.Xavier(), ctx=mx.cpu())
+    with parallel.sp_scope(mesh), pytest.raises(ValueError):
+        bad.generate(prompt, 2, kv_cache=True)
+
+
+def test_sp_backward_after_scope_exit():
+    """backward() issued AFTER the sp_scope exited must still work: the
+    cached sp fwd/bwd jits re-enter their KEYED scope around every
+    call, so lazy (re)traces never read the wrong ambient scope."""
+    import jax
+    from jax.sharding import Mesh
+    from mxnet_tpu import nd, parallel
+
+    mesh = Mesh(np.array(jax.devices("cpu")[:4]), ("sp",))
+    rs = np.random.RandomState(53)
+    qkv = mx.nd.array(rs.normal(0, 1, (2, 16, 96)).astype("f"))
+    qkv.attach_grad()
+    with parallel.sp_scope(mesh):
+        with autograd.record():
+            out = nd._contrib_multihead_attention(qkv, num_heads=4,
+                                                  impl="ring")
+            loss = out.sum()
+    loss.backward()                      # scope no longer active
+    assert np.isfinite(qkv.grad.asnumpy()).all()
