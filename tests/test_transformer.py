@@ -549,3 +549,22 @@ def test_sp_backward_after_scope_exit():
             loss = out.sum()
     loss.backward()                      # scope no longer active
     assert np.isfinite(qkv.grad.asnumpy()).all()
+
+
+def test_beam_and_export_refuse_sp_models():
+    """Beam search and the decode-step export are dense-cache paths:
+    on sp-attention models they refuse loudly (allow_sp=False) even
+    under an active scope."""
+    import jax
+    import pytest
+    from jax.sharding import Mesh
+    from mxnet_tpu import parallel
+
+    mesh = Mesh(np.array(jax.devices("cpu")[:2]), ("sp",))
+    net = make_net("ring", seed=14)
+    prompt = mx.nd.array(np.zeros((1, 3), "f"))
+    with parallel.sp_scope(mesh):
+        with pytest.raises(NotImplementedError):
+            net.beam_search(prompt, 2, beam=2)
+        with pytest.raises(NotImplementedError):
+            net.export_decode_step("/tmp/should_not_exist")
