@@ -56,8 +56,10 @@ CAPI_EX := cpp-package/example/capi_predict
 CAPI_TRAIN_EX := cpp-package/example/capi_train
 CAPI_KV_EX := cpp-package/example/capi_kv_iter
 CAPI_LM_EX := cpp-package/example/capi_lm_decode
+CAPI_AG_EX := cpp-package/example/capi_autograd
 
-capi_example: $(CAPI_EX) $(CAPI_TRAIN_EX) $(CAPI_KV_EX) $(CAPI_LM_EX)
+capi_example: $(CAPI_EX) $(CAPI_TRAIN_EX) $(CAPI_KV_EX) $(CAPI_LM_EX) \
+              $(CAPI_AG_EX)
 
 # one link recipe for every plain-C capi example (predict ABI; -lm is
 # harmless where unused, and both headers are cheap prereqs)
@@ -72,4 +74,4 @@ test: native
 
 clean:
 	rm -f $(LIB) $(CPP_EX) $(PRED_LIB) $(CAPI_EX) $(CAPI_TRAIN_EX) \
-	    $(CAPI_KV_EX) $(CAPI_LM_EX)
+	    $(CAPI_KV_EX) $(CAPI_LM_EX) $(CAPI_AG_EX)

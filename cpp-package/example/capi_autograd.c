@@ -1,0 +1,178 @@
+/* capi_autograd — eager autograd + CachedOp from plain C over the core
+ * C API (src/runtime/mxt_capi.h tranche 3; parity: c_api.h
+ * MXAutogradSetIsRecording:716 / MXAutogradMarkVariables:742 /
+ * MXAutogradBackward:762 / MXNDArrayGetGrad:558 / MXCreateCachedOp:796
+ * / MXInvokeCachedOp:812).
+ *
+ * Two legs, both asserted numerically by tests/test_cpp_package.py
+ * against the python autograd/CachedOp path:
+ *
+ *   1. eager tape: x marked with a grad buffer, y = square(x),
+ *      w = y * 3 via MXTImperativeInvoke while recording, backward(w)
+ *      -> grad(x) = 6x.  Checked exactly IN C (no python reference
+ *      needed for so simple a chain), printed for the test twin too.
+ *
+ *   2. CachedOp: the jitted-closure analog of MXCreateCachedOp, built
+ *      from a Symbol file (BatchNorm net => aux state).  One invoke
+ *      under record+train: prints the output, the taped gradients of
+ *      data/gamma/beta, and the IN-PLACE updated BN moving stats.
+ *
+ *   capi_autograd <symbol.json>
+ */
+#include <math.h>
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+
+#include "../../src/runtime/mxt_capi.h"
+
+#define CHECK(call)                                                   \
+  do {                                                                \
+    if ((call) != 0) {                                                \
+      fprintf(stderr, "%s failed: %s\n", #call, MXTGetLastError());   \
+      return 1;                                                       \
+    }                                                                 \
+  } while (0)
+
+static int print_vec(const char *name, MXTNDArrayHandle h, uint32_t n) {
+  float *buf = (float *)malloc(n * sizeof(float));
+  if (!buf) return 1;
+  if (MXTNDArraySyncCopyToCPU(h, buf, n) != 0) {
+    fprintf(stderr, "copy %s failed: %s\n", name, MXTGetLastError());
+    free(buf);
+    return 1;
+  }
+  printf("%s", name);
+  for (uint32_t i = 0; i < n; ++i) printf(" %.6f", buf[i]);
+  printf("\n");
+  free(buf);
+  return 0;
+}
+
+int main(int argc, char **argv) {
+  if (argc != 2) {
+    fprintf(stderr, "usage: %s <symbol.json>\n", argv[0]);
+    return 2;
+  }
+
+  /* ---- leg 1: eager tape over imperative ops ---- */
+  uint32_t shp3[] = {3};
+  MXTNDArrayHandle x = NULL, gx = NULL;
+  CHECK(MXTNDArrayCreate(shp3, 1, "float32", &x));
+  CHECK(MXTNDArrayCreate(shp3, 1, "float32", &gx));
+  float xv[3] = {1.0f, 2.0f, 3.0f};
+  CHECK(MXTNDArraySyncCopyFromCPU(x, xv, 3));
+  CHECK(MXTAutogradMarkVariables(1, &x, &gx));
+
+  int prev = -1, curr = -1;
+  CHECK(MXTAutogradSetIsRecording(1, &prev));
+  if (prev != 0) {
+    fprintf(stderr, "expected prev recording 0, got %d\n", prev);
+    return 1;
+  }
+  CHECK(MXTAutogradSetIsTraining(1, NULL));
+  CHECK(MXTAutogradIsRecording(&curr));
+  if (curr != 1) {
+    fprintf(stderr, "expected recording 1, got %d\n", curr);
+    return 1;
+  }
+
+  MXTNDArrayHandle y = NULL, w = NULL;
+  uint32_t n_out = 0;
+  CHECK(MXTImperativeInvoke("square", &x, 1, NULL, NULL, 0, &y, &n_out));
+  const char *mk[] = {"scalar"};
+  const char *mv[] = {"3.0"};
+  n_out = 0;
+  CHECK(MXTImperativeInvoke("_mul_scalar", &y, 1, mk, mv, 1, &w, &n_out));
+  CHECK(MXTAutogradSetIsRecording(0, &prev));
+  if (prev != 1) {
+    fprintf(stderr, "expected prev recording 1, got %d\n", prev);
+    return 1;
+  }
+
+  CHECK(MXTAutogradBackward(1, &w, NULL, 0, 1));
+  MXTNDArrayHandle gread = NULL;
+  CHECK(MXTNDArrayGetGrad(x, &gread));
+  float gv[3];
+  CHECK(MXTNDArraySyncCopyToCPU(gread, gv, 3));
+  for (int i = 0; i < 3; ++i) {
+    if (fabsf(gv[i] - 6.0f * xv[i]) > 1e-4f) {
+      fprintf(stderr, "eager grad[%d]=%f, want %f\n", i, gv[i],
+              6.0f * xv[i]);
+      return 1;
+    }
+  }
+  if (print_vec("eager_grad", gread, 3)) return 1;
+  MXTNDArrayFree(gread);
+  MXTNDArrayFree(y);
+  MXTNDArrayFree(w);
+  MXTNDArrayFree(x);
+  MXTNDArrayFree(gx);
+
+  /* ---- leg 2: CachedOp over a BatchNorm symbol ---- */
+  MXTSymbolHandle sym = NULL;
+  CHECK(MXTSymbolCreateFromFile(argv[1], &sym));
+  MXTCachedOpHandle cop = NULL;
+  CHECK(MXTCachedOpCreate(sym, &cop));
+
+  uint32_t shp23[] = {2, 3};
+  MXTNDArrayHandle data = NULL, gamma = NULL, beta = NULL;
+  MXTNDArrayHandle gdata = NULL, ggamma = NULL, gbeta = NULL;
+  MXTNDArrayHandle mean = NULL, var = NULL;
+  CHECK(MXTNDArrayCreate(shp23, 2, "float32", &data));
+  CHECK(MXTNDArrayCreate(shp23, 2, "float32", &gdata));
+  CHECK(MXTNDArrayCreate(shp3, 1, "float32", &gamma));
+  CHECK(MXTNDArrayCreate(shp3, 1, "float32", &ggamma));
+  CHECK(MXTNDArrayCreate(shp3, 1, "float32", &beta));
+  CHECK(MXTNDArrayCreate(shp3, 1, "float32", &gbeta));
+  CHECK(MXTNDArrayCreate(shp3, 1, "float32", &mean));
+  CHECK(MXTNDArrayCreate(shp3, 1, "float32", &var));
+  float dv[6], ones3[3] = {1.0f, 1.0f, 1.0f}, half3[3] = {0.5f, 0.5f, 0.5f};
+  for (int i = 0; i < 6; ++i) dv[i] = 0.3f * i - 0.7f;
+  CHECK(MXTNDArraySyncCopyFromCPU(data, dv, 6));
+  CHECK(MXTNDArraySyncCopyFromCPU(gamma, ones3, 3));
+  CHECK(MXTNDArraySyncCopyFromCPU(beta, half3, 3));
+  CHECK(MXTNDArraySyncCopyFromCPU(var, ones3, 3));
+
+  MXTNDArrayHandle vars[3] = {data, gamma, beta};
+  MXTNDArrayHandle grads[3] = {gdata, ggamma, gbeta};
+  CHECK(MXTAutogradMarkVariables(3, vars, grads));
+  CHECK(MXTAutogradSetIsRecording(1, NULL));
+  CHECK(MXTAutogradSetIsTraining(1, NULL));
+
+  const char *arg_names[] = {"data", "bn_gamma", "bn_beta"};
+  MXTNDArrayHandle args[3] = {data, gamma, beta};
+  const char *aux_names[] = {"bn_moving_mean", "bn_moving_var"};
+  MXTNDArrayHandle auxs[2] = {mean, var};
+  MXTNDArrayHandle outs[4] = {NULL, NULL, NULL, NULL};
+  uint32_t n_cop = 4;
+  CHECK(MXTCachedOpInvoke(cop, arg_names, args, 3, aux_names, auxs, 2,
+                          outs, &n_cop));
+  if (n_cop != 1) {
+    fprintf(stderr, "expected 1 CachedOp output, got %u\n", n_cop);
+    return 1;
+  }
+  CHECK(MXTAutogradSetIsRecording(0, NULL));
+  CHECK(MXTAutogradSetIsTraining(0, NULL));
+
+  if (print_vec("cop_out", outs[0], 1)) return 1;
+  CHECK(MXTAutogradBackward(1, outs, NULL, 0, 1));
+  if (print_vec("grad_data", gdata, 6)) return 1;
+  if (print_vec("grad_gamma", ggamma, 3)) return 1;
+  if (print_vec("grad_beta", gbeta, 3)) return 1;
+  /* BN moving stats were updated IN PLACE through the caller's handles */
+  if (print_vec("aux_mean", mean, 3)) return 1;
+  if (print_vec("aux_var", var, 3)) return 1;
+
+  MXTNDArrayFree(outs[0]);
+  for (int i = 0; i < 3; ++i) {
+    MXTNDArrayFree(vars[i]);
+    MXTNDArrayFree(grads[i]);
+  }
+  MXTNDArrayFree(mean);
+  MXTNDArrayFree(var);
+  MXTCachedOpFree(cop);
+  MXTSymbolFree(sym);
+  printf("ok\n");
+  return 0;
+}

@@ -151,3 +151,62 @@ def iter_next(it):
         return next(it)
     except StopIteration:
         return None
+
+
+# ---- autograd + CachedOp (MXTAutograd* / MXTCachedOp*; parity:
+# c_api_ndarray.cc MXAutogradSetIsRecording/MarkVariables/
+# BackwardEx + MXCreateCachedOp/MXInvokeCachedOp) ----
+
+def autograd_set_recording(flag):
+    from . import autograd
+    return int(autograd.set_recording(bool(flag)))
+
+
+def autograd_set_training(flag):
+    from . import autograd
+    return int(autograd.set_training(bool(flag)))
+
+
+def autograd_is_recording():
+    from . import autograd
+    return int(autograd.is_recording())
+
+
+def autograd_is_training():
+    from . import autograd
+    return int(autograd.is_training())
+
+
+def autograd_mark_variables(variables, gradients):
+    from . import autograd
+    autograd.mark_variables(list(variables), list(gradients))
+
+
+def autograd_backward(heads, head_grads, retain_graph, train_mode):
+    from . import autograd
+    autograd.backward(list(heads),
+                      None if head_grads is None else list(head_grads),
+                      retain_graph=bool(retain_graph),
+                      train_mode=bool(train_mode))
+
+
+def nd_grad(arr):
+    if arr.grad is None:
+        raise MXNetError("array has no gradient buffer; call "
+                         "MXTAutogradMarkVariables on it first")
+    return arr.grad
+
+
+def cached_op_create(sym):
+    from .gluon.block import CachedOp
+    return CachedOp(sym)
+
+
+def cached_op_invoke(cop, arg_names, arg_arrays, aux_names, aux_arrays):
+    """Run the compiled closure.  aux arrays (BN running stats) are
+    updated IN PLACE by CachedOp.__call__ — the C caller's existing
+    handles see the new values.  Under recording the call lands on the
+    autograd tape, so MXTAutogradBackward flows into marked args."""
+    args = dict(zip(arg_names, arg_arrays))
+    auxs = dict(zip(aux_names, aux_arrays))
+    return cop(args, auxs, current_context())

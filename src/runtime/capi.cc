@@ -772,6 +772,187 @@ void MXTDataIterFree(MXTDataIterHandle h) {
   delete ih;
 }
 
+/* ---------------- Autograd + CachedOp ---------------- */
+
+/* list of borrowed handles -> new PyList holding refs (nullptr on OOM) */
+static PyObject *handle_list(MXTNDArrayHandle *hs, uint32_t n) {
+  PyObject *l = PyList_New(n);
+  if (l == nullptr) return nullptr;
+  for (uint32_t i = 0; i < n; ++i) {
+    Py_INCREF((PyObject *)hs[i]);
+    PyList_SET_ITEM(l, i, (PyObject *)hs[i]);
+  }
+  return l;
+}
+
+/* list of C strings -> new PyList of str (nullptr + error on bad UTF-8) */
+static PyObject *name_list(const char **names, uint32_t n) {
+  PyObject *l = PyList_New(n);
+  if (l == nullptr) return nullptr;
+  for (uint32_t i = 0; i < n; ++i) {
+    PyObject *s = PyUnicode_FromString(names[i]);
+    if (s == nullptr) {
+      Py_DECREF(l);
+      return nullptr;
+    }
+    PyList_SET_ITEM(l, i, s);
+  }
+  return l;
+}
+
+/* shared body for the four flag entry points: call fn([arg]) and write
+ * the integer result (the previous/current flag) into *out if given.
+ * The args tuple is built HERE, under the GIL — building it at the
+ * call site would run Python C API with the GIL released. */
+static int flag_call(const char *fn, int has_arg, int arg, int *out) {
+  if (!ensure_python()) return -1;
+  Gil gil;
+  PyObject *args = has_arg ? Py_BuildValue("(i)", arg) : nullptr;
+  if (has_arg && args == nullptr) return -1;
+  PyObject *r = call_support(fn, args);
+  if (r == nullptr) return -1;
+  long v = PyLong_AsLong(r);
+  Py_DECREF(r);
+  if (v == -1 && PyErr_Occurred()) {
+    set_error(fn);
+    return -1;
+  }
+  if (out != nullptr) *out = (int)v;
+  return 0;
+}
+
+int MXTAutogradSetIsRecording(int is_recording, int *prev) {
+  return flag_call("autograd_set_recording", 1, is_recording, prev);
+}
+
+int MXTAutogradSetIsTraining(int is_training, int *prev) {
+  return flag_call("autograd_set_training", 1, is_training, prev);
+}
+
+int MXTAutogradIsRecording(int *out) {
+  if (out == nullptr) return -1;
+  return flag_call("autograd_is_recording", 0, 0, out);
+}
+
+int MXTAutogradIsTraining(int *out) {
+  if (out == nullptr) return -1;
+  return flag_call("autograd_is_training", 0, 0, out);
+}
+
+int MXTAutogradMarkVariables(uint32_t num, MXTNDArrayHandle *vars,
+                             MXTNDArrayHandle *grads) {
+  if (num > 0 && (vars == nullptr || grads == nullptr)) return -1;
+  if (!ensure_python()) return -1;
+  Gil gil;
+  PyObject *vs = handle_list(vars, num);
+  PyObject *gs = vs ? handle_list(grads, num) : nullptr;
+  if (gs == nullptr) {
+    Py_XDECREF(vs);
+    return -1;
+  }
+  PyObject *r = call_support("autograd_mark_variables",
+                             Py_BuildValue("(NN)", vs, gs));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXTAutogradBackward(uint32_t num, MXTNDArrayHandle *heads,
+                        MXTNDArrayHandle *head_grads, int retain_graph,
+                        int train_mode) {
+  if (num == 0 || heads == nullptr) return -1;
+  if (!ensure_python()) return -1;
+  Gil gil;
+  PyObject *hs = handle_list(heads, num);
+  if (hs == nullptr) return -1;
+  PyObject *hg;
+  if (head_grads != nullptr) {
+    hg = handle_list(head_grads, num);
+    if (hg == nullptr) {
+      Py_DECREF(hs);
+      return -1;
+    }
+  } else {
+    hg = Py_None;
+    Py_INCREF(Py_None);
+  }
+  PyObject *r = call_support(
+      "autograd_backward",
+      Py_BuildValue("(NNii)", hs, hg, retain_graph, train_mode));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXTNDArrayGetGrad(MXTNDArrayHandle h, MXTNDArrayHandle *out) {
+  if (h == nullptr || out == nullptr) return -1;
+  *out = nullptr;
+  if (!ensure_python()) return -1;
+  Gil gil;
+  PyObject *r = call_support("nd_grad", Py_BuildValue("(O)", (PyObject *)h));
+  if (r == nullptr) return -1;
+  *out = r;  // handle owns the ref
+  return 0;
+}
+
+int MXTCachedOpCreate(MXTSymbolHandle sym, MXTCachedOpHandle *out) {
+  if (sym == nullptr || out == nullptr) return -1;
+  *out = nullptr;
+  if (!ensure_python()) return -1;
+  Gil gil;
+  SymHandle *sh = (SymHandle *)sym;
+  PyObject *r = call_support("cached_op_create",
+                             Py_BuildValue("(O)", sh->sym));
+  if (r == nullptr) return -1;
+  *out = r;
+  return 0;
+}
+
+int MXTCachedOpInvoke(MXTCachedOpHandle h, const char **arg_names,
+                      MXTNDArrayHandle *args, uint32_t num_args,
+                      const char **aux_names, MXTNDArrayHandle *auxs,
+                      uint32_t num_aux, MXTNDArrayHandle *outputs,
+                      uint32_t *num_outputs) {
+  if (h == nullptr || num_outputs == nullptr ||
+      (num_args > 0 && (arg_names == nullptr || args == nullptr)) ||
+      (num_aux > 0 && (aux_names == nullptr || auxs == nullptr)))
+    return -1;
+  if (!ensure_python()) return -1;
+  Gil gil;
+  PyObject *an = name_list(arg_names, num_args);
+  PyObject *av = an ? handle_list(args, num_args) : nullptr;
+  PyObject *xn = av ? name_list(aux_names, num_aux) : nullptr;
+  PyObject *xv = xn ? handle_list(auxs, num_aux) : nullptr;
+  if (xv == nullptr) {
+    Py_XDECREF(an);
+    Py_XDECREF(av);
+    Py_XDECREF(xn);
+    set_error("CachedOpInvoke: bad name/handle tables");
+    return -1;
+  }
+  PyObject *r = call_support(
+      "cached_op_invoke",
+      Py_BuildValue("(ONNNN)", (PyObject *)h, an, av, xn, xv));
+  if (r == nullptr) return -1;
+  Py_ssize_t n = PySequence_Size(r);
+  if (n < 0 || outputs == nullptr || (uint32_t)n > *num_outputs) {
+    Py_DECREF(r);
+    set_error("CachedOpInvoke: output table too small");
+    return -1;
+  }
+  for (Py_ssize_t i = 0; i < n; ++i)
+    outputs[i] = (MXTNDArrayHandle)PySequence_GetItem(r, i);  // new refs
+  *num_outputs = (uint32_t)n;
+  Py_DECREF(r);
+  return 0;
+}
+
+void MXTCachedOpFree(MXTCachedOpHandle h) {
+  if (h == nullptr || !Py_IsInitialized()) return;
+  Gil gil;
+  Py_DECREF((PyObject *)h);
+}
+
 const char *MXTGetLastError(void) { return g_last_error.c_str(); }
 
 }  // extern "C"

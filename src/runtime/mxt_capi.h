@@ -190,3 +190,67 @@ MXT_API void MXTDataIterFree(MXTDataIterHandle h);
 }
 #endif
 #endif /* MXT_CAPI_KV_H_ */
+
+/* ---- Autograd + CachedOp (c_api.h MXNDArrayGetGrad:558,
+ * MXAutogradSetIsRecording:716, MXAutogradMarkVariables:742,
+ * MXAutogradBackward:762, MXCreateCachedOp:796,
+ * MXInvokeCachedOp:812) ---- */
+#ifndef MXT_CAPI_AG_H_
+#define MXT_CAPI_AG_H_
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef void *MXTCachedOpHandle;
+
+/* Toggle the eager tape / train mode for THIS thread (autograd state is
+ * thread-local, like the reference's).  *prev (optional) receives the
+ * previous flag.  While recording, every MXTImperativeInvoke of a
+ * differentiable op and every MXTCachedOpInvoke lands on the tape. */
+MXT_API int MXTAutogradSetIsRecording(int is_recording, int *prev);
+MXT_API int MXTAutogradSetIsTraining(int is_training, int *prev);
+MXT_API int MXTAutogradIsRecording(int *out);
+MXT_API int MXTAutogradIsTraining(int *out);
+
+/* Attach gradient buffers: vars[i] accumulates into grads[i]
+ * (grad_req "write" — reference MXAutogradMarkVariables' common case). */
+MXT_API int MXTAutogradMarkVariables(uint32_t num, MXTNDArrayHandle *vars,
+                                     MXTNDArrayHandle *grads);
+
+/* Reverse pass from heads.  head_grads may be NULL (implicit ones,
+ * like NDArray.backward()); when given it must hold one array per
+ * head.  Gradients deposit into the buffers attached by
+ * MXTAutogradMarkVariables; read them back via MXTNDArrayGetGrad. */
+MXT_API int MXTAutogradBackward(uint32_t num, MXTNDArrayHandle *heads,
+                                MXTNDArrayHandle *head_grads,
+                                int retain_graph, int train_mode);
+
+/* Live handle to h's attached gradient buffer (caller frees the
+ * handle, not the buffer).  Fails if no buffer was attached. */
+MXT_API int MXTNDArrayGetGrad(MXTNDArrayHandle h, MXTNDArrayHandle *out);
+
+/* Compiled-graph closure over a Symbol: forward is ONE jitted XLA
+ * executable, the taped backward a second (gluon/block.py CachedOp —
+ * the TPU analog of cached_op.cc's cached forward/backward graphs). */
+MXT_API int MXTCachedOpCreate(MXTSymbolHandle sym, MXTCachedOpHandle *out);
+
+/* Invoke: args by name; auxs (BN running stats, ...) by name, updated
+ * IN PLACE under train mode — the caller's aux handles see the new
+ * values.  On input *num_outputs is the capacity of outputs[]; on
+ * return the actual count (error if capacity is short).  Caller frees
+ * each returned handle.  Under recording the call is taped: a
+ * following MXTAutogradBackward flows into the marked args. */
+MXT_API int MXTCachedOpInvoke(MXTCachedOpHandle h,
+                              const char **arg_names,
+                              MXTNDArrayHandle *args, uint32_t num_args,
+                              const char **aux_names,
+                              MXTNDArrayHandle *auxs, uint32_t num_aux,
+                              MXTNDArrayHandle *outputs,
+                              uint32_t *num_outputs);
+MXT_API void MXTCachedOpFree(MXTCachedOpHandle h);
+
+#ifdef __cplusplus
+}
+#endif
+#endif /* MXT_CAPI_AG_H_ */

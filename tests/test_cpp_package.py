@@ -341,3 +341,62 @@ def test_capi_lm_decode_matches_python(tmp_path):
             if ln.startswith("generated:")]
     got = np.array([[float(v) for v in r] for r in rows])
     assert (got == expected).all(), (got, expected)
+
+
+CAPI_AG_BIN = os.path.join(REPO, "cpp-package", "example", "capi_autograd")
+
+
+def test_capi_autograd_and_cached_op(tmp_path):
+    """Autograd + CachedOp C API (mxt_capi.h tranche 3; parity: c_api.h
+    MXAutogradSetIsRecording:716 / MXAutogradMarkVariables:742 /
+    MXAutogradBackward:762, MXNDArrayGetGrad:558, MXCreateCachedOp:796 /
+    MXInvokeCachedOp:812): a plain-C program records eager op invokes on
+    the tape and backprops (gradient asserted exactly in C), then drives
+    a BatchNorm CachedOp under record+train — output, taped gradients,
+    and the IN-PLACE updated BN moving stats must match the python
+    CachedOp/autograd path running the identical recipe."""
+    subprocess.run(["make", "predict_capi", "capi_example"], cwd=REPO,
+                   check=True, capture_output=True)
+    # a symbol with aux state so the invoke exercises it: BN-square-sum
+    s = sym.sum(sym.square(sym.BatchNorm(sym.Variable("data"),
+                                         name="bn")))
+    path = str(tmp_path / "bn-symbol.json")
+    open(path, "w").write(s.tojson())
+
+    env = {**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONPATH": REPO}
+    proc = subprocess.run([CAPI_AG_BIN, path], env=env,
+                          capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    lines = proc.stdout.strip().splitlines()
+    assert lines[-1] == "ok", lines
+    got = {ln.split()[0]: np.array([float(v) for v in ln.split()[1:]])
+           for ln in lines if " " in ln}
+    np.testing.assert_allclose(got["eager_grad"], [6.0, 12.0, 18.0],
+                               atol=1e-5)
+
+    # python reference: the SAME recipe through capi_support
+    from mxnet_tpu import autograd
+    from mxnet_tpu import capi_support as cs
+    cop = cs.cached_op_create(cs.symbol_from_json(open(path).read()))
+    x = nd.array((np.arange(6) * 0.3 - 0.7).reshape(2, 3).astype("f"))
+    gamma, beta = nd.ones((3,)), nd.zeros((3,)) + 0.5
+    mean, var = nd.zeros((3,)), nd.ones((3,))
+    for v in (x, gamma, beta):
+        v.attach_grad()
+    with autograd.record():
+        outs = cs.cached_op_invoke(
+            cop, ["data", "bn_gamma", "bn_beta"], [x, gamma, beta],
+            ["bn_moving_mean", "bn_moving_var"], [mean, var])
+    autograd.backward(outs)
+    np.testing.assert_allclose(got["cop_out"],
+                               float(outs[0].asnumpy()), rtol=1e-5)
+    np.testing.assert_allclose(got["grad_data"],
+                               x.grad.asnumpy().ravel(), atol=1e-5)
+    # fix_gamma defaults True (reference batch_norm.cc): gamma grad
+    # pinned zero, beta grad real
+    np.testing.assert_allclose(got["grad_gamma"],
+                               gamma.grad.asnumpy(), atol=1e-6)
+    np.testing.assert_allclose(got["grad_beta"],
+                               beta.grad.asnumpy(), atol=1e-5)
+    np.testing.assert_allclose(got["aux_mean"], mean.asnumpy(), atol=1e-6)
+    np.testing.assert_allclose(got["aux_var"], var.asnumpy(), atol=1e-6)
