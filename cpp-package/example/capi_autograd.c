@@ -145,7 +145,23 @@ int main(int argc, char **argv) {
   const char *aux_names[] = {"bn_moving_mean", "bn_moving_var"};
   MXTNDArrayHandle auxs[2] = {mean, var};
   MXTNDArrayHandle outs[4] = {NULL, NULL, NULL, NULL};
-  uint32_t n_cop = 4;
+
+  /* a short output table must fail BEFORE any side effect: the BN
+   * moving mean must still be zeros afterwards */
+  uint32_t n_cop = 0;
+  if (MXTCachedOpInvoke(cop, arg_names, args, 3, aux_names, auxs, 2,
+                        outs, &n_cop) == 0) {
+    fprintf(stderr, "capacity-0 invoke unexpectedly succeeded\n");
+    return 1;
+  }
+  float mchk[3];
+  CHECK(MXTNDArraySyncCopyToCPU(mean, mchk, 3));
+  if (mchk[0] != 0.0f || mchk[1] != 0.0f || mchk[2] != 0.0f) {
+    fprintf(stderr, "failed invoke had side effects on aux\n");
+    return 1;
+  }
+
+  n_cop = 4;
   CHECK(MXTCachedOpInvoke(cop, arg_names, args, 3, aux_names, auxs, 2,
                           outs, &n_cop));
   if (n_cop != 1) {

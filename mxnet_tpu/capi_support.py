@@ -202,6 +202,13 @@ def cached_op_create(sym):
     return CachedOp(sym)
 
 
+def cached_op_num_outputs(cop):
+    """Output count for the C layer's capacity pre-check — MUST be
+    consulted before invoke so a too-small output table fails BEFORE
+    any side effect (in-place aux update, tape append)."""
+    return len(cop.symbol.list_outputs())
+
+
 def cached_op_invoke(cop, arg_names, arg_arrays, aux_names, aux_arrays):
     """Run the compiled closure.  aux arrays (BN running stats) are
     updated IN PLACE by CachedOp.__call__ — the C caller's existing
@@ -210,3 +217,42 @@ def cached_op_invoke(cop, arg_names, arg_arrays, aux_names, aux_arrays):
     args = dict(zip(arg_names, arg_arrays))
     auxs = dict(zip(aux_names, aux_arrays))
     return cop(args, auxs, current_context())
+
+
+# ---- profiler control + introspection + NDArray views (parity:
+# c_api.h MXSetProfilerConfig:220, MXSetProfilerState:228,
+# MXDumpProfile:231, MXNDArraySlice:455, MXNDArrayAt:467,
+# MXNDArrayReshape:485, MXListAllOpNames:850) ----
+
+def profiler_config(mode, filename):
+    from . import profiler
+    profiler.profiler_set_config(mode="all" if mode else "symbolic",
+                                 filename=filename)
+
+
+def profiler_state(state):
+    from . import profiler
+    profiler.profiler_set_state("run" if state else "stop")
+
+
+def profiler_dump():
+    from . import profiler
+    profiler.dump_profile()
+
+
+def list_all_op_names():
+    from .ops.registry import list_ops
+    return list_ops()
+
+
+def nd_reshape(arr, dims):
+    """-1 infers one dimension, like the reference's MXNDArrayReshape."""
+    return arr.reshape(tuple(int(d) for d in dims))
+
+
+def nd_slice(arr, begin, end):
+    return arr[int(begin):int(end)]
+
+
+def nd_at(arr, idx):
+    return arr[int(idx)]

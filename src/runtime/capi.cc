@@ -808,7 +808,10 @@ static int flag_call(const char *fn, int has_arg, int arg, int *out) {
   if (!ensure_python()) return -1;
   Gil gil;
   PyObject *args = has_arg ? Py_BuildValue("(i)", arg) : nullptr;
-  if (has_arg && args == nullptr) return -1;
+  if (has_arg && args == nullptr) {
+    set_error(fn);  // fetch+clear the pending error, don't leak it
+    return -1;
+  }
   PyObject *r = call_support(fn, args);
   if (r == nullptr) return -1;
   long v = PyLong_AsLong(r);
@@ -848,6 +851,7 @@ int MXTAutogradMarkVariables(uint32_t num, MXTNDArrayHandle *vars,
   PyObject *gs = vs ? handle_list(grads, num) : nullptr;
   if (gs == nullptr) {
     Py_XDECREF(vs);
+    set_error("MarkVariables: handle tables");
     return -1;
   }
   PyObject *r = call_support("autograd_mark_variables",
@@ -864,12 +868,16 @@ int MXTAutogradBackward(uint32_t num, MXTNDArrayHandle *heads,
   if (!ensure_python()) return -1;
   Gil gil;
   PyObject *hs = handle_list(heads, num);
-  if (hs == nullptr) return -1;
+  if (hs == nullptr) {
+    set_error("Backward: head table");
+    return -1;
+  }
   PyObject *hg;
   if (head_grads != nullptr) {
     hg = handle_list(head_grads, num);
     if (hg == nullptr) {
       Py_DECREF(hs);
+      set_error("Backward: head_grads table");
       return -1;
     }
   } else {
@@ -919,6 +927,19 @@ int MXTCachedOpInvoke(MXTCachedOpHandle h, const char **arg_names,
     return -1;
   if (!ensure_python()) return -1;
   Gil gil;
+  // capacity pre-check BEFORE the call: invoke has irreversible side
+  // effects (in-place aux update, autograd tape append), so a short
+  // output table must fail without running it — a retry would
+  // double-advance BN moving stats and leave a stray tape entry
+  PyObject *cnt = call_support("cached_op_num_outputs",
+                               Py_BuildValue("(O)", (PyObject *)h));
+  if (cnt == nullptr) return -1;
+  long want = PyLong_AsLong(cnt);
+  Py_DECREF(cnt);
+  if (want < 0 || outputs == nullptr || (uint32_t)want > *num_outputs) {
+    set_error("CachedOpInvoke: output table too small");
+    return -1;
+  }
   PyObject *an = name_list(arg_names, num_args);
   PyObject *av = an ? handle_list(args, num_args) : nullptr;
   PyObject *xn = av ? name_list(aux_names, num_aux) : nullptr;
@@ -935,9 +956,9 @@ int MXTCachedOpInvoke(MXTCachedOpHandle h, const char **arg_names,
       Py_BuildValue("(ONNNN)", (PyObject *)h, an, av, xn, xv));
   if (r == nullptr) return -1;
   Py_ssize_t n = PySequence_Size(r);
-  if (n < 0 || outputs == nullptr || (uint32_t)n > *num_outputs) {
+  if (n < 0 || (uint32_t)n > *num_outputs) {
     Py_DECREF(r);
-    set_error("CachedOpInvoke: output table too small");
+    set_error("CachedOpInvoke: unexpected output count");
     return -1;
   }
   for (Py_ssize_t i = 0; i < n; ++i)
@@ -951,6 +972,100 @@ void MXTCachedOpFree(MXTCachedOpHandle h) {
   if (h == nullptr || !Py_IsInitialized()) return;
   Gil gil;
   Py_DECREF((PyObject *)h);
+}
+
+/* ---------------- Profiler + introspection + views ---------------- */
+
+/* call fn(args) discarding the (None) result; args built by the caller
+ * UNDER the GIL it already holds */
+static int void_call(const char *fn, PyObject *args) {
+  PyObject *r = call_support(fn, args);
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXTProfilerSetConfig(int mode, const char *filename) {
+  if (filename == nullptr) return -1;
+  if (!ensure_python()) return -1;
+  Gil gil;
+  return void_call("profiler_config", Py_BuildValue("(is)", mode, filename));
+}
+
+int MXTProfilerSetState(int state) {
+  if (!ensure_python()) return -1;
+  Gil gil;
+  return void_call("profiler_state", Py_BuildValue("(i)", state));
+}
+
+int MXTProfilerDump(void) {
+  if (!ensure_python()) return -1;
+  Gil gil;
+  return void_call("profiler_dump", nullptr);
+}
+
+int MXTListAllOpNames(uint32_t *out_num, const char ***out_names,
+                      void **token) {
+  if (out_num == nullptr || out_names == nullptr || token == nullptr)
+    return -1;
+  if (!ensure_python()) return -1;
+  Gil gil;
+  PyObject *r = call_support("list_all_op_names", nullptr);
+  if (r == nullptr) return -1;
+  StringTable *t = new StringTable();
+  t->fill(r);
+  Py_DECREF(r);
+  *out_num = (uint32_t)t->ptrs.size();
+  *out_names = t->ptrs.data();
+  *token = t;
+  return 0;
+}
+
+void MXTListAllOpNamesFree(void *token) {
+  delete (StringTable *)token;
+}
+
+int MXTNDArrayReshape(MXTNDArrayHandle h, const int32_t *dims,
+                      uint32_t ndim, MXTNDArrayHandle *out) {
+  if (h == nullptr || out == nullptr || (ndim > 0 && dims == nullptr))
+    return -1;
+  *out = nullptr;
+  if (!ensure_python()) return -1;
+  Gil gil;
+  PyObject *t = PyTuple_New(ndim);
+  if (t == nullptr) return -1;
+  for (uint32_t i = 0; i < ndim; ++i)
+    PyTuple_SET_ITEM(t, i, PyLong_FromLong(dims[i]));
+  PyObject *r = call_support("nd_reshape",
+                             Py_BuildValue("(ON)", (PyObject *)h, t));
+  if (r == nullptr) return -1;
+  *out = r;
+  return 0;
+}
+
+int MXTNDArraySlice(MXTNDArrayHandle h, uint32_t begin, uint32_t end,
+                    MXTNDArrayHandle *out) {
+  if (h == nullptr || out == nullptr) return -1;
+  *out = nullptr;
+  if (!ensure_python()) return -1;
+  Gil gil;
+  PyObject *r = call_support(
+      "nd_slice", Py_BuildValue("(OII)", (PyObject *)h, begin, end));
+  if (r == nullptr) return -1;
+  *out = r;
+  return 0;
+}
+
+int MXTNDArrayAt(MXTNDArrayHandle h, uint32_t idx, MXTNDArrayHandle *out) {
+  if (h == nullptr || out == nullptr) return -1;
+  *out = nullptr;
+  if (!ensure_python()) return -1;
+  Gil gil;
+  PyObject *r = call_support("nd_at",
+                             Py_BuildValue("(OI)", (PyObject *)h, idx));
+  if (r == nullptr) return -1;
+  *out = r;
+  return 0;
 }
 
 const char *MXTGetLastError(void) { return g_last_error.c_str(); }

@@ -254,3 +254,42 @@ MXT_API void MXTCachedOpFree(MXTCachedOpHandle h);
 }
 #endif
 #endif /* MXT_CAPI_AG_H_ */
+
+/* ---- Profiler control + introspection + NDArray views (c_api.h
+ * MXSetProfilerConfig:220, MXSetProfilerState:228, MXDumpProfile:231,
+ * MXNDArraySlice:455, MXNDArrayAt:467, MXNDArrayReshape:485,
+ * MXListAllOpNames:850) ---- */
+#ifndef MXT_CAPI_MISC_H_
+#define MXT_CAPI_MISC_H_
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+/* mode 0: symbolic/op events only; 1: profile all.  filename is where
+ * MXTProfilerDump writes the chrome-trace JSON (an xplane trace
+ * directory lands next to it for device-side detail). */
+MXT_API int MXTProfilerSetConfig(int mode, const char *filename);
+MXT_API int MXTProfilerSetState(int state);  /* 1 run, 0 stop */
+MXT_API int MXTProfilerDump(void);
+
+/* Every registered operator name (ops + aliases) — the enumeration a
+ * foreign binding autogenerates its op surface from.  Table is valid
+ * until MXTListAllOpNamesFree(token). */
+MXT_API int MXTListAllOpNames(uint32_t *out_num, const char ***out_names,
+                              void **token);
+MXT_API void MXTListAllOpNamesFree(void *token);
+
+/* Views (new handles; caller frees).  Reshape accepts one -1 dim to
+ * infer, like the reference.  Slice/At act on axis 0. */
+MXT_API int MXTNDArrayReshape(MXTNDArrayHandle h, const int32_t *dims,
+                              uint32_t ndim, MXTNDArrayHandle *out);
+MXT_API int MXTNDArraySlice(MXTNDArrayHandle h, uint32_t begin,
+                            uint32_t end, MXTNDArrayHandle *out);
+MXT_API int MXTNDArrayAt(MXTNDArrayHandle h, uint32_t idx,
+                         MXTNDArrayHandle *out);
+
+#ifdef __cplusplus
+}
+#endif
+#endif /* MXT_CAPI_MISC_H_ */

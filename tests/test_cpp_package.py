@@ -400,3 +400,73 @@ def test_capi_autograd_and_cached_op(tmp_path):
                                beta.grad.asnumpy(), atol=1e-5)
     np.testing.assert_allclose(got["aux_mean"], mean.asnumpy(), atol=1e-6)
     np.testing.assert_allclose(got["aux_var"], var.asnumpy(), atol=1e-6)
+
+
+def test_capi_tranche4_ctypes_profiler_opnames_views(tmp_path):
+    """Tranche-4 surface through ctypes — the dynamic-FFI consumer
+    pattern an R/Julia binding would use (parity: c_api.h
+    MXSetProfilerConfig:220/MXSetProfilerState:228/MXDumpProfile:231,
+    MXListAllOpNames:850, MXNDArrayReshape:485/Slice:455/At:467).
+    The .so attaches to THIS process's interpreter (py_embed
+    ensure_python host-already-embeds branch), so handles interop with
+    in-process state."""
+    import ctypes
+    subprocess.run(["make", "predict_capi"], cwd=REPO, check=True,
+                   capture_output=True)
+    lib = ctypes.CDLL(os.path.join(REPO, "mxnet_tpu", "_native",
+                                   "libmxt_predict.so"))
+    lib.MXTGetLastError.restype = ctypes.c_char_p
+
+    def ck(rc):
+        assert rc == 0, lib.MXTGetLastError()
+
+    # profiler: config -> run -> one eager ABI invoke -> stop -> dump
+    trace = tmp_path / "prof.json"
+    ck(lib.MXTProfilerSetConfig(1, str(trace).encode()))
+    ck(lib.MXTProfilerSetState(1))
+    shp = (ctypes.c_uint32 * 2)(2, 3)
+    h = ctypes.c_void_p()
+    ck(lib.MXTNDArrayCreate(shp, 2, b"float32", ctypes.byref(h)))
+    vals = (ctypes.c_float * 6)(*[1, 2, 3, 4, 5, 6])
+    ck(lib.MXTNDArraySyncCopyFromCPU(h, vals, ctypes.c_uint64(6)))
+    sq = ctypes.c_void_p()
+    n_out = ctypes.c_uint32(0)
+    ck(lib.MXTImperativeInvoke(b"square", ctypes.byref(h), 1, None, None,
+                               0, ctypes.byref(sq), ctypes.byref(n_out)))
+    ck(lib.MXTProfilerSetState(0))
+    ck(lib.MXTProfilerDump())
+    import json
+    doc = json.load(open(trace))
+    assert any(ev["name"] == "square" for ev in doc["traceEvents"]), doc
+
+    # op-name enumeration matches the registry exactly
+    num = ctypes.c_uint32()
+    names = ctypes.POINTER(ctypes.c_char_p)()
+    tok = ctypes.c_void_p()
+    ck(lib.MXTListAllOpNames(ctypes.byref(num), ctypes.byref(names),
+                             ctypes.byref(tok)))
+    got_names = {names[i].decode() for i in range(num.value)}
+    from mxnet_tpu.ops.registry import list_ops
+    assert got_names == set(list_ops())
+    assert "FullyConnected" in got_names and "sgd_update" in got_names
+    lib.MXTListAllOpNamesFree(tok)
+
+    # views: reshape with -1 inference, slice, at — shapes and values
+    dims = (ctypes.c_int32 * 2)(3, -1)
+    rsh = ctypes.c_void_p()
+    ck(lib.MXTNDArrayReshape(h, dims, 2, ctypes.byref(rsh)))
+    oshp = (ctypes.c_uint32 * 16)()
+    ond = ctypes.c_uint32()
+    ck(lib.MXTNDArrayGetShape(rsh, ctypes.byref(ond), oshp))
+    assert (ond.value, oshp[0], oshp[1]) == (2, 3, 2)
+    sl = ctypes.c_void_p()
+    ck(lib.MXTNDArraySlice(rsh, 1, 3, ctypes.byref(sl)))
+    buf = (ctypes.c_float * 4)()
+    ck(lib.MXTNDArraySyncCopyToCPU(sl, buf, ctypes.c_uint64(4)))
+    assert list(buf) == [3.0, 4.0, 5.0, 6.0]
+    at = ctypes.c_void_p()
+    ck(lib.MXTNDArrayAt(rsh, 0, ctypes.byref(at)))
+    ck(lib.MXTNDArrayGetShape(at, ctypes.byref(ond), oshp))
+    assert (ond.value, oshp[0]) == (1, 2)
+    for hh in (at, sl, rsh, sq, h):
+        lib.MXTNDArrayFree(hh)
