@@ -321,6 +321,42 @@ def test_mirror_segments_consistency():
                                    err_msg=k)
 
 
+def test_mha_decode_consistency():
+    """The KV-cache decode op on the accelerator (round-5 decode
+    family): controlled qkv/cache/pos inputs at a MID-cache position —
+    stale columns beyond pos carry garbage that must not leak through
+    the mask — match CPU within TOL, and the returned caches change at
+    exactly column pos.  Op-level on purpose: token-level generate()
+    comparisons across backends are tie-breaking-flaky under bf16 MXU
+    matmuls; the cache write + masked softmax are what need the real
+    compiler."""
+    rs = np.random.RandomState(4)
+    B, H, Tmax, dh = 2, 2, 8, 4
+    D = H * dh
+    qkv = rs.normal(0, 1, (B, 1, 3 * D)).astype("f")
+    kc = rs.normal(0, 1, (B, H, Tmax, dh)).astype("f")
+    vc = rs.normal(0, 1, (B, H, Tmax, dh)).astype("f")
+    pos = np.array([3.0], "f")
+    outs = []
+    for ctx in (mx.cpu(), _accel()):
+        with ctx:
+            o, nk, nv = mx.nd.mha_decode_step(
+                mx.nd.array(qkv), mx.nd.array(kc), mx.nd.array(vc),
+                mx.nd.array(pos), num_heads=H)
+            outs.append((o.asnumpy(), nk.asnumpy(), nv.asnumpy()))
+    (a, ak, av_), (b, bk, bv) = outs
+    np.testing.assert_allclose(a, b, rtol=TOL, atol=TOL)
+    np.testing.assert_allclose(ak, bk, rtol=TOL, atol=TOL)
+    np.testing.assert_allclose(av_, bv, rtol=TOL, atol=TOL)
+    # the cache write touched exactly column pos on both backends —
+    # untouched columns must be bit-preserved (dynamic_update_slice),
+    # not round-tripped through a lower precision
+    for cache, ref in ((ak, kc), (av_, vc), (bk, kc), (bv, vc)):
+        assert not np.allclose(cache[:, :, 3], ref[:, :, 3])
+        np.testing.assert_allclose(np.delete(cache, 3, axis=2),
+                                   np.delete(ref, 3, axis=2), atol=1e-6)
+
+
 def test_device_augment_consistency():
     """device_augment's fused on-accelerator mirror/normalize/NCHW
     program produces the same batches as the host numpy pipeline when

@@ -139,6 +139,7 @@ def main():
                   "test_resnet50_fwd_bwd_consistency",
                   "test_gluon_lstm_consistency",
                   "test_transformer_lm_consistency",
+                  "test_mha_decode_consistency",
                   "test_mirror_segments_consistency",
                   "test_device_augment_consistency"):
         cases.append((fname.replace("test_", ""),
