@@ -1,0 +1,252 @@
+"""Reference binary checkpoint interop.
+
+A switching user's existing ``.params`` files — written by the
+reference's ``mx.nd.save`` / ``Module.save_checkpoint`` (dmlc stream
+serialization, src/ndarray/ndarray.cc:844-1050 ``NDArray::Save/Load``
++ the ``kMXAPINDArrayListMagic`` list container, c_api.cc:307) — load
+directly: :func:`mxnet_tpu.nd.load` sniffs the magic and routes here,
+so ``mx.model.load_checkpoint`` works on reference-era files unchanged.
+:func:`save_reference_format` writes the V2 stream so models round-trip
+BACK to the reference.
+
+Wire format (all little-endian):
+
+* list container: uint64 magic ``0x112``, uint64 reserved, then the
+  array vector (uint64 count + records) and the name vector (uint64
+  count + per-string uint64 length + utf8 bytes; count 0 == list form).
+* record, three generations sniffed from the leading uint32:
+  - ``0xF993fac9`` (V2, the reference-v1.0 writer): int32 storage type
+    (0 dense / 1 row_sparse / 2 csr); storage shape when sparse; shape;
+    int32 dev_type + int32 dev_id; int32 dtype flag; per-aux int32
+    dtype + shape when sparse; raw data blob; raw aux blobs.
+  - ``0xF993fac8`` (V1): shape; ctx; dtype flag; blob.
+  - anything else (legacy v0): the uint32 IS ndim, followed by the
+    dims; ctx; dtype flag; blob.
+* shapes (nnvm ``TShape::Save``): uint32 ndim + ndim * int64 dims —
+  V1's whole point was the move to int64 TShape (ndarray.cc:843); only
+  the v0 path carries uint32 dims.
+* dtype flags (mshadow): 0 f32, 1 f64, 2 f16, 3 u8, 4 i32, 5 i8, 6 i64.
+"""
+from __future__ import annotations
+
+import struct
+from typing import List, Tuple
+
+import numpy as _np
+
+from .base import MXNetError
+
+REFERENCE_LIST_MAGIC = 0x112
+_V1_MAGIC = 0xF993FAC8
+_V2_MAGIC = 0xF993FAC9
+
+_DTYPE_BY_FLAG = {0: "float32", 1: "float64", 2: "float16", 3: "uint8",
+                  4: "int32", 5: "int8", 6: "int64"}
+_FLAG_BY_DTYPE = {v: k for k, v in _DTYPE_BY_FLAG.items()}
+
+# storage types (include/mxnet/ndarray.h:60) and their aux-array counts
+_STYPE_DENSE, _STYPE_RSP, _STYPE_CSR = 0, 1, 2
+_NUM_AUX = {_STYPE_DENSE: 0, _STYPE_RSP: 1, _STYPE_CSR: 2}
+
+
+class _Reader:
+    __slots__ = ("buf", "pos")
+
+    def __init__(self, buf: bytes):
+        self.buf = buf
+        self.pos = 0
+
+    def take(self, n: int) -> bytes:
+        if self.pos + n > len(self.buf):
+            raise MXNetError(
+                f"truncated reference-format file at byte {self.pos}")
+        out = self.buf[self.pos:self.pos + n]
+        self.pos += n
+        return out
+
+    def u32(self) -> int:
+        return struct.unpack("<I", self.take(4))[0]
+
+    def i32(self) -> int:
+        return struct.unpack("<i", self.take(4))[0]
+
+    def u64(self) -> int:
+        return struct.unpack("<Q", self.take(8))[0]
+
+    def i64(self) -> int:
+        return struct.unpack("<q", self.take(8))[0]
+
+    def shape(self) -> Tuple[int, ...]:
+        """V1/V2 TShape: uint32 ndim + ndim * INT64 dims (V1 == 'the
+        int64_t TShape version', ndarray.cc:843)."""
+        ndim = self.u32()
+        if ndim > 32:
+            raise MXNetError(f"implausible ndim {ndim} (corrupt file?)")
+        return tuple(self.i64() for _ in range(ndim))
+
+    def blob(self, shape, flag) -> _np.ndarray:
+        dt = _np.dtype(_DTYPE_BY_FLAG.get(flag))
+        if flag not in _DTYPE_BY_FLAG:
+            raise MXNetError(f"unknown dtype flag {flag}")
+        n = int(_np.prod(shape, dtype=_np.int64)) if shape else 1
+        raw = self.take(n * dt.itemsize)
+        return _np.frombuffer(raw, dtype=dt).reshape(shape).copy()
+
+
+def _read_one(r: _Reader):
+    """One NDArray record -> NDArray / sparse NDArray (v0/V1/V2)."""
+    from .ndarray import array
+    from .ndarray.sparse import csr_matrix, row_sparse_array
+
+    first = r.u32()
+    if first == _V2_MAGIC:
+        stype = r.i32()
+        if stype not in _NUM_AUX:
+            raise MXNetError(f"unknown storage type {stype}")
+        nad = _NUM_AUX[stype]
+        sshape = r.shape() if nad else None
+        shape = r.shape()
+        if not shape:
+            return array(_np.zeros((0,), "float32"))
+        r.i32(), r.i32()  # context (dev_type, dev_id) — device is ours
+        flag = r.i32()
+        aux = [(r.i32(), r.shape()) for _ in range(nad)]
+        data = r.blob(sshape if nad else shape, flag)
+        aux_data = [r.blob(s, f) for f, s in aux]
+        if stype == _STYPE_RSP:
+            return row_sparse_array((data, aux_data[0]), shape=shape)
+        if stype == _STYPE_CSR:
+            # aux order: indptr, indices (csr::kIndPtr=0, kIdx=1)
+            return csr_matrix((data, aux_data[1], aux_data[0]),
+                              shape=shape)
+        return array(data)
+    # V1: full TShape follows; legacy v0: `first` IS ndim
+    if first == _V1_MAGIC:
+        shape = r.shape()
+    else:
+        ndim = first
+        if ndim > 32:
+            raise MXNetError(f"implausible ndim {ndim} (corrupt file?)")
+        shape = tuple(r.u32() for _ in range(ndim))
+    if not shape:
+        return array(_np.zeros((0,), "float32"))
+    r.i32(), r.i32()  # context
+    flag = r.i32()
+    return array(r.blob(shape, flag))
+
+
+def is_reference_format(fname: str) -> bool:
+    """Sniff the dmlc list magic without touching the rest of the file."""
+    try:
+        with open(fname, "rb") as f:
+            head = f.read(8)
+    except OSError:
+        return False
+    return len(head) == 8 and \
+        struct.unpack("<Q", head)[0] == REFERENCE_LIST_MAGIC
+
+
+def load_reference_format(fname: str):
+    """dict {name: NDArray} when the file carries names, else a list —
+    the same return contract as the reference's mx.nd.load."""
+    with open(fname, "rb") as f:
+        r = _Reader(f.read())
+    if r.u64() != REFERENCE_LIST_MAGIC:
+        raise MXNetError(f"{fname}: not a reference-format NDArray file")
+    r.u64()  # reserved
+    arrays = [_read_one(r) for _ in range(r.u64())]
+    names: List[str] = []
+    n_names = r.u64()
+    for _ in range(n_names):
+        names.append(r.take(r.u64()).decode("utf-8"))
+    if n_names == 0:
+        return arrays
+    if n_names != len(arrays):
+        raise MXNetError(
+            f"{fname}: {len(arrays)} arrays but {n_names} names")
+    return dict(zip(names, arrays))
+
+
+def _shape_bytes(shape) -> bytes:
+    return struct.pack("<I", len(shape)) + b"".join(
+        struct.pack("<q", int(d)) for d in shape)
+
+
+def _write_one(arr) -> bytes:
+    from .ndarray.sparse import CSRNDArray, RowSparseNDArray
+
+    def flag_of(a: _np.ndarray) -> int:
+        name = a.dtype.name
+        if name == "bfloat16":  # no reference-era flag: widen losslessly
+            name = "float32"
+        if name not in _FLAG_BY_DTYPE:
+            raise MXNetError(
+                f"dtype {name} has no reference-format encoding")
+        return _FLAG_BY_DTYPE[name]
+
+    ctx = struct.pack("<ii", 1, 0)  # always saved as cpu, like the ref
+    if isinstance(arr, RowSparseNDArray):
+        vals = _np.ascontiguousarray(_np.asarray(arr._values))
+        if vals.dtype.name == "bfloat16":
+            vals = vals.astype("float32")
+        idx = _np.asarray(arr._indices).astype(_np.int64)
+        return (struct.pack("<Ii", _V2_MAGIC, _STYPE_RSP)
+                + _shape_bytes(vals.shape) + _shape_bytes(arr.shape)
+                + ctx + struct.pack("<i", flag_of(vals))
+                + struct.pack("<i", _FLAG_BY_DTYPE["int64"])
+                + _shape_bytes(idx.shape)
+                + vals.tobytes() + idx.tobytes())
+    if isinstance(arr, CSRNDArray):
+        vals = _np.ascontiguousarray(_np.asarray(arr._values))
+        if vals.dtype.name == "bfloat16":
+            vals = vals.astype("float32")
+        indptr = _np.asarray(arr._indptr).astype(_np.int64)
+        indices = _np.asarray(arr._indices_c).astype(_np.int64)
+        return (struct.pack("<Ii", _V2_MAGIC, _STYPE_CSR)
+                + _shape_bytes(vals.shape) + _shape_bytes(arr.shape)
+                + ctx + struct.pack("<i", flag_of(vals))
+                + struct.pack("<i", _FLAG_BY_DTYPE["int64"])
+                + _shape_bytes(indptr.shape)
+                + struct.pack("<i", _FLAG_BY_DTYPE["int64"])
+                + _shape_bytes(indices.shape)
+                + vals.tobytes() + indptr.tobytes() + indices.tobytes())
+    if len(arr.shape) == 0:
+        # ndim 0 means "none" on the wire (the reference writes nothing
+        # after it, ndarray.cc is_none()); a 0-d scalar would corrupt
+        # every following record — the reference era had no 0-d arrays.
+        # Checked BEFORE ascontiguousarray, which silently promotes 0-d
+        # to (1,).
+        raise MXNetError(
+            "reference format cannot carry 0-d arrays; reshape to (1,)")
+    a = _np.ascontiguousarray(arr.asnumpy())
+    if a.dtype.name == "bfloat16":
+        a = a.astype("float32")
+    return (struct.pack("<Ii", _V2_MAGIC, _STYPE_DENSE)
+            + _shape_bytes(a.shape) + ctx
+            + struct.pack("<i", flag_of(a)) + a.tobytes())
+
+
+def save_reference_format(fname: str, data) -> None:
+    """Write NDArray / list / dict in the reference's binary container
+    (V2 records) — loadable by the reference's mx.nd.load /
+    load_checkpoint, and by ours."""
+    from .ndarray import NDArray
+    if isinstance(data, NDArray) or hasattr(data, "asnumpy"):
+        items, names = [data], []
+    elif isinstance(data, dict):
+        names = list(data.keys())
+        items = [data[k] for k in names]
+    elif isinstance(data, (list, tuple)):
+        items, names = list(data), []
+    else:
+        raise MXNetError(
+            "save_reference_format expects NDArray, list, or dict")
+    out = [struct.pack("<QQ", REFERENCE_LIST_MAGIC, 0),
+           struct.pack("<Q", len(items))]
+    out += [_write_one(a) for a in items]
+    out.append(struct.pack("<Q", len(names)))
+    for n in names:
+        raw = n.encode("utf-8")
+        out.append(struct.pack("<Q", len(raw)) + raw)
+    with open(fname, "wb") as f:
+        f.write(b"".join(out))

@@ -8,6 +8,7 @@ from .ndarray import (NDArray, array, zeros, ones, full, empty, arange, eye,
                       from_dlpack, equal, not_equal, greater, greater_equal,
                       lesser, lesser_equal, modulo, true_divide,
                       onehot_encode)
+from ..legacy_format import save_reference_format, load_reference_format
 from . import register
 from .register import invoke, _gen
 

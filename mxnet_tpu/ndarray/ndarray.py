@@ -672,6 +672,11 @@ def save(fname: str, data) -> None:
 
 
 def load(fname: str):
+    # reference-era binary .params files (dmlc list container) load
+    # transparently — load_checkpoint on a reference checkpoint works
+    from ..legacy_format import is_reference_format, load_reference_format
+    if is_reference_format(fname):
+        return load_reference_format(fname)
     with _np.load(fname, allow_pickle=False) as z:
         keys = list(z.keys())
         if keys == ["__mx_single__"]:
