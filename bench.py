@@ -54,7 +54,17 @@ EPOCH_S = float(os.environ.get("MXT_BENCH_EPOCH_S", 420))
 
 _STATE = {"phase": "start", "img_s": None, "epochs_timed": 0,
           "error": None}
-_WD = Watchdog(on_trip=lambda: _emit(partial=True))
+
+
+def _on_trip():
+    # the watchdog thread os._exit(0)s after this hook: the partial
+    # JSON must be emitted AND the advisory lock dropped here, or a
+    # hung bench pins chip_window's deference for the staleness window
+    _emit(partial=True)
+    _drop_lock()
+
+
+_WD = Watchdog(on_trip=_on_trip)
 
 
 def _emit(partial):
@@ -80,6 +90,14 @@ def _emit(partial):
 def _phase(name, budget):
     _STATE["phase"] = name
     _WD.phase(budget)
+    if _LOCK_HELD:
+        # refresh the lock mtime each phase so a legitimately long run
+        # (phase budgets sum past chip_window's 45-min staleness cutoff)
+        # is never mistaken for a stale lock
+        try:
+            os.utime(LOCK_PATH)
+        except OSError:
+            pass
 
 
 def _run():
@@ -287,7 +305,40 @@ def _run():
     assert final < max(losses[0] * 1.2, np.log(1000.0) + 0.5), losses
 
 
+LOCK_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         ".bench_lock")
+_LOCK_HELD = False
+
+
+def _take_lock():
+    """Advisory lock: tools/chip_window.py defers to a running bench
+    (kills + requeues its in-flight step) so the driver's official
+    round-end bench never shares the chip with playbook diagnostics."""
+    global _LOCK_HELD
+    try:
+        with open(LOCK_PATH, "w") as f:
+            f.write("%d %f" % (os.getpid(), time.time()))
+        _LOCK_HELD = True
+    except OSError:
+        pass
+
+
+def _drop_lock():
+    # only the taker may drop: a MXT_BENCH_NO_LOCK child must never
+    # delete the driver bench's lock out from under it
+    if not _LOCK_HELD:
+        return
+    try:
+        os.unlink(LOCK_PATH)
+    except OSError:
+        pass
+
+
 def main():
+    # chip_window's own bench steps run with MXT_BENCH_NO_LOCK=1 so the
+    # poller never defers to its own child
+    if not os.environ.get("MXT_BENCH_NO_LOCK"):
+        _take_lock()
     try:
         _run()
     except BaseException as e:  # noqa: BLE001 — always emit the JSON line
@@ -295,9 +346,12 @@ def main():
         if _WD.finish():
             _emit(partial=True)
         # teardown may hang on a dead backend; exit hard but parseable
+        # (os._exit skips atexit, so the lock drops explicitly first)
+        _drop_lock()
         os._exit(0)
     if _WD.finish():
         _emit(partial=False)
+    _drop_lock()
     os._exit(0)
 
 

@@ -172,39 +172,39 @@ def _shape_bytes(shape) -> bytes:
         struct.pack("<q", int(d)) for d in shape)
 
 
+def _widen(a: _np.ndarray):
+    """-> (contiguous array, dtype flag).  bf16 has no reference-era
+    flag: widened losslessly to f32 — array and flag change TOGETHER
+    (a flag-only mapping once invited an f32 flag over bf16 bytes)."""
+    a = _np.ascontiguousarray(a)
+    if a.dtype.name == "bfloat16":
+        a = a.astype("float32")
+    if a.dtype.name not in _FLAG_BY_DTYPE:
+        raise MXNetError(
+            f"dtype {a.dtype.name} has no reference-format encoding")
+    return a, _FLAG_BY_DTYPE[a.dtype.name]
+
+
 def _write_one(arr) -> bytes:
     from .ndarray.sparse import CSRNDArray, RowSparseNDArray
 
-    def flag_of(a: _np.ndarray) -> int:
-        name = a.dtype.name
-        if name == "bfloat16":  # no reference-era flag: widen losslessly
-            name = "float32"
-        if name not in _FLAG_BY_DTYPE:
-            raise MXNetError(
-                f"dtype {name} has no reference-format encoding")
-        return _FLAG_BY_DTYPE[name]
-
     ctx = struct.pack("<ii", 1, 0)  # always saved as cpu, like the ref
     if isinstance(arr, RowSparseNDArray):
-        vals = _np.ascontiguousarray(_np.asarray(arr._values))
-        if vals.dtype.name == "bfloat16":
-            vals = vals.astype("float32")
+        vals, vflag = _widen(_np.asarray(arr._values))
         idx = _np.asarray(arr._indices).astype(_np.int64)
         return (struct.pack("<Ii", _V2_MAGIC, _STYPE_RSP)
                 + _shape_bytes(vals.shape) + _shape_bytes(arr.shape)
-                + ctx + struct.pack("<i", flag_of(vals))
+                + ctx + struct.pack("<i", vflag)
                 + struct.pack("<i", _FLAG_BY_DTYPE["int64"])
                 + _shape_bytes(idx.shape)
                 + vals.tobytes() + idx.tobytes())
     if isinstance(arr, CSRNDArray):
-        vals = _np.ascontiguousarray(_np.asarray(arr._values))
-        if vals.dtype.name == "bfloat16":
-            vals = vals.astype("float32")
+        vals, vflag = _widen(_np.asarray(arr._values))
         indptr = _np.asarray(arr._indptr).astype(_np.int64)
         indices = _np.asarray(arr._indices_c).astype(_np.int64)
         return (struct.pack("<Ii", _V2_MAGIC, _STYPE_CSR)
                 + _shape_bytes(vals.shape) + _shape_bytes(arr.shape)
-                + ctx + struct.pack("<i", flag_of(vals))
+                + ctx + struct.pack("<i", vflag)
                 + struct.pack("<i", _FLAG_BY_DTYPE["int64"])
                 + _shape_bytes(indptr.shape)
                 + struct.pack("<i", _FLAG_BY_DTYPE["int64"])
@@ -214,16 +214,14 @@ def _write_one(arr) -> bytes:
         # ndim 0 means "none" on the wire (the reference writes nothing
         # after it, ndarray.cc is_none()); a 0-d scalar would corrupt
         # every following record — the reference era had no 0-d arrays.
-        # Checked BEFORE ascontiguousarray, which silently promotes 0-d
-        # to (1,).
+        # Checked BEFORE _widen's ascontiguousarray, which silently
+        # promotes 0-d to (1,).
         raise MXNetError(
             "reference format cannot carry 0-d arrays; reshape to (1,)")
-    a = _np.ascontiguousarray(arr.asnumpy())
-    if a.dtype.name == "bfloat16":
-        a = a.astype("float32")
+    a, flag = _widen(arr.asnumpy())
     return (struct.pack("<Ii", _V2_MAGIC, _STYPE_DENSE)
             + _shape_bytes(a.shape) + ctx
-            + struct.pack("<i", flag_of(a)) + a.tobytes())
+            + struct.pack("<i", flag) + a.tobytes())
 
 
 def save_reference_format(fname: str, data) -> None:
