@@ -60,8 +60,11 @@ def _on_trip():
     # the watchdog thread os._exit(0)s after this hook: the partial
     # JSON must be emitted AND the advisory lock dropped here, or a
     # hung bench pins chip_window's deference for the staleness window
-    _emit(partial=True)
-    _drop_lock()
+    # (finally: a broken stdout pipe must not leak the lock)
+    try:
+        _emit(partial=True)
+    finally:
+        _drop_lock()
 
 
 _WD = Watchdog(on_trip=_on_trip)
@@ -343,15 +346,20 @@ def main():
         _run()
     except BaseException as e:  # noqa: BLE001 — always emit the JSON line
         _STATE["error"] = "%s: %s" % (type(e).__name__, e)
-        if _WD.finish():
-            _emit(partial=True)
-        # teardown may hang on a dead backend; exit hard but parseable
-        # (os._exit skips atexit, so the lock drops explicitly first)
-        _drop_lock()
+        try:
+            if _WD.finish():
+                _emit(partial=True)
+        finally:
+            # teardown may hang on a dead backend; exit hard but
+            # parseable (os._exit skips atexit, so the lock drops
+            # explicitly, even past a broken stdout pipe)
+            _drop_lock()
         os._exit(0)
-    if _WD.finish():
-        _emit(partial=False)
-    _drop_lock()
+    try:
+        if _WD.finish():
+            _emit(partial=False)
+    finally:
+        _drop_lock()
     os._exit(0)
 
 

@@ -503,6 +503,13 @@ def golden_model_cases():
         # densenet's final AvgPool2D(7) assumes the 224 input contract
         "densenet121": _vision_case(_vision.densenet121,
                                     shape=(1, 3, 224, 224)),
+        # inception's branchy concat tree is the whole-graph NHWC
+        # pass's hardest shape (channel-axis Concat stays CL); 299 is
+        # its input contract
+        "inception_v3": _vision_case(_vision.inception_v3,
+                                     shape=(1, 3, 299, 299)),
+        "alexnet": _vision_case(_vision.alexnet,
+                                shape=(2, 3, 224, 224)),
         "transformer_lm": _lm_case(),
     }
 

@@ -181,7 +181,10 @@ def test_chip_window_defers_to_bench_lock(tmp_path, monkeypatch):
             real_sleep(0.1)
         with open(lock, "w") as f:
             f.write("test")
-        real_sleep(1.5)
+        # hold LONGER than _run's 2 s lock-check cadence (the loop now
+        # blocks in child.wait(timeout=2) between checks, which the
+        # patched time.sleep does not shorten)
+        real_sleep(3.5)
         os.unlink(lock)
 
     th = threading.Thread(target=lock_cycle)

@@ -97,6 +97,9 @@ def _run(name, cmd, timeout, summary_path, env=None, capture_to=None):
     try:
         import tempfile
         for attempt in (1, 2):
+            # rec["s"] must time THIS attempt's run, not the discarded
+            # first attempt + the (possibly very long) lock wait
+            t0 = time.perf_counter()
             with tempfile.TemporaryFile(mode="w+") as fo, \
                     tempfile.TemporaryFile(mode="w+") as fe:
                 child = subprocess.Popen(cmd, cwd=REPO, env=full_env,
@@ -118,7 +121,10 @@ def _run(name, cmd, timeout, summary_path, env=None, capture_to=None):
                         child.wait()
                         preempted = True
                         break
-                    time.sleep(2)
+                    try:  # returns the instant the child exits
+                        child.wait(timeout=2)
+                    except subprocess.TimeoutExpired:
+                        pass
                 if preempted:
                     _wait_bench_lock()
                     continue
