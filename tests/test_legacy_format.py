@@ -160,6 +160,40 @@ def test_zero_d_arrays_rejected_on_save(tmp_path):
                                     [mx.nd.array(np.float32(3.0))])
 
 
+def test_old_schema_symbol_json_loads():
+    """Pre-1.0 symbol JSON (the save_000800.json generation: 'param' /
+    'attr' keys, 2-element inputs, backward_source_id) must load and
+    execute — synthesized here from the old schema, mirroring the
+    reference fixture's shape."""
+    import json
+    doc = {
+        "nodes": [
+            {"op": "null", "param": {}, "name": "data", "inputs": [],
+             "backward_source_id": -1,
+             "attr": {"ctx_group": "stage1"}},
+            {"op": "null", "param": {}, "name": "fc_weight",
+             "inputs": [], "backward_source_id": -1},
+            {"op": "null", "param": {}, "name": "fc_bias", "inputs": [],
+             "backward_source_id": -1},
+            {"op": "FullyConnected",
+             "param": {"no_bias": "False", "num_hidden": "4"},
+             "name": "fc", "inputs": [[0, 0], [1, 0], [2, 0]],
+             "backward_source_id": -1},
+        ],
+        "arg_nodes": [0, 1, 2],
+        "heads": [[3, 0]],
+    }
+    s = mx.sym.load_json(json.dumps(doc))
+    assert s.list_arguments() == ["data", "fc_weight", "fc_bias"]
+    ex = s.simple_bind(mx.cpu(), grad_req="null", data=(2, 3))
+    x = np.random.RandomState(0).normal(0, 1, (2, 3)).astype("f")
+    ex.arg_dict["data"][:] = x
+    ex.arg_dict["fc_weight"][:] = np.ones((4, 3), "f")
+    ex.arg_dict["fc_bias"][:] = np.zeros((4,), "f")
+    out = ex.forward()[0].asnumpy()
+    np.testing.assert_allclose(out, x @ np.ones((3, 4), "f"), atol=1e-5)
+
+
 def test_corrupt_and_mismatched_files_fail_loudly(tmp_path):
     p = tmp_path / "bad.params"
     ref = [np.arange(8, dtype="f")]
