@@ -1189,6 +1189,58 @@ def test_bench_fused_step_and_fallback():
     assert rec.get("partial") and "injected" in rec.get("error", ""), rec
 
 
+def test_chip_window_best_config_composition(tmp_path, monkeypatch):
+    """compose_best_env (the benchbest window step) must compose ONLY
+    measured winners: NHWC when its leg beat the default, the fastest
+    sweep batch, the flag-sweep WINNER's flags above 1% gain — and
+    return no levers when nothing beat the default."""
+    import importlib
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    cw = importlib.import_module("chip_window")
+
+    # nothing measured -> no levers
+    _, levers = cw.compose_best_env({}, {}, "t",
+                                    artifact_dir=str(tmp_path))
+    assert levers == {}
+
+    doc = {"default": {"value": 1800.0},
+           "nhwc_default": {"value": 1900.0},
+           "batch_sweep": {"384": {"value": 1950.0},
+                           "512": {"value": 1700.0}}}
+    (tmp_path / "FLAGSWEEP_t.txt").write_text(
+        "baseline  1800.0 img/s\nlatency-hiding 1890.0 img/s\n"
+        "WINNER: latency-hiding (1890.0 img/s, +5.0% vs baseline)\n")
+    best_env, levers = cw.compose_best_env(
+        {}, doc, "t", artifact_dir=str(tmp_path))
+    assert levers["MXNET_TPU_CONV_LAYOUT"] == "NHWC"
+    assert levers["MXT_BENCH_BATCH"] == "384"
+    assert "latency_hiding" in levers["XLA_FLAGS"]
+    assert best_env["MXNET_FUSED_STEP"] == "0"
+
+    # losing legs compose nothing; sub-1% sweep wins are noise
+    doc2 = {"default": {"value": 1800.0},
+            "nhwc_default": {"value": 1500.0},
+            "batch_sweep": {"512": {"value": 1400.0}}}
+    (tmp_path / "FLAGSWEEP_t.txt").write_text(
+        "WINNER: vmem-64M (1810.0 img/s, +0.5% vs baseline)\n")
+    _, levers2 = cw.compose_best_env(
+        {}, doc2, "t", artifact_dir=str(tmp_path))
+    assert levers2 == {}
+
+    # a caller-forced --conv-layout is NOT a measured winner: it rides
+    # in best_env but must not appear as a lever (no redundant run)
+    benv3, levers3 = cw.compose_best_env(
+        {"MXNET_TPU_CONV_LAYOUT": "NHWC"}, {"default": {"value": 1800.0}},
+        "t2", artifact_dir=str(tmp_path))
+    assert levers3 == {} and benv3["MXNET_TPU_CONV_LAYOUT"] == "NHWC"
+
+    # with NO baseline anywhere, a lone batch leg composes nothing
+    _, levers4 = cw.compose_best_env(
+        {}, {"batch_sweep": {"512": {"value": 1400.0}}}, "t2",
+        artifact_dir=str(tmp_path))
+    assert levers4 == {}
+
+
 def test_bench_watchdog_trip_drops_lock():
     """A phase that outlives its budget trips the watchdog THREAD,
     which os._exit(0)s after its hook — bypassing main()'s cleanup —
