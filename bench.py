@@ -313,12 +313,31 @@ LOCK_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
 _LOCK_HELD = False
 
 
+def _lock_owner_pid():
+    try:
+        with open(LOCK_PATH) as f:
+            return int(f.read().split()[0])
+    except (OSError, ValueError, IndexError):
+        return None
+
+
 def _take_lock():
     """Advisory lock: tools/chip_window.py defers to a running bench
     (kills + requeues its in-flight step) so the driver's official
-    round-end bench never shares the chip with playbook diagnostics."""
+    round-end bench never shares the chip with playbook diagnostics.
+    A fresh lock held by another LIVE process is respected — a second
+    bench (e.g. CI racing the driver) runs without taking ownership
+    rather than clobbering the first taker's lock."""
     global _LOCK_HELD
     try:
+        pid = _lock_owner_pid()
+        if pid is not None and pid != os.getpid() and \
+                (time.time() - os.stat(LOCK_PATH).st_mtime) < 2700:
+            try:
+                os.kill(pid, 0)  # owner alive?
+                return           # yes: leave their lock alone
+            except (OSError, ProcessLookupError):
+                pass             # stale owner: take over
         with open(LOCK_PATH, "w") as f:
             f.write("%d %f" % (os.getpid(), time.time()))
         _LOCK_HELD = True
@@ -327,9 +346,10 @@ def _take_lock():
 
 
 def _drop_lock():
-    # only the taker may drop: a MXT_BENCH_NO_LOCK child must never
-    # delete the driver bench's lock out from under it
-    if not _LOCK_HELD:
+    # only the CURRENT owner may drop: a MXT_BENCH_NO_LOCK child, a
+    # non-owner second bench, or a process whose lock was taken over
+    # must never delete the live owner's lock
+    if not _LOCK_HELD or _lock_owner_pid() != os.getpid():
         return
     try:
         os.unlink(LOCK_PATH)

@@ -251,8 +251,21 @@ def nd_reshape(arr, dims):
 
 
 def nd_slice(arr, begin, end):
-    return arr[int(begin):int(end)]
+    """Validated like the reference's MXNDArraySlice (CHECK begin <=
+    end <= shape[0]) — python slicing would silently clamp an
+    out-of-range request into a wrong-sized view the C caller only
+    notices much later."""
+    begin, end = int(begin), int(end)
+    n = int(arr.shape[0]) if arr.shape else 0
+    if not 0 <= begin <= end <= n:
+        raise MXNetError(
+            f"slice [{begin}:{end}) out of range for axis-0 size {n}")
+    return arr[begin:end]
 
 
 def nd_at(arr, idx):
-    return arr[int(idx)]
+    idx = int(idx)
+    n = int(arr.shape[0]) if arr.shape else 0
+    if not 0 <= idx < n:
+        raise MXNetError(f"index {idx} out of range for axis-0 size {n}")
+    return arr[idx]

@@ -33,6 +33,13 @@ PyObject *support() {
 
 // call capi_support.<fn>(args...); returns new ref or nullptr+error set
 PyObject *call_support(const char *fn, PyObject *args) {
+  // a failed Py_BuildValue at a call site arrives as nullptr WITH a
+  // pending exception — calling on with zero args would mask the real
+  // error (and run the C API with an exception set)
+  if (args == nullptr && PyErr_Occurred()) {
+    set_error(fn);
+    return nullptr;
+  }
   PyObject *m = support();
   if (m == nullptr) {
     Py_XDECREF(args);
@@ -774,13 +781,26 @@ void MXTDataIterFree(MXTDataIterHandle h) {
 
 /* ---------------- Autograd + CachedOp ---------------- */
 
-/* list of borrowed handles -> new PyList holding refs (nullptr on OOM) */
-static PyObject *handle_list(MXTNDArrayHandle *hs, uint32_t n) {
+/* list of borrowed handles -> new PyList holding refs.  nullptr on OOM
+ * or on a NULL element (crash-free error instead of Py_INCREF(NULL));
+ * with null_as_none, NULL entries become None — the reference's
+ * MXAutogradBackwardEx permits per-head NULL ograds (implicit ones) */
+static PyObject *handle_list(MXTNDArrayHandle *hs, uint32_t n,
+                             bool null_as_none = false) {
   PyObject *l = PyList_New(n);
   if (l == nullptr) return nullptr;
   for (uint32_t i = 0; i < n; ++i) {
-    Py_INCREF((PyObject *)hs[i]);
-    PyList_SET_ITEM(l, i, (PyObject *)hs[i]);
+    PyObject *it = (PyObject *)hs[i];
+    if (it == nullptr) {
+      if (!null_as_none) {
+        Py_DECREF(l);
+        g_last_error = "NULL handle in array table";
+        return nullptr;
+      }
+      it = Py_None;
+    }
+    Py_INCREF(it);
+    PyList_SET_ITEM(l, i, it);
   }
   return l;
 }
@@ -874,7 +894,9 @@ int MXTAutogradBackward(uint32_t num, MXTNDArrayHandle *heads,
   }
   PyObject *hg;
   if (head_grads != nullptr) {
-    hg = handle_list(head_grads, num);
+    // per-head NULL == implicit ones for that head (reference
+    // MXAutogradBackwardEx semantics) — mapped to None
+    hg = handle_list(head_grads, num, /*null_as_none=*/true);
     if (hg == nullptr) {
       Py_DECREF(hs);
       set_error("Backward: head_grads table");
@@ -903,6 +925,12 @@ int MXTNDArrayGetGrad(MXTNDArrayHandle h, MXTNDArrayHandle *out) {
   return 0;
 }
 
+struct CopHandle {
+  PyObject *cop;
+  long nout;  // invariant per CachedOp: fetched ONCE at create so the
+              // per-invoke capacity pre-check costs no Python round-trip
+};
+
 int MXTCachedOpCreate(MXTSymbolHandle sym, MXTCachedOpHandle *out) {
   if (sym == nullptr || out == nullptr) return -1;
   *out = nullptr;
@@ -912,7 +940,21 @@ int MXTCachedOpCreate(MXTSymbolHandle sym, MXTCachedOpHandle *out) {
   PyObject *r = call_support("cached_op_create",
                              Py_BuildValue("(O)", sh->sym));
   if (r == nullptr) return -1;
-  *out = r;
+  PyObject *cnt = call_support("cached_op_num_outputs",
+                               Py_BuildValue("(O)", r));
+  if (cnt == nullptr) {
+    Py_DECREF(r);
+    return -1;
+  }
+  long nout = PyLong_AsLong(cnt);
+  Py_DECREF(cnt);
+  if (nout < 0) {
+    Py_DECREF(r);
+    set_error("CachedOpCreate: bad output count");
+    return -1;
+  }
+  CopHandle *h = new CopHandle{r, nout};
+  *out = h;
   return 0;
 }
 
@@ -927,16 +969,13 @@ int MXTCachedOpInvoke(MXTCachedOpHandle h, const char **arg_names,
     return -1;
   if (!ensure_python()) return -1;
   Gil gil;
+  CopHandle *ch = (CopHandle *)h;
   // capacity pre-check BEFORE the call: invoke has irreversible side
   // effects (in-place aux update, autograd tape append), so a short
   // output table must fail without running it — a retry would
-  // double-advance BN moving stats and leave a stray tape entry
-  PyObject *cnt = call_support("cached_op_num_outputs",
-                               Py_BuildValue("(O)", (PyObject *)h));
-  if (cnt == nullptr) return -1;
-  long want = PyLong_AsLong(cnt);
-  Py_DECREF(cnt);
-  if (want < 0 || outputs == nullptr || (uint32_t)want > *num_outputs) {
+  // double-advance BN moving stats and leave a stray tape entry.
+  // The count was cached at create (invariant per CachedOp).
+  if (outputs == nullptr || (uint32_t)ch->nout > *num_outputs) {
     set_error("CachedOpInvoke: output table too small");
     return -1;
   }
@@ -953,7 +992,7 @@ int MXTCachedOpInvoke(MXTCachedOpHandle h, const char **arg_names,
   }
   PyObject *r = call_support(
       "cached_op_invoke",
-      Py_BuildValue("(ONNNN)", (PyObject *)h, an, av, xn, xv));
+      Py_BuildValue("(ONNNN)", ch->cop, an, av, xn, xv));
   if (r == nullptr) return -1;
   Py_ssize_t n = PySequence_Size(r);
   if (n < 0 || (uint32_t)n > *num_outputs) {
@@ -969,9 +1008,13 @@ int MXTCachedOpInvoke(MXTCachedOpHandle h, const char **arg_names,
 }
 
 void MXTCachedOpFree(MXTCachedOpHandle h) {
-  if (h == nullptr || !Py_IsInitialized()) return;
-  Gil gil;
-  Py_DECREF((PyObject *)h);
+  if (h == nullptr) return;
+  CopHandle *ch = (CopHandle *)h;
+  if (Py_IsInitialized()) {
+    Gil gil;
+    Py_DECREF(ch->cop);
+  }
+  delete ch;
 }
 
 /* ---------------- Profiler + introspection + views ---------------- */

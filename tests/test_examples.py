@@ -1234,7 +1234,11 @@ def test_chip_window_best_config_composition(tmp_path, monkeypatch):
         "t2", artifact_dir=str(tmp_path))
     assert levers3 == {} and benv3["MXNET_TPU_CONV_LAYOUT"] == "NHWC"
 
-    # with NO baseline anywhere, a lone batch leg composes nothing
+    # with NO baseline anywhere, lone batch AND flag legs compose
+    # nothing (a >1% sweep WINNER file for the tag exists, but there
+    # is no bench number to justify burning a benchbest run)
+    (tmp_path / "FLAGSWEEP_t2.txt").write_text(
+        "WINNER: latency-hiding (900.0 img/s, +5.0% vs baseline)\n")
     _, levers4 = cw.compose_best_env(
         {}, {"batch_sweep": {"512": {"value": 1400.0}}}, "t2",
         artifact_dir=str(tmp_path))

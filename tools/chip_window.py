@@ -102,14 +102,26 @@ def _run(name, cmd, timeout, summary_path, env=None, capture_to=None):
             t0 = time.perf_counter()
             with tempfile.TemporaryFile(mode="w+") as fo, \
                     tempfile.TemporaryFile(mode="w+") as fe:
+                # own session: kills must take the whole process TREE
+                # (score/flagsweep steps spawn their own chip-using
+                # subprocesses — an orphaned grandchild would keep the
+                # chip busy next to the official bench)
                 child = subprocess.Popen(cmd, cwd=REPO, env=full_env,
-                                         stdout=fo, stderr=fe, text=True)
+                                         stdout=fo, stderr=fe, text=True,
+                                         start_new_session=True)
+
+                def _kill_tree():
+                    try:
+                        os.killpg(child.pid, 9)
+                    except (OSError, ProcessLookupError):
+                        child.kill()
+                    child.wait()
+
                 deadline = time.monotonic() + timeout
                 preempted = False
                 while child.poll() is None:
                     if time.monotonic() >= deadline:
-                        child.kill()
-                        child.wait()
+                        _kill_tree()
                         fo.seek(0), fe.seek(0)
                         raise subprocess.TimeoutExpired(
                             cmd, timeout, output=fo.read(),
@@ -117,8 +129,7 @@ def _run(name, cmd, timeout, summary_path, env=None, capture_to=None):
                     if attempt == 1 and _bench_lock_active():
                         print(f"   bench lock appeared mid-{name}; "
                               "killing + requeueing step", flush=True)
-                        child.kill()
-                        child.wait()
+                        _kill_tree()
                         preempted = True
                         break
                     try:  # returns the instant the child exits
@@ -214,24 +225,27 @@ def compose_best_env(env, bench_doc, tag, artifact_dir=None):
                 best_bs, best_bs_v = bs, v
         if best_bs:
             added["MXT_BENCH_BATCH"] = best_bs
-    try:  # sweep winner -> its flag string (same CONFIGS table)
-        exp_dir = os.path.join(REPO, "experiments")
-        if exp_dir not in sys.path:
-            sys.path.insert(0, exp_dir)
-        from xla_flag_sweep import CONFIGS as _SWEEP_CONFIGS
-        with open(os.path.join(artifact_dir,
-                               f"FLAGSWEEP_{tag}.txt")) as f:
-            sweep_txt = f.read()
-        m = re.search(r"WINNER: (\S+) \([\d.]+ img/s, \+([\d.]+)%",
-                      sweep_txt)
-        if m and m.group(1) != "baseline" and float(m.group(2)) > 1.0:
-            flags = dict(_SWEEP_CONFIGS).get(m.group(1), "")
-            if flags:
-                # the lever records ONLY the measured winner's flags;
-                # the run env composes them with any ambient XLA_FLAGS
-                added["XLA_FLAGS"] = flags
-    except (OSError, ImportError, ValueError):
-        pass
+    if base_v > 0:  # same no-baseline rule as the other levers
+        try:  # sweep winner -> its flag string (same CONFIGS table)
+            exp_dir = os.path.join(REPO, "experiments")
+            if exp_dir not in sys.path:
+                sys.path.insert(0, exp_dir)
+            from xla_flag_sweep import CONFIGS as _SWEEP_CONFIGS
+            with open(os.path.join(artifact_dir,
+                                   f"FLAGSWEEP_{tag}.txt")) as f:
+                sweep_txt = f.read()
+            m = re.search(r"WINNER: (\S+) \([\d.]+ img/s, \+([\d.]+)%",
+                          sweep_txt)
+            if m and m.group(1) != "baseline" and \
+                    float(m.group(2)) > 1.0:
+                flags = dict(_SWEEP_CONFIGS).get(m.group(1), "")
+                if flags:
+                    # the lever records ONLY the measured winner's
+                    # flags; the run env composes them with any
+                    # ambient XLA_FLAGS
+                    added["XLA_FLAGS"] = flags
+        except (OSError, ImportError, ValueError):
+            pass
     best_env = {**env, "MXNET_FUSED_STEP": "0", **added}
     if "XLA_FLAGS" in added:
         best_env["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") + " "
