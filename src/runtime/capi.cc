@@ -781,20 +781,26 @@ void MXTDataIterFree(MXTDataIterHandle h) {
 
 /* ---------------- Autograd + CachedOp ---------------- */
 
-/* list of borrowed handles -> new PyList holding refs.  nullptr on OOM
- * or on a NULL element (crash-free error instead of Py_INCREF(NULL));
- * with null_as_none, NULL entries become None — the reference's
+/* list of borrowed handles -> new PyList holding refs.  On ANY failure
+ * (OOM, or a NULL element — crash-free error instead of
+ * Py_INCREF(NULL)) returns nullptr with a COMPLETE error message
+ * already recorded under `where`, so callers just return -1.  With
+ * null_as_none, NULL entries become None — the reference's
  * MXAutogradBackwardEx permits per-head NULL ograds (implicit ones) */
-static PyObject *handle_list(MXTNDArrayHandle *hs, uint32_t n,
-                             bool null_as_none = false) {
+static PyObject *handle_list(const char *where, MXTNDArrayHandle *hs,
+                             uint32_t n, bool null_as_none = false) {
   PyObject *l = PyList_New(n);
-  if (l == nullptr) return nullptr;
+  if (l == nullptr) {
+    set_error(where);
+    return nullptr;
+  }
   for (uint32_t i = 0; i < n; ++i) {
     PyObject *it = (PyObject *)hs[i];
     if (it == nullptr) {
       if (!null_as_none) {
         Py_DECREF(l);
-        g_last_error = "NULL handle in array table";
+        g_last_error = std::string(where) +
+            ": NULL handle in array table";
         return nullptr;
       }
       it = Py_None;
@@ -805,14 +811,20 @@ static PyObject *handle_list(MXTNDArrayHandle *hs, uint32_t n,
   return l;
 }
 
-/* list of C strings -> new PyList of str (nullptr + error on bad UTF-8) */
-static PyObject *name_list(const char **names, uint32_t n) {
+/* list of C strings -> new PyList of str; same complete-error contract
+ * as handle_list (OOM / bad UTF-8) */
+static PyObject *name_list(const char *where, const char **names,
+                           uint32_t n) {
   PyObject *l = PyList_New(n);
-  if (l == nullptr) return nullptr;
+  if (l == nullptr) {
+    set_error(where);
+    return nullptr;
+  }
   for (uint32_t i = 0; i < n; ++i) {
     PyObject *s = PyUnicode_FromString(names[i]);
     if (s == nullptr) {
       Py_DECREF(l);
+      set_error(where);
       return nullptr;
     }
     PyList_SET_ITEM(l, i, s);
@@ -867,11 +879,11 @@ int MXTAutogradMarkVariables(uint32_t num, MXTNDArrayHandle *vars,
   if (num > 0 && (vars == nullptr || grads == nullptr)) return -1;
   if (!ensure_python()) return -1;
   Gil gil;
-  PyObject *vs = handle_list(vars, num);
-  PyObject *gs = vs ? handle_list(grads, num) : nullptr;
+  PyObject *vs = handle_list("MarkVariables: vars", vars, num);
+  PyObject *gs = vs ? handle_list("MarkVariables: grads", grads, num)
+                    : nullptr;
   if (gs == nullptr) {
     Py_XDECREF(vs);
-    set_error("MarkVariables: handle tables");
     return -1;
   }
   PyObject *r = call_support("autograd_mark_variables",
@@ -887,19 +899,16 @@ int MXTAutogradBackward(uint32_t num, MXTNDArrayHandle *heads,
   if (num == 0 || heads == nullptr) return -1;
   if (!ensure_python()) return -1;
   Gil gil;
-  PyObject *hs = handle_list(heads, num);
-  if (hs == nullptr) {
-    set_error("Backward: head table");
-    return -1;
-  }
+  PyObject *hs = handle_list("Backward: heads", heads, num);
+  if (hs == nullptr) return -1;
   PyObject *hg;
   if (head_grads != nullptr) {
     // per-head NULL == implicit ones for that head (reference
     // MXAutogradBackwardEx semantics) — mapped to None
-    hg = handle_list(head_grads, num, /*null_as_none=*/true);
+    hg = handle_list("Backward: head_grads", head_grads, num,
+                     /*null_as_none=*/true);
     if (hg == nullptr) {
       Py_DECREF(hs);
-      set_error("Backward: head_grads table");
       return -1;
     }
   } else {
@@ -979,15 +988,18 @@ int MXTCachedOpInvoke(MXTCachedOpHandle h, const char **arg_names,
     set_error("CachedOpInvoke: output table too small");
     return -1;
   }
-  PyObject *an = name_list(arg_names, num_args);
-  PyObject *av = an ? handle_list(args, num_args) : nullptr;
-  PyObject *xn = av ? name_list(aux_names, num_aux) : nullptr;
-  PyObject *xv = xn ? handle_list(auxs, num_aux) : nullptr;
+  PyObject *an = name_list("CachedOpInvoke: arg names", arg_names,
+                           num_args);
+  PyObject *av = an ? handle_list("CachedOpInvoke: args", args,
+                                  num_args) : nullptr;
+  PyObject *xn = av ? name_list("CachedOpInvoke: aux names", aux_names,
+                                num_aux) : nullptr;
+  PyObject *xv = xn ? handle_list("CachedOpInvoke: auxs", auxs,
+                                  num_aux) : nullptr;
   if (xv == nullptr) {
     Py_XDECREF(an);
     Py_XDECREF(av);
     Py_XDECREF(xn);
-    set_error("CachedOpInvoke: bad name/handle tables");
     return -1;
   }
   PyObject *r = call_support(

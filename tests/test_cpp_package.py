@@ -402,6 +402,61 @@ def test_capi_autograd_and_cached_op(tmp_path):
     np.testing.assert_allclose(got["aux_var"], var.asnumpy(), atol=1e-6)
 
 
+def test_capi_error_discipline_ctypes():
+    """Error paths across the C ABI return -1 with a real message in
+    MXTGetLastError — never a crash, never a pending-exception leak
+    that poisons the NEXT call (each failing call is followed by a
+    working one to prove the boundary stayed clean)."""
+    import ctypes
+    subprocess.run(["make", "predict_capi"], cwd=REPO, check=True,
+                   capture_output=True)
+    lib = ctypes.CDLL(os.path.join(REPO, "mxnet_tpu", "_native",
+                                   "libmxt_predict.so"))
+    lib.MXTGetLastError.restype = ctypes.c_char_p
+    shp = (ctypes.c_uint32 * 1)(4)
+    h = ctypes.c_void_p()
+
+    # unknown dtype
+    assert lib.MXTNDArrayCreate(shp, 1, b"float99", ctypes.byref(h)) != 0
+    assert b"float99" in lib.MXTGetLastError()
+    # a good call right after: no pending-exception poisoning
+    assert lib.MXTNDArrayCreate(shp, 1, b"float32", ctypes.byref(h)) == 0
+
+    # unknown operator
+    out_h = ctypes.c_void_p()
+    n_out = ctypes.c_uint32(0)
+    assert lib.MXTImperativeInvoke(b"no_such_op", ctypes.byref(h), 1,
+                                   None, None, 0, ctypes.byref(out_h),
+                                   ctypes.byref(n_out)) != 0
+    assert b"no_such_op" in lib.MXTGetLastError()
+
+    # NULL element inside a handle table: error, not a segfault
+    two = (ctypes.c_void_p * 2)(h, None)
+    assert lib.MXTAutogradMarkVariables(2, two, two) != 0
+    assert b"NULL" in lib.MXTGetLastError()
+
+    # out-of-range views validate like the reference
+    sl = ctypes.c_void_p()
+    assert lib.MXTNDArraySlice(h, 3, 99, ctypes.byref(sl)) != 0
+    assert b"out of range" in lib.MXTGetLastError()
+    at = ctypes.c_void_p()
+    assert lib.MXTNDArrayAt(h, 99, ctypes.byref(at)) != 0
+
+    # grad before mark_variables: loud error
+    g = ctypes.c_void_p()
+    assert lib.MXTNDArrayGetGrad(h, ctypes.byref(g)) != 0
+    assert b"MarkVariables" in lib.MXTGetLastError()
+
+    # and the handle still works after all those failures
+    vals = (ctypes.c_float * 4)(1, 2, 3, 4)
+    assert lib.MXTNDArraySyncCopyFromCPU(h, vals,
+                                         ctypes.c_uint64(4)) == 0
+    buf = (ctypes.c_float * 4)()
+    assert lib.MXTNDArraySyncCopyToCPU(h, buf, ctypes.c_uint64(4)) == 0
+    assert list(buf) == [1.0, 2.0, 3.0, 4.0]
+    lib.MXTNDArrayFree(h)
+
+
 def test_capi_tranche4_ctypes_profiler_opnames_views(tmp_path):
     """Tranche-4 surface through ctypes — the dynamic-FFI consumer
     pattern an R/Julia binding would use (parity: c_api.h
