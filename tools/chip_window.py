@@ -283,7 +283,10 @@ def layout_ab(summary_path, batch, step_timeout):
         rec = _run(f"layout_probe[{lay},bn={bn},{res}]",
                    [sys.executable, "experiments/layout_probe.py",
                     "--layout", lay, "--bn", bn, "--resident", res,
-                    "--batch", str(batch)],
+                    "--batch", str(batch),
+                    # IMG is forced to 32 in selftest (chip runs leave
+                    # it unset -> the probe's 224 default)
+                    "--img", os.environ.get("IMG", "224")],
                    step_timeout, summary_path)
         m = re.search(r"([\d.]+) img/s", rec.get("tail", ""))
         imgs = float(m.group(1)) if m else 0.0
@@ -352,6 +355,19 @@ def main():
         # they run their smoke configs (orchestration is what's tested)
         os.environ["MXT_LM_PROBE_SMOKE"] = "1"
         os.environ["MXT_DECODE_PROBE_SMOKE"] = "1"
+        # bench-shaped legs too: a CPU selftest at the chip-sized
+        # defaults (ResNet-50 BS=256@224) would run for hours — smoke
+        # sizes keep every leg minutes-scale.  Forced, not setdefault:
+        # an inherited MXT_BENCH_*/B/IMG from the launching shell would
+        # silently defeat the smoke sizing (same hazard as
+        # JAX_PLATFORMS below).  B/IMG cover the experiments/ probes
+        # (layout_probe via args.batch, bench_r01_config, profile_fit,
+        # fused_step_probe, xla_flag_sweep).
+        for k, v in (("MXT_BENCH_BATCH", "8"), ("MXT_BENCH_IMG", "32"),
+                     ("MXT_BENCH_BATCHES", "2"), ("MXT_BENCH_LR", "0.01"),
+                     ("B", "8"), ("IMG", "32")):
+            os.environ[k] = v
+        args.batch = min(args.batch, 8)
         # force, don't setdefault: the driver environment exports
         # JAX_PLATFORMS=axon, and a selftest that inherits it hangs on
         # a dead tunnel instead of exercising the cpu path
@@ -453,7 +469,8 @@ def main():
     # bench.py run so the numbers are directly comparable
     if "benchbatch" in steps:
         bench_doc.setdefault("batch_sweep", {})
-        for bs in (384, 512):
+        # selftest sweeps toy sizes (orchestration, not numbers)
+        for bs in ((12, 16) if selftest else (384, 512)):
             rec = _bench_json(
                 _run(f"bench_bs{bs}", [sys.executable, "bench.py"],
                      args.step_timeout, summary_path,
