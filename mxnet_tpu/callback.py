@@ -44,12 +44,14 @@ def _checkpointer(save_fn, period):
     return on_epoch_end
 
 
-def do_checkpoint(prefix, period=1):
-    """Save symbol + params to `prefix`-NNNN.params every `period` epochs."""
+def do_checkpoint(prefix, period=1, reference_format=False):
+    """Save symbol + params to `prefix`-NNNN.params every `period` epochs
+    (reference_format writes the original framework's binary container)."""
     from .model import save_checkpoint
 
     return _checkpointer(
-        lambda n, sym, arg, aux: save_checkpoint(prefix, n, sym, arg, aux),
+        lambda n, sym, arg, aux: save_checkpoint(
+            prefix, n, sym, arg, aux, reference_format=reference_format),
         period)
 
 

@@ -20,13 +20,21 @@ BatchEndParam = namedtuple("BatchEndParams",
 
 
 def save_checkpoint(prefix: str, epoch: int, symbol, arg_params: Dict,
-                    aux_params: Dict) -> None:
-    """Parity: model.save_checkpoint — prefix-symbol.json + prefix-%04d.params."""
+                    aux_params: Dict, reference_format: bool = False) -> None:
+    """Parity: model.save_checkpoint — prefix-symbol.json + prefix-%04d.params.
+
+    reference_format=True writes the .params in the ORIGINAL
+    framework's binary container (legacy_format.py V2) so the
+    checkpoint serves on a reference installation — load_checkpoint
+    here reads both formats transparently."""
     if symbol is not None:
         symbol.save(f"{prefix}-symbol.json")
     save_dict = {f"arg:{k}": v for k, v in arg_params.items()}
     save_dict.update({f"aux:{k}": v for k, v in aux_params.items()})
-    nd.save(f"{prefix}-{epoch:04d}.params", save_dict)
+    if reference_format:
+        nd.save_reference_format(f"{prefix}-{epoch:04d}.params", save_dict)
+    else:
+        nd.save(f"{prefix}-{epoch:04d}.params", save_dict)
 
 
 def load_checkpoint(prefix: str, epoch: int):

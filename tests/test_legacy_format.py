@@ -194,6 +194,42 @@ def test_old_schema_symbol_json_loads():
     np.testing.assert_allclose(out, x @ np.ones((3, 4), "f"), atol=1e-5)
 
 
+def test_save_checkpoint_reference_format_roundtrip(tmp_path):
+    """save_checkpoint(reference_format=True) writes a checkpoint whose
+    .params is the reference binary container, and load_checkpoint
+    reads it back identically (the reverse-migration convenience)."""
+    from mxnet_tpu import sym
+    net = sym.SoftmaxOutput(
+        sym.FullyConnected(sym.Variable("data"), num_hidden=2, name="fc"),
+        name="softmax")
+    rs = np.random.RandomState(2)
+    arg = {"fc_weight": mx.nd.array(rs.normal(0, 1, (2, 3)).astype("f")),
+           "fc_bias": mx.nd.array(np.zeros(2, "f"))}
+    prefix = str(tmp_path / "rf")
+    mx.model.save_checkpoint(prefix, 7, net, arg, {},
+                             reference_format=True)
+    from mxnet_tpu.legacy_format import is_reference_format
+    assert is_reference_format(prefix + "-0007.params")
+    _, arg2, aux2 = mx.model.load_checkpoint(prefix, 7)
+    assert aux2 == {}
+    for k in arg:
+        np.testing.assert_array_equal(arg2[k].asnumpy(),
+                                      arg[k].asnumpy())
+
+    # plumbed through the primary training surfaces too
+    from mxnet_tpu.io import DataDesc
+    mod = mx.mod.Module(net)
+    mod.bind(data_shapes=[DataDesc("data", (4, 3), np.float32)],
+             label_shapes=[DataDesc("softmax_label", (4,), np.float32)])
+    mod.init_params(mx.init.Xavier())
+    mod.save_checkpoint(str(tmp_path / "m"), 1, reference_format=True)
+    assert is_reference_format(str(tmp_path / "m-0001.params"))
+    cb = mx.callback.do_checkpoint(str(tmp_path / "c"),
+                                   reference_format=True)
+    cb(0, net, arg, {})
+    assert is_reference_format(str(tmp_path / "c-0001.params"))
+
+
 def test_corrupt_and_mismatched_files_fail_loudly(tmp_path):
     p = tmp_path / "bad.params"
     ref = [np.arange(8, dtype="f")]
