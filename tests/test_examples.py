@@ -951,6 +951,18 @@ def test_diagnose_tool():
                     "MXNet-TPU Info", "Device Info"):
         assert section in out, out
     assert "jax" in out
+    assert "IMPORT FAILED" not in out
+
+    # a user runs it from anywhere with NO PYTHONPATH (the tool must
+    # find the package relative to itself, like the reference's)
+    env = {k: v for k, v in ENV.items() if k != "PYTHONPATH"}
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "diagnose.py"),
+         "--device-timeout", "3"],
+        env=env, cwd="/tmp", capture_output=True, text=True, timeout=180)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "IMPORT FAILED" not in proc.stdout, proc.stdout
+    assert "Version" in proc.stdout
 
 
 def test_ipynb2md_tool(tmp_path):

@@ -12,6 +12,15 @@ import subprocess
 import sys
 import time
 
+# runnable from anywhere, like the reference's tool (the repo layout
+# puts the package one level up from tools/)
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+os.environ["PYTHONPATH"] = (
+    _REPO + os.pathsep + os.environ.get("PYTHONPATH", "")).rstrip(
+        os.pathsep)  # the device-probe subprocess needs it too
+
 
 def _section(title):
     print("----------" + title + "----------", flush=True)
