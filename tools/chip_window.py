@@ -3,20 +3,21 @@
 Rounds 2-3 proved TPU-tunnel windows cannot be assumed: both rounds
 ended with zero on-chip evidence.  This tool turns ANY window — even a
 15-minute one — into durable artifacts automatically.  On the first
-successful device probe it runs, in value order (r05 ordering —
-windows can last ~13 min, so cheap high-value legs ride first):
+successful device probe it runs, in value order (r05 session-3
+ordering — windows can last ~13 min, so the highest-value product
+legs ride first):
 
   1. bench.py standard + fused A/B       -> BENCH_WINDOW_<tag>.json
-  2. tools/run_tpu_consistency.py        -> CONSISTENCY_<tag>.json
-     (the TPU-vs-CPU correctness tier)
-  3. experiments/layout_probe.py A/B     -> LAYOUT_<tag>.json
-     (raw-JAX NCHW/NHWC x residency sweep; picks the winning config)
-  4. consistency --layout NHWC subset, product NHWC + batch-sweep
-     bench legs, r01-config reconciliation, flash probe, flag sweep
-  5. benchmark_score.py zoo inference    -> SCORE_<tag>.jsonl
-     (six 480s cells — after the cheap legs so a short window keeps
-     the correctness + layout evidence)
-  6. experiments/profile_fit.py / fused_step_probe  -> PROFILE/FUSEDPROBE
+  2. product NHWC + batch-sweep bench legs (VERDICT r4 top_next)
+  3. tools/run_tpu_consistency.py        -> CONSISTENCY_<tag>.json
+     (the TPU-vs-CPU correctness tier), then the NHWC subset
+  4. experiments/layout_probe.py A/B     -> LAYOUT_<tag>.json
+     (raw-JAX NCHW/NHWC x residency sweep)
+  5. LM/decode probes, r01-config reconciliation, flash probe, flag
+     sweep, then benchbest (one run composing the measured winners)
+  6. benchmark_score.py zoo inference    -> SCORE_<tag>.jsonl
+     (six 480s cells — late so a short window keeps the above)
+  7. experiments/profile_fit.py / fused_step_probe  -> PROFILE/FUSEDPROBE
 
 Every step is a subprocess with its own timeout, so one hang cannot eat
 the window; the summary (CHIP_WINDOW_<tag>.json) is rewritten atomically
@@ -311,10 +312,10 @@ def main():
     ap.add_argument("--probe-timeout", type=float, default=120.0)
     ap.add_argument("--step-timeout", type=float, default=900.0)
     ap.add_argument("--batch", type=int, default=256)
-    ap.add_argument("--steps", default="bench,consistency,layout,nhwc,"
-                    "benchnhwc,benchbatch,lmbench,decodebench,r01cfg,"
+    ap.add_argument("--steps", default="bench,benchnhwc,benchbatch,"
+                    "consistency,layout,nhwc,lmbench,decodebench,r01cfg,"
                     "flashprobe,flagsweep,benchbest,score,profile,fusedprobe",
-                    help="which steps to run, in this fixed order "
+                    help="which steps to run, in main()'s fixed order "
                          "(VERDICT r4 #2: the first minutes of any window "
                          "belong to the bench; diagnostics after) — "
                          "lets a re-armed poller skip artifacts already "
@@ -426,36 +427,14 @@ def main():
                  env={**env, "MXNET_FUSED_STEP": "1"}))
         _write_bench_window()
 
-    # 3. correctness tier (the flash case's Mosaic probe writes its
-    # verbatim toolchain output to a durable artifact, VERDICT r4 #5)
-    if "consistency" in steps:
-        cmd = [sys.executable, "tools/run_tpu_consistency.py",
-               "--out", os.path.join(REPO, f"CONSISTENCY_{tag}.json")]
-        if args.consistency_subset:
-            cmd += ["--only", args.consistency_subset]
-        _run("consistency", cmd, args.step_timeout * 2, summary_path,
-             env={"MXT_PALLAS_PROBE_LOG":
-                  os.path.join(REPO, f"MOSAIC_PROBE_{tag}.txt")})
-
-    # 4. layout/precision A/B (raw JAX ceiling probe)
-    winner = (layout_ab(summary_path, args.batch, args.step_timeout)
-              if "layout" in steps else None)
-
-    # 5. the framework's own NHWC lowering, on-chip, resnet-path subset
-    if "nhwc" in steps:
-        _run("consistency_nhwc",
-             [sys.executable, "tools/run_tpu_consistency.py",
-              "--layout", "NHWC", "--only", "conv,pool,batchnorm,resnet",
-              "--out", os.path.join(REPO, f"CONSISTENCY_{tag}_nhwc.json")],
-             args.step_timeout, summary_path)
-
-    # 6. if the raw probe says NHWC wins and the step-1 bench did not
-    # already run NHWC, measure the product path under it — standard
-    # step (the faster path per the r05 A/B): the framework-vs-raw
-    # layout question needs both points on-chip
-    if "benchnhwc" in steps and args.conv_layout != "NHWC" and (
-            winner is None or
-            (winner["img_s"] > 0 and winner["layout"] == "NHWC")):
+    # 2. the product-path MFU levers, right after the headline bench
+    # (VERDICT r4 top_next: the on-chip NHWC product A/B is the #1
+    # named item — it outranks re-validating correctness cases, so
+    # these legs moved ahead of consistency/layout).  The leg runs
+    # UNGATED: the raw A/B already measured NHWC winning raw
+    # (LAYOUT_r04.json, 1929 vs 1860) — the open question is purely
+    # whether the whole-graph pass carries that win to the product
+    # path, and only this leg can answer it.
         SUMMARY["bench_nhwc"] = bench_doc["nhwc_default"] = _bench_json(
             _run("bench_nhwc", [sys.executable, "bench.py"],
                  args.step_timeout, summary_path,
@@ -463,7 +442,7 @@ def main():
                       "MXNET_FUSED_STEP": "0"}))
         _write_bench_window()
 
-    # 6b. batch-size sweep at the product path (standard step): MFU at
+    # 2b. batch-size sweep at the product path (standard step): MFU at
     # BS=256 measured 22.9% (r05) — a bigger global batch is the
     # cheapest lever to test for MXU utilisation; each leg is a full
     # bench.py run so the numbers are directly comparable
@@ -480,6 +459,29 @@ def main():
             _write_bench_window()
         SUMMARY["batch_sweep"] = bench_doc["batch_sweep"]
         _write_summary(summary_path)
+
+    # 3. correctness tier (the flash case's Mosaic probe writes its
+    # verbatim toolchain output to a durable artifact, VERDICT r4 #5)
+    if "consistency" in steps:
+        cmd = [sys.executable, "tools/run_tpu_consistency.py",
+               "--out", os.path.join(REPO, f"CONSISTENCY_{tag}.json")]
+        if args.consistency_subset:
+            cmd += ["--only", args.consistency_subset]
+        _run("consistency", cmd, args.step_timeout * 2, summary_path,
+             env={"MXT_PALLAS_PROBE_LOG":
+                  os.path.join(REPO, f"MOSAIC_PROBE_{tag}.txt")})
+
+    # 4. layout/precision A/B (raw JAX ceiling probe)
+    winner = (layout_ab(summary_path, args.batch, args.step_timeout)
+              if "layout" in steps else None)  # flagsweep reads it
+
+    # 5. the framework's own NHWC lowering, on-chip, resnet-path subset
+    if "nhwc" in steps:
+        _run("consistency_nhwc",
+             [sys.executable, "tools/run_tpu_consistency.py",
+              "--layout", "NHWC", "--only", "conv,pool,batchnorm,resnet",
+              "--out", os.path.join(REPO, f"CONSISTENCY_{tag}_nhwc.json")],
+             args.step_timeout, summary_path)
 
     # 6c. transformer-LM MFU probe: the matmul-dominated flagship —
     # tells the MFU story the conv-bound ResNet cannot (its raw-JAX
