@@ -74,6 +74,25 @@ def _emit(partial):
     v = _STATE["img_s"] or 0.0
     out = {"metric": "resnet50_train_throughput", "value": round(v, 2),
            "unit": "img/s", "vs_baseline": round(v / BASELINE_IMG_S, 2)}
+    try:
+        # dispatch accounting rides along so every future perf PR's
+        # BENCH_*.json carries launch counts / transfer bytes / data-wait
+        # next to img/s (mxnet_tpu.observability; no-op if import failed
+        # before the metrics layer loaded)
+        from mxnet_tpu.observability import metrics as _obs_metrics
+        snap = _obs_metrics.snapshot()
+        out["observability"] = {
+            "dispatch_counts": snap["dispatch_counts"],
+            "fit_step_dispatches": snap["fit_step_dispatches"],
+            "transfer_bytes": snap["transfer_bytes"],
+            "data_wait_ms_total": round(snap["data_wait_ms_total"], 3),
+            "data_wait_ms_mean": round(snap["data_wait_ms_mean"], 6),
+            "engine_wait_seconds": round(snap["engine_wait_seconds"], 6),
+            "jit_cache": snap["jit_cache"],
+            "hbm": snap["hbm"],
+        }
+    except Exception:
+        pass
     if v and _STATE.get("chip") is not None:
         # MFU is the north-star axis (BASELINE.md: >=60%); report it
         # next to img/s so the scoring artifact carries it first-class

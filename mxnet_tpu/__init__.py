@@ -52,6 +52,7 @@ from . import module
 from . import module as mod
 from . import parallel
 from . import gluon
+from . import observability
 from . import profiler
 from . import monitor
 from . import monitor as mon

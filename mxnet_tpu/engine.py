@@ -20,10 +20,12 @@ Engine types (parity: src/engine/engine.cc:32-48, MXNET_ENGINE_TYPE):
 from __future__ import annotations
 
 import contextlib
+import time
 
 import jax
 
 from .base import getenv
+from .observability import metrics as _metrics
 
 _engine_type = getenv("MXNET_ENGINE_TYPE", "ThreadedEnginePerDevice")
 _bulk_size = 0
@@ -53,7 +55,12 @@ def maybe_sync(arrays) -> None:
 def wait_for_var(array) -> None:
     """Parity: Engine::WaitForVar — block until this buffer is computed."""
     if hasattr(array, "block_until_ready"):
+        on = _metrics.ENABLED  # captured once: an enable() mid-wait must
+        t0 = time.perf_counter() if on else 0.0  # not record t0=0.0
         array.block_until_ready()
+        if on:
+            _metrics.ENGINE_WAITS.inc(kind="wait_for_var")
+            _metrics.ENGINE_WAIT_SECONDS.inc(time.perf_counter() - t0)
 
 
 def wait_for_all() -> None:
@@ -62,6 +69,8 @@ def wait_for_all() -> None:
     PJRT has no global barrier; jax.effects_barrier() drains pending effects
     and live arrays synchronize on access, so this blocks host-side work.
     """
+    on = _metrics.ENABLED  # captured once: an enable() mid-wait must not
+    t0 = time.perf_counter() if on else 0.0  # record t0=0.0
     try:
         jax.effects_barrier()
     except Exception:
@@ -70,6 +79,9 @@ def wait_for_all() -> None:
     l = lib_if_loaded()  # never trigger a native build inside a barrier
     if l is not None:
         l.MXTEngineWaitAll()
+    if on:
+        _metrics.ENGINE_WAITS.inc(kind="wait_for_all")
+        _metrics.ENGINE_WAIT_SECONDS.inc(time.perf_counter() - t0)
 
 
 def set_bulk_size(size: int) -> int:

@@ -28,6 +28,8 @@ import jax.numpy as jnp
 from .base import MXNetError, getenv
 from .context import Context
 from .ndarray import NDArray
+from .observability import metrics as _metrics
+from .observability.tracing import trace_span
 from .symbol.graph import GraphPlan
 from . import random as _random
 
@@ -63,6 +65,7 @@ class Executor:
         self._grad_names = [n for n in self._plan.arg_names
                             if self.grad_req.get(n, "null") != "null"]
         self._monitor = None
+        self._monitor_all = False
         self._outputs_cache: Optional[List[NDArray]] = None
         self._snapshot = None  # (arg_vals, aux_vals, key) of last forward
         self._pending_grads = None  # grads held by a train-mode forward()
@@ -115,9 +118,13 @@ class Executor:
     def _fwd(self):
         key = ("fwd", self._plan_key)
         if key not in self._jit_cache:
+            if _metrics.ENABLED:
+                _metrics.JIT_CACHE_MISSES.inc()
             plan = self._plan
             self._jit_cache[key] = jax.jit(
                 lambda a, x, k, t: plan.run(a, x, k, t), static_argnums=(3,))
+        elif _metrics.ENABLED:
+            _metrics.JIT_CACHE_HITS.inc()
         return self._jit_cache[key]
 
     @property
@@ -125,6 +132,8 @@ class Executor:
         key = ("fwd_bwd", self._plan_key, tuple(self._grad_names),
                tuple(sorted(self._rsp_grad_args)))
         if key not in self._jit_cache:
+            if _metrics.ENABLED:
+                _metrics.JIT_CACHE_MISSES.inc()
             plan = self._plan
             rsp_map = dict(self._rsp_grad_args)
             grad_names = [n for n in self._grad_names if n not in rsp_map]
@@ -189,6 +198,8 @@ class Executor:
                 return outs, new_aux, grads, rsp_grads
 
             self._jit_cache[key] = jax.jit(fb)
+        elif _metrics.ENABLED:
+            _metrics.JIT_CACHE_HITS.inc()
         return self._jit_cache[key]
 
     # -- public API ---------------------------------------------------------
@@ -204,6 +215,10 @@ class Executor:
                 # (src/executor exec_group _load_general)
                 if dev is not None and _device_of(val) != dev:
                     val = jax.device_put(val, dev)
+                    if _metrics.ENABLED:
+                        _metrics.DEVICE_PUTS.inc()
+                        _metrics.TRANSFER_BYTES.inc(
+                            getattr(val, "nbytes", 0))
                 self.arg_dict[k]._set_data(val)
             else:
                 raise MXNetError(f"unknown forward argument {k}")
@@ -236,12 +251,18 @@ class Executor:
             # fused program from the snapshot (same RNG key → same
             # dropout mask; aux restored → stats not double-updated).
             ograds = [None] * len(self._plan.out_refs)
-            outs, new_aux, grads, rsp_grads = self._fwd_bwd(
-                arg_vals, aux_vals, key, ograds)
+            if _metrics.ENABLED:
+                _metrics.XLA_LAUNCHES.inc(kind="fwd_bwd")
+            with trace_span("forward_backward", cat="executor"):
+                outs, new_aux, grads, rsp_grads = self._fwd_bwd(
+                    arg_vals, aux_vals, key, ograds)
             self._set_results(outs, new_aux)
             self._pending_grads = (grads, rsp_grads)
             return self._outputs_cache
-        outs, new_aux = self._fwd(arg_vals, aux_vals, key, is_train)
+        if _metrics.ENABLED:
+            _metrics.XLA_LAUNCHES.inc(kind="fwd")
+        with trace_span("forward", cat="executor"):
+            outs, new_aux = self._fwd(arg_vals, aux_vals, key, is_train)
         self._set_results(outs, new_aux)
         return self._outputs_cache
 
@@ -281,8 +302,11 @@ class Executor:
         else:
             ograds = [g._data if isinstance(g, NDArray) else jnp.asarray(g)
                       for g in out_grads]
-        outs, new_aux, grads, rsp_grads = self._fwd_bwd(
-            arg_vals, aux_vals, key, ograds)
+        if _metrics.ENABLED:
+            _metrics.XLA_LAUNCHES.inc(kind="fwd_bwd")
+        with trace_span("forward_backward", cat="executor"):
+            outs, new_aux, grads, rsp_grads = self._fwd_bwd(
+                arg_vals, aux_vals, key, ograds)
         if set_results:
             self._set_results(outs, new_aux)
         self._deposit_grads(grads, rsp_grads)
@@ -337,12 +361,19 @@ class Executor:
         stats = lowered.compile().memory_analysis()
         if stats is None:  # backend doesn't report (older PJRT)
             return {}
+        # jax < 0.5 CompiledMemoryStats lacks peak_memory_in_bytes;
+        # approximate with the live-buffer sum so the O(nnz)-peak
+        # comparisons stay meaningful
+        peak = getattr(stats, "peak_memory_in_bytes", None)
+        if peak is None:
+            peak = (stats.temp_size_in_bytes + stats.argument_size_in_bytes
+                    + stats.output_size_in_bytes + stats.alias_size_in_bytes)
         return {
             "temp_bytes": stats.temp_size_in_bytes,
             "argument_bytes": stats.argument_size_in_bytes,
             "output_bytes": stats.output_size_in_bytes,
             "alias_bytes": stats.alias_size_in_bytes,
-            "peak_bytes": stats.peak_memory_in_bytes,
+            "peak_bytes": peak,
             "generated_code_bytes": stats.generated_code_size_in_bytes,
         }
 
@@ -364,9 +395,19 @@ class Executor:
             if k in self.aux_dict:
                 self.aux_dict[k]._set_data(v)
         if self._monitor is not None:
+            if self._monitor_all:
+                # monitor_all taps inputs too (parity: MonitorExecution
+                # monitor_all records both op inputs and outputs; the
+                # fused-graph analog is the bound argument set)
+                for name, arr in self.arg_dict.items():
+                    self._monitor(name + "_input", arr)
+                    if _metrics.ENABLED:
+                        _metrics.MONITOR_STATS.inc(io="input")
             names = self._plan.symbol.list_outputs()
             for i, o in enumerate(self._outputs_cache):
                 self._monitor(names[i], o)
+                if _metrics.ENABLED:
+                    _metrics.MONITOR_STATS.inc(io="output")
 
     def _forward_placed(self, arg_vals, aux_vals, key, is_train):
         """group2ctx model parallelism: eager per-node execution with
@@ -451,6 +492,7 @@ class Executor:
 
     def set_monitor_callback(self, callback, monitor_all=False) -> None:
         self._monitor = callback
+        self._monitor_all = bool(monitor_all)
 
     @property
     def output_dict(self):

@@ -10,11 +10,13 @@ keeps its meaning (parallel prefetch depth).
 from __future__ import annotations
 
 import concurrent.futures as _futures
+import time as _time
 
 import numpy as _np
 
 from ... import ndarray as nd
 from ...ndarray import NDArray
+from ...observability import metrics as _metrics
 from .sampler import BatchSampler, RandomSampler, SequentialSampler
 
 
@@ -60,14 +62,28 @@ class DataLoader:
     def __iter__(self):
         if self._num_workers == 0:
             for batch in self._batch_sampler:
-                yield self._batchify_fn([self._dataset[idx] for idx in batch])
+                on = _metrics.ENABLED
+                t0 = _time.perf_counter() if on else 0.0
+                out = self._batchify_fn([self._dataset[idx] for idx in batch])
+                if on:
+                    _metrics.DATA_WAIT_SECONDS.observe(
+                        _time.perf_counter() - t0)
+                yield out
             return
         with _futures.ThreadPoolExecutor(self._num_workers) as pool:
             futures = [pool.submit(
                 lambda b: self._batchify_fn([self._dataset[i] for i in b]),
                 batch) for batch in self._batch_sampler]
             for fut in futures:
-                yield fut.result()
+                # time the consumer-side stall, not the worker's build:
+                # with enough workers this is ~0 even when batchify is slow
+                on = _metrics.ENABLED
+                t0 = _time.perf_counter() if on else 0.0
+                out = fut.result()
+                if on:
+                    _metrics.DATA_WAIT_SECONDS.observe(
+                        _time.perf_counter() - t0)
+                yield out
 
     def __len__(self):
         return len(self._batch_sampler)

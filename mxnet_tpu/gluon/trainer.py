@@ -6,6 +6,8 @@ Applies an Optimizer to a ParameterDict; kvstore-backed when requested so
 from __future__ import annotations
 
 from ..base import MXNetError
+from ..observability import metrics as _metrics
+from ..observability.tracing import trace_span
 from .. import optimizer as opt
 from ..model import _create_kvstore
 from .parameter import ParameterDict, Parameter
@@ -76,6 +78,10 @@ class Trainer:
         TPU hot path: all parameters update in O(1) XLA dispatches via
         KVStore.pushpull / FusedUpdater.update_all (replaces the reference's
         per-parameter kvstore push loop, gluon/trainer.py:191-226)."""
+        with trace_span("trainer_step", cat="optimizer"):
+            self._step(batch_size, ignore_stale_grad)
+
+    def _step(self, batch_size, ignore_stale_grad=False):
         if not self._kv_initialized:
             self._init_kvstore()
         self._optimizer.rescale_grad = self._scale / batch_size

@@ -11,6 +11,7 @@ import gzip
 import os
 import struct
 import threading
+import time as _time
 import queue as _queue
 from collections import namedtuple
 from typing import List, Optional
@@ -20,6 +21,7 @@ import numpy as _np
 from .base import MXNetError, getenv, np_dtype
 from . import ndarray as nd
 from .ndarray import NDArray
+from .observability import metrics as _metrics
 
 DataDesc = namedtuple("DataDesc", ["name", "shape", "dtype", "layout"])
 DataDesc.__new__.__defaults__ = (_np.float32, "NCHW")
@@ -157,6 +159,8 @@ class NDArrayIter(DataIter):
                 # device-resident source: slice/gather ON DEVICE — no
                 # host round trip per batch (the TPU-native fast path the
                 # bench and user pipelines rely on)
+                if _metrics.ENABLED:
+                    _metrics.XLA_LAUNCHES.inc(kind="data")
                 if contiguous and not self.shuffle:
                     out.append(src[self.cursor:self.cursor + self.batch_size])
                 else:
@@ -310,8 +314,19 @@ class PrefetchingIter(DataIter):
         self._queue = _queue.Queue(maxsize=self._depth)
         self._start()
 
+    # tells BaseModule.fit this iterator already records its own
+    # consumer-side stall — fit must not observe the same wait again
+    _self_timed_data_wait = True
+
     def next(self):
+        # the queue.get IS the pipeline stall: with a healthy prefetch
+        # depth this is ~0; a growing mxnet_data_batch_wait_seconds here
+        # means the input pipeline can't keep up with the device
+        on = _metrics.ENABLED
+        t0 = _time.perf_counter() if on else 0.0
         batch = self._queue.get()
+        if on:
+            _metrics.DATA_WAIT_SECONDS.observe(_time.perf_counter() - t0)
         if batch is None:
             raise StopIteration
         if isinstance(batch, BaseException):

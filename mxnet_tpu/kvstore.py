@@ -29,6 +29,7 @@ from __future__ import annotations
 
 import functools
 import pickle
+import time
 from typing import Dict, List, Optional
 
 import jax
@@ -38,7 +39,23 @@ import numpy as _np
 from .base import MXNetError
 from . import ndarray as nd
 from .ndarray import NDArray
+from .observability import metrics as _metrics
+from .observability.tracing import trace_span
 from . import optimizer as opt
+
+
+def _nd_bytes(v) -> int:
+    """Byte size of an NDArray / sparse NDArray / raw jax array.  Sparse
+    is checked FIRST: RowSparseNDArray._data is a densifying property, so
+    going through it would dispatch an O(N) scatter-add per accounted
+    value and report dense bytes instead of nnz bytes."""
+    iv = getattr(v, "_values", None)
+    if iv is not None:
+        ii = getattr(v, "_indices", None)
+        return int((getattr(iv, "nbytes", 0) or 0)
+                   + (getattr(ii, "nbytes", 0) or 0))
+    d = getattr(v, "_data", v)
+    return int(getattr(d, "nbytes", 0) or 0)
 
 
 def _handoff(src: NDArray, dst: NDArray) -> None:
@@ -213,6 +230,19 @@ class KVStore:
     def push(self, key, value, priority: int = 0) -> None:
         """Aggregate `value` (list = per-device copies) into the store.
         If an optimizer is set (update_on_kvstore), applies the update."""
+        if _metrics.ENABLED:
+            t0 = time.perf_counter()
+            with trace_span("kvstore_push", cat="kvstore"):
+                self._push_impl(key, value, priority)
+            # success path only: a failed push must not count as pushed
+            _metrics.KVSTORE_ALLREDUCE_SECONDS.observe(
+                time.perf_counter() - t0)
+            _metrics.KVSTORE_PUSH_BYTES.inc(sum(
+                _nd_bytes(v) for vl in _val_list(value) for v in vl))
+        else:
+            self._push_impl(key, value, priority)
+
+    def _push_impl(self, key, value, priority: int = 0) -> None:
         keys, _ = _key_list(key)
         vals = _val_list(value)
         from .ndarray.sparse import RowSparseNDArray
@@ -242,6 +272,22 @@ class KVStore:
         and pull is a pointer hand-off.  Semantics are identical to
         push(key, value); pull(key, out) — verified by tests/test_kvstore.py.
         """
+        if _metrics.ENABLED:
+            t0 = time.perf_counter()
+            with trace_span("kvstore_pushpull", cat="kvstore"):
+                self._pushpull_impl(key, value, out, priority)
+            # success path only: a failed pushpull must not count bytes
+            _metrics.KVSTORE_ALLREDUCE_SECONDS.observe(
+                time.perf_counter() - t0)
+            _metrics.KVSTORE_PUSH_BYTES.inc(sum(
+                _nd_bytes(v) for vl in _val_list(value) for v in vl))
+            if out is not None:
+                _metrics.KVSTORE_PULL_BYTES.inc(sum(
+                    _nd_bytes(o) for ol in _val_list(out) for o in ol))
+        else:
+            self._pushpull_impl(key, value, out, priority)
+
+    def _pushpull_impl(self, key, value, out=None, priority: int = 0) -> None:
         keys, _ = _key_list(key)
         vals = _val_list(value)
         for k in keys:
@@ -344,6 +390,8 @@ class KVStore:
 
             fn = jax.jit(_m, donate_argnums=(1,))
             self._merge_cache[fkey] = fn
+        if _metrics.ENABLED:
+            _metrics.XLA_LAUNCHES.inc(kind="kvstore_merge")
         merged, new_res = fn(vdata, res)
         if gc is not None:
             for k, nr in zip(keys, new_res):
@@ -359,6 +407,9 @@ class KVStore:
             src = self._store[k]
             for o in olist:
                 _handoff(src, o)
+            if _metrics.ENABLED:
+                _metrics.KVSTORE_PULL_BYTES.inc(
+                    _nd_bytes(src) * len(olist))
 
     def row_sparse_pull(self, key, out=None, priority=0, row_ids=None) -> None:
         """Pull only the rows in row_ids (parity: KVStore::PullRowSparse)."""
@@ -386,7 +437,8 @@ class KVStore:
         if self.num_workers <= 1 or self.type == "local":
             return merged
         from .parallel import collectives
-        return collectives.allreduce_hosts(merged)
+        with trace_span("kvstore_allreduce", cat="kvstore"):
+            return collectives.allreduce_hosts(merged)
 
     # -- optimizer plumbing --------------------------------------------------
     def set_optimizer(self, optimizer: "opt.Optimizer") -> None:

@@ -16,6 +16,8 @@ from .. import metric as _metric
 from .. import ndarray as nd
 from ..io import DataDesc
 from ..model import BatchEndParam
+from ..observability import metrics as _obs
+from ..observability.tracing import step_span, trace_span
 
 
 def _check_input_names(symbol, names, typename, throw):
@@ -150,21 +152,48 @@ class BaseModule:
         if not isinstance(eval_metric, _metric.EvalMetric):
             eval_metric = _metric.create(eval_metric)
 
+        global_step = 0
         for epoch in range(begin_epoch, num_epoch):
             tic = time.time()
             eval_metric.reset()
             nbatch = 0
             data_iter = iter(train_data)
             end_of_batch = False
-            next_data_batch = next(data_iter)
+            with trace_span("data_fetch", cat="io"):
+                next_data_batch = next(data_iter)
             while not end_of_batch:
                 data_batch = next_data_batch
                 if monitor is not None:
                     monitor.tic()
-                self.forward_backward(data_batch)
-                self.update()
+                # dispatch accounting: the per-step delta of compiled
+                # launches + device_puts over forward_backward+update is
+                # the round-2 O(1) invariant, published as a gauge.
+                # kind="data" launches are excluded: a PrefetchingIter
+                # producer thread issues them DURING the step, which
+                # would make the delta nondeterministic.
+                obs_on = _obs.ENABLED
+                if obs_on:
+                    d0 = _obs.step_dispatches()
+                with step_span(global_step):
+                    self.forward_backward(data_batch)
+                    with trace_span("update", cat="optimizer"):
+                        self.update()
+                if obs_on:
+                    _obs.FIT_STEP_DISPATCHES.set(_obs.step_dispatches() - d0)
+                global_step += 1
                 try:
-                    next_data_batch = next(data_iter)
+                    # iterators that time their own consumer-side stall
+                    # (PrefetchingIter) must not be counted again here
+                    if obs_on and not getattr(
+                            data_iter, "_self_timed_data_wait", False):
+                        t0 = time.perf_counter()
+                        with trace_span("data_fetch", cat="io"):
+                            next_data_batch = next(data_iter)
+                        _obs.DATA_WAIT_SECONDS.observe(
+                            time.perf_counter() - t0)
+                    else:
+                        with trace_span("data_fetch", cat="io"):
+                            next_data_batch = next(data_iter)
                     self.prepare(next_data_batch)
                 except StopIteration:
                     end_of_batch = True

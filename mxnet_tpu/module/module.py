@@ -21,6 +21,8 @@ from ..context import Context, cpu
 from ..initializer import InitDesc, Uniform
 from .. import ndarray as nd
 from ..io import DataDesc
+from ..observability import metrics as _metrics
+from ..observability.tracing import trace_span
 from .. import optimizer as opt
 from ..model import _create_kvstore, load_checkpoint, save_checkpoint
 from .base_module import BaseModule, _check_input_names
@@ -399,8 +401,11 @@ class Module(BaseModule):
             import numpy as _np
             want = getattr(tgt._data, "sharding", None) \
                 or tgt.context.jax_device()
-            tgt._set_data(jax.device_put(
-                _np.asarray(arr, dtype=tgt.dtype), want))
+            val = _np.asarray(arr, dtype=tgt.dtype)
+            if _metrics.ENABLED:
+                _metrics.DEVICE_PUTS.inc()
+                _metrics.TRANSFER_BYTES.inc(val.nbytes)
+            tgt._set_data(jax.device_put(val, want))
 
     def _set_batch(self, data_batch, is_train):
         for name, arr in zip(self._data_names, data_batch.data):
@@ -560,9 +565,13 @@ class Module(BaseModule):
         states = {n: upd._state_data(upd.states[ukeys[n]])
                   for n in snames}
         xs = {n: arg_vals[n] for n in feed if n in arg_vals}
-        outs, new_aux, new_p, new_s, nts = fs["fn"](
-            params, states, aux_vals, xs, _random.next_key(),
-            lrs, wds, ts)
+        if _metrics.ENABLED:
+            _metrics.XLA_LAUNCHES.inc(kind="fused_step")
+            _metrics.OPTIMIZER_STEPS.inc()
+        with trace_span("fused_train_step", cat="executor"):
+            outs, new_aux, new_p, new_s, nts = fs["fn"](
+                params, states, aux_vals, xs, _random.next_key(),
+                lrs, wds, ts)
         commit_ts(nts)
 
         kv_store = (self._kvstore._store

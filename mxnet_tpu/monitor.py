@@ -12,7 +12,8 @@ from .ndarray import NDArray
 
 
 class Monitor:
-    def __init__(self, interval, stat_func=None, pattern=".*", sort=False):
+    def __init__(self, interval, stat_func=None, pattern=".*", sort=False,
+                 monitor_all=False):
         if stat_func is None:
             def asum_stat(x):
                 return nd.norm(x) / sqrt(x.size)
@@ -25,6 +26,9 @@ class Monitor:
         self.exes = []
         self.re_prog = re.compile(pattern)
         self.sort = sort
+        # parity: monitor.py Monitor(monitor_all=...) — record stats for
+        # executor inputs as well as outputs
+        self.monitor_all = bool(monitor_all)
 
     def stat_helper(self, name, array):
         if not self.activated or not self.re_prog.match(name):
@@ -32,7 +36,8 @@ class Monitor:
         self.queue.append((self.step, name, self.stat_func(array)))
 
     def install(self, exe):
-        exe.set_monitor_callback(self.stat_helper)
+        exe.set_monitor_callback(self.stat_helper,
+                                 monitor_all=self.monitor_all)
         self.exes.append(exe)
 
     def tic(self):

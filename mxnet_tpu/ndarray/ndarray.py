@@ -101,8 +101,10 @@ class NDArray:
 
     # -- sync / export ------------------------------------------------------
     def wait_to_read(self) -> None:
-        """Parity: NDArray::WaitToRead — block until the buffer is computed."""
-        self._data.block_until_ready() if hasattr(self._data, "block_until_ready") else None
+        """Parity: NDArray::WaitToRead — block until the buffer is
+        computed (via the engine, so the stall is metered)."""
+        from .. import engine as _engine
+        _engine.wait_for_var(self._data)
 
     wait_to_write = wait_to_read
 

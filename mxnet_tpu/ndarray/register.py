@@ -91,7 +91,7 @@ def invoke(op_name: str, ndarray_inputs, kwargs, out=None):
 
     recording = autograd.is_recording() and op.differentiable and op.mutates_input is None
     vjp_fn = None
-    profiling = _profiler.is_running()
+    profiling = _profiler.is_recording()
     t0 = _time.perf_counter_ns() if profiling else 0
     if recording and op_name == "Embedding" and params.get("sparse_grad"):
         # rows-only weight gradient (parity: rsp embedding grad,

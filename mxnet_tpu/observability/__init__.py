@@ -1,0 +1,45 @@
+"""Runtime-wide observability: structured tracing + metrics + dispatch
+accounting (the TPU redesign of the reference's engine profiler,
+`src/engine/profiler.cc`).
+
+The reference wired per-op exec stats into the engine because a training
+stack you cannot see cannot be optimized — the single worst perf bug in
+this port (193 `jax.device_put` RPCs per Module.fit step through the TPU
+tunnel, round 2) was invisible until dispatches were hand-counted.  This
+package makes that visibility a product API:
+
+  - `mxnet_tpu.observability.metrics` — a process-wide registry of
+    counters / gauges / histograms (XLA program launches by kind,
+    device_put count + transfer bytes, jit cache hits/misses, engine
+    wait stalls, kvstore push/pull bytes + allreduce latency, dataloader
+    batch-wait time, HBM usage) with Prometheus-text and JSON exporters.
+  - `mxnet_tpu.observability.tracing` — `with trace_span("forward"):`
+    spans that land BOTH in the python-side Chrome-trace timeline
+    (`profiler._events`) and in the XLA xplane trace
+    (`jax.profiler.TraceAnnotation`), so host spans line up with device
+    ops in TensorBoard/Perfetto.
+  - `dispatch_counts()` — the queryable per-kind XLA-launch/transfer
+    tally that `tests/test_dispatch_count.py` pins as an invariant.
+
+Overhead discipline: every hot-path hook is guarded by the module-level
+`metrics.ENABLED` flag (env `MXNET_METRICS_ENABLED`, default on; set 0
+to compile the whole layer down to one boolean test per hook — no dict
+allocation, no label formatting, no timestamps).
+"""
+from __future__ import annotations
+
+from . import metrics
+from . import tracing
+from .metrics import (Counter, Gauge, Histogram, MetricsRegistry, REGISTRY,
+                      enabled, enable, disable, dispatch_counts,
+                      step_dispatches, snapshot, render_prometheus,
+                      render_json, hbm_stats)
+from .tracing import trace_span, step_span, annotate
+
+__all__ = [
+    "metrics", "tracing", "Counter", "Gauge", "Histogram",
+    "MetricsRegistry", "REGISTRY", "enabled", "enable", "disable",
+    "dispatch_counts", "step_dispatches", "snapshot",
+    "render_prometheus", "render_json", "hbm_stats",
+    "trace_span", "step_span", "annotate",
+]

@@ -1,0 +1,438 @@
+"""Metrics registry: counters, gauges, histograms with labels +
+Prometheus-text / JSON exporters.
+
+Design rules (set by the round-2 regression this subsystem exists to
+catch — instrumentation must never become the overhead it measures):
+
+  - module-level fast-path flag: every runtime hook reads `ENABLED`
+    (plain module global) before touching a metric, so
+    MXNET_METRICS_ENABLED=0 costs one boolean test per hook;
+  - stable identity: metrics are created ONCE at import and looked up by
+    attribute, never by name on the hot path — `inc()` on the unlabeled
+    fast path is a single float add, no dict allocation;
+  - on-demand expensive data: HBM usage (`device.memory_stats()`) is
+    sampled inside `collect()`/`snapshot()`, never per-step.
+
+Prometheus text format follows the exposition format spec close enough
+for a scrape endpoint (`# TYPE` lines, `{label="v"}` selectors,
+histogram `_bucket`/`_sum`/`_count` series with cumulative `le`).
+"""
+from __future__ import annotations
+
+import json as _json
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from ..base import getenv
+
+# -- the fast-path switch ----------------------------------------------------
+# Hooks across engine/executor/kvstore/io read this module global directly:
+#   if metrics.ENABLED: metrics.XLA_LAUNCHES.inc(...)
+# bool default activates getenv's tolerant parsing ("0"/"false"/"" off)
+ENABLED: bool = getenv("MXNET_METRICS_ENABLED", True)
+
+
+def enabled() -> bool:
+    return ENABLED
+
+
+def enable() -> None:
+    global ENABLED
+    ENABLED = True
+
+
+def disable() -> None:
+    global ENABLED
+    ENABLED = False
+
+
+# -- metric primitives -------------------------------------------------------
+# One shared mutation lock: hooks fire from the training thread AND from
+# data-pipeline producer threads (PrefetchingIter, DataLoader pools); an
+# unguarded read-modify-write would drop increments and corrupt the
+# exact-count invariant dispatch_counts() advertises.  Contention is a
+# few acquisitions per training step — noise next to an XLA dispatch.
+_MUT_LOCK = threading.Lock()
+
+
+def _label_key(labels: dict) -> Tuple:
+    return tuple(sorted(labels.items()))
+
+
+class Metric:
+    """Base: name + help + label-set → value(s)."""
+
+    kind = "untyped"
+
+    def __init__(self, name: str, help: str = "", registry=None):
+        self.name = name
+        self.help = help
+        (registry if registry is not None else REGISTRY)._register(self)
+
+    def reset(self) -> None:
+        raise NotImplementedError
+
+    def samples(self) -> List[Tuple[str, Tuple, float]]:
+        """[(series_name, label_items, value)] for the exporters."""
+        raise NotImplementedError
+
+
+class Counter(Metric):
+    """Monotonic counter.  The unlabeled path is one float add (hot-path
+    safe); labeled children live in a dict keyed by sorted label items."""
+
+    kind = "counter"
+
+    def __init__(self, name, help="", registry=None):
+        self._value = 0.0
+        self._children: Dict[Tuple, float] = {}
+        super().__init__(name, help, registry)
+
+    def inc(self, value: float = 1.0, **labels) -> None:
+        if labels:
+            k = _label_key(labels)
+            with _MUT_LOCK:
+                self._children[k] = self._children.get(k, 0.0) + value
+        else:
+            with _MUT_LOCK:
+                self._value += value
+
+    @property
+    def value(self) -> float:
+        # list() snapshots in one GIL-atomic C copy: hook threads may
+        # insert a new label key while we read
+        return self._value + sum(list(self._children.values()))
+
+    def get(self, **labels) -> float:
+        return self._children.get(_label_key(labels), 0.0) if labels \
+            else self._value
+
+    def reset(self) -> None:
+        self._value = 0.0
+        self._children.clear()
+
+    def samples(self):
+        out = []
+        if self._value or not self._children:
+            out.append((self.name, (), self._value))
+        for k, v in sorted(list(self._children.items())):
+            out.append((self.name, k, v))
+        return out
+
+
+class Gauge(Metric):
+    """Point-in-time value; optional callback makes it computed-on-read
+    (used for HBM usage so device RPCs only happen at export time)."""
+
+    kind = "gauge"
+
+    def __init__(self, name, help="", registry=None, fn=None):
+        self._value = 0.0
+        self._children: Dict[Tuple, float] = {}
+        self._fn = fn
+        super().__init__(name, help, registry)
+
+    def set(self, value: float, **labels) -> None:
+        if labels:
+            self._children[_label_key(labels)] = float(value)
+        else:
+            self._value = float(value)
+
+    def inc(self, value: float = 1.0, **labels) -> None:
+        if labels:
+            k = _label_key(labels)
+            with _MUT_LOCK:
+                self._children[k] = self._children.get(k, 0.0) + value
+        else:
+            with _MUT_LOCK:
+                self._value += value
+
+    def dec(self, value: float = 1.0, **labels) -> None:
+        self.inc(-value, **labels)
+
+    def get(self, **labels) -> float:
+        if self._fn is not None and not labels:
+            return float(self._fn())
+        return self._children.get(_label_key(labels), 0.0) if labels \
+            else self._value
+
+    def reset(self) -> None:
+        self._value = 0.0
+        self._children.clear()
+
+    def samples(self):
+        if self._fn is not None:
+            try:
+                return [(self.name, (), float(self._fn()))]
+            except Exception:
+                return [(self.name, (), 0.0)]
+        out = []
+        if self._value or not self._children:
+            out.append((self.name, (), self._value))
+        for k, v in sorted(list(self._children.items())):
+            out.append((self.name, k, v))
+        return out
+
+
+# default: latency-ish spread from 100us to ~100s
+_DEFAULT_BUCKETS = (1e-4, 5e-4, 1e-3, 5e-3, 1e-2, 5e-2, 0.1, 0.5, 1.0,
+                    5.0, 10.0, 60.0)
+
+
+class Histogram(Metric):
+    """Fixed-bucket histogram (cumulative `le` buckets on export, like
+    Prometheus); tracks sum + count so mean is recoverable."""
+
+    kind = "histogram"
+
+    def __init__(self, name, help="", buckets=_DEFAULT_BUCKETS,
+                 registry=None):
+        self.buckets = tuple(sorted(buckets))
+        self._counts = [0] * (len(self.buckets) + 1)  # +inf tail
+        self._sum = 0.0
+        self._count = 0
+        super().__init__(name, help, registry)
+
+    def observe(self, value: float) -> None:
+        with _MUT_LOCK:
+            self._sum += value
+            self._count += 1
+            for i, b in enumerate(self.buckets):
+                if value <= b:
+                    self._counts[i] += 1
+                    return
+            self._counts[-1] += 1
+
+    @property
+    def count(self) -> int:
+        return self._count
+
+    @property
+    def sum(self) -> float:
+        return self._sum
+
+    @property
+    def mean(self) -> float:
+        return self._sum / self._count if self._count else 0.0
+
+    def reset(self) -> None:
+        self._counts = [0] * (len(self.buckets) + 1)
+        self._sum = 0.0
+        self._count = 0
+
+    def samples(self):
+        out, cum = [], 0
+        for b, c in zip(self.buckets, self._counts):
+            cum += c
+            out.append((self.name + "_bucket", (("le", repr(float(b))),), cum))
+        cum += self._counts[-1]
+        out.append((self.name + "_bucket", (("le", "+Inf"),), cum))
+        out.append((self.name + "_sum", (), self._sum))
+        out.append((self.name + "_count", (), self._count))
+        return out
+
+
+class MetricsRegistry:
+    """Name → Metric; collect/export/reset over the whole set."""
+
+    def __init__(self):
+        self._metrics: Dict[str, Metric] = {}
+        self._lock = threading.Lock()
+
+    def _register(self, metric: Metric) -> None:
+        with self._lock:
+            if metric.name in self._metrics:
+                raise ValueError(f"duplicate metric {metric.name}")
+            self._metrics[metric.name] = metric
+
+    def get(self, name: str) -> Optional[Metric]:
+        return self._metrics.get(name)
+
+    def metrics(self) -> List[Metric]:
+        return list(self._metrics.values())
+
+    def reset(self) -> None:
+        for m in self._metrics.values():
+            m.reset()
+
+    # -- exporters ----------------------------------------------------------
+    def render_prometheus(self) -> str:
+        lines = []
+        for m in self._metrics.values():
+            if m.help:
+                lines.append(f"# HELP {m.name} {m.help}")
+            lines.append(f"# TYPE {m.name} {m.kind}")
+            for series, labels, value in m.samples():
+                sel = ""
+                if labels:
+                    sel = "{" + ",".join(f'{k}="{v}"' for k, v in labels) + "}"
+                v = repr(float(value)) if isinstance(value, float) \
+                    else str(value)
+                lines.append(f"{series}{sel} {v}")
+        return "\n".join(lines) + "\n"
+
+    def render_json(self) -> str:
+        return _json.dumps(self.to_dict(), sort_keys=True)
+
+    def to_dict(self) -> dict:
+        out = {}
+        for m in self._metrics.values():
+            if isinstance(m, Histogram):
+                out[m.name] = {"type": "histogram", "sum": m.sum,
+                               "count": m.count, "mean": m.mean,
+                               "buckets": {repr(float(b)): c for b, c in
+                                           zip(m.buckets, m._counts)},
+                               "inf": m._counts[-1]}
+            else:
+                series = {}
+                for name_, labels, value in m.samples():
+                    key = ",".join(f"{k}={v}" for k, v in labels) or "_"
+                    series[key] = value
+                out[m.name] = {"type": m.kind, "values": series}
+        return out
+
+
+REGISTRY = MetricsRegistry()
+
+# -- the runtime metric set ---------------------------------------------------
+# Stable module-level objects: hooks reference these directly (no registry
+# lookup on the hot path) and tests may assert identity stays put across
+# enable/disable flips.
+XLA_LAUNCHES = Counter(
+    "mxnet_xla_launches_total",
+    "Compiled XLA program launches by kind (fwd, fwd_bwd, fused_step, "
+    "kvstore_merge, optimizer, data)")
+DEVICE_PUTS = Counter(
+    "mxnet_device_put_total",
+    "Explicit jax.device_put host->device / device->device transfers")
+TRANSFER_BYTES = Counter(
+    "mxnet_device_transfer_bytes_total",
+    "Bytes moved by instrumented device transfers")
+JIT_CACHE_HITS = Counter(
+    "mxnet_jit_cache_hits_total",
+    "Executor compiled-entry-point cache hits")
+JIT_CACHE_MISSES = Counter(
+    "mxnet_jit_cache_misses_total",
+    "Executor compiled-entry-point cache misses (new jit closures)")
+ENGINE_WAITS = Counter(
+    "mxnet_engine_wait_total",
+    "Engine blocking waits by kind (wait_for_var, wait_for_all)")
+ENGINE_WAIT_SECONDS = Counter(
+    "mxnet_engine_wait_seconds_total",
+    "Seconds spent blocked in engine waits")
+KVSTORE_PUSH_BYTES = Counter(
+    "mxnet_kvstore_push_bytes_total",
+    "Gradient bytes pushed into the kvstore")
+KVSTORE_PULL_BYTES = Counter(
+    "mxnet_kvstore_pull_bytes_total",
+    "Parameter bytes pulled out of the kvstore")
+KVSTORE_ALLREDUCE_SECONDS = Histogram(
+    "mxnet_kvstore_allreduce_seconds",
+    "Wall-clock latency of kvstore push/pushpull aggregation "
+    "(includes cross-host allreduce when num_workers > 1)")
+DATA_WAIT_SECONDS = Histogram(
+    "mxnet_data_batch_wait_seconds",
+    "Time the training loop waited for the next data batch")
+OPTIMIZER_STEPS = Counter(
+    "mxnet_optimizer_steps_total",
+    "Optimizer step applications (fused multi-tensor update = 1)")
+MONITOR_STATS = Counter(
+    "mxnet_monitor_stats_total",
+    "Executor monitor-callback stat records, by io direction")
+FIT_STEP_DISPATCHES = Gauge(
+    "mxnet_fit_step_dispatches",
+    "XLA program launches + device_puts issued by the most recent "
+    "steady-state Module.fit step, excluding async data-pipeline "
+    "launches (the round-2 O(1)-dispatch invariant, now queryable)")
+
+
+def _hbm_stats_all() -> List[dict]:
+    """Per-device memory_stats() — TPU backends report bytes_in_use /
+    peak_bytes_in_use / bytes_limit; CPU returns nothing."""
+    out = []
+    try:
+        import jax
+        for d in jax.local_devices():
+            try:
+                s = d.memory_stats()
+            except Exception:
+                s = None
+            if s:
+                out.append({"device": str(d.id), "platform": d.platform,
+                            **{k: v for k, v in s.items()
+                               if isinstance(v, (int, float))}})
+    except Exception:
+        pass
+    return out
+
+
+def hbm_stats() -> List[dict]:
+    return _hbm_stats_all()
+
+
+def _hbm_in_use_total() -> float:
+    return float(sum(s.get("bytes_in_use", 0) for s in _hbm_stats_all()))
+
+
+HBM_BYTES_IN_USE = Gauge(
+    "mxnet_hbm_bytes_in_use",
+    "Sum of bytes_in_use over jax.local_devices() (sampled at export)",
+    fn=_hbm_in_use_total)
+
+
+# -- product API --------------------------------------------------------------
+def step_dispatches() -> float:
+    """Launch + transfer tally EXCLUDING kind=\"data\" launches — the
+    windowed delta `Module.fit` publishes as FIT_STEP_DISPATCHES.  Data
+    launches are excluded because a PrefetchingIter producer thread
+    issues them mid-step, which would make the per-step delta
+    nondeterministic."""
+    return (XLA_LAUNCHES.value - XLA_LAUNCHES.get(kind="data")
+            + DEVICE_PUTS.value)
+
+
+def dispatch_counts() -> Dict[str, float]:
+    """Per-kind dispatch tally since process start (or the last
+    `REGISTRY.reset()`): compiled-program launches keyed `xla:<kind>`
+    plus `device_put`.  The per-step delta of this dict is the invariant
+    `tests/test_dispatch_count.py` pins; `fit_step_dispatches` (a gauge,
+    also in `snapshot()`) carries the most recent fit step's total."""
+    out: Dict[str, float] = {}
+    # list() snapshots the dict in one C-level copy (GIL-atomic) so a
+    # producer thread inserting a new label kind mid-call cannot raise
+    # "dictionary changed size during iteration"
+    for labels, v in list(XLA_LAUNCHES._children.items()):
+        kind = dict(labels).get("kind", "other")
+        out["xla:" + kind] = out.get("xla:" + kind, 0.0) + v
+    if XLA_LAUNCHES._value:
+        out["xla:other"] = out.get("xla:other", 0.0) + XLA_LAUNCHES._value
+    out["device_put"] = DEVICE_PUTS.value
+    out["total"] = XLA_LAUNCHES.value + DEVICE_PUTS.value
+    return out
+
+
+def snapshot() -> dict:
+    """One JSON-able dict with the numbers a perf PR needs: dispatch
+    accounting, transfer volume, data-wait, engine stalls, HBM."""
+    return {
+        "dispatch_counts": dispatch_counts(),
+        "fit_step_dispatches": FIT_STEP_DISPATCHES.get(),
+        "transfer_bytes": TRANSFER_BYTES.value,
+        "kvstore_push_bytes": KVSTORE_PUSH_BYTES.value,
+        "kvstore_pull_bytes": KVSTORE_PULL_BYTES.value,
+        "data_wait_ms_total": DATA_WAIT_SECONDS.sum * 1e3,
+        "data_wait_ms_mean": DATA_WAIT_SECONDS.mean * 1e3,
+        "engine_wait_seconds": ENGINE_WAIT_SECONDS.value,
+        "jit_cache": {"hits": JIT_CACHE_HITS.value,
+                      "misses": JIT_CACHE_MISSES.value},
+        "optimizer_steps": OPTIMIZER_STEPS.value,
+        "hbm": hbm_stats(),
+    }
+
+
+def render_prometheus() -> str:
+    return REGISTRY.render_prometheus()
+
+
+def render_json() -> str:
+    return REGISTRY.render_json()

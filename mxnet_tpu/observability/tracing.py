@@ -1,0 +1,111 @@
+"""Structured tracing spans that land in BOTH timelines.
+
+`with trace_span("forward"):` emits
+  - a python-side Chrome-trace complete event ("X") into the profiler's
+    `_events` buffer (dumped by `profiler.dump_profile()`), and
+  - a `jax.profiler.TraceAnnotation` scope, so the same span shows up
+    inside the XLA xplane trace next to the device ops it covers
+    (TensorBoard / Perfetto line the two up by wall-clock).
+
+`step_span(step)` additionally uses `jax.profiler.StepTraceAnnotation`,
+which TensorBoard's profile plugin uses for per-step breakdowns.
+
+Fast path: when the profiler is stopped, a span is ONE predicate test —
+no timestamps, no annotation objects, no allocation beyond the generator
+frame.  Nesting is expressed the Chrome-trace way: events on the same
+pid/tid whose [ts, ts+dur] ranges contain each other render nested.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+
+_tls = threading.local()
+_tid_lock = threading.Lock()
+_tid_map: dict = {}
+
+
+def _tid() -> int:
+    """Small stable per-thread id (Chrome trace tids are more readable
+    than 140-bit thread idents)."""
+    t = getattr(_tls, "tid", None)
+    if t is None:
+        with _tid_lock:
+            t = _tid_map.setdefault(threading.get_ident(), len(_tid_map))
+        _tls.tid = t
+    return t
+
+
+def _depth() -> int:
+    return getattr(_tls, "depth", 0)
+
+
+def _profiler():
+    from .. import profiler
+    return profiler
+
+
+def _annotation(name: str):
+    try:
+        import jax
+        return jax.profiler.TraceAnnotation(name)
+    except Exception:
+        return None
+
+
+@contextlib.contextmanager
+def trace_span(name: str, cat: str = "runtime"):
+    """Record `name` as a nested span on both timelines while the
+    profiler runs; a no-op predicate test otherwise."""
+    prof = _profiler()
+    if not prof.is_recording():
+        yield
+        return
+    ann = _annotation(name)
+    if ann is not None:
+        ann.__enter__()
+    _tls.depth = _depth() + 1
+    start = time.perf_counter() * 1e6
+    try:
+        yield
+    finally:
+        end = time.perf_counter() * 1e6
+        _tls.depth -= 1
+        if ann is not None:
+            ann.__exit__(None, None, None)
+        prof.record_event(name, start, end, cat=cat, tid=_tid(),
+                          args={"depth": _depth()})
+
+
+@contextlib.contextmanager
+def step_span(step_num: int, name: str = "train"):
+    """Step-boundary annotation: xplane StepTraceAnnotation (feeds
+    TensorBoard's per-step breakdown) + a Chrome-trace span."""
+    prof = _profiler()
+    if not prof.is_recording():
+        yield
+        return
+    ann = None
+    try:
+        import jax
+        ann = jax.profiler.StepTraceAnnotation(name, step_num=step_num)
+        ann.__enter__()
+    except Exception:
+        ann = None
+    start = time.perf_counter() * 1e6
+    try:
+        yield
+    finally:
+        end = time.perf_counter() * 1e6
+        if ann is not None:
+            ann.__exit__(None, None, None)
+        prof.record_event(f"{name}_step", start, end, cat="step",
+                          tid=_tid(), args={"step": step_num})
+
+
+def annotate(name: str):
+    """Bare xplane annotation (no python-side event) — for spans that
+    only matter relative to device ops."""
+    ann = _annotation(name)
+    return ann if ann is not None else contextlib.nullcontext()

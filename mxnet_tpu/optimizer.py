@@ -19,6 +19,8 @@ import jax.numpy as jnp
 from .base import MXNetError, Registry
 from . import ndarray as nd
 from .ndarray import NDArray
+from .observability import metrics as _metrics
+from .observability.tracing import trace_span
 
 _REG = Registry("optimizer")
 
@@ -808,6 +810,8 @@ class Updater:
 
     def __call__(self, index, grad, weight):
         self._ensure_state(index, weight)
+        if _metrics.ENABLED:
+            _metrics.OPTIMIZER_STEPS.inc()
         self.optimizer.update_multi_precision(index, weight, grad,
                                               self.states[index])
 
@@ -979,7 +983,11 @@ class FusedUpdater(Updater):
             # not donated — executor snapshots may still alias their buffers
             fn = jax.jit(_apply, donate_argnums=(2,))
             self._fn_cache[key] = fn
-        nws, nss, nts = fn(wvals, gvals, svals, lrs, wds, ts)
+        if _metrics.ENABLED:
+            _metrics.XLA_LAUNCHES.inc(kind="optimizer")
+            _metrics.OPTIMIZER_STEPS.inc()
+        with trace_span("optimizer_update_all", cat="optimizer"):
+            nws, nss, nts = fn(wvals, gvals, svals, lrs, wds, ts)
         commit_ts(nts)
         for k, i in enumerate(indices):
             weights[k]._set_data(nws[k])

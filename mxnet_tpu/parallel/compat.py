@@ -1,0 +1,23 @@
+"""jax version compat for the parallel toolkit.
+
+`shard_map` moved from `jax.experimental.shard_map` to top-level
+`jax.shard_map` (jax 0.6) and renamed its replication-check kwarg from
+`check_rep` to `check_vma` (jax 0.7).  Call sites in this package use
+the modern spelling; this shim maps it back on older jax.
+"""
+from __future__ import annotations
+
+import inspect
+
+try:  # jax >= 0.6
+    from jax import shard_map as _shard_map
+except ImportError:
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+if "check_vma" in inspect.signature(_shard_map).parameters:
+    shard_map = _shard_map
+else:
+    def shard_map(f, *args, check_vma=None, **kwargs):
+        if check_vma is not None:
+            kwargs["check_rep"] = check_vma
+        return _shard_map(f, *args, **kwargs)

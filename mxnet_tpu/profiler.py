@@ -1,12 +1,22 @@
 """Profiler (parity: python/mxnet/profiler.py over src/engine/profiler.cc).
 
-The reference recorded per-operator exec stats in the engine and dumped
-Chrome-trace JSON.  On TPU, XLA/PJRT profiling is the native mechanism:
-`profiler_set_state('run')` starts a jax profiler trace (xplane, viewable in
-TensorBoard/Perfetto and convertible to chrome trace); `dump_profile()` stops
-it.  The MXNET_PROFILER_AUTOSTART env var is honored (initialize.cc parity).
-Additionally a lightweight python-side op timeline records eager op invokes
-and can be dumped as chrome-trace JSON to `filename` for API parity.
+Now a façade over `mxnet_tpu.observability`: the span API
+(`observability.tracing.trace_span`) and the runtime metrics registry
+(`observability.metrics`) feed the same two timelines this module owns —
+
+  - python side: a Chrome-trace event buffer (`_events`) of eager op
+    invokes and `trace_span` scopes, dumped by `dump_profile()`;
+  - device side: the XLA xplane trace — `profiler_set_state('run')`
+    starts `jax.profiler.start_trace` (viewable in TensorBoard/Perfetto);
+    spans emit `jax.profiler.TraceAnnotation` so both line up.
+
+The MXNet parity API is unchanged: `set_config`/`set_state`/
+`dump_profile`/`pause`/`resume`, plus the MXNET_PROFILER_AUTOSTART env
+(initialize.cc parity).  `pause()` only SUPPRESSES recording
+(MXProfilePause parity) — previously recorded events survive a
+pause/resume cycle; only a stop→run transition clears the buffer.
+`dump_profile()` writes atomically (tmp + os.replace) so a crash
+mid-dump never leaves a truncated trace file.
 """
 from __future__ import annotations
 
@@ -22,6 +32,7 @@ _config = {"profile_all": False, "profile_symbolic": True,
            "profile_imperative": False, "profile_memory": False,
            "profile_api": False, "filename": "profile.json"}
 _state = "stop"
+_paused = False
 _events: List[dict] = []
 _trace_dir: Optional[str] = None
 
@@ -37,8 +48,12 @@ set_config = profiler_set_config
 
 
 def profiler_set_state(state="stop"):
-    """Parity: MXSetProfilerState — 'run' starts tracing, 'stop' ends it."""
-    global _state, _trace_dir
+    """Parity: MXSetProfilerState — 'run' starts tracing, 'stop' ends it.
+
+    Only the stop→run transition clears the event buffer and opens a
+    fresh xplane trace dir; pause()/resume() never pass through here
+    (MXProfilePause parity: pause suppresses, it does not restart)."""
+    global _state, _trace_dir, _paused
     if state == "run" and _state != "run":
         _trace_dir = os.path.splitext(_config["filename"])[0] + "_xplane"
         try:
@@ -47,6 +62,11 @@ def profiler_set_state(state="stop"):
         except Exception:
             _trace_dir = None
         _events.clear()
+        _paused = False
+    elif state == "run":
+        # run->run: at minimum un-pause (scripts written against the old
+        # pause()==stop behavior call set_state('run') to resume)
+        _paused = False
     elif state == "stop" and _state == "run":
         _stop_trace()
     _state = state
@@ -66,35 +86,79 @@ def _stop_trace():
         _trace_dir = None
 
 
-def record_event(name: str, start_us: float, end_us: float, cat="operator"):
-    """Engine hook: eager invokes call this when profiling is on."""
-    if _state == "run":
-        _events.append({"name": name, "cat": cat, "ph": "X",
-                        "ts": start_us, "dur": end_us - start_us,
-                        "pid": 0, "tid": 0})
+def record_event(name: str, start_us: float, end_us: float, cat="operator",
+                 tid: int = 0, args: Optional[dict] = None):
+    """Timeline hook: eager op invokes and `trace_span` scopes land here
+    as Chrome-trace complete events (suppressed while paused)."""
+    if _state == "run" and not _paused:
+        ev = {"name": name, "cat": cat, "ph": "X",
+              "ts": start_us, "dur": end_us - start_us,
+              "pid": 0, "tid": tid}
+        if args:
+            ev["args"] = args
+        _events.append(ev)
 
 
 def is_running() -> bool:
+    """Parity: profiler state is 'run' (paused still counts as running)."""
     return _state == "run"
 
 
+def is_recording() -> bool:
+    """True when events should actually be recorded: running AND not
+    paused — the predicate every hot-path hook tests first."""
+    return _state == "run" and not _paused
+
+
 def dump_profile():
-    """Parity: MXDumpProfile — write chrome-trace JSON of python-side events
-    (device-side detail lives in the xplane trace directory)."""
+    """Parity: MXDumpProfile — write chrome-trace JSON of python-side
+    events (device-side detail lives in the xplane trace directory).
+    Atomic: a crash mid-dump leaves the previous file intact, never a
+    truncated/invalid JSON."""
     global _state
     _stop_trace()
     _state = "stop"
-    with open(_config["filename"], "w") as f:
+    fname = _config["filename"]
+    tmp = fname + ".tmp"
+    with open(tmp, "w") as f:
         json.dump({"traceEvents": _events,
                    "displayTimeUnit": "ms"}, f)
+    os.replace(tmp, fname)
 
 
 def pause():
-    profiler_set_state("stop")
+    """Parity: MXProfilePause — suppress recording, keep everything
+    already recorded (and keep the profiler formally 'running')."""
+    global _paused
+    _paused = True
 
 
 def resume():
-    profiler_set_state("run")
+    """Parity: MXProfileResume — recording continues; previously
+    recorded events are preserved."""
+    global _paused
+    _paused = False
+
+
+# -- observability façade -----------------------------------------------------
+# The span API and metrics exporters live in mxnet_tpu.observability;
+# re-exported here so profiler-era user code finds the whole toolkit in
+# one namespace (mx.profiler.trace_span(...), mx.profiler.dump_metrics()).
+def trace_span(name: str, cat: str = "runtime"):
+    from .observability.tracing import trace_span as _ts
+    return _ts(name, cat=cat)
+
+
+def step_span(step_num: int, name: str = "train"):
+    from .observability.tracing import step_span as _ss
+    return _ss(step_num, name=name)
+
+
+def dump_metrics() -> dict:
+    """Snapshot of the runtime metrics registry (dispatch counts,
+    transfer bytes, data-wait, HBM) — see observability.metrics."""
+    from .observability import metrics as _m
+    return _m.snapshot()
 
 
 if getenv("MXNET_PROFILER_AUTOSTART", 0):
