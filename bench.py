@@ -100,6 +100,8 @@ def _emit(partial):
         out.update(mfu(v, kind=_STATE["chip"]))
     if "fused_step" in _STATE:
         out["fused_step"] = _STATE["fused_step"]
+    if _STATE.get("gluon_trainer") is not None:
+        out["gluon_trainer"] = _STATE["gluon_trainer"]
     if partial:
         out["partial"] = True
         out["phase"] = _STATE["phase"]
@@ -325,6 +327,78 @@ def _run():
     assert np.isfinite(losses).all(), losses
     final = float(np.mean(losses[-BATCHES_PER_EPOCH:]))
     assert final < max(losses[0] * 1.2, np.log(1000.0) + 0.5), losses
+
+    # fused-trainer A/B rider (tiny MLP, seconds; MXT_BENCH_GLUON=0 skips):
+    # lands the Gluon fast-path trajectory (MXNET_FUSED_TRAINER on/off) in
+    # the same BENCH JSON as the headline number, which is already durable
+    # in _STATE by this point — a rider failure must never cost it
+    if os.environ.get("MXT_BENCH_GLUON", "1") != "0":
+        _phase("gluon_trainer", EPOCH_S)
+        try:
+            _STATE["gluon_trainer"] = _gluon_trainer_leg(mx, ctx)
+        except Exception as e:  # noqa: BLE001
+            _STATE["gluon_trainer"] = {
+                "error": "%s: %s" % (type(e).__name__, str(e)[:200])}
+
+
+def _gluon_trainer_leg(mx, ctx):
+    """Fused vs legacy Gluon Trainer A/B: steps/s and the
+    mxnet_trainer_step_dispatches gauge for a 20-param dense hybridized
+    MLP — the bucketed-allreduce + one-program-update path vs the
+    reference-shaped per-key loop (MXNET_FUSED_TRAINER=0)."""
+    from mxnet_tpu import autograd, gluon
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu.observability import metrics as _m
+
+    rs = np.random.RandomState(0)
+    bs, steps = 256, 30
+    x = mx.nd.array(rs.normal(0, 1, (bs, 64)).astype("f"), ctx=ctx)
+    y = mx.nd.array(rs.normal(0, 1, (bs, 1)).astype("f"), ctx=ctx)
+    loss_fn = gluon.loss.L2Loss()
+    out = {}
+    prev = os.environ.get("MXNET_FUSED_TRAINER")
+    try:
+        for mode, flag in (("fused", "1"), ("legacy", "0")):
+            os.environ["MXNET_FUSED_TRAINER"] = flag
+            net = nn.HybridSequential()
+            with net.name_scope():
+                for _ in range(9):
+                    net.add(nn.Dense(64, activation="relu"))
+                net.add(nn.Dense(1))
+            net.hybridize()
+            net.initialize(mx.init.Xavier(), ctx=ctx)
+            trainer = gluon.Trainer(net.collect_params(), "sgd",
+                                    {"learning_rate": 0.01, "momentum": 0.9},
+                                    kvstore="tpu_sync",
+                                    update_on_kvstore=False)
+
+            def one_step():
+                with autograd.record():
+                    l = loss_fn(net(x), y)
+                l.backward()
+                trainer.step(bs)
+                return l
+
+            for _ in range(3):
+                last = one_step()
+            float(last.asnumpy().ravel()[0])  # compile+warmup sync
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                last = one_step()
+            float(last.asnumpy().ravel()[0])
+            dt = time.perf_counter() - t0
+            out[mode] = {
+                "steps_per_s": round(steps / dt, 2),
+                "samples_per_s": round(bs * steps / dt, 1),
+                "trainer_step_dispatches": _m.TRAINER_STEP_DISPATCHES.get(),
+                "allreduce_buckets": _m.ALLREDUCE_BUCKETS.get(),
+            }
+    finally:
+        if prev is None:
+            os.environ.pop("MXNET_FUSED_TRAINER", None)
+        else:
+            os.environ["MXNET_FUSED_TRAINER"] = prev
+    return out
 
 
 LOCK_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),

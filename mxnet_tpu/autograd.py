@@ -195,6 +195,7 @@ def backward(heads, head_grads=None, retain_graph: bool = False,
             return
         if k in grad_map:
             seen.add(id(ref))
+            ref._fresh_grad = True
             g = grad_map[k]
             if isinstance(ref._grad, RowSparseNDArray):
                 if not isinstance(g, _RspCot):

@@ -20,6 +20,7 @@ import numpy as _np
 
 from ..base import MXNetError
 from ..context import cpu
+from ..observability import metrics as _metrics
 from .. import ndarray as nd
 from ..ndarray import NDArray
 from .. import symbol as sym_mod
@@ -246,6 +247,11 @@ class CachedOp:
         arg_vals = {k: v._data for k, v in arg_arrays.items()}
         aux_vals = {k: v._data for k, v in aux_arrays.items()}
         key = _random.next_key()
+        if _metrics.ENABLED:
+            # the gluon analog of the executor's fwd/fwd_bwd accounting:
+            # a hybridized step is visible in dispatch_counts() as one
+            # xla:fwd plus (when recording) one xla:bwd at backward time
+            _metrics.XLA_LAUNCHES.inc(kind="fwd")
         outs, new_aux = self._fwd(arg_vals, aux_vals, key, is_train)
         out_nds = [NDArray(o, ctx) for o in outs]
         if autograd.is_recording():
@@ -256,6 +262,8 @@ class CachedOp:
             raw_outs = tuple(outs) + tuple(new_aux[k] for k in sorted(new_aux))
 
             def vjp_fn(cots):
+                if _metrics.ENABLED:
+                    _metrics.XLA_LAUNCHES.inc(kind="bwd")
                 return bwd_jit(primals, tuple(cots), aux_snapshot, key, is_train)
 
             autograd._record(None, [arg_arrays[n] for n in names], out_nds,

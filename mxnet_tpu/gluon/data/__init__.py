@@ -2,4 +2,5 @@
 from .dataset import Dataset, SimpleDataset, ArrayDataset, RecordFileDataset
 from .sampler import Sampler, SequentialSampler, RandomSampler, BatchSampler
 from .dataloader import DataLoader
+from .prefetcher import AsyncPrefetcher, prefetch_to_device
 from . import vision

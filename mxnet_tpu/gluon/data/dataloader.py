@@ -21,8 +21,20 @@ from .sampler import BatchSampler, RandomSampler, SequentialSampler
 
 
 def default_batchify_fn(data):
-    """Stack samples into a batch (parity: dataloader.default_batchify_fn)."""
+    """Stack samples into a batch (parity: dataloader.default_batchify_fn).
+
+    NDArray samples stack in ONE device-side dispatch — the old path paid
+    a per-sample `asnumpy()` device→host sync plus a re-upload, which made
+    batchification O(batch_size) blocking round trips on a tunneled TPU."""
     if isinstance(data[0], NDArray):
+        from ...ndarray.sparse import BaseSparseNDArray
+        if not any(isinstance(d, BaseSparseNDArray) for d in data):
+            import jax.numpy as jnp
+            if _metrics.ENABLED:
+                _metrics.XLA_LAUNCHES.inc(kind="data")
+            return NDArray(jnp.stack([d._data for d in data]),
+                           data[0].context)
+        # sparse samples: rows-only storage densifies through the host
         return nd.array(_np.stack([d.asnumpy() for d in data]))
     if isinstance(data[0], tuple):
         data = zip(*data)

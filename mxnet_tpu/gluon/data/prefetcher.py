@@ -1,0 +1,259 @@
+"""Prefetch-to-device pipeline: overlap the host→HBM transfer of batch N+1
+with the compute of batch N.
+
+The reference hides input latency with dmlc::ThreadedIter double-buffering
+(src/io/iter_prefetcher.h); the TPU analog is a background thread that
+`jax.device_put`s the NEXT batch while the current step's XLA programs run,
+so the training loop's queue.get is ~0 when the pipeline keeps up.  The
+consumer-side stall is measured by the `mxnet_prefetch_wait_seconds`
+histogram; transfers are accounted as kind="data" launches (excluded from
+per-step dispatch deltas — they are issued mid-step by the producer thread)
+plus `mxnet_device_transfer_bytes_total`.
+
+`AsyncPrefetcher` is the shared core (also backing `io.PrefetchingIter`);
+`prefetch_to_device(it, depth=2)` is the user-facing wrapper for any batch
+iterable (DataLoader, DataIter, generator).
+"""
+from __future__ import annotations
+
+import queue as _queue
+import threading
+import time as _time
+
+from ...observability import metrics as _metrics
+
+# end-of-stream sentinel (not None: sources may legitimately yield None)
+_END = object()
+
+_live_prefetchers = None  # weakref.WeakSet, created lazily
+
+
+def _register(p) -> None:
+    """Track live prefetchers and stop them atexit: a daemon worker
+    mid-XLA-dispatch at interpreter teardown aborts the process
+    ('terminate called without an active exception')."""
+    global _live_prefetchers
+    if _live_prefetchers is None:
+        import atexit
+        import weakref
+        _live_prefetchers = weakref.WeakSet()
+        atexit.register(_close_live_prefetchers)
+    _live_prefetchers.add(p)
+
+
+def _close_live_prefetchers() -> None:
+    for p in list(_live_prefetchers or ()):
+        try:
+            p.close()
+        except Exception:
+            pass
+
+
+class AsyncPrefetcher:
+    """Bounded background-thread prefetch over a `next()`-style source.
+
+    The worker calls `next_fn()` (StopIteration ends the stream), applies
+    `transform` (e.g. device placement) still on the worker thread, and
+    feeds a queue of `depth` ready batches.  Worker exceptions re-raise in
+    the consumer on `get()`, followed by StopIteration — a consumer that
+    swallows the error won't hang."""
+
+    def __init__(self, next_fn, depth: int = 2, transform=None,
+                 observe_wait: bool = False):
+        self._next_fn = next_fn
+        self._transform = transform
+        # prefetch_to_device consumers observe their stalls into the
+        # prefetch_wait histogram; io.PrefetchingIter keeps recording
+        # into DATA_WAIT_SECONDS itself — one histogram per wait, never
+        # both
+        self._observe_wait = observe_wait
+        self._depth = max(1, int(depth))
+        self._queue: _queue.Queue = _queue.Queue(maxsize=self._depth)
+        self._stop = threading.Event()
+        self._done = False
+        _register(self)
+        self._thread = threading.Thread(target=self._worker, daemon=True)
+        self._thread.start()
+
+    def _worker(self) -> None:
+        while not self._stop.is_set():
+            try:
+                item = self._next_fn()
+                if self._transform is not None:
+                    item = self._transform(item)
+            except StopIteration:
+                self._queue.put(_END)
+                return
+            except BaseException as e:  # surface in the consumer thread
+                self._queue.put(e)
+                self._queue.put(_END)
+                return
+            self._queue.put(item)
+
+    def get(self):
+        """Next ready batch; blocks only when the pipeline is behind (the
+        stall is the prefetch_wait histogram).  Exhaustion is sticky:
+        every get() after the stream ends raises StopIteration instead
+        of blocking on the drained queue."""
+        if self._done:
+            raise StopIteration
+        on = _metrics.ENABLED and self._observe_wait
+        t0 = _time.perf_counter() if on else 0.0
+        item = self._queue.get()
+        if on:
+            _metrics.PREFETCH_WAIT_SECONDS.observe(_time.perf_counter() - t0)
+        if item is _END:
+            self._done = True
+            raise StopIteration
+        if isinstance(item, BaseException):
+            raise item
+        return item
+
+    def close(self) -> None:
+        """Stop the worker and drain the buffer (idempotent); any later
+        get() raises StopIteration."""
+        self._done = True
+        self._stop.set()
+        try:
+            while True:
+                self._queue.get_nowait()
+        except _queue.Empty:
+            pass
+        t = self._thread
+        if t is not None and t.is_alive():
+            t.join(timeout=5)
+        self._thread = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+def _resolve_device(device):
+    """Context / jax.Device / None -> (jax.Device, Context label).
+
+    The Context is what placed NDArrays report as `.context` — it must
+    name the DESTINATION device, or downstream `as_in_context` calls
+    would see a mismatch and re-copy the batch the prefetch just moved."""
+    from ...context import Context, _local, current_context
+    if device is None:
+        ctx = current_context()
+        return ctx.jax_device(), ctx
+    if hasattr(device, "jax_device"):
+        return device.jax_device(), device
+    plat = getattr(device, "platform", "cpu")
+    kind = "cpu" if plat == "cpu" else "tpu"
+    try:
+        idx = _local(plat).index(device)
+    except ValueError:
+        idx = 0
+    return device, Context(kind, idx)
+
+
+def _device_put_batch(batch, dev, ctx):
+    """Recursively move a batch (NDArray / DataBatch / list / tuple /
+    numpy) onto `dev`, labelling results with `ctx`.  Already-placed
+    arrays pass through untouched."""
+    import jax
+
+    from ...ndarray import NDArray
+
+    def leaf(x):
+        if isinstance(x, NDArray):
+            from ...ndarray.sparse import BaseSparseNDArray
+            if isinstance(x, BaseSparseNDArray):
+                return x  # rows-only storage is host-orchestrated
+            d = x._data
+            if dev in getattr(d, "devices", lambda: set())():
+                return x
+            if _metrics.ENABLED:
+                _metrics.XLA_LAUNCHES.inc(kind="data")
+                _metrics.TRANSFER_BYTES.inc(int(getattr(d, "nbytes", 0) or 0))
+            return NDArray(jax.device_put(d, dev), ctx)
+        if isinstance(x, (list, tuple)):
+            return type(x)(leaf(v) for v in x)
+        if hasattr(x, "data") and hasattr(x, "label"):  # io.DataBatch
+            x.data = [leaf(v) for v in x.data]
+            if x.label is not None:
+                x.label = [leaf(v) for v in x.label]
+            return x
+        if hasattr(x, "shape") and hasattr(x, "dtype"):  # numpy / jax array
+            if _metrics.ENABLED:
+                _metrics.XLA_LAUNCHES.inc(kind="data")
+                _metrics.TRANSFER_BYTES.inc(int(getattr(x, "nbytes", 0) or 0))
+            return NDArray(jax.device_put(x, dev), ctx)
+        return x
+
+    return leaf(batch)
+
+
+class _DevicePrefetchIter:
+    """Iterator returned by prefetch_to_device: double-buffers device
+    placement of upcoming batches in a background thread."""
+
+    def __init__(self, source, depth: int = 2, device=None):
+        self._source = source
+        self._depth = depth
+        self._dev, self._ctx = _resolve_device(device)
+        self._pf = None
+        self._start()
+
+    def _start(self) -> None:
+        src = self._source
+        next_fn = src.next if hasattr(src, "next") and not hasattr(src, "__next__") \
+            else iter(src).__next__
+        self._pf = AsyncPrefetcher(
+            next_fn, depth=self._depth,
+            transform=lambda b: _device_put_batch(b, self._dev, self._ctx),
+            observe_wait=True)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._pf is None:
+            raise StopIteration
+        return self._pf.get()
+
+    next = __next__
+
+    def reset(self) -> None:
+        """Restart the underlying source (DataIter protocol)."""
+        self.close()
+        if hasattr(self._source, "reset"):
+            self._source.reset()
+        self._start()
+
+    def close(self) -> None:
+        if self._pf is not None:
+            self._pf.close()
+            self._pf = None
+
+    def __len__(self):
+        return len(self._source)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+def prefetch_to_device(data_iter, depth: int = 2, device=None):
+    """Wrap a batch iterable so the next `depth` batches are device-resident
+    before the training loop asks for them.
+
+    >>> for batch in prefetch_to_device(loader, depth=2):
+    ...     trainer.step(...)   # batch N+1 uploads while step N runs
+
+    device: a Context, a jax.Device, or None (the current context's device).
+    """
+    return _DevicePrefetchIter(data_iter, depth=depth, device=device)

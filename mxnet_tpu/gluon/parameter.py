@@ -25,6 +25,20 @@ class DeferredInitializationError(MXNetError):
     pass
 
 
+_zero_all_fn = None
+
+
+def _zero_all(arrs):
+    """One compiled program producing zeros for every buffer (jax caches
+    per shape/dtype signature)."""
+    global _zero_all_fn
+    if _zero_all_fn is None:
+        import jax
+        import jax.numpy as jnp
+        _zero_all_fn = jax.jit(lambda xs: [jnp.zeros_like(x) for x in xs])
+    return _zero_all_fn(arrs)
+
+
 class Parameter:
     def __init__(self, name, grad_req="write", shape=None, dtype=_np.float32,
                  lr_mult=1.0, wd_mult=1.0, init=None, allow_deferred_init=False,
@@ -222,6 +236,14 @@ class Parameter:
         else:
             self._grad[:] = 0
 
+    @property
+    def fresh_grad(self):
+        """True when backward has deposited into this parameter's grad on
+        any device copy since the last Trainer step (the stale-grad
+        guard's source of truth; parity: NDArray::fresh_out_grad)."""
+        return self._data is not None and \
+            any(getattr(d, "_fresh_grad", False) for d in self.list_data())
+
     def var(self):
         from .. import symbol
         if self._var is None:
@@ -348,8 +370,23 @@ class ParameterDict:
             v.initialize(None, ctx, init, force_reinit=force_reinit)
 
     def zero_grad(self):
-        for i in self.values():
-            i.zero_grad()
+        """Zero every dense grad buffer in ONE jitted dispatch (the
+        per-parameter loop issued O(#params) device ops); row-sparse
+        grads clear their rows host-side as before."""
+        from ..ndarray.sparse import RowSparseNDArray
+        dense = []
+        for p in self.values():
+            g = p._grad
+            if g is None:
+                continue
+            if isinstance(g, RowSparseNDArray):
+                g._clear_rows()
+            else:
+                dense.append(g)
+        if not dense:
+            return
+        for g, z in zip(dense, _zero_all([g._data for g in dense])):
+            g._set_data(z)
 
     def reset_ctx(self, ctx):
         for i in self.values():

@@ -2,10 +2,22 @@
 
 Applies an Optimizer to a ParameterDict; kvstore-backed when requested so
 `KVStore('tpu_sync')` data parallelism works unmodified from gluon code.
+
+TPU fast path (MXNET_FUSED_TRAINER, default on): a steady-state `step` on a
+dense model is O(1) XLA dispatches regardless of parameter count —
+  1. bucketed allreduce: all dense grads flatten into size-capped buckets
+     (MXNET_BUCKET_SIZE_MB, ~32MB) in ONE jitted program and reduce via
+     one store-less `kvstore.allreduce` over the transient buckets;
+  2. fused update: `FusedUpdater.update_all` slices each gradient straight
+     out of the reduced flat buckets inside its single compiled optimizer
+     program (grad_views), so un-flattening costs nothing.
+`MXNET_FUSED_TRAINER=0` pins the reference-shaped legacy path (per-key
+push/pull loop + per-parameter updater calls) for A/B and bisection.
 """
 from __future__ import annotations
 
-from ..base import MXNetError
+from ..base import MXNetError, getenv
+from ..ndarray import NDArray
 from ..observability import metrics as _metrics
 from ..observability.tracing import trace_span
 from .. import optimizer as opt
@@ -15,7 +27,7 @@ from .parameter import ParameterDict, Parameter
 
 class Trainer:
     def __init__(self, params, optimizer, optimizer_params=None, kvstore="device",
-                 compression_params=None):
+                 compression_params=None, update_on_kvstore=None):
         if isinstance(params, (dict, ParameterDict)):
             params = list(params.values())
         if not isinstance(params, (list, tuple)):
@@ -35,6 +47,13 @@ class Trainer:
         self._init_optimizer(optimizer, optimizer_params)
         self._kv_initialized = False
         self._kvstore = kvstore
+        self._update_on_kvstore_arg = update_on_kvstore
+        self._fused = bool(getenv("MXNET_FUSED_TRAINER", True))
+        self._bucketer = None
+        self._bucket_sig = None
+        # (flat bucket arrays, per-param views, index tuple) staged by a
+        # for-step allreduce for the fused update to consume
+        self._reduced = None
 
     def _init_optimizer(self, optimizer, optimizer_params):
         param_dict = {i: param for i, param in enumerate(self._params)}
@@ -53,6 +72,17 @@ class Trainer:
         arg_arrays = {param.name: param.data() for param in self._params}
         kvstore, update_on_kvstore = _create_kvstore(
             self._kvstore, 1, arg_arrays)
+        if self._update_on_kvstore_arg is not None:
+            # explicit user override (parity: later-1.x Trainer arg)
+            update_on_kvstore = bool(self._update_on_kvstore_arg)
+            if update_on_kvstore and kvstore is None:
+                # parity: reference Trainer raises rather than silently
+                # training with local updaters (save_states would then
+                # write a different state format than the user asked for)
+                raise ValueError(
+                    "update_on_kvstore=True requires a kvstore, but "
+                    f"kvstore={self._kvstore!r} resolved to none — set "
+                    "update_on_kvstore=False or pass a kvstore")
         if kvstore:
             if self._compression_params:
                 kvstore.set_gradient_compression(self._compression_params)
@@ -72,14 +102,53 @@ class Trainer:
     def set_learning_rate(self, lr):
         self._optimizer.set_learning_rate(lr)
 
+    # -- stale-grad accounting ----------------------------------------------
+    @staticmethod
+    def _is_fresh(param):
+        return param.fresh_grad
+
+    def _mask_stale(self, live, ignore_stale_grad):
+        """Parity: gluon/trainer.py:216 — a gradient that backward has not
+        rewritten since the last step either raises (default) or masks its
+        parameter out of the update (ignore_stale_grad=True)."""
+        if ignore_stale_grad:
+            return [(i, p) for i, p in live if self._is_fresh(p)]
+        for i, p in live:
+            for d in p.list_data():
+                if not getattr(d, "_fresh_grad", False):
+                    raise UserWarning(
+                        f"Gradient of Parameter `{p.name}` on context "
+                        f"{d.context} has not been updated by backward "
+                        "since last `step`. This could mean a bug in your "
+                        "model that made it only use a subset of the "
+                        "Parameters (Blocks) for this iteration. If you "
+                        "are intentionally only using a subset, call step "
+                        "with ignore_stale_grad=True to suppress this "
+                        "warning and skip updating of Parameters with "
+                        "stale gradient")
+        return live
+
+    @staticmethod
+    def _clear_fresh(entries):
+        for _, p in entries:
+            for d in p.list_data():
+                d._fresh_grad = False
+
     def step(self, batch_size, ignore_stale_grad=False):
         """Apply one optimization step with grads scaled by 1/batch_size.
 
         TPU hot path: all parameters update in O(1) XLA dispatches via
-        KVStore.pushpull / FusedUpdater.update_all (replaces the reference's
-        per-parameter kvstore push loop, gluon/trainer.py:191-226)."""
+        bucketed KVStore.pushpull + FusedUpdater.update_all (replaces the
+        reference's per-parameter kvstore push loop, gluon/trainer.py:191-226).
+        The per-step dispatch delta is published as the
+        mxnet_trainer_step_dispatches gauge."""
+        on = _metrics.ENABLED
+        d0 = _metrics.step_dispatches() if on else 0.0
         with trace_span("trainer_step", cat="optimizer"):
             self._step(batch_size, ignore_stale_grad)
+        if on:
+            _metrics.TRAINER_STEP_DISPATCHES.set(
+                _metrics.step_dispatches() - d0)
 
     def _step(self, batch_size, ignore_stale_grad=False):
         if not self._kv_initialized:
@@ -88,6 +157,14 @@ class Trainer:
         live = [(i, p) for i, p in enumerate(self._params)
                 if p.grad_req != "null"]
         if self._kv is not None and self._update_on_kvstore:
+            # parity: the reference NEVER masks the kvstore push set —
+            # only the no-kvstore updater loop honors ignore_stale_grad.
+            # Masking here would also desynchronize collective
+            # participation across hosts (worker A skips a stale param
+            # worker B pushes → mismatched allreduce → pod hang), so
+            # stale grads raise (default) or push as-is.
+            if not ignore_stale_grad:
+                self._mask_stale(live, False)
             # row-sparse grad_stype params go through the kvstore per-key
             # sparse path (class-preserving push → lazy rsp optimizer on
             # the store) so untouched rows never decay
@@ -105,11 +182,19 @@ class Trainer:
                         out=p.list_data())
             dense = [ip for ip in live if ip not in rsp]
             if dense:
-                self._kv.pushpull([i for i, _ in dense],
-                                  [p.list_grad() for _, p in dense],
-                                  out=[p.list_data() for _, p in dense])
+                if self._fused:
+                    self._kv.pushpull([i for i, _ in dense],
+                                      [p.list_grad() for _, p in dense],
+                                      out=[p.list_data() for _, p in dense])
+                else:
+                    # MXNET_FUSED_TRAINER=0: the reference-shaped per-key
+                    # loop, for A/B runs and bisection
+                    for i, p in dense:
+                        self._kv.pushpull(i, p.list_grad(),
+                                          out=p.list_data())
+            self._clear_fresh(live)
             return
-        self._allreduce_grads()
+        self._allreduce_grads(for_step=True)
         self._update(ignore_stale_grad)
 
     def allreduce_grads(self):
@@ -117,22 +202,80 @@ class Trainer:
             self._init_kvstore()
         self._allreduce_grads()
 
-    def _allreduce_grads(self):
+    def _allreduce_grads(self, for_step=False):
+        self._reduced = None
         if self._kv is None:
             return
-        for i, param in enumerate(self._params):
-            if param.grad_req != "null":
+        live = [(i, p) for i, p in enumerate(self._params)
+                if p.grad_req != "null"]
+        rsp = [(i, p) for i, p in live
+               if getattr(p, "_grad_stype", "default") == "row_sparse"]
+        for i, p in rsp:
+            # sparse keys keep the per-key class-preserving path
+            self._kv.push(i, p.list_grad())
+            if not self._update_on_kvstore:
+                self._kv.pull(i, p.list_grad())
+        dense = [ip for ip in live if ip not in rsp]
+        if not dense:
+            return
+        # compression stays on the per-key path: its residuals are keyed
+        # per parameter, and bucket-level quantization would change the
+        # error-feedback semantics vs the reference
+        fused_ok = (self._fused and not self._update_on_kvstore
+                    and not self._compression_params
+                    and all(len(p.list_grad()) == 1 for _, p in dense))
+        if not fused_ok:
+            for i, param in dense:
                 self._kv.push(i, param.list_grad())
                 if not self._update_on_kvstore:
                     self._kv.pull(i, param.list_grad())
+            return
+        flats, views, idx = self._bucketed_pushpull(dense)
+        if for_step:
+            # the fused update slices grads straight out of the flat
+            # buckets (grad_views); per-key grad buffers are rewritten
+            # only for the public allreduce_grads() contract below
+            self._reduced = (flats, views, idx)
+        else:
+            outs = self._bucketer.unflatten(flats)
+            for (i, p), g in zip(dense, outs):
+                p.list_grad()[0]._set_data(g)
+
+    def _bucketed_pushpull(self, dense):
+        """Flatten → one store-less fused allreduce over the buckets →
+        reduced flat buckets.  Returns (flat arrays, per-param views,
+        indices).  The buckets are TRANSIENT — they never enter the
+        kvstore's backing store, so no gradient-sized copy is pinned and
+        nothing is copied per step beyond the reduce itself."""
+        from ..kvstore import GradBucketer
+        grads = [p.list_grad()[0] for _, p in dense]
+        sig = tuple((tuple(g.shape), str(g.dtype)) for g in grads)
+        idx = tuple(i for i, _ in dense)
+        if self._bucketer is None or self._bucket_sig != (sig, idx):
+            cap = int(float(getenv("MXNET_BUCKET_SIZE_MB", 32.0))
+                      * 1024 * 1024)
+            self._bucketer = GradBucketer(sig, cap)
+            self._bucket_sig = (sig, idx)
+        bk = self._bucketer
+        with trace_span("bucketed_allreduce", cat="kvstore"):
+            flats = bk.flatten([g.handle for g in grads])
+            ctx = grads[0].context
+            reduced = self._kv.allreduce([NDArray(f, ctx) for f in flats])
+        return ([r.handle for r in reduced],
+                [bk.views[j] for j in range(len(dense))], idx)
 
     def _update(self, ignore_stale_grad=False):
         from ..optimizer import FusedUpdater
         live = [(i, p) for i, p in enumerate(self._params)
                 if p.grad_req != "null"]
+        # pop the staged buckets BEFORE the stale check: if it raises,
+        # a later update() must not consume the previous step's grads
+        reduced, self._reduced = self._reduced, None
+        live = self._mask_stale(live, ignore_stale_grad)
         if self._update_on_kvstore and self._kv is not None:
             for i, param in live:
                 self._kv.pull(i, out=param.list_data())
+            self._clear_fresh(live)
             return
         upd = self._updaters[0]
         # one updater per device copy (parity: reference trainer keeps
@@ -140,6 +283,7 @@ class Trainer:
         ncopies = max((len(p.list_data()) for _, p in live), default=1)
         while len(self._updaters) < ncopies:
             self._updaters.append(opt.get_updater(self._optimizer))
+        done = list(live)
         # row-sparse grad_stype params take the lazy per-key sparse path
         # (dense autograd grad → RowSparse cast → row-wise update); the
         # rest go through the fused multi-tensor dispatch
@@ -154,17 +298,47 @@ class Trainer:
                       else _sp.cast_storage(grad, "row_sparse"), arr)
             live = [ip for ip in live if ip not in rsp]
             if not live:
+                self._clear_fresh(done)
                 return
-        if isinstance(upd, FusedUpdater) and \
-                all(len(p.list_data()) == 1 for _, p in live):
-            upd.update_all([i for i, _ in live],
-                           [p.list_grad()[0] for _, p in live],
-                           [p.list_data()[0] for _, p in live])
+        fused_ok = self._fused and isinstance(upd, FusedUpdater)
+        if fused_ok and all(len(p.list_data()) == 1 for _, p in live):
+            if reduced is not None:
+                flats, views, idx = reduced
+                pos = {i: j for j, i in enumerate(idx)}
+                # _allreduce_grads staged every dense live param in the
+                # buckets; a param outside `idx` would train on its raw
+                # UN-REDUCED grad buffer (the for_step path deliberately
+                # never rewrites per-key grads), so fail loudly instead
+                assert all(i in pos for i, _ in live), (idx, live)
+                if live:
+                    upd.update_all(
+                        [i for i, _ in live], flats,
+                        [p.list_data()[0] for _, p in live],
+                        grad_views=[views[pos[i]] for i, _ in live])
+            else:
+                upd.update_all([i for i, _ in live],
+                               [p.list_grad()[0] for _, p in live],
+                               [p.list_data()[0] for _, p in live])
+            self._clear_fresh(done)
             return
+        if fused_ok and ncopies > 1 and \
+                all(len(p.list_data()) == ncopies for _, p in live):
+            # uniform multi-device copies: one fused program per copy
+            # slot — O(#copies) dispatches, still O(1) in param count
+            for c in range(ncopies):
+                self._updaters[c].update_all(
+                    [i for i, _ in live],
+                    [p.list_grad()[c] for _, p in live],
+                    [p.list_data()[c] for _, p in live])
+            self._clear_fresh(done)
+            return
+        # legacy per-parameter loop (MXNET_FUSED_TRAINER=0, ragged device
+        # copies, or optimizers without a fused_step)
         for i, param in live:
             for u, arr, grad in zip(self._updaters, param.list_data(),
                                     param.list_grad()):
                 u(i, grad, arr)
+        self._clear_fresh(done)
 
     def update(self, batch_size, ignore_stale_grad=False):
         if not self._kv_initialized:

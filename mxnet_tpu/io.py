@@ -10,9 +10,7 @@ from __future__ import annotations
 import gzip
 import os
 import struct
-import threading
 import time as _time
-import queue as _queue
 from collections import namedtuple
 from typing import List, Optional
 
@@ -253,9 +251,15 @@ class ResizeIter(DataIter):
 
 class PrefetchingIter(DataIter):
     """Background-thread prefetch (parity: io.py PrefetchingIter /
-    src/io/iter_prefetcher.h double-buffering on dmlc::ThreadedIter)."""
+    src/io/iter_prefetcher.h double-buffering on dmlc::ThreadedIter).
 
-    def __init__(self, iters, rename_data=None, rename_label=None, depth=2):
+    Backed by the shared `gluon.data.prefetcher.AsyncPrefetcher` core.
+    With `device` set (a Context or jax.Device), the worker thread also
+    `jax.device_put`s each batch — the next batch is HBM-resident before
+    the training loop asks for it (prefetch-to-device)."""
+
+    def __init__(self, iters, rename_data=None, rename_label=None, depth=2,
+                 device=None):
         if not isinstance(iters, list):
             iters = [iters]
         assert len(iters) == 1, "composite prefetch of multiple iters: pass one"
@@ -264,16 +268,8 @@ class PrefetchingIter(DataIter):
         self.rename_data = rename_data
         self.rename_label = rename_label
         self._depth = int(depth)
-        self._queue: _queue.Queue = _queue.Queue(maxsize=self._depth)
-        self._stop = threading.Event()
-        self._thread = None
-        global _live_prefetchers
-        if _live_prefetchers is None:
-            import atexit
-            import weakref
-            _live_prefetchers = weakref.WeakSet()
-            atexit.register(_close_live_prefetchers)
-        _live_prefetchers.add(self)
+        self._device = device
+        self._pf = None
         self._start()
 
     @property
@@ -290,28 +286,20 @@ class PrefetchingIter(DataIter):
         return [DataDesc(self.rename_label[0].get(d.name, d.name), d.shape,
                          d.dtype) for d in self.iter.provide_label]
 
-    def _worker(self):
-        while not self._stop.is_set():
-            try:
-                batch = self.iter.next()
-            except StopIteration:
-                self._queue.put(None)
-                return
-            except BaseException as e:  # surface in the consumer thread
-                self._queue.put(e)
-                self._queue.put(None)  # then StopIteration: a consumer
-                return                 # that swallows the error won't hang
-            self._queue.put(batch)
-
     def _start(self):
-        self._thread = threading.Thread(target=self._worker, daemon=True)
-        self._thread.start()
+        from .gluon.data.prefetcher import (AsyncPrefetcher,
+                                            _device_put_batch,
+                                            _resolve_device)
+        transform = None
+        if self._device is not None:
+            dev, ctx = _resolve_device(self._device)
+            transform = lambda b: _device_put_batch(b, dev, ctx)  # noqa: E731
+        self._pf = AsyncPrefetcher(self.iter.next, depth=self._depth,
+                                   transform=transform)
 
     def reset(self):
         self.close()
         self.iter.reset()
-        self._stop = threading.Event()
-        self._queue = _queue.Queue(maxsize=self._depth)
         self._start()
 
     # tells BaseModule.fit this iterator already records its own
@@ -322,51 +310,31 @@ class PrefetchingIter(DataIter):
         # the queue.get IS the pipeline stall: with a healthy prefetch
         # depth this is ~0; a growing mxnet_data_batch_wait_seconds here
         # means the input pipeline can't keep up with the device
+        if self._pf is None:
+            raise StopIteration
         on = _metrics.ENABLED
         t0 = _time.perf_counter() if on else 0.0
-        batch = self._queue.get()
-        if on:
-            _metrics.DATA_WAIT_SECONDS.observe(_time.perf_counter() - t0)
-        if batch is None:
-            raise StopIteration
-        if isinstance(batch, BaseException):
-            raise batch  # re-raise the worker's failure where the user is
+        try:
+            batch = self._pf.get()
+        finally:
+            if on:
+                _metrics.DATA_WAIT_SECONDS.observe(_time.perf_counter() - t0)
         return batch
 
     def iter_next(self):
         raise NotImplementedError
 
     def close(self):
-        """Stop the prefetch worker and drain the buffer.  Registered
-        atexit: a daemon worker mid-XLA-dispatch at interpreter
-        teardown aborts the process ('terminate called without an
-        active exception'), so every live prefetcher is stopped before
-        the runtime goes away."""
-        self._stop.set()
-        try:
-            while True:
-                self._queue.get_nowait()
-        except _queue.Empty:
-            pass
-        t = self._thread
-        if t is not None and t.is_alive():
-            t.join(timeout=5)
-        self._thread = None
+        """Stop the prefetch worker and drain the buffer (the shared
+        prefetcher core also registers itself atexit — a daemon worker
+        mid-XLA-dispatch at interpreter teardown aborts the process)."""
+        if self._pf is not None:
+            self._pf.close()
+            self._pf = None
 
     def __del__(self):
         try:
             self.close()
-        except Exception:
-            pass
-
-
-_live_prefetchers: "weakref.WeakSet[PrefetchingIter]" = None  # type: ignore
-
-
-def _close_live_prefetchers():
-    for it in list(_live_prefetchers or ()):
-        try:
-            it.close()
         except Exception:
             pass
 

@@ -149,6 +149,84 @@ class GradientCompression:
         return {"type": self.type, "threshold": self.threshold}
 
 
+class GradBucketer:
+    """Size-capped dense-gradient bucketing for O(1)-dispatch allreduce.
+
+    The reference allreduces one engine push per key (kvstore_local.h); here
+    all dense grads are grouped into dtype-homogeneous, order-preserving
+    buckets of at most `cap_bytes` (MXNET_BUCKET_SIZE_MB) and each bucket
+    crosses the kvstore as ONE flat array — pushes per step become
+    O(total grad bytes / cap), independent of parameter count.
+
+    `flatten` runs as a single jitted program over every bucket.  `views`
+    maps each input position to (bucket, offset, shape) so
+    `FusedUpdater.update_all(grad_views=...)` slices gradients straight out
+    of the reduced flat buckets inside its own fused program (un-flattening
+    is free on the trainer hot path); `unflatten` materializes per-key
+    grads only for the public `Trainer.allreduce_grads()` contract.
+    """
+
+    def __init__(self, sig, cap_bytes: int):
+        # sig: tuple of (shape, dtype_str) in input order
+        self.sig = tuple((tuple(s), str(d)) for s, d in sig)
+        self.cap = max(1, int(cap_bytes))
+        layout: List[tuple] = []
+        cur: List[int] = []
+        cur_dtype, cur_bytes = None, 0
+        for pos, (shape, dtype) in enumerate(self.sig):
+            nbytes = int(_np.dtype(dtype).itemsize * _np.prod(shape)) \
+                if shape else _np.dtype(dtype).itemsize
+            if cur and (dtype != cur_dtype or cur_bytes + nbytes > self.cap):
+                layout.append(tuple(cur))
+                cur, cur_bytes = [], 0
+            cur.append(pos)
+            cur_dtype, cur_bytes = dtype, cur_bytes + nbytes
+        if cur:
+            layout.append(tuple(cur))
+        self.layout = tuple(layout)
+        self.views: List[tuple] = [None] * len(self.sig)
+        for b, bucket in enumerate(self.layout):
+            off = 0
+            for pos in bucket:
+                shape, _ = self.sig[pos]
+                size = int(_np.prod(shape)) if shape else 1
+                self.views[pos] = (b, off, shape)
+                off += size
+        lay, sig_ = self.layout, self.sig
+
+        def _flat(gs):
+            return [jnp.concatenate([gs[p].reshape(-1) for p in bucket])
+                    if len(bucket) > 1 else gs[bucket[0]].reshape(-1)
+                    for bucket in lay]
+
+        def _unflat(flats):
+            out = [None] * len(sig_)
+            for b, bucket in enumerate(lay):
+                off = 0
+                for p in bucket:
+                    shape = sig_[p][0]
+                    size = int(_np.prod(shape)) if shape else 1
+                    out[p] = flats[b][off:off + size].reshape(shape)
+                    off += size
+            return out
+
+        self._flatten = jax.jit(_flat)
+        self._unflatten = jax.jit(_unflat)
+
+    def flatten(self, grads: List) -> List:
+        """Raw jax arrays in sig order -> flat bucket arrays (one dispatch)."""
+        if _metrics.ENABLED:
+            _metrics.XLA_LAUNCHES.inc(kind="allreduce")
+            _metrics.ALLREDUCE_BUCKETS.set(len(self.layout))
+        return self._flatten(grads)
+
+    def unflatten(self, flats: List) -> List:
+        """Flat bucket arrays -> per-key arrays (one dispatch)."""
+        if _metrics.ENABLED:
+            _metrics.XLA_LAUNCHES.inc(kind="allreduce")
+        return self._unflatten(flats)
+
+
 def _key_list(key):
     if isinstance(key, (int, str)):
         return [key], False
@@ -439,6 +517,39 @@ class KVStore:
         from .parallel import collectives
         with trace_span("kvstore_allreduce", cat="kvstore"):
             return collectives.allreduce_hosts(merged)
+
+    def allreduce(self, values: List[NDArray]) -> List[NDArray]:
+        """Store-less dense allreduce: sum each value across its per-device
+        copies and across hosts, return the reduced arrays.
+
+        For TRANSIENT keys (the Trainer's gradient buckets) — unlike
+        push/pull nothing is `init`ed or persisted, so reducing N bytes
+        costs no store copy and pins no store memory.  `values` is a list
+        with one entry PER VALUE: an NDArray, or that value's
+        per-device-copy list of NDArrays.  (Unlike push/pushpull, a flat
+        NDArray list here means N distinct values — never N device
+        copies of one value.)"""
+        vals = [list(v) if isinstance(v, (list, tuple)) else [v]
+                for v in values]
+        if _metrics.ENABLED:
+            t0 = time.perf_counter()
+            with trace_span("kvstore_allreduce", cat="kvstore"):
+                out = self._allreduce_impl(vals)
+            _metrics.KVSTORE_ALLREDUCE_SECONDS.observe(
+                time.perf_counter() - t0)
+            _metrics.KVSTORE_PUSH_BYTES.inc(sum(
+                _nd_bytes(v) for vl in vals for v in vl))
+            return out
+        return self._allreduce_impl(vals)
+
+    def _allreduce_impl(self, vals: List[List[NDArray]]) -> List[NDArray]:
+        merged = [self._merge_local(vl) for vl in vals]
+        raw = [m._data if isinstance(m, NDArray) else m for m in merged]
+        if self.num_workers > 1 and self.type != "local":
+            from .parallel import collectives
+            raw = collectives.allreduce_hosts_many(raw)
+        return [r if isinstance(r, NDArray) else NDArray(r, vl[0].context)
+                for r, vl in zip(raw, vals)]
 
     # -- optimizer plumbing --------------------------------------------------
     def set_optimizer(self, optimizer: "opt.Optimizer") -> None:

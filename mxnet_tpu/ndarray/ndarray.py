@@ -31,7 +31,7 @@ class NDArray:
     """Multi-dimensional array on a device, with async execution semantics."""
 
     __slots__ = ("_data", "_ctx", "_version", "_grad", "_grad_req", "_writable",
-                 "_base", "__weakref__")
+                 "_base", "_fresh_grad", "__weakref__")
     # make numpy defer to our __r*__ ops
     __array_priority__ = 100.0
 
@@ -43,6 +43,10 @@ class NDArray:
         self._grad_req: str = "null"
         self._writable = writable
         self._base = None
+        # set True by autograd.backward when it deposits into this array's
+        # grad buffer; Trainer.step clears it after consuming the gradient
+        # (parity: NDArray::fresh_out_grad, the stale-grad guard)
+        self._fresh_grad = False
         _engine.maybe_sync([data])
 
     # -- core accessors -----------------------------------------------------

@@ -300,8 +300,8 @@ REGISTRY = MetricsRegistry()
 # enable/disable flips.
 XLA_LAUNCHES = Counter(
     "mxnet_xla_launches_total",
-    "Compiled XLA program launches by kind (fwd, fwd_bwd, fused_step, "
-    "kvstore_merge, optimizer, data)")
+    "Compiled XLA program launches by kind (fwd, bwd, fwd_bwd, fused_step, "
+    "kvstore_merge, allreduce, optimizer, data)")
 DEVICE_PUTS = Counter(
     "mxnet_device_put_total",
     "Explicit jax.device_put host->device / device->device transfers")
@@ -344,6 +344,20 @@ FIT_STEP_DISPATCHES = Gauge(
     "XLA program launches + device_puts issued by the most recent "
     "steady-state Module.fit step, excluding async data-pipeline "
     "launches (the round-2 O(1)-dispatch invariant, now queryable)")
+TRAINER_STEP_DISPATCHES = Gauge(
+    "mxnet_trainer_step_dispatches",
+    "XLA program launches + device_puts issued by the most recent "
+    "gluon Trainer.step (allreduce + optimizer; forward/backward are "
+    "outside step() and counted under xla:fwd / xla:bwd)")
+ALLREDUCE_BUCKETS = Gauge(
+    "mxnet_allreduce_buckets",
+    "Gradient buckets the most recent bucketed allreduce fused into "
+    "(size-capped by MXNET_BUCKET_SIZE_MB; O(total grad bytes), "
+    "independent of parameter count)")
+PREFETCH_WAIT_SECONDS = Histogram(
+    "mxnet_prefetch_wait_seconds",
+    "Time the consumer blocked on the prefetch-to-device queue; near "
+    "zero when the input pipeline keeps ahead of the device")
 
 
 def _hbm_stats_all() -> List[dict]:
@@ -417,6 +431,9 @@ def snapshot() -> dict:
     return {
         "dispatch_counts": dispatch_counts(),
         "fit_step_dispatches": FIT_STEP_DISPATCHES.get(),
+        "trainer_step_dispatches": TRAINER_STEP_DISPATCHES.get(),
+        "allreduce_buckets": ALLREDUCE_BUCKETS.get(),
+        "prefetch_wait_ms_total": PREFETCH_WAIT_SECONDS.sum * 1e3,
         "transfer_bytes": TRANSFER_BYTES.value,
         "kvstore_push_bytes": KVSTORE_PUSH_BYTES.value,
         "kvstore_pull_bytes": KVSTORE_PULL_BYTES.value,

@@ -812,6 +812,11 @@ class Updater:
         self._ensure_state(index, weight)
         if _metrics.ENABLED:
             _metrics.OPTIMIZER_STEPS.inc()
+            # a per-key update launches at least one device program; the
+            # legacy (non-fused) trainer path is O(params) of these, and
+            # TRAINER_STEP_DISPATCHES must show that against the fused
+            # path's single update_all launch
+            _metrics.XLA_LAUNCHES.inc(kind="optimizer")
         self.optimizer.update_multi_precision(index, weight, grad,
                                               self.states[index])
 
@@ -917,21 +922,44 @@ class FusedUpdater(Updater):
 
         return hc["lr"], hc["wd"], ts, commit_ts
 
-    def update_all(self, indices, grads, weights) -> None:
+    @staticmethod
+    def _materialize_views(grads, grad_views):
+        """Slice per-key gradients out of flat bucket arrays eagerly (the
+        rare non-fused-optimizer fallback; the fused path slices inside
+        its compiled program instead)."""
+        out = []
+        for b, off, shape in grad_views:
+            f = grads[b]._data if isinstance(grads[b], NDArray) else grads[b]
+            size = int(_np.prod(shape)) if shape else 1
+            out.append(f[off:off + size].reshape(shape))
+        return out
+
+    def update_all(self, indices, grads, weights, grad_views=None) -> None:
         """Apply the optimizer to all (grad, weight) pairs in one dispatch.
 
         grads: NDArray or raw jax arrays; weights: NDArrays (updated
         in place via _set_data).  Falls back to the per-key path for
         optimizers without fused_step.
+
+        grad_views: when set, `grads` holds the FLAT BUCKET arrays of a
+        bucketed allreduce (kvstore.GradBucketer) and grad_views[k] =
+        (bucket, offset, shape) locates parameter k's gradient inside
+        them; the slice+reshape traces into the same fused program, so
+        un-flattening costs no extra dispatch or copy.  (The bucket
+        buffers are NOT donated — no output shares their shape — they
+        stay live until the trainer drops its reference after the call.)
         """
         opt_ = self.optimizer
         if not getattr(opt_, "fused", False):
+            if grad_views is not None:
+                grads = self._materialize_views(grads, grad_views)
             for i, g, w in zip(indices, grads, weights):
                 g = g if isinstance(g, NDArray) else NDArray(g, w.context)
                 self(i, g, w)
             return
         from .ndarray.sparse import RowSparseNDArray
-        if any(isinstance(g, RowSparseNDArray) for g in grads):
+        if grad_views is None and \
+                any(isinstance(g, RowSparseNDArray) for g in grads):
             # rsp grads take the rows-only lazy path (reading ._data here
             # would densify the O(vocab) gradient the executor just kept
             # rows-only); dense keys stay in the fused multi-tensor trace
@@ -953,8 +981,17 @@ class FusedUpdater(Updater):
         wvals = [w._data for w in weights]
         gvals = [g._data if isinstance(g, NDArray) else g for g in grads]
         svals = [self._state_data(self.states[i]) for i in indices]
+        views = tuple(grad_views) if grad_views is not None else None
 
-        key = (type(opt_).__name__, opt_.fused_hyper_key(), tuple(indices))
+        # dispatch-stability key: identity of the compiled step is pinned
+        # on (optimizer, hypers, key tuple, dtypes, shardings, state
+        # treedef, bucket views) — any drift re-selects a cached program
+        # instead of silently retracing under the same entry
+        key = (type(opt_).__name__, opt_.fused_hyper_key(), tuple(indices),
+               tuple(str(w.dtype) for w in wvals),
+               tuple(str(g.dtype) for g in gvals),
+               tuple(str(getattr(w, "sharding", None)) for w in wvals),
+               jax.tree_util.tree_structure(svals), views)
         fn = self._fn_cache.get(key)
         if fn is None:
             idx = list(indices)
@@ -973,14 +1010,23 @@ class FusedUpdater(Updater):
             def _apply(wv, gv, sv, lrs, wds, ts):
                 nws, nss = [], []
                 for k in range(len(wv)):
-                    nw, ns = opt_._fused_step_mp(idx[k], wv[k], gv[k], sv[k],
+                    if views is not None:
+                        b, off, shape = views[k]
+                        size = int(_np.prod(shape)) if shape else 1
+                        g_k = gv[b][off:off + size].reshape(shape)
+                    else:
+                        g_k = gv[k]
+                    nw, ns = opt_._fused_step_mp(idx[k], wv[k], g_k, sv[k],
                                                  lrs[k], wds[k], ts[k])
                     nws.append(_cast_like(nw, wv[k]))
                     nss.append(_cast_like(ns, sv[k]))
                 return nws, nss, ts + 1
 
-            # donate states (owned exclusively by this updater); weights are
-            # not donated — executor snapshots may still alias their buffers
+            # donate states (owned exclusively by this updater, aliased to
+            # the new-state outputs); weights are not donated — executor
+            # snapshots may still alias their buffers.  Flat grad buckets
+            # are NOT donated: no output shares their shape, so donation
+            # could never alias and would only warn.
             fn = jax.jit(_apply, donate_argnums=(2,))
             self._fn_cache[key] = fn
         if _metrics.ENABLED:

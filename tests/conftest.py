@@ -29,6 +29,15 @@ import numpy as np
 import pytest
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long-running tests excluded from the tier-1 run")
+    config.addinivalue_line(
+        "markers",
+        "perf_smoke: CPU-runnable dispatch-count regression gates — the "
+        "perf analogue of a correctness test; runs in the tier-1 path")
+
+
 @pytest.fixture(autouse=True)
 def _seed():
     np.random.seed(0)

@@ -1,0 +1,145 @@
+"""Prefetch-to-device pipeline (PR 2): ordering, shutdown, exception
+propagation, and the shared AsyncPrefetcher core behind both
+`gluon.data.prefetch_to_device` and `io.PrefetchingIter`."""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.gluon.data import (ArrayDataset, DataLoader,
+                                  prefetch_to_device)
+from mxnet_tpu.gluon.data.prefetcher import AsyncPrefetcher
+
+
+def test_async_prefetcher_order_and_exhaustion():
+    src = iter(range(10))
+    pf = AsyncPrefetcher(lambda: next(src), depth=3)
+    got = []
+    while True:
+        try:
+            got.append(pf.get())
+        except StopIteration:
+            break
+    assert got == list(range(10))
+    # exhausted prefetcher keeps raising StopIteration, never hangs
+    with pytest.raises(StopIteration):
+        pf.get()
+
+
+def test_async_prefetcher_transform_runs_on_worker():
+    main = threading.get_ident()
+    seen = []
+
+    src = iter(range(4))
+
+    def transform(x):
+        seen.append(threading.get_ident())
+        return x * 2
+
+    pf = AsyncPrefetcher(lambda: next(src), depth=2, transform=transform)
+    out = []
+    while True:
+        try:
+            out.append(pf.get())
+        except StopIteration:
+            break
+    assert out == [0, 2, 4, 6]
+    assert all(t != main for t in seen)  # device_put overlaps the step
+
+
+def test_async_prefetcher_exception_propagates_then_stops():
+    """A worker failure re-raises in the consumer, THEN StopIteration —
+    a consumer that catches the error won't hang on the next get()."""
+    state = {"n": 0}
+
+    def next_fn():
+        state["n"] += 1
+        if state["n"] > 2:
+            raise ValueError("boom at batch 3")
+        return state["n"]
+
+    pf = AsyncPrefetcher(next_fn, depth=2)
+    assert pf.get() == 1
+    assert pf.get() == 2
+    with pytest.raises(ValueError, match="boom at batch 3"):
+        pf.get()
+    with pytest.raises(StopIteration):
+        pf.get()
+
+
+def test_async_prefetcher_close_joins_worker():
+    """close() stops a worker blocked on a full queue (slow consumer) and
+    is idempotent."""
+    ev = threading.Event()
+
+    def next_fn():
+        ev.set()
+        return 1  # infinite source; queue fills, worker blocks on put
+
+    pf = AsyncPrefetcher(next_fn, depth=1)
+    assert ev.wait(timeout=5)
+    pf.get()  # unblock at least one put so the stop flag is observed
+    pf.close()
+    deadline = time.time() + 5
+    while pf._thread is not None and time.time() < deadline:
+        time.sleep(0.01)
+    pf.close()  # idempotent
+
+
+def test_prefetch_to_device_dataloader_values():
+    x = np.arange(64, dtype="f").reshape(16, 4)
+    y = np.arange(16, dtype="f")
+    loader = DataLoader(ArrayDataset(mx.nd.array(x), mx.nd.array(y)),
+                        batch_size=4)
+    plain = [(bx.asnumpy(), by.asnumpy()) for bx, by in loader]
+    pre = [(bx.asnumpy(), by.asnumpy())
+           for bx, by in prefetch_to_device(loader, depth=2)]
+    assert len(plain) == len(pre) == 4
+    for (ax, ay), (bx, by) in zip(plain, pre):
+        np.testing.assert_array_equal(ax, bx)
+        np.testing.assert_array_equal(ay, by)
+
+
+def test_prefetch_to_device_is_device_resident():
+    import jax
+    dev = jax.devices()[0]
+    loader = DataLoader(ArrayDataset(mx.nd.ones((8, 3)), mx.nd.ones((8,))),
+                        batch_size=4)
+    for bx, by in prefetch_to_device(loader, depth=2):
+        assert dev in bx._data.devices()
+        assert dev in by._data.devices()
+
+
+def test_prefetch_to_device_reset_protocol():
+    """reset() restarts the underlying DataIter source (io protocol)."""
+    from mxnet_tpu.io import NDArrayIter
+    x = mx.nd.array(np.arange(24, dtype="f").reshape(12, 2))
+    it = prefetch_to_device(NDArrayIter(x, batch_size=4), depth=2)
+    first = [b.data[0].asnumpy() for b in it]
+    it.reset()
+    second = [b.data[0].asnumpy() for b in it]
+    assert len(first) == len(second) == 3
+    for a, b in zip(first, second):
+        np.testing.assert_array_equal(a, b)
+    it.close()
+
+
+def test_io_prefetching_iter_device_put():
+    """io.PrefetchingIter(device=...) double-buffers HBM placement on the
+    worker thread (shared AsyncPrefetcher core)."""
+    import jax
+    from mxnet_tpu.io import NDArrayIter, PrefetchingIter
+    x = mx.nd.array(np.arange(32, dtype="f").reshape(8, 4))
+    y = mx.nd.array(np.arange(8, dtype="f"))
+    pit = PrefetchingIter(NDArrayIter(x, y, batch_size=4), depth=2,
+                          device=mx.cpu())
+    dev = jax.devices()[0]
+    n = 0
+    for batch in pit:
+        n += 1
+        for arr in batch.data + batch.label:
+            assert dev in arr._data.devices()
+    assert n == 2
+    pit.close()

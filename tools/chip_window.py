@@ -430,11 +430,8 @@ def main():
     # 2. the product-path MFU levers, right after the headline bench
     # (VERDICT r4 top_next: the on-chip NHWC product A/B is the #1
     # named item — it outranks re-validating correctness cases, so
-    # these legs moved ahead of consistency/layout).  The leg runs
-    # UNGATED: the raw A/B already measured NHWC winning raw
-    # (LAYOUT_r04.json, 1929 vs 1860) — the open question is purely
-    # whether the whole-graph pass carries that win to the product
-    # path, and only this leg can answer it.
+    # these legs moved ahead of consistency/layout).
+    if "benchnhwc" in steps:
         SUMMARY["bench_nhwc"] = bench_doc["nhwc_default"] = _bench_json(
             _run("bench_nhwc", [sys.executable, "bench.py"],
                  args.step_timeout, summary_path,
