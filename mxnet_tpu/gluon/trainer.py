@@ -11,10 +11,21 @@ dense model is O(1) XLA dispatches regardless of parameter count —
   2. fused update: `FusedUpdater.update_all` slices each gradient straight
      out of the reduced flat buckets inside its single compiled optimizer
      program (grad_views), so un-flattening costs nothing.
+`compression_params={'type': '2bit'}` composes with the fast path: the
+buckets quantize against flat per-bucket error-feedback residuals (one
+more fused program; the dist leg ships the packed 4-codes/byte payload,
+~1/16 of the float32 bytes) while per-parameter residual semantics stay
+identical to the reference's per-key quantizer — see
+kvstore._compressed_allreduce_impl.
 `MXNET_FUSED_TRAINER=0` pins the reference-shaped legacy path (per-key
 push/pull loop + per-parameter updater calls) for A/B and bisection.
 """
 from __future__ import annotations
+
+import pickle
+
+import jax.numpy as jnp
+import numpy as _np
 
 from ..base import MXNetError, getenv
 from ..ndarray import NDArray
@@ -54,6 +65,15 @@ class Trainer:
         # (flat bucket arrays, per-param views, index tuple) staged by a
         # for-step allreduce for the fused update to consume
         self._reduced = None
+        # 2-bit error-feedback state for the compressed bucketed
+        # allreduce: one flat f32 residual per bucket, laid out by the
+        # bucketer (each parameter's residual is its own slice, so
+        # per-parameter error-feedback semantics survive bucketing);
+        # rebuilt zero-initialized on bucket-signature change
+        self._residuals = None
+        # (bucket_sig, numpy arrays) from load_states, adopted — with a
+        # signature check — when the bucketer is next built
+        self._pending_residuals = None
 
     def _init_optimizer(self, optimizer, optimizer_params):
         param_dict = {i: param for i, param in enumerate(self._params)}
@@ -180,7 +200,8 @@ class Trainer:
                             else _sp.cast_storage(g, "row_sparse")
                             for g in p.list_grad()],
                         out=p.list_data())
-            dense = [ip for ip in live if ip not in rsp]
+            rsp_idx = {i for i, _ in rsp}
+            dense = [ip for ip in live if ip[0] not in rsp_idx]
             if dense:
                 if self._fused:
                     self._kv.pushpull([i for i, _ in dense],
@@ -215,14 +236,17 @@ class Trainer:
             self._kv.push(i, p.list_grad())
             if not self._update_on_kvstore:
                 self._kv.pull(i, p.list_grad())
-        dense = [ip for ip in live if ip not in rsp]
+        # O(1) set membership — `ip not in rsp` was O(len(live)·len(rsp))
+        rsp_idx = {i for i, _ in rsp}
+        dense = [ip for ip in live if ip[0] not in rsp_idx]
         if not dense:
             return
-        # compression stays on the per-key path: its residuals are keyed
-        # per parameter, and bucket-level quantization would change the
-        # error-feedback semantics vs the reference
+        # 2-bit compression composes with bucketing: the quantizer is
+        # purely elementwise, so flat per-bucket residuals (threaded
+        # through _bucketed_pushpull) preserve per-parameter
+        # error-feedback semantics exactly — fused-compressed matches
+        # the legacy per-key-compressed path (tests/test_fused_step.py)
         fused_ok = (self._fused and not self._update_on_kvstore
-                    and not self._compression_params
                     and all(len(p.list_grad()) == 1 for _, p in dense))
         if not fused_ok:
             for i, param in dense:
@@ -256,13 +280,52 @@ class Trainer:
                       * 1024 * 1024)
             self._bucketer = GradBucketer(sig, cap)
             self._bucket_sig = (sig, idx)
+            # the flat residual layout is a function of the bucket
+            # layout — a signature change restarts error feedback
+            self._residuals = None
         bk = self._bucketer
+        gc = getattr(self._kv, "_gc", None)
         with trace_span("bucketed_allreduce", cat="kvstore"):
             flats = bk.flatten([g.handle for g in grads])
             ctx = grads[0].context
-            reduced = self._kv.allreduce([NDArray(f, ctx) for f in flats])
+            buckets = [NDArray(f, ctx) for f in flats]
+            if gc is not None:
+                if self._residuals is None:
+                    self._residuals = self._init_residuals(bk)
+                reduced, self._residuals = self._kv.allreduce(
+                    buckets, compression=gc, residuals=self._residuals)
+            else:
+                reduced = self._kv.allreduce(buckets)
         return ([r.handle for r in reduced],
                 [bk.views[j] for j in range(len(dense))], idx)
+
+    def _init_residuals(self, bk):
+        """Fresh zero residuals sized to the bucket layout — unless
+        load_states stashed checkpointed ones, which must match the
+        current bucket signature exactly (a silent zero-reset would
+        discard the checkpoint's error feedback)."""
+        if self._pending_residuals is not None:
+            saved_sig, arrays = self._pending_residuals
+            # the param signature alone is not enough: a different
+            # MXNET_BUCKET_SIZE_MB regroups the same params into
+            # different flat buckets, so the residual ARRAY layout must
+            # match too (else the jitted quantize dies on shapes)
+            if saved_sig != self._bucket_sig or \
+                    tuple(int(a.shape[0]) for a in arrays) != bk.sizes:
+                raise MXNetError(
+                    "Trainer.load_states: checkpointed compression "
+                    "residuals were saved for a different parameter/"
+                    f"bucket signature ({len(arrays)} buckets over "
+                    f"{len(saved_sig[0])} dense params; current layout "
+                    f"has {len(bk.sizes)} buckets over "
+                    f"{len(self._bucket_sig[0])} dense params with "
+                    "different shapes/dtypes/order). Resuming would "
+                    "silently reset 2-bit error feedback — load states "
+                    "saved from the same model and bucket layout "
+                    "(MXNET_BUCKET_SIZE_MB included).")
+            self._pending_residuals = None
+            return [jnp.asarray(a) for a in arrays]
+        return [jnp.zeros(n, dtype=jnp.float32) for n in bk.sizes]
 
     def _update(self, ignore_stale_grad=False):
         from ..optimizer import FusedUpdater
@@ -296,7 +359,8 @@ class Trainer:
                                         param.list_grad()):
                     u(i, grad if isinstance(grad, _sp.RowSparseNDArray)
                       else _sp.cast_storage(grad, "row_sparse"), arr)
-            live = [ip for ip in live if ip not in rsp]
+            rsp_idx = {i for i, _ in rsp}
+            live = [ip for ip in live if ip[0] not in rsp_idx]
             if not live:
                 self._clear_fresh(done)
                 return
@@ -308,8 +372,15 @@ class Trainer:
                 # _allreduce_grads staged every dense live param in the
                 # buckets; a param outside `idx` would train on its raw
                 # UN-REDUCED grad buffer (the for_step path deliberately
-                # never rewrites per-key grads), so fail loudly instead
-                assert all(i in pos for i, _ in live), (idx, live)
+                # never rewrites per-key grads), so fail loudly — a real
+                # raise, not an assert, so python -O cannot strip it
+                missing = [i for i, _ in live if i not in pos]
+                if missing:
+                    raise MXNetError(
+                        f"staged gradient buckets cover params {idx} but "
+                        f"the update set includes {missing} — the "
+                        "allreduce and update steps saw different live "
+                        "parameter sets")
                 if live:
                     upd.update_all(
                         [i for i, _ in live], flats,
@@ -351,21 +422,82 @@ class Trainer:
         if not self._kv_initialized:
             self._init_kvstore()
         if self._update_on_kvstore:
-            self._kv.save_optimizer_states(fname, dump_optimizer=True)
+            if self._kv._updater is None:
+                raise MXNetError("no optimizer set")
+            states = self._kv._updater.get_states(dump_optimizer=True)
         else:
-            with open(fname, "wb") as fout:
-                fout.write(self._updaters[0].get_states(dump_optimizer=True))
+            states = self._updaters[0].get_states(dump_optimizer=True)
+        with open(fname, "wb") as fout:
+            fout.write(self._wrap_states(states))
+
+    def _wrap_states(self, states: bytes) -> bytes:
+        """Without compression the file is the raw updater-state pickle
+        (format unchanged).  With compression active, the 2-bit
+        error-feedback residuals ride along in a sentinel-keyed wrapper
+        so a resumed run continues the same quantization trajectory
+        instead of silently restarting from zero error."""
+        bucket = None
+        if self._residuals is not None:
+            bucket = {"sig": self._bucket_sig,
+                      "residuals": [_np.asarray(r) for r in self._residuals]}
+        elif self._pending_residuals is not None:
+            saved_sig, arrays = self._pending_residuals
+            bucket = {"sig": saved_sig,
+                      "residuals": [_np.asarray(a) for a in arrays]}
+        kv_res = {}
+        if self._kv is not None and getattr(self._kv, "_residuals", None):
+            # per-key residuals (legacy per-key path and the
+            # update_on_kvstore fused pushpull both key them in the kv)
+            kv_res = {k: _np.asarray(v)
+                      for k, v in self._kv._residuals.items()}
+        if bucket is None and not kv_res:
+            return states
+        return pickle.dumps({"__mxt_trainer_states__": 1,
+                             "updater": states,
+                             "bucket": bucket,
+                             "kv_residuals": kv_res})
+
+    @staticmethod
+    def _unwrap_states(payload: bytes):
+        """(updater-state bytes, residual extras or None).  Raw legacy
+        files unpickle to the updater's own dict/tuple — never a dict
+        with the sentinel key — so detection cannot misfire."""
+        try:
+            obj = pickle.loads(payload)
+        except Exception:
+            return payload, None
+        if isinstance(obj, dict) and obj.get("__mxt_trainer_states__") == 1:
+            return obj["updater"], obj
+        return payload, None
 
     def load_states(self, fname):
         if not self._kv_initialized:
             self._init_kvstore()
+        with open(fname, "rb") as f:
+            payload = f.read()
+        states, extra = self._unwrap_states(payload)
         if self._update_on_kvstore:
-            self._kv.load_optimizer_states(fname)
+            if self._kv._updater is None:
+                raise MXNetError("no optimizer set")
+            self._kv._updater.set_states(states)
             self._optimizer = self._kv._updater.optimizer
         else:
-            with open(fname, "rb") as f:
-                states = f.read()
             for updater in self._updaters:
                 updater.set_states(states)
                 updater.optimizer = self._updaters[0].optimizer
             self._optimizer = self._updaters[0].optimizer
+        if extra is None:
+            return
+        kv_res = extra.get("kv_residuals") or {}
+        if kv_res and self._kv is not None:
+            self._kv._residuals = {k: jnp.asarray(v)
+                                   for k, v in kv_res.items()}
+        bucket = extra.get("bucket")
+        if bucket is None:
+            return
+        self._pending_residuals = (bucket["sig"], bucket["residuals"])
+        self._residuals = None
+        if self._bucket_sig is not None:
+            # a bucketer already exists: adopt (or reject) immediately
+            # instead of deferring the mismatch to the next step
+            self._residuals = self._init_residuals(self._bucketer)

@@ -23,7 +23,12 @@ TPU-native design (SURVEY.md §2.3 "TPU-native equivalent"):
     4 codes/byte; per-key residual carries the quantization error forward).
     On ICI it is off by default (bandwidth makes it unnecessary); when
     enabled via `set_gradient_compression` it is applied on the push path —
-    the useful case is DCN-connected multi-slice training.
+    the useful case is DCN-connected multi-slice training.  The fused
+    Trainer path composes it with bucketed allreduce:
+    `allreduce(values, compression=..., residuals=...)` quantizes flat
+    gradient buckets against flat residuals in one program and ships only
+    the packed payload on the dist leg (worker-quantize /
+    dequantize-sum split, parity: kvstore_dist.h PushCompressed).
 """
 from __future__ import annotations
 
@@ -36,7 +41,7 @@ import jax
 import jax.numpy as jnp
 import numpy as _np
 
-from .base import MXNetError
+from .base import MXNetError, getenv
 from . import ndarray as nd
 from .ndarray import NDArray
 from .observability import metrics as _metrics
@@ -120,6 +125,79 @@ _dequantize_2bit = jax.jit(_dequantize_2bit_impl,
                            static_argnames=("threshold", "size"))
 
 
+# -- bucket-level compressed allreduce programs -------------------------------
+# The quantizer is purely elementwise, so running it over FLAT GRADIENT
+# BUCKETS (kvstore.GradBucketer) with flat residual buffers preserves the
+# reference's per-parameter error-feedback semantics exactly — each
+# parameter's residual occupies its own slice of the bucket residual.
+# That is what lets 2-bit compression compose with the O(1)-dispatch fused
+# Trainer path instead of forcing the O(num_params) per-key loop.
+# jax.jit keys these module-level programs on bucket shapes + threshold,
+# so a signature change re-selects a cached program rather than retracing
+# under the same entry (same dispatch-stability rule as FusedUpdater).
+
+def _quantize_buckets_impl(flats, residuals, threshold):
+    """Per-bucket quantize with the residual update fused into the SAME
+    program (worker-side half of kvstore_dist.h PushCompressed) — one
+    launch for every bucket.  Also emits each bucket's mean |error| (=
+    mean |new residual|) so the compression_error histogram costs no
+    extra program."""
+    packeds, new_res, errs = [], [], []
+    for f, r in zip(flats, residuals):
+        packed, nr = _quantize_2bit_impl(f.reshape(-1), r, threshold)
+        packeds.append(packed)
+        new_res.append(nr)
+        errs.append(jnp.mean(jnp.abs(nr)))
+    return packeds, new_res, errs
+
+
+def _dequantize_sum_impl(stacks, threshold, shapes, dtypes):
+    """Dequantize every worker's packed payload and sum — the
+    server-side half of the reference split (kvstore_dist_server.h
+    DecompressAndMerge), one launch for every bucket.  stacks[k] is
+    (num_workers, packed_len) uint8."""
+    outs = []
+    for st, shape, dt in zip(stacks, shapes, dtypes):
+        size = 1
+        for s in shape:
+            size *= s
+        vals = jax.vmap(
+            lambda p, _t=threshold, _n=size: _dequantize_2bit_impl(
+                p, _t, _n))(st)
+        outs.append(jnp.sum(vals, axis=0).reshape(shape).astype(dt))
+    return outs
+
+
+def _compressed_reduce_local_impl(flats, residuals, threshold):
+    """Single-process compressed reduce: quantize + residual update +
+    dequantize fused into ONE program (there is no wire to cross, but
+    the quantize→dequantize round trip must still run so training sees
+    the same error-feedback trajectory as a multi-host pod — and as the
+    reference's per-key path)."""
+    packeds, new_res, errs = _quantize_buckets_impl(flats, residuals,
+                                                    threshold)
+    outs = [_dequantize_2bit_impl(p, threshold, f.size)
+            .reshape(f.shape).astype(f.dtype)
+            for p, f in zip(packeds, flats)]
+    return outs, new_res, errs
+
+
+# single-process: residuals (argnum 1) are donated — one fused program,
+# the caller always replaces its copy with the returned one, so the old
+# grad-sized f32 buffers back the new values in place.  The multi-host
+# _quantize_buckets deliberately does NOT donate: the all-gather wire
+# leg runs between quantize and the caller's reassignment, and a
+# transient DCN failure there must leave the caller's residuals valid
+# for retry, not pointing at deleted buffers.
+_quantize_buckets = jax.jit(_quantize_buckets_impl,
+                            static_argnames=("threshold",))
+_compressed_reduce_local = jax.jit(_compressed_reduce_local_impl,
+                                   static_argnames=("threshold",),
+                                   donate_argnums=(1,))
+_dequantize_sum = jax.jit(_dequantize_sum_impl,
+                          static_argnames=("threshold", "shapes", "dtypes"))
+
+
 class GradientCompression:
     """Parity: `src/kvstore/gradient_compression.h:37` — holds type +
     threshold; quantize/dequantize as XLA-compiled kernels."""
@@ -185,6 +263,7 @@ class GradBucketer:
             layout.append(tuple(cur))
         self.layout = tuple(layout)
         self.views: List[tuple] = [None] * len(self.sig)
+        sizes: List[int] = []
         for b, bucket in enumerate(self.layout):
             off = 0
             for pos in bucket:
@@ -192,6 +271,10 @@ class GradBucketer:
                 size = int(_np.prod(shape)) if shape else 1
                 self.views[pos] = (b, off, shape)
                 off += size
+            sizes.append(off)
+        # total elements per flat bucket — the Trainer sizes its
+        # error-feedback residual buffers off this
+        self.sizes = tuple(sizes)
         lay, sig_ = self.layout, self.sig
 
         def _flat(gs):
@@ -518,7 +601,8 @@ class KVStore:
         with trace_span("kvstore_allreduce", cat="kvstore"):
             return collectives.allreduce_hosts(merged)
 
-    def allreduce(self, values: List[NDArray]) -> List[NDArray]:
+    def allreduce(self, values: List[NDArray], compression=None,
+                  residuals=None):
         """Store-less dense allreduce: sum each value across its per-device
         copies and across hosts, return the reduced arrays.
 
@@ -528,18 +612,41 @@ class KVStore:
         with one entry PER VALUE: an NDArray, or that value's
         per-device-copy list of NDArrays.  (Unlike push/pushpull, a flat
         NDArray list here means N distinct values — never N device
-        copies of one value.)"""
+        copies of one value.)
+
+        compression: a GradientCompression (or compression_params dict)
+        switches on the 2-bit error-feedback leg and changes the return
+        to ``(reduced, new_residuals)``.  The intra-host device-copy
+        merge stays FULL precision (parity: the reference compresses
+        only the worker→server leg, kvstore_dist.h PushCompressed);
+        each value is then quantized against its entry in `residuals`
+        (flat f32 arrays OWNED BY THE CALLER, zero-initialized here when
+        None — note the old arrays are donated to XLA, so the caller
+        must replace its copy with the returned ones) and only the
+        PACKED payload (4 codes/byte) crosses the dist leg, which
+        all-gathers the packed buckets and dequantize-sums them.  On a
+        single process the quantize→dequantize round trip still runs —
+        same training trajectory as a pod, and as the reference's
+        per-key path — fused into one program."""
         vals = [list(v) if isinstance(v, (list, tuple)) else [v]
                 for v in values]
+        if compression is not None and not isinstance(
+                compression, GradientCompression):
+            compression = GradientCompression(**compression)
         if _metrics.ENABLED:
             t0 = time.perf_counter()
             with trace_span("kvstore_allreduce", cat="kvstore"):
-                out = self._allreduce_impl(vals)
+                out = self._allreduce_impl(vals) if compression is None \
+                    else self._compressed_allreduce_impl(
+                        vals, residuals, compression)
             _metrics.KVSTORE_ALLREDUCE_SECONDS.observe(
                 time.perf_counter() - t0)
             _metrics.KVSTORE_PUSH_BYTES.inc(sum(
                 _nd_bytes(v) for vl in vals for v in vl))
             return out
+        if compression is not None:
+            return self._compressed_allreduce_impl(vals, residuals,
+                                                   compression)
         return self._allreduce_impl(vals)
 
     def _allreduce_impl(self, vals: List[List[NDArray]]) -> List[NDArray]:
@@ -550,6 +657,58 @@ class KVStore:
             raw = collectives.allreduce_hosts_many(raw)
         return [r if isinstance(r, NDArray) else NDArray(r, vl[0].context)
                 for r, vl in zip(raw, vals)]
+
+    def _compressed_allreduce_impl(self, vals, residuals,
+                                   gc: GradientCompression):
+        """2-bit error-feedback allreduce over transient values (the
+        Trainer's flat gradient buckets).  Returns (reduced NDArrays,
+        new residuals).  Steady-state launches: 1 (fused quantize+
+        dequantize+residual) on a single process; 3 (quantize, packed
+        all-gather, dequantize-sum) on a multi-host pod — the wire
+        moves ~1/16 of the float32 gradient bytes either way."""
+        if not vals:
+            return [], []
+        merged = [self._merge_local(vl) for vl in vals]
+        raw = [m._data if isinstance(m, NDArray) else m for m in merged]
+        if residuals is None:
+            residuals = [jnp.zeros(x.size, dtype=jnp.float32) for x in raw]
+        thr = gc.threshold
+        dist = self.num_workers > 1 and self.type != "local"
+        if _metrics.ENABLED:
+            _metrics.XLA_LAUNCHES.inc(kind="allreduce")
+        if dist:
+            packed, new_res, errs = _quantize_buckets(raw, residuals, thr)
+            from .parallel import collectives
+            stacks = collectives.allgather_stack_many(packed)
+            if _metrics.ENABLED:
+                _metrics.XLA_LAUNCHES.inc(2, kind="allreduce")
+            out = _dequantize_sum(
+                stacks, thr, tuple(tuple(x.shape) for x in raw),
+                tuple(str(x.dtype) for x in raw))
+        else:
+            out, new_res, errs = _compressed_reduce_local(
+                raw, residuals, thr)
+        if _metrics.ENABLED:
+            # wire accounting: dist stage=raw is what full precision
+            # WOULD ship per worker; stage=compressed is the packed
+            # payload that actually does (on a single process the dist
+            # leg is virtual, but the payload math is exact — the CPU
+            # acceptance gate reads these)
+            _metrics.KVSTORE_WIRE_BYTES.set(
+                sum(int(x.nbytes) for x in raw), leg="dist", stage="raw")
+            _metrics.KVSTORE_WIRE_BYTES.set(
+                sum((int(x.size) + 3) // 4 for x in raw),
+                leg="dist", stage="compressed")
+            _metrics.KVSTORE_WIRE_BYTES.set(
+                sum(_nd_bytes(v) for vl in vals for v in vl),
+                leg="intra", stage="raw")
+            if getenv("MXNET_COMPRESSION_ERROR_METRIC", True):
+                # float() blocks on the reduce program's tiny scalar
+                # outputs; =0 skips the sync on latency-critical runs
+                for e in errs:
+                    _metrics.COMPRESSION_ERROR.observe(float(e))
+        return ([o if isinstance(o, NDArray) else NDArray(o, vl[0].context)
+                 for o, vl in zip(out, vals)], new_res)
 
     # -- optimizer plumbing --------------------------------------------------
     def set_optimizer(self, optimizer: "opt.Optimizer") -> None:

@@ -358,6 +358,24 @@ PREFETCH_WAIT_SECONDS = Histogram(
     "mxnet_prefetch_wait_seconds",
     "Time the consumer blocked on the prefetch-to-device queue; near "
     "zero when the input pipeline keeps ahead of the device")
+KVSTORE_WIRE_BYTES = Gauge(
+    "mxnet_kvstore_wire_bytes",
+    "PER-WORKER PAYLOAD bytes of the most recent compressed bucketed "
+    "allreduce, by leg (intra = device-copy merge within a host, always "
+    "full precision; dist = cross-host DCN) and stage (raw = what full "
+    "precision would contribute, compressed = the packed 2-bit payload "
+    "actually contributed, ~1/16 on float32).  NOTE: the compressed "
+    "dist leg is an all-gather, so each worker RECEIVES "
+    "(num_workers-1) x this payload — compare against a raw ring "
+    "allreduce's ~2x raw bytes/worker when sizing pods (the 2-bit win "
+    "holds up to ~32 workers)")
+COMPRESSION_ERROR = Histogram(
+    "mxnet_compression_error",
+    "Mean |quantization error| per gradient bucket per compressed "
+    "allreduce (the error-feedback residual magnitude; bounded by the "
+    "2-bit threshold).  Growing means the threshold is too coarse for "
+    "the gradient scale",
+    buckets=(1e-4, 1e-3, 1e-2, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 10.0))
 
 
 def _hbm_stats_all() -> List[dict]:
@@ -437,6 +455,13 @@ def snapshot() -> dict:
         "transfer_bytes": TRANSFER_BYTES.value,
         "kvstore_push_bytes": KVSTORE_PUSH_BYTES.value,
         "kvstore_pull_bytes": KVSTORE_PULL_BYTES.value,
+        "kvstore_wire_bytes": {
+            "dist_raw": KVSTORE_WIRE_BYTES.get(leg="dist", stage="raw"),
+            "dist_compressed": KVSTORE_WIRE_BYTES.get(
+                leg="dist", stage="compressed"),
+            "intra_raw": KVSTORE_WIRE_BYTES.get(leg="intra", stage="raw"),
+        },
+        "compression_error_mean": COMPRESSION_ERROR.mean,
         "data_wait_ms_total": DATA_WAIT_SECONDS.sum * 1e3,
         "data_wait_ms_mean": DATA_WAIT_SECONDS.mean * 1e3,
         "engine_wait_seconds": ENGINE_WAIT_SECONDS.value,

@@ -948,6 +948,12 @@ class FusedUpdater(Updater):
         un-flattening costs no extra dispatch or copy.  (The bucket
         buffers are NOT donated — no output shares their shape — they
         stay live until the trainer drops its reference after the call.)
+
+        2-bit-compressed buckets arrive here already dequantized in the
+        gradient dtype (the error-feedback residual treedef lives with
+        the Trainer/kvstore, never in this program), so the cache key
+        below is compression-agnostic by construction: toggling
+        compression_params cannot grow the compiled-step cache.
         """
         opt_ = self.optimizer
         if not getattr(opt_, "fused", False):

@@ -61,6 +61,19 @@ def host_mesh() -> Mesh:
     return m
 
 
+def _stitch(mesh, x):
+    """One process's array as its slice of the 'hosts'-sharded global
+    array: device-native assembly — the value is replicated to the
+    process's local devices (D2D copies) and registered as that
+    process's row, no host round trip.  Shared by every cross-host leg
+    (dense allreduce, rsp row gather, packed-payload gather)."""
+    bufs = [jax.device_put(jnp.expand_dims(x, 0), d)
+            for d in mesh.devices[jax.process_index()]]
+    return jax.make_array_from_single_device_arrays(
+        (jax.process_count(),) + tuple(x.shape),
+        NamedSharding(mesh, P("hosts")), bufs)
+
+
 def allreduce_hosts_many(arrs):
     """Sum each array across worker processes in ONE compiled program.
 
@@ -75,21 +88,10 @@ def allreduce_hosts_many(arrs):
         return list(arrs)
     from ..ndarray import NDArray
     mesh = host_mesh()
-    shard = NamedSharding(mesh, P("hosts"))
     repl = NamedSharding(mesh, P())
     raw = [jnp.asarray(a._data if isinstance(a, NDArray) else a)
            for a in arrs]
-    nproc = jax.process_count()
-    # device-native global-array assembly: each process's merged value is
-    # replicated to its local devices (D2D copies), then stitched as the
-    # process's slice of the 'hosts'-sharded axis — no host round trip
-    pidx = jax.process_index()
-    local_row = list(mesh.devices[pidx])
-    glob = []
-    for x in raw:
-        bufs = [jax.device_put(jnp.expand_dims(x, 0), d) for d in local_row]
-        glob.append(jax.make_array_from_single_device_arrays(
-            (nproc,) + tuple(x.shape), shard, bufs))
+    glob = [_stitch(mesh, x) for x in raw]
     key = tuple((tuple(x.shape), str(x.dtype)) for x in raw)
     fn = _host_sum_cache.get(key)
     if fn is None:
@@ -108,6 +110,25 @@ def allreduce_hosts_many(arrs):
 def allreduce_hosts(arr):
     """Sum one NDArray across worker processes (KVStore multi-host push)."""
     return allreduce_hosts_many([arr])[0]
+
+
+def allgather_stack_many(arrs):
+    """Stack each array across worker processes: result[k] has shape
+    (num_processes,) + arrs[k].shape, with row p holding process p's
+    contribution, returned as the process-LOCAL replica.
+
+    The wire leg of the compressed kvstore allreduce: the only bytes
+    that cross DCN are the inputs themselves (one all-gather of the
+    PACKED 2-bit payloads — kvstore._compressed_allreduce_impl
+    dequantize-sums the replicated stack locally afterwards, mirroring
+    the reference's worker-quantize/server-dequantize-sum split in
+    kvstore_dist.h PushCompressed).  Single-process callers take the
+    fused local path instead; the identity stack here is a fallback."""
+    if jax.process_count() <= 1:
+        return [jnp.expand_dims(a, 0) for a in arrs]
+    mesh = host_mesh()
+    gathered = _repl_jit(mesh, _ident)([_stitch(mesh, a) for a in arrs])
+    return [g.addressable_data(0) for g in gathered]
 
 
 def host_barrier():
@@ -172,19 +193,10 @@ def allgather_rows_many(pairs, pad_rows_to=None):
         return [(ids, vals) for ids, vals in pairs]
     import numpy as np
     mesh = host_mesh()
-    shard = NamedSharding(mesh, P("hosts"))
-    nproc = jax.process_count()
-    pidx = jax.process_index()
-    local_row = list(mesh.devices[pidx])
-
-    def stitch(x):
-        bufs = [jax.device_put(jnp.expand_dims(x, 0), d) for d in local_row]
-        return jax.make_array_from_single_device_arrays(
-            (nproc,) + tuple(x.shape), shard, bufs)
 
     # leg 1: agree on every key's max nnz in one tiny replicated reduce
     nnz = jnp.asarray([ids.shape[0] for ids, _ in pairs], jnp.int32)
-    gmax = _repl_jit(mesh, _max0)(stitch(nnz))
+    gmax = _repl_jit(mesh, _max0)(_stitch(mesh, nnz))
     rsp_collective_programs += 1
     maxns = np.asarray(gmax.addressable_data(0)).tolist()
     if pad_rows_to is not None:
@@ -197,7 +209,7 @@ def allgather_rows_many(pairs, pad_rows_to=None):
             jnp.asarray(ids, jnp.int64))
         pvals = jnp.zeros((maxn,) + tuple(vals.shape[1:]), vals.dtype) \
             .at[:vals.shape[0]].set(vals)
-        padded.append((stitch(pids), stitch(pvals)))
+        padded.append((_stitch(mesh, pids), _stitch(mesh, pvals)))
     gathered = _repl_jit(mesh, _ident)(padded)
     rsp_collective_programs += 1
 

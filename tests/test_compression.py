@@ -1,0 +1,326 @@
+"""2-bit error-feedback gradient compression — the bucket-level
+programs and the compressed bucketed allreduce (ISSUE 3 tentpole).
+
+Reference semantics (src/kvstore/gradient_compression.h:37-134):
+r = grad + residual; r >= +T maps to +T, r <= -T to -T, else 0; the
+residual keeps r - out so the quantization error feeds the next step.
+The map is purely elementwise, so flat per-bucket residual buffers
+preserve per-parameter error feedback exactly — pinned here against a
+numpy reference and against the per-key quantizer; the Gluon
+fused-vs-legacy training parity lives in tests/test_fused_step.py.
+"""
+import pickle
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.kvstore import (_compressed_reduce_local, _dequantize_sum,
+                               _quantize_buckets)
+from mxnet_tpu.observability import metrics as M
+
+
+def _ref_quantize(grad, residual, threshold):
+    """Numpy reference of the reference threshold map (the kernel in
+    gradient_compression-inl.h)."""
+    r = grad.astype("f") + residual
+    out = np.where(r >= threshold, threshold,
+                   np.where(r <= -threshold, -threshold, 0.0)).astype("f")
+    return out, (r - out).astype("f")
+
+
+# ------------------------------------------------ bucket-level programs
+
+def test_bucket_quantize_matches_reference_threshold_map():
+    """+T / -T / 0 cells and the residual update, over multiple rounds
+    so the error feedback carries across calls like a training loop."""
+    rs = np.random.RandomState(0)
+    thr = 0.5
+    flats = [rs.normal(0, 0.7, (37,)).astype("f"),
+             rs.normal(0, 0.7, (8,)).astype("f")]
+    flats[0][:4] = [thr, -thr, thr - 0.01, -thr + 0.01]  # boundary cells
+    res = [np.zeros(37, "f"), np.zeros(8, "f")]
+    for _ in range(3):
+        outs, new_res, _ = _compressed_reduce_local(
+            [jnp.asarray(f) for f in flats],
+            [jnp.asarray(r) for r in res], thr)
+        for j in range(2):
+            exp, exp_res = _ref_quantize(flats[j], res[j], thr)
+            np.testing.assert_allclose(np.asarray(outs[j]), exp, rtol=1e-6)
+            np.testing.assert_allclose(np.asarray(new_res[j]), exp_res,
+                                       rtol=1e-6, atol=1e-7)
+            assert set(np.unique(np.asarray(outs[j]))) <= {0.0, thr, -thr}
+            res[j] = np.asarray(new_res[j])
+        flats = [rs.normal(0, 0.7, f.shape).astype("f") for f in flats]
+
+
+def test_packing_density():
+    """<= ceil(n/4) payload bytes per bucket — 4 codes/byte, including
+    the padded tail when n is not a multiple of 4."""
+    for n in (1, 2, 3, 4, 5, 37, 128):
+        packed, _, _ = _quantize_buckets(
+            [jnp.ones((n,), jnp.float32)],
+            [jnp.zeros(n, jnp.float32)], 0.5)
+        assert str(packed[0].dtype) == "uint8", packed[0].dtype
+        assert packed[0].nbytes <= (n + 3) // 4, (n, packed[0].nbytes)
+
+
+def test_dequantize_sum_over_worker_stack():
+    """The dist-leg half: each worker's packed payload dequantizes
+    independently and the results sum (the reference's server-side
+    dequantize-and-aggregate)."""
+    rs = np.random.RandomState(1)
+    thr = 0.5
+    g1 = rs.normal(0, 1, (11,)).astype("f")
+    g2 = rs.normal(0, 1, (11,)).astype("f")
+    z = lambda: [jnp.zeros(11, jnp.float32)]  # noqa: E731
+    p1, _, _ = _quantize_buckets([jnp.asarray(g1)], z(), thr)
+    p2, _, _ = _quantize_buckets([jnp.asarray(g2)], z(), thr)
+    out = _dequantize_sum([jnp.stack([p1[0], p2[0]])], thr,
+                          ((11,),), ("float32",))
+    e1, _ = _ref_quantize(g1, np.zeros(11, "f"), thr)
+    e2, _ = _ref_quantize(g2, np.zeros(11, "f"), thr)
+    np.testing.assert_allclose(np.asarray(out[0]), e1 + e2, rtol=1e-6)
+
+
+# ------------------------------------------- KVStore.allreduce variant
+
+def test_compressed_allreduce_threshold_plumbing_and_wire_bytes():
+    """Threshold parameter reaches the bucket programs (outputs live in
+    {+T, -T, 0}), shapes round-trip, and the KVSTORE_WIRE_BYTES gauges
+    report the 2-bit payload: compressed <= raw/8 (ISSUE 3 acceptance;
+    actual ratio is 1/16 + padding)."""
+    kv = mx.kv.create("tpu_sync")
+    thr = 2.0
+    rs = np.random.RandomState(2)
+    vals = [mx.nd.array(rs.normal(0, 3, (9, 5)).astype("f")),
+            mx.nd.array(rs.normal(0, 3, (17,)).astype("f"))]
+    reduced, res = kv.allreduce(
+        vals, compression={"type": "2bit", "threshold": thr})
+    assert [r.shape for r in reduced] == [(9, 5), (17,)]
+    for r in reduced:
+        u = set(np.unique(r.asnumpy()))
+        assert u <= {0.0, thr, -thr}, u
+    assert len(res) == 2 and res[0].shape == (45,) and res[1].shape == (17,)
+    raw = M.KVSTORE_WIRE_BYTES.get(leg="dist", stage="raw")
+    packed = M.KVSTORE_WIRE_BYTES.get(leg="dist", stage="compressed")
+    assert raw == 4 * (45 + 17), raw
+    assert packed == (45 + 3) // 4 + (17 + 3) // 4, packed
+    assert packed * 8 <= raw
+    assert M.KVSTORE_WIRE_BYTES.get(leg="intra", stage="raw") == raw
+
+
+def test_compressed_allreduce_error_feedback_round_trip():
+    """Residuals returned by one call feed the next: a gradient below
+    threshold accumulates until it crosses it (the error-feedback
+    contract that makes 2-bit training converge)."""
+    kv = mx.kv.create("tpu_sync")
+    comp = {"type": "2bit", "threshold": 0.5}
+    g = mx.nd.array(np.full(6, 0.2, "f"))
+    # r accumulates 0.2/step: 0.2 -> 0, 0.4 -> 0, 0.6 >= T -> +T
+    res = None
+    for expect in (0.0, 0.0, 0.5):
+        out, res = kv.allreduce([g], compression=comp, residuals=res)
+        np.testing.assert_allclose(out[0].asnumpy(), np.full(6, expect),
+                                   rtol=1e-6, atol=1e-7)
+    np.testing.assert_allclose(np.asarray(res[0]), np.full(6, 0.1, "f"),
+                               rtol=1e-5)  # 0.6 - 0.5 carries forward
+
+
+def test_bucket_residuals_equal_per_key_residuals():
+    """Concatenated per-key quantization == flat-bucket quantization —
+    the elementwise invariant that lets compression compose with
+    bucketing without changing error-feedback semantics."""
+    thr = 0.5
+    kv = mx.kv.create("tpu_sync")
+    kv.set_gradient_compression({"type": "2bit", "threshold": thr})
+    rs = np.random.RandomState(3)
+    shapes = [(4, 3), (7,), (5,)]
+    grads = [rs.normal(0, 0.6, s).astype("f") for s in shapes]
+    for _ in range(2):  # two rounds so per-key residuals are non-zero
+        per_key = [kv._compress(i, mx.nd.array(g))
+                   for i, g in enumerate(grads)]
+    flat = np.concatenate([g.ravel() for g in grads])
+    kv2 = mx.kv.create("tpu_sync")
+    res = None
+    for _ in range(2):
+        reduced, res = kv2.allreduce(
+            [mx.nd.array(flat)],
+            compression={"type": "2bit", "threshold": thr}, residuals=res)
+    np.testing.assert_allclose(
+        reduced[0].asnumpy(),
+        np.concatenate([p.asnumpy().ravel() for p in per_key]), rtol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(res[0]),
+        np.concatenate([np.asarray(kv._residuals[i]) for i in range(3)]),
+        rtol=1e-6, atol=1e-7)
+
+
+def test_compression_error_metric_and_knob(monkeypatch):
+    """The compression_error histogram observes one mean-|error| sample
+    per bucket; MXNET_COMPRESSION_ERROR_METRIC=0 skips the device sync."""
+    kv = mx.kv.create("tpu_sync")
+    comp = {"type": "2bit", "threshold": 0.5}
+    monkeypatch.setenv("MXNET_COMPRESSION_ERROR_METRIC", "0")
+    c0 = M.COMPRESSION_ERROR.count
+    kv.allreduce([mx.nd.array(np.full(8, 0.2, "f"))], compression=comp)
+    assert M.COMPRESSION_ERROR.count == c0
+    monkeypatch.delenv("MXNET_COMPRESSION_ERROR_METRIC", raising=False)
+    kv.allreduce([mx.nd.array(np.full(8, 0.2, "f"))], compression=comp)
+    assert M.COMPRESSION_ERROR.count == c0 + 1
+    # 0.2 below threshold -> everything is error
+    assert M.COMPRESSION_ERROR.sum > 0
+
+
+# ---------------------------------------- Trainer residual checkpoints
+
+def _mlp(depth=4, width=8, seed=11):
+    mx.random.seed(seed)
+    net = nn.HybridSequential()
+    with net.name_scope():
+        for _ in range(depth):
+            net.add(nn.Dense(width, activation="relu"))
+        net.add(nn.Dense(1))
+    net.hybridize()
+    net.initialize(mx.init.Xavier(), ctx=mx.cpu())
+    return net
+
+
+_COMP = {"type": "2bit", "threshold": 0.5}
+
+
+def _trainer(net, comp=_COMP):
+    return gluon.Trainer(net.collect_params(), "sgd",
+                         {"learning_rate": 0.05, "momentum": 0.9},
+                         kvstore="tpu_sync", update_on_kvstore=False,
+                         compression_params=comp)
+
+
+def _batch():
+    rs = np.random.RandomState(0)
+    return (mx.nd.array(rs.normal(0, 1, (8, 16)).astype("f")),
+            mx.nd.array(rs.normal(0, 1, (8, 1)).astype("f")))
+
+
+def _step(net, tr, x, y, loss_fn):
+    with autograd.record():
+        l = loss_fn(net(x), y)
+    l.backward()
+    tr.step(8)
+
+
+def test_trainer_threshold_plumbing():
+    net = _mlp()
+    tr = _trainer(net, comp={"type": "2bit", "threshold": 2.0})
+    x, y = _batch()
+    _step(net, tr, x, y, gluon.loss.L2Loss())
+    assert tr._kv._gc.threshold == 2.0
+    assert tr._residuals is not None  # fused-compressed path engaged
+
+
+def test_residuals_survive_checkpoint(tmp_path):
+    """save_states/load_states round-trips the error-feedback state:
+    resume == continuous training, bit-for-bit on weights AND
+    residuals (a silent zero-reset would diverge within one step)."""
+    x, y = _batch()
+    loss_fn = gluon.loss.L2Loss()
+    net1 = _mlp()
+    t1 = _trainer(net1)
+    for _ in range(5):
+        _step(net1, t1, x, y, loss_fn)
+    fname = str(tmp_path / "trainer.states")
+    t1.save_states(fname)
+    snap = [p.data().asnumpy().copy()
+            for p in net1.collect_params().values()]
+    for _ in range(2):
+        _step(net1, t1, x, y, loss_fn)
+    ref_w = [p.data().asnumpy() for p in net1.collect_params().values()]
+    ref_res = [np.asarray(r) for r in t1._residuals]
+
+    net2 = _mlp(seed=99)  # different init — weights restored from snap
+    for p, w in zip(net2.collect_params().values(), snap):
+        p.set_data(mx.nd.array(w))
+    t2 = _trainer(net2)
+    t2.load_states(fname)
+    for _ in range(2):
+        _step(net2, t2, x, y, loss_fn)
+    for a, b in zip(ref_w,
+                    [p.data().asnumpy()
+                     for p in net2.collect_params().values()]):
+        np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-8)
+    for a, b in zip(ref_res, [np.asarray(r) for r in t2._residuals]):
+        np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-8)
+
+
+def test_residual_signature_mismatch_raises(tmp_path):
+    """Loading residuals saved for a different model must raise clearly
+    — both when the target trainer has already stepped (immediate) and
+    when it has not (at first bucketer build)."""
+    x, y = _batch()
+    loss_fn = gluon.loss.L2Loss()
+    net1 = _mlp(depth=4)
+    t1 = _trainer(net1)
+    for _ in range(3):
+        _step(net1, t1, x, y, loss_fn)
+    fname = str(tmp_path / "trainer.states")
+    t1.save_states(fname)
+
+    net2 = _mlp(depth=5)
+    net2(x)  # materialize deferred shapes
+    t2 = _trainer(net2)
+    t2.load_states(fname)  # not stepped yet: deferred check
+    with pytest.raises(MXNetError, match="residuals"):
+        _step(net2, t2, x, y, loss_fn)
+
+    net3 = _mlp(depth=5)
+    t3 = _trainer(net3)
+    for _ in range(2):
+        _step(net3, t3, x, y, loss_fn)
+    with pytest.raises(MXNetError, match="residuals"):
+        t3.load_states(fname)  # already stepped: immediate check
+
+
+def test_residual_bucket_cap_mismatch_raises(tmp_path, monkeypatch):
+    """Same params, different MXNET_BUCKET_SIZE_MB: the param signature
+    matches but the residual bucket layout does not — must raise the
+    same clear error, not die on shapes inside the jitted quantize."""
+    x, y = _batch()
+    loss_fn = gluon.loss.L2Loss()
+    net1 = _mlp()
+    t1 = _trainer(net1)
+    for _ in range(3):
+        _step(net1, t1, x, y, loss_fn)
+    assert len(t1._residuals) == 1  # default cap: one bucket
+    fname = str(tmp_path / "trainer.states")
+    t1.save_states(fname)
+
+    monkeypatch.setenv("MXNET_BUCKET_SIZE_MB", "0.0001")  # bucket/param
+    net2 = _mlp(seed=99)
+    net2(x)
+    t2 = _trainer(net2)
+    t2.load_states(fname)
+    with pytest.raises(MXNetError, match="residuals"):
+        _step(net2, t2, x, y, loss_fn)
+
+
+def test_uncompressed_state_format_unchanged(tmp_path):
+    """Without compression the file stays the raw updater-state pickle
+    (no sentinel wrapper) so pre-existing checkpoints keep loading."""
+    x, y = _batch()
+    loss_fn = gluon.loss.L2Loss()
+    net = _mlp()
+    tr = _trainer(net, comp=None)
+    for _ in range(2):
+        _step(net, tr, x, y, loss_fn)
+    fname = str(tmp_path / "trainer.states")
+    tr.save_states(fname)
+    with open(fname, "rb") as f:
+        obj = pickle.loads(f.read())
+    assert not (isinstance(obj, dict)
+                and obj.get("__mxt_trainer_states__"))
+    tr.load_states(fname)  # raw format loads
