@@ -102,6 +102,8 @@ def _emit(partial):
         out["fused_step"] = _STATE["fused_step"]
     if _STATE.get("gluon_trainer") is not None:
         out["gluon_trainer"] = _STATE["gluon_trainer"]
+    if _STATE.get("inference") is not None:
+        out["inference"] = _STATE["inference"]
     if partial:
         out["partial"] = True
         out["phase"] = _STATE["phase"]
@@ -340,6 +342,18 @@ def _run():
             _STATE["gluon_trainer"] = {
                 "error": "%s: %s" % (type(e).__name__, str(e)[:200])}
 
+    # inference-serving rider (ISSUE 4; MXT_BENCH_INFER=0 skips): p50/p99
+    # request latency, throughput, compile count, and padding waste for
+    # per-request vs micro-batched serving through the shape-bucketed
+    # AOT path — same durability contract as the gluon rider
+    if os.environ.get("MXT_BENCH_INFER", "1") != "0":
+        _phase("inference", EPOCH_S)
+        try:
+            _STATE["inference"] = _inference_leg(mx, ctx)
+        except Exception as e:  # noqa: BLE001
+            _STATE["inference"] = {
+                "error": "%s: %s" % (type(e).__name__, str(e)[:200])}
+
 
 def _gluon_trainer_leg(mx, ctx):
     """Fused vs legacy vs fused-compressed Gluon Trainer A/B/C: steps/s,
@@ -410,6 +424,113 @@ def _gluon_trainer_leg(mx, ctx):
             os.environ.pop("MXNET_FUSED_TRAINER", None)
         else:
             os.environ["MXNET_FUSED_TRAINER"] = prev
+    return out
+
+
+def _inference_leg(mx, ctx):
+    """Shape-bucketed AOT serving A/B: per-request dispatch vs dynamic
+    micro-batching (mxnet_tpu.serving) on a dense MLP, mixed request
+    batch sizes.  Reports per-mode p50/p99 latency (ms), request and
+    row throughput, AOT compile count, and mean padding waste — the
+    numbers docs/inference.md tells operators to watch."""
+    from mxnet_tpu.observability import metrics as _m
+
+    # every number below (compiles, dispatches, padding waste) comes
+    # from the serve counters — with metrics disabled the leg would
+    # fabricate zeros, so force-enable for its duration (try/finally:
+    # a raising leg must not leave hooks enabled against
+    # MXNET_METRICS_ENABLED=0)
+    metrics_were_enabled = _m.ENABLED
+    if not metrics_were_enabled:
+        _m.enable()
+    try:
+        return _inference_leg_body(mx, ctx, _m)
+    finally:
+        if not metrics_were_enabled:
+            _m.disable()
+
+
+def _inference_leg_body(mx, ctx, _m):
+    import threading
+
+    from mxnet_tpu import serving, sym
+
+    rs = np.random.RandomState(0)
+    nin, nhid, nout = 64, 256, 32
+    net = sym.FullyConnected(sym.Variable("data"), num_hidden=nhid,
+                             name="fc1")
+    net = sym.Activation(net, act_type="relu")
+    net = sym.FullyConnected(net, num_hidden=nout, name="fc2")
+    net = sym.SoftmaxOutput(net, name="softmax")
+    arg_shapes, _, _ = net.infer_shape(data=(16, nin))
+    params = {"arg:" + n: mx.nd.array(
+        rs.normal(0, 0.05, s).astype("f"), ctx=ctx)
+        for n, s in zip(net.list_arguments(), arg_shapes)
+        if n not in ("data", "softmax_label")}
+    pred = serving.BucketedPredictor(net, params, {"data": (16, nin)},
+                                     dev=ctx)
+    t0 = time.perf_counter()
+    pred.warmup()
+    warmup_s = time.perf_counter() - t0
+
+    n_req = int(os.environ.get("MXT_BENCH_INFER_REQS", 200))
+    sizes = rs.randint(1, 9, n_req)  # mixed 1..8-row requests
+    reqs = [rs.normal(0, 1, (int(b), nin)).astype("f") for b in sizes]
+
+    def pctl(lat, q):
+        return float(np.percentile(np.asarray(lat) * 1e3, q))
+
+    out = {"warmup_s": round(warmup_s, 3),
+           "buckets": list(pred.spec.batch_buckets),
+           "compiles": _m.SERVE_COMPILES.value}
+
+    # leg A: one dispatch per request
+    compiles0 = _m.SERVE_COMPILES.value
+    lat = []
+    t0 = time.perf_counter()
+    for x in reqs:
+        t1 = time.perf_counter()
+        pred.predict(x)
+        lat.append(time.perf_counter() - t1)
+    dt = time.perf_counter() - t0
+    out["per_request"] = {
+        "p50_ms": round(pctl(lat, 50), 3), "p99_ms": round(pctl(lat, 99), 3),
+        "requests_per_s": round(n_req / dt, 1),
+        "rows_per_s": round(float(sizes.sum()) / dt, 1),
+        "hot_path_compiles": _m.SERVE_COMPILES.value - compiles0,
+    }
+
+    # leg B: the same traffic from concurrent clients, coalesced
+    compiles0 = _m.SERVE_COMPILES.value
+    batches0 = _m.SERVE_BATCHES.value
+    lat2, lock = [], threading.Lock()
+    with serving.MicroBatcher(pred, max_wait_ms=2.0) as bat:
+        def client(chunk):
+            for x in chunk:
+                t1 = time.perf_counter()
+                bat.predict(data=x)
+                d = time.perf_counter() - t1
+                with lock:
+                    lat2.append(d)
+        threads = [threading.Thread(target=client, args=(reqs[i::8],))
+                   for i in range(8)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        dt = time.perf_counter() - t0
+    n_batches = _m.SERVE_BATCHES.value - batches0
+    out["coalesced"] = {
+        "p50_ms": round(pctl(lat2, 50), 3), "p99_ms": round(pctl(lat2, 99), 3),
+        "requests_per_s": round(n_req / dt, 1),
+        "rows_per_s": round(float(sizes.sum()) / dt, 1),
+        "dispatches": n_batches,
+        "requests_per_dispatch": round(n_req / max(1, n_batches), 2),
+        "hot_path_compiles": _m.SERVE_COMPILES.value - compiles0,
+    }
+    out["padding_waste_last"] = round(_m.SERVE_PADDING_WASTE.get(), 4)
+    out["latency_ms_mean"] = round(_m.SERVE_LATENCY_SECONDS.mean * 1e3, 3)
     return out
 
 

@@ -64,4 +64,5 @@ from . import image
 from . import rtc
 from . import contrib
 from . import predictor
+from . import serving
 from . import export

@@ -161,6 +161,49 @@ def getenv(name: str, default):
     return ty(val)
 
 
+_COMPILE_CACHE_WIRED = False
+_COMPILE_CACHE_FAILED = False
+
+
+def maybe_enable_compile_cache() -> bool:
+    """Wire JAX's persistent compilation cache to MXNET_COMPILE_CACHE_DIR.
+
+    Every jit/AOT compile (training executors AND serving buckets) then
+    lands on disk, so a process restart — the serving case: a rolling
+    redeploy must not pay the full bucket-lattice compile again — loads
+    executables instead of recompiling.  Checked lazily at executor /
+    serving construction (not import) so the env can be set after
+    `import mxnet_tpu`; idempotent and near-free once wired.  Returns
+    whether the cache is active."""
+    global _COMPILE_CACHE_WIRED, _COMPILE_CACHE_FAILED
+    if _COMPILE_CACHE_WIRED:
+        return True
+    if _COMPILE_CACHE_FAILED:
+        return False  # warned once already; don't retry per bind
+    cache_dir = os.environ.get("MXNET_COMPILE_CACHE_DIR")
+    if not cache_dir:
+        return False
+    try:
+        _jax.config.update("jax_compilation_cache_dir", cache_dir)
+        # default thresholds skip "cheap" compiles — serving buckets are
+        # exactly the small programs the restart win comes from, so
+        # persist everything
+        for knob, val in (("jax_persistent_cache_min_compile_time_secs", 0),
+                          ("jax_persistent_cache_min_entry_size_bytes", -1)):
+            try:
+                _jax.config.update(knob, val)
+            except Exception:  # noqa: BLE001 — knob name drifts by version
+                pass
+    except Exception as e:  # noqa: BLE001
+        import warnings
+        warnings.warn(f"MXNET_COMPILE_CACHE_DIR={cache_dir!r} could not be "
+                      f"wired: {e}")
+        _COMPILE_CACHE_FAILED = True
+        return False
+    _COMPILE_CACHE_WIRED = True
+    return True
+
+
 # ---------------------------------------------------------------------------
 # Generic registry (parity: dmlc::Registry / python/mxnet/registry.py)
 # ---------------------------------------------------------------------------

@@ -25,7 +25,7 @@ from typing import Dict, List, Optional
 import jax
 import jax.numpy as jnp
 
-from .base import MXNetError, getenv
+from .base import MXNetError, getenv, maybe_enable_compile_cache
 from .context import Context
 from .ndarray import NDArray
 from .observability import metrics as _metrics
@@ -49,6 +49,10 @@ class Executor:
                  aux_states: Dict[str, NDArray], group2ctx=None,
                  shared_exec: Optional["Executor"] = None,
                  mesh=None, data_shard_args=()):
+        # persistent XLA compile cache (MXNET_COMPILE_CACHE_DIR): wired
+        # at bind time so training executors share the on-disk cache the
+        # serving path uses — a restart skips recompiles in both worlds
+        maybe_enable_compile_cache()
         self._symbol = symbol
         self._ctx = ctx if isinstance(ctx, Context) else Context(ctx)
         self.arg_dict = dict(args)

@@ -18,7 +18,7 @@ import jax
 import jax.numpy as jnp
 import numpy as _np
 
-from ..base import MXNetError
+from ..base import MXNetError, getenv
 from ..context import cpu
 from ..observability import metrics as _metrics
 from .. import ndarray as nd
@@ -217,6 +217,36 @@ class CachedOp:
             lambda args, aux, key, t: self.plan.run(args, aux, key, t),
             static_argnums=(3,))
         self._bwd_cache = {}
+        self._fwd_donated = None  # built on first donated inference call
+
+    def _get_fwd_donated(self):
+        """Inference-mode forward that DONATES the non-parameter inputs
+        (MXNET_DONATE_INFER): the data buffer's HBM block is released to
+        the program instead of held live across the call — the serving
+        path's donated-buffer dispatch, available to hybridized blocks.
+        Params/aux ride a separate non-donated slot, so weights survive.
+        Caveat (docs/inference.md): on backends with real donation the
+        caller's input NDArray is consumed by the call."""
+        if self._fwd_donated is None:
+            plan = self.plan
+
+            def fwd_d(data_vals, param_vals, aux_vals, key, t):
+                merged = dict(param_vals)
+                merged.update(data_vals)
+                return plan.run(merged, aux_vals, key, t)
+
+            # one-time, narrowly-scoped filter install (NOT a per-call
+            # warnings.catch_warnings, which mutates process-global
+            # filter state non-thread-safely on every forward): backends
+            # without usable donation warn at each retrace; the user
+            # opted into best-effort donation, so that specific warning
+            # is expected noise
+            import warnings as _warnings
+            _warnings.filterwarnings(
+                "ignore", message="Some donated buffers were not usable")
+            self._fwd_donated = jax.jit(
+                fwd_d, static_argnums=(4,), donate_argnums=(0,))
+        return self._fwd_donated
 
     def _run_all(self, names, vals_list, aux_vals, key, is_train):
         d = dict(zip(names, vals_list))
@@ -241,7 +271,7 @@ class CachedOp:
         return self._bwd_cache[key_]
 
     def __call__(self, arg_arrays: Dict[str, NDArray],
-                 aux_arrays: Dict[str, NDArray], ctx):
+                 aux_arrays: Dict[str, NDArray], ctx, input_names=None):
         from .. import random as _random
         is_train = autograd.is_training()
         arg_vals = {k: v._data for k, v in arg_arrays.items()}
@@ -252,6 +282,21 @@ class CachedOp:
             # a hybridized step is visible in dispatch_counts() as one
             # xla:fwd plus (when recording) one xla:bwd at backward time
             _metrics.XLA_LAUNCHES.inc(kind="fwd")
+        # the env read is short-circuited off the training path and is
+        # one dict lookup per inference forward — kept per-call (not a
+        # module snapshot) so the knob can be toggled at runtime
+        if input_names and not is_train and not autograd.is_recording() \
+                and getenv("MXNET_DONATE_INFER", False):
+            data_vals = {k: arg_vals[k] for k in input_names
+                         if k in arg_vals}
+            param_vals = {k: v for k, v in arg_vals.items()
+                          if k not in data_vals}
+            outs, new_aux = self._get_fwd_donated()(
+                data_vals, param_vals, aux_vals, key, is_train)
+            out_nds = [NDArray(o, ctx) for o in outs]
+            for k, v in new_aux.items():
+                aux_arrays[k]._set_data(v)
+            return out_nds
         outs, new_aux = self._fwd(arg_vals, aux_vals, key, is_train)
         out_nds = [NDArray(o, ctx) for o in outs]
         if autograd.is_recording():
@@ -390,11 +435,19 @@ class HybridBlock(Block):
         entry = getattr(self, "_cached_by_fmt", {}).get(
             self._fmt_key(in_format))
         if entry is not None and "op" in entry:
+            # the cached-op analog of the executor's _jit_cache
+            # accounting: a hybridized forward that reuses its compiled
+            # op is a hit, a (re)trace is a miss — snapshot()["jit_cache"]
+            # now covers the gluon path too
+            if _metrics.ENABLED:
+                _metrics.JIT_CACHE_HITS.inc()
             (self._cached_op, self._cached_input_names,
              self._cached_params, self._cached_aux) = entry["op"]
             self._in_format = in_format
             self._out_format = entry["out_format"]
         else:
+            if _metrics.ENABLED:
+                _metrics.JIT_CACHE_MISSES.inc()
             self._build_cache(*args)
         arg_dict = {}
         aux_dict = {}
@@ -406,7 +459,8 @@ class HybridBlock(Block):
             else:
                 arg_dict[name] = p.data()
         ctx = flat_args[0].context if flat_args else cpu()
-        out = self._cached_op(arg_dict, aux_dict, ctx)
+        out = self._cached_op(arg_dict, aux_dict, ctx,
+                              input_names=self._cached_input_names)
         ret, _ = _regroup(out, self._out_format)
         return ret
 

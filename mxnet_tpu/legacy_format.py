@@ -146,11 +146,28 @@ def is_reference_format(fname: str) -> bool:
         struct.unpack("<Q", head)[0] == REFERENCE_LIST_MAGIC
 
 
+def is_reference_buffer(buf: bytes) -> bool:
+    """`is_reference_format` for an in-memory blob (no file round trip)."""
+    return len(buf) >= 8 and \
+        struct.unpack("<Q", buf[:8])[0] == REFERENCE_LIST_MAGIC
+
+
+def load_reference_buffer(buf: bytes, origin: str = "<buffer>"):
+    """`load_reference_format` for an in-memory blob: same return
+    contract (dict when named, else list), no temp file."""
+    r = _Reader(buf)
+    return _load_reference_reader(r, origin)
+
+
 def load_reference_format(fname: str):
     """dict {name: NDArray} when the file carries names, else a list —
     the same return contract as the reference's mx.nd.load."""
     with open(fname, "rb") as f:
         r = _Reader(f.read())
+    return _load_reference_reader(r, fname)
+
+
+def _load_reference_reader(r: "_Reader", fname: str):
     if r.u64() != REFERENCE_LIST_MAGIC:
         raise MXNetError(f"{fname}: not a reference-format NDArray file")
     r.u64()  # reserved

@@ -202,6 +202,64 @@ class BucketingModule(BaseModule):
                        data_batch.provide_label)
         self._active, self._active_key = held, held_key
 
+    def warmup_buckets(self, buckets, run=True, for_training=False):
+        """AOT-warm a set of buckets before traffic (the serving-path
+        `warmup()` idea applied to the training/eval bucketing surface):
+        `buckets` is an iterable of (bucket_key, data_shapes,
+        label_shapes) triples.  Each bucket is materialized (bound,
+        params shared) and — with `run=True` — executed once on zeros so
+        its XLA programs compile NOW; after warmup, `switch_bucket`
+        between warmed keys costs a dict lookup and zero recompiles
+        (pinned by tests/test_serving.py).
+
+        `for_training=True` warms the fused forward+backward program
+        instead of the inference forward (the two are distinct XLA
+        executables — an inference-only warmup leaves the first training
+        step on each bucket paying its compile).  The warmup
+        forward_backward writes zeros-derived values into the grad
+        buffers; they are zeroed afterwards so grad_req='add'
+        accumulation never trains on warmup-contaminated gradients."""
+        self._require(params=True)
+        from .. import ndarray as nd
+        from ..io import DataBatch
+        if for_training and not self.for_training:
+            raise MXNetError(
+                "warmup_buckets(for_training=True) on a module bound "
+                "with for_training=False")
+        held, held_key = self._active, self._active_key
+        try:
+            for bucket_key, data_shapes, label_shapes in buckets:
+                self._activate(bucket_key, data_shapes, label_shapes)
+                if not run:
+                    continue
+                data = [nd.zeros(tuple(d.shape), dtype=getattr(
+                    d, "dtype", "float32")) for d in data_shapes]
+                label = [nd.zeros(tuple(d.shape), dtype=getattr(
+                    d, "dtype", "float32")) for d in (label_shapes or [])]
+                batch = DataBatch(data=data, label=label or None, pad=0,
+                                  index=None, bucket_key=bucket_key,
+                                  provide_data=data_shapes,
+                                  provide_label=label_shapes)
+                if for_training:
+                    ex = self._active._exec
+                    # a training-mode forward on the zeros batch also
+                    # advances aux state (BatchNorm moving stats) —
+                    # snapshot and restore so warmup mutates NOTHING
+                    aux_snap = {k: v._data for k, v in ex.aux_dict.items()}
+                    self._active.forward_backward(batch)
+                    for k, v in aux_snap.items():
+                        ex.aux_dict[k]._set_data(v)
+                    # scrub the warmup grads: under grad_req='add' they
+                    # would otherwise accumulate into the first real step
+                    for g in ex.grad_dict.values():
+                        if g is not None:
+                            g._set_data(nd.zeros(
+                                g.shape, dtype=g.dtype)._data)
+                else:
+                    self._active.forward(batch, is_train=False)
+        finally:
+            self._active, self._active_key = held, held_key
+
     # -- compute ------------------------------------------------------------
     def forward(self, data_batch, is_train=None):
         self._require(params=True)

@@ -4,7 +4,8 @@ Parity: `python/mxnet/ndarray/__init__.py` — flat op functions plus
 `random`, `linalg`, `sparse` sub-namespaces.
 """
 from .ndarray import (NDArray, array, zeros, ones, full, empty, arange, eye,
-                      concatenate, moveaxis, waitall, save, load, from_numpy,
+                      concatenate, moveaxis, waitall, save, load,
+                      load_frombuffer, from_numpy,
                       from_dlpack, equal, not_equal, greater, greater_equal,
                       lesser, lesser_equal, modulo, true_divide,
                       onehot_encode)

@@ -677,6 +677,15 @@ def save(fname: str, data) -> None:
         os.replace(fname + ".npz", fname)
 
 
+def _from_npz(z):
+    keys = list(z.keys())
+    if keys == ["__mx_single__"]:
+        return array(z["__mx_single__"])
+    if all(k.startswith("__mx_list_") for k in keys):
+        return [array(z[k]) for k in sorted(keys)]
+    return {k: array(z[k]) for k in keys}
+
+
 def load(fname: str):
     # reference-era binary .params files (dmlc list container) load
     # transparently — load_checkpoint on a reference checkpoint works
@@ -684,9 +693,20 @@ def load(fname: str):
     if is_reference_format(fname):
         return load_reference_format(fname)
     with _np.load(fname, allow_pickle=False) as z:
-        keys = list(z.keys())
-        if keys == ["__mx_single__"]:
-            return array(z["__mx_single__"])
-        if all(k.startswith("__mx_list_") for k in keys):
-            return [array(z[k]) for k in sorted(keys)]
-        return {k: array(z[k]) for k in keys}
+        return _from_npz(z)
+
+
+def load_frombuffer(buf):
+    """Deserialize an in-memory param/array blob — what `load` does for
+    a file, without the file (parity: MXNDArrayLoadFromBuffer,
+    c_api.cc; the C predict API hands the param blob over by pointer).
+    Accepts both container formats `load` does: reference-era dmlc list
+    files and the .npz container `save` writes."""
+    import io as _io
+    from ..legacy_format import (is_reference_buffer,
+                                 load_reference_buffer)
+    buf = bytes(buf)
+    if is_reference_buffer(buf):
+        return load_reference_buffer(buf)
+    with _np.load(_io.BytesIO(buf), allow_pickle=False) as z:
+        return _from_npz(z)

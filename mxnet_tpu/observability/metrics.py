@@ -369,6 +369,39 @@ KVSTORE_WIRE_BYTES = Gauge(
     "(num_workers-1) x this payload — compare against a raw ring "
     "allreduce's ~2x raw bytes/worker when sizing pods (the 2-bit win "
     "holds up to ~32 workers)")
+SERVE_REQUESTS = Counter(
+    "mxnet_serve_requests_total",
+    "Inference requests served by the serving fast path "
+    "(mxnet_tpu.serving), coalesced or not")
+SERVE_BATCHES = Counter(
+    "mxnet_serve_batches_total",
+    "Bucket dispatches issued by the serving fast path — one compiled "
+    "XLA launch each; requests/batches is the coalescing factor")
+SERVE_COMPILES = Counter(
+    "mxnet_serve_compiles_total",
+    "AOT bucket compiles (lower().compile()).  After warmup() this must "
+    "stay FLAT under traffic — growth means requests are escaping the "
+    "bucket set and paying hot-path compiles")
+SERVE_QUEUE_DEPTH = Gauge(
+    "mxnet_serve_queue_depth",
+    "Requests waiting in the micro-batcher queue (sampled at "
+    "submit/drain)")
+SERVE_PADDING_WASTE = Gauge(
+    "mxnet_serve_padding_waste",
+    "Fraction of the most recent serving dispatch's input elements that "
+    "were bucket padding (dead compute).  Persistently high means the "
+    "bucket ladder is too coarse for the traffic: widen "
+    "MXNET_SERVE_BUCKETS")
+SERVE_COALESCED_ROWS = Gauge(
+    "mxnet_serve_coalesced_rows",
+    "Rows in the most recent coalesced micro-batch (before bucket "
+    "padding)")
+SERVE_LATENCY_SECONDS = Histogram(
+    "mxnet_serve_request_seconds",
+    "End-to-end request latency through the serving fast path (includes "
+    "micro-batcher queue wait on the coalesced path)",
+    buckets=(1e-4, 2.5e-4, 5e-4, 1e-3, 2.5e-3, 5e-3, 1e-2, 2.5e-2,
+             5e-2, 0.1, 0.25, 1.0, 5.0))
 COMPRESSION_ERROR = Histogram(
     "mxnet_compression_error",
     "Mean |quantization error| per gradient bucket per compressed "
@@ -468,6 +501,15 @@ def snapshot() -> dict:
         "jit_cache": {"hits": JIT_CACHE_HITS.value,
                       "misses": JIT_CACHE_MISSES.value},
         "optimizer_steps": OPTIMIZER_STEPS.value,
+        "serving": {
+            "requests": SERVE_REQUESTS.value,
+            "batches": SERVE_BATCHES.value,
+            "compiles": SERVE_COMPILES.value,
+            "queue_depth": SERVE_QUEUE_DEPTH.get(),
+            "padding_waste": SERVE_PADDING_WASTE.get(),
+            "coalesced_rows": SERVE_COALESCED_ROWS.get(),
+            "latency_ms_mean": SERVE_LATENCY_SECONDS.mean * 1e3,
+        },
         "hbm": hbm_stats(),
     }
 

@@ -16,7 +16,7 @@ import numpy as _np
 import jax
 import jax.numpy as jnp
 
-from .base import MXNetError, Registry
+from .base import MXNetError, Registry, getenv
 from . import ndarray as nd
 from .ndarray import NDArray
 from .observability import metrics as _metrics
@@ -934,7 +934,8 @@ class FusedUpdater(Updater):
             out.append(f[off:off + size].reshape(shape))
         return out
 
-    def update_all(self, indices, grads, weights, grad_views=None) -> None:
+    def update_all(self, indices, grads, weights, grad_views=None,
+                   donate_weights=None) -> None:
         """Apply the optimizer to all (grad, weight) pairs in one dispatch.
 
         grads: NDArray or raw jax arrays; weights: NDArrays (updated
@@ -954,8 +955,18 @@ class FusedUpdater(Updater):
         the Trainer/kvstore, never in this program), so the cache key
         below is compression-agnostic by construction: toggling
         compression_params cannot grow the compiled-step cache.
+
+        donate_weights (default MXNET_DONATE_WEIGHTS, off): donate the
+        weight buffers too — each new weight aliases its old buffer, so
+        the optimizer step updates parameters truly IN PLACE (no second
+        copy of the model live during the update).  Off by default
+        because executor snapshots / user-held NDArray views may still
+        alias the old buffers; enable when the trainer owns the weights
+        outright (docs/perf_tuning.md).
         """
         opt_ = self.optimizer
+        if donate_weights is None:
+            donate_weights = getenv("MXNET_DONATE_WEIGHTS", False)
         if not getattr(opt_, "fused", False):
             if grad_views is not None:
                 grads = self._materialize_views(grads, grad_views)
@@ -976,7 +987,8 @@ class FusedUpdater(Updater):
                     self(i, g, w)
             if dense:
                 di, dg, dw = zip(*dense)
-                self.update_all(list(di), list(dg), list(dw))
+                self.update_all(list(di), list(dg), list(dw),
+                                donate_weights=donate_weights)
             return
         indices = list(indices)
         for i, w in zip(indices, weights):
@@ -997,7 +1009,8 @@ class FusedUpdater(Updater):
                tuple(str(w.dtype) for w in wvals),
                tuple(str(g.dtype) for g in gvals),
                tuple(str(getattr(w, "sharding", None)) for w in wvals),
-               jax.tree_util.tree_structure(svals), views)
+               jax.tree_util.tree_structure(svals), views,
+               bool(donate_weights))
         fn = self._fn_cache.get(key)
         if fn is None:
             idx = list(indices)
@@ -1029,11 +1042,13 @@ class FusedUpdater(Updater):
                 return nws, nss, ts + 1
 
             # donate states (owned exclusively by this updater, aliased to
-            # the new-state outputs); weights are not donated — executor
-            # snapshots may still alias their buffers.  Flat grad buckets
-            # are NOT donated: no output shares their shape, so donation
-            # could never alias and would only warn.
-            fn = jax.jit(_apply, donate_argnums=(2,))
+            # the new-state outputs); weights join the donation set only
+            # under the donate_weights knob — executor snapshots may
+            # still alias their buffers in the general case.  Flat grad
+            # buckets are NOT donated: no output shares their shape, so
+            # donation could never alias and would only warn.
+            fn = jax.jit(_apply,
+                         donate_argnums=(0, 2) if donate_weights else (2,))
             self._fn_cache[key] = fn
         if _metrics.ENABLED:
             _metrics.XLA_LAUNCHES.inc(kind="optimizer")

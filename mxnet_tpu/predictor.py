@@ -22,6 +22,41 @@ from . import symbol as sym_mod
 from .context import Context, cpu
 
 
+def load_param_payload(params) -> Dict[str, NDArray]:
+    """Normalize a param payload to {name: NDArray}.
+
+    Accepts a ready dict (NDArray or numpy values), a serialized blob
+    as bytes — parsed IN MEMORY via `nd.load_frombuffer` (MXPredCreate
+    takes the blob by pointer; the old tempfile write/unlink round trip
+    put a disk write on the model-load path) — or a file path."""
+    if isinstance(params, dict):
+        return {k: v if isinstance(v, NDArray) else nd.array(v)
+                for k, v in params.items()}
+    if isinstance(params, (bytes, bytearray, memoryview)):
+        loaded = nd.load_frombuffer(bytes(params))
+    else:
+        loaded = nd.load(params)
+    if not isinstance(loaded, dict):
+        raise MXNetError(
+            "param payload must carry named arrays (arg:/aux: prefixes "
+            "or plain names); got an unnamed list")
+    return loaded
+
+
+def split_arg_aux(params: Dict[str, NDArray]):
+    """Split a loaded param dict on the `arg:`/`aux:` save prefixes
+    (unprefixed names count as args, matching MXPredCreate)."""
+    arg_params, aux_params = {}, {}
+    for k, v in params.items():
+        if k.startswith("arg:"):
+            arg_params[k[4:]] = v
+        elif k.startswith("aux:"):
+            aux_params[k[4:]] = v
+        else:
+            arg_params[k] = v
+    return arg_params, aux_params
+
+
 class Predictor:
     """Parity: MXPredCreate → the handle; methods mirror the C calls."""
 
@@ -34,29 +69,8 @@ class Predictor:
             symbol = sym_mod.Group([internals[n] for n in output_names])
         self._symbol = symbol
         self._ctx = dev or cpu()
-        if isinstance(param_bytes_or_file, (bytes, bytearray)):
-            # MXPredCreate takes the param blob by pointer; accept bytes
-            import os as _os
-            import tempfile
-            with tempfile.NamedTemporaryFile(suffix=".params",
-                                             delete=False) as f:
-                f.write(param_bytes_or_file)
-                tmp_name = f.name
-            try:
-                params = nd.load(tmp_name)
-            finally:
-                _os.unlink(tmp_name)
-        else:
-            params = nd.load(param_bytes_or_file)
-        arg_params = {}
-        aux_params = {}
-        for k, v in params.items():
-            if k.startswith("arg:"):
-                arg_params[k[4:]] = v
-            elif k.startswith("aux:"):
-                aux_params[k[4:]] = v
-            else:
-                arg_params[k] = v
+        arg_params, aux_params = split_arg_aux(
+            load_param_payload(param_bytes_or_file))
 
         arg_names = symbol.list_arguments()
         self._input_names = [n for n in arg_names if n not in arg_params]

@@ -1,0 +1,27 @@
+"""mxnet_tpu.serving — the inference fast path.
+
+Three layers, composable (docs/inference.md is the guide):
+
+  - `BucketSpec` / `buckets` — the padded shape-bucket lattice
+    (pow2-derived, `MXNET_SERVE_BUCKETS` / `MXNET_SERVE_SEQ_BUCKETS`);
+  - `BucketedPredictor` — AOT-compiled executables per bucket
+    (`jax.jit(...).lower(...).compile()`), `warmup()` for zero
+    hot-path compiles, donated input buffers, persistent compile cache
+    via `MXNET_COMPILE_CACHE_DIR`;
+  - `MicroBatcher` — dynamic micro-batching: concurrent requests
+    coalesce into one covering-bucket dispatch
+    (`MXNET_SERVE_MAX_WAIT_MS` / `MXNET_SERVE_MAX_BATCH`).
+
+Reference lineage: the C predict API + bucketing executors of MXNet
+(arxiv 1512.01274) and TVM's ahead-of-time deployment modules
+(arxiv 1802.04799).
+"""
+from . import buckets
+from .buckets import (BucketSpec, covering_bucket, pad_to_shape,
+                      parse_bucket_env, pow2_buckets)
+from .predictor import BucketedPredictor
+from .batcher import MicroBatcher
+
+__all__ = ["BucketSpec", "BucketedPredictor", "MicroBatcher", "buckets",
+           "covering_bucket", "pad_to_shape", "parse_bucket_env",
+           "pow2_buckets"]

@@ -1,0 +1,256 @@
+"""Dynamic micro-batching for the serving fast path.
+
+Steady-state serving traffic is many small concurrent requests; each
+one dispatched alone wastes the accelerator (a TPU matmul at batch 1
+runs at the same step latency as batch 16).  The micro-batcher is the
+standard serving answer (TF-Serving's BatchingSession shape): a request
+queue plus one dispatcher thread that coalesces whatever arrived within
+`max_wait_ms` (or until `max_batch` rows) into ONE padded bucket
+dispatch, then scatters the output rows back to the callers' futures.
+
+Latency contract: a lone request waits at most `max_wait_ms` beyond its
+own dispatch; under load the queue drains continuously and the wait
+converges to zero (the previous dispatch IS the wait).
+"""
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from concurrent.futures import Future
+from typing import Dict, List, Optional
+
+import numpy as _np
+
+from ..base import MXNetError, getenv
+from ..observability import metrics as _metrics
+from .buckets import covering_bucket, pad_to_shape
+
+__all__ = ["MicroBatcher"]
+
+
+class _Request:
+    __slots__ = ("inputs", "rows", "future", "t0")
+
+    def __init__(self, inputs: Dict[str, _np.ndarray]):
+        self.inputs = inputs
+        self.rows = next(iter(inputs.values())).shape[0]
+        self.future: Future = Future()
+        self.t0 = time.perf_counter()
+
+
+class MicroBatcher:
+    """Coalesces concurrent `submit()`s into bucket-sized dispatches.
+
+    Parameters
+    ----------
+    predictor : BucketedPredictor
+        The AOT-compiled serving executor requests are routed through.
+    max_wait_ms : float
+        How long the dispatcher holds an open batch for more arrivals
+        (default `MXNET_SERVE_MAX_WAIT_MS`, 2 ms).  0 disables
+        coalescing-by-time: each drain takes only what already queued.
+    max_batch : int
+        Row cap per coalesced dispatch (default `MXNET_SERVE_MAX_BATCH`,
+        else the predictor's largest batch bucket).
+    """
+
+    def __init__(self, predictor, max_wait_ms: Optional[float] = None,
+                 max_batch: Optional[int] = None):
+        self._pred = predictor
+        if max_wait_ms is None:
+            max_wait_ms = getenv("MXNET_SERVE_MAX_WAIT_MS", 2.0)
+        self._max_wait_s = max(0.0, float(max_wait_ms)) / 1e3
+        self._max_batch = int(max_batch or predictor.spec.max_batch)
+        self._queue: "queue.Queue[_Request]" = queue.Queue()
+        self._pending: _Request = None  # displaced overflow, leads next group
+        self._closed = False
+        # serializes the closed-check+enqueue against close(): without
+        # it a submit() could enqueue after close() drained, leaving its
+        # future unresolved forever
+        self._submit_lock = threading.Lock()
+        self._thread = threading.Thread(
+            target=self._loop, name="mxnet-serve-batcher", daemon=True)
+        self._thread.start()
+
+    # -- client side ---------------------------------------------------------
+    def submit(self, **inputs) -> Future:
+        """Enqueue one request; resolves to the list of output arrays
+        (rows matching this request).  Never blocks on model execution:
+        oversized requests ride the dispatcher thread too (dispatched
+        alone; predict() chunks them over the largest bucket).  A
+        malformed request fails ITS OWN future at enqueue time — it is
+        never coalesced, so it cannot poison a group of well-formed
+        requests that arrived in the same wait window.
+
+        Output-shape note (seq-bucketed models): outputs come back at
+        the dispatched bucket's width — for a coalesced group that is
+        the GROUP's covering seq bucket, which may exceed the bucket
+        the same request would route to solo.  Consumers slice by their
+        request's true sequence length (valid-region values are
+        identical either way; docs/inference.md)."""
+        try:
+            # normalization can fail too (unknown input name, empty
+            # request) — every malformed-request shape must land on the
+            # returned future as a descriptive MXNetError, never escape
+            # as a raw KeyError in the caller's thread
+            self._pred._check_names(inputs)
+            req = _Request({n: self._pred._as_host(n, v)
+                            for n, v in inputs.items()})
+            self._pred._check_request(req.inputs)
+        except Exception as e:  # noqa: BLE001 — delivered to caller
+            f = Future()
+            f.set_exception(e)
+            return f
+        with self._submit_lock:
+            # atomic closed-check + enqueue: anything enqueued here is
+            # ahead of close()'s sentinel, so the dispatcher serves it
+            if self._closed:
+                raise MXNetError("MicroBatcher is closed")
+            self._queue.put(req)
+        if _metrics.ENABLED:
+            _metrics.SERVE_QUEUE_DEPTH.set(self._queue.qsize())
+        return req.future
+
+    def predict(self, **inputs) -> List[_np.ndarray]:
+        """Blocking submit — the drop-in replacement for
+        `predictor.predict` that rides the coalesced path."""
+        return self.submit(**inputs).result()
+
+    def close(self, timeout: float = 5.0) -> None:
+        """Drain and stop the dispatcher thread.  Requests that raced
+        past the sentinel fail loudly instead of hanging their caller's
+        Future.result() forever."""
+        with self._submit_lock:
+            if self._closed:
+                return
+            self._closed = True
+            self._queue.put(None)  # wake the dispatcher
+        self._thread.join(timeout)
+        # requests still queued when the dispatcher exits fail loudly
+        # instead of hanging their caller's Future.result() forever
+        alive = self._thread.is_alive()  # join timed out mid-dispatch
+        leftovers = []
+        if not alive and self._pending is not None:
+            # only touch _pending once the dispatcher is gone — it
+            # writes the slot concurrently while alive
+            leftovers.append(self._pending)
+            self._pending = None
+        while True:
+            try:
+                r = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            if r is not None:
+                leftovers.append(r)
+        if alive:
+            # the drain above may have eaten the close sentinel; re-arm
+            # it so the still-running dispatcher exits instead of
+            # blocking in queue.get() forever when its dispatch ends
+            self._queue.put(None)
+        for r in leftovers:
+            if not r.future.done():
+                r.future.set_exception(
+                    MXNetError("MicroBatcher closed before dispatch"))
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    # -- dispatcher side -----------------------------------------------------
+    def _take_group(self) -> Optional[List[_Request]]:
+        """Block for the first request, then hold the batch open until
+        max_wait elapses or max_batch rows have arrived."""
+        if self._pending is not None:
+            first, self._pending = self._pending, None
+        else:
+            first = self._queue.get()
+            if first is None:
+                return None
+        group, rows = [first], first.rows
+        deadline = time.perf_counter() + self._max_wait_s
+        while rows < self._max_batch:
+            remaining = deadline - time.perf_counter()
+            try:
+                nxt = self._queue.get(
+                    timeout=remaining if remaining > 0 else None,
+                    block=remaining > 0)
+            except queue.Empty:
+                break
+            if nxt is None:
+                self._queue.put(None)  # re-post the close sentinel
+                break
+            if rows + nxt.rows > self._max_batch:
+                # would overflow the largest bucket: dispatch what we
+                # have; hold the displaced request in the pending slot so
+                # it LEADS the next group (re-queueing would push it to
+                # the FIFO tail, starving large requests behind a steady
+                # stream of small ones)
+                self._pending = nxt
+                break
+            group.append(nxt)
+            rows += nxt.rows
+        if _metrics.ENABLED:
+            _metrics.SERVE_QUEUE_DEPTH.set(self._queue.qsize())
+        return group
+
+    def _dispatch_group(self, group: List[_Request]) -> None:
+        try:
+            names = list(group[0].inputs)
+            # per-request sequence lengths may differ: pad each request
+            # up to the group's covering seq bucket BEFORE stacking, so
+            # the coalesced batch is rectangular (host-side copies; the
+            # device still sees one transfer + one dispatch)
+            spec = self._pred.spec
+            stacked = {}
+            for n in names:
+                parts = [r.inputs[n] for r in group]
+                ax = spec.seq_axes.get(n)
+                if ax is not None and len(
+                        {p.shape[ax] for p in parts}) > 1:
+                    tgt = covering_bucket(
+                        spec.seq_buckets,
+                        max(p.shape[ax] for p in parts))
+                    parts = [pad_to_shape(
+                        p, p.shape[:ax] + (tgt,) + p.shape[ax + 1:])
+                        for p in parts]
+                stacked[n] = parts[0] if len(parts) == 1 else \
+                    _np.concatenate(parts, axis=0)
+            # the routed private path: request accounting happens HERE,
+            # per caller (predict() would count the stacked batch as one
+            # request and fold queue wait out of the latency histogram)
+            outs = self._pred._predict_routed(stacked)
+            lo = 0
+            for r in group:
+                # done() guard: close(timeout) may have already failed
+                # this future while a long dispatch (first-bucket
+                # compile) overran the join — an unguarded set_result
+                # would raise InvalidStateError and poison the rest of
+                # the group
+                if not r.future.done():
+                    r.future.set_result(
+                        [o[lo:lo + r.rows] for o in outs])
+                lo += r.rows
+            if _metrics.ENABLED:
+                now = time.perf_counter()
+                _metrics.SERVE_REQUESTS.inc(len(group))
+                for r in group:
+                    _metrics.SERVE_LATENCY_SECONDS.observe(now - r.t0)
+                _metrics.SERVE_COALESCED_ROWS.set(
+                    sum(r.rows for r in group))
+        except Exception as e:  # noqa: BLE001 — failures go to callers
+            for r in group:
+                if not r.future.done():
+                    r.future.set_exception(e)
+
+    def _loop(self) -> None:
+        while True:
+            group = self._take_group()
+            if group is None:
+                return
+            self._dispatch_group(group)
+            if self._closed and self._queue.empty() \
+                    and self._pending is None:
+                return

@@ -1,0 +1,322 @@
+"""Shape-bucketed AOT inference executor — the serving fast path.
+
+What the naive path costs: `Predictor` compiles one executable per
+EXACT input shape, so the first request at any unseen batch size or
+sequence length pays a full XLA compile on the hot path (seconds), and
+every request is its own dispatch.  This module is the TPU realization
+of the reference design pair the ROADMAP's serving north star points
+at — MXNet's bucketing executors (arxiv 1512.01274) and TVM's
+ahead-of-time compiled deployment modules (arxiv 1802.04799):
+
+  - a small fixed lattice of padded shape buckets (`buckets.BucketSpec`,
+    pow2-derived, `MXNET_SERVE_BUCKETS` override);
+  - each bucket AOT-compiled ONCE via `jax.jit(...).lower(...).compile()`
+    — `warmup()` moves every compile off the request path;
+  - JAX's persistent compilation cache (`MXNET_COMPILE_CACHE_DIR`) so a
+    process restart re-loads executables from disk instead of
+    recompiling;
+  - requests pad on host into the bucket shape (one device transfer,
+    ONE XLA dispatch per request/coalesced batch) and slice the valid
+    rows back out;
+  - the padded input buffer is donated to the executable
+    (`donate_argnums`) — on TPU the input HBM block is released to the
+    program instead of held across the call.
+"""
+from __future__ import annotations
+
+import threading
+import time
+import warnings
+from typing import Dict, List, Optional, Sequence
+
+import numpy as _np
+
+import jax
+
+from ..base import MXNetError, maybe_enable_compile_cache, np_dtype
+from ..context import cpu
+from ..ndarray import NDArray
+from ..observability import metrics as _metrics
+from ..observability.tracing import trace_span
+from .. import symbol as sym_mod
+from ..symbol import Symbol
+from ..symbol.graph import GraphPlan
+from .buckets import BucketSpec, pad_to_shape
+
+__all__ = ["BucketedPredictor"]
+
+
+class BucketedPredictor:
+    """Forward-only serving executor over a fixed shape-bucket lattice.
+
+    Parameters
+    ----------
+    symbol : Symbol or str
+        The inference graph (a Symbol, or its JSON as from
+        `Symbol.tojson()`).
+    params : dict / bytes / str
+        `{name: NDArray-or-numpy}` (optionally `arg:`/`aux:` prefixed),
+        a serialized param blob (parsed in memory), or a param file
+        path.
+    input_shapes : dict
+        `{input_name: shape}` — axis 0 is the batch axis; the declared
+        sizes are the maxima the default pow2 bucket ladders are
+        derived from.
+    seq_axes : dict, optional
+        `{input_name: axis}` marking a second bucketed (sequence) axis.
+        Sequence padding is exact only for position-independent models
+        (see docs/inference.md for the caveat).
+    donate : bool
+        Donate the padded input buffer to the compiled program
+        (default True; a no-op on backends without donation support).
+    """
+
+    def __init__(self, symbol, params, input_shapes: Dict[str, tuple],
+                 dev=None, batch_buckets=None, seq_axes=None,
+                 seq_buckets=None, input_dtypes=None,
+                 output_names: Optional[Sequence[str]] = None,
+                 donate: bool = True):
+        from ..predictor import load_param_payload, split_arg_aux
+        maybe_enable_compile_cache()
+        if isinstance(symbol, Symbol):
+            sym = symbol
+        else:
+            sym = sym_mod.load_json(symbol)
+        if output_names:
+            internals = sym.get_internals()
+            sym = sym_mod.Group([internals[n] for n in output_names])
+        self._symbol = sym
+        self._ctx = dev or cpu()
+        self._plan = GraphPlan(sym)
+        self._donate = bool(donate)
+
+        arg_params, aux_params = split_arg_aux(load_param_payload(params))
+        arg_names = sym.list_arguments()
+        self._input_names = [n for n in arg_names if n not in arg_params]
+        for name in input_shapes:
+            if name not in self._input_names:
+                raise MXNetError(
+                    f"'{name}' is not a free input of the symbol; free "
+                    f"inputs: {self._input_names}")
+        dev_j = self._ctx.jax_device()
+
+        def _to_dev(v):
+            return jax.device_put(
+                v._data if isinstance(v, NDArray) else _np.asarray(v), dev_j)
+
+        self._params = {k: _to_dev(v) for k, v in arg_params.items()}
+        self._aux = {k: _to_dev(v) for k, v in aux_params.items()}
+        self._input_dtypes = {
+            n: np_dtype((input_dtypes or {}).get(n, "float32"))
+            for n in input_shapes}
+
+        self.spec = BucketSpec(input_shapes, batch_buckets=batch_buckets,
+                               seq_axes=seq_axes, seq_buckets=seq_buckets)
+        # serving must be deterministic across identical requests — a
+        # fixed key, never the global stream (is_train=False consumes no
+        # randomness in stock models anyway)
+        self._rng = jax.random.PRNGKey(0)
+        self._compiled: Dict[tuple, object] = {}
+        self._extra: Dict[tuple, dict] = {}  # per-bucket zero placeholders
+        # compiles may be triggered concurrently by batcher + direct
+        # callers; one lock keeps "compile each bucket once" true
+        self._compile_lock = threading.Lock()
+
+        plan = self._plan
+
+        def _serve(data, extra, params, aux, key):
+            merged = dict(params)
+            merged.update(extra)
+            merged.update(data)
+            outs, _ = plan.run(merged, aux, key, False)
+            return list(outs)
+
+        self._jit = jax.jit(
+            _serve, donate_argnums=(0,) if self._donate else ())
+
+    # -- compilation ---------------------------------------------------------
+    def _placeholder_shapes(self, in_shapes: dict) -> dict:
+        """Zero placeholders for free args not served as inputs (label
+        heads of training symbols — MXPredCreate parity)."""
+        missing = [n for n in self._input_names if n not in in_shapes]
+        if not missing:
+            return {}
+        arg_shapes, _, _ = self._symbol.infer_shape_partial(**in_shapes)
+        inferred = dict(zip(self._symbol.list_arguments(), arg_shapes or []))
+        out = {}
+        for name in missing:
+            shp = inferred.get(name)
+            if shp is None:
+                raise MXNetError(
+                    f"input '{name}' has no declared shape and shape "
+                    f"inference could not determine one")
+            out[name] = tuple(shp)
+        return out
+
+    def precompile(self, key: tuple):
+        """AOT-compile one bucket (idempotent).  The compile happens via
+        lower().compile() so it also lands in the persistent compilation
+        cache when MXNET_COMPILE_CACHE_DIR is set."""
+        if key in self._compiled:
+            return self._compiled[key]
+        with self._compile_lock:
+            if key in self._compiled:
+                return self._compiled[key]
+            in_shapes = self.spec.bucket_input_shapes(key)
+            extra = {n: jax.device_put(
+                _np.zeros(s, _np.float32), self._ctx.jax_device())
+                for n, s in self._placeholder_shapes(in_shapes).items()}
+            data_avals = {n: jax.ShapeDtypeStruct(s, self._input_dtypes[n])
+                          for n, s in in_shapes.items()}
+            to_aval = lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype)  # noqa: E731
+            extra_avals = {k: to_aval(v) for k, v in extra.items()}
+            param_avals = {k: to_aval(v) for k, v in self._params.items()}
+            aux_avals = {k: to_aval(v) for k, v in self._aux.items()}
+            # bucket padding is only sound for batch-major outputs
+            # (valid rows slice back out on axis 0) — reject scalar /
+            # non-batch-major outputs HERE with a clear error instead of
+            # silently serving corrupted values (a batch-diluted mean,
+            # a time-major RNN output) or crashing at slice time
+            out_shapes = [o.shape for o in jax.eval_shape(
+                self._jit, data_avals, extra_avals, param_avals,
+                aux_avals, self._rng)]
+            bad = [s for s in out_shapes
+                   if len(s) < 1 or s[0] != key[0]]
+            if bad:
+                raise MXNetError(
+                    f"output shapes {out_shapes} are not batch-major "
+                    f"(axis 0 != bucket batch {key[0]}): this symbol "
+                    f"cannot be served through bucket padding "
+                    f"(docs/inference.md)")
+            with warnings.catch_warnings():
+                # CPU/odd backends report "donated buffers were not
+                # usable" when no output aliases the input shape; the
+                # donation is a best-effort HBM release, not a contract
+                warnings.filterwarnings(
+                    "ignore", message=".*donated buffers.*")
+                compiled = self._jit.lower(
+                    data_avals, extra_avals, param_avals, aux_avals,
+                    self._rng).compile()
+            if _metrics.ENABLED:
+                _metrics.SERVE_COMPILES.inc()
+            self._extra[key] = extra
+            self._compiled[key] = compiled
+            return compiled
+
+    def warmup(self, keys=None) -> "BucketedPredictor":
+        """Compile every bucket (or the given keys) ahead of traffic —
+        after this, serving any request within the bucket set performs
+        ZERO XLA compiles."""
+        for key in (keys if keys is not None else self.spec.all_keys()):
+            self.precompile(tuple(key))
+        return self
+
+    @property
+    def num_compiled(self) -> int:
+        return len(self._compiled)
+
+    # -- serving -------------------------------------------------------------
+    def _as_host(self, name: str, value) -> _np.ndarray:
+        """Request payloads normalize to host numpy in the declared input
+        dtype (the C predict API hands over host buffers; device-resident
+        NDArrays are fetched — serving's contract is host-in/host-out)."""
+        if isinstance(value, NDArray):
+            value = value.asnumpy()
+        arr = _np.asarray(value)
+        dt = self._input_dtypes[name]
+        if arr.dtype != dt:
+            arr = arr.astype(dt)
+        return arr
+
+    def _served_names(self) -> list:
+        return [n for n in self._input_names
+                if n in self.spec.input_shapes]
+
+    def _check_names(self, inputs) -> None:
+        served = self._served_names()
+        if set(inputs) != set(served):
+            raise MXNetError(
+                f"request needs exactly inputs {served}, got "
+                f"{sorted(inputs)}")
+
+    def _check_request(self, inputs: Dict[str, _np.ndarray]) -> None:
+        """Validate one request's input set and geometry up front: exact
+        served-input names, fixed (non-bucketed) dims matching the
+        declared template, sequence inside the largest seq bucket, and
+        one agreed batch size.  Raises MXNetError.  The micro-batcher
+        runs this at submit() so a malformed request fails ALONE instead
+        of poisoning the coalesced group it would have joined."""
+        self._check_names(inputs)
+        for n, a in inputs.items():
+            tmpl = self.spec.input_shapes[n]
+            if len(a.shape) != len(tmpl):
+                raise MXNetError(
+                    f"input '{n}': rank {len(a.shape)} != declared "
+                    f"rank {len(tmpl)} {tmpl}")
+            ax_seq = self.spec.seq_axes.get(n)
+            for i in range(1, len(tmpl)):
+                if i != ax_seq and a.shape[i] != tmpl[i]:
+                    raise MXNetError(
+                        f"input '{n}' dim {i} is {a.shape[i]}, declared "
+                        f"{tmpl[i]} (only batch/seq axes may vary)")
+        # one agreed batch size + seq inside the largest bucket
+        self.spec.route({n: a.shape for n, a in inputs.items()})
+
+    def _dispatch(self, key: tuple, padded: dict) -> list:
+        compiled = self.precompile(key)
+        if _metrics.ENABLED:
+            _metrics.XLA_LAUNCHES.inc(kind="serve")
+            _metrics.SERVE_BATCHES.inc()
+        with trace_span("serve_dispatch", cat="serving"):
+            return compiled(padded, self._extra[key], self._params,
+                            self._aux, self._rng)
+
+    def _predict_routed(self, inputs: Dict[str, _np.ndarray]) -> list:
+        shapes = {n: a.shape for n, a in inputs.items()}
+        key = self.spec.route(shapes)
+        rows = next(iter(shapes.values()))[0]
+        if key[0] is None:
+            # request larger than the biggest bucket: chunk over it
+            cap = self.spec.max_batch
+            outs_per_chunk = []
+            for lo in range(0, rows, cap):
+                chunk = {n: a[lo:lo + cap] for n, a in inputs.items()}
+                outs_per_chunk.append(self._predict_routed(chunk))
+            return [_np.concatenate(parts, axis=0)
+                    for parts in zip(*outs_per_chunk)]
+        bucket_shapes = self.spec.bucket_input_shapes(key)
+        padded = {n: pad_to_shape(a, bucket_shapes[n])
+                  for n, a in inputs.items()}
+        if _metrics.ENABLED:
+            _metrics.SERVE_PADDING_WASTE.set(
+                self.spec.waste_fraction(key, shapes))
+        outs = self._dispatch(key, padded)
+        # valid-row mask: batch padding is dead rows at the tail; the
+        # sequence axis (if any) is NOT sliced here — output seq layout
+        # is model-defined (docs/inference.md)
+        return [_np.asarray(o)[:rows] for o in outs]
+
+    def predict(self, *args, **kwargs) -> List[_np.ndarray]:
+        """Run one request: positional args follow the symbol's input
+        order, kwargs go by input name.  Returns host numpy outputs
+        sliced to the request's valid rows."""
+        served = self._served_names()
+        if args:
+            if kwargs or len(args) > len(served):
+                raise MXNetError(
+                    f"predict takes inputs {served} (got {len(args)} "
+                    f"positional + {sorted(kwargs)})")
+            kwargs = dict(zip(served, args))
+        self._check_names(kwargs)  # before _as_host's dtype lookup
+        t0 = time.perf_counter()
+        inputs = {n: self._as_host(n, v) for n, v in kwargs.items()}
+        self._check_request(inputs)
+        outs = self._predict_routed(inputs)
+        if _metrics.ENABLED:
+            _metrics.SERVE_REQUESTS.inc()
+            _metrics.SERVE_LATENCY_SECONDS.observe(time.perf_counter() - t0)
+        return outs
+
+    # C-predict-API-shaped alias (MXPredForward parity for callers
+    # porting off `Predictor`)
+    forward = predict
