@@ -104,6 +104,8 @@ def _emit(partial):
         out["gluon_trainer"] = _STATE["gluon_trainer"]
     if _STATE.get("inference") is not None:
         out["inference"] = _STATE["inference"]
+    if _STATE.get("checkpoint") is not None:
+        out["checkpoint"] = _STATE["checkpoint"]
     if partial:
         out["partial"] = True
         out["phase"] = _STATE["phase"]
@@ -354,6 +356,18 @@ def _run():
             _STATE["inference"] = {
                 "error": "%s: %s" % (type(e).__name__, str(e)[:200])}
 
+    # checkpoint rider (ISSUE 5; MXT_BENCH_CKPT=0 skips): how long an
+    # async save blocks the step critical path vs a synchronous save
+    # (acceptance: < 20%), plus commit and restore latency — same
+    # durability contract as the other riders
+    if os.environ.get("MXT_BENCH_CKPT", "1") != "0":
+        _phase("checkpoint", EPOCH_S)
+        try:
+            _STATE["checkpoint"] = _checkpoint_leg(mx, ctx)
+        except Exception as e:  # noqa: BLE001
+            _STATE["checkpoint"] = {
+                "error": "%s: %s" % (type(e).__name__, str(e)[:200])}
+
 
 def _gluon_trainer_leg(mx, ctx):
     """Fused vs legacy vs fused-compressed Gluon Trainer A/B/C: steps/s,
@@ -424,6 +438,65 @@ def _gluon_trainer_leg(mx, ctx):
             os.environ.pop("MXNET_FUSED_TRAINER", None)
         else:
             os.environ["MXNET_FUSED_TRAINER"] = prev
+    return out
+
+
+def _checkpoint_leg(mx, ctx):
+    """Async vs sync checkpoint A/B on a training-shaped state
+    (MXT_BENCH_CKPT_MB, default 32MB of f32 'parameters' + an opaque
+    optimizer-state blob): save-blocking-time for each mode, async
+    commit latency, restore (CRC-validated) latency.  The headline
+    number is block_ratio = async-block / sync-save — the fraction of
+    a synchronous save the training step still pays with async on."""
+    import shutil
+    import tempfile
+
+    from mxnet_tpu import checkpoint as ckpt
+
+    mb = float(os.environ.get("MXT_BENCH_CKPT_MB", 32))
+    n_arrays = 8
+    rows = max(1, int(mb * (1 << 20) / 4 / n_arrays / 1024))
+    rs = np.random.RandomState(0)
+    state = {f"param:w{i}": mx.nd.array(
+        rs.normal(0, 1, (rows, 1024)).astype("f"), ctx=ctx)
+        for i in range(n_arrays)}
+    state["optimizer:states"] = rs.bytes(1 << 20)
+    reps = int(os.environ.get("MXT_BENCH_CKPT_REPS", 3))
+    root = tempfile.mkdtemp(prefix="mxt_ckpt_bench_")
+    out = {"state_mb": round(mb, 1), "reps": reps}
+    try:
+        sync_mgr = ckpt.CheckpointManager(
+            os.path.join(root, "sync"), async_save=False)
+        sync_s = []
+        for r in range(reps):
+            t0 = time.perf_counter()
+            sync_mgr.save(r + 1, state)
+            sync_s.append(time.perf_counter() - t0)
+        async_mgr = ckpt.CheckpointManager(os.path.join(root, "async"))
+        async_mgr.save(0, state)  # warm the writer thread
+        async_mgr.wait()
+        block_s, total_s = [], []
+        for r in range(reps):
+            t0 = time.perf_counter()
+            async_mgr.save(r + 1, state)
+            block_s.append(time.perf_counter() - t0)
+            async_mgr.wait()
+            total_s.append(time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        step, restored = async_mgr.restore()
+        restore_s = time.perf_counter() - t0
+        assert step == reps and len(restored) == len(state)
+        sync_save = float(np.median(sync_s))
+        async_block = float(np.median(block_s))
+        out.update({
+            "sync_save_s": round(sync_save, 4),
+            "async_block_s": round(async_block, 4),
+            "async_total_s": round(float(np.median(total_s)), 4),
+            "block_ratio": round(async_block / max(sync_save, 1e-9), 4),
+            "restore_s": round(restore_s, 4),
+        })
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
     return out
 
 

@@ -65,4 +65,5 @@ from . import rtc
 from . import contrib
 from . import predictor
 from . import serving
+from . import checkpoint
 from . import export

@@ -204,6 +204,20 @@ def maybe_enable_compile_cache() -> bool:
     return True
 
 
+def atomic_write(fname: str, data) -> None:
+    """Crash-atomic small-file write: temp file in the SAME directory,
+    then one ``os.replace`` — a crash mid-write never corrupts an
+    existing file at ``fname``.  str writes text, bytes writes binary.
+    (The checkpoint subsystem's directory-level commit lives in
+    mxnet_tpu/checkpoint/layout.py; this is the single-file variant
+    shared by symbol/params/states writers.)"""
+    tmp = f"{fname}.tmp-{os.getpid()}"
+    mode = "w" if isinstance(data, str) else "wb"
+    with open(tmp, mode) as f:
+        f.write(data)
+    os.replace(tmp, fname)
+
+
 # ---------------------------------------------------------------------------
 # Generic registry (parity: dmlc::Registry / python/mxnet/registry.py)
 # ---------------------------------------------------------------------------

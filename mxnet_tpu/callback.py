@@ -34,11 +34,24 @@ def _metric_pairs(param):
 # ---------------------------------------------------------------------------
 # Epoch-end: checkpointing
 # ---------------------------------------------------------------------------
-def _checkpointer(save_fn, period):
+def _checkpointer(save_fn, period, managed_fn=None):
+    """When MXNET_CHECKPOINT_DIR is set (checked at CALL time, so
+    long-lived jobs can opt in without re-building callbacks), saves
+    route through the fault-tolerant CheckpointManager — async, atomic,
+    CRC-validated, retention-GC'd (docs/checkpointing.md).  Unset, the
+    legacy prefix-file write runs unchanged."""
     period = max(1, int(period))
 
     def on_epoch_end(epoch, sym=None, arg=None, aux=None):
-        if _due(epoch + 1, period):
+        if not _due(epoch + 1, period):
+            return
+        mgr = None
+        if managed_fn is not None:
+            from .checkpoint import env_manager
+            mgr = env_manager()
+        if mgr is not None:
+            managed_fn(mgr, epoch + 1, sym, arg, aux)
+        else:
             save_fn(epoch + 1, sym, arg, aux)
 
     return on_epoch_end
@@ -46,21 +59,41 @@ def _checkpointer(save_fn, period):
 
 def do_checkpoint(prefix, period=1, reference_format=False):
     """Save symbol + params to `prefix`-NNNN.params every `period` epochs
-    (reference_format writes the original framework's binary container)."""
+    (reference_format writes the original framework's binary container).
+    With MXNET_CHECKPOINT_DIR set, saves go through the atomic
+    CheckpointManager instead (epoch number = checkpoint step)."""
     from .model import save_checkpoint
+
+    def _managed(mgr, n, sym, arg, aux):
+        from .checkpoint import pack_module_state
+        mgr.save(n, pack_module_state(sym, arg or {}, aux or {}),
+                 meta={"prefix": prefix, "source": "do_checkpoint"})
 
     return _checkpointer(
         lambda n, sym, arg, aux: save_checkpoint(
             prefix, n, sym, arg, aux, reference_format=reference_format),
-        period)
+        period, managed_fn=_managed)
 
 
 def module_checkpoint(mod, prefix, period=1, save_optimizer_states=False):
     """Save a Module's checkpoint (and optionally optimizer state) every
-    `period` epochs."""
+    `period` epochs.  With MXNET_CHECKPOINT_DIR set, saves go through
+    the atomic CheckpointManager (optimizer state rides along in the
+    same atomic commit instead of a second .states file)."""
+
+    def _managed(mgr, n, *_):
+        from .checkpoint import pack_module_state
+        arg, aux = mod.get_params()
+        opt_states = mod.get_optimizer_states_bytes() \
+            if save_optimizer_states and mod.optimizer_initialized \
+            and hasattr(mod, "get_optimizer_states_bytes") else None
+        mgr.save(n, pack_module_state(mod.symbol, arg, aux,
+                                      optimizer_states=opt_states),
+                 meta={"prefix": prefix, "source": "module_checkpoint"})
+
     return _checkpointer(
         lambda n, *_: mod.save_checkpoint(prefix, n, save_optimizer_states),
-        period)
+        period, managed_fn=_managed)
 
 
 # ---------------------------------------------------------------------------

@@ -417,7 +417,12 @@ class Trainer:
         self._optimizer.rescale_grad = self._scale / batch_size
         self._update(ignore_stale_grad)
 
-    def save_states(self, fname):
+    def get_states_bytes(self) -> bytes:
+        """The complete durable optimizer state as one bytes payload:
+        updater state (+ optimizer) and, when gradient compression is
+        active, the error-feedback residuals — exactly what
+        ``save_states`` writes to disk.  This is the trainer's
+        checkpoint surface (`mxnet_tpu.checkpoint.save_trainer`)."""
         assert self._optimizer is not None
         if not self._kv_initialized:
             self._init_kvstore()
@@ -427,8 +432,11 @@ class Trainer:
             states = self._kv._updater.get_states(dump_optimizer=True)
         else:
             states = self._updaters[0].get_states(dump_optimizer=True)
-        with open(fname, "wb") as fout:
-            fout.write(self._wrap_states(states))
+        return self._wrap_states(states)
+
+    def save_states(self, fname):
+        from ..base import atomic_write
+        atomic_write(fname, self.get_states_bytes())
 
     def _wrap_states(self, states: bytes) -> bytes:
         """Without compression the file is the raw updater-state pickle
@@ -471,10 +479,15 @@ class Trainer:
         return payload, None
 
     def load_states(self, fname):
-        if not self._kv_initialized:
-            self._init_kvstore()
         with open(fname, "rb") as f:
             payload = f.read()
+        self.set_states_bytes(payload)
+
+    def set_states_bytes(self, payload: bytes):
+        """Inverse of ``get_states_bytes`` (both raw legacy pickles and
+        the residual-carrying sentinel wrapper)."""
+        if not self._kv_initialized:
+            self._init_kvstore()
         states, extra = self._unwrap_states(payload)
         if self._update_on_kvstore:
             if self._kv._updater is None:

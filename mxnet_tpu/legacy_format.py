@@ -34,7 +34,7 @@ from typing import List, Tuple
 
 import numpy as _np
 
-from .base import MXNetError
+from .base import MXNetError, atomic_write
 
 REFERENCE_LIST_MAGIC = 0x112
 _V1_MAGIC = 0xF993FAC8
@@ -263,5 +263,6 @@ def save_reference_format(fname: str, data) -> None:
     for n in names:
         raw = n.encode("utf-8")
         out.append(struct.pack("<Q", len(raw)) + raw)
-    with open(fname, "wb") as f:
-        f.write(b"".join(out))
+    # crash-atomic (same rule as nd.save); the bytes written are
+    # unchanged — still the reference's exact container
+    atomic_write(fname, b"".join(out))

@@ -26,7 +26,13 @@ def save_checkpoint(prefix: str, epoch: int, symbol, arg_params: Dict,
     reference_format=True writes the .params in the ORIGINAL
     framework's binary container (legacy_format.py V2) so the
     checkpoint serves on a reference installation — load_checkpoint
-    here reads both formats transparently."""
+    here reads both formats transparently.
+
+    Both files are written crash-atomically (temp-in-same-dir +
+    os.replace inside nd.save / Symbol.save): a crash mid-save never
+    corrupts an existing checkpoint at the same prefix.  For the
+    fault-tolerant manager (async saves, CRC validation, retention,
+    auto-resume) see mxnet_tpu.checkpoint / docs/checkpointing.md."""
     if symbol is not None:
         symbol.save(f"{prefix}-symbol.json")
     save_dict = {f"arg:{k}": v for k, v in arg_params.items()}

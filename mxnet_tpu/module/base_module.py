@@ -130,8 +130,17 @@ class BaseModule:
             initializer=None, arg_params=None, aux_params=None,
             allow_missing=False, force_rebind=False, force_init=False,
             begin_epoch=0, num_epoch=None, validation_metric=None,
-            monitor=None):
-        """Train loop (parity: base_module.py:376-487)."""
+            monitor=None, checkpoint_dir=None, checkpoint_period=1,
+            checkpoint_max_keep=None):
+        """Train loop (parity: base_module.py:376-487).
+
+        ``checkpoint_dir`` opts into the fault-tolerant checkpoint
+        subsystem (docs/checkpointing.md): fit auto-resumes from the
+        newest valid checkpoint there (params + optimizer state;
+        ``begin_epoch`` advances to the saved epoch), saves one atomic
+        async checkpoint every ``checkpoint_period`` epochs, keeps the
+        newest ``checkpoint_max_keep`` (None = all), and barriers on
+        outstanding writes before returning."""
         assert num_epoch is not None, "please specify number of epochs"
         from ..initializer import Uniform
         if initializer is None:
@@ -147,12 +156,56 @@ class BaseModule:
                          force_init=force_init)
         self.init_optimizer(kvstore=kvstore, optimizer=optimizer,
                             optimizer_params=optimizer_params)
+        _ckpt = None
+        if checkpoint_dir is not None:
+            from .. import checkpoint as _ckpt_mod
+            _ckpt = _ckpt_mod.CheckpointManager(
+                checkpoint_dir, max_to_keep=checkpoint_max_keep)
+            restored = _ckpt.restore()
+            if restored is not None:
+                ck_epoch, ck_state = restored
+                ck_arg, ck_aux, ck_opt, _ = \
+                    _ckpt_mod.unpack_module_state(ck_state)
+                self.set_params(
+                    {k: nd.array(v) for k, v in ck_arg.items()},
+                    {k: nd.array(v) for k, v in ck_aux.items()})
+                if ck_opt is not None:
+                    if hasattr(self, "set_optimizer_states_bytes"):
+                        self.set_optimizer_states_bytes(ck_opt)
+                    else:
+                        # BucketingModule/SequentialModule never had a
+                        # durable optimizer-state surface (no
+                        # save_optimizer_states either): params resume,
+                        # optimizer restarts fresh — say so
+                        self.logger.warning(
+                            "checkpoint carries optimizer state but %s "
+                            "cannot restore it; resuming params only",
+                            type(self).__name__)
+                begin_epoch = max(begin_epoch, int(ck_epoch))
+                self.logger.info(
+                    "fit: resumed from checkpoint epoch %d in %s",
+                    ck_epoch, checkpoint_dir)
         if validation_metric is None:
             validation_metric = eval_metric
         if not isinstance(eval_metric, _metric.EvalMetric):
             eval_metric = _metric.create(eval_metric)
 
         global_step = 0
+        try:
+            self._fit_epochs(
+                train_data, eval_data, eval_metric, validation_metric,
+                epoch_end_callback, batch_end_callback, eval_end_callback,
+                eval_batch_end_callback, monitor, begin_epoch, num_epoch,
+                global_step, _ckpt, checkpoint_period)
+        finally:
+            if _ckpt is not None:
+                _ckpt.close()  # barrier: all queued writes committed
+
+    def _fit_epochs(self, train_data, eval_data, eval_metric,
+                    validation_metric, epoch_end_callback,
+                    batch_end_callback, eval_end_callback,
+                    eval_batch_end_callback, monitor, begin_epoch,
+                    num_epoch, global_step, _ckpt, checkpoint_period):
         for epoch in range(begin_epoch, num_epoch):
             tic = time.time()
             eval_metric.reset()
@@ -218,6 +271,19 @@ class BaseModule:
             if epoch_end_callback is not None:
                 for callback in _as_list(epoch_end_callback):
                     callback(epoch, self.symbol, arg_params_, aux_params_)
+            if _ckpt is not None and (
+                    (epoch + 1) % max(1, checkpoint_period) == 0
+                    or epoch == num_epoch - 1):  # final epoch always saved
+                from .. import checkpoint as _ckpt_mod
+                # async: the device->host snapshot happens here, the
+                # serialize+write happens off the epoch loop.  Module
+                # types without an optimizer-state surface checkpoint
+                # params only (same coverage the legacy path had).
+                opt_bytes = self.get_optimizer_states_bytes() \
+                    if hasattr(self, "get_optimizer_states_bytes") else None
+                _ckpt.save(epoch + 1, _ckpt_mod.pack_module_state(
+                    self.symbol, arg_params_, aux_params_,
+                    optimizer_states=opt_bytes))
 
             if eval_data:
                 res = self.score(eval_data, validation_metric,

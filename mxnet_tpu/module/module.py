@@ -650,21 +650,31 @@ class Module(BaseModule):
         assert self.binded
         mon.install(self._exec)
 
-    def save_optimizer_states(self, fname):
+    def get_optimizer_states_bytes(self) -> bytes:
+        """Optimizer state as one bytes payload — the Module's durable
+        checkpoint surface (mxnet_tpu.checkpoint / fit(checkpoint_dir))."""
         assert self.optimizer_initialized
-        if self._update_on_kvstore:
-            self._kvstore.save_optimizer_states(fname)
-        else:
-            with open(fname, "wb") as fout:
-                fout.write(self._updater.get_states())
+        updater = self._kvstore._updater if self._update_on_kvstore \
+            else self._updater
+        if updater is None:
+            raise MXNetError("no optimizer set")
+        return updater.get_states()
+
+    def set_optimizer_states_bytes(self, payload: bytes) -> None:
+        assert self.optimizer_initialized
+        updater = self._kvstore._updater if self._update_on_kvstore \
+            else self._updater
+        if updater is None:
+            raise MXNetError("no optimizer set")
+        updater.set_states(payload)
+
+    def save_optimizer_states(self, fname):
+        from ..base import atomic_write
+        atomic_write(fname, self.get_optimizer_states_bytes())
 
     def load_optimizer_states(self, fname):
-        assert self.optimizer_initialized
-        if self._update_on_kvstore:
-            self._kvstore.load_optimizer_states(fname)
-        else:
-            with open(fname, "rb") as f:
-                self._updater.set_states(f.read())
+        with open(fname, "rb") as f:
+            self.set_optimizer_states_bytes(f.read())
 
     def reshape(self, data_shapes, label_shapes=None):
         assert self.binded

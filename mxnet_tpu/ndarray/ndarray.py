@@ -672,9 +672,12 @@ def save(fname: str, data) -> None:
     else:
         raise MXNetError("save expects NDArray, list, or dict")
     import os
-    _np.savez(fname, **payload)  # numpy appends .npz when missing
-    if not fname.endswith(".npz"):
-        os.replace(fname + ".npz", fname)
+    # write to a temp file in the same directory, then one atomic
+    # os.replace: a crash mid-save must never corrupt an existing file
+    # at `fname` (model.save_checkpoint overwrites .params in place)
+    tmp = f"{fname}.tmp-{os.getpid()}"
+    _np.savez(tmp, **payload)  # numpy appends .npz when missing
+    os.replace(tmp + ".npz", fname)
 
 
 def _from_npz(z):

@@ -402,6 +402,33 @@ SERVE_LATENCY_SECONDS = Histogram(
     "micro-batcher queue wait on the coalesced path)",
     buckets=(1e-4, 2.5e-4, 5e-4, 1e-3, 2.5e-3, 5e-3, 1e-2, 2.5e-2,
              5e-2, 0.1, 0.25, 1.0, 5.0))
+CHECKPOINT_SAVE_SECONDS = Histogram(
+    "mxnet_checkpoint_save_seconds",
+    "Full wall-clock of each checkpoint save, snapshot through atomic "
+    "commit (async saves: measured on the writer thread)")
+CHECKPOINT_SAVE_BLOCKED_SECONDS = Histogram(
+    "mxnet_checkpoint_save_blocked_seconds",
+    "Time CheckpointManager.save() blocked its caller — the step "
+    "critical-path cost.  Async mode: just the device->host snapshot; "
+    "sync mode: the whole write")
+CHECKPOINT_RESTORE_SECONDS = Histogram(
+    "mxnet_checkpoint_restore_seconds",
+    "Wall-clock of each successful checkpoint restore (CRC validation "
+    "included)")
+CHECKPOINT_BYTES_WRITTEN = Counter(
+    "mxnet_checkpoint_bytes_written_total",
+    "Payload bytes committed by checkpoint saves (shard files)")
+CHECKPOINT_LAST_STEP = Gauge(
+    "mxnet_checkpoint_last_step",
+    "Step of the most recent successfully committed checkpoint — a "
+    "flat-lining value under traffic is the page-the-oncall signal "
+    "that durable state has stopped advancing")
+CHECKPOINT_FAILURES = Counter(
+    "mxnet_checkpoint_failures_total",
+    "Checkpoint subsystem failures by stage (save_attempt = retried "
+    "transient IO error, save = retries exhausted, restore = torn/"
+    "corrupt checkpoint skipped, gc = retention sweep error) and "
+    "reason")
 COMPRESSION_ERROR = Histogram(
     "mxnet_compression_error",
     "Mean |quantization error| per gradient bucket per compressed "
@@ -509,6 +536,17 @@ def snapshot() -> dict:
             "padding_waste": SERVE_PADDING_WASTE.get(),
             "coalesced_rows": SERVE_COALESCED_ROWS.get(),
             "latency_ms_mean": SERVE_LATENCY_SECONDS.mean * 1e3,
+        },
+        "checkpoint": {
+            "last_step": CHECKPOINT_LAST_STEP.get(),
+            "saves": CHECKPOINT_SAVE_SECONDS.count,
+            "save_ms_mean": CHECKPOINT_SAVE_SECONDS.mean * 1e3,
+            "save_blocked_ms_mean":
+                CHECKPOINT_SAVE_BLOCKED_SECONDS.mean * 1e3,
+            "restores": CHECKPOINT_RESTORE_SECONDS.count,
+            "restore_ms_mean": CHECKPOINT_RESTORE_SECONDS.mean * 1e3,
+            "bytes_written": CHECKPOINT_BYTES_WRITTEN.value,
+            "failures": CHECKPOINT_FAILURES.value,
         },
         "hbm": hbm_stats(),
     }

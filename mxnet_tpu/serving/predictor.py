@@ -104,8 +104,11 @@ class BucketedPredictor:
             return jax.device_put(
                 v._data if isinstance(v, NDArray) else _np.asarray(v), dev_j)
 
-        self._params = {k: _to_dev(v) for k, v in arg_params.items()}
-        self._aux = {k: _to_dev(v) for k, v in aux_params.items()}
+        # one tuple holds the live (params, aux) pair: hot_reload swaps
+        # it with a single reference assignment, so no reader can ever
+        # see params of one checkpoint with aux of another
+        self._weights = ({k: _to_dev(v) for k, v in arg_params.items()},
+                         {k: _to_dev(v) for k, v in aux_params.items()})
         self._input_dtypes = {
             n: np_dtype((input_dtypes or {}).get(n, "float32"))
             for n in input_shapes}
@@ -133,6 +136,14 @@ class BucketedPredictor:
 
         self._jit = jax.jit(
             _serve, donate_argnums=(0,) if self._donate else ())
+
+    @property
+    def _params(self) -> dict:
+        return self._weights[0]
+
+    @property
+    def _aux(self) -> dict:
+        return self._weights[1]
 
     # -- compilation ---------------------------------------------------------
     def _placeholder_shapes(self, in_shapes: dict) -> dict:
@@ -267,9 +278,10 @@ class BucketedPredictor:
         if _metrics.ENABLED:
             _metrics.XLA_LAUNCHES.inc(kind="serve")
             _metrics.SERVE_BATCHES.inc()
-        with trace_span("serve_dispatch", cat="serving"):
-            return compiled(padded, self._extra[key], self._params,
-                            self._aux, self._rng)
+        params, aux = self._weights  # one read: a mid-call hot_reload
+        with trace_span("serve_dispatch", cat="serving"):  # can't tear
+            return compiled(padded, self._extra[key], params, aux,
+                            self._rng)
 
     def _predict_routed(self, inputs: Dict[str, _np.ndarray]) -> list:
         shapes = {n: a.shape for n, a in inputs.items()}
@@ -320,3 +332,103 @@ class BucketedPredictor:
     # C-predict-API-shaped alias (MXPredForward parity for callers
     # porting off `Predictor`)
     forward = predict
+
+    # -- checkpoint hot reload ----------------------------------------------
+    @property
+    def loaded_step(self):
+        """Step of the last hot-reloaded checkpoint (None = construction
+        params still serving)."""
+        return getattr(self, "_loaded_step", None)
+
+    def _as_checkpoint_manager(self, source):
+        from ..checkpoint import CheckpointManager
+        if isinstance(source, CheckpointManager):
+            return source
+        return CheckpointManager(str(source))
+
+    def hot_reload(self, source, step=None) -> int:
+        """Swap the served weights for those of the newest valid
+        checkpoint under ``source`` (a checkpoint directory or
+        ``CheckpointManager``) WITHOUT recompiling — shapes/dtypes must
+        match the serving graph, so every AOT bucket executable keeps
+        working.  Torn/corrupt checkpoints are skipped by the manager's
+        validated restore; a checkpoint missing any served parameter
+        raises and the old weights keep serving (no partial swap).
+        Returns the loaded step."""
+        from ..checkpoint import (ARG_PREFIX, AUX_PREFIX, PARAM_PREFIX)
+        mgr = self._as_checkpoint_manager(source)
+        res = mgr.restore(step)
+        if res is None:
+            raise MXNetError(
+                f"hot_reload: no valid checkpoint under {mgr.directory!r}")
+        got_step, state = res
+
+        # prefix-respecting lookup: a parameter loads from param:/arg:
+        # entries only, aux state from aux: (falling back to param: —
+        # gluon checkpoints carry BN running stats as Parameters).  An
+        # arg: entry can never silently satisfy an aux name or vice
+        # versa even when base names collide.
+        def _lookup(name, prefixes, what, cur):
+            for prefix in prefixes:
+                if prefix + name in state:
+                    arr = _np.asarray(state[prefix + name])
+                    if tuple(arr.shape) != tuple(cur.shape):
+                        raise MXNetError(
+                            f"hot_reload: {what} '{name}' shape "
+                            f"{arr.shape} != serving shape "
+                            f"{tuple(cur.shape)}")
+                    return jax.device_put(
+                        arr.astype(cur.dtype, copy=False), dev_j)
+            raise MXNetError(
+                f"hot_reload: checkpoint step {got_step} lacks served "
+                f"{what} '{name}' — old weights keep serving")
+
+        dev_j = self._ctx.jax_device()
+        old_params, old_aux = self._weights
+        new_params = {name: _lookup(name, (PARAM_PREFIX, ARG_PREFIX),
+                                    "parameter", cur)
+                      for name, cur in old_params.items()}
+        new_aux = {name: _lookup(name, (AUX_PREFIX, PARAM_PREFIX),
+                                 "aux state", cur)
+                   for name, cur in old_aux.items()}
+        # ONE reference assignment commits both dicts together:
+        # in-flight _dispatch calls hold the old pair, new requests see
+        # the new pair — never params of one step with aux of another
+        self._weights = (new_params, new_aux)
+        self._loaded_step = got_step
+        return got_step
+
+    def start_auto_reload(self, source, interval_s: float = 30.0) -> None:
+        """Poll ``source`` every ``interval_s`` and hot-reload whenever
+        a newer valid checkpoint lands — the training-to-serving
+        weight pipeline with no restarts.  Polling cost is one
+        directory scan; reload errors are logged and the previous
+        weights keep serving."""
+        import logging
+        if getattr(self, "_reload_thread", None) is not None:
+            raise MXNetError("auto-reload already running")
+        mgr = self._as_checkpoint_manager(source)
+        stop = threading.Event()
+
+        def _poll():
+            while not stop.wait(interval_s):
+                try:
+                    newest = mgr.latest_step()
+                    if newest is not None and newest != self.loaded_step:
+                        self.hot_reload(mgr)
+                except Exception as e:  # noqa: BLE001 — keep serving
+                    logging.getLogger(__name__).warning(
+                        "auto-reload failed (serving old weights): %s", e)
+
+        self._reload_stop = stop
+        self._reload_thread = threading.Thread(
+            target=_poll, name="mxt-serve-reload", daemon=True)
+        self._reload_thread.start()
+
+    def stop_auto_reload(self) -> None:
+        t = getattr(self, "_reload_thread", None)
+        if t is None:
+            return
+        self._reload_stop.set()
+        t.join(timeout=5)
+        self._reload_thread = None

@@ -356,8 +356,8 @@ class Symbol:
                            "attrs": {"mxnet_version": ["int", 10000]}}, indent=2)
 
     def save(self, fname: str) -> None:
-        with open(fname, "w") as f:
-            f.write(self.tojson())
+        from ..base import atomic_write
+        atomic_write(fname, self.tojson())
 
     def debug_str(self) -> str:
         lines = []

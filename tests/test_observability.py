@@ -92,8 +92,11 @@ def test_counters_increment_across_fit(tmp_path):
     # snapshot carries the accounting a perf PR needs
     snap = obs.snapshot()
     for k in ("dispatch_counts", "fit_step_dispatches", "transfer_bytes",
-              "data_wait_ms_total", "jit_cache", "hbm"):
+              "data_wait_ms_total", "jit_cache", "hbm", "checkpoint"):
         assert k in snap, snap.keys()
+    for k in ("last_step", "saves", "save_blocked_ms_mean", "bytes_written",
+              "failures"):
+        assert k in snap["checkpoint"], snap["checkpoint"]
     json.dumps(snap)  # JSON-able end to end
 
 
