@@ -6,7 +6,7 @@ SRCS := src/runtime/storage.cc src/runtime/engine.cc \
         src/runtime/recordio.cc src/runtime/prefetch.cc
 LIB := mxnet_tpu/_native/libmxtpu_runtime.so
 
-.PHONY: native test clean cpp_example predict_capi capi_example
+.PHONY: native test chaos clean cpp_example predict_capi capi_example
 
 native: $(LIB)
 
@@ -71,6 +71,12 @@ cpp-package/example/capi_%: cpp-package/example/capi_%.c $(PRED_LIB) \
 
 test: native
 	python -m pytest tests/ -x -q
+
+# the full chaos plan: every fault-injection / overload resilience
+# drill, including the slow sustained legs the default tier-1 run
+# (-m 'not slow') skips.  docs/serving_resilience.md is the guide.
+chaos:
+	JAX_PLATFORMS=cpu python -m pytest tests/ -q -m chaos
 
 clean:
 	rm -f $(LIB) $(CPP_EX) $(PRED_LIB) $(CAPI_EX) $(CAPI_TRAIN_EX) \

@@ -106,6 +106,8 @@ def _emit(partial):
         out["inference"] = _STATE["inference"]
     if _STATE.get("checkpoint") is not None:
         out["checkpoint"] = _STATE["checkpoint"]
+    if _STATE.get("overload") is not None:
+        out["overload"] = _STATE["overload"]
     if partial:
         out["partial"] = True
         out["phase"] = _STATE["phase"]
@@ -368,6 +370,18 @@ def _run():
             _STATE["checkpoint"] = {
                 "error": "%s: %s" % (type(e).__name__, str(e)[:200])}
 
+    # overload rider (ISSUE 6; MXT_BENCH_OVERLOAD=0 skips): p99 and
+    # shed-rate of the ResilientServer at ~2x sustained capacity vs the
+    # uncontended baseline — the bounded-degradation acceptance numbers
+    # (docs/serving_resilience.md); same durability contract
+    if os.environ.get("MXT_BENCH_OVERLOAD", "1") != "0":
+        _phase("overload", EPOCH_S)
+        try:
+            _STATE["overload"] = _overload_leg(mx, ctx)
+        except Exception as e:  # noqa: BLE001
+            _STATE["overload"] = {
+                "error": "%s: %s" % (type(e).__name__, str(e)[:200])}
+
 
 def _gluon_trainer_leg(mx, ctx):
     """Fused vs legacy vs fused-compressed Gluon Trainer A/B/C: steps/s,
@@ -605,6 +619,104 @@ def _inference_leg_body(mx, ctx, _m):
     out["padding_waste_last"] = round(_m.SERVE_PADDING_WASTE.get(), 4)
     out["latency_ms_mean"] = round(_m.SERVE_LATENCY_SECONDS.mean * 1e3, 3)
     return out
+
+
+def _overload_leg(mx, ctx):
+    """ResilientServer under ~2x sustained capacity (ISSUE 6): bursts
+    of 2x max_batch one-row requests per dispatch interval against the
+    admission-controlled server.  Reports the uncontended p50/p99, the
+    flooded p99 of ADMITTED-and-served requests and its ratio to the
+    uncontended p99 (acceptance: <= 3x), the shed rate (the excess must
+    reject typed, not queue), goodput over admitted, and the
+    expired-dispatch count (must be 0)."""
+    import threading
+
+    from mxnet_tpu import serving, sym
+    from mxnet_tpu.serving import Overloaded
+
+    rs = np.random.RandomState(0)
+    nin, nhid, nout = 64, 256, 32
+    net = sym.FullyConnected(sym.Variable("data"), num_hidden=nhid,
+                             name="fc1")
+    net = sym.Activation(net, act_type="relu")
+    net = sym.FullyConnected(net, num_hidden=nout, name="fc2")
+    arg_shapes, _, _ = net.infer_shape(data=(16, nin))
+    params = {"arg:" + n: mx.nd.array(
+        rs.normal(0, 0.05, s).astype("f"), ctx=ctx)
+        for n, s in zip(net.list_arguments(), arg_shapes)
+        if n != "data"}
+    pred = serving.BucketedPredictor(net, params, {"data": (16, nin)},
+                                     dev=ctx)
+    max_queue = int(os.environ.get("MXT_BENCH_OVERLOAD_QUEUE", 16))
+    srv = serving.ResilientServer(pred, max_queue=max_queue,
+                                  max_wait_ms=1.0)
+    # compiles AND pre-executes every bucket: a bucket's first real
+    # execution pays a one-time linking cost that would otherwise land
+    # mid-flood and poison the dispatch-latency EWMA
+    srv.warmup()
+    x = rs.normal(0, 1, (1, nin)).astype("f")
+    try:
+        lats = []
+        for _ in range(30):
+            t0 = time.perf_counter()
+            srv.predict(data=x)
+            lats.append(time.perf_counter() - t0)
+        unc_p50 = float(np.percentile(np.asarray(lats) * 1e3, 50))
+        unc_p99 = float(np.percentile(np.asarray(lats) * 1e3, 99))
+        mean_lat = float(np.mean(lats))
+
+        max_batch = pred.spec.max_batch
+        bursts = int(os.environ.get("MXT_BENCH_OVERLOAD_BURSTS", 40))
+        deadline_ms = max(50.0, mean_lat * 1e3 * 20)
+        lock = threading.Lock()
+        served_lat, shed, failed = [], 0, 0
+        pending = []
+
+        def _on_done(fut, t0):
+            dt = time.perf_counter() - t0
+            with lock:
+                if fut.exception() is None:
+                    served_lat.append(dt)
+
+        for _ in range(bursts):
+            # one burst = 2x what a full-batch dispatch serves in one
+            # dispatch interval -> sustained ~2x capacity
+            for _ in range(2 * max_batch):
+                t0 = time.perf_counter()
+                try:
+                    fut = srv.submit(deadline_ms=deadline_ms, data=x)
+                    fut.add_done_callback(
+                        lambda f, t0=t0: _on_done(f, t0))
+                    pending.append(fut)
+                except Overloaded:
+                    shed += 1
+            time.sleep(max(mean_lat, 1e-3))
+        for fut in pending:
+            if fut.exception(timeout=60) is not None:
+                failed += 1
+        st = srv.stats()
+        total = bursts * 2 * max_batch
+        admitted = total - shed
+        p99 = float(np.percentile(np.asarray(served_lat) * 1e3, 99)) \
+            if served_lat else 0.0
+        return {
+            "uncontended_p50_ms": round(unc_p50, 3),
+            "uncontended_p99_ms": round(unc_p99, 3),
+            "requests": total,
+            "max_queue": max_queue,
+            "deadline_ms": round(deadline_ms, 1),
+            "shed": shed,
+            "shed_rate": round(shed / total, 4),
+            "served": len(served_lat),
+            "expired_or_failed": failed,
+            "goodput": round(len(served_lat) / max(1, admitted), 4),
+            "overload_p99_ms": round(p99, 3),
+            "p99_ratio": round(p99 / max(unc_p99, 1e-9), 2),
+            "expired_dispatches": st["expired_dispatches"],
+            "dispatch_ewma_ms": st["dispatch_ewma_ms"],
+        }
+    finally:
+        srv.close()
 
 
 LOCK_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),

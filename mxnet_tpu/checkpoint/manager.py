@@ -31,11 +31,38 @@ import time
 from typing import Callable, Dict, List, Optional, Tuple
 
 from ..base import MXNetError, getenv
+from ..faultinject import fire as _fi_fire
 from ..observability import metrics as _metrics
 from . import layout as _layout
 from .layout import CheckpointInvalidError
 
 log = logging.getLogger(__name__)
+
+
+def _corrupt_step_dir(path: str) -> None:
+    """Chaos helper for the ``checkpoint.io`` corrupt rule: flip the
+    last byte of the first shard in a COMMITTED checkpoint dir —
+    exactly the bit-rot/torn-replication damage the CRC-validated
+    restore exists to catch (quick_validate still passes, sizes are
+    unchanged; the load must reject it)."""
+    try:
+        names = sorted(n for n in os.listdir(path) if n.endswith(".npz"))
+    except OSError:
+        return
+    if not names:
+        return
+    fp = os.path.join(path, names[0])
+    # flip a byte mid-file: that lands in array payload (CRC mismatch)
+    # or a zip member header (shard unreadable) — either way the
+    # validated restore must reject the checkpoint.  A trailing-byte
+    # flip would land in the zip end-of-central-directory slack, which
+    # readers tolerate.
+    size = os.path.getsize(fp)
+    with open(fp, "r+b") as f:
+        f.seek(size // 2)
+        b = f.read(1)
+        f.seek(size // 2)
+        f.write(bytes([b[0] ^ 0xFF]))
 
 
 class CheckpointError(MXNetError):
@@ -206,10 +233,21 @@ class CheckpointManager:
             try:
                 if self.fault_hook is not None:
                     self.fault_hook(step, attempt)
+                # process-wide chaos site generalizing the per-manager
+                # fault_hook: raise OSError to exercise the retry path,
+                # the default InjectedFault to exhaust it into a typed
+                # CheckpointError; delay models slow storage
+                _fi_fire("checkpoint.io", step=step, attempt=attempt)
                 written = _layout.write_checkpoint_dir(
                     self.directory, step, snap, meta=meta,
                     signatures=signatures,
                     tmp_token=f"{os.getpid()}-{self._next_seq()}")
+                # corrupt rules fire AFTER the commit (only= keeps the
+                # raise/delay rules above from double-firing): the next
+                # restore must skip this checkpoint via CRC validation
+                _fi_fire("checkpoint.io", only="corrupt",
+                         corrupt=lambda: _corrupt_step_dir(os.path.join(
+                             self.directory, _layout.step_dirname(step))))
                 break
             except (OSError, IOError) as e:
                 if _metrics.ENABLED:

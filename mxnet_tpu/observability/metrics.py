@@ -111,6 +111,20 @@ class Counter(Metric):
         self._value = 0.0
         self._children.clear()
 
+    def fold_label(self, label: str, value, replacement) -> None:
+        """Merge every child whose ``label`` equals ``value`` into the
+        same label set with ``label=replacement`` — bounds label
+        cardinality (e.g. evicted serving tenants fold into
+        ``tenant="_evicted"``) while preserving the counter's total."""
+        with _MUT_LOCK:
+            for k in [k for k in list(self._children)
+                      if dict(k).get(label) == value]:
+                v = self._children.pop(k)
+                d = dict(k)
+                d[label] = replacement
+                nk = _label_key(d)
+                self._children[nk] = self._children.get(nk, 0.0) + v
+
     def samples(self):
         out = []
         if self._value or not self._children:
@@ -155,6 +169,13 @@ class Gauge(Metric):
             return float(self._fn())
         return self._children.get(_label_key(labels), 0.0) if labels \
             else self._value
+
+    def remove(self, **labels) -> None:
+        """Drop one labeled child (gauges are point-in-time, so removal
+        is semantically clean — used to keep per-tenant gauge
+        cardinality bounded when a tenant is evicted)."""
+        with _MUT_LOCK:
+            self._children.pop(_label_key(labels), None)
 
     def reset(self) -> None:
         self._value = 0.0
@@ -402,6 +423,48 @@ SERVE_LATENCY_SECONDS = Histogram(
     "micro-batcher queue wait on the coalesced path)",
     buckets=(1e-4, 2.5e-4, 5e-4, 1e-3, 2.5e-3, 5e-3, 1e-2, 2.5e-2,
              5e-2, 0.1, 0.25, 1.0, 5.0))
+SERVE_ADMITTED = Counter(
+    "mxnet_serve_admitted_total",
+    "Requests admitted past ResilientServer admission control, by "
+    "tenant (shed requests never count here)")
+SERVE_SHED = Counter(
+    "mxnet_serve_shed_total",
+    "Requests rejected by admission control with a typed Overloaded "
+    "error, by tenant and reason (queue_full = per-tenant bound hit, "
+    "deadline_unmeetable = estimated wait already exceeds the request's "
+    "deadline).  Shedding here is the DESIGN under overload: bounded "
+    "p99 + rejections instead of tail-latency collapse")
+SERVE_EXPIRED = Counter(
+    "mxnet_serve_expired_total",
+    "Admitted requests dropped before dispatch because their deadline "
+    "passed in queue (typed DeadlineExceeded to the caller; expired "
+    "work is NEVER padded or dispatched), by tenant")
+SERVE_GOODPUT = Gauge(
+    "mxnet_serve_goodput",
+    "served / admitted fraction per tenant since process start — the "
+    "overload acceptance gauge (>= 0.9 of admitted work must complete "
+    "under 2x flood; shed requests are excluded by construction)")
+SERVE_READY = Gauge(
+    "mxnet_serve_ready",
+    "1 when the most recently evaluated ResilientServer readyz() "
+    "passes (warmup complete, dispatch latency / failure rate / stall "
+    "/ hot-reload staleness within thresholds), else 0")
+SERVE_READY_TRANSITIONS = Counter(
+    "mxnet_serve_ready_transitions_total",
+    "readyz flips, by direction (up = became ready, down = became "
+    "unready).  A flapping counter is the page-the-oncall signal that "
+    "the replica is oscillating around a threshold")
+SERVE_RELOAD_FAILURES = Counter(
+    "mxnet_serve_reload_failures_total",
+    "Serving auto-reload poll failures (missing/corrupt checkpoint "
+    "dir, failed weight swap).  Each one kept serving the OLD weights; "
+    "a climbing counter means the training->serving pipeline is broken "
+    "while the replica still looks healthy")
+FAULTS_INJECTED = Counter(
+    "mxnet_faults_injected_total",
+    "Faults fired by the mxnet_tpu.faultinject harness, by site and "
+    "mode.  Nonzero in production means someone left MXNET_FAULT_PLAN "
+    "set")
 CHECKPOINT_SAVE_SECONDS = Histogram(
     "mxnet_checkpoint_save_seconds",
     "Full wall-clock of each checkpoint save, snapshot through atomic "
@@ -536,6 +599,16 @@ def snapshot() -> dict:
             "padding_waste": SERVE_PADDING_WASTE.get(),
             "coalesced_rows": SERVE_COALESCED_ROWS.get(),
             "latency_ms_mean": SERVE_LATENCY_SECONDS.mean * 1e3,
+            "admitted": SERVE_ADMITTED.value,
+            "shed": SERVE_SHED.value,
+            "expired": SERVE_EXPIRED.value,
+            # list() snapshots against hook threads inserting tenants
+            "goodput": {dict(k).get("tenant", "_"): v for k, v in
+                        sorted(list(SERVE_GOODPUT._children.items()))},
+            "ready": SERVE_READY.get(),
+            "ready_transitions": SERVE_READY_TRANSITIONS.value,
+            "reload_failures": SERVE_RELOAD_FAILURES.value,
+            "faults_injected": FAULTS_INJECTED.value,
         },
         "checkpoint": {
             "last_step": CHECKPOINT_LAST_STEP.get(),

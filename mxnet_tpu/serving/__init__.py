@@ -1,6 +1,7 @@
 """mxnet_tpu.serving — the inference fast path.
 
-Three layers, composable (docs/inference.md is the guide):
+Four layers, composable (docs/inference.md and
+docs/serving_resilience.md are the guides):
 
   - `BucketSpec` / `buckets` — the padded shape-bucket lattice
     (pow2-derived, `MXNET_SERVE_BUCKETS` / `MXNET_SERVE_SEQ_BUCKETS`);
@@ -10,18 +11,31 @@ Three layers, composable (docs/inference.md is the guide):
     via `MXNET_COMPILE_CACHE_DIR`;
   - `MicroBatcher` — dynamic micro-batching: concurrent requests
     coalesce into one covering-bucket dispatch
-    (`MXNET_SERVE_MAX_WAIT_MS` / `MXNET_SERVE_MAX_BATCH`).
+    (`MXNET_SERVE_MAX_WAIT_MS` / `MXNET_SERVE_MAX_BATCH`);
+  - `ResilientServer` — the resilience tier: per-tenant admission
+    control with bounded priority queues (`MXNET_SERVE_MAX_QUEUE`),
+    deadline-aware scheduling + load shedding
+    (`MXNET_SERVE_SHED_POLICY`, typed `Overloaded` /
+    `DeadlineExceeded`), and a `healthz()`/`readyz()` surface fed from
+    the metrics registry.  Failure behavior is testable via
+    `mxnet_tpu.faultinject`.
 
 Reference lineage: the C predict API + bucketing executors of MXNet
-(arxiv 1512.01274) and TVM's ahead-of-time deployment modules
-(arxiv 1802.04799).
+(arxiv 1512.01274), TVM's ahead-of-time deployment modules
+(arxiv 1802.04799), and TF-Serving's health-checked batching workers
+(arxiv 1605.08695).
 """
 from . import buckets
 from .buckets import (BucketSpec, covering_bucket, pad_to_shape,
                       parse_bucket_env, pow2_buckets)
 from .predictor import BucketedPredictor
-from .batcher import MicroBatcher
+from .batcher import (BatcherClosedError, BatcherDeadError, MicroBatcher,
+                      stack_requests)
+from . import resilience
+from .resilience import DeadlineExceeded, Overloaded, ResilientServer
 
-__all__ = ["BucketSpec", "BucketedPredictor", "MicroBatcher", "buckets",
-           "covering_bucket", "pad_to_shape", "parse_bucket_env",
-           "pow2_buckets"]
+__all__ = ["BucketSpec", "BucketedPredictor", "MicroBatcher",
+           "ResilientServer", "Overloaded", "DeadlineExceeded",
+           "BatcherClosedError", "BatcherDeadError", "buckets",
+           "resilience", "covering_bucket", "pad_to_shape",
+           "parse_bucket_env", "pow2_buckets", "stack_requests"]

@@ -23,10 +23,23 @@ from typing import Dict, List, Optional
 import numpy as _np
 
 from ..base import MXNetError, getenv
+from ..faultinject import fire as _fi_fire
 from ..observability import metrics as _metrics
 from .buckets import covering_bucket, pad_to_shape
 
-__all__ = ["MicroBatcher"]
+__all__ = ["MicroBatcher", "BatcherClosedError", "BatcherDeadError",
+           "stack_requests"]
+
+
+class BatcherClosedError(MXNetError):
+    """The batcher/server was closed before this request could be
+    dispatched (or before it could be submitted)."""
+
+
+class BatcherDeadError(MXNetError):
+    """The dispatcher thread died.  Every pending future is failed with
+    this — a dead worker must surface as a typed error, never as a
+    caller hanging in Future.result() forever."""
 
 
 class _Request:
@@ -37,6 +50,29 @@ class _Request:
         self.rows = next(iter(inputs.values())).shape[0]
         self.future: Future = Future()
         self.t0 = time.perf_counter()
+
+
+def stack_requests(spec, group) -> Dict[str, _np.ndarray]:
+    """Stack a group of validated requests into one rectangular batch.
+    Per-request sequence lengths may differ: each request pads up to the
+    group's covering seq bucket BEFORE stacking (host-side copies; the
+    device still sees one transfer + one dispatch).  Shared by
+    `MicroBatcher` and `ResilientServer` — any object with `.inputs`
+    dicts of equal key sets works."""
+    names = list(group[0].inputs)
+    stacked = {}
+    for n in names:
+        parts = [r.inputs[n] for r in group]
+        ax = spec.seq_axes.get(n)
+        if ax is not None and len({p.shape[ax] for p in parts}) > 1:
+            tgt = covering_bucket(spec.seq_buckets,
+                                  max(p.shape[ax] for p in parts))
+            parts = [pad_to_shape(
+                p, p.shape[:ax] + (tgt,) + p.shape[ax + 1:])
+                for p in parts]
+        stacked[n] = parts[0] if len(parts) == 1 else \
+            _np.concatenate(parts, axis=0)
+    return stacked
 
 
 class MicroBatcher:
@@ -64,7 +100,19 @@ class MicroBatcher:
         self._max_batch = int(max_batch or predictor.spec.max_batch)
         self._queue: "queue.Queue[_Request]" = queue.Queue()
         self._pending: _Request = None  # displaced overflow, leads next group
+        # guards the pending slot: the dispatcher writes it while
+        # close(timeout) (after a timed-out join) and _die() must be
+        # able to claim it and fail its future instead of leaving the
+        # caller hanging
+        self._pending_lock = threading.Lock()
         self._closed = False
+        # set (under _pending_lock) once close() has swept the pending
+        # slot: from then on the dispatcher must fail a displaced
+        # request itself — parking it would orphan it.  Before the
+        # sweep, parking during a graceful close is correct: the
+        # dispatcher drains the slot before exiting
+        self._swept = False
+        self._fatal: Exception = None  # dispatcher-death cause
         # serializes the closed-check+enqueue against close(): without
         # it a submit() could enqueue after close() drained, leaving its
         # future unresolved forever
@@ -105,8 +153,13 @@ class MicroBatcher:
         with self._submit_lock:
             # atomic closed-check + enqueue: anything enqueued here is
             # ahead of close()'s sentinel, so the dispatcher serves it
+            # (and _die() drains under the same lock, so nothing can
+            # slip into the queue after a dead worker's final sweep)
             if self._closed:
-                raise MXNetError("MicroBatcher is closed")
+                raise BatcherClosedError("MicroBatcher is closed")
+            if self._fatal is not None:
+                raise BatcherDeadError(
+                    f"MicroBatcher worker died: {self._fatal}")
             self._queue.put(req)
         if _metrics.ENABLED:
             _metrics.SERVE_QUEUE_DEPTH.set(self._queue.qsize())
@@ -118,24 +171,28 @@ class MicroBatcher:
         return self.submit(**inputs).result()
 
     def close(self, timeout: float = 5.0) -> None:
-        """Drain and stop the dispatcher thread.  Requests that raced
-        past the sentinel fail loudly instead of hanging their caller's
-        Future.result() forever."""
+        """Drain and stop the dispatcher thread.  Requests still queued
+        (or displaced into the pending slot) when the worker exits — or
+        when the join times out because a dispatch is hung — fail with a
+        typed ``BatcherClosedError`` instead of hanging their caller's
+        ``Future.result()`` forever; later ``submit()``s raise
+        immediately."""
         with self._submit_lock:
             if self._closed:
                 return
             self._closed = True
             self._queue.put(None)  # wake the dispatcher
         self._thread.join(timeout)
-        # requests still queued when the dispatcher exits fail loudly
-        # instead of hanging their caller's Future.result() forever
         alive = self._thread.is_alive()  # join timed out mid-dispatch
         leftovers = []
-        if not alive and self._pending is not None:
-            # only touch _pending once the dispatcher is gone — it
-            # writes the slot concurrently while alive
-            leftovers.append(self._pending)
-            self._pending = None
+        with self._pending_lock:
+            # the slot lock makes the claim safe even while the
+            # dispatcher is alive mid-dispatch: it fails (rather than
+            # parks) displaced requests once _swept is set
+            self._swept = True
+            if self._pending is not None:
+                leftovers.append(self._pending)
+                self._pending = None
         while True:
             try:
                 r = self._queue.get_nowait()
@@ -151,7 +208,8 @@ class MicroBatcher:
         for r in leftovers:
             if not r.future.done():
                 r.future.set_exception(
-                    MXNetError("MicroBatcher closed before dispatch"))
+                    BatcherClosedError("MicroBatcher closed before "
+                                       "dispatch"))
 
     def __enter__(self):
         return self
@@ -163,9 +221,9 @@ class MicroBatcher:
     def _take_group(self) -> Optional[List[_Request]]:
         """Block for the first request, then hold the batch open until
         max_wait elapses or max_batch rows have arrived."""
-        if self._pending is not None:
+        with self._pending_lock:
             first, self._pending = self._pending, None
-        else:
+        if first is None:
             first = self._queue.get()
             if first is None:
                 return None
@@ -188,7 +246,17 @@ class MicroBatcher:
                 # it LEADS the next group (re-queueing would push it to
                 # the FIFO tail, starving large requests behind a steady
                 # stream of small ones)
-                self._pending = nxt
+                with self._pending_lock:
+                    if self._swept:
+                        # close() already swept the slot: fail the
+                        # displaced request now, or nobody ever will
+                        # (a merely-closing batcher still drains — a
+                        # request enqueued before close() is served)
+                        if not nxt.future.done():
+                            nxt.future.set_exception(BatcherClosedError(
+                                "MicroBatcher closed before dispatch"))
+                    else:
+                        self._pending = nxt
                 break
             group.append(nxt)
             rows += nxt.rows
@@ -198,26 +266,7 @@ class MicroBatcher:
 
     def _dispatch_group(self, group: List[_Request]) -> None:
         try:
-            names = list(group[0].inputs)
-            # per-request sequence lengths may differ: pad each request
-            # up to the group's covering seq bucket BEFORE stacking, so
-            # the coalesced batch is rectangular (host-side copies; the
-            # device still sees one transfer + one dispatch)
-            spec = self._pred.spec
-            stacked = {}
-            for n in names:
-                parts = [r.inputs[n] for r in group]
-                ax = spec.seq_axes.get(n)
-                if ax is not None and len(
-                        {p.shape[ax] for p in parts}) > 1:
-                    tgt = covering_bucket(
-                        spec.seq_buckets,
-                        max(p.shape[ax] for p in parts))
-                    parts = [pad_to_shape(
-                        p, p.shape[:ax] + (tgt,) + p.shape[ax + 1:])
-                        for p in parts]
-                stacked[n] = parts[0] if len(parts) == 1 else \
-                    _np.concatenate(parts, axis=0)
+            stacked = stack_requests(self._pred.spec, group)
             # the routed private path: request accounting happens HERE,
             # per caller (predict() would count the stacked batch as one
             # request and fold queue wait out of the latency histogram)
@@ -246,11 +295,53 @@ class MicroBatcher:
                     r.future.set_exception(e)
 
     def _loop(self) -> None:
-        while True:
-            group = self._take_group()
-            if group is None:
-                return
-            self._dispatch_group(group)
-            if self._closed and self._queue.empty() \
-                    and self._pending is None:
-                return
+        group = None
+        try:
+            while True:
+                group = self._take_group()
+                if group is None:
+                    return
+                # chaos site: a raise rule here kills the worker thread
+                # — the death path below must fail every in-flight and
+                # queued future with a typed error, never hang callers
+                _fi_fire("serving.batcher")
+                self._dispatch_group(group)
+                group = None
+                if self._closed and self._queue.empty() \
+                        and self._pending is None:
+                    return
+        except BaseException as e:  # noqa: BLE001 — worker death
+            # swallow after cleanup: the cause is recorded in _fatal
+            # (submit raises it), every future failed typed, and the
+            # thread exits — re-raising would only spam the thread
+            # excepthook
+            self._die(e, group)
+            import logging
+            logging.getLogger(__name__).error(
+                "MicroBatcher worker died: %r", e)
+
+    def _die(self, exc: BaseException, group) -> None:
+        """Dispatcher-death cleanup: record the cause (submit() raises
+        it from now on), then fail the current group plus everything
+        queued/pending.  Runs under _submit_lock so no submit() can
+        slip a request into the queue after the final sweep."""
+        err = BatcherDeadError(
+            f"MicroBatcher worker died: {type(exc).__name__}: {exc}")
+        reqs = list(group or [])
+        with self._submit_lock:
+            self._fatal = exc if isinstance(exc, Exception) \
+                else RuntimeError(repr(exc))
+            with self._pending_lock:
+                if self._pending is not None:
+                    reqs.append(self._pending)
+                    self._pending = None
+            while True:
+                try:
+                    r = self._queue.get_nowait()
+                except queue.Empty:
+                    break
+                if r is not None:
+                    reqs.append(r)
+        for r in reqs:
+            if not r.future.done():
+                r.future.set_exception(err)

@@ -35,6 +35,7 @@ import jax
 
 from ..base import MXNetError, maybe_enable_compile_cache, np_dtype
 from ..context import cpu
+from ..faultinject import fire as _fi_fire
 from ..ndarray import NDArray
 from ..observability import metrics as _metrics
 from ..observability.tracing import trace_span
@@ -275,6 +276,10 @@ class BucketedPredictor:
 
     def _dispatch(self, key: tuple, padded: dict) -> list:
         compiled = self.precompile(key)
+        # chaos site: delay = slow model under load (the overload chaos
+        # test's capacity governor), raise = failed dispatch — surfaces
+        # to the direct caller or the submitting future, typed
+        _fi_fire("serving.dispatch", key=key)
         if _metrics.ENABLED:
             _metrics.XLA_LAUNCHES.inc(kind="serve")
             _metrics.SERVE_BATCHES.inc()
@@ -356,6 +361,9 @@ class BucketedPredictor:
         raises and the old weights keep serving (no partial swap).
         Returns the loaded step."""
         from ..checkpoint import (ARG_PREFIX, AUX_PREFIX, PARAM_PREFIX)
+        # chaos site: a raise here proves the old-weights-keep-serving
+        # contract — auto-reload catches, counts, and keeps polling
+        _fi_fire("serving.hot_reload")
         mgr = self._as_checkpoint_manager(source)
         res = mgr.restore(step)
         if res is None:
@@ -402,13 +410,25 @@ class BucketedPredictor:
         """Poll ``source`` every ``interval_s`` and hot-reload whenever
         a newer valid checkpoint lands — the training-to-serving
         weight pipeline with no restarts.  Polling cost is one
-        directory scan; reload errors are logged and the previous
-        weights keep serving."""
+        directory scan.
+
+        Failure contract: a transiently missing/corrupt checkpoint dir
+        or a failed weight swap is logged, counted in
+        ``mxnet_serve_reload_failures_total``
+        (``snapshot()["serving"]["reload_failures"]``), and the
+        PREVIOUS weights keep serving — the poll thread never dies.
+        ``_last_reload_ok`` tracks the last successful poll so
+        ``ResilientServer.readyz()`` can flag hot-reload staleness."""
         import logging
         if getattr(self, "_reload_thread", None) is not None:
             raise MXNetError("auto-reload already running")
         mgr = self._as_checkpoint_manager(source)
         stop = threading.Event()
+        self._reload_interval_s = float(interval_s)
+        # a just-started poller is healthy by definition: staleness is
+        # measured from here until the first (possibly failing) poll
+        self._last_reload_ok = time.monotonic()
+        self._last_reload_error: Optional[str] = None
 
         def _poll():
             while not stop.wait(interval_s):
@@ -416,7 +436,14 @@ class BucketedPredictor:
                     newest = mgr.latest_step()
                     if newest is not None and newest != self.loaded_step:
                         self.hot_reload(mgr)
+                    # a clean poll — including "nothing new" — refreshes
+                    # the staleness clock
+                    self._last_reload_ok = time.monotonic()
+                    self._last_reload_error = None
                 except Exception as e:  # noqa: BLE001 — keep serving
+                    self._last_reload_error = f"{type(e).__name__}: {e}"
+                    if _metrics.ENABLED:
+                        _metrics.SERVE_RELOAD_FAILURES.inc()
                     logging.getLogger(__name__).warning(
                         "auto-reload failed (serving old weights): %s", e)
 

@@ -36,6 +36,13 @@ def pytest_configure(config):
         "markers",
         "perf_smoke: CPU-runnable dispatch-count regression gates — the "
         "perf analogue of a correctness test; runs in the tier-1 path")
+    config.addinivalue_line(
+        "markers",
+        "chaos: fault-injection / overload resilience drills "
+        "(mxnet_tpu.faultinject).  The fast deterministic subset runs "
+        "in the tier-1 path by default; `pytest -m chaos` (or `make "
+        "chaos`) selects the full plan including the slow sustained "
+        "legs")
 
 
 @pytest.fixture(autouse=True)

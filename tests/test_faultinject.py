@@ -1,0 +1,234 @@
+"""Fault-injection harness (mxnet_tpu.faultinject): plan parsing,
+deterministic occurrence windows, and the degradation contract at every
+wired site — serving dispatch, batcher worker, checkpoint IO,
+hot-reload.  Each site must fail TYPED (or fall back to old state),
+never hang or silently corrupt (ISSUE 6 acceptance)."""
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import checkpoint as ckpt
+from mxnet_tpu import faultinject as fi
+from mxnet_tpu import serving, sym
+from mxnet_tpu.observability import metrics as m
+
+
+def _mlp_predictor(max_batch=4, nin=3, nhid=4):
+    net = sym.FullyConnected(sym.Variable("data"), num_hidden=nhid,
+                             name="fc")
+    return serving.BucketedPredictor(net, {}, {"data": (max_batch, nin)})
+
+
+# -- plan construction --------------------------------------------------------
+
+def test_parse_plan_syntax():
+    plan = fi.parse_plan("serving.dispatch:delay:0.05;"
+                         "checkpoint.io:raise:OSError:2,"
+                         "serving.batcher:raise,"
+                         "checkpoint.io:corrupt:1")
+    rules = plan.rules()
+    assert len(rules) == 4
+    d = plan.rules("serving.dispatch")[0]
+    assert d.mode == "delay" and d.delay_s == 0.05 and d.times is None
+    r = plan.rules("checkpoint.io")[0]
+    assert r.mode == "raise" and r.exc is OSError and r.times == 2
+    c = plan.rules("checkpoint.io")[1]
+    assert c.mode == "corrupt" and c.times == 1
+    assert plan.rules("serving.batcher")[0].exc is fi.InjectedFault
+
+
+def test_parse_plan_rejects_malformed():
+    for bad in ("serving.dispatch", "x:explode", "x:delay",
+                "x:raise:NoSuchError", "x:delay:abc"):
+        with pytest.raises(mx.MXNetError, match="MXNET_FAULT_PLAN"):
+            fi.parse_plan(bad)
+
+
+def test_install_from_env(monkeypatch):
+    monkeypatch.setenv(fi.ENV_VAR, "serving.dispatch:raise:MXNetError:1")
+    plan = fi.install_from_env()
+    try:
+        assert fi.plan() is plan
+        with pytest.raises(mx.MXNetError):
+            fi.fire("serving.dispatch")
+        fi.fire("serving.dispatch")  # window exhausted: no-op
+    finally:
+        fi.clear()
+    assert fi.plan() is None
+    monkeypatch.setenv(fi.ENV_VAR, "")
+    assert fi.install_from_env() is None
+
+
+def test_occurrence_window_after_and_times():
+    plan = fi.FaultPlan().add("site.x", "raise", after=2, times=2)
+    with fi.active(plan):
+        fi.fire("site.x")  # 0: skipped
+        fi.fire("site.x")  # 1: skipped
+        for _ in range(2):  # 2, 3: fire
+            with pytest.raises(fi.InjectedFault):
+                fi.fire("site.x")
+        fi.fire("site.x")  # 4: window over
+    assert plan.stats() == {"site.x": 2}
+    plan.reset()
+    assert plan.stats() == {"site.x": 0}
+
+
+def test_fire_is_noop_without_plan_and_counts_metric():
+    fi.fire("serving.dispatch")  # no plan: must not raise
+    c0 = m.FAULTS_INJECTED.get(site="site.y", mode="delay")
+    with fi.active(fi.FaultPlan().add("site.y", "delay", delay_s=0.0)):
+        fi.fire("site.y")
+    assert m.FAULTS_INJECTED.get(site="site.y", mode="delay") == c0 + 1
+
+
+def test_active_restores_previous_plan():
+    outer = fi.FaultPlan()
+    with fi.active(outer):
+        with fi.active(fi.FaultPlan()):
+            assert fi.plan() is not outer
+        assert fi.plan() is outer
+    assert fi.plan() is None
+
+
+# -- site: serving.dispatch ---------------------------------------------------
+
+@pytest.mark.chaos
+def test_dispatch_raise_is_typed_and_recoverable():
+    pred = _mlp_predictor().warmup()
+    x = np.ones((1, 3), "f")
+    with fi.active(fi.FaultPlan().add("serving.dispatch", "raise",
+                                      times=1)):
+        with pytest.raises(fi.InjectedFault):
+            pred.predict(x)
+        out = pred.predict(x)  # window over: the same replica recovers
+    assert out[0].shape[0] == 1
+
+
+@pytest.mark.chaos
+def test_dispatch_delay_injects_latency():
+    pred = _mlp_predictor().warmup()
+    x = np.ones((1, 3), "f")
+    pred.predict(x)
+    with fi.active(fi.FaultPlan().add("serving.dispatch", "delay",
+                                      delay_s=0.05)):
+        t0 = time.perf_counter()
+        pred.predict(x)
+        assert time.perf_counter() - t0 >= 0.05
+
+
+@pytest.mark.chaos
+def test_dispatch_raise_reaches_microbatcher_future():
+    """A dispatch-site fault inside a coalesced group fails the
+    group's futures (typed), and the batcher keeps serving."""
+    pred = _mlp_predictor().warmup()
+    with serving.MicroBatcher(pred, max_wait_ms=5) as bat:
+        with fi.active(fi.FaultPlan().add("serving.dispatch", "raise",
+                                          times=1)):
+            fut = bat.submit(data=np.ones((1, 3), "f"))
+            with pytest.raises(fi.InjectedFault):
+                fut.result(timeout=30)
+        out = bat.predict(data=np.ones((1, 3), "f"))
+    assert out[0].shape[0] == 1
+
+
+# -- site: serving.batcher (worker death) -------------------------------------
+
+@pytest.mark.chaos
+def test_batcher_worker_death_fails_futures_typed():
+    """ISSUE 6 satellite: a dead dispatcher thread must fail pending
+    futures with a typed error — callers NEVER hang — and later
+    submits raise immediately."""
+    pred = _mlp_predictor().warmup()
+    bat = serving.MicroBatcher(pred, max_wait_ms=5)
+    with fi.active(fi.FaultPlan().add("serving.batcher", "raise")):
+        fut = bat.submit(data=np.ones((1, 3), "f"))
+        with pytest.raises(serving.BatcherDeadError, match="died"):
+            fut.result(timeout=30)
+    bat._thread.join(timeout=5)
+    with pytest.raises(serving.BatcherDeadError):
+        bat.submit(data=np.ones((1, 3), "f"))
+    bat.close()  # close after death is a clean no-op
+
+
+# -- site: checkpoint.io ------------------------------------------------------
+
+def test_checkpoint_io_oserror_exercises_retry(tmp_path):
+    mgr = ckpt.CheckpointManager(str(tmp_path), async_save=False,
+                                 retries=3, backoff_s=0.001)
+    c0 = m.CHECKPOINT_FAILURES.get(stage="save_attempt",
+                                   reason="OSError")
+    with fi.active(fi.parse_plan("checkpoint.io:raise:OSError:2")):
+        mgr.save(1, {"w": np.ones(4, "f")})
+    assert mgr.all_steps() == [1]  # recovered within the retry budget
+    assert m.CHECKPOINT_FAILURES.get(stage="save_attempt",
+                                     reason="OSError") == c0 + 2
+
+
+def test_checkpoint_io_exhaustion_is_typed(tmp_path):
+    mgr = ckpt.CheckpointManager(str(tmp_path), async_save=False,
+                                 retries=1, backoff_s=0.001)
+    with fi.active(fi.parse_plan("checkpoint.io:raise:OSError")):
+        with pytest.raises(ckpt.CheckpointError, match="after 2 attempts"):
+            mgr.save(1, {"w": np.ones(4, "f")})
+    assert mgr.all_steps() == []
+
+
+def test_checkpoint_io_default_fault_not_retried(tmp_path):
+    """The default InjectedFault is NOT an IO error: it must surface
+    as a typed CheckpointError without burning the retry budget."""
+    hits = []
+    mgr = ckpt.CheckpointManager(str(tmp_path), async_save=False,
+                                 retries=3, backoff_s=0.001,
+                                 fault_hook=lambda s, a: hits.append(a))
+    with fi.active(fi.parse_plan("checkpoint.io:raise")):
+        with pytest.raises(ckpt.CheckpointError):
+            mgr.save(1, {"w": np.ones(4, "f")})
+    assert hits == [0]  # one attempt, no retries
+
+
+@pytest.mark.chaos
+def test_checkpoint_io_corrupt_restores_fall_back(tmp_path):
+    """A corrupt rule damages a COMMITTED checkpoint's shard bytes;
+    CRC-validated restore must count it and fall back to the previous
+    valid step — never load damaged weights."""
+    mgr = ckpt.CheckpointManager(str(tmp_path), async_save=False)
+    mgr.save(1, {"w": np.arange(64, dtype="f")})
+    plan = fi.parse_plan("checkpoint.io:corrupt:1")
+    with fi.active(plan):
+        mgr.save(2, {"w": np.arange(64, dtype="f") * 2})
+    assert plan.stats() == {"checkpoint.io": 1}
+    f0 = m.CHECKPOINT_FAILURES.get(stage="restore", reason="invalid")
+    step, state = mgr.restore()
+    assert step == 1
+    np.testing.assert_array_equal(state["w"], np.arange(64, dtype="f"))
+    assert m.CHECKPOINT_FAILURES.get(stage="restore",
+                                     reason="invalid") == f0 + 1
+
+
+# -- site: serving.hot_reload -------------------------------------------------
+
+@pytest.mark.chaos
+def test_hot_reload_fault_keeps_old_weights(tmp_path):
+    """A failed hot reload is typed and leaves the served weights
+    untouched — requests before and after the failure are bitwise
+    identical."""
+    net = sym.FullyConnected(sym.Variable("data"), num_hidden=2,
+                             name="fc")
+    rs = np.random.RandomState(0)
+    w = rs.normal(0, 1, (2, 3)).astype("f")
+    b = np.zeros(2, "f")
+    pred = serving.BucketedPredictor(
+        net, {"arg:fc_weight": w, "arg:fc_bias": b}, {"data": (2, 3)})
+    x = np.ones((1, 3), "f")
+    ref = pred.predict(x)[0]
+    mgr = ckpt.CheckpointManager(str(tmp_path), async_save=False)
+    mgr.save(5, {"param:fc_weight": w * 2, "param:fc_bias": b})
+    with fi.active(fi.FaultPlan().add("serving.hot_reload", "raise")):
+        with pytest.raises(fi.InjectedFault):
+            pred.hot_reload(mgr)
+    assert pred.loaded_step is None
+    np.testing.assert_array_equal(pred.predict(x)[0], ref)
+    # harness cleared: the same reload now succeeds
+    assert pred.hot_reload(mgr) == 5

@@ -594,3 +594,88 @@ def test_compile_cache_dir_wires(tmp_path, monkeypatch):
         for k, v in saved.items():
             jax.config.update(k, v)
         base._COMPILE_CACHE_WIRED = False
+
+
+# -- close()/worker-death contract (ISSUE 6 satellite) ------------------------
+
+def test_microbatcher_submit_after_close_raises_immediately():
+    pred, _, _ = _mlp_predictor(max_batch=4)
+    bat = serving.MicroBatcher(pred, max_wait_ms=5)
+    bat.close()
+    with pytest.raises(serving.BatcherClosedError, match="closed"):
+        bat.submit(data=np.ones((1, 8), "f"))
+
+
+def test_microbatcher_close_timeout_fails_pending_not_hang():
+    """close(timeout) overrunning a hung dispatch must fail every
+    queued request (including the displaced pending-slot one) with a
+    typed error — callers never hang in Future.result()."""
+    from mxnet_tpu import faultinject as fi
+    pred, _, _ = _mlp_predictor(max_batch=4)
+    pred.warmup()
+    with fi.active(fi.FaultPlan().add("serving.dispatch", "delay",
+                                      delay_s=0.6)):
+        bat = serving.MicroBatcher(pred, max_wait_ms=0, max_batch=4)
+        first = bat.submit(data=np.ones((1, 8), "f"))  # enters dispatch
+        time.sleep(0.05)
+        # 4-row request displaces into the pending slot; 1-row queues
+        disp = bat.submit(data=np.ones((4, 8), "f"))
+        tail = bat.submit(data=np.ones((1, 8), "f"))
+        t0 = time.perf_counter()
+        bat.close(timeout=0.05)  # join times out mid-dispatch
+        assert time.perf_counter() - t0 < 0.5
+        for fut in (disp, tail):
+            with pytest.raises(serving.BatcherClosedError,
+                               match="before dispatch"):
+                fut.result(timeout=5)
+        # the in-flight request still completes (or fails) on its own
+        assert first.result(timeout=5)[0].shape == (1, 4)
+    bat._thread.join(timeout=5)  # dispatcher exits via re-armed sentinel
+    assert not bat._thread.is_alive()
+
+
+# -- auto-reload hardening (ISSUE 6 satellite) --------------------------------
+
+def test_auto_reload_survives_transient_failure_and_counts(tmp_path):
+    """A transiently failing checkpoint scan must not kill the reload
+    thread: failures are counted in serving reload_failures, old
+    weights keep serving, and the poller recovers when storage does."""
+    from mxnet_tpu import checkpoint as ckpt
+    net = sym.FullyConnected(sym.Variable("data"), num_hidden=2,
+                             name="fc")
+    w = np.ones((2, 8), "f")
+    pred = serving.BucketedPredictor(
+        net, {"arg:fc_weight": w, "arg:fc_bias": np.zeros(2, "f")},
+        {"data": (2, 8)})
+    x = np.ones((1, 8), "f")
+    ref = pred.predict(x)[0]
+    mgr = ckpt.CheckpointManager(str(tmp_path), async_save=False)
+    orig, calls = mgr.latest_step, {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] <= 2:
+            raise OSError("transient checkpoint-dir scan failure")
+        return orig()
+
+    mgr.latest_step = flaky
+    f0 = m.SERVE_RELOAD_FAILURES.value
+    pred.start_auto_reload(mgr, interval_s=0.02)
+    try:
+        deadline = time.monotonic() + 10
+        while m.SERVE_RELOAD_FAILURES.value < f0 + 2:
+            assert time.monotonic() < deadline, "failures not counted"
+            time.sleep(0.02)
+        assert pred._reload_thread.is_alive(), "reload thread died"
+        np.testing.assert_array_equal(pred.predict(x)[0], ref)
+        assert obs.snapshot()["serving"]["reload_failures"] >= 2
+        # storage recovers: the next poll picks up the new checkpoint
+        mgr.save(7, {"param:fc_weight": w * 2,
+                     "param:fc_bias": np.zeros(2, "f")})
+        deadline = time.monotonic() + 10
+        while pred.loaded_step != 7:
+            assert time.monotonic() < deadline, "never reloaded"
+            time.sleep(0.02)
+        np.testing.assert_array_equal(pred.predict(x)[0], ref * 2)
+    finally:
+        pred.stop_auto_reload()
