@@ -6,7 +6,7 @@ SRCS := src/runtime/storage.cc src/runtime/engine.cc \
         src/runtime/recordio.cc src/runtime/prefetch.cc
 LIB := mxnet_tpu/_native/libmxtpu_runtime.so
 
-.PHONY: native test chaos clean cpp_example predict_capi capi_example
+.PHONY: native test chaos lint-graft clean cpp_example predict_capi capi_example
 
 native: $(LIB)
 
@@ -77,6 +77,15 @@ test: native
 # (-m 'not slow') skips.  docs/serving_resilience.md is the guide.
 chaos:
 	JAX_PLATFORMS=cpu python -m pytest tests/ -q -m chaos
+
+# graft-lint: the repo-specific static analysis gate (ISSUE 7,
+# docs/static_analysis.md).  Exit nonzero on any non-baselined finding
+# of the five rules (thread-safety, host-sync, atomic-write, env-sync,
+# metrics-hygiene); tests/test_analysis.py runs the same sweep in
+# tier-1.  JAX_PLATFORMS=cpu keeps the package import off a possibly
+# unreachable TPU tunnel (same reason as the chaos target).
+lint-graft:
+	JAX_PLATFORMS=cpu python -m mxnet_tpu.analysis mxnet_tpu
 
 clean:
 	rm -f $(LIB) $(CPP_EX) $(PRED_LIB) $(CAPI_EX) $(CAPI_TRAIN_EX) \

@@ -53,6 +53,7 @@ from . import module as mod
 from . import parallel
 from . import gluon
 from . import observability
+from . import analysis
 from . import faultinject
 from . import profiler
 from . import monitor

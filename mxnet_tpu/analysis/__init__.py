@@ -1,0 +1,42 @@
+"""graft-lint: repo-specific static analysis + runtime concurrency
+sanitizer (ISSUE 7; docs/static_analysis.md).
+
+Static side — ``analysis.run(checkers, paths) -> [Finding]`` with five
+repo-specific rules (thread-safety, host-sync, atomic-write, env-sync,
+metrics-hygiene), per-finding ``# graft-lint: disable=<rule>``
+suppression and a checked-in ``baseline.json`` for grandfathered
+findings.  ``make lint-graft`` / ``python -m mxnet_tpu.analysis`` is
+the CI gate; tests/test_analysis.py pins it in tier-1.
+
+Runtime side — ``MXNET_SANITIZE=1`` arms lock-order tracking on every
+package lock (deadlock detector) and ``no_sync()`` regions that raise
+on device→host syncs; results surface in
+``observability.snapshot()["analysis"]``.
+
+This module stays import-light: the whole package imports it for
+``hot_path`` / lock factories, so the ast machinery loads lazily.
+"""
+from __future__ import annotations
+
+from . import sanitizer
+from .sanitizer import (LockOrderError, SyncViolation, check_sync,
+                        hot_path, make_condition, make_lock, make_rlock,
+                        no_sync, sanitized)
+
+__all__ = ["run", "run_detailed", "Finding", "Baseline", "ALL_RULES",
+           "hot_path", "no_sync", "sanitizer", "sanitized",
+           "make_lock", "make_rlock", "make_condition", "check_sync",
+           "LockOrderError", "SyncViolation"]
+
+_LAZY = {"run": "core", "run_detailed": "core", "Finding": "core",
+         "Baseline": "core", "DEFAULT_BASELINE": "core",
+         "ALL_RULES": "checkers", "registry": "checkers"}
+
+
+def __getattr__(name):
+    mod = _LAZY.get(name)
+    if mod is None:
+        raise AttributeError(f"module {__name__!r} has no attribute "
+                             f"{name!r}")
+    import importlib
+    return getattr(importlib.import_module(f".{mod}", __name__), name)

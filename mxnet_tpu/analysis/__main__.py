@@ -1,0 +1,66 @@
+"""graft-lint CLI: ``python -m mxnet_tpu.analysis [paths...]``.
+
+Exit status: 0 = clean (baseline included), 1 = active findings,
+2 = usage error.  ``make lint-graft`` is the canonical invocation.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+from .checkers import ALL_RULES
+from .core import DEFAULT_BASELINE, run_detailed
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m mxnet_tpu.analysis",
+        description="graft-lint: repo-specific static analysis "
+                    "(docs/static_analysis.md)")
+    ap.add_argument("paths", nargs="*", default=["mxnet_tpu"],
+                    help="files/dirs to scan (default: mxnet_tpu)")
+    ap.add_argument("--rules", default=None,
+                    help="comma list of rules (default: all of "
+                         f"{', '.join(ALL_RULES)})")
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE,
+                    help="baseline json (default: the checked-in one)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="report grandfathered findings too")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable output")
+    ap.add_argument("--list-rules", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for r in ALL_RULES:
+            print(r)
+        return 0
+    rules = None if args.rules is None else \
+        [r.strip() for r in args.rules.split(",") if r.strip()]
+    baseline = None if args.no_baseline else args.baseline
+    t0 = time.perf_counter()
+    try:
+        active, baselined, suppressed = run_detailed(
+            rules, args.paths or ["mxnet_tpu"], baseline)
+    except KeyError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    dt = time.perf_counter() - t0
+    if args.as_json:
+        print(json.dumps({
+            "active": [f.to_dict() for f in active],
+            "baselined": len(baselined), "suppressed": suppressed,
+            "seconds": round(dt, 3)}, indent=1))
+    else:
+        for f in active:
+            print(f)
+        print(f"graft-lint: {len(active)} finding(s), "
+              f"{len(baselined)} baselined, {suppressed} suppressed "
+              f"({dt:.1f}s)", file=sys.stderr)
+    return 1 if active else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

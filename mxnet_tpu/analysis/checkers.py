@@ -1,0 +1,738 @@
+"""The five repo-specific graft-lint checkers (ISSUE 7).
+
+Each rule encodes a defect class a human reviewer actually caught in
+PRs 1-6; the checker docstrings name the incident.  All checkers are
+AST-based and conservative — a miss is recoverable (the sanitizer or a
+review catches it), a false-positive storm kills the gate.
+"""
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from .core import FileCtx, Finding, PKG_DIR, REPO_ROOT
+
+_ENV_RE = re.compile(r"^(MXNET_|MXT_)[A-Z0-9_]+$")
+_ENV_DOC_RE = re.compile(r"\b((?:MXNET|MXT)_[A-Z0-9_]*\*?)")
+
+
+def _call_name(node: ast.AST) -> str:
+    """Dotted name of a call target: ``np.savez`` -> 'np.savez'."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def _const_str(node) -> Optional[str]:
+    return node.value if isinstance(node, ast.Constant) \
+        and isinstance(node.value, str) else None
+
+
+# ---------------------------------------------------------------------------
+# 1. thread-safety
+# ---------------------------------------------------------------------------
+class _ClassInfo:
+    def __init__(self, node: ast.ClassDef):
+        self.node = node
+        self.methods: Dict[str, ast.FunctionDef] = {}
+        self.locks: Dict[str, bool] = {}      # attr -> reentrant?
+        self.worker_entries: Set[str] = set()
+        # attr -> [(side, method, node, frozenset(held))]
+        self.writes: Dict[str, list] = {}
+        self.init_only: Set[str] = set()
+
+
+_LOCK_CTORS = {
+    "threading.Lock": False, "threading.RLock": True,
+    "Lock": False, "RLock": True,
+    # the sanitizer factories (mxnet_tpu.analysis.sanitizer)
+    "make_lock": False, "make_rlock": True,
+    "_san.make_lock": False, "_san.make_rlock": True,
+    "sanitizer.make_lock": False, "sanitizer.make_rlock": True,
+}
+_COND_CTORS = {"threading.Condition", "Condition", "make_condition",
+               "_san.make_condition", "sanitizer.make_condition"}
+
+
+def _lock_ctor_reentrant(call: ast.Call) -> Optional[bool]:
+    """None = not a lock construction; else the reentrancy of the lock
+    bound by this call (Condition counts as its inner lock)."""
+    name = _call_name(call.func)
+    if name in _LOCK_CTORS:
+        return _LOCK_CTORS[name]
+    if name in _COND_CTORS or name.endswith(".Condition"):
+        # an explicit reentrant= kwarg or inner lock wins; a BARE
+        # Condition() defaults to an RLock (threading.Condition's
+        # documented default), so it IS reentrant
+        for kw in call.keywords:
+            if kw.arg == "reentrant" and isinstance(kw.value, ast.Constant):
+                return bool(kw.value.value)
+        for a in call.args:
+            if isinstance(a, ast.Call):
+                inner = _lock_ctor_reentrant(a)
+                if inner is not None:
+                    return inner
+        return True
+
+
+class ThreadSafetyChecker:
+    """Classes that spawn ``threading.Thread`` must guard shared mutable
+    attributes with a held lock (the PR 6 hung-future reviews), and a
+    non-reentrant lock must not be re-acquirable on the same thread
+    (the PR 5 SIGTERM-mid-save deadlock class).
+
+    Flags (a) ``self.attr = ...`` rebinds reachable from BOTH the worker
+    thread and non-worker methods with no common must-held lock, and
+    (b) acquisition of ``self.X`` while a path already holds ``self.X``
+    and X is non-reentrant.  ``__init__`` writes are construction
+    (happens-before ``Thread.start``), never flagged.
+    """
+
+    name = "thread-safety"
+    _MAX_DEPTH = 12
+
+    def check_file(self, ctx: FileCtx) -> List[Finding]:
+        out: List[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.ClassDef):
+                out.extend(self._check_class(ctx, node))
+        return out
+
+    # -- per-class analysis --------------------------------------------------
+    def _check_class(self, ctx: FileCtx, cls: ast.ClassDef) -> List[Finding]:
+        info = _ClassInfo(cls)
+        for item in cls.body:
+            if isinstance(item, ast.FunctionDef):
+                info.methods[item.name] = item
+        # pass 1: lock attrs + worker entries (Thread(target=...))
+        local_workers: List[ast.FunctionDef] = []
+        for mname, m in info.methods.items():
+            local_defs = {n.name: n for n in ast.walk(m)
+                          if isinstance(n, ast.FunctionDef) and n is not m}
+            for n in ast.walk(m):
+                if isinstance(n, ast.Assign) and isinstance(n.value, ast.Call):
+                    re_ent = _lock_ctor_reentrant(n.value)
+                    if re_ent is not None:
+                        for t in n.targets:
+                            if isinstance(t, ast.Attribute) and \
+                                    isinstance(t.value, ast.Name) and \
+                                    t.value.id == "self":
+                                info.locks[t.attr] = re_ent
+                if isinstance(n, ast.Call) and \
+                        _call_name(n.func).endswith("Thread"):
+                    for kw in n.keywords:
+                        if kw.arg != "target":
+                            continue
+                        v = kw.value
+                        if isinstance(v, ast.Attribute) and \
+                                isinstance(v.value, ast.Name) and \
+                                v.value.id == "self":
+                            info.worker_entries.add(v.attr)
+                        elif isinstance(v, ast.Name) and v.id in local_defs:
+                            # closure worker (predictor._poll): analyze
+                            # the local def as worker-side code
+                            local_workers.append(local_defs[v.id])
+        if not info.worker_entries and not local_workers:
+            return []
+        qual = cls.name
+        reentry: List[Finding] = []
+        sink: list = []   # (attr, side, method, node, held)
+        seen: Set[tuple] = set()
+
+        # pass 2: walk methods with must-held lock tracking
+        def walk(fn: ast.FunctionDef, held: frozenset, side: str,
+                 chain: Tuple[str, ...]):
+            if len(chain) >= self._MAX_DEPTH or \
+                    (fn.name, held, side) in seen:
+                return
+            seen.add((fn.name, held, side))
+            for stmt in fn.body:
+                visit(fn, stmt, held, side, chain + (fn.name,))
+
+        def visit(fn, stmt, held, side, chain):
+            if isinstance(stmt, ast.With):
+                new_held = set(held)
+                for item in stmt.items:
+                    e = item.context_expr
+                    if isinstance(e, ast.Attribute) and \
+                            isinstance(e.value, ast.Name) and \
+                            e.value.id == "self" and e.attr in info.locks:
+                        if e.attr in held and not info.locks[e.attr]:
+                            reentry.append(ctx.finding(
+                                self.name, e,
+                                f"non-reentrant lock 'self.{e.attr}' is "
+                                f"re-acquired on a thread that already "
+                                f"holds it (path: {' -> '.join(chain)}) "
+                                f"— guaranteed deadlock; use an RLock "
+                                f"or restructure",
+                                symbol=f"{qual}.{fn.name}"))
+                        new_held.add(e.attr)
+                for s in stmt.body:
+                    visit(fn, s, frozenset(new_held), side, chain)
+                return
+            if isinstance(stmt, (ast.If, ast.For, ast.While)):
+                for s in list(stmt.body) + list(stmt.orelse):
+                    visit(fn, s, held, side, chain)
+                return
+            if isinstance(stmt, ast.Try):
+                for s in (list(stmt.body) + list(stmt.orelse)
+                          + list(stmt.finalbody)
+                          + [h for hh in stmt.handlers for h in hh.body]):
+                    visit(fn, s, held, side, chain)
+                return
+            # attribute rebinds + self-method calls in plain statements
+            for node in ast.walk(stmt):
+                if isinstance(node, ast.Assign):
+                    for t in node.targets:
+                        record_write(fn, t, node, held, side)
+                elif isinstance(node, ast.AugAssign):
+                    record_write(fn, node.target, node, held, side)
+                elif isinstance(node, ast.Call) and \
+                        isinstance(node.func, ast.Attribute) and \
+                        isinstance(node.func.value, ast.Name) and \
+                        node.func.value.id == "self":
+                    callee = info.methods.get(node.func.attr)
+                    if callee is not None and callee.name != fn.name:
+                        walk(callee, held, side, chain)
+
+        def record_write(fn, target, node, held, side):
+            if fn.name == "__init__":
+                return
+            if isinstance(target, ast.Attribute) and \
+                    isinstance(target.value, ast.Name) and \
+                    target.value.id == "self":
+                sink.append((target.attr, side, fn.name, node, held))
+
+        worker_names = set(info.worker_entries)
+        for m in sorted(worker_names):
+            if m in info.methods:
+                walk(info.methods[m], frozenset(), "worker", ())
+        for lw in local_workers:
+            walk(lw, frozenset(), "worker", ())
+        worker_reached = {s[2] for s in sink if s[1] == "worker"}
+        seen.clear()
+        for mname, m in info.methods.items():
+            if mname == "__init__" or mname in worker_names:
+                continue
+            walk(m, frozenset(), "caller", ())
+
+        # pass 3: write/write conflicts without a common must-held lock
+        findings: List[Finding] = list(reentry)
+        by_attr: Dict[str, list] = {}
+        for attr, side, method, node, held in sink:
+            by_attr.setdefault(attr, []).append((side, method, node, held))
+        for attr, rows in sorted(by_attr.items()):
+            if attr in info.locks:
+                continue
+            w = [r for r in rows if r[0] == "worker"]
+            c = [r for r in rows if r[0] == "caller"
+                 and r[1] not in worker_reached]
+            if not w or not c:
+                continue
+            common = None
+            for _, _, _, held in w + c:
+                common = set(held) if common is None else common & set(held)
+            if common:
+                continue
+            _, method, node, held = (c + w)[0]
+            others = sorted({f"{qual}.{m}" for _, m, _, _ in w})
+            findings.append(ctx.finding(
+                self.name, node,
+                f"attribute 'self.{attr}' is written both from the "
+                f"worker thread ({', '.join(others)}) and from "
+                f"{qual}.{method} with no common lock held — guard "
+                f"both writes with one of "
+                f"{sorted(info.locks) or ['a lock']}",
+                symbol=f"{qual}.{method}"))
+        return findings
+
+
+# ---------------------------------------------------------------------------
+# 2. host-sync
+# ---------------------------------------------------------------------------
+_SYNC_ATTRS = {"asnumpy", "asscalar", "item", "block_until_ready",
+               "wait_to_read", "wait_to_write"}
+_SYNC_CALLS = {"np.asarray", "_np.asarray", "numpy.asarray",
+               "np.array", "_np.array"}
+
+
+class HostSyncChecker:
+    """No device→host synchronization inside ``@analysis.hot_path``
+    functions or functions handed to ``jax.jit`` (the round-2/round-4
+    dispatch-count regressions, caught statically).
+
+    A ``.asnumpy()`` / ``float(nd)`` / ``np.asarray`` /
+    ``block_until_ready`` on a hot path stalls the PJRT pipeline and
+    turns O(1)-dispatch steps back into blocking ones.  The check is
+    transitive over same-file calls (``self.m()`` and module-level
+    functions) from every hot entry.
+    """
+
+    name = "host-sync"
+    _MAX_DEPTH = 16
+
+    def check_file(self, ctx: FileCtx) -> List[Finding]:
+        funcs: Dict[str, ast.FunctionDef] = {}   # qualified name -> def
+        methods: Dict[str, Dict[str, ast.FunctionDef]] = {}
+        hot: List[Tuple[str, ast.FunctionDef, Optional[str]]] = []
+
+        def collect(node, cls: Optional[str]):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.ClassDef):
+                    collect(child, child.name)
+                elif isinstance(child, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)):
+                    qual = f"{cls}.{child.name}" if cls else child.name
+                    funcs[qual] = child
+                    if cls:
+                        methods.setdefault(cls, {})[child.name] = child
+                    else:
+                        methods.setdefault("", {})[child.name] = child
+                    for dec in child.decorator_list:
+                        dn = _call_name(dec) if not isinstance(dec, ast.Call) \
+                            else _call_name(dec.func)
+                        if dn.split(".")[-1] == "hot_path" or \
+                                dn in ("jax.jit", "_jax.jit"):
+                            hot.append((qual, child, cls))
+                    collect(child, cls)
+
+        collect(ctx.tree, None)
+        # functions passed to jax.jit(...) positionally are hot entries
+        jit_args: Set[str] = set()
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Call) and \
+                    _call_name(node.func) in ("jax.jit", "_jax.jit"):
+                for a in node.args[:1]:
+                    if isinstance(a, ast.Name):
+                        jit_args.add(a.id)
+                    elif isinstance(a, ast.Attribute) and \
+                            isinstance(a.value, ast.Name) and \
+                            a.value.id == "self":
+                        jit_args.add(a.attr)
+        hot_quals = {q for q, _, _ in hot}
+        for qual, fn in funcs.items():
+            if fn.name in jit_args and qual not in hot_quals:
+                cls = qual.rsplit(".", 1)[0] if "." in qual else None
+                hot.append((qual, fn, cls))
+
+        out: List[Finding] = []
+        for qual, fn, cls in hot:
+            seen: Set[str] = set()
+            self._scan(ctx, fn, cls, (qual,), methods, seen, out)
+        return out
+
+    @staticmethod
+    def _host_math(node) -> bool:
+        """int/float of host-static expressions is not a device sync:
+        numpy/math shape arithmetic (int(np.prod(shape))), env/config
+        parsing (float(getenv(...))), and ``x.shape[i]`` accesses."""
+        if isinstance(node, ast.Call):
+            cn = _call_name(node.func)
+            root = cn.split(".")[0]
+            leaf = cn.split(".")[-1]
+            return root in ("np", "_np", "numpy", "math", "len",
+                            "builtins") or \
+                leaf in ("getenv", "get", "len", "float", "int")
+        if isinstance(node, ast.Subscript):
+            v = node.value
+            return isinstance(v, ast.Attribute) and \
+                v.attr in ("shape", "sizes", "strides", "buckets")
+        return False
+
+    def _scan(self, ctx, fn, cls, chain, methods, seen, out):
+        key = chain[-1]
+        if key in seen or len(chain) > self._MAX_DEPTH:
+            return
+        seen.add(key)
+        entry = chain[0]
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            cn = _call_name(node.func)
+            sync = None
+            if isinstance(node.func, ast.Attribute) and \
+                    node.func.attr in _SYNC_ATTRS and not node.args:
+                sync = f".{node.func.attr}()"
+            elif cn in _SYNC_CALLS:
+                sync = f"{cn}(...)"
+            elif cn in ("float", "int") and node.args and isinstance(
+                    node.args[0], (ast.Call, ast.Subscript)) and \
+                    not self._host_math(node.args[0]):
+                # float(x.sum()) — a device value materialized to host.
+                # Bare names are skipped (float(scale) on a python
+                # scalar is everywhere), as is numpy/math shape
+                # arithmetic (int(np.prod(shape)) is host-static).
+                sync = f"{cn}(<expr>)"
+            if sync is not None:
+                via = "" if len(chain) == 1 else \
+                    f" (via {' -> '.join(chain)})"
+                out.append(ctx.finding(
+                    self.name, node,
+                    f"device->host sync {sync} reachable from hot path "
+                    f"'{entry}'{via} — hot paths must stay async "
+                    f"(move the read off-path, use metrics gauges, or "
+                    f"suppress with justification)"))
+                continue
+            # transitive: self.m() within the class, bare f() in module
+            if isinstance(node.func, ast.Attribute) and \
+                    isinstance(node.func.value, ast.Name) and \
+                    node.func.value.id == "self" and cls:
+                callee = methods.get(cls, {}).get(node.func.attr)
+                if callee is not None:
+                    self._scan(ctx, callee, cls,
+                               chain + (f"{cls}.{callee.name}",),
+                               methods, seen, out)
+            elif isinstance(node.func, ast.Name):
+                callee = methods.get("", {}).get(node.func.id)
+                if callee is not None:
+                    self._scan(ctx, callee, None,
+                               chain + (callee.name,), methods, seen,
+                               out)
+
+
+# ---------------------------------------------------------------------------
+# 3. atomic-write
+# ---------------------------------------------------------------------------
+_EXEMPT_FILES = ("mxnet_tpu/base.py", "mxnet_tpu/checkpoint/layout.py")
+_WRITE_CALLS = {"np.savez", "_np.savez", "np.savez_compressed",
+                "_np.savez_compressed", "np.save", "_np.save",
+                "json.dump", "_json.dump"}
+
+
+class AtomicWriteChecker:
+    """Persistent files must be written crash-atomically: via
+    ``base.atomic_write``, ``checkpoint/layout.py``, or the
+    tmp-then-``os.replace`` idiom in the same function (the PR 5 review
+    found five writers that could leave torn files; this pins the fix).
+
+    Flags ``open(path, 'w'/'wb'/'a')``, ``np.savez``, ``json.dump`` in
+    any other context.  A function that also calls ``os.replace`` (or
+    ``atomic_write``) is using the idiom and passes.
+    """
+
+    name = "atomic-write"
+
+    def check_file(self, ctx: FileCtx) -> List[Finding]:
+        if ctx.relpath.endswith(_EXEMPT_FILES):
+            return []
+        # map each function to whether it uses the atomic idiom
+        out: List[Finding] = []
+        funcs = [n for n in ast.walk(ctx.tree)
+                 if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+        covered: List[Tuple[int, int, bool]] = []
+        for fn in funcs:
+            atomic = False
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Call):
+                    cn = _call_name(node.func)
+                    if cn in ("os.replace", "os.rename") or \
+                            cn.split(".")[-1] == "atomic_write":
+                        atomic = True
+                        break
+            covered.append((fn.lineno,
+                            getattr(fn, "end_lineno", fn.lineno), atomic))
+
+        def in_atomic_fn(line: int) -> bool:
+            # innermost enclosing function wins
+            best = None
+            for lo, hi, atomic in covered:
+                if lo <= line <= hi and \
+                        (best is None or lo > best[0]):
+                    best = (lo, atomic)
+            return best[1] if best else False
+
+        # names bound to in-memory buffers: np.save(buf)/json.dump(.., buf)
+        # into a BytesIO/StringIO is not a persistent write
+        membuf: set = set()
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Assign) and \
+                    isinstance(node.value, ast.Call):
+                vn = _call_name(node.value.func)
+                if vn.split(".")[-1] in ("BytesIO", "StringIO"):
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            membuf.add(t.id)
+
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            cn = _call_name(node.func)
+            mode = None
+            if cn == "open" or cn.endswith(".open") and cn != "os.open":
+                mode = "r"
+                if len(node.args) >= 2:
+                    mode = _const_str(node.args[1]) or ""
+                for kw in node.keywords:
+                    if kw.arg == "mode":
+                        mode = _const_str(kw.value) or ""
+                base = mode.replace("b", "").replace("t", "") \
+                           .replace("+", "")
+                if base not in ("w", "a", "x"):
+                    continue
+            elif cn not in _WRITE_CALLS:
+                continue
+            else:
+                # np.save(buf, ...) / json.dump(obj, buf): in-memory
+                # targets are exempt (position of the file arg differs
+                # by callee; any BytesIO/StringIO name among the args
+                # qualifies)
+                if any(isinstance(a, ast.Name) and a.id in membuf
+                       for a in node.args):
+                    continue
+            if in_atomic_fn(node.lineno):
+                continue
+            what = f"open(..., '{mode}')" if mode else f"{cn}(...)"
+            out.append(ctx.finding(
+                self.name, node,
+                f"{what} writes a persistent file non-atomically — a "
+                f"crash mid-write leaves a torn file.  Use "
+                f"base.atomic_write / checkpoint.layout, or write to a "
+                f"same-dir tmp and os.replace"))
+        return out
+
+
+# ---------------------------------------------------------------------------
+# 4. env-sync
+# ---------------------------------------------------------------------------
+# roots searched for the docs→code direction: variables honored outside
+# the python package (native runtime, harness scripts) or read through
+# helpers the AST pass can't follow still count as read.  The package
+# itself is included so a PARTIAL scan (one file) never turns every
+# documented variable into a "stale row".  Paths are repo-relative.
+_ENV_EXTRA_ROOTS = ("mxnet_tpu", "src", "tools", "bench.py", "benchmark",
+                    "watchdog_util.py", "__graft_entry__.py",
+                    "experiments", "tests", "tests_tpu", "example")
+_ENV_DOC = os.path.join("docs", "env_var.md")
+
+
+class EnvVarSyncChecker:
+    """Every ``MXNET_*`` / ``MXT_*`` variable the package reads must be
+    documented in docs/env_var.md, and every documented variable must
+    be read somewhere (package, native runtime, or harness) — the PR
+    1-6 reviews each found knobs that shipped undocumented.
+
+    Reads are detected as ``os.environ.get/[]/setdefault``,
+    ``os.getenv`` and ``base.getenv`` calls with a literal name.  Doc
+    tokens ending in ``*`` are prefix wildcards (``MXT_BENCH_*``).
+    """
+
+    name = "env-sync"
+
+    def __init__(self, doc_path: Optional[str] = None,
+                 extra_roots: Sequence[str] = _ENV_EXTRA_ROOTS):
+        self.doc_path = doc_path or os.path.join(REPO_ROOT, _ENV_DOC)
+        self.extra_roots = extra_roots
+        self._reads: List[Tuple[str, FileCtx, ast.AST]] = []
+        self._indirect: Set[str] = set()
+
+    def check_file(self, ctx: FileCtx) -> List[Finding]:
+        for node in ast.walk(ctx.tree):
+            name = self._read_name(node)
+            if name and _ENV_RE.match(name):
+                self._reads.append((name, ctx, node))
+            elif isinstance(node, ast.Call):
+                # indirection reads: a literal env name handed to a
+                # helper (parse_bucket_env("MXNET_SERVE_BUCKETS")).
+                # Counts for the docs→code direction only — the
+                # code→docs direction stays strict on direct reads.
+                for a in node.args:
+                    s = _const_str(a)
+                    if s and _ENV_RE.match(s):
+                        self._indirect.add(s)
+        return []
+
+    @staticmethod
+    def _read_name(node) -> Optional[str]:
+        if isinstance(node, ast.Call):
+            cn = _call_name(node.func)
+            if cn in ("os.environ.get", "environ.get", "os.getenv",
+                      "getenv", "_base.getenv", "base.getenv",
+                      "os.environ.setdefault", "environ.setdefault") \
+                    and node.args:
+                return _const_str(node.args[0])
+        if isinstance(node, ast.Subscript):
+            base = _call_name(node.value)
+            if base in ("os.environ", "environ"):
+                sl = node.slice
+                if isinstance(sl, ast.Index):  # py<3.9 compat shape
+                    sl = sl.value
+                return _const_str(sl)
+        return None
+
+    def _doc_tokens(self) -> Tuple[Set[str], List[str]]:
+        try:
+            with open(self.doc_path, encoding="utf-8") as f:
+                text = f.read()
+        except OSError:
+            return set(), []
+        tokens = set(_ENV_DOC_RE.findall(text))
+        exact = {t for t in tokens if not t.endswith("*")}
+        # wildcard rows (`MXT_BENCH_*`) document a family — but a bare
+        # brand prefix (the prose says "the MXNET_* knobs") documents
+        # nothing and must not become a catch-all
+        prefixes = [t[:-1] for t in tokens
+                    if t.endswith("*") and t[:-1] not in ("MXNET_", "MXT_")]
+        return exact, prefixes
+
+    def finalize(self) -> List[Finding]:
+        exact, prefixes = self._doc_tokens()
+        out: List[Finding] = []
+        read_names: Set[str] = set()
+        doc_rel = os.path.relpath(self.doc_path, REPO_ROOT) \
+            .replace(os.sep, "/")
+        reported: Set[str] = set()
+        for name, ctx, node in self._reads:
+            read_names.add(name)
+            if name in exact or any(name.startswith(p) for p in prefixes):
+                continue
+            if name in reported:
+                continue   # one finding per variable, at its first read
+            reported.add(name)
+            out.append(ctx.finding(
+                self.name, node,
+                f"env var '{name}' is read here but not documented in "
+                f"{doc_rel} — add a row (name, default, meaning)"))
+        # docs -> code: documented vars nobody reads anywhere
+        undocumented_side = exact - read_names - self._indirect
+        if undocumented_side:
+            extra_text = self._extra_corpus()
+            for name in sorted(undocumented_side):
+                if name in extra_text:
+                    continue
+                out.append(Finding(
+                    rule=self.name, path=doc_rel, line=1, col=0,
+                    symbol=name,
+                    message=f"env var '{name}' is documented in "
+                            f"{doc_rel} but never read by the package, "
+                            f"native runtime, or harness — stale row?"))
+        return out
+
+    def _extra_corpus(self) -> str:
+        chunks: List[str] = []
+        for root in self.extra_roots:
+            p = os.path.join(REPO_ROOT, root)
+            if os.path.isfile(p):
+                try:
+                    with open(p, encoding="utf-8",
+                              errors="ignore") as f:
+                        chunks.append(f.read())
+                except OSError:
+                    pass
+                continue
+            for dirpath, dirnames, filenames in os.walk(p):
+                dirnames[:] = [d for d in dirnames
+                               if d not in ("__pycache__", ".git")]
+                for fname in filenames:
+                    if not fname.endswith((".py", ".cc", ".h", ".sh")):
+                        continue
+                    try:
+                        with open(os.path.join(dirpath, fname),
+                                  encoding="utf-8",
+                                  errors="ignore") as f:
+                            chunks.append(f.read())
+                    except OSError:
+                        pass
+        return "\n".join(chunks)
+
+
+# ---------------------------------------------------------------------------
+# 5. metrics-hygiene
+# ---------------------------------------------------------------------------
+class MetricsHygieneChecker:
+    """Metric names and label VALUES must come from bounded sets — an
+    f-string / %-format / .format() label value is unbounded cardinality
+    (the PR 6 per-tenant series leak: every distinct string becomes a
+    forever-живая time series in the registry and the scrape).
+
+    Flags dynamic strings passed as label kwargs to ``.inc/.set/.dec``
+    on ALL-CAPS metric objects, and non-literal metric names in
+    ``Counter/Gauge/Histogram`` constructions.  ``type(e).__name__``
+    and plain variables are allowed — bounded sets routed through a
+    variable are the normal idiom; string BUILDING at the call site is
+    the defect.
+    """
+
+    name = "metrics-hygiene"
+
+    @staticmethod
+    def _is_metric_recv(node: ast.Attribute) -> bool:
+        v = node.value
+        last = v.attr if isinstance(v, ast.Attribute) else \
+            v.id if isinstance(v, ast.Name) else ""
+        return bool(last) and last == last.upper() and \
+            any(c.isalpha() for c in last)
+
+    @staticmethod
+    def _dynamic_str(node) -> Optional[str]:
+        if isinstance(node, ast.JoinedStr):
+            return "f-string"
+        if isinstance(node, ast.BinOp) and isinstance(
+                node.op, (ast.Add, ast.Mod)):
+            for side in (node.left, node.right):
+                if _const_str(side) is not None or \
+                        isinstance(side, ast.JoinedStr):
+                    return "string concatenation/%-format"
+        if isinstance(node, ast.Call):
+            cn = _call_name(node.func)
+            if cn.endswith(".format"):
+                return ".format()"
+            if cn == "str" and node.args and not isinstance(
+                    node.args[0], ast.Constant):
+                return "str(<expr>)"
+        return None
+
+    def check_file(self, ctx: FileCtx) -> List[Finding]:
+        out: List[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            # label values on metric mutators
+            if isinstance(node.func, ast.Attribute) and \
+                    node.func.attr in ("inc", "set", "dec") and \
+                    self._is_metric_recv(node.func):
+                for kw in node.keywords:
+                    if kw.arg is None:
+                        continue
+                    why = self._dynamic_str(kw.value)
+                    if why:
+                        out.append(ctx.finding(
+                            self.name, kw.value,
+                            f"label '{kw.arg}' gets a dynamically built "
+                            f"value ({why}) — label values must come "
+                            f"from a bounded set or the metric's "
+                            f"cardinality is unbounded (fold/bound the "
+                            f"value first; see Counter.fold_label)"))
+            # metric names at construction
+            cn = _call_name(node.func)
+            if cn.split(".")[-1] in ("Counter", "Gauge", "Histogram") \
+                    and node.args:
+                name_arg = node.args[0]
+                if _const_str(name_arg) is None and \
+                        self._dynamic_str(name_arg):
+                    out.append(ctx.finding(
+                        self.name, name_arg,
+                        "metric name is dynamically built — names must "
+                        "be literal so the registry and dashboards are "
+                        "enumerable"))
+        return out
+
+
+# ---------------------------------------------------------------------------
+def registry() -> Dict[str, type]:
+    return {
+        ThreadSafetyChecker.name: ThreadSafetyChecker,
+        HostSyncChecker.name: HostSyncChecker,
+        AtomicWriteChecker.name: AtomicWriteChecker,
+        EnvVarSyncChecker.name: EnvVarSyncChecker,
+        MetricsHygieneChecker.name: MetricsHygieneChecker,
+    }
+
+
+ALL_RULES = tuple(registry())

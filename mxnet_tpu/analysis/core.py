@@ -1,0 +1,266 @@
+"""graft-lint core: Finding, suppression, baseline, and the run() driver.
+
+Repo-specific static analysis (ISSUE 7).  PRs 1-6 grew a heavily
+threaded runtime and the reviews kept catching the same defect classes
+by hand — reentrant-lock deadlocks, hidden device→host syncs on hot
+paths, non-atomic writes, undocumented env vars, unbounded metric
+labels.  This package turns those review invariants into checkers that
+run in tier-1 (`make lint-graft`, tests/test_analysis.py), the same
+move the big-system papers make: check system invariants mechanically,
+not by reviewer vigilance (arxiv 1605.08695; MXNet's dependency engine
+itself is the "ad-hoc threading doesn't scale" lesson, 1512.01274).
+
+Design:
+
+  * a checker is an object with ``name``, ``check_file(ctx)`` and an
+    optional ``finalize()`` for cross-file rules (env-var sync);
+  * per-finding suppression: ``# graft-lint: disable=<rule>[,<rule>]``
+    on the finding's line or the line directly above it;
+  * grandfathering: ``analysis/baseline.json`` entries match findings
+    by (rule, path, symbol) and must carry a justification — the gate
+    fails on NEW findings only, so the rule set can be stricter than
+    the code it lands on.
+
+Static analysis is intentionally conservative: checkers prefer missing
+an exotic violation over drowning the gate in false positives (every
+false positive costs either a suppression comment or reviewer trust).
+"""
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+# repo root = parent of the mxnet_tpu package directory; checkers that
+# need repo-level context (docs/env_var.md) resolve against this, so
+# the gate works regardless of the caller's cwd
+PKG_DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+REPO_ROOT = os.path.dirname(PKG_DIR)
+DEFAULT_BASELINE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "baseline.json")
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*graft-lint:\s*disable=([A-Za-z0-9_,\- ]+)")
+
+
+@dataclass
+class Finding:
+    """One rule violation at a source location.
+
+    ``symbol`` is the enclosing ``Class.method`` (or module-level name)
+    — it is the stable half of the baseline key, so baselined findings
+    survive unrelated line churn in the same file.
+    """
+    rule: str
+    path: str          # repo-relative, '/'-separated
+    line: int
+    col: int
+    message: str
+    symbol: str = ""
+
+    def key(self) -> Tuple[str, str, str]:
+        return (self.rule, self.path, self.symbol)
+
+    def __str__(self) -> str:
+        where = f"{self.path}:{self.line}:{self.col}"
+        sym = f" [{self.symbol}]" if self.symbol else ""
+        return f"{where}: {self.rule}{sym}: {self.message}"
+
+    def to_dict(self) -> dict:
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "col": self.col, "symbol": self.symbol,
+                "message": self.message}
+
+
+class FileCtx:
+    """Parsed view of one source file handed to every checker."""
+
+    def __init__(self, path: str, relpath: str, source: str,
+                 tree: ast.AST):
+        self.path = path
+        self.relpath = relpath
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = tree
+        # line -> set of disabled rules ("all" disables every rule).
+        # A TRAILING directive (code before the '#') covers exactly its
+        # own line; a COMMENT-ONLY directive line covers the next line
+        # — so neither style accidentally suppresses a neighbor.
+        self.suppressions: Dict[int, set] = {}
+        for i, text in enumerate(self.lines, start=1):
+            m = _SUPPRESS_RE.search(text)
+            if not m:
+                continue
+            rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+            target = i + 1 if text[:m.start()].strip() == "" else i
+            self.suppressions.setdefault(target, set()).update(rules)
+
+    def suppressed(self, rule: str, line: int) -> bool:
+        rules = self.suppressions.get(line)
+        return bool(rules) and (rule in rules or "all" in rules)
+
+    def finding(self, rule: str, node: ast.AST, message: str,
+                symbol: str = "") -> Finding:
+        return Finding(rule=rule, path=self.relpath,
+                       line=getattr(node, "lineno", 0),
+                       col=getattr(node, "col_offset", 0),
+                       message=message, symbol=symbol)
+
+
+def enclosing_symbols(tree: ast.AST) -> Dict[int, str]:
+    """line -> dotted enclosing symbol (``Class.method``), computed once
+    per file so checkers can stamp findings cheaply."""
+    out: Dict[int, str] = {}
+
+    def visit(node, prefix):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef)):
+                name = f"{prefix}.{child.name}" if prefix else child.name
+                end = getattr(child, "end_lineno", child.lineno)
+                for ln in range(child.lineno, end + 1):
+                    out[ln] = name
+                visit(child, name)
+            else:
+                visit(child, prefix)
+
+    visit(tree, "")
+    return out
+
+
+# ---------------------------------------------------------------------------
+# baseline
+# ---------------------------------------------------------------------------
+class Baseline:
+    """Grandfathered findings: (rule, path, symbol) triples with a
+    mandatory justification.  ``matches`` consumes nothing — one entry
+    suppresses every finding with the same key (a function with two
+    grandfathered writes is one review decision, not two)."""
+
+    def __init__(self, entries: Sequence[dict]):
+        self.entries = list(entries)
+        self._keys = set()
+        for e in self.entries:
+            if not e.get("justification"):
+                raise ValueError(
+                    f"baseline entry {e} lacks a justification — "
+                    "grandfathering is a review decision, write it down")
+            self._keys.add((e["rule"], e["path"], e.get("symbol", "")))
+
+    @classmethod
+    def load(cls, path: Optional[str]) -> "Baseline":
+        if not path or not os.path.exists(path):
+            return cls([])
+        with open(path) as f:
+            data = json.load(f)
+        return cls(data.get("findings", []))
+
+    def matches(self, finding: Finding) -> bool:
+        return finding.key() in self._keys
+
+    def rules_present(self) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for e in self.entries:
+            out[e["rule"]] = out.get(e["rule"], 0) + 1
+        return out
+
+
+# ---------------------------------------------------------------------------
+# driver
+# ---------------------------------------------------------------------------
+def _iter_py_files(paths: Iterable[str]) -> List[str]:
+    out: List[str] = []
+    for p in paths:
+        if os.path.isfile(p):
+            out.append(p)
+            continue
+        for dirpath, dirnames, filenames in os.walk(p):
+            dirnames[:] = [d for d in dirnames
+                           if d not in ("__pycache__", ".git")]
+            out.extend(os.path.join(dirpath, f)
+                       for f in sorted(filenames) if f.endswith(".py"))
+    return out
+
+
+def _relpath(path: str) -> str:
+    ap = os.path.abspath(path)
+    if ap.startswith(REPO_ROOT + os.sep):
+        ap = ap[len(REPO_ROOT) + 1:]
+    return ap.replace(os.sep, "/")
+
+
+def resolve_checkers(checkers=None) -> List:
+    """Names/instances -> checker instances ('all'/None = every rule)."""
+    from . import checkers as _mod
+    table = _mod.registry()
+    if checkers is None or checkers == "all":
+        return [cls() for cls in table.values()]
+    out = []
+    for c in checkers:
+        if isinstance(c, str):
+            if c not in table:
+                raise KeyError(
+                    f"unknown checker '{c}'; known: {sorted(table)}")
+            out.append(table[c]())
+        else:
+            out.append(c)
+    return out
+
+
+def run(checkers=None, paths: Sequence[str] = ("mxnet_tpu",),
+        baseline: Optional[str] = DEFAULT_BASELINE) -> List[Finding]:
+    """Run ``checkers`` over ``paths`` -> active findings.
+
+    Inline-suppressed and baselined findings are filtered out; the
+    result is what the gate fails on.  ``baseline=None`` reports
+    everything (used by the baseline-refresh workflow and the unit
+    fixtures).
+    """
+    active, _, _ = run_detailed(checkers, paths, baseline)
+    return active
+
+
+def run_detailed(checkers=None, paths: Sequence[str] = ("mxnet_tpu",),
+                 baseline: Optional[str] = DEFAULT_BASELINE):
+    """-> (active, baselined, suppressed_count)."""
+    insts = resolve_checkers(checkers)
+    bl = Baseline.load(baseline)
+    raw: List[Finding] = []
+    suppressed = 0
+    resolved = []
+    for p in paths:
+        if not os.path.isabs(p) and not os.path.exists(p):
+            p = os.path.join(REPO_ROOT, p)  # cwd-independent gate
+        resolved.append(p)
+    files = _iter_py_files(resolved)
+    for path in files:
+        try:
+            with open(path, encoding="utf-8") as f:
+                source = f.read()
+            tree = ast.parse(source, filename=path)
+        except (SyntaxError, UnicodeDecodeError, OSError) as e:
+            raw.append(Finding(rule="parse-error", path=_relpath(path),
+                               line=getattr(e, "lineno", 0) or 0, col=0,
+                               message=f"could not parse: {e}"))
+            continue
+        ctx = FileCtx(path, _relpath(path), source, tree)
+        symbols = enclosing_symbols(tree)
+        for checker in insts:
+            for f in checker.check_file(ctx):
+                if not f.symbol:
+                    f.symbol = symbols.get(f.line, "")
+                if ctx.suppressed(f.rule, f.line):
+                    suppressed += 1
+                else:
+                    raw.append(f)
+    for checker in insts:
+        fin = getattr(checker, "finalize", None)
+        if fin is not None:
+            raw.extend(fin())
+    active = [f for f in raw if not bl.matches(f)]
+    baselined = [f for f in raw if bl.matches(f)]
+    active.sort(key=lambda f: (f.path, f.line, f.rule))
+    return active, baselined, suppressed

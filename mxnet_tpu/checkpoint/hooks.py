@@ -25,7 +25,8 @@ class _PreemptionHook:
         self.state_fn = state_fn
         self.exit_on_signal = exit_on_signal
         self._fired = False
-        self._lock = threading.Lock()
+        from ..analysis.sanitizer import make_lock
+        self._lock = make_lock("checkpoint.hooks.fired")
         self._prev = {}
         self._signals = tuple(signals)
         self._atexit_registered = False

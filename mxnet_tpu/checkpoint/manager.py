@@ -23,6 +23,7 @@ through ``fault_hook``.
 from __future__ import annotations
 
 import atexit
+import itertools
 import logging
 import os
 import shutil
@@ -30,6 +31,7 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional, Tuple
 
+from ..analysis import sanitizer as _san
 from ..base import MXNetError, getenv
 from ..faultinject import fire as _fi_fire
 from ..observability import metrics as _metrics
@@ -127,7 +129,15 @@ class CheckpointManager:
             if max_pending is None else int(max_pending)
         if self.max_pending < 1:
             raise MXNetError("max_pending must be >= 1")
-        self._seq = 0
+        # lock-FREE token source (itertools.count is GIL-atomic).  It
+        # used to ride self._lock, but that acquisition happened while
+        # the writer held _write_lock (write→queue edge) while the
+        # SIGTERM emergency save acquires _write_lock while the main
+        # thread may hold _lock (queue→write edge) — an ABBA deadlock
+        # the MXNET_SANITIZE=1 lock-order graph flags and
+        # tests/test_analysis.py pins.  With the counter lock-free the
+        # writer never blocks on _lock while holding _write_lock.
+        self._seq = itertools.count(1)
         self._last_saved_step: Optional[int] = None
         # serializes actual writes: a block=True save (preemption hook)
         # may run on the caller thread concurrently with the worker —
@@ -136,8 +146,19 @@ class CheckpointManager:
         # main thread and may interrupt a synchronous save there; a
         # plain lock would deadlock the emergency save on the frame
         # below it
-        self._write_lock = threading.RLock()
-        self._lock = threading.Condition()
+        self._write_lock = _san.make_rlock("checkpoint.manager.write")
+        # queue/accounting condition — REENTRANT for the same SIGTERM
+        # reason as _write_lock: the emergency save path re-enters
+        # _lock's critical sections (save → _raise_pending_error /
+        # _next_seq / wait) and the signal can land while the main
+        # thread is INSIDE one of them (save()'s backpressure wait,
+        # wait()'s drain loop).  With a plain Condition the handler
+        # deadlocks the process during its SIGTERM grace window — the
+        # ordering hazard the MXNET_SANITIZE=1 lock sanitizer flags
+        # (tests/test_analysis.py pins it); Condition.wait still fully
+        # releases the RLock recursion via _release_save
+        self._lock = _san.make_condition("checkpoint.manager.queue",
+                                         reentrant=True)
         self._queue: List[tuple] = []
         self._pending = 0
         self._errors: List[BaseException] = []
@@ -277,9 +298,9 @@ class CheckpointManager:
                     stage="gc", reason=type(e).__name__)
 
     def _next_seq(self) -> int:
-        with self._lock:
-            self._seq += 1
-            return self._seq
+        # MUST stay lock-free: called with _write_lock held (see the
+        # _seq comment in __init__ for the deadlock this prevents)
+        return next(self._seq)
 
     # -- barrier -------------------------------------------------------------
     def wait(self, timeout: Optional[float] = None) -> None:
@@ -385,7 +406,7 @@ class CheckpointManager:
 # env-routed default manager (legacy callback path)
 # ---------------------------------------------------------------------------
 _ENV_MANAGERS: Dict[str, CheckpointManager] = {}
-_ENV_LOCK = threading.Lock()
+_ENV_LOCK = _san.make_lock("checkpoint.env_managers")
 
 
 def _drain_env_managers() -> None:

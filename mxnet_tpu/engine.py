@@ -24,6 +24,7 @@ import time
 
 import jax
 
+from .analysis import sanitizer as _sanitizer
 from .base import getenv
 from .observability import metrics as _metrics
 
@@ -55,6 +56,7 @@ def maybe_sync(arrays) -> None:
 def wait_for_var(array) -> None:
     """Parity: Engine::WaitForVar — block until this buffer is computed."""
     if hasattr(array, "block_until_ready"):
+        _sanitizer.check_sync("engine.wait_for_var")
         on = _metrics.ENABLED  # captured once: an enable() mid-wait must
         t0 = time.perf_counter() if on else 0.0  # not record t0=0.0
         array.block_until_ready()
@@ -69,6 +71,7 @@ def wait_for_all() -> None:
     PJRT has no global barrier; jax.effects_barrier() drains pending effects
     and live arrays synchronize on access, so this blocks host-side work.
     """
+    _sanitizer.check_sync("engine.wait_for_all")
     on = _metrics.ENABLED  # captured once: an enable() mid-wait must not
     t0 = time.perf_counter() if on else 0.0  # record t0=0.0
     try:

@@ -17,7 +17,7 @@ from typing import Dict, Optional, Sequence
 
 import numpy as _np
 
-from .base import MXNetError
+from .base import MXNetError, atomic_write
 from . import ndarray as nd
 from .ndarray import NDArray
 from . import symbol as sym_mod
@@ -54,15 +54,17 @@ def export_model(symbol, arg_params: Dict, aux_params: Dict,
             for n in input_names]
     exported = jexport.export(jax.jit(fn))(*args)
     os.makedirs(path, exist_ok=True)
-    with open(os.path.join(path, "program.shlo"), "wb") as f:
-        f.write(exported.serialize())
+    # every artifact commits via tmp+os.replace (base.atomic_write):
+    # re-exporting over a served model directory must never leave a
+    # half-written program next to the old params
+    atomic_write(os.path.join(path, "program.shlo"), exported.serialize())
     nd.save(os.path.join(path, "params.nd"),
             {f"arg:{k}": NDArray(v) for k, v in params.items()} |
             {f"aux:{k}": NDArray(v) for k, v in auxs.items()})
-    with open(os.path.join(path, "meta.json"), "w") as f:
-        json.dump({"input_names": input_names,
-                   "input_shapes": {k: list(v) for k, v in input_shapes.items()},
-                   "outputs": symbol.list_outputs()}, f)
+    atomic_write(os.path.join(path, "meta.json"), json.dumps(
+        {"input_names": input_names,
+         "input_shapes": {k: list(v) for k, v in input_shapes.items()},
+         "outputs": symbol.list_outputs()}))
     symbol.save(os.path.join(path, "symbol.json"))
 
 

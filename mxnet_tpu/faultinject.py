@@ -160,7 +160,8 @@ class FaultPlan:
 
     def __init__(self):
         self._rules: List[FaultRule] = []
-        self._lock = threading.Lock()
+        from .analysis.sanitizer import make_lock
+        self._lock = make_lock("faultinject.plan")
 
     def add(self, site: str, mode: str, **kw) -> "FaultPlan":
         """Append a rule (chainable): ``plan.add("serving.dispatch",

@@ -27,6 +27,7 @@ import pickle
 import jax.numpy as jnp
 import numpy as _np
 
+from ..analysis import hot_path
 from ..base import MXNetError, getenv
 from ..ndarray import NDArray
 from ..observability import metrics as _metrics
@@ -154,6 +155,7 @@ class Trainer:
             for d in p.list_data():
                 d._fresh_grad = False
 
+    @hot_path
     def step(self, batch_size, ignore_stale_grad=False):
         """Apply one optimization step with grads scaled by 1/batch_size.
 

@@ -41,7 +41,8 @@ import jax
 import jax.numpy as jnp
 import numpy as _np
 
-from .base import MXNetError, getenv
+from .analysis import hot_path
+from .base import MXNetError, atomic_write, getenv
 from . import ndarray as nd
 from .ndarray import NDArray
 from .observability import metrics as _metrics
@@ -296,6 +297,7 @@ class GradBucketer:
         self._flatten = jax.jit(_flat)
         self._unflatten = jax.jit(_unflat)
 
+    @hot_path
     def flatten(self, grads: List) -> List:
         """Raw jax arrays in sig order -> flat bucket arrays (one dispatch)."""
         if _metrics.ENABLED:
@@ -303,6 +305,7 @@ class GradBucketer:
             _metrics.ALLREDUCE_BUCKETS.set(len(self.layout))
         return self._flatten(grads)
 
+    @hot_path
     def unflatten(self, flats: List) -> List:
         """Flat bucket arrays -> per-key arrays (one dispatch)."""
         if _metrics.ENABLED:
@@ -601,6 +604,7 @@ class KVStore:
         with trace_span("kvstore_allreduce", cat="kvstore"):
             return collectives.allreduce_hosts(merged)
 
+    @hot_path
     def allreduce(self, values: List[NDArray], compression=None,
                   residuals=None):
         """Store-less dense allreduce: sum each value across its per-device
@@ -770,8 +774,9 @@ class KVStore:
     def save_optimizer_states(self, fname: str, dump_optimizer=False) -> None:
         if self._updater is None:
             raise MXNetError("no optimizer set")
-        with open(fname, "wb") as f:
-            f.write(self._updater.get_states(dump_optimizer))
+        # crash-atomic like every other state writer (PR 5): a save
+        # interrupted mid-write must not corrupt the previous states
+        atomic_write(fname, self._updater.get_states(dump_optimizer))
 
     def load_optimizer_states(self, fname: str) -> None:
         if self._updater is None:

@@ -22,6 +22,7 @@ import jax
 import jax.numpy as jnp
 import numpy as _np
 
+from ..analysis import sanitizer as _sanitizer
 from ..base import MXNetError, np_dtype
 from ..context import Context, current_context
 from .. import engine as _engine
@@ -117,6 +118,9 @@ class NDArray:
         reference).  Always a WRITABLE copy — jax device buffers surface as
         read-only views, but the reference contract (NDArray::SyncCopyToCPU)
         hands the caller an owned buffer (custom-op backwards mutate it)."""
+        # sanitizer chokepoint: inside an analysis.no_sync() region this
+        # raises (MXNET_SANITIZE=1); one flag test otherwise
+        _sanitizer.check_sync("NDArray.asnumpy")
         out = _np.asarray(self._data)
         if not out.flags.writeable:
             out = out.copy()

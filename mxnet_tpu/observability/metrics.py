@@ -24,6 +24,7 @@ import threading
 from typing import Dict, List, Optional, Tuple
 
 from ..base import getenv
+from ..analysis.sanitizer import make_lock as _make_lock
 
 # -- the fast-path switch ----------------------------------------------------
 # Hooks across engine/executor/kvstore/io read this module global directly:
@@ -52,7 +53,9 @@ def disable() -> None:
 # unguarded read-modify-write would drop increments and corrupt the
 # exact-count invariant dispatch_counts() advertises.  Contention is a
 # few acquisitions per training step — noise next to an XLA dispatch.
-_MUT_LOCK = threading.Lock()
+# (sanitizer factory: a plain threading.Lock unless MXNET_SANITIZE=1,
+# in which case it joins the lock-order graph as "metrics.mut")
+_MUT_LOCK = _make_lock("metrics.mut")
 
 
 def _label_key(labels: dict) -> Tuple:
@@ -258,7 +261,7 @@ class MetricsRegistry:
 
     def __init__(self):
         self._metrics: Dict[str, Metric] = {}
-        self._lock = threading.Lock()
+        self._lock = _make_lock("metrics.registry")
 
     def _register(self, metric: Metric) -> None:
         with self._lock:
@@ -492,6 +495,17 @@ CHECKPOINT_FAILURES = Counter(
     "transient IO error, save = retries exhausted, restore = torn/"
     "corrupt checkpoint skipped, gc = retention sweep error) and "
     "reason")
+ANALYSIS_LOCK_VIOLATIONS = Counter(
+    "mxnet_analysis_lock_order_violations_total",
+    "Concurrency-sanitizer lock findings under MXNET_SANITIZE=1, by "
+    "kind (cycle = ABBA ordering hazard across subsystem locks, "
+    "reentry = same-thread re-acquisition of a non-reentrant lock — "
+    "the PR 5 SIGTERM-mid-save deadlock class).  Nonzero anywhere, "
+    "including chaos runs, is a bug")
+ANALYSIS_SYNC_VIOLATIONS = Counter(
+    "mxnet_analysis_sync_violations_total",
+    "Device->host syncs observed inside analysis.no_sync() regions "
+    "(runtime complement of the static host-sync graft-lint rule)")
 COMPRESSION_ERROR = Histogram(
     "mxnet_compression_error",
     "Mean |quantization error| per gradient bucket per compressed "
@@ -566,6 +580,20 @@ def dispatch_counts() -> Dict[str, float]:
     return out
 
 
+def _analysis_snapshot() -> dict:
+    """snapshot()["analysis"]: sanitizer state + violation counters
+    (docs/static_analysis.md).  The sanitizer import is lazy/guarded —
+    the metrics layer must never fail because of it."""
+    out = {"lock_order_violations": ANALYSIS_LOCK_VIOLATIONS.value,
+           "sync_violations": ANALYSIS_SYNC_VIOLATIONS.value}
+    try:
+        from ..analysis import sanitizer as _san
+        out.update(_san.state())
+    except Exception:  # noqa: BLE001
+        out["enabled"] = False
+    return out
+
+
 def snapshot() -> dict:
     """One JSON-able dict with the numbers a perf PR needs: dispatch
     accounting, transfer volume, data-wait, engine stalls, HBM."""
@@ -610,6 +638,7 @@ def snapshot() -> dict:
             "reload_failures": SERVE_RELOAD_FAILURES.value,
             "faults_injected": FAULTS_INJECTED.value,
         },
+        "analysis": _analysis_snapshot(),
         "checkpoint": {
             "last_step": CHECKPOINT_LAST_STEP.get(),
             "saves": CHECKPOINT_SAVE_SECONDS.count,

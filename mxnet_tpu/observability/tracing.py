@@ -21,8 +21,10 @@ import contextlib
 import threading
 import time
 
+from ..analysis.sanitizer import make_lock as _make_lock
+
 _tls = threading.local()
-_tid_lock = threading.Lock()
+_tid_lock = _make_lock("tracing.tid")
 _tid_map: dict = {}
 
 

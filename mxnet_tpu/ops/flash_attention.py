@@ -88,10 +88,12 @@ def pallas_available(timeout=150.0):
         if log_path:
             # VERBATIM toolchain output for the window artifact (the
             # r4 consistency record only kept a 300-char tail — not
-            # enough to attribute the remote Mosaic 500 to infra)
-            with open(log_path, "w") as f:
-                f.write("rc=%s\n--- stdout ---\n%s\n--- stderr ---\n%s"
-                        % (out.returncode, out.stdout, out.stderr))
+            # enough to attribute the remote Mosaic 500 to infra);
+            # atomic so a killed probe can't leave a torn artifact
+            from ..base import atomic_write
+            atomic_write(log_path,
+                         "rc=%s\n--- stdout ---\n%s\n--- stderr ---\n%s"
+                         % (out.returncode, out.stdout, out.stderr))
         if out.returncode == 0 and "PALLAS_PROBE_OK" in out.stdout:
             _PALLAS_OK = True
             return True

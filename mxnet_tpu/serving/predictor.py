@@ -33,6 +33,7 @@ import numpy as _np
 
 import jax
 
+from ..analysis import hot_path
 from ..base import MXNetError, maybe_enable_compile_cache, np_dtype
 from ..context import cpu
 from ..faultinject import fire as _fi_fire
@@ -124,7 +125,8 @@ class BucketedPredictor:
         self._extra: Dict[tuple, dict] = {}  # per-bucket zero placeholders
         # compiles may be triggered concurrently by batcher + direct
         # callers; one lock keeps "compile each bucket once" true
-        self._compile_lock = threading.Lock()
+        from ..analysis import sanitizer as _san
+        self._compile_lock = _san.make_lock("serving.predictor.compile")
 
         plan = self._plan
 
@@ -274,6 +276,7 @@ class BucketedPredictor:
         # one agreed batch size + seq inside the largest bucket
         self.spec.route({n: a.shape for n, a in inputs.items()})
 
+    @hot_path
     def _dispatch(self, key: tuple, padded: dict) -> list:
         compiled = self.precompile(key)
         # chaos site: delay = slow model under load (the overload chaos
@@ -288,6 +291,7 @@ class BucketedPredictor:
             return compiled(padded, self._extra[key], params, aux,
                             self._rng)
 
+    @hot_path
     def _predict_routed(self, inputs: Dict[str, _np.ndarray]) -> list:
         shapes = {n: a.shape for n, a in inputs.items()}
         key = self.spec.route(shapes)
@@ -310,8 +314,10 @@ class BucketedPredictor:
         outs = self._dispatch(key, padded)
         # valid-row mask: batch padding is dead rows at the tail; the
         # sequence axis (if any) is NOT sliced here — output seq layout
-        # is model-defined (docs/inference.md)
-        return [_np.asarray(o)[:rows] for o in outs]
+        # is model-defined (docs/inference.md).  The asarray below is
+        # the request's ONE contractual device->host sync (serving is
+        # host-in/host-out), not a hidden stall:
+        return [_np.asarray(o)[:rows] for o in outs]  # graft-lint: disable=host-sync
 
     def predict(self, *args, **kwargs) -> List[_np.ndarray]:
         """Run one request: positional args follow the symbol's input

@@ -48,6 +48,7 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as _np
 
+from ..analysis import hot_path, sanitizer as _san
 from ..base import MXNetError, getenv
 from ..observability import metrics as _metrics
 from .batcher import BatcherClosedError, BatcherDeadError, stack_requests
@@ -179,7 +180,12 @@ class ResilientServer:
         if max_wait_ms is None:
             max_wait_ms = getenv("MXNET_SERVE_MAX_WAIT_MS", 2.0)
         self._max_wait_s = max(0.0, float(max_wait_ms)) / 1e3
-        self._max_batch = int(max_batch or predictor.spec.max_batch)
+        # same default chain as MicroBatcher: ctor arg >
+        # MXNET_SERVE_MAX_BATCH > largest bucket
+        if max_batch is None:
+            max_batch = getenv("MXNET_SERVE_MAX_BATCH",
+                               int(predictor.spec.max_batch))
+        self._max_batch = int(max_batch)
         self.unready_latency_ms = unready_latency_ms
         self.unready_failure_rate = float(unready_failure_rate)
         self.stall_timeout_s = float(stall_timeout_s)
@@ -188,7 +194,9 @@ class ResilientServer:
         if self.max_tenants < 1:
             raise MXNetError("max_tenants must be >= 1")
 
-        self._cv = threading.Condition()
+        # lock order (sanitizer-pinned): cv -> metrics.mut (label incs
+        # under admission); ready_lock never nests inside cv
+        self._cv = _san.make_condition("serving.resilience.cv")
         self._tenants: Dict[str, _Tenant] = {}
         self._rr: List[str] = []      # tenant round-robin order
         self._rr_idx = 0
@@ -209,7 +217,7 @@ class ResilientServer:
         # watchdog thread and readyz() callers: without it a flip could
         # double-count SERVE_READY_TRANSITIONS (the flapping signal)
         # and publish torn _ready/_last_checks state
-        self._ready_lock = threading.Lock()
+        self._ready_lock = _san.make_lock("serving.resilience.ready")
         self._last_checks: Dict[str, bool] = {}
         self._last_detail: dict = {}
         self._ready_reasons: List[str] = ["no_evaluation_yet"]
@@ -515,6 +523,7 @@ class ResilientServer:
                 _metrics.SERVE_GOODPUT.set(t.served / t.admitted,
                                            tenant=t.name)
 
+    @hot_path
     def _dispatch_group(self, group: List[_Request]) -> None:
         t0 = time.perf_counter()
         # the authoritative expired-work gate, evaluated at dispatch

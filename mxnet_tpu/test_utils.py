@@ -784,8 +784,13 @@ def get_bz2_data(data_dir, data_name, url, data_origin_name):
         return path
     if not os.path.exists(origin):
         download(url, fname=origin)
-    with bz2.BZ2File(origin, "rb") as src, open(path, "wb") as dst:
+    # decompress to a same-dir tmp, then one os.replace: a crash
+    # mid-decompress must not leave a torn file that the
+    # os.path.exists fast path above would trust forever after
+    tmp = f"{path}.tmp-{os.getpid()}"
+    with bz2.BZ2File(origin, "rb") as src, open(tmp, "wb") as dst:
         dst.write(src.read())
+    os.replace(tmp, path)
     return path
 
 

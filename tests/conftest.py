@@ -43,6 +43,12 @@ def pytest_configure(config):
         "in the tier-1 path by default; `pytest -m chaos` (or `make "
         "chaos`) selects the full plan including the slow sustained "
         "legs")
+    config.addinivalue_line(
+        "markers",
+        "analysis: graft-lint full-codebase static-analysis sweeps "
+        "(mxnet_tpu.analysis; `make lint-graft` is the CLI twin).  "
+        "Runs in tier-1 by default; skip on slow containers with "
+        "`-m 'not analysis'`")
 
 
 @pytest.fixture(autouse=True)
