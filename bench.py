@@ -397,6 +397,20 @@ def _run():
             _STATE["lint"] = {
                 "error": "%s: %s" % (type(e).__name__, str(e)[:200])}
 
+    # flight-recorder rider (ISSUE 8; MXT_BENCH_FLIGHT=0 skips):
+    # recorder overhead on the fused trainer step (enabled vs
+    # MXNET_FLIGHT=0 steps/s, acceptance <= 2%), ring drop count, and
+    # dump latency — the "always-on" claim's budget guard; same
+    # durability contract as the other riders.  The flight summary
+    # itself rides in the snapshot _emit() already embeds.
+    if os.environ.get("MXT_BENCH_FLIGHT", "1") != "0":
+        _phase("flight", EPOCH_S)
+        try:
+            _STATE["flight"] = _flight_leg(mx, ctx)
+        except Exception as e:  # noqa: BLE001
+            _STATE["flight"] = {
+                "error": "%s: %s" % (type(e).__name__, str(e)[:200])}
+
 
 def _gluon_trainer_leg(mx, ctx):
     """Fused vs legacy vs fused-compressed Gluon Trainer A/B/C: steps/s,
@@ -732,6 +746,115 @@ def _overload_leg(mx, ctx):
         }
     finally:
         srv.close()
+
+
+def _flight_leg(mx, ctx):
+    """Flight-recorder overhead A/B (docs/observability.md): the same
+    fused-trainer step measured with the recorder on vs MXNET_FLIGHT=0,
+    plus ring drops over the run and the latency of a full ring dump.
+    Acceptance: overhead_pct <= 2 (the recorder must be cheap enough to
+    stay always-on)."""
+    import json as _json
+    import tempfile
+
+    from mxnet_tpu import autograd, gluon
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu.observability import flight
+
+    rs = np.random.RandomState(0)
+    bs, steps = 256, 30
+    x = mx.nd.array(rs.normal(0, 1, (bs, 64)).astype("f"), ctx=ctx)
+    y = mx.nd.array(rs.normal(0, 1, (bs, 1)).astype("f"), ctx=ctx)
+    loss_fn = gluon.loss.L2Loss()
+    net = nn.HybridSequential()
+    with net.name_scope():
+        for _ in range(9):
+            net.add(nn.Dense(64, activation="relu"))
+        net.add(nn.Dense(1))
+    net.hybridize()
+    net.initialize(mx.init.Xavier(), ctx=ctx)
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.01, "momentum": 0.9},
+                            kvstore="tpu_sync", update_on_kvstore=False)
+
+    def one_step():
+        with autograd.record():
+            l = loss_fn(net(x), y)
+        l.backward()
+        trainer.step(bs)
+        return l
+
+    def measure():
+        """Median steps/s: individual step timings, median taken —
+        multi-ms scheduler stalls on a shared container would otherwise
+        dominate a mean and read as (anti-)recorder overhead."""
+        for _ in range(3):
+            last = one_step()
+        float(last.asnumpy().ravel()[0])  # compile+warmup sync
+        times = []
+        for _ in range(steps):
+            t0 = time.perf_counter()
+            last = one_step()
+            float(last.asnumpy().ravel()[0])
+            times.append(time.perf_counter() - t0)
+        return 1.0 / float(np.median(times))
+
+    was_on = flight.ENABLED
+    tmp_dir = tempfile.mkdtemp(prefix="mxt-bench-flight-")
+    prev_dir = os.environ.get("MXNET_FLIGHT_DIR")
+    # noisy-container steps WILL trip the slow-step watchdog mid-leg;
+    # its auto-dumps belong in the leg's scratch dir, not the cwd
+    os.environ["MXNET_FLIGHT_DIR"] = tmp_dir
+    try:
+        try:
+            # throwaway leg: compiles + allocator warm for BOTH
+            # measured legs, so leg order doesn't masquerade as
+            # recorder overhead
+            flight.disable()
+            measure()
+            # interleaved rounds, best-of per mode: the recorder's
+            # cost is microseconds under a milliseconds-noisy
+            # shared-container step, so a single A/B pair routinely
+            # reads negative overhead — best-of is the
+            # least-interference estimate for each mode
+            off_sps = on_sps = 0.0
+            for _ in range(3):
+                flight.disable()
+                off_sps = max(off_sps, measure())
+                flight.enable()
+                flight.reset()
+                on_sps = max(on_sps, measure())
+        finally:
+            (flight.enable if was_on else flight.disable)()
+            if prev_dir is None:
+                os.environ.pop("MXNET_FLIGHT_DIR", None)
+            else:
+                os.environ["MXNET_FLIGHT_DIR"] = prev_dir
+        st = flight.stats()
+        t0 = time.perf_counter()
+        path = flight.dump(path=os.path.join(tmp_dir,
+                                             "bench_flight.json"))
+        dump_ms = (time.perf_counter() - t0) * 1e3
+        with open(path) as f:
+            n_events = len(_json.load(f)["traceEvents"])
+    finally:
+        # the OUTER finally owns the scratch dir: a raise anywhere in
+        # the measured legs (not just the dump) must not leak it — it
+        # may already hold watchdog auto-dumps
+        import shutil
+        shutil.rmtree(tmp_dir, ignore_errors=True)
+    overhead_pct = (off_sps - on_sps) / off_sps * 100.0 if off_sps else 0.0
+    return {
+        "steps_per_s_enabled": round(on_sps, 2),
+        "steps_per_s_disabled": round(off_sps, 2),
+        "overhead_pct": round(overhead_pct, 2),
+        "overhead_budget_pct": 2.0,
+        "ok": overhead_pct <= 2.0,
+        "ring_drops": st["drops"],
+        "ring_records": st["records"],
+        "dump_ms": round(dump_ms, 2),
+        "dump_events": n_events,
+    }
 
 
 def _lint_leg(mx):

@@ -646,14 +646,18 @@ class EnvVarSyncChecker:
 # 5. metrics-hygiene
 # ---------------------------------------------------------------------------
 class MetricsHygieneChecker:
-    """Metric names and label VALUES must come from bounded sets — an
-    f-string / %-format / .format() label value is unbounded cardinality
-    (the PR 6 per-tenant series leak: every distinct string becomes a
-    forever-живая time series in the registry and the scrape).
+    """Metric names, label VALUES, and flight-recorder phase names must
+    come from bounded sets — an f-string / %-format / .format() value
+    is unbounded cardinality (the PR 6 per-tenant series leak: every
+    distinct string becomes a forever-живая time series in the registry
+    and the scrape; ISSUE 8 extends the same rule to ``phase_span``
+    names, each of which is a forever-entry in ``flight.summary()`` and
+    an EWMA slot in the slow-phase watchdog).
 
     Flags dynamic strings passed as label kwargs to ``.inc/.set/.dec``
-    on ALL-CAPS metric objects, and non-literal metric names in
-    ``Counter/Gauge/Histogram`` constructions.  ``type(e).__name__``
+    on ALL-CAPS metric objects, non-literal metric names in
+    ``Counter/Gauge/Histogram`` constructions, and dynamically built
+    phase names passed to ``phase_span(...)``.  ``type(e).__name__``
     and plain variables are allowed — bounded sets routed through a
     variable are the normal idiom; string BUILDING at the call site is
     the defect.
@@ -721,6 +725,31 @@ class MetricsHygieneChecker:
                         "metric name is dynamically built — names must "
                         "be literal so the registry and dashboards are "
                         "enumerable"))
+            # flight-recorder phase names (ISSUE 8): phase_span("x"),
+            # flight.record("x", ...) — every distinct name is an
+            # unbounded entry in flight.summary() + a watchdog EWMA
+            # slot.  `phase_span` is distinctive enough to match under
+            # ANY receiver (x.phase_span / profiler.phase_span / bare);
+            # `record` is too generic, so it stays allowlisted to
+            # flight-ish bases (other aliases escape — conservative by
+            # design, a miss is recoverable)
+            last = cn.split(".")[-1]
+            if (last == "phase_span"
+                    or (last == "record"
+                        and cn.split(".")[0] in ("record", "flight",
+                                                 "_flight", "fl"))) and \
+                    node.args:
+                name_arg = node.args[0]
+                why = self._dynamic_str(name_arg)
+                if why:
+                    out.append(ctx.finding(
+                        self.name, name_arg,
+                        f"flight-recorder phase name is dynamically "
+                        f"built ({why}) — phase names must come from a "
+                        f"bounded literal set (unbounded phase "
+                        f"cardinality grows flight.summary() and the "
+                        f"watchdog EWMA table forever; put the varying "
+                        f"part in labels=... instead)"))
         return out
 
 

@@ -218,6 +218,27 @@ def atomic_write(fname: str, data) -> None:
     os.replace(tmp, fname)
 
 
+def unique_path(directory: str, stem: str, ext: str, clock=None) -> str:
+    """Collision-free timestamped file path — the ONE filename policy
+    every dump writer (``profiler.dump_profile`` autosnapshots,
+    ``observability.flight.dump``) shares:
+    ``<dir>/<stem>-<UTC stamp>-<pid>[.N]<ext>``.
+
+    ``clock`` is the injectable epoch-seconds source (default
+    ``time.time``) so tests exercise the collision suffix
+    deterministically instead of racing ambient wall-clock."""
+    import time as _time
+    t = (clock or _time.time)()
+    stamp = _time.strftime("%Y%m%d-%H%M%S", _time.gmtime(t))
+    base_name = f"{stem}-{stamp}-{os.getpid()}"
+    path = os.path.join(directory, base_name + ext)
+    n = 1
+    while os.path.exists(path):
+        path = os.path.join(directory, f"{base_name}.{n}{ext}")
+        n += 1
+    return path
+
+
 # ---------------------------------------------------------------------------
 # Generic registry (parity: dmlc::Registry / python/mxnet/registry.py)
 # ---------------------------------------------------------------------------

@@ -34,6 +34,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 from ..analysis import sanitizer as _san
 from ..base import MXNetError, getenv
 from ..faultinject import fire as _fi_fire
+from ..observability import flight as _flight
 from ..observability import metrics as _metrics
 from . import layout as _layout
 from .layout import CheckpointInvalidError
@@ -178,22 +179,29 @@ class CheckpointManager:
         self._raise_pending_error()
         step = int(step)
         t0 = time.perf_counter()
-        snap = _layout.snapshot_state(state)
-        job = (step, snap, dict(meta or {}), dict(signatures or {}), t0)
-        if self._async and not block:
-            with self._lock:
-                self._ensure_worker()
-                # backpressure: degrade toward synchronous when storage
-                # can't keep up, never queue unboundedly (each job pins
-                # a full host snapshot)
-                while self._pending >= self.max_pending:
-                    self._lock.wait()
-                self._queue.append(job)
-                self._pending += 1
-                self._lock.notify_all()
-        else:
-            self._run_job(job)
-            self._raise_pending_error()
+        # the caller-visible blocking phase: snapshot + (async) queue
+        # admission, or the whole write in sync mode — the flight span
+        # answers "what stole time from MY step", CHECKPOINT_SAVE_SECONDS
+        # answers "how long did the write take"
+        with _flight.phase_span("checkpoint_block", cat="checkpoint",
+                                step=step):
+            snap = _layout.snapshot_state(state)
+            job = (step, snap, dict(meta or {}), dict(signatures or {}),
+                   t0)
+            if self._async and not block:
+                with self._lock:
+                    self._ensure_worker()
+                    # backpressure: degrade toward synchronous when
+                    # storage can't keep up, never queue unboundedly
+                    # (each job pins a full host snapshot)
+                    while self._pending >= self.max_pending:
+                        self._lock.wait()
+                    self._queue.append(job)
+                    self._pending += 1
+                    self._lock.notify_all()
+            else:
+                self._run_job(job)
+                self._raise_pending_error()
         if _metrics.ENABLED:
             _metrics.CHECKPOINT_SAVE_BLOCKED_SECONDS.observe(
                 time.perf_counter() - t0)
@@ -248,6 +256,11 @@ class CheckpointManager:
 
     def _run_job_locked(self, job: tuple) -> None:
         step, snap, meta, signatures, t0 = job
+        with _flight.phase_span("checkpoint_write", cat="checkpoint",
+                                step=step):
+            self._run_attempts(step, snap, meta, signatures, t0)
+
+    def _run_attempts(self, step, snap, meta, signatures, t0) -> None:
         attempts = self.retries + 1
         delay = self.backoff_s
         for attempt in range(attempts):

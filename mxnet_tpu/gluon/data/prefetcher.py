@@ -20,6 +20,7 @@ import queue as _queue
 import threading
 import time as _time
 
+from ...observability import flight as _flight
 from ...observability import metrics as _metrics
 
 # end-of-stream sentinel (not None: sources may legitimately yield None)
@@ -80,7 +81,11 @@ class AsyncPrefetcher:
             try:
                 item = self._next_fn()
                 if self._transform is not None:
-                    item = self._transform(item)
+                    # device placement (h2d) happens HERE on the worker
+                    # thread — the flight span attributes the transfer
+                    # to the producer, not the consumer's wait
+                    with _flight.phase_span("prefetch_h2d", cat="io"):
+                        item = self._transform(item)
             except StopIteration:
                 self._queue.put(_END)
                 return
@@ -99,7 +104,8 @@ class AsyncPrefetcher:
             raise StopIteration
         on = _metrics.ENABLED and self._observe_wait
         t0 = _time.perf_counter() if on else 0.0
-        item = self._queue.get()
+        with _flight.phase_span("prefetch_wait", cat="io"):
+            item = self._queue.get()
         if on:
             _metrics.PREFETCH_WAIT_SECONDS.observe(_time.perf_counter() - t0)
         if item is _END:

@@ -30,6 +30,7 @@ import numpy as _np
 from ..analysis import hot_path
 from ..base import MXNetError, getenv
 from ..ndarray import NDArray
+from ..observability import flight as _flight
 from ..observability import metrics as _metrics
 from ..observability.tracing import trace_span
 from .. import optimizer as opt
@@ -75,6 +76,10 @@ class Trainer:
         # (bucket_sig, numpy arrays) from load_states, adopted — with a
         # signature check — when the bucketer is next built
         self._pending_residuals = None
+        # monotonically increasing step id stamped on flight-recorder
+        # phase records (joins allreduce/compress/update sub-phases to
+        # their step in a timeline dump)
+        self._step_id = 0
 
     def _init_optimizer(self, optimizer, optimizer_params):
         param_dict = {i: param for i, param in enumerate(self._params)}
@@ -166,8 +171,11 @@ class Trainer:
         mxnet_trainer_step_dispatches gauge."""
         on = _metrics.ENABLED
         d0 = _metrics.step_dispatches() if on else 0.0
-        with trace_span("trainer_step", cat="optimizer"):
+        with trace_span("trainer_step", cat="optimizer"), \
+                _flight.phase_span("trainer_step", cat="step",
+                                   step=self._step_id, watch=True):
             self._step(batch_size, ignore_stale_grad)
+        self._step_id += 1
         if on:
             _metrics.TRAINER_STEP_DISPATCHES.set(
                 _metrics.step_dispatches() - d0)
@@ -287,15 +295,20 @@ class Trainer:
             self._residuals = None
         bk = self._bucketer
         gc = getattr(self._kv, "_gc", None)
-        with trace_span("bucketed_allreduce", cat="kvstore"):
+        with trace_span("bucketed_allreduce", cat="kvstore"), \
+                _flight.phase_span("allreduce", cat="kvstore",
+                                   step=self._step_id):
             flats = bk.flatten([g.handle for g in grads])
             ctx = grads[0].context
             buckets = [NDArray(f, ctx) for f in flats]
             if gc is not None:
                 if self._residuals is None:
                     self._residuals = self._init_residuals(bk)
-                reduced, self._residuals = self._kv.allreduce(
-                    buckets, compression=gc, residuals=self._residuals)
+                with _flight.phase_span("compress", cat="kvstore",
+                                        step=self._step_id):
+                    reduced, self._residuals = self._kv.allreduce(
+                        buckets, compression=gc,
+                        residuals=self._residuals)
             else:
                 reduced = self._kv.allreduce(buckets)
         return ([r.handle for r in reduced],
@@ -384,14 +397,19 @@ class Trainer:
                         "allreduce and update steps saw different live "
                         "parameter sets")
                 if live:
-                    upd.update_all(
-                        [i for i, _ in live], flats,
-                        [p.list_data()[0] for _, p in live],
-                        grad_views=[views[pos[i]] for i, _ in live])
+                    with _flight.phase_span("fused_update",
+                                            cat="optimizer",
+                                            step=self._step_id):
+                        upd.update_all(
+                            [i for i, _ in live], flats,
+                            [p.list_data()[0] for _, p in live],
+                            grad_views=[views[pos[i]] for i, _ in live])
             else:
-                upd.update_all([i for i, _ in live],
-                               [p.list_grad()[0] for _, p in live],
-                               [p.list_data()[0] for _, p in live])
+                with _flight.phase_span("fused_update", cat="optimizer",
+                                        step=self._step_id):
+                    upd.update_all([i for i, _ in live],
+                                   [p.list_grad()[0] for _, p in live],
+                                   [p.list_data()[0] for _, p in live])
             self._clear_fresh(done)
             return
         if fused_ok and ncopies > 1 and \

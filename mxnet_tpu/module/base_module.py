@@ -16,6 +16,7 @@ from .. import metric as _metric
 from .. import ndarray as nd
 from ..io import DataDesc
 from ..model import BatchEndParam
+from ..observability import flight as _flight
 from ..observability import metrics as _obs
 from ..observability.tracing import step_span, trace_span
 
@@ -208,11 +209,15 @@ class BaseModule:
                     num_epoch, global_step, _ckpt, checkpoint_period):
         for epoch in range(begin_epoch, num_epoch):
             tic = time.time()
+            # decided ONCE per epoch: flipping the recorder on mid-epoch
+            # must not fabricate a span with a t0 from before the flip
+            ep_t0 = _flight.now_us() if _flight.ENABLED else None
             eval_metric.reset()
             nbatch = 0
             data_iter = iter(train_data)
             end_of_batch = False
-            with trace_span("data_fetch", cat="io"):
+            with trace_span("data_fetch", cat="io"), \
+                    _flight.phase_span("data_wait", cat="io"):
                 next_data_batch = next(data_iter)
             while not end_of_batch:
                 data_batch = next_data_batch
@@ -240,12 +245,16 @@ class BaseModule:
                     if obs_on and not getattr(
                             data_iter, "_self_timed_data_wait", False):
                         t0 = time.perf_counter()
-                        with trace_span("data_fetch", cat="io"):
+                        with trace_span("data_fetch", cat="io"), \
+                                _flight.phase_span("data_wait", cat="io",
+                                                   step=global_step):
                             next_data_batch = next(data_iter)
                         _obs.DATA_WAIT_SECONDS.observe(
                             time.perf_counter() - t0)
                     else:
-                        with trace_span("data_fetch", cat="io"):
+                        with trace_span("data_fetch", cat="io"), \
+                                _flight.phase_span("data_wait", cat="io",
+                                                   step=global_step):
                             next_data_batch = next(data_iter)
                     self.prepare(next_data_batch)
                 except StopIteration:
@@ -293,6 +302,11 @@ class BaseModule:
                 for name, val in res:
                     self.logger.info("Epoch[%d] Validation-%s=%f", epoch, name, val)
             train_data.reset()
+            if ep_t0 is not None:
+                # non-lexical span (the epoch body is one loop pass):
+                # recorded via the raw clock + record() pair
+                _flight.record("fit_epoch", "train", ep_t0,
+                               _flight.now_us(), step=epoch)
 
     def _adopt_existing_bind(self, data_shapes, label_shapes, for_training,
                              inputs_need_grad=False, grad_req="write",

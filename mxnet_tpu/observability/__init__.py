@@ -20,6 +20,14 @@ package makes that visibility a product API:
     ops in TensorBoard/Perfetto.
   - `dispatch_counts()` — the queryable per-kind XLA-launch/transfer
     tally that `tests/test_dispatch_count.py` pins as an invariant.
+  - `mxnet_tpu.observability.flight` — the always-on flight recorder:
+    `phase_span(...)` ring-records per-phase step/request timelines
+    (data-wait/h2d/allreduce/fused-update, queue-wait/pad/dispatch/
+    slice with end-to-end trace ids), `flight.dump()` exports a
+    Perfetto-loadable Chrome trace merging training + serving +
+    profiler `_events`, and a slow-step/slow-request watchdog
+    auto-dumps the ring on anomaly and on SIGUSR2
+    (`MXNET_FLIGHT=0` disables; see docs/observability.md).
 
 Overhead discipline: every hot-path hook is guarded by the module-level
 `metrics.ENABLED` flag (env `MXNET_METRICS_ENABLED`, default on; set 0
@@ -30,16 +38,20 @@ from __future__ import annotations
 
 from . import metrics
 from . import tracing
+from . import flight
+from . import timeline
 from .metrics import (Counter, Gauge, Histogram, MetricsRegistry, REGISTRY,
                       enabled, enable, disable, dispatch_counts,
                       step_dispatches, snapshot, render_prometheus,
                       render_json, hbm_stats)
 from .tracing import trace_span, step_span, annotate
+from .flight import phase_span, trace_scope, new_trace_id
 
 __all__ = [
-    "metrics", "tracing", "Counter", "Gauge", "Histogram",
-    "MetricsRegistry", "REGISTRY", "enabled", "enable", "disable",
-    "dispatch_counts", "step_dispatches", "snapshot",
+    "metrics", "tracing", "flight", "timeline", "Counter", "Gauge",
+    "Histogram", "MetricsRegistry", "REGISTRY", "enabled", "enable",
+    "disable", "dispatch_counts", "step_dispatches", "snapshot",
     "render_prometheus", "render_json", "hbm_stats",
     "trace_span", "step_span", "annotate",
+    "phase_span", "trace_scope", "new_trace_id",
 ]

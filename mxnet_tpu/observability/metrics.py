@@ -205,7 +205,13 @@ _DEFAULT_BUCKETS = (1e-4, 5e-4, 1e-3, 5e-3, 1e-2, 5e-2, 0.1, 0.5, 1.0,
 
 class Histogram(Metric):
     """Fixed-bucket histogram (cumulative `le` buckets on export, like
-    Prometheus); tracks sum + count so mean is recoverable."""
+    Prometheus); tracks sum + count so mean is recoverable.
+
+    ``observe(value, exemplar=...)`` additionally remembers the latest
+    exemplar (a flight-recorder trace_id) per bucket — the OpenMetrics
+    exemplar idea: a p99 bucket links to one concrete recorded request
+    timeline instead of an anonymous count (``exemplars()``,
+    ``snapshot()["serving"]["latency_exemplars"]``)."""
 
     kind = "histogram"
 
@@ -215,17 +221,34 @@ class Histogram(Metric):
         self._counts = [0] * (len(self.buckets) + 1)  # +inf tail
         self._sum = 0.0
         self._count = 0
+        self._exemplars: Dict[int, Tuple[float, object]] = {}
         super().__init__(name, help, registry)
 
-    def observe(self, value: float) -> None:
+    def observe(self, value: float, exemplar=None) -> None:
         with _MUT_LOCK:
             self._sum += value
             self._count += 1
             for i, b in enumerate(self.buckets):
                 if value <= b:
                     self._counts[i] += 1
+                    if exemplar is not None:
+                        self._exemplars[i] = (value, exemplar)
                     return
             self._counts[-1] += 1
+            if exemplar is not None:
+                self._exemplars[len(self.buckets)] = (value, exemplar)
+
+    def exemplars(self) -> Dict[str, dict]:
+        """{le: {"value", "trace_id"}} for buckets that have one —
+        the hop from a latency percentile to `flight` dump spans."""
+        with _MUT_LOCK:
+            items = list(self._exemplars.items())
+        out = {}
+        for i, (v, ex) in sorted(items):
+            le = "+Inf" if i >= len(self.buckets) \
+                else repr(float(self.buckets[i]))
+            out[le] = {"value": v, "trace_id": ex}
+        return out
 
     @property
     def count(self) -> int:
@@ -243,6 +266,7 @@ class Histogram(Metric):
         self._counts = [0] * (len(self.buckets) + 1)
         self._sum = 0.0
         self._count = 0
+        self._exemplars.clear()
 
     def samples(self):
         out, cum = [], 0
@@ -506,6 +530,14 @@ ANALYSIS_SYNC_VIOLATIONS = Counter(
     "mxnet_analysis_sync_violations_total",
     "Device->host syncs observed inside analysis.no_sync() regions "
     "(runtime complement of the static host-sync graft-lint rule)")
+FLIGHT_DUMPS = Counter(
+    "mxnet_flight_dumps_total",
+    "Flight-recorder timeline dumps by reason (manual = flight.dump() "
+    "call, anomaly = slow-phase watchdog trip [k x EWMA, "
+    "MXNET_FLIGHT_SLOW_FACTOR], signal = SIGUSR2).  A climbing anomaly "
+    "count is the page-the-oncall signal that steps/requests keep "
+    "blowing their own baseline — each dump under MXNET_FLIGHT_DIR "
+    "holds the timeline of the moments before it")
 COMPRESSION_ERROR = Histogram(
     "mxnet_compression_error",
     "Mean |quantization error| per gradient bucket per compressed "
@@ -580,6 +612,17 @@ def dispatch_counts() -> Dict[str, float]:
     return out
 
 
+def _flight_snapshot() -> dict:
+    """snapshot()["flight"]: ring/watchdog state + per-phase p50/p99 +
+    slowest-record exemplars (docs/observability.md).  Lazy/guarded —
+    the metrics layer must never fail because of the recorder."""
+    try:
+        from . import flight as _fl
+        return _fl.snapshot_summary()
+    except Exception:  # noqa: BLE001
+        return {"enabled": False}
+
+
 def _analysis_snapshot() -> dict:
     """snapshot()["analysis"]: sanitizer state + violation counters
     (docs/static_analysis.md).  The sanitizer import is lazy/guarded —
@@ -637,7 +680,10 @@ def snapshot() -> dict:
             "ready_transitions": SERVE_READY_TRANSITIONS.value,
             "reload_failures": SERVE_RELOAD_FAILURES.value,
             "faults_injected": FAULTS_INJECTED.value,
+            # exemplar hop: p99 bucket -> trace_id -> flight dump spans
+            "latency_exemplars": SERVE_LATENCY_SECONDS.exemplars(),
         },
+        "flight": _flight_snapshot(),
         "analysis": _analysis_snapshot(),
         "checkpoint": {
             "last_step": CHECKPOINT_LAST_STEP.get(),

@@ -1,0 +1,104 @@
+"""Timeline export for the flight recorder: Chrome trace-event JSON
+(Perfetto-loadable) + per-phase latency digests.
+
+One format, three producers merged on one timeline:
+
+  * flight-recorder ring records (``flight.records()``) — training and
+    serving phase spans, per-thread tids, step/trace_id args;
+  * the profiler's python-side ``_events`` (eager op invokes and
+    ``trace_span`` scopes) — already Chrome-trace complete events;
+  * (device-side detail stays in the xplane trace directory the
+    profiler manages; wall-clock lines the two files up in Perfetto.)
+
+All python-side producers stamp ``time.perf_counter()`` microseconds,
+so sorting by ``ts`` is globally consistent.  The dump is the standard
+`trace-event format <https://docs.google.com/document/d/1CvAClvFfyA5R-
+PhYUmn5OOQtYMH4h6I0nSsKchNAySU>`_: load it in Perfetto
+(ui.perfetto.dev) or chrome://tracing unmodified.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+__all__ = ["build_trace", "chrome_events", "summarize"]
+
+#: pid stamped on every python-side event — matches profiler._events so
+#: all sources group under one process row in the viewer
+PID = 0
+
+
+def chrome_events(flight_records: List[tuple]) -> List[dict]:
+    """``(segment, record)`` pairs → Chrome trace complete events plus
+    one thread_name metadata event per segment."""
+    events: List[dict] = []
+    seen_tids: Dict[int, str] = {}
+    for seg, rec in flight_records:
+        name, cat, t0, t1, step, trace_id, labels = rec
+        seen_tids.setdefault(seg.tid, seg.thread_name)
+        ev = {"name": name, "cat": cat, "ph": "X", "ts": t0,
+              "dur": t1 - t0, "pid": PID, "tid": seg.tid}
+        args = {}
+        if step is not None:
+            args["step"] = step
+        if trace_id is not None:
+            args["trace_id"] = trace_id
+        if labels:
+            args.update(labels)
+        if args:
+            ev["args"] = args
+        events.append(ev)
+    for tid, tname in sorted(seen_tids.items()):
+        events.append({"name": "thread_name", "ph": "M", "pid": PID,
+                       "tid": tid, "args": {"name": tname}})
+    return events
+
+
+def build_trace(flight_records: List[tuple],
+                profiler_events: Optional[List[dict]] = None,
+                meta: Optional[dict] = None) -> dict:
+    """The full dump payload: flight events merged with the profiler's
+    ``_events`` (same pid/clock), sorted by timestamp so viewers and
+    tests see one coherent timeline."""
+    events = chrome_events(flight_records)
+    if profiler_events:
+        events.extend(profiler_events)
+    events.sort(key=lambda e: e.get("ts", 0))
+    out = {"traceEvents": events, "displayTimeUnit": "ms"}
+    if meta:
+        out["metadata"] = dict(meta)
+    return out
+
+
+def _pctl(sorted_vals: List[float], q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    idx = min(len(sorted_vals) - 1, int(q * len(sorted_vals)))
+    return sorted_vals[idx]
+
+
+def summarize(flight_records: List[tuple], top: int = 3) -> dict:
+    """Per-phase digest: ``{name: {count, total_ms, p50_ms, p99_ms,
+    max_ms, slowest: [{dur_ms, t0_us, step, trace_id}]}}`` — the
+    compact complement of the full dump (``snapshot()["flight"]``).
+    ``slowest`` carries step/trace_id so a bad percentile links to a
+    concrete recorded timeline."""
+    by_name: Dict[str, List[tuple]] = {}
+    for _, rec in flight_records:
+        by_name.setdefault(rec[0], []).append(rec)
+    out: Dict[str, dict] = {}
+    for name, recs in sorted(by_name.items()):
+        durs = sorted(r[3] - r[2] for r in recs)   # microseconds
+        slowest = sorted(recs, key=lambda r: r[3] - r[2],
+                         reverse=True)[:max(0, top)]
+        out[name] = {
+            "count": len(durs),
+            "total_ms": round(sum(durs) / 1e3, 3),
+            "p50_ms": round(_pctl(durs, 0.50) / 1e3, 3),
+            "p99_ms": round(_pctl(durs, 0.99) / 1e3, 3),
+            "max_ms": round(durs[-1] / 1e3, 3),
+            "slowest": [{"dur_ms": round((r[3] - r[2]) / 1e3, 3),
+                         "t0_us": round(r[2], 1),
+                         "step": r[4], "trace_id": r[5]}
+                        for r in slowest],
+        }
+    return out

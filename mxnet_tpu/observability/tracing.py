@@ -8,16 +8,23 @@
     (TensorBoard / Perfetto line the two up by wall-clock).
 
 `step_span(step)` additionally uses `jax.profiler.StepTraceAnnotation`,
-which TensorBoard's profile plugin uses for per-step breakdowns.
+which TensorBoard's profile plugin uses for per-step breakdowns — and,
+since ISSUE 8, feeds the always-on flight recorder
+(`observability.flight`) even while the profiler is paused/stopped:
+both timelines stamp the SAME `time.perf_counter()` monotonic clock,
+so flight records and profiler `_events` can never disagree on t0/t1
+ordering.
 
-Fast path: when the profiler is stopped, a span is ONE predicate test —
-no timestamps, no annotation objects, no allocation beyond the generator
-frame.  Nesting is expressed the Chrome-trace way: events on the same
-pid/tid whose [ts, ts+dur] ranges contain each other render nested.
+Fast path: when the profiler is stopped, a `trace_span` is ONE
+predicate test — no timestamps, no annotation objects, no allocation
+beyond the generator frame.  Nesting is expressed the Chrome-trace way:
+events on the same pid/tid whose [ts, ts+dur] ranges contain each other
+render nested.
 """
 from __future__ import annotations
 
 import contextlib
+import sys
 import threading
 import time
 
@@ -30,7 +37,8 @@ _tid_map: dict = {}
 
 def _tid() -> int:
     """Small stable per-thread id (Chrome trace tids are more readable
-    than 140-bit thread idents)."""
+    than 140-bit thread idents).  Shared with the flight recorder so
+    merged dumps line threads up."""
     t = getattr(_tls, "tid", None)
     if t is None:
         with _tid_lock:
@@ -48,6 +56,11 @@ def _profiler():
     return profiler
 
 
+def _flight():
+    from . import flight
+    return flight
+
+
 def _annotation(name: str):
     try:
         import jax
@@ -59,23 +72,44 @@ def _annotation(name: str):
 @contextlib.contextmanager
 def trace_span(name: str, cat: str = "runtime"):
     """Record `name` as a nested span on both timelines while the
-    profiler runs; a no-op predicate test otherwise."""
+    profiler runs; a no-op predicate test otherwise.
+
+    Exception-safe depth accounting: the increment/decrement pair and
+    the event record sit in `finally` blocks ordered so that a raising
+    body (or a raising annotation `__exit__`) can neither leak a depth
+    level nor lose the event — the profiler `_events` buffer and the
+    flight ring must agree on span nesting after an exception unwinds
+    through a step."""
     prof = _profiler()
     if not prof.is_recording():
         yield
         return
     ann = _annotation(name)
-    if ann is not None:
-        ann.__enter__()
-    _tls.depth = _depth() + 1
     start = time.perf_counter() * 1e6
+    _tls.depth = _depth() + 1
+    entered = False
     try:
-        yield
+        if ann is not None:
+            ann.__enter__()
+            entered = True
+        try:
+            yield
+        except BaseException:
+            # the annotation sees exactly the exception unwinding
+            # through the SPAN BODY — never an unrelated outer
+            # exception sys.exc_info() would report on a normal
+            # completion inside an except handler, and never an
+            # __exit__ on an annotation whose __enter__ raised
+            if entered:
+                entered = False
+                ann.__exit__(*sys.exc_info())
+            raise
+        if entered:
+            entered = False
+            ann.__exit__(None, None, None)
     finally:
         end = time.perf_counter() * 1e6
-        _tls.depth -= 1
-        if ann is not None:
-            ann.__exit__(None, None, None)
+        _tls.depth = _depth() - 1
         prof.record_event(name, start, end, cat=cat, tid=_tid(),
                           args={"depth": _depth()})
 
@@ -83,27 +117,55 @@ def trace_span(name: str, cat: str = "runtime"):
 @contextlib.contextmanager
 def step_span(step_num: int, name: str = "train"):
     """Step-boundary annotation: xplane StepTraceAnnotation (feeds
-    TensorBoard's per-step breakdown) + a Chrome-trace span."""
+    TensorBoard's per-step breakdown) + a Chrome-trace span + an
+    always-on flight-recorder step record.
+
+    The flight record uses the monotonic `perf_counter` clock whether
+    or not the profiler is running — in particular while the profiler
+    is PAUSED (is_running but not recording), the step still lands in
+    the ring with correctly ordered t0/t1, so a later resume cannot
+    interleave out-of-order events between the two timelines.  It also
+    feeds the slow-step watchdog (`flight.note`)."""
     prof = _profiler()
-    if not prof.is_recording():
+    rec = prof.is_recording()
+    fl = _flight()
+    if not rec and not fl.ENABLED:
         yield
         return
     ann = None
-    try:
-        import jax
-        ann = jax.profiler.StepTraceAnnotation(name, step_num=step_num)
-        ann.__enter__()
-    except Exception:
-        ann = None
+    if rec:
+        try:
+            import jax
+            ann = jax.profiler.StepTraceAnnotation(name, step_num=step_num)
+            ann.__enter__()
+        except Exception:
+            ann = None
+    # bounded by construction: callers pass literal step-stream names
+    # ("train"), so the derived record name is one entry per stream
+    rec_name = name + "_step"
     start = time.perf_counter() * 1e6
     try:
-        yield
+        try:
+            yield
+        except BaseException:
+            # only a body exception reaches the annotation (see
+            # trace_span): normal completion inside an outer except
+            # handler must not report that handler's exception
+            if ann is not None:
+                a, ann = ann, None
+                a.__exit__(*sys.exc_info())
+            raise
+        if ann is not None:
+            a, ann = ann, None
+            a.__exit__(None, None, None)
     finally:
         end = time.perf_counter() * 1e6
-        if ann is not None:
-            ann.__exit__(None, None, None)
-        prof.record_event(f"{name}_step", start, end, cat="step",
-                          tid=_tid(), args={"step": step_num})
+        if rec:
+            prof.record_event(rec_name, start, end, cat="step",
+                              tid=_tid(), args={"step": step_num})
+        if fl.ENABLED:
+            fl.record(rec_name, "step", start, end, step=step_num,
+                      watch=True)
 
 
 def annotate(name: str):

@@ -113,17 +113,16 @@ def is_recording() -> bool:
 def dump_profile():
     """Parity: MXDumpProfile — write chrome-trace JSON of python-side
     events (device-side detail lives in the xplane trace directory).
-    Atomic: a crash mid-dump leaves the previous file intact, never a
-    truncated/invalid JSON."""
+    Atomic via the same ``base.atomic_write`` policy the flight
+    recorder's dumps use: a crash mid-dump leaves the previous file
+    intact, never a truncated/invalid JSON."""
     global _state
+    from .base import atomic_write
     _stop_trace()
     _state = "stop"
-    fname = _config["filename"]
-    tmp = fname + ".tmp"
-    with open(tmp, "w") as f:
-        json.dump({"traceEvents": _events,
-                   "displayTimeUnit": "ms"}, f)
-    os.replace(tmp, fname)
+    atomic_write(_config["filename"],
+                 json.dumps({"traceEvents": _events,
+                             "displayTimeUnit": "ms"}))
 
 
 def pause():
@@ -159,6 +158,20 @@ def dump_metrics() -> dict:
     transfer bytes, data-wait, HBM) — see observability.metrics."""
     from .observability import metrics as _m
     return _m.snapshot()
+
+
+def phase_span(name: str, cat: str = "phase", **kw):
+    """Flight-recorder phase span (observability.flight) — always-on
+    ring recording, independent of the profiler state."""
+    from .observability.flight import phase_span as _ps
+    return _ps(name, cat=cat, **kw)
+
+
+def dump_flight(path=None):
+    """Dump the flight-recorder ring (merged with this profiler's
+    `_events`) as Perfetto-loadable Chrome trace JSON."""
+    from .observability.flight import dump as _dump
+    return _dump(path)
 
 
 if getenv("MXNET_PROFILER_AUTOSTART", 0):

@@ -20,6 +20,14 @@ docs/serving_resilience.md are the guides):
     the metrics registry.  Failure behavior is testable via
     `mxnet_tpu.faultinject`.
 
+Every request is flight-recorded end to end (ISSUE 8,
+docs/observability.md): a trace_id minted at submit rides through
+submit/admission -> queue-wait -> pad -> dispatch -> slice phase spans
+across the batcher/scheduler threads, the serving latency histogram
+carries per-bucket exemplar trace ids, and a slow-request watchdog
+auto-dumps a Perfetto-loadable timeline on anomaly
+(`observability.flight`; `MXNET_FLIGHT=0` disables).
+
 Reference lineage: the C predict API + bucketing executors of MXNet
 (arxiv 1512.01274), TVM's ahead-of-time deployment modules
 (arxiv 1802.04799), and TF-Serving's health-checked batching workers

@@ -14,6 +14,7 @@ converges to zero (the previous dispatch IS the wait).
 """
 from __future__ import annotations
 
+import contextlib
 import queue
 import threading
 import time
@@ -25,11 +26,31 @@ import numpy as _np
 from ..analysis import hot_path, sanitizer as _san
 from ..base import MXNetError, getenv
 from ..faultinject import fire as _fi_fire
+from ..observability import flight as _flight
 from ..observability import metrics as _metrics
 from .buckets import covering_bucket, pad_to_shape
 
 __all__ = ["MicroBatcher", "BatcherClosedError", "BatcherDeadError",
-           "stack_requests"]
+           "stack_requests", "record_group_queue_wait",
+           "group_trace_scope"]
+
+
+def record_group_queue_wait(group, t_dispatch_us: float) -> None:
+    """Flight-record each request's queue-wait (submit t0 → dispatch
+    start) under its OWN trace id.  Shared by both dispatchers
+    (`MicroBatcher` / `ResilientServer`) so the queue-wait semantics
+    and id scheme cannot drift apart."""
+    for r in group:
+        _flight.record("serve_queue_wait", "serving", r.t0 * 1e6,
+                       t_dispatch_us, trace_id=r.trace_id)
+
+
+def group_trace_scope(group):
+    """Thread-local trace scope carrying the group's JOINED ids — the
+    pad/dispatch/slice spans recorded inside are joinable against every
+    member request (single-request group: its id verbatim)."""
+    return _flight.trace_scope(
+        _flight.join_ids([r.trace_id for r in group]))
 
 
 class BatcherClosedError(MXNetError):
@@ -44,13 +65,18 @@ class BatcherDeadError(MXNetError):
 
 
 class _Request:
-    __slots__ = ("inputs", "rows", "future", "t0")
+    __slots__ = ("inputs", "rows", "future", "t0", "trace_id")
 
     def __init__(self, inputs: Dict[str, _np.ndarray]):
         self.inputs = inputs
         self.rows = next(iter(inputs.values())).shape[0]
         self.future: Future = Future()
         self.t0 = time.perf_counter()
+        # flight-recorder request id, minted at submit and carried
+        # through queue-wait/pad/dispatch/slice so one request's spans
+        # are joinable across threads in a timeline dump
+        self.trace_id = _flight.new_trace_id() if _flight.ENABLED \
+            else None
 
 
 def stack_requests(spec, group) -> Dict[str, _np.ndarray]:
@@ -154,6 +180,12 @@ class MicroBatcher:
             req = _Request({n: self._pred._as_host(n, v)
                             for n, v in inputs.items()})
             self._pred._check_request(req.inputs)
+            if _flight.ENABLED:
+                # caller-thread anchor span: the request's trace id now
+                # exists on BOTH sides of the thread hop (submit here,
+                # queue-wait/pad/dispatch/slice on the dispatcher)
+                _flight.record("serve_submit", "serving", req.t0 * 1e6,
+                               _flight.now_us(), trace_id=req.trace_id)
         except Exception as e:  # noqa: BLE001 — delivered to caller
             f = Future()
             f.set_exception(e)
@@ -274,12 +306,20 @@ class MicroBatcher:
 
     @hot_path
     def _dispatch_group(self, group: List[_Request]) -> None:
+        fl = _flight.ENABLED
+        if fl:
+            record_group_queue_wait(group, _flight.now_us())
+        scope = group_trace_scope(group) if fl \
+            else contextlib.nullcontext()
         try:
-            stacked = stack_requests(self._pred.spec, group)
-            # the routed private path: request accounting happens HERE,
-            # per caller (predict() would count the stacked batch as one
-            # request and fold queue wait out of the latency histogram)
-            outs = self._pred._predict_routed(stacked)
+            with scope:
+                with _flight.phase_span("serve_stack", cat="serving"):
+                    stacked = stack_requests(self._pred.spec, group)
+                # the routed private path: request accounting happens
+                # HERE, per caller (predict() would count the stacked
+                # batch as one request and fold queue wait out of the
+                # latency histogram)
+                outs = self._pred._predict_routed(stacked)
             lo = 0
             for r in group:
                 # done() guard: close(timeout) may have already failed
@@ -291,13 +331,18 @@ class MicroBatcher:
                     r.future.set_result(
                         [o[lo:lo + r.rows] for o in outs])
                 lo += r.rows
+            now = time.perf_counter()
             if _metrics.ENABLED:
-                now = time.perf_counter()
                 _metrics.SERVE_REQUESTS.inc(len(group))
                 for r in group:
-                    _metrics.SERVE_LATENCY_SECONDS.observe(now - r.t0)
+                    _metrics.SERVE_LATENCY_SECONDS.observe(
+                        now - r.t0, exemplar=r.trace_id)
                 _metrics.SERVE_COALESCED_ROWS.set(
                     sum(r.rows for r in group))
+            if fl:
+                # slow-request watchdog: end-to-end latency vs EWMA
+                for r in group:
+                    _flight.note("serve_request", now - r.t0)
         except Exception as e:  # noqa: BLE001 — failures go to callers
             for r in group:
                 if not r.future.done():

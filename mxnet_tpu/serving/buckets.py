@@ -69,6 +69,17 @@ def covering_bucket(buckets: Sequence[int], n: int) -> Optional[int]:
     return None
 
 
+def bucket_label(key: tuple) -> str:
+    """Compact stable label for a bucket key in flight-recorder records
+    ("8" batch-only, "8x128" batch x seq, "chunked" for oversized
+    requests riding the largest bucket).  Cardinality is bounded by the
+    lattice — one label per bucket, ever — so it is safe to attach to
+    timeline spans and summaries."""
+    if key and key[0] is None:
+        return "chunked"
+    return "x".join(str(k) for k in key)
+
+
 def pad_to_shape(arr: _np.ndarray, shape: Tuple[int, ...]) -> _np.ndarray:
     """Zero-pad a host array up to `shape` (every dim of `arr` must be
     <= the target).  Host-side on purpose: requests arrive from the RPC

@@ -27,6 +27,7 @@ from __future__ import annotations
 import threading
 import time
 import warnings
+from contextlib import nullcontext as _nullcontext
 from typing import Dict, List, Optional, Sequence
 
 import numpy as _np
@@ -38,12 +39,13 @@ from ..base import MXNetError, maybe_enable_compile_cache, np_dtype
 from ..context import cpu
 from ..faultinject import fire as _fi_fire
 from ..ndarray import NDArray
+from ..observability import flight as _flight
 from ..observability import metrics as _metrics
 from ..observability.tracing import trace_span
 from .. import symbol as sym_mod
 from ..symbol import Symbol
 from ..symbol.graph import GraphPlan
-from .buckets import BucketSpec, pad_to_shape
+from .buckets import BucketSpec, bucket_label, pad_to_shape
 
 __all__ = ["BucketedPredictor"]
 
@@ -279,17 +281,24 @@ class BucketedPredictor:
     @hot_path
     def _dispatch(self, key: tuple, padded: dict) -> list:
         compiled = self.precompile(key)
-        # chaos site: delay = slow model under load (the overload chaos
-        # test's capacity governor), raise = failed dispatch — surfaces
-        # to the direct caller or the submitting future, typed
-        _fi_fire("serving.dispatch", key=key)
-        if _metrics.ENABLED:
-            _metrics.XLA_LAUNCHES.inc(kind="serve")
-            _metrics.SERVE_BATCHES.inc()
-        params, aux = self._weights  # one read: a mid-call hot_reload
-        with trace_span("serve_dispatch", cat="serving"):  # can't tear
-            return compiled(padded, self._extra[key], params, aux,
-                            self._rng)
+        # the flight span opens BEFORE the chaos site: an injected
+        # delay models a slow model under load, so it must show up as a
+        # long serve_dispatch phase in the timeline — exactly what the
+        # slow-request watchdog's auto-dump exists to attribute
+        with _flight.phase_span("serve_dispatch", cat="serving",
+                                labels={"bucket": bucket_label(key)}):
+            # chaos site: delay = slow model under load (the overload
+            # chaos test's capacity governor), raise = failed dispatch —
+            # surfaces to the direct caller or the submitting future
+            _fi_fire("serving.dispatch", key=key)
+            if _metrics.ENABLED:
+                _metrics.XLA_LAUNCHES.inc(kind="serve")
+                _metrics.SERVE_BATCHES.inc()
+            # one read: a mid-call hot_reload can't tear the pair
+            params, aux = self._weights
+            with trace_span("serve_dispatch", cat="serving"):
+                return compiled(padded, self._extra[key], params, aux,
+                                self._rng)
 
     @hot_path
     def _predict_routed(self, inputs: Dict[str, _np.ndarray]) -> list:
@@ -306,8 +315,10 @@ class BucketedPredictor:
             return [_np.concatenate(parts, axis=0)
                     for parts in zip(*outs_per_chunk)]
         bucket_shapes = self.spec.bucket_input_shapes(key)
-        padded = {n: pad_to_shape(a, bucket_shapes[n])
-                  for n, a in inputs.items()}
+        with _flight.phase_span("serve_pad", cat="serving",
+                                labels={"bucket": bucket_label(key)}):
+            padded = {n: pad_to_shape(a, bucket_shapes[n])
+                      for n, a in inputs.items()}
         if _metrics.ENABLED:
             _metrics.SERVE_PADDING_WASTE.set(
                 self.spec.waste_fraction(key, shapes))
@@ -317,7 +328,8 @@ class BucketedPredictor:
         # is model-defined (docs/inference.md).  The asarray below is
         # the request's ONE contractual device->host sync (serving is
         # host-in/host-out), not a hidden stall:
-        return [_np.asarray(o)[:rows] for o in outs]  # graft-lint: disable=host-sync
+        with _flight.phase_span("serve_slice", cat="serving"):
+            return [_np.asarray(o)[:rows] for o in outs]  # graft-lint: disable=host-sync
 
     def predict(self, *args, **kwargs) -> List[_np.ndarray]:
         """Run one request: positional args follow the symbol's input
@@ -334,10 +346,17 @@ class BucketedPredictor:
         t0 = time.perf_counter()
         inputs = {n: self._as_host(n, v) for n, v in kwargs.items()}
         self._check_request(inputs)
-        outs = self._predict_routed(inputs)
+        fl = _flight.ENABLED
+        trace_id = _flight.new_trace_id() if fl else None
+        with _flight.trace_scope(trace_id) if fl \
+                else _nullcontext():
+            outs = self._predict_routed(inputs)
+        dt = time.perf_counter() - t0
         if _metrics.ENABLED:
             _metrics.SERVE_REQUESTS.inc()
-            _metrics.SERVE_LATENCY_SECONDS.observe(time.perf_counter() - t0)
+            _metrics.SERVE_LATENCY_SECONDS.observe(dt, exemplar=trace_id)
+        if fl:
+            _flight.note("serve_request", dt)
         return outs
 
     # C-predict-API-shaped alias (MXPredForward parity for callers

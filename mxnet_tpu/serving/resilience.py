@@ -44,14 +44,18 @@ import threading
 import time
 from collections import deque
 from concurrent.futures import Future
+from contextlib import nullcontext as _nullcontext
 from typing import Dict, List, Optional, Tuple
 
 import numpy as _np
 
 from ..analysis import hot_path, sanitizer as _san
 from ..base import MXNetError, getenv
+from ..observability import flight as _flight
 from ..observability import metrics as _metrics
-from .batcher import BatcherClosedError, BatcherDeadError, stack_requests
+from .batcher import (BatcherClosedError, BatcherDeadError,
+                      group_trace_scope, record_group_queue_wait,
+                      stack_requests)
 
 log = logging.getLogger(__name__)
 
@@ -81,7 +85,7 @@ class DeadlineExceeded(MXNetError):
 
 class _Request:
     __slots__ = ("inputs", "rows", "future", "tenant", "tref",
-                 "priority", "deadline", "t0")
+                 "priority", "deadline", "t0", "trace_id")
 
     def __init__(self, inputs, tenant: str, priority: int,
                  deadline: Optional[float]):
@@ -96,6 +100,10 @@ class _Request:
         self.priority = int(priority)
         self.deadline = deadline  # absolute perf_counter time, or None
         self.t0 = time.perf_counter()
+        # flight-recorder id: one per request, end to end (admission ->
+        # queue-wait -> pad -> dispatch -> slice across threads)
+        self.trace_id = _flight.new_trace_id() if _flight.ENABLED \
+            else None
 
 
 class _Tenant:
@@ -258,7 +266,11 @@ class ResilientServer:
         deadline = None if deadline_ms is None \
             else now + float(deadline_ms) / 1e3
         req = _Request(host, tenant, priority, deadline)
-        with self._cv:
+        # the admission phase records for SHED requests too (the span
+        # closes on the Overloaded raise) — a timeline shows both what
+        # was admitted and what bounced, under the same trace id scheme
+        with _flight.phase_span("serve_admission", cat="serving",
+                                trace_id=req.trace_id), self._cv:
             if self._closed:
                 raise BatcherClosedError("ResilientServer is closed")
             if self._fatal is not None:
@@ -537,19 +549,25 @@ class ResilientServer:
             group = [r for r in group if r not in dead]
             if not group:
                 return
+        fl = _flight.ENABLED
+        if fl:
+            record_group_queue_wait(group, t0 * 1e6)
+        scope = group_trace_scope(group) if fl else _nullcontext()
         ok = True
         try:
-            stacked = stack_requests(self._pred.spec, group)
-            # independent tripwire reading for the chaos invariant
-            # (pinned at 0 by the tests): dispatch truly starts HERE —
-            # a fresh clock read, not the gate's t0, so a future
-            # reordering or weakening of the gate above still shows up
-            # as a nonzero expired-dispatch count
-            t_start = time.perf_counter()
-            for r in group:
-                if r.deadline is not None and t_start >= r.deadline:
-                    self._expired_dispatches += 1
-            outs = self._pred._predict_routed(stacked)
+            with scope:
+                with _flight.phase_span("serve_stack", cat="serving"):
+                    stacked = stack_requests(self._pred.spec, group)
+                # independent tripwire reading for the chaos invariant
+                # (pinned at 0 by the tests): dispatch truly starts HERE
+                # — a fresh clock read, not the gate's t0, so a future
+                # reordering or weakening of the gate above still shows
+                # up as a nonzero expired-dispatch count
+                t_start = time.perf_counter()
+                for r in group:
+                    if r.deadline is not None and t_start >= r.deadline:
+                        self._expired_dispatches += 1
+                outs = self._pred._predict_routed(stacked)
             lo = 0
             for r in group:
                 if not r.future.done():
@@ -561,7 +579,11 @@ class ResilientServer:
                 t.served += 1
                 self._publish_goodput(t)
                 if _metrics.ENABLED:
-                    _metrics.SERVE_LATENCY_SECONDS.observe(now - r.t0)
+                    _metrics.SERVE_LATENCY_SECONDS.observe(
+                        now - r.t0, exemplar=r.trace_id)
+                if fl:
+                    # slow-request watchdog: end-to-end latency vs EWMA
+                    _flight.note("serve_request", now - r.t0)
             if _metrics.ENABLED:
                 _metrics.SERVE_REQUESTS.inc(len(group))
                 _metrics.SERVE_COALESCED_ROWS.set(

@@ -15,6 +15,13 @@ if "host_platform_device_count" not in flags:
         flags + " --xla_force_host_platform_device_count=8"
 
 os.environ["JAX_PLATFORMS"] = "cpu"
+# flight-recorder anomaly auto-dumps default to cwd; in a noisy shared
+# container a slow test step WILL trip the watchdog, so route dumps to
+# scratch (tests that assert on dumps monkeypatch their own dir)
+if "MXNET_FLIGHT_DIR" not in os.environ:
+    import tempfile
+    os.environ["MXNET_FLIGHT_DIR"] = tempfile.mkdtemp(
+        prefix="mxt-test-flight-")
 import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from __graft_entry__ import _cpu_only_guard
@@ -49,6 +56,12 @@ def pytest_configure(config):
         "(mxnet_tpu.analysis; `make lint-graft` is the CLI twin).  "
         "Runs in tier-1 by default; skip on slow containers with "
         "`-m 'not analysis'`")
+    config.addinivalue_line(
+        "markers",
+        "flight: flight-recorder timeline tests (mxnet_tpu."
+        "observability.flight — ring recording, trace-id propagation, "
+        "Perfetto export, anomaly auto-dump).  Runs in tier-1 by "
+        "default; `pytest -m flight` selects just the recorder suite")
 
 
 @pytest.fixture(autouse=True)
