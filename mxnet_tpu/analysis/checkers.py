@@ -753,6 +753,161 @@ class MetricsHygieneChecker:
         return out
 
 
+class MemoryHygieneChecker:
+    """Device-array creation must stay attributable (ISSUE 9): a
+    ``jax.device_put`` whose result the HBM ledger can never see is a
+    buffer the OOM post-mortem reports as untagged — the exact
+    dark-bytes class the ledger exists to eliminate.
+
+    A ``device_put`` call site passes when any of:
+
+      * its result feeds an ``NDArray(...)`` construction in the same
+        expression — NDArray.__init__ ledger-registers the wrapper;
+      * it sits lexically inside a ``with memory_scope("tag")`` block
+        (any receiver: ``memory_scope`` / ``_mem.memory_scope``);
+      * its RESULT flows into a ledger call in the same function: the
+        name the device_put is assigned to is later an argument to
+        ``register``/``register_nd``/``register_host``/
+        ``note_compiled``/``._set_data``/``NDArray(...)`` — the
+        "ledger-registered helper" idiom (predictor ``_to_dev``).
+        Per-VALUE on purpose: a function that registers one buffer
+        does not whitelist its other device_puts (an unrelated
+        ``_set_data`` elsewhere in the function must not hide a
+        retained, never-registered copy);
+      * the file IS the ledger (``observability/``).
+
+    Transient device→device redistribution (mesh placement in
+    ``parallel/``, eager sp-op staging) carries justified inline
+    suppressions — same policy as every other rule.
+    """
+
+    name = "memory-hygiene"
+
+    _REGISTER_FNS = ("register", "register_nd", "register_host",
+                     "note_compiled", "_set_data")
+
+    @staticmethod
+    def _last_name(func) -> str:
+        """Terminal name of a call target, tolerant of subscripted
+        receivers (``self.arg_dict[k]._set_data`` -> ``_set_data``,
+        which ``_call_name`` gives up on)."""
+        if isinstance(func, ast.Attribute):
+            return func.attr
+        return _call_name(func).split(".")[-1]
+
+    @staticmethod
+    def _is_device_put(node: ast.Call) -> bool:
+        return MemoryHygieneChecker._last_name(node.func) == "device_put"
+
+    @classmethod
+    def _is_register_call(cls, func) -> bool:
+        last = cls._last_name(func)
+        if last not in cls._REGISTER_FNS:
+            return False
+        if last != "register":
+            return True
+        # a bare `.register` is everywhere (atexit, base.Registry, the
+        # ops registry) — only a ledger receiver whitelists device_puts
+        if isinstance(func, ast.Attribute):
+            recv = _call_name(func.value).split(".")[-1]
+            return recv in ("memory", "_memory", "_mem")
+        return False
+
+    @staticmethod
+    def _in_memory_scope(node, parents) -> bool:
+        cur = parents.get(node)
+        while cur is not None:
+            if isinstance(cur, ast.With):
+                for item in cur.items:
+                    ce = item.context_expr
+                    if isinstance(ce, ast.Call) and _call_name(
+                            ce.func).split(".")[-1] == "memory_scope":
+                        return True
+            cur = parents.get(cur)
+        return False
+
+    @classmethod
+    def _feeds_registered_call(cls, node, parents) -> bool:
+        """Nested (transitively) inside an NDArray(...) construction or
+        a ledger-register/_set_data call's argument list."""
+        cur = parents.get(node)
+        while cur is not None:
+            if isinstance(cur, ast.Call):
+                if cls._last_name(cur.func).endswith("NDArray") or \
+                        cls._is_register_call(cur.func):
+                    return True
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return False
+            cur = parents.get(cur)
+        return False
+
+    @classmethod
+    def _result_reaches_register(cls, node, parents) -> bool:
+        """Per-VALUE helper idiom: the name(s) the device_put's
+        enclosing assignment binds are later an argument to a ledger
+        register / ``_set_data`` / ``NDArray(...)`` call in the same
+        function.  A value that escapes through a lambda or is never
+        name-bound is opaque to this — suppress with justification."""
+        stmt, fn, p = None, None, parents.get(node)
+        while p is not None:
+            if isinstance(p, ast.Lambda):
+                return False
+            if isinstance(p, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                fn = p
+                break
+            if stmt is None and isinstance(
+                    p, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+                stmt = p
+            p = parents.get(p)
+        if fn is None or stmt is None:
+            return False
+        targets = stmt.targets if isinstance(stmt, ast.Assign) \
+            else [stmt.target]
+        names = {sub.id for t in targets for sub in ast.walk(t)
+                 if isinstance(sub, ast.Name)}
+        if not names:
+            return False
+        for sub in ast.walk(fn):
+            if not isinstance(sub, ast.Call):
+                continue
+            if not (cls._is_register_call(sub.func)
+                    or cls._last_name(sub.func).endswith("NDArray")):
+                continue
+            for arg in list(sub.args) + [kw.value for kw in sub.keywords]:
+                if any(isinstance(n, ast.Name) and n.id in names
+                       for n in ast.walk(arg)):
+                    return True
+        return False
+
+    def check_file(self, ctx: FileCtx) -> List[Finding]:
+        rel = ctx.relpath.replace("\\", "/")
+        if "/observability/" in rel or rel.startswith("observability/"):
+            return []
+        out: List[Finding] = []
+        parents: Dict[ast.AST, ast.AST] = {}
+        for parent in ast.walk(ctx.tree):
+            for child in ast.iter_child_nodes(parent):
+                parents[child] = parent
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call) or not self._is_device_put(node):
+                continue
+            if self._feeds_registered_call(node, parents):
+                continue
+            if self._in_memory_scope(node, parents):
+                continue
+            if self._result_reaches_register(node, parents):
+                continue
+            out.append(ctx.finding(
+                self.name, node,
+                "device_put outside a memory_scope / ledger-registered "
+                "helper — the resulting buffer is invisible to the HBM "
+                "ledger (untagged in memory.report() and the OOM "
+                "post-mortem).  Wrap the creation in `with "
+                "memory_scope(\"<tag>\")`, register the result "
+                "(memory.register), or route it through NDArray"))
+        return out
+
+
 # ---------------------------------------------------------------------------
 def registry() -> Dict[str, type]:
     return {
@@ -761,6 +916,7 @@ def registry() -> Dict[str, type]:
         AtomicWriteChecker.name: AtomicWriteChecker,
         EnvVarSyncChecker.name: EnvVarSyncChecker,
         MetricsHygieneChecker.name: MetricsHygieneChecker,
+        MemoryHygieneChecker.name: MemoryHygieneChecker,
     }
 
 

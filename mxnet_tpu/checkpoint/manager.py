@@ -35,6 +35,7 @@ from ..analysis import sanitizer as _san
 from ..base import MXNetError, getenv
 from ..faultinject import fire as _fi_fire
 from ..observability import flight as _flight
+from ..observability import memory as _memory
 from ..observability import metrics as _metrics
 from . import layout as _layout
 from .layout import CheckpointInvalidError
@@ -184,8 +185,18 @@ class CheckpointManager:
         # answers "what stole time from MY step", CHECKPOINT_SAVE_SECONDS
         # answers "how long did the write take"
         with _flight.phase_span("checkpoint_block", cat="checkpoint",
-                                step=step):
+                                step=step, mem=True):
             snap = _layout.snapshot_state(state)
+            if _memory.ENABLED:
+                # host-side ledger twin: each queued async save pins a
+                # full host-RAM snapshot until the writer commits it —
+                # exactly the host hog worth attributing.  Registered
+                # per payload array; the weakrefs die when the job is
+                # dropped after commit, so a drained queue reads zero.
+                for _name, (kind, payload) in snap.items():
+                    if kind == "array":
+                        _memory.register_host(payload,
+                                              tag="checkpoint_host")
             job = (step, snap, dict(meta or {}), dict(signatures or {}),
                    t0)
             if self._async and not block:

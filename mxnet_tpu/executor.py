@@ -27,7 +27,9 @@ import jax.numpy as jnp
 
 from .base import MXNetError, getenv, maybe_enable_compile_cache
 from .context import Context
+from .faultinject import fire as _fi_fire
 from .ndarray import NDArray
+from .observability import memory as _memory
 from .observability import metrics as _metrics
 from .observability.tracing import trace_span
 from .symbol.graph import GraphPlan
@@ -233,10 +235,16 @@ class Executor:
             axis = self._mesh.axis_names[0]
             shard = NamedSharding(self._mesh, P(axis))
             repl = NamedSharding(self._mesh, P())
-            arg_vals = {k: jax.device_put(v, shard if k in self._data_shard_args
-                                          and v.ndim >= 1 else repl)
+            # these sharded/replicated copies outlive the call — they sit
+            # in self._snapshot until the next forward (a model-plus-aux
+            # block of HBM), so the ledger must see them
+            arg_vals = {k: _memory.register(
+                jax.device_put(v, shard if k in self._data_shard_args
+                               and v.ndim >= 1 else repl), tag="executor")
                         for k, v in arg_vals.items()}
-            aux_vals = {k: jax.device_put(v, repl) for k, v in aux_vals.items()}
+            aux_vals = {k: _memory.register(jax.device_put(v, repl),
+                                            tag="executor")
+                        for k, v in aux_vals.items()}
         return arg_vals, aux_vals, _random.next_key()
 
     def forward(self, is_train: bool = False, **kwargs) -> List[NDArray]:
@@ -257,7 +265,8 @@ class Executor:
             ograds = [None] * len(self._plan.out_refs)
             if _metrics.ENABLED:
                 _metrics.XLA_LAUNCHES.inc(kind="fwd_bwd")
-            with trace_span("forward_backward", cat="executor"):
+            with trace_span("forward_backward", cat="executor"), \
+                    _memory.oom_guard("executor.forward_backward"):
                 outs, new_aux, grads, rsp_grads = self._fwd_bwd(
                     arg_vals, aux_vals, key, ograds)
             self._set_results(outs, new_aux)
@@ -265,7 +274,8 @@ class Executor:
             return self._outputs_cache
         if _metrics.ENABLED:
             _metrics.XLA_LAUNCHES.inc(kind="fwd")
-        with trace_span("forward", cat="executor"):
+        with trace_span("forward", cat="executor"), \
+                _memory.oom_guard("executor.forward"):
             outs, new_aux = self._fwd(arg_vals, aux_vals, key, is_train)
         self._set_results(outs, new_aux)
         return self._outputs_cache
@@ -308,7 +318,12 @@ class Executor:
                       for g in out_grads]
         if _metrics.ENABLED:
             _metrics.XLA_LAUNCHES.inc(kind="fwd_bwd")
-        with trace_span("forward_backward", cat="executor"):
+        # OOM post-mortem chokepoint: a RESOURCE_EXHAUSTED out of the
+        # fused training program dumps ledger+ring and re-raises typed;
+        # the memory.oom chaos site injects a synthetic one here
+        with trace_span("forward_backward", cat="executor"), \
+                _memory.oom_guard("executor.forward_backward"):
+            _fi_fire("memory.oom", at="executor")
             outs, new_aux, grads, rsp_grads = self._fwd_bwd(
                 arg_vals, aux_vals, key, ograds)
         if set_results:
@@ -363,23 +378,16 @@ class Executor:
         else:
             lowered = self._fwd.lower(arg_vals, aux_vals, key, train)
         stats = lowered.compile().memory_analysis()
-        if stats is None:  # backend doesn't report (older PJRT)
-            return {}
-        # jax < 0.5 CompiledMemoryStats lacks peak_memory_in_bytes;
-        # approximate with the live-buffer sum so the O(nnz)-peak
-        # comparisons stay meaningful
-        peak = getattr(stats, "peak_memory_in_bytes", None)
-        if peak is None:
-            peak = (stats.temp_size_in_bytes + stats.argument_size_in_bytes
-                    + stats.output_size_in_bytes + stats.alias_size_in_bytes)
-        return {
-            "temp_bytes": stats.temp_size_in_bytes,
-            "argument_bytes": stats.argument_size_in_bytes,
-            "output_bytes": stats.output_size_in_bytes,
-            "alias_bytes": stats.alias_size_in_bytes,
-            "peak_bytes": peak,
-            "generated_code_bytes": stats.generated_code_size_in_bytes,
-        }
+        # one structured shape for EVERY jax version (memory.
+        # compiled_stats_dict): same keys whether or not the stats
+        # carry peak_memory_in_bytes (jax < 0.5 estimates it as the
+        # live-buffer sum and flags peak_estimated); {} only when the
+        # backend reports nothing (older PJRT).  The result is filed
+        # under the HBM ledger's "executor" tag so report()["compiled"]
+        # shows the training program next to the serving buckets.
+        out = _memory.compiled_stats_dict(stats)
+        _memory.note_compiled("executor", out)
+        return out
 
     @property
     def outputs(self) -> List[NDArray]:
@@ -388,13 +396,18 @@ class Executor:
         return self._outputs_cache
 
     def _set_results(self, outs, new_aux):
-        self._outputs_cache = [NDArray(o, self._ctx) for o in outs]
-        stypes = self._plan.out_stypes()
-        if any(s != "default" for s in stypes):
-            from .ndarray.sparse import cast_storage as _cast
-            self._outputs_cache = [
-                _cast(o, st) if st != "default" else o
-                for o, st in zip(self._outputs_cache, stypes)]
+        # HBM ledger: the executor HOLDS its outputs until the next
+        # forward — attributable memory, not transient (sparse re-wraps
+        # stay inside the scope: cast_storage builds NEW wrappers that
+        # would otherwise register untagged while the tagged ones die)
+        with _memory.memory_scope("output"):
+            self._outputs_cache = [NDArray(o, self._ctx) for o in outs]
+            stypes = self._plan.out_stypes()
+            if any(s != "default" for s in stypes):
+                from .ndarray.sparse import cast_storage as _cast
+                self._outputs_cache = [
+                    _cast(o, st) if st != "default" else o
+                    for o, st in zip(self._outputs_cache, stypes)]
         for k, v in new_aux.items():
             if k in self.aux_dict:
                 self.aux_dict[k]._set_data(v)
@@ -433,7 +446,9 @@ class Executor:
             ins = [resolve(r) for r in step.in_refs]
             grp = step.node.attrs.get("ctx_group")
             if grp and grp in devmap:
-                ins = [jax.device_put(x, devmap[grp]) for x in ins]
+                # eager D2D hop of values already attributed at their
+                # creation (group2ctx placement, not a new allocation)
+                ins = [jax.device_put(x, devmap[grp]) for x in ins]  # graft-lint: disable=memory-hygiene
             p = dict(step.params)
             if step.op.takes_is_train:
                 p["__is_train__"] = is_train

@@ -30,6 +30,14 @@ docs/serving_resilience.md):
                           default ``InjectedFault`` to exhaust it) plus a
                           post-write ``corrupt`` hook that flips bytes in a
                           committed shard (restore must skip it via CRC)
+  ``memory.oom``          the dispatch chokepoints guarded by
+                          ``memory.oom_guard`` (executor fused step,
+                          fused optimizer update, serving dispatch) — a
+                          ``raise`` rule is a synthetic RESOURCE_EXHAUSTED
+                          (``is_oom`` matches the site name), so the OOM
+                          post-mortem (catch → ledger+ring dump → typed
+                          ``DeviceMemoryError``) is chaos-testable with no
+                          real HBM pressure
   ==================================================================
 
 Configuration is API- or env-driven::
@@ -68,7 +76,7 @@ ENV_VAR = "MXNET_FAULT_PLAN"
 #: the named sites the runtime has wired (fire() accepts any name — new
 #: sites need no registration — but these are the documented ones)
 SITES = ("serving.dispatch", "serving.batcher", "serving.hot_reload",
-         "checkpoint.io")
+         "checkpoint.io", "memory.oom")
 
 _MODES = ("raise", "delay", "corrupt")
 

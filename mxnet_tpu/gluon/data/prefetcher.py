@@ -21,6 +21,7 @@ import threading
 import time as _time
 
 from ...observability import flight as _flight
+from ...observability import memory as _memory
 from ...observability import metrics as _metrics
 
 # end-of-stream sentinel (not None: sources may legitimately yield None)
@@ -83,8 +84,11 @@ class AsyncPrefetcher:
                 if self._transform is not None:
                     # device placement (h2d) happens HERE on the worker
                     # thread — the flight span attributes the transfer
-                    # to the producer, not the consumer's wait
-                    with _flight.phase_span("prefetch_h2d", cat="io"):
+                    # to the producer, not the consumer's wait (and the
+                    # ledger attributes the staged batch to "prefetch")
+                    with _flight.phase_span("prefetch_h2d", cat="io",
+                                            mem=True), \
+                            _memory.memory_scope("prefetch"):
                         item = self._transform(item)
             except StopIteration:
                 self._queue.put(_END)

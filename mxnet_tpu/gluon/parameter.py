@@ -15,6 +15,8 @@ import numpy as _np
 
 from ..base import MXNetError, np_dtype
 from ..context import Context, cpu, current_context
+from ..observability import memory as _memory
+from ..observability.memory import memory_scope as _memory_scope
 from .. import ndarray as nd
 from ..ndarray import NDArray
 from .. import initializer
@@ -123,17 +125,26 @@ class Parameter:
                 f"Cannot initialize Parameter {self.name} because it has "
                 "invalid shape: {self.shape}.")
         if data is None:
-            data = nd.zeros(self.shape, dtype=self.dtype, ctx=ctx[0])
-            initializer.create(default_init)(
-                InitDesc(self.name, {"__init__": init}), data)
+            # HBM ledger: the parameter buffer is born here — tag it
+            with _memory_scope("param"):
+                data = nd.zeros(self.shape, dtype=self.dtype, ctx=ctx[0])
+                initializer.create(default_init)(
+                    InitDesc(self.name, {"__init__": init}), data)
         self._init_impl(data, ctx)
 
     def _init_impl(self, data, ctx_list):
         self._ctx = list(ctx_list)
-        if not isinstance(data, NDArray):
-            data = nd.array(data, dtype=self.dtype)
-        self._data = data.as_in_context(self._ctx[0]) if \
-            data.context != self._ctx[0] else data
+        with _memory_scope("param"):
+            if not isinstance(data, NDArray):
+                data = nd.array(data, dtype=self.dtype)
+            self._data = data.as_in_context(self._ctx[0]) if \
+                data.context != self._ctx[0] else data
+            if _memory.ENABLED:
+                # load-path wrappers (ParameterDict.load / _load_init)
+                # arrive already registered under their creation tag
+                # (nd.load -> _untagged); re-registering retags the
+                # same live wrapper to param instead of double counting
+                _memory.register_nd(self._data)
         self._init_grad()
 
     def _init_grad(self):
@@ -145,12 +156,15 @@ class Parameter:
             # directly — O(vocab) dense grads are never allocated
             # (parity: rsp embedding grads, optimizer_op.cc rsp kernels)
             from ..ndarray import sparse as _sp
-            self._grad = _sp.zeros_sparse("row_sparse", self._data.shape,
-                                          ctx=self._data.context,
-                                          dtype=self._data.dtype)
+            with _memory_scope("grad"):
+                self._grad = _sp.zeros_sparse(
+                    "row_sparse", self._data.shape,
+                    ctx=self._data.context, dtype=self._data.dtype)
         else:
-            self._grad = nd.zeros(self._data.shape, dtype=self._data.dtype,
-                                  ctx=self._data.context)
+            with _memory_scope("grad"):
+                self._grad = nd.zeros(self._data.shape,
+                                      dtype=self._data.dtype,
+                                      ctx=self._data.context)
         from .. import autograd
         autograd.mark_variables([self._data], [self._grad], self.grad_req)
 

@@ -31,6 +31,7 @@ from ..analysis import hot_path
 from ..base import MXNetError, getenv
 from ..ndarray import NDArray
 from ..observability import flight as _flight
+from ..observability import memory as _memory
 from ..observability import metrics as _metrics
 from ..observability.tracing import trace_span
 from .. import optimizer as opt
@@ -173,7 +174,8 @@ class Trainer:
         d0 = _metrics.step_dispatches() if on else 0.0
         with trace_span("trainer_step", cat="optimizer"), \
                 _flight.phase_span("trainer_step", cat="step",
-                                   step=self._step_id, watch=True):
+                                   step=self._step_id, watch=True,
+                                   mem=True):
             self._step(batch_size, ignore_stale_grad)
         self._step_id += 1
         if on:
@@ -297,7 +299,8 @@ class Trainer:
         gc = getattr(self._kv, "_gc", None)
         with trace_span("bucketed_allreduce", cat="kvstore"), \
                 _flight.phase_span("allreduce", cat="kvstore",
-                                   step=self._step_id):
+                                   step=self._step_id, mem=True), \
+                _memory.memory_scope("grad_bucket"):
             flats = bk.flatten([g.handle for g in grads])
             ctx = grads[0].context
             buckets = [NDArray(f, ctx) for f in flats]
@@ -309,6 +312,12 @@ class Trainer:
                     reduced, self._residuals = self._kv.allreduce(
                         buckets, compression=gc,
                         residuals=self._residuals)
+                if _memory.ENABLED:
+                    # the allreduce returns FRESH residual arrays each
+                    # step (functional update) — re-register so the
+                    # ledger keeps attributing the live ones
+                    for r in self._residuals:
+                        _memory.register(r, tag="compression_residual")
             else:
                 reduced = self._kv.allreduce(buckets)
         return ([r.handle for r in reduced],
@@ -339,8 +348,12 @@ class Trainer:
                     "saved from the same model and bucket layout "
                     "(MXNET_BUCKET_SIZE_MB included).")
             self._pending_residuals = None
-            return [jnp.asarray(a) for a in arrays]
-        return [jnp.zeros(n, dtype=jnp.float32) for n in bk.sizes]
+            return [_memory.register(jnp.asarray(a),
+                                     tag="compression_residual")
+                    for a in arrays]
+        return [_memory.register(jnp.zeros(n, dtype=jnp.float32),
+                                 tag="compression_residual")
+                for n in bk.sizes]
 
     def _update(self, ignore_stale_grad=False):
         from ..optimizer import FusedUpdater
@@ -399,14 +412,15 @@ class Trainer:
                 if live:
                     with _flight.phase_span("fused_update",
                                             cat="optimizer",
-                                            step=self._step_id):
+                                            step=self._step_id,
+                                            mem=True):
                         upd.update_all(
                             [i for i, _ in live], flats,
                             [p.list_data()[0] for _, p in live],
                             grad_views=[views[pos[i]] for i, _ in live])
             else:
                 with _flight.phase_span("fused_update", cat="optimizer",
-                                        step=self._step_id):
+                                        step=self._step_id, mem=True):
                     upd.update_all([i for i, _ in live],
                                    [p.list_grad()[0] for _, p in live],
                                    [p.list_data()[0] for _, p in live])

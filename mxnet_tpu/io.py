@@ -19,6 +19,7 @@ import numpy as _np
 from .base import MXNetError, getenv, np_dtype
 from . import ndarray as nd
 from .ndarray import NDArray
+from .observability import memory as _memory
 from .observability import metrics as _metrics
 
 DataDesc = namedtuple("DataDesc", ["name", "shape", "dtype", "layout"])
@@ -152,21 +153,24 @@ class NDArrayIter(DataIter):
             pad = self.batch_size - self.num_data + self.cursor
             sel = _np.concatenate([self.idx[self.cursor:], self.idx[:pad]])
         out = []
-        for _, src in data_source:
-            if isinstance(src, NDArray):
-                # device-resident source: slice/gather ON DEVICE — no
-                # host round trip per batch (the TPU-native fast path the
-                # bench and user pipelines rely on)
-                if _metrics.ENABLED:
-                    _metrics.XLA_LAUNCHES.inc(kind="data")
-                if contiguous and not self.shuffle:
-                    out.append(src[self.cursor:self.cursor + self.batch_size])
+        # HBM ledger: per-batch staging is runtime-owned "data" memory
+        with _memory.memory_scope("data"):
+            for _, src in data_source:
+                if isinstance(src, NDArray):
+                    # device-resident source: slice/gather ON DEVICE — no
+                    # host round trip per batch (the TPU-native fast path
+                    # the bench and user pipelines rely on)
+                    if _metrics.ENABLED:
+                        _metrics.XLA_LAUNCHES.inc(kind="data")
+                    if contiguous and not self.shuffle:
+                        out.append(
+                            src[self.cursor:self.cursor + self.batch_size])
+                    else:
+                        from .ndarray.register import _gen
+                        out.append(_gen.take(src, nd.array(
+                            sel.astype(_np.int32))))
                 else:
-                    from .ndarray.register import _gen
-                    out.append(_gen.take(src, nd.array(
-                        sel.astype(_np.int32))))
-            else:
-                out.append(nd.array(src[sel], dtype=src.dtype))
+                    out.append(nd.array(src[sel], dtype=src.dtype))
         return out
 
     def getdata(self):

@@ -45,6 +45,7 @@ from .analysis import hot_path
 from .base import MXNetError, atomic_write, getenv
 from . import ndarray as nd
 from .ndarray import NDArray
+from .observability import memory as _memory
 from .observability import metrics as _metrics
 from .observability.tracing import trace_span
 from . import optimizer as opt
@@ -354,8 +355,13 @@ class KVStore:
     def init(self, key, value) -> None:
         keys, _ = _key_list(key)
         vals = _val_list(value)
-        for k, vlist in zip(keys, vals):
-            self._store[k] = vlist[0].copy()
+        # HBM ledger: the backing store pins one device copy per key —
+        # a full model's worth of HBM that the bucketed fast path never
+        # touches; attributing it is exactly what makes that cost
+        # visible in memory.report()
+        with _memory.memory_scope("kvstore"):
+            for k, vlist in zip(keys, vals):
+                self._store[k] = vlist[0].copy()
 
     @staticmethod
     def _merge_local(vlist):

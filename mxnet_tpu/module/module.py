@@ -21,6 +21,7 @@ from ..context import Context, cpu
 from ..initializer import InitDesc, Uniform
 from .. import ndarray as nd
 from ..io import DataDesc
+from ..observability import memory as _memory
 from ..observability import metrics as _metrics
 from ..observability.tracing import trace_span
 from .. import optimizer as opt
@@ -143,12 +144,16 @@ class Module(BaseModule):
             return
         assert self.binded, "call bind before initializing the parameters"
         if self._arg_params is None:
-            self._arg_params = {name: nd.zeros(arr.shape, dtype=arr.dtype)
-                                for name, arr in self._exec.arg_dict.items()
-                                if name in self._param_names}
+            with _memory.memory_scope("param"):
+                self._arg_params = {
+                    name: nd.zeros(arr.shape, dtype=arr.dtype)
+                    for name, arr in self._exec.arg_dict.items()
+                    if name in self._param_names}
         if self._aux_params is None:
-            self._aux_params = {name: nd.zeros(arr.shape, dtype=arr.dtype)
-                                for name, arr in self._exec.aux_dict.items()}
+            with _memory.memory_scope("param"):
+                self._aux_params = {
+                    name: nd.zeros(arr.shape, dtype=arr.dtype)
+                    for name, arr in self._exec.aux_dict.items()}
         attrs = self._symbol.attr_dict()
 
         def _impl(name, arr, cache):
@@ -257,33 +262,42 @@ class Module(BaseModule):
         args, grads, reqs = {}, {}, {}
         shared_args = shared_module._exec.arg_dict if shared_module else {}
         shared_aux = shared_module._exec.aux_dict if shared_module else {}
-        for name, shp, dt in zip(arg_names, arg_shapes, arg_types):
-            if name in shared_args and tuple(shared_args[name].shape) == tuple(shp):
-                args[name] = shared_args[name]
-            else:
-                args[name] = nd.zeros(shp, ctx=ctx0, dtype=dt)
-            is_input = name in self._data_names or name in self._label_names \
-                or name in self._state_names
-            if not for_training:
-                reqs[name] = "null"
-            elif is_input:
-                if name in self._data_names and inputs_need_grad:
-                    reqs[name] = "write"
+        # HBM ledger: bind-time buffers are the symbolic path's params/
+        # grads — tag them like the gluon owners so Module.fit training
+        # attributes the same way a gluon Trainer run does (the inner
+        # "grad" scope overrides for gradient buffers; innermost wins)
+        with _memory.memory_scope("param"):
+            for name, shp, dt in zip(arg_names, arg_shapes, arg_types):
+                if name in shared_args and \
+                        tuple(shared_args[name].shape) == tuple(shp):
+                    args[name] = shared_args[name]
                 else:
+                    args[name] = nd.zeros(shp, ctx=ctx0, dtype=dt)
+                is_input = name in self._data_names \
+                    or name in self._label_names \
+                    or name in self._state_names
+                if not for_training:
                     reqs[name] = "null"
-            elif name in self._fixed_param_names:
-                reqs[name] = "null"
-            else:
-                reqs[name] = grad_req if isinstance(grad_req, str) else \
-                    grad_req.get(name, "write")
-            if reqs[name] != "null":
-                grads[name] = nd.zeros(shp, ctx=ctx0, dtype=dt)
-        aux = {}
-        for name, shp, dt in zip(self._aux_names, aux_shapes, aux_types):
-            if name in shared_aux and tuple(shared_aux[name].shape) == tuple(shp):
-                aux[name] = shared_aux[name]
-            else:
-                aux[name] = nd.zeros(shp, ctx=ctx0, dtype=dt)
+                elif is_input:
+                    if name in self._data_names and inputs_need_grad:
+                        reqs[name] = "write"
+                    else:
+                        reqs[name] = "null"
+                elif name in self._fixed_param_names:
+                    reqs[name] = "null"
+                else:
+                    reqs[name] = grad_req if isinstance(grad_req, str) else \
+                        grad_req.get(name, "write")
+                if reqs[name] != "null":
+                    with _memory.memory_scope("grad"):
+                        grads[name] = nd.zeros(shp, ctx=ctx0, dtype=dt)
+            aux = {}
+            for name, shp, dt in zip(self._aux_names, aux_shapes, aux_types):
+                if name in shared_aux and \
+                        tuple(shared_aux[name].shape) == tuple(shp):
+                    aux[name] = shared_aux[name]
+                else:
+                    aux[name] = nd.zeros(shp, ctx=ctx0, dtype=dt)
 
         if mesh is not None:
             # keep params/grads/aux replicated over the mesh so optimizer

@@ -25,6 +25,7 @@ import numpy as _np
 from ..analysis import sanitizer as _sanitizer
 from ..base import MXNetError, np_dtype
 from ..context import Context, current_context
+from ..observability import memory as _memory
 from .. import engine as _engine
 
 
@@ -48,6 +49,11 @@ class NDArray:
         # grad buffer; Trainer.step clears it after consuming the gradient
         # (parity: NDArray::fresh_out_grad, the stale-grad guard)
         self._fresh_grad = False
+        # HBM ledger: track the wrapper (it survives _set_data swaps)
+        # under the current memory_scope tag — one boolean test when
+        # MXNET_MEMORY_LEDGER=0 (docs/memory.md)
+        if _memory.ENABLED:
+            _memory.register_nd(self)
         _engine.maybe_sync([data])
 
     # -- core accessors -----------------------------------------------------

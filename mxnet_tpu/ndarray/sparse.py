@@ -337,6 +337,10 @@ class CSRNDArray(BaseSparseNDArray):
             cols[:nnz] = hcols
         dev = other._data.devices().pop() if hasattr(other._data, "devices") \
             else None
+        # eager sp-op staging: the scatter inputs are transient (dead
+        # once `dense` exists); the retained output is ledger-tracked
+        # through other._set_data
+        # graft-lint: disable=memory-hygiene
         put = (lambda a: _jax.device_put(a, dev)) if dev is not None \
             else jnp.asarray
         dense = _csr_scatter_dense(put(vals), put(rows), put(cols),

@@ -28,6 +28,14 @@ package makes that visibility a product API:
     profiler `_events`, and a slow-step/slow-request watchdog
     auto-dumps the ring on anomaly and on SIGUSR2
     (`MXNET_FLIGHT=0` disables; see docs/observability.md).
+  - `mxnet_tpu.observability.memory` — the HBM ledger: weakref-tracked
+    device/host byte attribution by `memory_scope` tag
+    (`memory.report()`, `snapshot()["memory"]`), per-phase net-delta
+    memory records in the flight ring, an `MXNET_HBM_BUDGET_MB` soft
+    budget, and an OOM post-mortem (`oom_guard` catches
+    RESOURCE_EXHAUSTED at the dispatch chokepoints, dumps ledger +
+    ring, re-raises typed; `MXNET_MEMORY_LEDGER=0` disables; see
+    docs/memory.md).
 
 Overhead discipline: every hot-path hook is guarded by the module-level
 `metrics.ENABLED` flag (env `MXNET_METRICS_ENABLED`, default on; set 0
@@ -40,18 +48,21 @@ from . import metrics
 from . import tracing
 from . import flight
 from . import timeline
+from . import memory
 from .metrics import (Counter, Gauge, Histogram, MetricsRegistry, REGISTRY,
                       enabled, enable, disable, dispatch_counts,
                       step_dispatches, snapshot, render_prometheus,
                       render_json, hbm_stats)
 from .tracing import trace_span, step_span, annotate
 from .flight import phase_span, trace_scope, new_trace_id
+from .memory import memory_scope, oom_guard, DeviceMemoryError, HBMBudgetError
 
 __all__ = [
-    "metrics", "tracing", "flight", "timeline", "Counter", "Gauge",
-    "Histogram", "MetricsRegistry", "REGISTRY", "enabled", "enable",
-    "disable", "dispatch_counts", "step_dispatches", "snapshot",
+    "metrics", "tracing", "flight", "timeline", "memory", "Counter",
+    "Gauge", "Histogram", "MetricsRegistry", "REGISTRY", "enabled",
+    "enable", "disable", "dispatch_counts", "step_dispatches", "snapshot",
     "render_prometheus", "render_json", "hbm_stats",
     "trace_span", "step_span", "annotate",
     "phase_span", "trace_scope", "new_trace_id",
+    "memory_scope", "oom_guard", "DeviceMemoryError", "HBMBudgetError",
 ]

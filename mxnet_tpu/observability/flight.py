@@ -234,14 +234,33 @@ def record(name: str, cat: str, t0_us: float, t1_us: float,
         note(name, (t1_us - t0_us) / 1e6)
 
 
+def _mem_live():
+    """Tracked device bytes from the HBM ledger, or None when the
+    ledger is off (lazy import: memory ↔ flight is a benign cycle
+    broken by function-level imports on both sides)."""
+    from . import memory as _mem
+    return _mem.tracked_bytes() if _mem.ENABLED else None
+
+
 @contextlib.contextmanager
 def phase_span(name: str, cat: str = "phase", step: Optional[int] = None,
                trace_id: Optional[str] = None,
-               labels: Optional[dict] = None, watch: bool = False):
+               labels: Optional[dict] = None, watch: bool = False,
+               mem: bool = False):
     """The flight-recorder primitive: time the body and ring-record it.
 
     ``MXNET_FLIGHT=0``: ONE boolean test, nothing else.  ``watch=True``
     additionally feeds the slow-phase watchdog (k×EWMA anomaly dump).
+    ``mem=True`` samples the HBM ledger's tracked device bytes at entry
+    and exit (two O(1) counter reads; skipped when
+    ``MXNET_MEMORY_LEDGER=0``) and labels the record with
+    ``mem_delta_bytes``/``mem_live_bytes`` — the per-phase memory
+    timeline: ``dump()`` renders these as a Perfetto counter track, so
+    the timeline shows WHICH phase grew HBM.  The sampled counter is
+    PROCESS-global: a concurrent thread allocating inside this span's
+    window (e.g. the prefetcher staging the next batch during a
+    trainer step) lands in this span's delta too — read overlapping
+    spans' deltas together, per-tag truth lives in ``memory.report()``.
     Phase ``name``s must come from a bounded literal set — the
     metrics-hygiene graft-lint rule rejects dynamically built names
     (every distinct name is a forever-entry in ``summary()``).
@@ -250,9 +269,16 @@ def phase_span(name: str, cat: str = "phase", step: Optional[int] = None,
         yield
         return
     t0 = _now_us()
+    m0 = _mem_live() if mem else None
     try:
         yield
     finally:
+        if m0 is not None:
+            m1 = _mem_live()
+            if m1 is not None:
+                labels = dict(labels) if labels else {}
+                labels["mem_delta_bytes"] = int(m1 - m0)
+                labels["mem_live_bytes"] = int(m1)
         record(name, cat, t0, _now_us(), step=step, trace_id=trace_id,
                labels=labels, watch=watch)
 
@@ -366,7 +392,7 @@ def dump(path: Optional[str] = None, reason: str = "manual",
     _last_dump_path = path
     from . import metrics as _metrics
     if _metrics.ENABLED:
-        # reason is one of {"manual", "anomaly", "signal"} — bounded
+        # reason is one of {"manual", "anomaly", "signal", "oom"} — bounded
         _metrics.FLIGHT_DUMPS.inc(reason=reason)
     return path
 

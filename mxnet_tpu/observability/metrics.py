@@ -151,7 +151,12 @@ class Gauge(Metric):
 
     def set(self, value: float, **labels) -> None:
         if labels:
-            self._children[_label_key(labels)] = float(value)
+            k = _label_key(labels)
+            # same lock as replace_children(): a labeled set racing the
+            # full-child-set swap must not land in the orphaned old dict
+            # and vanish from every future export
+            with _MUT_LOCK:
+                self._children[k] = float(value)
         else:
             self._value = float(value)
 
@@ -179,6 +184,19 @@ class Gauge(Metric):
         cardinality bounded when a tenant is evicted)."""
         with _MUT_LOCK:
             self._children.pop(_label_key(labels), None)
+
+    def replace_children(self, items) -> None:
+        """Atomically swap the FULL labeled-child set from an iterable
+        of ``(labels_dict, value)`` — one reference assignment, so an
+        export racing the rebuild sees either the old or the new
+        complete set, never a half-built one (the export-time pull
+        refresh idiom, e.g. the memory ledger's per-tag gauge)."""
+        children = {_label_key(labels): float(v) for labels, v in items}
+        with _MUT_LOCK:
+            # same lock discipline as inc/dec/remove — a concurrent
+            # labeled mutator must not land its write in the orphaned
+            # old dict and vanish from every future export
+            self._children = children
 
     def reset(self) -> None:
         self._value = 0.0
@@ -534,10 +552,27 @@ FLIGHT_DUMPS = Counter(
     "mxnet_flight_dumps_total",
     "Flight-recorder timeline dumps by reason (manual = flight.dump() "
     "call, anomaly = slow-phase watchdog trip [k x EWMA, "
-    "MXNET_FLIGHT_SLOW_FACTOR], signal = SIGUSR2).  A climbing anomaly "
+    "MXNET_FLIGHT_SLOW_FACTOR], signal = SIGUSR2, oom = device "
+    "RESOURCE_EXHAUSTED post-mortem via memory.oom_guard).  A climbing "
+    "anomaly "
     "count is the page-the-oncall signal that steps/requests keep "
     "blowing their own baseline — each dump under MXNET_FLIGHT_DIR "
     "holds the timeline of the moments before it")
+MEMORY_LEDGER_BYTES = Gauge(
+    "mxnet_memory_ledger_bytes",
+    "Tracked live bytes by ledger tag and space (mxnet_tpu."
+    "observability.memory; bounded tag set — param/grad/output/executor/"
+    "optimizer_state/grad_bucket/compression_residual/serve_weights/"
+    "kvstore/prefetch/data/checkpoint_host, "
+    "space=device|host [host = e.g. checkpoint snapshot twins], and "
+    "_untagged for the unattributed remainder).  Refreshed at export "
+    "time from the weakref ledger, never on the hot path")
+SERVE_BUCKET_HBM_BYTES = Gauge(
+    "mxnet_serve_bucket_hbm_bytes",
+    "Compiled peak HBM bytes per serving bucket (CompiledMemoryStats "
+    "of the AOT executable, set once at precompile; labels are the "
+    "bounded bucket-lattice set).  The multi-model HBM budgeter's "
+    "per-bucket cost table — what an LRU bucket eviction would free")
 COMPRESSION_ERROR = Histogram(
     "mxnet_compression_error",
     "Mean |quantization error| per gradient bucket per compressed "
@@ -623,6 +658,18 @@ def _flight_snapshot() -> dict:
         return {"enabled": False}
 
 
+def _memory_snapshot() -> dict:
+    """snapshot()["memory"]: per-tag live/peak bytes, attribution pct,
+    untagged remainder, budget + OOM state (docs/memory.md).  Lazy/
+    guarded — the metrics layer must never fail because of the
+    ledger."""
+    try:
+        from . import memory as _mem
+        return _mem.snapshot_summary()
+    except Exception:  # noqa: BLE001
+        return {"enabled": False}
+
+
 def _analysis_snapshot() -> dict:
     """snapshot()["analysis"]: sanitizer state + violation counters
     (docs/static_analysis.md).  The sanitizer import is lazy/guarded —
@@ -684,6 +731,7 @@ def snapshot() -> dict:
             "latency_exemplars": SERVE_LATENCY_SECONDS.exemplars(),
         },
         "flight": _flight_snapshot(),
+        "memory": _memory_snapshot(),
         "analysis": _analysis_snapshot(),
         "checkpoint": {
             "last_step": CHECKPOINT_LAST_STEP.get(),
@@ -700,9 +748,23 @@ def snapshot() -> dict:
     }
 
 
+def _refresh_export_gauges() -> None:
+    """Pull-style gauges that aren't ``fn=``-driven refresh here so the
+    render paths export fresh values even when ``snapshot()`` never
+    runs (the documented Prometheus scrape wiring).  Lazy/guarded — a
+    render must never fail because of the ledger."""
+    try:
+        from . import memory as _mem
+        _mem.refresh_gauge()
+    except Exception:  # noqa: BLE001
+        pass
+
+
 def render_prometheus() -> str:
+    _refresh_export_gauges()
     return REGISTRY.render_prometheus()
 
 
 def render_json() -> str:
+    _refresh_export_gauges()
     return REGISTRY.render_json()

@@ -47,6 +47,14 @@ def chrome_events(flight_records: List[tuple]) -> List[dict]:
         if args:
             ev["args"] = args
         events.append(ev)
+        if labels and "mem_live_bytes" in labels:
+            # ledger-sampled phases also emit a Chrome COUNTER event at
+            # phase end: Perfetto renders one "hbm_live_bytes" track
+            # whose steps line up with the phase spans — the
+            # which-phase-grew-HBM view (docs/memory.md)
+            events.append({"name": "hbm_live_bytes", "ph": "C",
+                           "ts": t1, "pid": PID,
+                           "args": {"bytes": labels["mem_live_bytes"]}})
     for tid, tname in sorted(seen_tids.items()):
         events.append({"name": "thread_name", "ph": "M", "pid": PID,
                        "tid": tid, "args": {"name": tname}})

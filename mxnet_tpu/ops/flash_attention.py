@@ -346,7 +346,7 @@ def _mha_decode_step_op(p, qkv, kc, vc, pos):
             # SHARDED — they are the recurrent state of the decode
             # loop, and gathering them back each step would both defeat
             # the memory scaling and pay O(cache) transfers per token
-            out = jax.device_put(out, orig_dev)
+            out = jax.device_put(out, orig_dev)  # graft-lint: disable=memory-hygiene
         return out.reshape(B, 1, D).astype(qkv.dtype), kc, vc
     t = pos.astype(jnp.int32).reshape(())
     zero = jnp.zeros((), jnp.int32)
@@ -415,7 +415,8 @@ def _multihead_attention_op(p, qkv):
         out = fn(q, k, v, mesh, axis_name=axis, causal=bool(p["causal"]),
                  scale=float(scale))
         if eager and orig_dev is not None:
-            out = jax.device_put(out, orig_dev)
+            # transient D2D return-to-caller move (see ops/registry)
+            out = jax.device_put(out, orig_dev)  # graft-lint: disable=memory-hygiene
     else:
         out = _dense_reference(q, k, v, float(scale), bool(p["causal"]))
     return out.transpose(0, 2, 1, 3).reshape(B, T, D)

@@ -20,6 +20,8 @@ from .analysis import hot_path
 from .base import MXNetError, Registry, getenv
 from . import ndarray as nd
 from .ndarray import NDArray
+from .faultinject import fire as _fi_fire
+from .observability import memory as _memory
 from .observability import metrics as _metrics
 from .observability.tracing import trace_span
 
@@ -791,6 +793,20 @@ def _conform_state_sharding(state, weight):
     return place(state)
 
 
+def _register_state(state) -> None:
+    """Ledger-register raw jax arrays inside an optimizer state tree
+    (NDArray states already self-registered at creation under the
+    enclosing memory_scope)."""
+    if state is None or isinstance(state, NDArray):
+        return
+    if isinstance(state, (tuple, list)):
+        for s in state:
+            _register_state(s)
+        return
+    if hasattr(state, "shape") and hasattr(state, "dtype"):
+        _memory.register(state, tag="optimizer_state")
+
+
 class Updater:
     """Applies an optimizer with per-index states (parity: optimizer.get_updater)."""
 
@@ -801,8 +817,16 @@ class Updater:
 
     def _ensure_state(self, index, weight):
         if index not in self.states:
-            state = self.optimizer.create_state_multi_precision(index, weight)
-            self.states[index] = _conform_state_sharding(state, weight)
+            # HBM ledger: optimizer state (momentum/adam moments, fp32
+            # masters) is born here — NDArray states self-register under
+            # the scope tag, raw jax states register explicitly
+            with _memory.memory_scope("optimizer_state"):
+                state = self.optimizer.create_state_multi_precision(
+                    index, weight)
+                state = _conform_state_sharding(state, weight)
+                if _memory.ENABLED:
+                    _register_state(state)
+            self.states[index] = state
             self.states_synced[index] = True
         elif not self.states_synced[index]:
             self.states[index] = self.sync_state_context(self.states[index],
@@ -886,6 +910,12 @@ class FusedUpdater(Updater):
         if isinstance(old, (tuple, list)):
             return type(old)(self._state_writeback(o, n)
                              for o, n in zip(old, new))
+        # raw jax state: the registered old array dies here — the
+        # replacement must re-register or optimizer_state attribution
+        # drifts to zero after the first fused step (same per-step
+        # re-registration the compression residuals do)
+        if _memory.ENABLED:
+            _memory.register(new, tag="optimizer_state")
         return new
 
     def hyper_arrays(self, indices):
@@ -1059,7 +1089,12 @@ class FusedUpdater(Updater):
         if _metrics.ENABLED:
             _metrics.XLA_LAUNCHES.inc(kind="optimizer")
             _metrics.OPTIMIZER_STEPS.inc()
-        with trace_span("optimizer_update_all", cat="optimizer"):
+        # OOM post-mortem chokepoint: the fused multi-tensor update is
+        # the other program that holds a whole model (+states) live;
+        # the memory.oom chaos site injects a synthetic one here
+        with trace_span("optimizer_update_all", cat="optimizer"), \
+                _memory.oom_guard("optimizer.update_all"):
+            _fi_fire("memory.oom", at="optimizer")
             nws, nss, nts = fn(wvals, gvals, svals, lrs, wds, ts)
         commit_ts(nts)
         for k, i in enumerate(indices):

@@ -67,7 +67,8 @@ def _stitch(mesh, x):
     process's local devices (D2D copies) and registered as that
     process's row, no host round trip.  Shared by every cross-host leg
     (dense allreduce, rsp row gather, packed-payload gather)."""
-    bufs = [jax.device_put(jnp.expand_dims(x, 0), d)
+    # transient assembly rows for one collective — dead at return
+    bufs = [jax.device_put(jnp.expand_dims(x, 0), d)  # graft-lint: disable=memory-hygiene
             for d in mesh.devices[jax.process_index()]]
     return jax.make_array_from_single_device_arrays(
         (jax.process_count(),) + tuple(x.shape),

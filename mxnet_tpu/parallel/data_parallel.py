@@ -21,11 +21,13 @@ from ..base import MXNetError
 def shard_batch(mesh: Mesh, x, axis_name: str = "dp"):
     """Place a host array onto the mesh, sharded along dim 0."""
     spec = P(axis_name) if x.ndim >= 1 else P()
-    return jax.device_put(x, NamedSharding(mesh, spec))
+    # mesh placement of a caller-owned batch: the caller tags it
+    # (prefetcher/executor scopes); not a new logical allocation
+    return jax.device_put(x, NamedSharding(mesh, spec))  # graft-lint: disable=memory-hygiene
 
 
 def replicate(mesh: Mesh, x):
-    return jax.device_put(x, NamedSharding(mesh, P()))
+    return jax.device_put(x, NamedSharding(mesh, P()))  # graft-lint: disable=memory-hygiene
 
 
 class DataParallelStep:

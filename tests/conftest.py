@@ -62,6 +62,21 @@ def pytest_configure(config):
         "observability.flight — ring recording, trace-id propagation, "
         "Perfetto export, anomaly auto-dump).  Runs in tier-1 by "
         "default; `pytest -m flight` selects just the recorder suite")
+    config.addinivalue_line(
+        "markers",
+        "memory: HBM-ledger tests (mxnet_tpu.observability.memory — "
+        "attribution/leak gates, budget watchdog, OOM post-mortem).  "
+        "Runs in tier-1 by default; `pytest -m memory` selects just "
+        "the ledger suite")
+
+
+@pytest.fixture(autouse=True)
+def _flight_dir(tmp_path, monkeypatch):
+    """Flight/OOM auto-dumps default to cwd (MXNET_FLIGHT_DIR='.') —
+    a test that trips the slow-phase watchdog or the OOM post-mortem
+    must never litter the repo root with flight-*/oom-*.json.  Tests
+    that care about the dir still monkeypatch their own."""
+    monkeypatch.setenv("MXNET_FLIGHT_DIR", str(tmp_path / "flight-dumps"))
 
 
 @pytest.fixture(autouse=True)

@@ -144,6 +144,12 @@ BAD_METRICS = """
         counter.SERVE_SHED.inc(tenant=f"tenant-{tenant}")
 """
 
+BAD_MEMORY = """
+    import jax
+    def stage(x, dev):
+        return jax.device_put(x, dev)
+"""
+
 
 # -- each rule fires on its known-bad fixture --------------------------------
 
@@ -350,7 +356,8 @@ def test_cli_exits_nonzero_on_seeded_violations(tmp_path):
              "host-sync": BAD_HOST_SYNC,
              "atomic-write": BAD_ATOMIC_WRITE,
              "env-sync": BAD_ENV_SYNC,
-             "metrics-hygiene": BAD_METRICS}
+             "metrics-hygiene": BAD_METRICS,
+             "memory-hygiene": BAD_MEMORY}
     assert set(seeds) == set(ALL_RULES)
     for i, (rule, src) in enumerate(seeds.items()):
         p = tmp_path / f"seed_{i}.py"
