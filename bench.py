@@ -352,6 +352,20 @@ def _run():
             _STATE["gluon_trainer"] = {
                 "error": "%s: %s" % (type(e).__name__, str(e)[:200])}
 
+    # whole-step rider (ISSUE 10; MXT_BENCH_WHOLESTEP=0 skips): steps/s
+    # + per-step dispatch counts for the PR 2 fused path vs
+    # MXNET_WHOLE_STEP=1 (one donated program) vs whole-step + bf16
+    # autocast — same durability contract as the other riders.  CPU
+    # numbers gate the dispatch counts; re-validate steps/s on device
+    # when the chip window returns (CHIP_WINDOW_r05c).
+    if os.environ.get("MXT_BENCH_WHOLESTEP", "1") != "0":
+        _phase("wholestep", EPOCH_S)
+        try:
+            _STATE["wholestep"] = _wholestep_leg(mx, ctx)
+        except Exception as e:  # noqa: BLE001
+            _STATE["wholestep"] = {
+                "error": "%s: %s" % (type(e).__name__, str(e)[:200])}
+
     # inference-serving rider (ISSUE 4; MXT_BENCH_INFER=0 skips): p50/p99
     # request latency, throughput, compile count, and padding waste for
     # per-request vs micro-batched serving through the shape-bucketed
@@ -499,6 +513,80 @@ def _gluon_trainer_leg(mx, ctx):
             os.environ.pop("MXNET_FUSED_TRAINER", None)
         else:
             os.environ["MXNET_FUSED_TRAINER"] = prev
+    return out
+
+
+def _wholestep_leg(mx, ctx):
+    """Whole-step compilation A/B/C (ISSUE 10): the same 20-param dense
+    hybridized MLP trained through WholeStepCompiler.step under three
+    regimes — fused (MXNET_WHOLE_STEP unset: the PR 2 multi-program
+    path via automatic fallback), whole_step (one donated XLA program
+    per step), whole_step_bf16 (same program with matmul compute
+    autocast to bf16) — reporting steps/s, the per-step dispatch_counts
+    delta, and the trainer-step gauge.  The dispatch numbers are the
+    durable CPU acceptance (1 program vs 4); steps/s is indicative
+    until re-measured on device (CHIP_WINDOW_r05c: chip down)."""
+    from mxnet_tpu import gluon, observability as _obs
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu.gluon.wholestep import WholeStepCompiler
+    from mxnet_tpu.observability import metrics as _m
+
+    rs = np.random.RandomState(0)
+    bs, steps = 256, 30
+    x = mx.nd.array(rs.normal(0, 1, (bs, 64)).astype("f"), ctx=ctx)
+    y = mx.nd.array(rs.normal(0, 1, (bs, 1)).astype("f"), ctx=ctx)
+    loss_fn = gluon.loss.L2Loss()
+    out = {"note": "CPU dispatch gates; device steps/s pending chip "
+                   "window (CHIP_WINDOW_r05c)"}
+    saved = {k: os.environ.get(k) for k in ("MXNET_WHOLE_STEP",
+                                            "MXNET_AMP")}
+    try:
+        for mode, env in (
+                ("fused", {}),
+                ("whole_step", {"MXNET_WHOLE_STEP": "1"}),
+                ("whole_step_bf16", {"MXNET_WHOLE_STEP": "1",
+                                     "MXNET_AMP": "bf16"})):
+            for k in saved:
+                os.environ.pop(k, None)
+            os.environ.update(env)
+            net = nn.HybridSequential()
+            with net.name_scope():
+                for _ in range(9):
+                    net.add(nn.Dense(64, activation="relu"))
+                net.add(nn.Dense(1))
+            net.hybridize()
+            net.initialize(mx.init.Xavier(), ctx=ctx)
+            trainer = gluon.Trainer(net.collect_params(), "sgd",
+                                    {"learning_rate": 0.01,
+                                     "momentum": 0.9},
+                                    kvstore="tpu_sync",
+                                    update_on_kvstore=False)
+            stc = WholeStepCompiler(net, loss_fn, trainer)
+            for _ in range(3):
+                last = stc.step(x, y)
+            float(np.asarray(last.asnumpy()).ravel()[0])  # compile sync
+            c0 = _obs.dispatch_counts()
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                last = stc.step(x, y)
+            float(np.asarray(last.asnumpy()).ravel()[0])
+            dt = time.perf_counter() - t0
+            c1 = _obs.dispatch_counts()
+            out[mode] = {
+                "steps_per_s": round(steps / dt, 2),
+                "samples_per_s": round(bs * steps / dt, 1),
+                "whole_step_active": stc.active,
+                "dispatches_per_step": round(
+                    (c1.get("total", 0) - c0.get("total", 0)) / steps, 2),
+                "trainer_step_dispatches":
+                    _m.TRAINER_STEP_DISPATCHES.get(),
+            }
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
     return out
 
 

@@ -155,6 +155,20 @@ def _acc(a, b):
     return a + b
 
 
+def _ones_cot(shape: Tuple[int, ...], dtype):
+    """Default head cotangent — allocated FRESH each call, never cached:
+    when a head is itself a leaf with attach_grad, this exact array is
+    deposited as the user-visible ``.grad`` buffer, and several
+    consumers donate gradient buffers into jitted programs (per-key
+    ``Trainer.update``, module fit, serving).  A process-lifetime cache
+    would hand out an array XLA may delete, poisoning every later
+    default-seed backward of that (shape, dtype) with 'Array has been
+    deleted'.  The fill is one cheap XLA op; the whole-step program
+    never needs it at all — gluon/wholestep.py differentiates a summed
+    loss instead."""
+    return jnp.ones(shape, dtype)
+
+
 def backward(heads, head_grads=None, retain_graph: bool = False,
              train_mode: bool = True) -> None:
     """Reverse walk of the tape from `heads` (parity: Imperative::Backward)."""
@@ -163,7 +177,7 @@ def backward(heads, head_grads=None, retain_graph: bool = False,
     grad_map: Dict[Tuple[int, int], jax.Array] = {}
     for i, h in enumerate(heads):
         hg = None if head_grads is None else head_grads[i]
-        g = jnp.ones(h.shape, h.dtype) if hg is None else (
+        g = _ones_cot(tuple(h.shape), h.dtype) if hg is None else (
             hg._data if hasattr(hg, "_data") else jnp.asarray(hg))
         k = _key(h)
         grad_map[k] = _acc(grad_map[k], g) if k in grad_map else g

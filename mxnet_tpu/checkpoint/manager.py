@@ -546,6 +546,17 @@ def save_trainer(manager: CheckpointManager, step: int, params,
         state[TRAINER_STATES_KEY] = trainer.get_states_bytes()
         if trainer._bucket_sig is not None:
             signatures["trainer_bucket_sig"] = repr(trainer._bucket_sig)
+        # read the policy the training ACTUALLY ran under (the updater's
+        # dtype_policy follows the last executed step; the whole-step
+        # fallback resets it to f32) — the MXNET_AMP env var would lie
+        # when whole-step fell back and AMP was inert
+        upds = getattr(trainer, "_updaters", None) or []
+        policy = getattr(upds[0], "dtype_policy", "f32") if upds else "f32"
+        # stamp the EFFECTIVE policy unconditionally — "f32" included —
+        # so a resume under a different MXNET_AMP is loud in BOTH
+        # directions (f32 checkpoint resumed bf16 is just as much a
+        # trajectory change as the reverse; restore_trainer checks)
+        signatures["amp_policy"] = policy
     if extra_state:
         overlap = set(extra_state) & set(state)
         if overlap:
@@ -562,10 +573,37 @@ def restore_trainer(manager: CheckpointManager, params, trainer=None,
     ``trainer``).  Returns the restored step, or None when the
     directory holds no valid checkpoint.  Missing parameters raise —
     a half-restored model must never train silently."""
-    res = manager.restore(step)
+    res = manager.restore(step, with_manifest=True)
     if res is None:
         return None
-    got_step, state = res
+    got_step, state, manifest = res
+    saved_amp = (manifest.get("signatures") or {}).get("amp_policy")
+    if saved_amp is not None:
+        try:
+            # the saved stamp records the EFFECTIVE policy (what the
+            # training actually ran), so compare against what this
+            # process can effectively run: MXNET_AMP only applies
+            # inside the whole-step program — with whole-step off the
+            # resume is f32 no matter what MXNET_AMP says
+            from ..base import getenv
+            from ..gluon.wholestep import amp_policy
+            cur = amp_policy() if getenv("MXNET_WHOLE_STEP", False) \
+                else "f32"
+        except Exception:  # noqa: BLE001
+            cur = "f32"
+        if cur != saved_amp:
+            # a resume under a different precision policy is VALID but
+            # sits on a different numeric trajectory — say so loudly.
+            # `cur` is the CONFIGURED policy; if whole-step falls back
+            # at runtime the effective precision is f32 regardless, a
+            # case only the compiler's own fallback warning can catch
+            log.warning(
+                "checkpoint step %s was written under effective "
+                "MXNET_AMP=%s but this process is configured for %s — "
+                "resuming changes the numeric trajectory (loss-scaler "
+                "state restores regardless; if whole-step falls back, "
+                "the run is f32 whatever MXNET_AMP says)",
+                got_step, saved_amp, cur)
     pd = _as_param_dict(params)
     missing = [name for name in pd
                if f"{PARAM_PREFIX}{name}" not in state]

@@ -2,6 +2,8 @@
 from .parameter import Parameter, Constant, ParameterDict, DeferredInitializationError
 from .block import Block, HybridBlock, SymbolBlock, CachedOp
 from .trainer import Trainer
+from . import wholestep
+from .wholestep import WholeStepCompiler
 from . import nn
 from . import rnn
 from . import loss

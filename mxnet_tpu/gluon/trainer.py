@@ -77,6 +77,17 @@ class Trainer:
         # (bucket_sig, numpy arrays) from load_states, adopted — with a
         # signature check — when the bucketer is next built
         self._pending_residuals = None
+        # dynamic loss-scaling state for MXNET_AMP=fp16 whole-step
+        # training (gluon/wholestep.py): device scalars donated into the
+        # compiled step each call; rides save_states/load_states so a
+        # resumed run continues the same scale trajectory
+        self._scaler = None
+        # (idx, device applied-step vector) mirrored by the whole-step
+        # compiler after each step; persisted with the scaler because
+        # fp16 skip-steps make it lag the schedule counts — a resume
+        # seeding Adam's bias-correction t from the counts would diverge
+        self._applied_ts = None
+        self._applied_ts_pending = None  # set by load_states, consumed once
         # monotonically increasing step id stamped on flight-recorder
         # phase records (joins allreduce/compress/update sub-phases to
         # their step in a timeline dump)
@@ -283,19 +294,10 @@ class Trainer:
         indices).  The buckets are TRANSIENT — they never enter the
         kvstore's backing store, so no gradient-sized copy is pinned and
         nothing is copied per step beyond the reduce itself."""
-        from ..kvstore import GradBucketer
         grads = [p.list_grad()[0] for _, p in dense]
         sig = tuple((tuple(g.shape), str(g.dtype)) for g in grads)
         idx = tuple(i for i, _ in dense)
-        if self._bucketer is None or self._bucket_sig != (sig, idx):
-            cap = int(float(getenv("MXNET_BUCKET_SIZE_MB", 32.0))
-                      * 1024 * 1024)
-            self._bucketer = GradBucketer(sig, cap)
-            self._bucket_sig = (sig, idx)
-            # the flat residual layout is a function of the bucket
-            # layout — a signature change restarts error feedback
-            self._residuals = None
-        bk = self._bucketer
+        bk = self._ensure_bucketer(sig, idx)
         gc = getattr(self._kv, "_gc", None)
         with trace_span("bucketed_allreduce", cat="kvstore"), \
                 _flight.phase_span("allreduce", cat="kvstore",
@@ -322,6 +324,59 @@ class Trainer:
                 reduced = self._kv.allreduce(buckets)
         return ([r.handle for r in reduced],
                 [bk.views[j] for j in range(len(dense))], idx)
+
+    def _ensure_bucketer(self, sig, idx):
+        """Build (or reuse) the GradBucketer for this dense-gradient
+        signature.  Shared by the fused allreduce AND the whole-step
+        compiler so both lay residuals out identically — a checkpoint
+        written under one path restores under the other."""
+        from ..kvstore import GradBucketer
+        if self._bucketer is None or self._bucket_sig != (sig, idx):
+            cap = int(float(getenv("MXNET_BUCKET_SIZE_MB", 32.0))
+                      * 1024 * 1024)
+            self._bucketer = GradBucketer(sig, cap)
+            self._bucket_sig = (sig, idx)
+            # the flat residual layout is a function of the bucket
+            # layout — a signature change restarts error feedback
+            self._residuals = None
+        return self._bucketer
+
+    def _ensure_scaler(self):
+        """Dynamic loss-scaling state (MXNET_AMP=fp16): scale and
+        consecutive-finite-step count as device scalars — the whole-step
+        program reads, updates, and returns them functionally, so no
+        per-step host sync ever inspects them.  Growth/backoff policy:
+        x2 after MXNET_LOSS_SCALE_WINDOW consecutive finite steps, x0.5
+        (floor 1.0) on any nonfinite gradient, that step skipped."""
+        if self._scaler is None:
+            self._scaler = self._make_scaler(
+                getenv("MXNET_LOSS_SCALE_INIT", 65536.0), 0,
+                getenv("MXNET_LOSS_SCALE_WINDOW", 200))
+        return self._scaler
+
+    @staticmethod
+    def _make_scaler(scale, good, window):
+        """The one place the scaler dict is constructed — fresh starts
+        (_ensure_scaler) and checkpoint restores (load_states) must
+        produce the identical structure."""
+        return {
+            "scale": _memory.register(
+                jnp.asarray(float(scale), dtype=jnp.float32),
+                tag="optimizer_state"),
+            "good": _memory.register(
+                jnp.asarray(int(good), dtype=jnp.int32),
+                tag="optimizer_state"),
+            "window": int(window),
+        }
+
+    @property
+    def loss_scale(self) -> float:
+        """Current dynamic loss scale (1.0 when fp16 scaling is off).
+        Reading it syncs the device scalar — diagnostics/tests only,
+        never the hot path."""
+        if self._scaler is None:
+            return 1.0
+        return float(_np.asarray(self._scaler["scale"]))
 
     def _init_residuals(self, bk):
         """Fresh zero residuals sized to the bucket layout — unless
@@ -393,6 +448,14 @@ class Trainer:
                 self._clear_fresh(done)
                 return
         fused_ok = self._fused and isinstance(upd, FusedUpdater)
+        # update_all always runs f32 optimizer math — clear any sticky
+        # whole-step AMP policy (a direct Trainer.step after AMP
+        # whole-step training must not key, and loudly "recompile",
+        # the update_all program under a precision it never traced)
+        if fused_ok:
+            for u in self._updaters:
+                if u.dtype_policy != "f32":
+                    u.dtype_policy = "f32"
         if fused_ok and all(len(p.list_data()) == 1 for _, p in live):
             if reduced is not None:
                 flats, views, idx = reduced
@@ -473,11 +536,14 @@ class Trainer:
         atomic_write(fname, self.get_states_bytes())
 
     def _wrap_states(self, states: bytes) -> bytes:
-        """Without compression the file is the raw updater-state pickle
-        (format unchanged).  With compression active, the 2-bit
-        error-feedback residuals ride along in a sentinel-keyed wrapper
-        so a resumed run continues the same quantization trajectory
-        instead of silently restarting from zero error."""
+        """Without compression or loss scaling the file is the raw
+        updater-state pickle (format unchanged).  With compression
+        active, the 2-bit error-feedback residuals ride along in a
+        sentinel-keyed wrapper so a resumed run continues the same
+        quantization trajectory instead of silently restarting from
+        zero error; with fp16 dynamic loss scaling active (whole-step
+        AMP), the scaler's scale/good-step state rides the same wrapper
+        so a resumed run continues the same scale trajectory."""
         bucket = None
         if self._residuals is not None:
             bucket = {"sig": self._bucket_sig,
@@ -492,12 +558,22 @@ class Trainer:
             # update_on_kvstore fused pushpull both key them in the kv)
             kv_res = {k: _np.asarray(v)
                       for k, v in self._kv._residuals.items()}
-        if bucket is None and not kv_res:
+        scaler = None
+        if self._scaler is not None:
+            scaler = {"scale": float(_np.asarray(self._scaler["scale"])),
+                      "good": int(_np.asarray(self._scaler["good"])),
+                      "window": int(self._scaler["window"])}
+            if self._applied_ts is not None:
+                scaler["ts_idx"] = list(self._applied_ts[0])
+                scaler["ts"] = [int(t) for t in
+                                _np.asarray(self._applied_ts[1])]
+        if bucket is None and not kv_res and scaler is None:
             return states
         return pickle.dumps({"__mxt_trainer_states__": 1,
                              "updater": states,
                              "bucket": bucket,
-                             "kv_residuals": kv_res})
+                             "kv_residuals": kv_res,
+                             "scaler": scaler})
 
     @staticmethod
     def _unwrap_states(payload: bytes):
@@ -523,6 +599,14 @@ class Trainer:
         if not self._kv_initialized:
             self._init_kvstore()
         states, extra = self._unwrap_states(payload)
+        # loading REPLACES the trainer's auxiliary training state: a
+        # checkpoint written without fp16 must not inherit this
+        # process's previous scaler/applied-ts trajectory (the next
+        # save would otherwise persist the stale scale into the new
+        # run's checkpoints)
+        self._scaler = None
+        self._applied_ts = None
+        self._applied_ts_pending = None
         if self._update_on_kvstore:
             if self._kv._updater is None:
                 raise MXNetError("no optimizer set")
@@ -535,6 +619,14 @@ class Trainer:
             self._optimizer = self._updaters[0].optimizer
         if extra is None:
             return
+        scaler = extra.get("scaler")
+        if scaler is not None:
+            self._scaler = self._make_scaler(
+                scaler["scale"], scaler["good"], scaler["window"])
+            if scaler.get("ts") is not None:
+                self._applied_ts_pending = (
+                    tuple(scaler["ts_idx"]),
+                    [int(t) for t in scaler["ts"]])
         kv_res = extra.get("kv_residuals") or {}
         if kv_res and self._kv is not None:
             self._kv._residuals = {k: jnp.asarray(v)

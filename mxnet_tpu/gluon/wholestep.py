@@ -1,0 +1,697 @@
+"""Whole-step compilation + mixed precision for the Gluon hot loop.
+
+PRs 2-3 left a dense hybridized model's training step at 3-4
+steady-state XLA dispatches: fwd (CachedOp), bwd (vjp program),
+bucketed allreduce, fused update.  Every remaining boundary is a
+Python round trip through the TPU tunnel and a lost cross-stage fusion
+opportunity — the TVM (arxiv 1802.04799) / TPU-MLIR (arxiv 2210.15016)
+observation that the next hot-path win is compiling MORE of the step.
+
+``WholeStepCompiler`` traces forward + loss + backward + bucketed
+reduce (+ 2-bit quantize/dequantize against the Trainer's flat
+error-feedback residuals) + the ``FusedUpdater`` optimizer math into
+ONE ``jax.jit`` program with parameters, optimizer state, residuals,
+aux state, and loss-scaler state DONATED: a steady-state training step
+is **1 XLA dispatch** regardless of parameter count.  Opt-in via
+``MXNET_WHOLE_STEP=1``; any unsupported construct — sparse params,
+``update_on_kvstore``, multi-host kvstore, custom/non-differentiable
+ops, non-``write`` grad_req, multi-device copies, a loss that cannot
+compose symbolically — falls back to the PR 2 fused path (<= 4
+dispatches) with a single warning.
+
+Mixed precision rides the same program (``MXNET_AMP=bf16|fp16``):
+matmul / conv / deconv compute autocasts to the low-precision dtype
+inside the compiled step (per-op cast-in/cast-out over
+``AMP_COMPUTE_OPS``; f32 master weights and optimizer state never
+leave f32, so the backward's matmuls run low-precision too via the
+cast vjp).  ``fp16`` adds dynamic loss scaling: scale/unscale,
+nonfinite detection, skip-step, and scale growth/backoff
+(``MXNET_LOSS_SCALE_INIT`` / ``MXNET_LOSS_SCALE_WINDOW``) all trace
+into the same program; the scaler state is device-resident, donated,
+and rides ``Trainer.save_states`` / ``load_states`` (and therefore
+``mx.checkpoint.save_trainer``).
+
+Numerics: the f32 whole-step program runs the exact op sequence of the
+fused path (same GraphPlan, same bucket layout, same
+quantize/dequantize math, same fused_step) — tests/test_wholestep.py
+pins bitwise agreement over 5 steps on its nets.  (XLA may fuse the
+single program differently than the fused path's separate programs, so
+arbitrary models get f32 ulp-level agreement, not a bitwise
+guarantee.)  Under fp16 skip-steps the
+python-side ``num_update`` (lr schedules) still advances while the
+device-side bias-correction counter ``t`` advances only on applied
+steps — the numerically correct behavior for Adam-family optimizers.
+"""
+from __future__ import annotations
+
+import itertools
+import logging
+
+import jax
+import jax.numpy as jnp
+import numpy as _np
+
+from ..analysis import hot_path
+from ..base import MXNetError, getenv
+from ..ndarray import NDArray
+from ..observability import flight as _flight
+from ..observability import memory as _memory
+from ..observability import metrics as _metrics
+from ..observability.tracing import trace_span
+from ..optimizer import cast_like as _cast_like
+from .. import symbol as sym_mod
+from ..symbol.graph import GraphPlan
+from .. import autograd
+from .parameter import DeferredInitializationError
+
+logger = logging.getLogger("mxnet_tpu.gluon.wholestep")
+
+# internal graph-input names for the step's data/label feeds — namespaced
+# so they can never collide with a parameter name
+_DATA = "__wholestep_data__"
+_LABEL = "__wholestep_label__"
+
+# ops whose compute autocasts to the low-precision dtype under MXNET_AMP
+# (the flops carriers; everything else — norms, softmax, loss, optimizer
+# — stays f32).  Inputs flagged f32-forced by the op registry
+# (Operator.f32_inputs) are never cast.
+AMP_COMPUTE_OPS = frozenset({
+    "FullyConnected", "Convolution", "Deconvolution", "dot", "batch_dot",
+})
+
+_LP_DTYPES = {"bf16": jnp.bfloat16, "fp16": jnp.float16}
+
+# install the donation-noise filter ONCE per process, not per compiler:
+# repeated unguarded filterwarnings() calls grow warnings.filters without
+# bound (same expected-noise rationale as CachedOp's filter in block.py)
+_donation_filter_installed = False
+
+
+def _install_donation_filter():
+    global _donation_filter_installed
+    if not _donation_filter_installed:
+        import warnings as _warnings
+        _warnings.filterwarnings(
+            "ignore", message="Some donated buffers were not usable")
+        _donation_filter_installed = True
+
+# process-unique id per traced graph, used in the compiled-program cache
+# key: the cache (FusedUpdater._fn_cache) outlives any one compiler, so
+# keying on id(plan) could alias a NEW graph onto a dead one's recycled
+# address and silently run the wrong program
+_PLAN_UID = itertools.count(1)
+_SCALE_GROWTH = 2.0
+_SCALE_BACKOFF = 0.5
+_SCALE_MAX = float(2 ** 24)
+
+
+def amp_policy() -> str:
+    """Resolve MXNET_AMP to a dtype policy ("f32" | "bf16" | "fp16")."""
+    raw = str(getenv("MXNET_AMP", "")).strip().lower()
+    if raw in ("", "0", "off", "none", "f32", "fp32", "float32"):
+        return "f32"
+    if raw in ("bf16", "bfloat16"):
+        return "bf16"
+    if raw in ("fp16", "f16", "float16"):
+        return "fp16"
+    raise MXNetError(
+        f"MXNET_AMP={raw!r} not understood (use bf16, fp16, or off)")
+
+
+def _amp_overrides(plan: GraphPlan, lp):
+    """step_overrides for GraphPlan.run that autocast AMP_COMPUTE_OPS:
+    f32 float inputs cast to ``lp`` for the op's compute, outputs cast
+    back to f32 so the surrounding graph (activations, norms, loss) is
+    unchanged.  jax.vjp of the cast pair makes the op's BACKWARD
+    matmuls low-precision too, with f32 gradients delivered to the
+    optimizer."""
+    over = {}
+    for si, step in enumerate(plan.steps):
+        if step.op.name not in AMP_COMPUTE_OPS:
+            continue
+        keep32 = frozenset(step.op.f32_inputs)
+
+        def _run(p, ins, _op=step.op, _keep=keep32):
+            cast = [a.astype(lp)
+                    if (i not in _keep and a is not None
+                        and getattr(a, "dtype", None) == jnp.float32)
+                    else a
+                    for i, a in enumerate(ins)]
+            out = _op.fn(p, *cast)
+            out = out if isinstance(out, tuple) else (out,)
+            return tuple(o.astype(jnp.float32)
+                         if getattr(o, "dtype", None) == lp else o
+                         for o in out)
+
+        over[si] = _run
+    return over
+
+
+class _Ineligible(RuntimeError):
+    """Construct the whole-step tracer cannot compile — fall back."""
+
+
+class _AmpIneligible(_Ineligible):
+    """MXNET_AMP cannot apply to this model — a CONFIG-dependent
+    condition, so it falls back per-step (re-checked on every call)
+    instead of permanently demoting a compiler whose f32 program may be
+    built and working; unsetting MXNET_AMP resumes whole-step."""
+
+
+def _sel(finite, new, old):
+    """Per-leaf where(finite, new, old) tolerant of None / nested
+    tuple states (the fp16 skip-step select)."""
+    if new is None or old is None:
+        return new
+    if isinstance(new, (tuple, list)):
+        return type(new)(_sel(finite, a, b) for a, b in zip(new, old))
+    return jnp.where(finite, new, old)
+
+
+# the dtype-preservation rule is SHARED with FusedUpdater.update_all
+# (optimizer.cast_like) — the whole-step/fused bitwise-parity contract
+# depends on both paths casting identically
+
+
+class WholeStepCompiler:
+    """One donated XLA program per Gluon training step.
+
+    ::
+
+        stepper = mx.gluon.wholestep.WholeStepCompiler(net, loss_fn,
+                                                       trainer)
+        for x, y in batches:
+            loss = stepper.step(x, y)          # per-sample loss NDArray
+
+    ``step`` runs the single compiled whole-step program when
+    ``MXNET_WHOLE_STEP=1`` and the model/trainer are eligible, and the
+    classic record/backward/``Trainer.step`` fused path otherwise —
+    the returned loss and the training trajectory are identical in f32
+    either way.  ``net`` must be a ``HybridBlock`` (hybridized or not;
+    the compiler traces its own graph) and ``loss_fn`` a HybridBlock
+    loss taking ``(pred, label)``.
+    """
+
+    def __init__(self, net, loss_fn, trainer):
+        self.net = net
+        self.loss_fn = loss_fn
+        self.trainer = trainer
+        self._built = None
+        self._fallback_reason = None  # permanent-fallback explanation
+        self._warned = False
+        self._hyper = {}
+        self._ts = None
+        self._ts_next = None
+        # once the program has executed successfully, runtime failures
+        # (OOM included) must PROPAGATE, not silently fall back — the
+        # failed call may already have invalidated donated buffers, so
+        # re-running the step eagerly is not safe
+        self._ran = False
+        self._amp_warned = False       # AMP-ineligible model, warn once
+        self._amp_env_checked = False  # AMP-without-whole-step, warn once
+        # backends without real donation (CPU) warn per trace; the user
+        # opted into best-effort donation, so this is expected noise
+        _install_donation_filter()
+
+    # -- public entry --------------------------------------------------------
+    @hot_path
+    def step(self, data, label, batch_size=None):
+        """One full training step on (data, label); returns the loss
+        NDArray (per-sample, exactly what ``loss_fn(net(data), label)``
+        returns on the fallback path).  Steady state: 1 XLA dispatch
+        when whole-step is active, <= 4 on the fallback path."""
+        bs = batch_size if batch_size is not None else int(data.shape[0])
+        if self._fallback_reason is not None:
+            return self._fallback(data, label, bs)
+        if not getenv("MXNET_WHOLE_STEP", False):
+            self._warn_amp_without_wholestep()
+            return self._fallback(data, label, bs)
+        if autograd.is_recording():
+            raise MXNetError(
+                "WholeStepCompiler.step() must not be called inside "
+                "autograd.record() — it manages forward/backward itself")
+        policy = amp_policy()
+        try:
+            built = self._ensure_built()
+            return self._run(built, data, label, bs, policy)
+        except DeferredInitializationError:
+            # shapes materialize on the eager path; retry the build on
+            # the next step
+            return self._fallback(data, label, bs)
+        except _AmpIneligible as e:
+            # config-dependent, NOT permanent: re-checked every step, so
+            # unsetting MXNET_AMP resumes the whole-step program
+            if not self._amp_warned:
+                logger.warning(
+                    "MXNET_AMP requested but %s — running the fused f32 "
+                    "path while the policy is set", e)
+                self._amp_warned = True
+            return self._fallback(data, label, bs)
+        except _Ineligible as e:
+            self._note_fallback(str(e))
+            return self._fallback(data, label, bs)
+        except Exception as e:  # noqa: BLE001 — tracing arbitrary user graphs
+            if self._ran or self._is_execution_failure(e):
+                # runtime failure (e.g. the typed OOM that
+                # memory.oom_guard re-raises after its post-mortem): the
+                # counters were rolled back by _run, but the failed call
+                # may have consumed donated buffers — eagerly retrying
+                # could read dead arrays, and the user must see the
+                # error.  Applies on the FIRST call too: jit executes
+                # (and donates) right after tracing, so an
+                # execution-typed error means buffers were at risk even
+                # though _ran is still False
+                raise
+            self._note_fallback(f"{type(e).__name__}: {e}")
+            return self._fallback(data, label, bs)
+
+    @staticmethod
+    def _is_execution_failure(e: Exception) -> bool:
+        """True when the exception came from EXECUTING the compiled
+        program (device OOM, XLA runtime) rather than from tracing it —
+        execution implies the donated buffers were in play, so eager
+        fallback is unsafe; trace failures happen before donation and
+        may fall back freely."""
+        if isinstance(e, (_memory.DeviceMemoryError,
+                          _memory.HBMBudgetError)):
+            return True
+        if type(e).__name__ == "XlaRuntimeError":
+            return True
+        return "RESOURCE_EXHAUSTED" in str(e)
+
+    __call__ = step
+
+    @property
+    def active(self) -> bool:
+        """True once a whole-step program has been built and no
+        permanent fallback was taken."""
+        return self._built is not None and self._fallback_reason is None
+
+    @property
+    def fallback_reason(self):
+        return self._fallback_reason
+
+    # -- fallback (the PR 2 fused path) --------------------------------------
+    def _fallback(self, data, label, batch_size):
+        # the fused/legacy path always runs f32 optimizer math — clear
+        # any sticky whole-step AMP policy so update_all never keys
+        # (and loudly "recompiles") under a precision it never traced
+        for u in getattr(self.trainer, "_updaters", None) or []:
+            if getattr(u, "dtype_policy", "f32") != "f32":
+                u.dtype_policy = "f32"
+        with autograd.record():
+            out = self.net(data)
+            loss = self.loss_fn(out, label)
+        loss.backward()
+        self.trainer.step(batch_size)
+        return loss
+
+    def _warn_amp_without_wholestep(self) -> None:
+        """MXNET_AMP only exists inside the whole-step program; setting
+        it without MXNET_WHOLE_STEP=1 silently trains f32 — say so."""
+        if self._amp_env_checked:
+            return
+        self._amp_env_checked = True
+        try:
+            policy = amp_policy()
+        except MXNetError:
+            return
+        if policy != "f32":
+            logger.warning(
+                "MXNET_AMP=%s is set but MXNET_WHOLE_STEP is not enabled "
+                "— autocast and loss scaling only exist inside the "
+                "whole-step program; training runs full f32", policy)
+
+    def _note_fallback(self, reason: str) -> None:
+        self._fallback_reason = reason
+        if not self._warned:
+            try:
+                policy = amp_policy()
+            except MXNetError:
+                policy = "f32"
+            amp_note = "" if policy == "f32" else (
+                f"; MXNET_AMP={policy} is INERT on the fallback path — "
+                "training runs full f32 with no loss scaling")
+            logger.warning(
+                "MXNET_WHOLE_STEP=1 requested but this model/trainer is "
+                "not whole-step compilable (%s) — using the fused "
+                "multi-program path%s", reason, amp_note)
+            self._warned = True
+
+    # -- build ---------------------------------------------------------------
+    def _ensure_built(self):
+        if self._built is not None:
+            return self._built
+        tr = self.trainer
+        if not tr._kv_initialized:
+            tr._init_kvstore()
+        self._check_trainer(tr)
+        plan, out_sym = self._trace_graph()
+        built = self._bind_graph(tr, plan)
+        built["symbol"] = out_sym  # hold the graph alive (id-keyed cache)
+        self._built = built
+        return built
+
+    def _check_trainer(self, tr) -> None:
+        from ..optimizer import FusedUpdater
+        if tr._update_on_kvstore:
+            raise _Ineligible("update_on_kvstore trainers push per key "
+                              "through the kvstore")
+        if not tr._fused:
+            raise _Ineligible("MXNET_FUSED_TRAINER=0 pins the legacy path")
+        upd = tr._updaters[0]
+        if not isinstance(upd, FusedUpdater) or \
+                not getattr(upd.optimizer, "fused", False):
+            raise _Ineligible(
+                f"optimizer {type(upd.optimizer).__name__} has no "
+                "fused_step")
+        if tr._kv is not None and tr._kv.num_workers > 1:
+            raise _Ineligible("multi-host kvstore collectives are not "
+                              "jit-inlinable yet")
+        for p in tr._params:
+            if getattr(p, "_grad_stype", "default") != "default":
+                raise _Ineligible(f"sparse-grad parameter {p.name}")
+            if p.grad_req not in ("write", "null"):
+                raise _Ineligible(
+                    f"grad_req={p.grad_req!r} on {p.name} (vjp gives "
+                    "write semantics)")
+            if p.grad_req != "null" and len(p.list_data()) != 1:
+                raise _Ineligible(f"multi-device copies of {p.name}")
+
+    def _trace_graph(self):
+        """Compose net + loss symbolically into one GraphPlan (the same
+        machinery hybridize() uses, extended through the loss)."""
+        dsym = sym_mod.Variable(_DATA)
+        lsym = sym_mod.Variable(_LABEL)
+        out = self.net(dsym)
+        if isinstance(out, (list, tuple)):
+            if len(out) != 1:
+                raise _Ineligible("multi-output networks")
+            out = out[0]
+        loss_sym = self.loss_fn(out, lsym)
+        if isinstance(loss_sym, (list, tuple)):
+            if len(loss_sym) != 1:
+                raise _Ineligible("multi-output losses")
+            loss_sym = loss_sym[0]
+        plan = GraphPlan(loss_sym)
+        for s in plan.steps:
+            if s.op.name == "Custom" or not s.op.differentiable:
+                raise _Ineligible(
+                    f"op {s.op.name} is not whole-step traceable")
+        return plan, loss_sym
+
+    def _bind_graph(self, tr, plan):
+        """Map graph inputs onto trainer parameters; freeze the live
+        order, bucket layout, and updater keys the program will use —
+        all IDENTICAL to the fused path's so optimizer/residual state is
+        interchangeable between the two."""
+        params_by_name = {p.name: p for p in tr._params}
+        gset, cnames = set(), []
+        for n in plan.arg_names:
+            if n in (_DATA, _LABEL):
+                continue
+            p = params_by_name.get(n)
+            if p is None:
+                raise _Ineligible(
+                    f"graph input {n!r} is not a trainer parameter")
+            (gset.add(n) if p.grad_req != "null" else cnames.append(n))
+        for n in plan.aux_names:
+            if n not in params_by_name:
+                raise _Ineligible(
+                    f"auxiliary state {n!r} is not a trainer parameter")
+        if not gset:
+            raise _Ineligible("no trainable parameters in the graph")
+        # live order = trainer param order, exactly like Trainer._step
+        live = [(i, p) for i, p in enumerate(tr._params)
+                if p.grad_req != "null"]
+        missing = [p.name for _, p in live if p.name not in gset]
+        if missing:
+            raise _Ineligible(
+                f"trainable parameters unused by the graph: {missing[:3]}"
+                " (their gradients would go stale)")
+        idx = tuple(i for i, _ in live)
+        gnames = [p.name for _, p in live]
+        sig = tuple((tuple(p.data().shape), str(p.data().dtype))
+                    for _, p in live)
+        bk = tr._ensure_bucketer(sig, idx)
+        upd = tr._updaters[0]
+        for i, p in live:
+            upd._ensure_state(i, p.data())
+        return {"plan": plan, "idx": idx, "gnames": gnames,
+                "cnames": tuple(cnames),
+                "aux_names": tuple(plan.aux_names),
+                "params": params_by_name, "bk": bk, "sig": sig,
+                "uid": next(_PLAN_UID)}
+
+    # -- the compiled program ------------------------------------------------
+    def _build_fn(self, built, opt_, policy, thr, window):
+        """Trace fwd+loss+bwd+reduce+update into one jitted callable.
+
+        ftrain(gparams, states, residuals, scaler, aux, consts, data,
+               label, key, lrs, wds, ts)
+          -> (loss, new_aux, new_params, new_states, new_residuals,
+              new_scaler, new_ts)
+        gparams/states/residuals/scaler/aux are DONATED — the step
+        updates the model truly in place on backends with donation."""
+        plan = built["plan"]
+        gnames = built["gnames"]
+        idx = built["idx"]
+        bk = built["bk"]
+        lp = _LP_DTYPES.get(policy)
+        overrides = _amp_overrides(plan, lp) if lp is not None else None
+        use_comp = thr is not None
+        use_scaler = policy == "fp16"
+        flatten_inline = bk.flatten_inline if use_comp else None
+        unflatten_inline = bk.unflatten_inline if use_comp else None
+        if use_comp:
+            from ..kvstore import reduce_buckets_inline
+        fused_step = opt_._fused_step_mp
+
+        def ftrain(gparams, states, residuals, scaler, aux, consts,
+                   data, label, key, lrs, wds, ts):
+            def fwd(p):
+                m = dict(consts)
+                m[_DATA] = data
+                m[_LABEL] = label
+                m.update(p)
+                outs, new_aux = plan.run(m, aux, key, True,
+                                         step_overrides=overrides)
+                total = jnp.sum(outs[0].astype(jnp.float32))
+                if use_scaler:
+                    total = total * scaler["scale"]
+                return total, (outs[0], new_aux)
+
+            _, vjp_fn, (loss, new_aux) = jax.vjp(fwd, gparams,
+                                                 has_aux=True)
+            (gd,) = vjp_fn(jnp.asarray(1.0, jnp.float32))
+            glist = [gd[n] for n in gnames]
+            finite = None
+            if use_scaler:
+                inv = 1.0 / scaler["scale"]
+                glist = [(g.astype(jnp.float32) * inv).astype(g.dtype)
+                         for g in glist]
+                finite = jnp.asarray(True)
+                for g in glist:
+                    finite = jnp.logical_and(finite,
+                                             jnp.all(jnp.isfinite(g)))
+            new_res = residuals
+            if use_comp:
+                flats = flatten_inline(glist)
+                red, new_res, _errs = reduce_buckets_inline(
+                    flats, residuals, thr)
+                glist = unflatten_inline(red)
+            new_p, new_s = {}, []
+            for k, n in enumerate(gnames):
+                nw, ns = fused_step(idx[k], gparams[n], glist[k],
+                                    states[k], lrs[k], wds[k], ts[k])
+                new_p[n] = _cast_like(nw, gparams[n])
+                new_s.append(_cast_like(ns, states[k]))
+            new_scaler = scaler
+            if use_scaler:
+                # skip-step: a nonfinite gradient anywhere keeps params,
+                # states, residuals, aux (BN running stats — an
+                # overflowing batch must not poison them forever), and
+                # the bias-correction counter at their pre-step values —
+                # only the scaler moves (backoff)
+                new_aux = {n: jnp.where(finite, a, aux[n])
+                           for n, a in new_aux.items()}
+                new_p = {n: jnp.where(finite, new_p[n], gparams[n])
+                         for n in gnames}
+                new_s = [_sel(finite, a, b) for a, b in zip(new_s, states)]
+                if use_comp:
+                    new_res = [jnp.where(finite, a, b)
+                               for a, b in zip(new_res, residuals)]
+                nts = jnp.where(finite, ts + 1, ts)
+                good = jnp.where(finite, scaler["good"] + 1, 0)
+                grow = good >= window
+                scale = jnp.where(grow,
+                                  jnp.minimum(scaler["scale"]
+                                              * _SCALE_GROWTH,
+                                              _SCALE_MAX),
+                                  scaler["scale"])
+                scale = jnp.where(finite, scale,
+                                  jnp.maximum(scaler["scale"]
+                                              * _SCALE_BACKOFF, 1.0))
+                good = jnp.where(grow, jnp.zeros_like(good), good)
+                new_scaler = {"scale": scale, "good": good}
+            else:
+                nts = ts + 1
+            return loss, new_aux, new_p, new_s, new_res, new_scaler, nts
+
+        return jax.jit(ftrain, donate_argnums=(0, 1, 2, 3, 4))
+
+    # -- per-step driver -----------------------------------------------------
+    def _hyper_arrays(self, opt_, idx):
+        """Device-cached lr/wd vectors + the device-resident step
+        counter (same last-value caching as FusedUpdater.hyper_arrays:
+        nothing re-uploads unless a schedule actually moves, and ts
+        lives on device, advanced by the compiled step itself — under
+        fp16 only on applied steps)."""
+        hc = self._hyper
+        lr_t = tuple(opt_._get_lr(i) for i in idx)
+        wd_t = tuple(opt_._get_wd(i) for i in idx)
+        # np.array over PYTHON scalars builds a host constant to ship
+        # device-ward — not a device read, so not a host sync:
+        if hc.get("lr_key") != lr_t:
+            hc["lr_key"] = lr_t
+            hc["lr"] = jnp.asarray(_np.array(lr_t, _np.float32))  # graft-lint: disable=host-sync
+        if hc.get("wd_key") != wd_t:
+            hc["wd_key"] = wd_t
+            hc["wd"] = jnp.asarray(_np.array(wd_t, _np.float32))  # graft-lint: disable=host-sync
+        counts_t = tuple(opt_._index_update_count[i] for i in idx)
+        if self._ts is None or self._ts_next != counts_t:
+            # (re)seed — first build, or an external path (per-key
+            # update, load_states) moved the counts.  A checkpointed
+            # APPLIED-step vector takes precedence: under fp16 the
+            # schedule counts include skipped steps, so reseeding Adam's
+            # bias-correction t from them would diverge from the
+            # uninterrupted run after any skip
+            pend = getattr(self.trainer, "_applied_ts_pending", None)
+            if pend is not None and pend[0] == idx:
+                self._ts = jnp.asarray(_np.array(pend[1], _np.int32))  # graft-lint: disable=host-sync
+                self.trainer._applied_ts_pending = None
+            else:
+                self._ts = jnp.asarray(_np.array(counts_t, _np.int32))  # graft-lint: disable=host-sync
+        return hc["lr"], hc["wd"], self._ts, counts_t
+
+    def _run(self, built, data, label, bs, policy):
+        tr = self.trainer
+        upd = tr._updaters[0]
+        opt_ = upd.optimizer
+        idx = built["idx"]
+        if policy != "f32" and any(d != "float32" for _, d in built["sig"]):
+            raise _AmpIneligible(
+                f"MXNET_AMP={policy} needs float32 master weights")
+        gc = getattr(tr._kv, "_gc", None) if tr._kv is not None else None
+        thr = gc.threshold if gc is not None else None
+        residuals = []
+        if thr is not None:
+            if tr._residuals is None:
+                tr._residuals = tr._init_residuals(built["bk"])
+            residuals = tr._residuals
+        scaler = {}
+        window = 0
+        if policy == "fp16":
+            st = tr._ensure_scaler()
+            window = st["window"]  # a python int, set at creation
+            scaler = {"scale": st["scale"], "good": st["good"]}
+
+        opt_.rescale_grad = tr._scale / bs
+        # snapshot the schedule counters: the program traces lazily on
+        # its first call below, and a trace-time failure routes step()
+        # to the fallback path whose Trainer.step counts the SAME step
+        # again — without rollback num_update would be off by one
+        # forever (lr schedules, Adam bias correction)
+        prev_nu = opt_.num_update
+        prev_counts = {i: opt_._index_update_count.get(i) for i in idx}
+        for i in idx:
+            opt_._update_count(i)
+        try:
+            return self._dispatch(built, opt_, upd, policy, thr, window,
+                                  scaler, residuals, data, label, bs)
+        except Exception:
+            opt_.num_update = prev_nu
+            for i, c in prev_counts.items():
+                if c is None:
+                    opt_._index_update_count.pop(i, None)
+                else:
+                    opt_._index_update_count[i] = c
+            raise
+
+    def _dispatch(self, built, opt_, upd, policy, thr, window, scaler,
+                  residuals, data, label, bs):
+        tr = self.trainer
+        params = built["params"]
+        gnames = built["gnames"]
+        idx = built["idx"]
+        lrs, wds, ts, counts_t = self._hyper_arrays(opt_, idx)
+        gparams = {n: params[n].list_data()[0]._data for n in gnames}
+        consts = {n: params[n].list_data()[0]._data
+                  for n in built["cnames"]}
+        aux = {n: params[n].list_data()[0]._data
+               for n in built["aux_names"]}
+        svals = [upd._state_data(upd.states[i]) for i in idx]
+
+        upd.dtype_policy = policy
+        # the key's policy component carries EVERYTHING policy-derived
+        # (fp16 folds the loss-scale window in): lookup_program's loud
+        # recompile detection compares the policy-independent tail, so a
+        # policy-derived field there would mask e.g. the f32->fp16 flip
+        pol_key = policy if policy != "fp16" else f"fp16/w{window}"
+        key = ("whole_step", pol_key, type(opt_).__name__,
+               opt_.fused_hyper_key(), idx,
+               tuple(d for _, d in built["sig"]),
+               built["uid"], thr,
+               built["bk"].sizes if thr is not None else None,
+               jax.tree_util.tree_structure(svals))
+        fn = upd.lookup_program(
+            key, lambda: self._build_fn(built, opt_, policy, thr,
+                                        window))
+
+        from .. import random as _random
+        rkey = _random.next_key()
+        on = _metrics.ENABLED
+        d0 = _metrics.step_dispatches() if on else 0.0
+        if on:
+            _metrics.XLA_LAUNCHES.inc(kind="whole_step")
+            _metrics.OPTIMIZER_STEPS.inc()
+        with trace_span("whole_step", cat="trainer"), \
+                _flight.phase_span("whole_step", cat="step",
+                                   step=tr._step_id, watch=True,
+                                   mem=True), \
+                _memory.oom_guard("wholestep.step"):
+            loss, new_aux, new_p, new_s, new_res, new_scaler, nts = fn(
+                gparams, svals, residuals, scaler, aux, consts,
+                data._data, label._data, rkey, lrs, wds, ts)
+        tr._step_id += 1
+        if on:
+            _metrics.TRAINER_STEP_DISPATCHES.set(
+                _metrics.step_dispatches() - d0)
+
+        for n in gnames:
+            params[n].list_data()[0]._set_data(new_p[n])
+        for n in built["aux_names"]:
+            params[n].list_data()[0]._set_data(new_aux[n])
+        for k, i in enumerate(idx):
+            upd.states[i] = upd._state_writeback(upd.states[i], new_s[k])
+        if thr is not None:
+            # the program returns FRESH residual arrays (functional
+            # update) — re-register so ledger attribution follows the
+            # live ones, same as the fused allreduce does
+            if _memory.ENABLED:
+                tr._residuals = [_memory.register(
+                    r, tag="compression_residual") for r in new_res]
+            else:
+                tr._residuals = list(new_res)
+        if policy == "fp16":
+            st = tr._scaler
+            st["scale"], st["good"] = new_scaler["scale"], \
+                new_scaler["good"]
+        self._ts = nts
+        self._ts_next = tuple(c + 1 for c in counts_t)
+        # mirror the device-side applied-step vector onto the trainer so
+        # save_states can persist it with the scaler (fp16 kill-resume:
+        # ts lags the schedule counts by one per skipped step)
+        tr._applied_ts = (idx, nts)
+        self._ran = True
+        return NDArray(loss, data.context)

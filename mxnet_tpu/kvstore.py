@@ -200,6 +200,19 @@ _dequantize_sum = jax.jit(_dequantize_sum_impl,
                           static_argnames=("threshold", "shapes", "dtypes"))
 
 
+def reduce_buckets_inline(flats, residuals, threshold):
+    """Pure single-process compressed bucket reduce for tracing INSIDE an
+    outer jit: quantize + residual update + dequantize, no metrics, no
+    NDArray wrapping, no dispatch of its own.  The gluon whole-step
+    compiler (`gluon/wholestep.py`) inlines this into its one-program
+    training step so 2-bit error feedback composes with whole-step
+    compilation at zero extra launches; the math (and therefore the
+    residual trajectory) is identical to the fused path's
+    `_compressed_reduce_local` program.  Returns (reduced flats, new
+    residuals, per-bucket mean |error|)."""
+    return _compressed_reduce_local_impl(flats, residuals, threshold)
+
+
 class GradientCompression:
     """Parity: `src/kvstore/gradient_compression.h:37` — holds type +
     threshold; quantize/dequantize as XLA-compiled kernels."""
@@ -295,6 +308,11 @@ class GradBucketer:
                     off += size
             return out
 
+        # pure, jit-inlinable forms (no metrics, no dispatch of their
+        # own): the whole-step compiler traces these inside its single
+        # training-step program instead of issuing the jitted wrappers
+        self.flatten_inline = _flat
+        self.unflatten_inline = _unflat
         self._flatten = jax.jit(_flat)
         self._unflatten = jax.jit(_unflat)
 

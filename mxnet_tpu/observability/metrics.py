@@ -413,8 +413,11 @@ FIT_STEP_DISPATCHES = Gauge(
 TRAINER_STEP_DISPATCHES = Gauge(
     "mxnet_trainer_step_dispatches",
     "XLA program launches + device_puts issued by the most recent "
-    "gluon Trainer.step (allreduce + optimizer; forward/backward are "
-    "outside step() and counted under xla:fwd / xla:bwd)")
+    "gluon training step.  Fused path: Trainer.step's allreduce + "
+    "optimizer (forward/backward are outside step() and counted under "
+    "xla:fwd / xla:bwd).  Whole-step path (MXNET_WHOLE_STEP=1): the "
+    "ENTIRE step — fwd+bwd+reduce+update ride one donated program "
+    "(xla:whole_step), so this gauge reads 1")
 ALLREDUCE_BUCKETS = Gauge(
     "mxnet_allreduce_buckets",
     "Gradient buckets the most recent bucketed allreduce fused into "
@@ -573,6 +576,15 @@ SERVE_BUCKET_HBM_BYTES = Gauge(
     "of the AOT executable, set once at precompile; labels are the "
     "bounded bucket-lattice set).  The multi-model HBM budgeter's "
     "per-bucket cost table — what an LRU bucket eviction would free")
+FUSED_DTYPE_RECOMPILES = Counter(
+    "mxnet_fused_dtype_policy_recompiles_total",
+    "Compiled-step program recompiles caused by a dtype-policy "
+    "(MXNET_AMP) change, by step mode (update_all / whole_step).  Each "
+    "is deliberate and LOUD (FusedUpdater.lookup_program logs it): the "
+    "alternative — silently reusing a program traced under another "
+    "precision for bf16/fp16 gradients — would train in the wrong "
+    "dtype without ever erroring.  A count that climbs every step "
+    "means something is flapping MXNET_AMP mid-run")
 COMPRESSION_ERROR = Histogram(
     "mxnet_compression_error",
     "Mean |quantization error| per gradient bucket per compressed "
@@ -709,6 +721,7 @@ def snapshot() -> dict:
         "jit_cache": {"hits": JIT_CACHE_HITS.value,
                       "misses": JIT_CACHE_MISSES.value},
         "optimizer_steps": OPTIMIZER_STEPS.value,
+        "fused_dtype_recompiles": FUSED_DTYPE_RECOMPILES.value,
         "serving": {
             "requests": SERVE_REQUESTS.value,
             "batches": SERVE_BATCHES.value,

@@ -8,6 +8,7 @@ checkpoint/resume and kvstore server-side optimizers.
 """
 from __future__ import annotations
 
+import logging
 import math
 import pickle
 from typing import Any, Dict, Optional
@@ -26,6 +27,20 @@ from .observability import metrics as _metrics
 from .observability.tracing import trace_span
 
 _REG = Registry("optimizer")
+_logger = logging.getLogger("mxnet_tpu.optimizer")
+
+
+def cast_like(new, old):
+    """Keep weights/states in their own dtype after a compiled step
+    (traced lr/wd are strong f32; the per-key path's weak python floats
+    did this implicitly).  Tolerant of None and nested tuple states.
+    Shared by FusedUpdater.update_all and the gluon whole-step compiler
+    — their bitwise-parity contract depends on identical casting."""
+    if new is None or old is None:
+        return new
+    if isinstance(old, (tuple, list)):
+        return type(old)(cast_like(n, o) for n, o in zip(new, old))
+    return new.astype(old.dtype) if hasattr(old, "dtype") else new
 
 
 def _rows_of(arr, rows):
@@ -887,9 +902,64 @@ class FusedUpdater(Updater):
     stays available and bit-identical for optimizers without a fused_step.
     """
 
+    #: compiled-step program cache bound (LRU).  Generous: a training
+    #: process legitimately holds a handful of live programs (per step
+    #: mode x dtype policy x param-group signature); what must NOT
+    #: accumulate are dead entries from recreated whole-step compilers
+    FN_CACHE_MAX = 64
+
     def __init__(self, optimizer: Optimizer):
         super().__init__(optimizer)
         self._fn_cache: Dict[Any, Any] = {}
+        # dtype policy the compiled step programs were traced under
+        # ("f32" | "bf16" | "fp16"; set from MXNET_AMP by the trainer /
+        # whole-step compiler).  It is position 1 of every program cache
+        # key, so a policy flip can never silently reuse a program traced
+        # for another precision — see lookup_program.
+        self.dtype_policy = "f32"
+
+    def lookup_program(self, key, build):
+        """Compiled-step program cache shared by update_all and the gluon
+        whole-step compiler (`gluon/wholestep.py`).
+
+        ``key`` = (step_mode, dtype_policy, *rest): step_mode names the
+        program shape ("update_all" / "whole_step"), dtype_policy the
+        MXNET_AMP precision it was traced under.  A miss whose ``rest``
+        matches a cached entry under a DIFFERENT dtype policy recompiles
+        LOUDLY — warning + FUSED_DTYPE_RECOMPILES counter — because the
+        silent failure mode here is real: reusing an f32-traced program
+        for bf16/fp16 gradients would train in the wrong precision
+        without ever erroring."""
+        fn = self._fn_cache.get(key)
+        if fn is not None:
+            self._fn_cache[key] = self._fn_cache.pop(key)  # LRU refresh
+            return fn
+        for k2 in self._fn_cache:
+            if isinstance(k2, tuple) and len(k2) >= 2 and \
+                    k2[0] == key[0] and k2[1] != key[1] and \
+                    k2[2:] == key[2:]:
+                _logger.warning(
+                    "dtype-policy change (%s -> %s): recompiling the %s "
+                    "fused program — the %s-traced program is NOT reused",
+                    k2[1], key[1], key[0], k2[1])
+                if _metrics.ENABLED:
+                    # key[0] comes from the two call sites' literals
+                    # ("update_all" / "whole_step") — bounded label set
+                    _metrics.FUSED_DTYPE_RECOMPILES.inc(mode=key[0])
+                break
+        fn = build()
+        self._fn_cache[key] = fn
+        # bounded LRU: superseded programs (dead per-compiler uids,
+        # abandoned dtype policies) must not pin their jitted
+        # executables + traced-graph closures for the trainer's
+        # lifetime; evicting a LIVE entry only costs a retrace
+        while len(self._fn_cache) > self.FN_CACHE_MAX:
+            evicted = next(iter(self._fn_cache))
+            del self._fn_cache[evicted]
+            _logger.info("fused program cache full (%d): evicted LRU "
+                         "entry %s/%s", self.FN_CACHE_MAX,
+                         evicted[0], evicted[1])
+        return fn
 
     @staticmethod
     def _state_data(state):
@@ -920,6 +990,12 @@ class FusedUpdater(Updater):
 
     def hyper_arrays(self, indices):
         """Device-cached (lrs, wds, ts, commit_ts) for a key tuple.
+
+        NOTE: gluon/wholestep.py's WholeStepCompiler._hyper_arrays
+        mirrors this caching scheme (plus a checkpointed applied-ts
+        precedence branch for fp16 skip-steps) — a behavioral change
+        here must be mirrored there for fused/whole-step optimizer
+        state to stay interchangeable.
 
         Through the tunnel every fresh host->device transfer costs a
         latency hop on the hot path, so lr/wd re-upload only when a
@@ -1038,29 +1114,21 @@ class FusedUpdater(Updater):
         views = tuple(grad_views) if grad_views is not None else None
 
         # dispatch-stability key: identity of the compiled step is pinned
-        # on (optimizer, hypers, key tuple, dtypes, shardings, state
-        # treedef, bucket views) — any drift re-selects a cached program
-        # instead of silently retracing under the same entry
-        key = (type(opt_).__name__, opt_.fused_hyper_key(), tuple(indices),
+        # on (step mode, dtype policy, optimizer, hypers, key tuple,
+        # dtypes, shardings, state treedef, bucket views) — any drift
+        # re-selects a cached program instead of silently retracing under
+        # the same entry, and a dtype-policy flip recompiles loudly
+        # (lookup_program)
+        key = ("update_all", self.dtype_policy,
+               type(opt_).__name__, opt_.fused_hyper_key(), tuple(indices),
                tuple(str(w.dtype) for w in wvals),
                tuple(str(g.dtype) for g in gvals),
                tuple(str(getattr(w, "sharding", None)) for w in wvals),
                jax.tree_util.tree_structure(svals), views,
                bool(donate_weights))
-        fn = self._fn_cache.get(key)
-        if fn is None:
-            idx = list(indices)
 
-            def _cast_like(new, old):
-                # traced lr/wd are strong f32 — keep weights/states in their
-                # own dtype (the per-key path's weak python floats did this
-                # implicitly)
-                if new is None or old is None:
-                    return new
-                if isinstance(old, (tuple, list)):
-                    return type(old)(_cast_like(n, o)
-                                     for n, o in zip(new, old))
-                return new.astype(old.dtype) if hasattr(old, "dtype") else new
+        def _build():
+            idx = list(indices)
 
             def _apply(wv, gv, sv, lrs, wds, ts):
                 nws, nss = [], []
@@ -1073,8 +1141,8 @@ class FusedUpdater(Updater):
                         g_k = gv[k]
                     nw, ns = opt_._fused_step_mp(idx[k], wv[k], g_k, sv[k],
                                                  lrs[k], wds[k], ts[k])
-                    nws.append(_cast_like(nw, wv[k]))
-                    nss.append(_cast_like(ns, sv[k]))
+                    nws.append(cast_like(nw, wv[k]))
+                    nss.append(cast_like(ns, sv[k]))
                 return nws, nss, ts + 1
 
             # donate states (owned exclusively by this updater, aliased to
@@ -1083,9 +1151,10 @@ class FusedUpdater(Updater):
             # still alias their buffers in the general case.  Flat grad
             # buckets are NOT donated: no output shares their shape, so
             # donation could never alias and would only warn.
-            fn = jax.jit(_apply,
-                         donate_argnums=(0, 2) if donate_weights else (2,))
-            self._fn_cache[key] = fn
+            return jax.jit(_apply,
+                           donate_argnums=(0, 2) if donate_weights else (2,))
+
+        fn = self.lookup_program(key, _build)
         if _metrics.ENABLED:
             _metrics.XLA_LAUNCHES.inc(kind="optimizer")
             _metrics.OPTIMIZER_STEPS.inc()

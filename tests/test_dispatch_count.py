@@ -541,6 +541,104 @@ def test_bucketed_allreduce_is_storeless():
         sorted(map(str, trainer._kv._store))
 
 
+# -- Gluon whole-step compilation (ISSUE 10) ----------------------------
+
+
+def _wholestep_stepper(net, batch=8, nin=16, compression=None,
+                       loss_fn=None):
+    """WholeStepCompiler step closure over `net` (same steady-state
+    discipline as _gluon_stepper: one trainer/compiler across warmup
+    and the measured window)."""
+    from mxnet_tpu import gluon
+    from mxnet_tpu.gluon.wholestep import WholeStepCompiler
+    rs = np.random.RandomState(0)
+    x = mx.nd.array(rs.normal(0, 1, (batch, nin)).astype("f"))
+    y = mx.nd.array(rs.normal(0, 1, (batch, 1)).astype("f"))
+    loss_fn = loss_fn or gluon.loss.L2Loss()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.05, "momentum": 0.9},
+                            kvstore="tpu_sync", update_on_kvstore=False,
+                            compression_params=compression)
+    st = WholeStepCompiler(net, loss_fn, trainer)
+    return st, lambda: st.step(x, y)
+
+
+def _wholestep_steady_per_step(net, warmup=3, n=3, compression=None,
+                               loss_fn=None):
+    from mxnet_tpu import observability as obs
+    st, step = _wholestep_stepper(net, compression=compression,
+                                  loss_fn=loss_fn)
+    for _ in range(warmup):
+        step()
+    c0 = obs.dispatch_counts()
+    for _ in range(n):
+        step()
+    c1 = obs.dispatch_counts()
+    return st, {k: (c1.get(k, 0) - c0.get(k, 0)) / n
+                for k in c1 if c1.get(k, 0) != c0.get(k, 0)}
+
+
+@pytest.mark.perf_smoke
+def test_wholestep_dispatch_budget(monkeypatch):
+    """ISSUE 10 acceptance gate: MXNET_WHOLE_STEP=1 runs a dense
+    hybridized step as ONE donated XLA program — <= 2 steady-state
+    dispatches (measured exactly 1: xla:whole_step), 0 device_puts,
+    and the TRAINER_STEP_DISPATCHES gauge keeps telling the truth."""
+    monkeypatch.setenv("MXNET_WHOLE_STEP", "1")
+    from mxnet_tpu.observability import metrics as m
+    net = _gluon_mlp(depth=9)   # 20 params
+    st, per_step = _wholestep_steady_per_step(net)
+    assert st.active, st.fallback_reason
+    assert per_step.get("device_put", 0) == 0, per_step
+    assert per_step.get("total", 99) <= 2.0, per_step
+    assert per_step.get("xla:whole_step", 0) >= 1.0, per_step
+    assert m.TRAINER_STEP_DISPATCHES.get() <= 2.0
+
+
+@pytest.mark.perf_smoke
+def test_wholestep_dispatch_is_param_count_independent(monkeypatch):
+    monkeypatch.setenv("MXNET_WHOLE_STEP", "1")
+    st_s, small = _wholestep_steady_per_step(_gluon_mlp(depth=4))
+    st_b, big = _wholestep_steady_per_step(_gluon_mlp(depth=9))
+    # both must really be on the whole-step program — the fused
+    # fallback is ALSO param-count independent, so without this the
+    # comparison passes vacuously with the feature dead
+    assert st_s.active, st_s.fallback_reason
+    assert st_b.active, st_b.fallback_reason
+    assert big.get("total", 0) <= small.get("total", 0) + 0.01, \
+        (small, big)
+
+
+@pytest.mark.perf_smoke
+def test_wholestep_compressed_dispatch_budget(monkeypatch):
+    """2-bit compression composes with whole-step at ZERO extra
+    launches: quantize/dequantize + residual update trace into the
+    same single program (vs +1 program on the fused path)."""
+    monkeypatch.setenv("MXNET_WHOLE_STEP", "1")
+    net = _gluon_mlp(depth=9)
+    st, per_step = _wholestep_steady_per_step(
+        net, compression={"type": "2bit", "threshold": 0.5})
+    assert st.active, st.fallback_reason
+    assert per_step.get("device_put", 0) == 0, per_step
+    assert per_step.get("total", 99) <= 2.0, per_step
+
+
+@pytest.mark.perf_smoke
+def test_wholestep_fallback_dispatch_budget(monkeypatch):
+    """An ineligible construct (eager-only loss) must land on the PR 2
+    fused path and keep ITS budget: <= 4 steady-state dispatches."""
+    monkeypatch.setenv("MXNET_WHOLE_STEP", "1")
+
+    def plain_loss(pred, label):  # .mean(): no Symbol support -> fallback
+        return ((pred - label) ** 2).mean(axis=1) / 2
+
+    net = _gluon_mlp(depth=9)
+    st, per_step = _wholestep_steady_per_step(net, loss_fn=plain_loss)
+    assert not st.active
+    assert per_step.get("device_put", 0) == 0, per_step
+    assert per_step.get("total", 99) <= 4.0, per_step
+
+
 def test_explicit_update_on_kvstore_without_store_raises():
     """update_on_kvstore=True with no kvstore must raise, not silently
     train on local updaters (parity: reference Trainer)."""
