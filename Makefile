@@ -6,7 +6,7 @@ SRCS := src/runtime/storage.cc src/runtime/engine.cc \
         src/runtime/recordio.cc src/runtime/prefetch.cc
 LIB := mxnet_tpu/_native/libmxtpu_runtime.so
 
-.PHONY: native test chaos lint-graft clean cpp_example predict_capi capi_example
+.PHONY: native test chaos chaos-train lint-graft clean cpp_example predict_capi capi_example
 
 native: $(LIB)
 
@@ -77,6 +77,17 @@ test: native
 # (-m 'not slow') skips.  docs/serving_resilience.md is the guide.
 chaos:
 	JAX_PLATFORMS=cpu python -m pytest tests/ -q -m chaos
+
+# the training-side chaos drills (ISSUE 12,
+# docs/training_resilience.md): supervisor retry/watchdog suites,
+# prefetcher fault containment, checkpoint restore diagnostics +
+# preemption — the full files, chaos-marked legs included
+# (MXNET_CHECKPOINT_FSYNC=0: the SIGKILL/SIGTERM subprocess drills
+# write real checkpoints; atomicity holds without the fsyncs).
+chaos-train:
+	JAX_PLATFORMS=cpu MXNET_CHECKPOINT_FSYNC=0 python -m pytest \
+	    tests/test_supervisor.py tests/test_prefetcher.py \
+	    tests/test_faultinject.py tests/test_checkpoint.py -q
 
 # graft-lint: the repo-specific static analysis gate (ISSUE 7,
 # docs/static_analysis.md).  Exit nonzero on any non-baselined finding

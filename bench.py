@@ -102,6 +102,8 @@ def _emit(partial):
         out["fused_step"] = _STATE["fused_step"]
     if _STATE.get("gluon_trainer") is not None:
         out["gluon_trainer"] = _STATE["gluon_trainer"]
+    if _STATE.get("wholestep") is not None:
+        out["wholestep"] = _STATE["wholestep"]
     if _STATE.get("inference") is not None:
         out["inference"] = _STATE["inference"]
     if _STATE.get("checkpoint") is not None:
@@ -114,6 +116,8 @@ def _emit(partial):
         out["flight"] = _STATE["flight"]
     if _STATE.get("memory") is not None:
         out["memory"] = _STATE["memory"]
+    if _STATE.get("chaos") is not None:
+        out["chaos"] = _STATE["chaos"]
     if partial:
         out["partial"] = True
         out["phase"] = _STATE["phase"]
@@ -441,6 +445,20 @@ def _run():
             _STATE["memory"] = _memory_leg(mx, ctx)
         except Exception as e:  # noqa: BLE001
             _STATE["memory"] = {
+                "error": "%s: %s" % (type(e).__name__, str(e)[:200])}
+
+    # chaos rider (ISSUE 12; MXT_BENCH_CHAOS=0 skips): TrainingSupervisor
+    # overhead on the fused trainer step (supervised vs bare steps/s,
+    # per-step paired interleave + amortized snapshot cost, acceptance
+    # <= 2%) and the recovery latency of a snapshot-restore-replay
+    # retry under an injected transient trainer.step failure
+    # (docs/training_resilience.md) — same durability contract
+    if os.environ.get("MXT_BENCH_CHAOS", "1") != "0":
+        _phase("chaos", EPOCH_S)
+        try:
+            _STATE["chaos"] = _chaos_leg(mx, ctx)
+        except Exception as e:  # noqa: BLE001
+            _STATE["chaos"] = {
                 "error": "%s: %s" % (type(e).__name__, str(e)[:200])}
 
 
@@ -1081,6 +1099,153 @@ def _memory_leg(mx, ctx):
         "untagged_bytes": summ["untagged_bytes"],
         "tracked_bytes": summ["tracked_bytes"],
         "peak_by_tag": summ["peak_by_tag"],
+    }
+
+
+def _chaos_leg(mx, ctx):
+    """TrainingSupervisor overhead + recovery latency
+    (docs/training_resilience.md): the same fused-trainer step measured
+    supervised vs bare — PER-STEP paired interleave (median of
+    adjacent-pair deltas, the memory-rider methodology: a 2% budget is
+    below this container's window drift) — plus the amortized rolling-
+    snapshot cost (measured directly, divided by the snapshot interval;
+    the paired median alone would hide a 1-in-N boundary outlier) and
+    the wall-clock of one snapshot-restore-replay recovery under an
+    injected transient trainer.step failure.  Acceptance:
+    overhead_pct + snapshot_amortized_pct <= 2.
+
+    The supervisor's steady-state cost is a FIXED ~0.1-0.2 ms/step (two
+    worker-thread context switches for the stall guard; reported as
+    overhead_fixed_ms) — so the budget is evaluated at a training-
+    representative step duration (bs=1024, ~12 ms/step on this
+    container; real accelerator steps are tens of ms).  For ms-scale
+    steps where the fixed cost would bite,
+    MXNET_SUPERVISE_STALL_FACTOR=0 runs steps inline (no hop; retry +
+    divergence watchdog keep working) — docs/training_resilience.md."""
+    import tempfile
+
+    from mxnet_tpu import autograd, faultinject, gluon
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu.gluon.supervisor import TrainingSupervisor
+    from mxnet_tpu.observability import metrics as _m
+
+    rs = np.random.RandomState(0)
+    bs, steps = 1024, 30
+    snapshot_steps = 50  # the MXNET_SUPERVISE_SNAPSHOT_STEPS default
+    x = mx.nd.array(rs.normal(0, 1, (bs, 64)).astype("f"), ctx=ctx)
+    y = mx.nd.array(rs.normal(0, 1, (bs, 1)).astype("f"), ctx=ctx)
+    loss_fn = gluon.loss.L2Loss()
+    net = nn.HybridSequential()
+    with net.name_scope():
+        for _ in range(9):
+            net.add(nn.Dense(64, activation="relu"))
+        net.add(nn.Dense(1))
+    net.hybridize()
+    net.initialize(mx.init.Xavier(), ctx=ctx)
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.01, "momentum": 0.9},
+                            kvstore="tpu_sync", update_on_kvstore=False)
+
+    def one_step(x, y):
+        with autograd.record():
+            l = loss_fn(net(x), y)
+        l.backward()
+        trainer.step(bs)
+        return l
+
+    sup = TrainingSupervisor(one_step, trainer=trainer, params=net,
+                             snapshot_steps=snapshot_steps)
+
+    def timed(fn):
+        t0 = time.perf_counter()
+        last = fn(x, y)
+        float(last.asnumpy().ravel()[0])
+        return time.perf_counter() - t0
+
+    tmp_dir = tempfile.mkdtemp(prefix="mxt-bench-chaos-")
+    prev_dir = os.environ.get("MXNET_FLIGHT_DIR")
+    os.environ["MXNET_FLIGHT_DIR"] = tmp_dir
+    try:
+        # warm compiles/allocator for both arms (also warms the
+        # supervisor's EWMA + takes the first snapshots)
+        for _ in range(steps):
+            timed(sup.step)
+            timed(one_step)
+        # PER-STEP paired interleave, alternating pair order — both
+        # arms advance ONE shared trajectory, so each adjacent pair
+        # sees the same machine state and the same step shape
+        deltas, sup_times, bare_times = [], [], []
+        for i in range(5 * steps):
+            first_sup = i % 2 == 0
+            for is_sup in ((True, False) if first_sup else (False, True)):
+                dt = timed(sup.step if is_sup else one_step)
+                (sup_times if is_sup else bare_times).append(dt)
+            deltas.append(sup_times[-1] - bare_times[-1])
+        bare_med = float(np.median(bare_times))
+        # the snapshot cost, measured directly and amortized over the
+        # interval (the paired MEDIAN is deliberately robust to the
+        # 1-in-snapshot_steps boundary outlier, so it would hide it).
+        # Probing clears the replay window, so rebuild a real one
+        # before the recovery measurement below.
+        snap_s = []
+        for _ in range(5):
+            sup._snap = None  # force a capture at the next check
+            t0 = time.perf_counter()
+            sup._maybe_snapshot()
+            snap_s.append(time.perf_counter() - t0)
+        snap_med = float(np.median(snap_s))
+        # recovery latency: one injected transient -> restore + replay
+        # of the ACTUAL window + re-execute.  Advance past the probe so
+        # the window holds a real replay span (a snapshot boundary
+        # crossing may shorten it; the JSON reports the true length —
+        # worst case at a fault is snapshot_steps-1)
+        for _ in range(snapshot_steps // 2):
+            sup.step(x, y)
+        replayed = len(sup._window)
+        retries0 = _m.SUPERVISOR_RETRIES.value
+        plan = faultinject.FaultPlan().add("trainer.step", "raise",
+                                           exc=OSError, times=1)
+        with faultinject.active(plan):
+            t0 = time.perf_counter()
+            l = sup.step(x, y)
+            float(l.asnumpy().ravel()[0])
+            recovery_s = time.perf_counter() - t0
+        assert plan.stats().get("trainer.step") == 1
+        assert _m.SUPERVISOR_RETRIES.value == retries0 + 1
+    finally:
+        sup.close()
+        if prev_dir is None:
+            os.environ.pop("MXNET_FLIGHT_DIR", None)
+        else:
+            os.environ["MXNET_FLIGHT_DIR"] = prev_dir
+        import shutil
+        shutil.rmtree(tmp_dir, ignore_errors=True)
+    # best-of-3 over round-sized chunks (the riders' shared noise
+    # discipline), plus the amortized snapshot cost the median hides
+    overhead_pct = 0.0
+    if deltas:
+        third = max(1, len(deltas) // 3)
+        overhead_pct = min(
+            float(np.median(deltas[i:i + third])) / bare_med * 100.0
+            for i in range(0, len(deltas), third))
+    snap_amortized_pct = snap_med / snapshot_steps / bare_med * 100.0
+    total_pct = overhead_pct + snap_amortized_pct
+    fixed_ms = float(np.median(deltas)) * 1e3
+    return {
+        "steps_per_s_supervised": round(1.0 / float(np.median(sup_times)),
+                                        2),
+        "steps_per_s_bare": round(1.0 / bare_med, 2),
+        "overhead_fixed_ms": round(fixed_ms, 3),
+        "overhead_pct": round(overhead_pct, 2),
+        "snapshot_ms": round(snap_med * 1e3, 3),
+        "snapshot_interval": snapshot_steps,
+        "snapshot_amortized_pct": round(snap_amortized_pct, 2),
+        "total_overhead_pct": round(total_pct, 2),
+        "overhead_budget_pct": 2.0,
+        "ok": total_pct <= 2.0,
+        "recovery_ms": round(recovery_s * 1e3, 1),
+        "recovery_replay_steps": replayed,
+        "supervisor": sup.stats(),
     }
 
 

@@ -55,6 +55,7 @@ from . import gluon
 from . import observability
 from . import analysis
 from . import faultinject
+from . import resilience
 from . import profiler
 from . import monitor
 from . import monitor as mon

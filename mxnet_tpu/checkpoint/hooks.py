@@ -54,6 +54,26 @@ class _PreemptionHook:
             self.manager.wait(timeout=300)
         except Exception as e:  # noqa: BLE001 — dying anyway; log, don't mask
             log.error("preemption-hook save failed: %s", e)
+        finally:
+            # a SIGTERM'd run leaves a TIMELINE, not just weights: the
+            # flight ring holds the last ~MXNET_FLIGHT_RING phases per
+            # thread — exactly the "what was it doing when the cloud
+            # reclaimed it" evidence.  AFTER the save (its own
+            # checkpoint_block/_write spans belong in the dump), inline
+            # (this process is exiting; no background thread survives),
+            # and never allowed to mask a save failure.
+            self._dump_flight()
+
+    @staticmethod
+    def _dump_flight() -> None:
+        try:
+            from ..observability import flight as _flight
+            if _flight.ENABLED:
+                path = _flight.dump(reason="preempt")
+                log.warning("preemption hook: flight timeline dumped to %s",
+                            path)
+        except Exception as e:  # noqa: BLE001
+            log.error("preemption-hook flight dump failed: %s", e)
 
     def _on_signal(self, signum, frame):
         self._save_once(f"signal {signum}")

@@ -51,7 +51,17 @@ _TMP_PREFIX = ".tmp-"
 
 class CheckpointInvalidError(MXNetError):
     """A checkpoint directory failed validation (torn write, missing
-    shard, CRC mismatch, unreadable manifest)."""
+    shard, CRC mismatch, unreadable manifest).  ``kind`` names the
+    rejection class — ``manifest`` (unreadable/unsupported/drifted
+    manifest), ``torn`` (missing shard / size mismatch / missing
+    entry: an incomplete write), ``crc`` (bit-rot: stored CRC32
+    disagrees), ``shard`` (shard file unreadable) — so
+    ``CheckpointManager.restore``'s exhaustion diagnostics can say WHY
+    each candidate was rejected."""
+
+    def __init__(self, msg: str, kind: str = "invalid"):
+        super().__init__(msg)
+        self.kind = kind
 
 
 def step_dirname(step: int) -> str:
@@ -240,11 +250,12 @@ def read_manifest(step_dir: str) -> dict:
             manifest = json.load(f)
     except (OSError, ValueError) as e:
         raise CheckpointInvalidError(
-            f"{step_dir}: unreadable manifest ({e})") from None
+            f"{step_dir}: unreadable manifest ({e})",
+            kind="manifest") from None
     if manifest.get("format_version") != FORMAT_VERSION:
         raise CheckpointInvalidError(
             f"{step_dir}: unsupported format_version "
-            f"{manifest.get('format_version')!r}")
+            f"{manifest.get('format_version')!r}", kind="manifest")
     return manifest
 
 
@@ -258,11 +269,12 @@ def quick_validate(step_dir: str) -> dict:
             size = os.path.getsize(path)
         except OSError:
             raise CheckpointInvalidError(
-                f"{step_dir}: missing shard {fname}") from None
+                f"{step_dir}: missing shard {fname}",
+                kind="torn") from None
         if size != info.get("bytes"):
             raise CheckpointInvalidError(
                 f"{step_dir}: shard {fname} is {size} bytes, manifest "
-                f"says {info.get('bytes')}")
+                f"says {info.get('bytes')}", kind="torn")
     return manifest
 
 
@@ -279,7 +291,8 @@ def load_checkpoint_dir(step_dir: str) -> Tuple[dict, Dict]:
                 loaded_shards[fname] = {k: z[k] for k in z.keys()}
         except Exception as e:  # noqa: BLE001 — any zip/npy damage
             raise CheckpointInvalidError(
-                f"{step_dir}: shard {fname} unreadable ({e})") from None
+                f"{step_dir}: shard {fname} unreadable ({e})",
+                kind="shard") from None
     state: Dict = {}
     for name, entry in manifest["entries"].items():
         kind = entry["kind"]
@@ -289,13 +302,14 @@ def load_checkpoint_dir(step_dir: str) -> Tuple[dict, Dict]:
         shard = loaded_shards.get(entry["shard"], {})
         if entry["key"] not in shard:
             raise CheckpointInvalidError(
-                f"{step_dir}: entry '{name}' missing from {entry['shard']}")
+                f"{step_dir}: entry '{name}' missing from {entry['shard']}",
+                kind="torn")
         arr = shard[entry["key"]]
         crc = zlib.crc32(_np.ascontiguousarray(arr).tobytes())
         if crc != entry["crc32"]:
             raise CheckpointInvalidError(
                 f"{step_dir}: CRC mismatch on '{name}' "
-                f"(stored {entry['crc32']}, computed {crc})")
+                f"(stored {entry['crc32']}, computed {crc})", kind="crc")
         if kind == "bytes":
             state[name] = arr.tobytes()
         else:
@@ -303,7 +317,7 @@ def load_checkpoint_dir(step_dir: str) -> Tuple[dict, Dict]:
                     str(arr.dtype) != entry.get("dtype"):
                 raise CheckpointInvalidError(
                     f"{step_dir}: entry '{name}' shape/dtype drifted from "
-                    "manifest")
+                    "manifest", kind="manifest")
             state[name] = arr
     return manifest, state
 

@@ -399,11 +399,18 @@ class CheckpointManager:
         ``step`` raises ``CheckpointInvalidError`` loudly instead — the
         caller named a checkpoint, silently loading a different one
         would be a correctness bug.  Returns ``(step, state)`` (or
-        ``(step, state, manifest)``) — ``None`` when nothing valid
-        exists."""
+        ``(step, state, manifest)``) — ``None`` when the directory
+        holds NO ``step_N`` candidates at all (the fresh-start case
+        ``restore_or_initialize`` keys on).  When candidates exist but
+        every one is invalid, raises ``CheckpointError`` listing each
+        step scanned and why it was rejected (torn / crc / manifest /
+        shard) — a directory FULL of damaged checkpoints is storage
+        trouble the operator must see, not a silent fresh start that
+        quietly discards the run."""
         self.wait()
         candidates = [int(step)] if step is not None \
             else sorted(_layout.raw_steps(self.directory), reverse=True)
+        rejected: List[Tuple[int, str, str]] = []
         for cand in candidates:
             path = os.path.join(self.directory, _layout.step_dirname(cand))
             t0 = time.perf_counter()
@@ -415,6 +422,8 @@ class CheckpointManager:
                         stage="restore", reason="invalid")
                 if step is not None:
                     raise
+                rejected.append((cand, getattr(e, "kind", "invalid"),
+                                 str(e)))
                 log.warning("skipping invalid checkpoint %s: %s", path, e)
                 continue
             if _metrics.ENABLED:
@@ -423,6 +432,15 @@ class CheckpointManager:
             if with_manifest:
                 return cand, state, manifest
             return cand, state
+        if rejected:
+            lines = "\n".join(
+                f"  step {s}: [{kind}] {msg}" for s, kind, msg in rejected)
+            raise CheckpointError(
+                f"no valid checkpoint in {self.directory}: scanned "
+                f"{len(rejected)} candidate(s) newest-first and rejected "
+                f"every one —\n{lines}\n(torn = incomplete write, crc = "
+                "bit-rot, manifest/shard = unreadable metadata or "
+                "payload; see docs/checkpointing.md)")
         return None
 
 

@@ -38,6 +38,29 @@ docs/serving_resilience.md):
                           post-mortem (catch → ledger+ring dump → typed
                           ``DeviceMemoryError``) is chaos-testable with no
                           real HBM pressure
+  ``trainer.step``        every Gluon training step — ``Trainer._step``
+                          on the fused/legacy paths AND
+                          ``WholeStepCompiler._run`` on the whole-step
+                          path, exactly once per step (raise = failed
+                          step the ``TrainingSupervisor`` classifies and
+                          retries; delay = slow step that feeds the stall
+                          watchdog EWMA) — docs/training_resilience.md
+  ``data.batch``          ``AsyncPrefetcher`` worker, before each source
+                          read (raise ``OSError`` = transient IO the
+                          worker respawns once over; raise
+                          ``DataCorruptionError`` = corrupt record the
+                          ``MXNET_DATA_SKIP_BUDGET`` consumes)
+  ``kvstore.allreduce``   ``KVStore.allreduce`` entry — the fused
+                          Trainer's bucketed gradient reduce (raise =
+                          failed collective; whole-step mode inlines the
+                          reduce into the donated program, so this site
+                          only fires on the fused/legacy paths)
+  ``device.unavailable``  the training dispatch chokepoints
+                          (``WholeStepCompiler._dispatch``, the fused
+                          update) — a ``raise`` rule defaults to the
+                          typed ``DeviceUnavailableError`` (classified
+                          transient), modeling a dropped TPU tunnel with
+                          no real device loss
   ==================================================================
 
 Configuration is API- or env-driven::
@@ -64,6 +87,7 @@ from typing import Callable, Dict, List, Optional
 
 from .base import MXNetError
 from .observability import metrics as _metrics
+from .resilience import DataCorruptionError, DeviceUnavailableError
 
 __all__ = ["InjectedFault", "FaultRule", "FaultPlan", "parse_plan",
            "install", "install_from_env", "clear", "active", "plan",
@@ -76,7 +100,8 @@ ENV_VAR = "MXNET_FAULT_PLAN"
 #: the named sites the runtime has wired (fire() accepts any name — new
 #: sites need no registration — but these are the documented ones)
 SITES = ("serving.dispatch", "serving.batcher", "serving.hot_reload",
-         "checkpoint.io", "memory.oom")
+         "checkpoint.io", "memory.oom", "trainer.step", "data.batch",
+         "kvstore.allreduce", "device.unavailable")
 
 _MODES = ("raise", "delay", "corrupt")
 
@@ -97,6 +122,11 @@ _EXC_TYPES: Dict[str, type] = {
     "IOError": IOError,
     "RuntimeError": RuntimeError,
     "TimeoutError": TimeoutError,
+    # the training-resilience taxonomy (mxnet_tpu.resilience): a
+    # transient device loss and a corrupt input record, so a chaos plan
+    # can drive the supervisor retry and the data skip budget by name
+    "DeviceUnavailableError": DeviceUnavailableError,
+    "DataCorruptionError": DataCorruptionError,
 }
 
 
@@ -137,6 +167,11 @@ class FaultRule:
         self.site = str(site)
         self.mode = mode
         self.delay_s = float(delay_s)
+        if exc is InjectedFault and self.site == "device.unavailable":
+            # the site's whole point is modeling a transient device
+            # loss — default its raise rules to the typed error the
+            # resilience classifier maps to "transient"
+            exc = DeviceUnavailableError
         self.exc = exc
         self.message = message
         self.times = times
@@ -288,18 +323,24 @@ def active(plan_: FaultPlan):
 # ---------------------------------------------------------------------------
 def parse_plan(spec: str) -> FaultPlan:
     """Parse the ``MXNET_FAULT_PLAN`` syntax: rules separated by ``;``
-    (or ``,``), each ``site:mode[:arg][:times]``::
+    (or ``,``), each ``site:mode[:arg][:times[:after]]``::
 
         serving.dispatch:delay:0.05        # 50 ms delay, every dispatch
         serving.batcher:raise              # InjectedFault, every group
         checkpoint.io:raise:OSError:2      # OSError on the first 2 writes
         checkpoint.io:corrupt:1            # corrupt the first commit
+        trainer.step:raise:OSError:1:6     # fail exactly the 7th step
 
     ``arg`` is seconds for ``delay`` and an exception name for ``raise``
     (InjectedFault, MXNetError, OSError, IOError, RuntimeError,
-    TimeoutError); for ``corrupt`` the slot holds ``times`` directly.
-    Malformed specs raise loudly — a silently-ignored typo would make a
-    chaos drill pass vacuously."""
+    TimeoutError, DeviceUnavailableError, DataCorruptionError; a bare
+    ``device.unavailable:raise`` defaults to DeviceUnavailableError);
+    for ``corrupt`` the first optional slot holds ``times`` directly.
+    ``after`` skips that many matching occurrences first (the
+    ``FaultRule`` window, so an env-driven drill can hit exactly the
+    Nth step/dispatch).  Malformed specs — unknown tokens and TRAILING
+    EXTRAS included — raise loudly: a silently-ignored field would make
+    a chaos drill pass vacuously."""
     out = FaultPlan()
     for token in spec.replace(";", ",").split(","):
         token = token.strip()
@@ -315,8 +356,6 @@ def parse_plan(spec: str) -> FaultPlan:
                 if not rest:
                     raise ValueError("delay needs seconds")
                 kw = {"delay_s": float(rest[0])}
-                if len(rest) > 1:
-                    kw["times"] = int(rest[1])
             elif mode == "raise":
                 kw = {}
                 if rest:
@@ -325,12 +364,20 @@ def parse_plan(spec: str) -> FaultPlan:
                             f"unknown exception {rest[0]!r} (have "
                             f"{sorted(_EXC_TYPES)})")
                     kw["exc"] = _EXC_TYPES[rest[0]]
-                if len(rest) > 1:
-                    kw["times"] = int(rest[1])
             elif mode == "corrupt":
-                kw = {"times": int(rest[0])} if rest else {}
+                # corrupt has no arg slot: times/after shift left one
+                kw = {}
+                rest = [None] + rest
             else:
                 raise ValueError(f"unknown mode {mode!r}")
+            if len(rest) > 1:
+                kw["times"] = int(rest[1])
+            if len(rest) > 2:
+                kw["after"] = int(rest[2])
+            if len(rest) > 3:
+                raise ValueError(
+                    f"trailing fields {rest[3:]} (syntax is "
+                    "site:mode[:arg][:times[:after]])")
         except ValueError as e:
             raise MXNetError(f"{ENV_VAR}: bad rule {token!r}: {e}") from None
         out.add(site, mode, **kw)

@@ -4,6 +4,8 @@ from .block import Block, HybridBlock, SymbolBlock, CachedOp
 from .trainer import Trainer
 from . import wholestep
 from .wholestep import WholeStepCompiler
+from . import supervisor
+from .supervisor import TrainingSupervisor
 from . import nn
 from . import rnn
 from . import loss

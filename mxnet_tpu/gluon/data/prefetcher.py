@@ -16,13 +16,21 @@ iterable (DataLoader, DataIter, generator).
 """
 from __future__ import annotations
 
+import logging
 import queue as _queue
 import threading
 import time as _time
 
+from ...analysis import sanitizer as _san
+from ...base import getenv
+from ...faultinject import fire as _fi_fire
 from ...observability import flight as _flight
 from ...observability import memory as _memory
 from ...observability import metrics as _metrics
+from ...resilience import (DataCorruptionError, DataSkipBudgetError,
+                           classify as _classify, TRANSIENT as _TRANSIENT)
+
+log = logging.getLogger(__name__)
 
 # end-of-stream sentinel (not None: sources may legitimately yield None)
 _END = object()
@@ -58,12 +66,33 @@ class AsyncPrefetcher:
     `transform` (e.g. device placement) still on the worker thread, and
     feeds a queue of `depth` ready batches.  Worker exceptions re-raise in
     the consumer on `get()`, followed by StopIteration — a consumer that
-    swallows the error won't hang."""
+    swallows the error won't hang.
+
+    Fault containment (ISSUE 12; docs/training_resilience.md):
+
+    * a TRANSIENT IO error from the source (resilience.classify —
+      OSError/timeout/UNAVAILABLE, or an injected `data.batch` fault)
+      respawns the worker ONCE per prefetcher after a short backoff
+      (`mxnet_prefetch_respawns_total`); a second transient surfaces to
+      the consumer exactly as before.
+    * a `DataCorruptionError` (undecodable record) is SKIPPED while the
+      `skip_budget` lasts (default `MXNET_DATA_SKIP_BUDGET`, 0 = every
+      corrupt record surfaces); each skip counts
+      `mxnet_data_records_skipped_total`, and exhausting the budget
+      surfaces a typed `DataSkipBudgetError` — one bad record can't
+      kill an epoch, but systemically damaged data still fails loudly."""
+
+    _MAX_RESPAWNS = 1
+    _RESPAWN_BACKOFF_S = 0.05
 
     def __init__(self, next_fn, depth: int = 2, transform=None,
-                 observe_wait: bool = False):
+                 observe_wait: bool = False, skip_budget=None):
         self._next_fn = next_fn
         self._transform = transform
+        self._skip_budget = int(getenv("MXNET_DATA_SKIP_BUDGET", 0)) \
+            if skip_budget is None else int(skip_budget)
+        self.respawns = 0
+        self.skipped = 0
         # prefetch_to_device consumers observe their stalls into the
         # prefetch_wait histogram; io.PrefetchingIter keeps recording
         # into DATA_WAIT_SECONDS itself — one histogram per wait, never
@@ -73,6 +102,9 @@ class AsyncPrefetcher:
         self._queue: _queue.Queue = _queue.Queue(maxsize=self._depth)
         self._stop = threading.Event()
         self._done = False
+        # guards self._thread: written by close() (consumer) AND by the
+        # respawn path (worker hands the stream to its replacement)
+        self._tlock = _san.make_lock("prefetcher.thread")
         _register(self)
         self._thread = threading.Thread(target=self._worker, daemon=True)
         self._thread.start()
@@ -80,6 +112,13 @@ class AsyncPrefetcher:
     def _worker(self) -> None:
         while not self._stop.is_set():
             try:
+                # chaos site: fires before the source read, so an
+                # injected raise models a read that failed WITHOUT
+                # consuming a record (a skip/respawn then re-reads the
+                # same record — the stream content is unchanged); a real
+                # decoder raising DataCorruptionError mid-read genuinely
+                # drops that record
+                _fi_fire("data.batch")
                 item = self._next_fn()
                 if self._transform is not None:
                     # device placement (h2d) happens HERE on the worker
@@ -93,7 +132,50 @@ class AsyncPrefetcher:
             except StopIteration:
                 self._queue.put(_END)
                 return
+            except DataCorruptionError as e:
+                if self.skipped < self._skip_budget:
+                    self.skipped += 1
+                    if _metrics.ENABLED:
+                        _metrics.DATA_RECORDS_SKIPPED.inc()
+                    log.warning(
+                        "prefetcher: skipping corrupt record (%s) — "
+                        "%d/%d of MXNET_DATA_SKIP_BUDGET used", e,
+                        self.skipped, self._skip_budget)
+                    continue
+                if self._skip_budget == 0:
+                    err: BaseException = e  # skipping never opted into
+                else:
+                    err = DataSkipBudgetError(
+                        f"corrupt-record skip budget exhausted "
+                        f"({self._skip_budget} records already skipped; "
+                        f"next: {e}) — the input data is damaged beyond "
+                        "MXNET_DATA_SKIP_BUDGET")
+                    err.__cause__ = e
+                self._queue.put(err)
+                self._queue.put(_END)
+                return
             except BaseException as e:  # surface in the consumer thread
+                if self.respawns < self._MAX_RESPAWNS and \
+                        not self._stop.is_set() and \
+                        _classify(e) is _TRANSIENT:
+                    # transient source hiccup (flaky NFS, dropped
+                    # connection, injected chaos): hand the stream to a
+                    # fresh worker once instead of killing the epoch
+                    self.respawns += 1
+                    if _metrics.ENABLED:
+                        _metrics.PREFETCH_RESPAWNS.inc()
+                    log.warning(
+                        "prefetcher: worker hit transient %s: %s — "
+                        "respawning (%d/%d)", type(e).__name__, e,
+                        self.respawns, self._MAX_RESPAWNS)
+                    _time.sleep(self._RESPAWN_BACKOFF_S)
+                    t = threading.Thread(target=self._worker, daemon=True)
+                    with self._tlock:
+                        if self._stop.is_set():
+                            return  # closed during the backoff window
+                        self._thread = t
+                    t.start()
+                    return
                 self._queue.put(e)
                 self._queue.put(_END)
                 return
@@ -129,10 +211,11 @@ class AsyncPrefetcher:
                 self._queue.get_nowait()
         except _queue.Empty:
             pass
-        t = self._thread
+        with self._tlock:
+            t = self._thread
+            self._thread = None
         if t is not None and t.is_alive():
             t.join(timeout=5)
-        self._thread = None
 
     def __del__(self):
         try:
@@ -203,9 +286,11 @@ class _DevicePrefetchIter:
     """Iterator returned by prefetch_to_device: double-buffers device
     placement of upcoming batches in a background thread."""
 
-    def __init__(self, source, depth: int = 2, device=None):
+    def __init__(self, source, depth: int = 2, device=None,
+                 skip_budget=None):
         self._source = source
         self._depth = depth
+        self._skip_budget = skip_budget
         self._dev, self._ctx = _resolve_device(device)
         self._pf = None
         self._start()
@@ -217,7 +302,7 @@ class _DevicePrefetchIter:
         self._pf = AsyncPrefetcher(
             next_fn, depth=self._depth,
             transform=lambda b: _device_put_batch(b, self._dev, self._ctx),
-            observe_wait=True)
+            observe_wait=True, skip_budget=self._skip_budget)
 
     def __iter__(self):
         return self
@@ -257,7 +342,8 @@ class _DevicePrefetchIter:
             pass
 
 
-def prefetch_to_device(data_iter, depth: int = 2, device=None):
+def prefetch_to_device(data_iter, depth: int = 2, device=None,
+                       skip_budget=None):
     """Wrap a batch iterable so the next `depth` batches are device-resident
     before the training loop asks for them.
 
@@ -265,5 +351,8 @@ def prefetch_to_device(data_iter, depth: int = 2, device=None):
     ...     trainer.step(...)   # batch N+1 uploads while step N runs
 
     device: a Context, a jax.Device, or None (the current context's device).
+    skip_budget: corrupt-record tolerance (default MXNET_DATA_SKIP_BUDGET)
+    — see AsyncPrefetcher.
     """
-    return _DevicePrefetchIter(data_iter, depth=depth, device=device)
+    return _DevicePrefetchIter(data_iter, depth=depth, device=device,
+                               skip_budget=skip_budget)

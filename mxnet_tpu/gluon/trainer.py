@@ -29,6 +29,7 @@ import numpy as _np
 
 from ..analysis import hot_path
 from ..base import MXNetError, getenv
+from ..faultinject import fire as _fi_fire
 from ..ndarray import NDArray
 from ..observability import flight as _flight
 from ..observability import memory as _memory
@@ -196,6 +197,12 @@ class Trainer:
     def _step(self, batch_size, ignore_stale_grad=False):
         if not self._kv_initialized:
             self._init_kvstore()
+        # chaos site (one global read when no plan): fires BEFORE any
+        # param/optimizer mutation, so an injected raise models a step
+        # that failed without consuming state — the TrainingSupervisor
+        # classifies it transient and retries (whole-step mode fires the
+        # same site in WholeStepCompiler._run; exactly one per step)
+        _fi_fire("trainer.step", step=self._step_id)
         self._optimizer.rescale_grad = self._scale / batch_size
         live = [(i, p) for i, p in enumerate(self._params)
                 if p.grad_req != "null"]

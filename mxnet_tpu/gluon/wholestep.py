@@ -53,7 +53,10 @@ import numpy as _np
 
 from ..analysis import hot_path
 from ..base import MXNetError, getenv
+from ..faultinject import InjectedFault as _InjectedFault
+from ..faultinject import fire as _fi_fire
 from ..ndarray import NDArray
+from ..resilience import DeviceUnavailableError as _DeviceUnavailableError
 from ..observability import flight as _flight
 from ..observability import memory as _memory
 from ..observability import metrics as _metrics
@@ -251,7 +254,8 @@ class WholeStepCompiler:
             self._note_fallback(str(e))
             return self._fallback(data, label, bs)
         except Exception as e:  # noqa: BLE001 — tracing arbitrary user graphs
-            if self._ran or self._is_execution_failure(e):
+            if self._ran or self._is_execution_failure(e) \
+                    or self._is_transient(e):
                 # runtime failure (e.g. the typed OOM that
                 # memory.oom_guard re-raises after its post-mortem): the
                 # counters were rolled back by _run, but the failed call
@@ -275,9 +279,25 @@ class WholeStepCompiler:
         if isinstance(e, (_memory.DeviceMemoryError,
                           _memory.HBMBudgetError)):
             return True
+        # injected faults and transient device losses (the resilience
+        # taxonomy's "transient" class) must NEVER demote the compiler
+        # to a permanent fused fallback: the condition is recoverable —
+        # propagate so a TrainingSupervisor (or the user) can restore
+        # state and retry the same whole-step program
+        if isinstance(e, (_InjectedFault, _DeviceUnavailableError)):
+            return True
         if type(e).__name__ == "XlaRuntimeError":
             return True
-        return "RESOURCE_EXHAUSTED" in str(e)
+        return "RESOURCE_EXHAUSTED" in str(e) or "UNAVAILABLE" in str(e)
+
+    @staticmethod
+    def _is_transient(e: Exception) -> bool:
+        """The resilience taxonomy's transient class (plain OSError /
+        ConnectionError / timeout included): RECOVERABLE conditions
+        must propagate — even on the first call, before ``_ran`` —
+        never permanently demote the compiler to the fused fallback."""
+        from ..resilience import TRANSIENT, classify
+        return classify(e) is TRANSIENT
 
     __call__ = step
 
@@ -576,6 +596,11 @@ class WholeStepCompiler:
 
     def _run(self, built, data, label, bs, policy):
         tr = self.trainer
+        # chaos site, fired BEFORE the schedule counters advance and
+        # before any donated buffer is touched: an injected raise is a
+        # cleanly-retryable failed step (the fused path fires the same
+        # site in Trainer._step — exactly one per training step)
+        _fi_fire("trainer.step", step=tr._step_id)
         upd = tr._updaters[0]
         opt_ = upd.optimizer
         idx = built["idx"]
@@ -648,6 +673,10 @@ class WholeStepCompiler:
             key, lambda: self._build_fn(built, opt_, policy, thr,
                                         window))
 
+        # chaos site for transient device loss at the dispatch boundary:
+        # fires before fn() executes, so the donated buffers are still
+        # live and a supervisor restore+retry reuses the built program
+        _fi_fire("device.unavailable", step=tr._step_id)
         from .. import random as _random
         rkey = _random.next_key()
         on = _metrics.ENABLED

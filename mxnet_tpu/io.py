@@ -260,10 +260,14 @@ class PrefetchingIter(DataIter):
     Backed by the shared `gluon.data.prefetcher.AsyncPrefetcher` core.
     With `device` set (a Context or jax.Device), the worker thread also
     `jax.device_put`s each batch — the next batch is HBM-resident before
-    the training loop asks for it (prefetch-to-device)."""
+    the training loop asks for it (prefetch-to-device).  The core's
+    fault containment rides along: transient source IO errors respawn
+    the worker once, and `skip_budget` (default `MXNET_DATA_SKIP_BUDGET`)
+    skips corrupt records (`resilience.DataCorruptionError`) instead of
+    killing the epoch — docs/training_resilience.md."""
 
     def __init__(self, iters, rename_data=None, rename_label=None, depth=2,
-                 device=None):
+                 device=None, skip_budget=None):
         if not isinstance(iters, list):
             iters = [iters]
         assert len(iters) == 1, "composite prefetch of multiple iters: pass one"
@@ -273,6 +277,7 @@ class PrefetchingIter(DataIter):
         self.rename_label = rename_label
         self._depth = int(depth)
         self._device = device
+        self._skip_budget = skip_budget
         self._pf = None
         self._start()
 
@@ -299,7 +304,8 @@ class PrefetchingIter(DataIter):
             dev, ctx = _resolve_device(self._device)
             transform = lambda b: _device_put_batch(b, dev, ctx)  # noqa: E731
         self._pf = AsyncPrefetcher(self.iter.next, depth=self._depth,
-                                   transform=transform)
+                                   transform=transform,
+                                   skip_budget=self._skip_budget)
 
     def reset(self):
         self.close()

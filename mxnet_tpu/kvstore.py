@@ -43,6 +43,7 @@ import numpy as _np
 
 from .analysis import hot_path
 from .base import MXNetError, atomic_write, getenv
+from .faultinject import fire as _fi_fire
 from . import ndarray as nd
 from .ndarray import NDArray
 from .observability import memory as _memory
@@ -658,6 +659,13 @@ class KVStore:
         per-key path — fused into one program."""
         vals = [list(v) if isinstance(v, (list, tuple)) else [v]
                 for v in values]
+        # chaos site: a raise here models a failed gradient collective
+        # (dropped pod peer, tunnel loss).  Fires BEFORE any reduce
+        # work, so residuals/buckets are untouched and the supervisor's
+        # snapshot retry re-executes the step cleanly.  (Whole-step mode
+        # inlines the reduce into the donated program — this site only
+        # fires on the fused/legacy paths.)
+        _fi_fire("kvstore.allreduce", values=len(vals))
         if compression is not None and not isinstance(
                 compression, GradientCompression):
             compression = GradientCompression(**compression)

@@ -132,7 +132,7 @@ class BaseModule:
             allow_missing=False, force_rebind=False, force_init=False,
             begin_epoch=0, num_epoch=None, validation_metric=None,
             monitor=None, checkpoint_dir=None, checkpoint_period=1,
-            checkpoint_max_keep=None):
+            checkpoint_max_keep=None, supervise=False):
         """Train loop (parity: base_module.py:376-487).
 
         ``checkpoint_dir`` opts into the fault-tolerant checkpoint
@@ -141,7 +141,14 @@ class BaseModule:
         ``begin_epoch`` advances to the saved epoch), saves one atomic
         async checkpoint every ``checkpoint_period`` epochs, keeps the
         newest ``checkpoint_max_keep`` (None = all), and barriers on
-        outstanding writes before returning."""
+        outstanding writes before returning.
+
+        ``supervise=True`` runs every fit step through a
+        ``gluon.TrainingSupervisor`` (docs/training_resilience.md):
+        transient step failures restore a rolling host snapshot of
+        params + optimizer state and replay; divergence and stall
+        watchdogs post-mortem and raise typed errors.  Inert under
+        ``MXNET_SUPERVISE=0``."""
         assert num_epoch is not None, "please specify number of epochs"
         from ..initializer import Uniform
         if initializer is None:
@@ -191,14 +198,21 @@ class BaseModule:
         if not isinstance(eval_metric, _metric.EvalMetric):
             eval_metric = _metric.create(eval_metric)
 
+        _sup = None
+        if supervise:
+            from ..gluon.supervisor import TrainingSupervisor
+            _sup = TrainingSupervisor.for_module(self)
+
         global_step = 0
         try:
             self._fit_epochs(
                 train_data, eval_data, eval_metric, validation_metric,
                 epoch_end_callback, batch_end_callback, eval_end_callback,
                 eval_batch_end_callback, monitor, begin_epoch, num_epoch,
-                global_step, _ckpt, checkpoint_period)
+                global_step, _ckpt, checkpoint_period, _sup)
         finally:
+            if _sup is not None:
+                _sup.close()
             if _ckpt is not None:
                 _ckpt.close()  # barrier: all queued writes committed
 
@@ -206,7 +220,8 @@ class BaseModule:
                     validation_metric, epoch_end_callback,
                     batch_end_callback, eval_end_callback,
                     eval_batch_end_callback, monitor, begin_epoch,
-                    num_epoch, global_step, _ckpt, checkpoint_period):
+                    num_epoch, global_step, _ckpt, checkpoint_period,
+                    _sup=None):
         for epoch in range(begin_epoch, num_epoch):
             tic = time.time()
             # decided ONCE per epoch: flipping the recorder on mid-epoch
@@ -233,9 +248,14 @@ class BaseModule:
                 if obs_on:
                     d0 = _obs.step_dispatches()
                 with step_span(global_step):
-                    self.forward_backward(data_batch)
-                    with trace_span("update", cat="optimizer"):
-                        self.update()
+                    if _sup is not None:
+                        # supervised: fwd/bwd/update run as ONE step_fn
+                        # under retry + divergence/stall watchdogs
+                        _sup.step(data_batch)
+                    else:
+                        self.forward_backward(data_batch)
+                        with trace_span("update", cat="optimizer"):
+                            self.update()
                 if obs_on:
                     _obs.FIT_STEP_DISPATCHES.set(_obs.step_dispatches() - d0)
                 global_step += 1

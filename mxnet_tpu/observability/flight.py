@@ -55,6 +55,7 @@ from ..analysis import sanitizer as _san
 log = logging.getLogger(__name__)
 
 __all__ = ["ENABLED", "enable", "disable", "enabled", "phase_span",
+           "watch_ewma",
            "record", "note", "now_us", "new_trace_id", "trace_scope",
            "current_trace_id", "join_ids", "records", "stats", "dump",
            "summary", "snapshot_summary", "reset", "configure"]
@@ -143,7 +144,12 @@ _epoch = 0
 _seg_lock = _san.make_rlock("flight.segments")
 _watch_lock = _san.make_lock("flight.watch")
 _watch: Dict[str, Tuple[float, int]] = {}   # name -> (ewma_s, count)
-_last_auto_dump = 0.0
+# None = no auto-dump yet (the sentinel matters: time.monotonic() can be
+# SMALLER than AUTO_DUMP_MIN_S on a freshly booted container, and a 0.0
+# seed would then swallow the first anomaly dump — the PR 9 OOM-window
+# bug class, fixed in memory.py, reproduced here by
+# tests/test_flight.py::test_autodump_rate_limited on this host)
+_last_auto_dump: Optional[float] = None
 _last_anomaly: dict = {}
 _dump_count = 0
 _last_dump_path: Optional[str] = None
@@ -310,11 +316,22 @@ def watch_state() -> Dict[str, dict]:
                 for k, (e, c) in sorted(_watch.items())}
 
 
+def watch_ewma(name: str) -> Optional[float]:
+    """The warmed EWMA (seconds) of a ``watch=True`` phase, or None
+    before ``_WARMUP`` samples.  The training stall watchdog
+    (gluon/supervisor.py) seeds its step deadline from the
+    ``trainer_step``/``whole_step`` phases through this."""
+    with _watch_lock:
+        e, c = _watch.get(name, (0.0, 0))
+    return e if c >= _WARMUP and e > 0.0 else None
+
+
 def _anomaly_dump(phase: str, dur_s: float, ewma_s: float) -> None:
     global _last_auto_dump
     now = time.monotonic()
     with _watch_lock:
-        if now - _last_auto_dump < AUTO_DUMP_MIN_S:
+        if _last_auto_dump is not None and \
+                now - _last_auto_dump < AUTO_DUMP_MIN_S:
             return
         _last_auto_dump = now
     _last_anomaly.clear()
@@ -392,7 +409,8 @@ def dump(path: Optional[str] = None, reason: str = "manual",
     _last_dump_path = path
     from . import metrics as _metrics
     if _metrics.ENABLED:
-        # reason is one of {"manual", "anomaly", "signal", "oom"} — bounded
+        # reason is one of {"manual", "anomaly", "signal", "oom",
+        # "divergence", "stall", "preempt"} — bounded
         _metrics.FLIGHT_DUMPS.inc(reason=reason)
     return path
 
@@ -423,7 +441,7 @@ def reset() -> None:
         _segments.clear()
     with _watch_lock:
         _watch.clear()
-    _last_auto_dump = 0.0
+    _last_auto_dump = None
     _last_anomaly.clear()
 
 

@@ -585,6 +585,42 @@ FUSED_DTYPE_RECOMPILES = Counter(
     "precision for bf16/fp16 gradients — would train in the wrong "
     "dtype without ever erroring.  A count that climbs every step "
     "means something is flapping MXNET_AMP mid-run")
+SUPERVISOR_SNAPSHOTS = Counter(
+    "mxnet_supervisor_snapshots_total",
+    "Rolling host snapshots the TrainingSupervisor took (every "
+    "MXNET_SUPERVISE_SNAPSHOT_STEPS) — the donation-safe restore points "
+    "transient-step retries rebuild from")
+SUPERVISOR_RETRIES = Counter(
+    "mxnet_supervisor_retries_total",
+    "Supervised training steps re-executed after a transient failure "
+    "(restore last snapshot -> replay window -> retry).  A climbing "
+    "count with training still progressing is the supervisor doing its "
+    "job; pair with faults_injected to tell chaos from real faults")
+SUPERVISOR_REWINDS = Counter(
+    "mxnet_supervisor_rewinds_total",
+    "Snapshot restores performed by the TrainingSupervisor, by reason "
+    "(retry = transient-step recovery, divergence = "
+    "MXNET_SUPERVISE_ON_DIVERGE=rewind)")
+SUPERVISOR_WATCHDOG_TRIPS = Counter(
+    "mxnet_supervisor_watchdog_trips_total",
+    "Training watchdog firings by kind (divergence = "
+    "MXNET_SUPERVISE_DIVERGE_PATIENCE consecutive nonfinite losses, "
+    "stall = a step blew its EWMA-derived deadline).  Each trip leaves "
+    "one rate-limited post-mortem (report + flight ring) under "
+    "MXNET_FLIGHT_DIR")
+SUPERVISOR_LAST_SNAPSHOT_STEP = Gauge(
+    "mxnet_supervisor_last_snapshot_step",
+    "Step id of the TrainingSupervisor's most recent rolling host "
+    "snapshot — how far back a donation-safe retry would rewind")
+PREFETCH_RESPAWNS = Counter(
+    "mxnet_prefetch_respawns_total",
+    "AsyncPrefetcher worker threads respawned after a transient IO "
+    "error (one respawn per prefetcher lifetime; a second transient "
+    "surfaces to the consumer)")
+DATA_RECORDS_SKIPPED = Counter(
+    "mxnet_data_records_skipped_total",
+    "Corrupt input records skipped by the prefetcher's "
+    "MXNET_DATA_SKIP_BUDGET (typed DataSkipBudgetError on exhaustion)")
 COMPRESSION_ERROR = Histogram(
     "mxnet_compression_error",
     "Mean |quantization error| per gradient bucket per compressed "
@@ -746,6 +782,18 @@ def snapshot() -> dict:
         "flight": _flight_snapshot(),
         "memory": _memory_snapshot(),
         "analysis": _analysis_snapshot(),
+        "supervisor": {
+            "snapshots": SUPERVISOR_SNAPSHOTS.value,
+            "last_snapshot_step": SUPERVISOR_LAST_SNAPSHOT_STEP.get(),
+            "retries": SUPERVISOR_RETRIES.value,
+            "rewinds": {dict(k).get("reason", "_"): v for k, v in
+                        sorted(list(SUPERVISOR_REWINDS._children.items()))},
+            "watchdog_trips": {
+                dict(k).get("kind", "_"): v for k, v in
+                sorted(list(SUPERVISOR_WATCHDOG_TRIPS._children.items()))},
+            "prefetch_respawns": PREFETCH_RESPAWNS.value,
+            "data_records_skipped": DATA_RECORDS_SKIPPED.value,
+        },
         "checkpoint": {
             "last_step": CHECKPOINT_LAST_STEP.get(),
             "saves": CHECKPOINT_SAVE_SECONDS.count,

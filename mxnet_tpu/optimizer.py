@@ -1164,6 +1164,10 @@ class FusedUpdater(Updater):
         with trace_span("optimizer_update_all", cat="optimizer"), \
                 _memory.oom_guard("optimizer.update_all"):
             _fi_fire("memory.oom", at="optimizer")
+            # transient-device chaos site at the fused-update dispatch
+            # boundary (the fused-path twin of the whole-step site):
+            # fires before fn(), so weights/states are still pre-step
+            _fi_fire("device.unavailable", at="optimizer")
             nws, nss, nts = fn(wvals, gvals, svals, lrs, wds, ts)
         commit_ts(nts)
         for k, i in enumerate(indices):

@@ -549,3 +549,88 @@ def test_checkpoint_metrics_in_snapshot(tmp_path):
     assert sec["saves"] == saves + 1
     assert sec["bytes_written"] > 0
     json.dumps(snap)
+
+
+# ---------------------------------------------------------------------------
+# restore exhaustion diagnostics (ISSUE 12 satellite): when EVERY
+# candidate is invalid, say which steps were scanned and why each was
+# rejected — never a bare "no valid checkpoint", never a silent fresh
+# start over a directory full of damaged runs
+# ---------------------------------------------------------------------------
+def _corrupt_crc(step_dir):
+    shard = os.path.join(step_dir, "shard_0.npz")
+    with np.load(shard, allow_pickle=False) as z:
+        entries = {k: z[k].copy() for k in z.keys()}
+    for k, v in entries.items():
+        if v.dtype != np.bool_ and v.size:
+            entries[k] = v + v.dtype.type(1)
+            break
+    with open(shard, "wb") as f:
+        np.savez(f, **entries)
+
+
+def test_restore_exhaustion_lists_every_candidate_and_reason(tmp_path):
+    mgr = ck.CheckpointManager(str(tmp_path))
+    _save_steps(mgr, [1, 2, 3])
+    # three distinct damage classes across the three candidates
+    (tmp_path / "step_3" / "manifest.json").write_text(
+        (tmp_path / "step_3" / "manifest.json").read_text()[:40])  # torn
+    os.remove(tmp_path / "step_2" / "shard_0.npz")                 # torn
+    _corrupt_crc(str(tmp_path / "step_1"))                         # crc
+    with pytest.raises(ck.CheckpointError) as ei:
+        mgr.restore()
+    msg = str(ei.value)
+    for frag in ("scanned 3 candidate", "step 3", "step 2", "step 1",
+                 "[manifest]", "[torn]", "[crc]"):
+        assert frag in msg, (frag, msg)
+
+
+def test_restore_empty_dir_still_returns_none(tmp_path):
+    # the fresh-start contract restore_or_initialize keys on is ONLY
+    # for directories with no step_N candidates at all
+    mgr = ck.CheckpointManager(str(tmp_path))
+    assert mgr.restore() is None
+
+
+def test_restore_or_initialize_raises_on_all_invalid(tmp_path):
+    """A directory full of damaged checkpoints must NOT silently
+    initialize fresh — that would quietly discard the run."""
+    mgr = ck.CheckpointManager(str(tmp_path))
+    _save_steps(mgr, [5])
+    _corrupt_crc(str(tmp_path / "step_5"))
+    net, tr = _gluon_setup()
+    with pytest.raises(ck.CheckpointError, match="step 5"):
+        ck.restore_or_initialize(mgr, net, tr,
+                                 initializer=mx.init.Xavier())
+
+
+def test_invalid_error_kinds():
+    from mxnet_tpu.checkpoint.layout import CheckpointInvalidError
+    assert CheckpointInvalidError("x").kind == "invalid"
+    assert CheckpointInvalidError("x", kind="crc").kind == "crc"
+
+
+def test_preemption_hook_dumps_flight_ring(tmp_path, monkeypatch):
+    """Satellite: the emergency save leaves a TIMELINE (flight dump,
+    reason="preempt") alongside the weights — in-process drill of what
+    the SIGTERM subprocess test pins end-to-end."""
+    from mxnet_tpu.checkpoint.hooks import _PreemptionHook
+    from mxnet_tpu.observability import flight
+    from mxnet_tpu.observability import metrics as MM
+    fdir = tmp_path / "fl"
+    monkeypatch.setenv("MXNET_FLIGHT_DIR", str(fdir))
+    mgr = ck.CheckpointManager(str(tmp_path / "ck"), async_save=False)
+    hook = _PreemptionHook(mgr, lambda: (7, {"w": np.ones(4, "f")}),
+                           signals=(), exit_on_signal=False)
+    dumps = MM.FLIGHT_DUMPS.get(reason="preempt")
+    hook._save_once("signal 15")
+    assert ck.all_steps(str(tmp_path / "ck")) == [7]
+    assert MM.FLIGHT_DUMPS.get(reason="preempt") == dumps + 1
+    files = list(fdir.glob("flight-*.json"))
+    assert files
+    import json as _json
+    assert any(_json.load(open(f)).get("metadata", {}).get("reason")
+               == "preempt" for f in files)
+    # already-fired hook never dumps twice
+    hook._save_once("atexit")
+    assert MM.FLIGHT_DUMPS.get(reason="preempt") == dumps + 1

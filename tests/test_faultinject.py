@@ -232,3 +232,20 @@ def test_hot_reload_fault_keeps_old_weights(tmp_path):
     np.testing.assert_array_equal(pred.predict(x)[0], ref)
     # harness cleared: the same reload now succeeds
     assert pred.hot_reload(mgr) == 5
+
+
+def test_parse_plan_after_slot_and_trailing_rejection():
+    """ISSUE 12: env rules take an `after` occurrence offset
+    (site:mode[:arg][:times[:after]]) so a drill can hit exactly the
+    Nth step; anything past it is a loud error, never silently dropped."""
+    plan = fi.parse_plan("trainer.step:raise:OSError:1:6;"
+                         "serving.dispatch:delay:0.05:3:2;"
+                         "checkpoint.io:corrupt:1:4")
+    r = plan.rules("trainer.step")[0]
+    assert r.exc is OSError and r.times == 1 and r.after == 6
+    d = plan.rules("serving.dispatch")[0]
+    assert d.delay_s == 0.05 and d.times == 3 and d.after == 2
+    c = plan.rules("checkpoint.io")[0]
+    assert c.mode == "corrupt" and c.times == 1 and c.after == 4
+    with pytest.raises(mx.base.MXNetError, match="trailing"):
+        fi.parse_plan("trainer.step:raise:OSError:1:6:9")

@@ -143,3 +143,132 @@ def test_io_prefetching_iter_device_put():
             assert dev in arr._data.devices()
     assert n == 2
     pit.close()
+
+
+# ---------------------------------------------------------------------------
+# fault containment (ISSUE 12): respawn-once + corrupt-record skip budget
+# ---------------------------------------------------------------------------
+def _drain(pf):
+    got = []
+    while True:
+        try:
+            got.append(pf.get())
+        except StopIteration:
+            break
+    return got
+
+
+def test_worker_respawns_once_on_transient_io():
+    from mxnet_tpu import faultinject as fi
+    from mxnet_tpu.observability import metrics as M
+    src = iter(range(12))
+    before = M.PREFETCH_RESPAWNS.value
+    plan = fi.FaultPlan().add("data.batch", "raise", exc=OSError,
+                              times=1, after=4)
+    with fi.active(plan):
+        pf = AsyncPrefetcher(lambda: next(src), depth=2)
+        got = _drain(pf)
+    # the fire happens BEFORE the source read, so no record was
+    # consumed: the respawned worker delivers the COMPLETE stream
+    assert got == list(range(12))
+    assert pf.respawns == 1
+    assert M.PREFETCH_RESPAWNS.value == before + 1
+    pf.close()
+
+
+def test_second_transient_surfaces_to_consumer():
+    from mxnet_tpu import faultinject as fi
+    src = iter(range(12))
+    plan = fi.FaultPlan().add("data.batch", "raise", exc=OSError,
+                              times=2, after=4)
+    with fi.active(plan):
+        pf = AsyncPrefetcher(lambda: next(src), depth=2)
+        got = []
+        with pytest.raises(OSError):
+            while True:
+                try:
+                    got.append(pf.get())
+                except StopIteration:
+                    break
+    assert pf.respawns == 1  # one respawn spent, second error surfaced
+    # sticky exhaustion after the error — never hangs
+    with pytest.raises(StopIteration):
+        pf.get()
+    pf.close()
+
+
+def test_corrupt_record_skip_budget():
+    from mxnet_tpu import faultinject as fi
+    from mxnet_tpu.observability import metrics as M
+    from mxnet_tpu.resilience import DataCorruptionError
+    before = M.DATA_RECORDS_SKIPPED.value
+    src = iter(range(10))
+    plan = fi.FaultPlan().add("data.batch", "raise",
+                              exc=DataCorruptionError, times=2, after=3)
+    with fi.active(plan):
+        pf = AsyncPrefetcher(lambda: next(src), skip_budget=4)
+        got = _drain(pf)
+    # injected pre-read corruption consumes budget but loses no record
+    assert got == list(range(10))
+    assert pf.skipped == 2
+    assert M.DATA_RECORDS_SKIPPED.value == before + 2
+    pf.close()
+
+
+def test_skip_budget_exhausts_to_typed_error():
+    from mxnet_tpu import faultinject as fi
+    from mxnet_tpu.resilience import (DataCorruptionError,
+                                      DataSkipBudgetError)
+    src = iter(range(10))
+    plan = fi.FaultPlan().add("data.batch", "raise",
+                              exc=DataCorruptionError, times=5, after=2)
+    with fi.active(plan):
+        pf = AsyncPrefetcher(lambda: next(src), skip_budget=2)
+        with pytest.raises(DataSkipBudgetError) as ei:
+            _drain(pf)
+    assert isinstance(ei.value.__cause__, DataCorruptionError)
+    assert pf.skipped == 2
+    pf.close()
+
+
+def test_skip_budget_zero_surfaces_corruption_directly():
+    """Default budget (0): corruption surfaces typed and unskipped —
+    skipping records is always an explicit opt-in."""
+    from mxnet_tpu.resilience import DataCorruptionError
+
+    def bad():
+        raise DataCorruptionError("undecodable record")
+
+    pf = AsyncPrefetcher(bad)
+    with pytest.raises(DataCorruptionError):
+        pf.get()
+    assert pf.skipped == 0
+    pf.close()
+
+
+def test_real_corrupt_record_is_genuinely_skipped():
+    """A decoder raising mid-read consumes the record: the skip budget
+    drops THAT record and the stream continues with the rest."""
+    from mxnet_tpu.resilience import DataCorruptionError
+    src = iter(range(8))
+
+    def decode():
+        v = next(src)
+        if v == 3:
+            raise DataCorruptionError(f"record {v} undecodable")
+        return v
+
+    pf = AsyncPrefetcher(decode, skip_budget=1)
+    assert _drain(pf) == [0, 1, 2, 4, 5, 6, 7]
+    assert pf.skipped == 1
+    pf.close()
+
+
+def test_prefetching_iter_plumbs_skip_budget():
+    from mxnet_tpu.io import NDArrayIter, PrefetchingIter
+    x = mx.nd.array(np.arange(24, dtype="f").reshape(12, 2))
+    pit = PrefetchingIter(NDArrayIter(x, batch_size=4), depth=2,
+                          skip_budget=3)
+    assert pit._pf._skip_budget == 3
+    assert sum(1 for _ in pit) == 3
+    pit.close()
