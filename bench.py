@@ -447,6 +447,20 @@ def _run():
             _STATE["memory"] = {
                 "error": "%s: %s" % (type(e).__name__, str(e)[:200])}
 
+    # MFU rider (ISSUE 13; MXT_BENCH_MFU=0 skips): fused vs whole-step
+    # {mfu_pct, flops_per_step, bytes_per_step, per_layer_top3} from
+    # the program introspector, introspection-on vs MXNET_INTROSPECT=0
+    # per-step paired-interleave overhead (acceptance <= 2%), and a
+    # perf-baseline write + reread round-trip in the same run — same
+    # durability contract as the other riders
+    if os.environ.get("MXT_BENCH_MFU", "1") != "0":
+        _phase("mfu", EPOCH_S)
+        try:
+            _STATE["mfu"] = _mfu_leg(mx, ctx)
+        except Exception as e:  # noqa: BLE001
+            _STATE["mfu"] = {
+                "error": "%s: %s" % (type(e).__name__, str(e)[:200])}
+
     # chaos rider (ISSUE 12; MXT_BENCH_CHAOS=0 skips): TrainingSupervisor
     # overhead on the fused trainer step (supervised vs bare steps/s,
     # per-step paired interleave + amortized snapshot cost, acceptance
@@ -1099,6 +1113,177 @@ def _memory_leg(mx, ctx):
         "untagged_bytes": summ["untagged_bytes"],
         "tracked_bytes": summ["tracked_bytes"],
         "peak_by_tag": summ["peak_by_tag"],
+    }
+
+
+def _mfu_leg(mx, ctx):
+    """Program-introspection rider (docs/introspection.md): MFU/
+    roofline numbers for the fused path vs the whole-step program
+    (analytical flops from the noted programs ÷ this leg's own
+    measured median step time ÷ the platform peak), the whole-step
+    per_layer() top-3 + attribution pct (acceptance >= 90% to named
+    blocks), introspection-on vs MXNET_INTROSPECT=0 per-step
+    paired-interleave overhead (acceptance <= 2%, the _memory_leg
+    methodology), and a perf-baseline write + reread round-trip."""
+    import json as _json
+    import shutil
+    import tempfile
+
+    from mxnet_tpu import autograd, gluon
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu.gluon.wholestep import WholeStepCompiler
+    from mxnet_tpu.observability import introspect
+
+    rs = np.random.RandomState(0)
+    bs, steps = 256, 30
+
+    def build(seed):
+        mx.random.seed(seed)
+        net = nn.HybridSequential()
+        with net.name_scope():
+            for _ in range(6):
+                net.add(nn.Dense(64, activation="relu"))
+            net.add(nn.Dense(1))
+        net.hybridize()
+        net.initialize(mx.init.Xavier(), ctx=ctx)
+        trainer = gluon.Trainer(net.collect_params(), "sgd",
+                                {"learning_rate": 0.01, "momentum": 0.9},
+                                kvstore="tpu_sync",
+                                update_on_kvstore=False)
+        return net, trainer
+
+    x = mx.nd.array(rs.normal(0, 1, (bs, 64)).astype("f"), ctx=ctx)
+    y = mx.nd.array(rs.normal(0, 1, (bs, 1)).astype("f"), ctx=ctx)
+    loss_fn = gluon.loss.L2Loss()
+
+    was_on = introspect.ENABLED
+    prev_hlo = introspect.HLO
+    tmp_dir = tempfile.mkdtemp(prefix="mxt-bench-mfu-")
+    prev_base = os.environ.get("MXNET_PERF_BASELINE_DIR")
+    prev_whole = os.environ.get("MXNET_WHOLE_STEP")
+    prev_flight = os.environ.get("MXNET_FLIGHT_DIR")
+    os.environ["MXNET_PERF_BASELINE_DIR"] = tmp_dir
+    os.environ["MXNET_FLIGHT_DIR"] = tmp_dir
+    try:
+        introspect.enable()
+        introspect.reset()
+        introspect.configure(hlo=True, sentinel_every=1)
+
+        # -- fused leg ---------------------------------------------------
+        os.environ["MXNET_WHOLE_STEP"] = "0"
+        net_f, tr_f = build(11)
+
+        def fused_step():
+            with autograd.record():
+                l = loss_fn(net_f(x), y)
+            l.backward()
+            tr_f.step(bs)
+            return l
+
+        for _ in range(5):
+            fused_step()
+        times = []
+        for _ in range(steps):
+            t0 = time.perf_counter()
+            last = fused_step()
+            float(last.asnumpy().ravel()[0])
+            times.append(time.perf_counter() - t0)
+        fused_dt = float(np.median(times))
+        f_flops, f_bytes, _ = introspect.step_flops()
+        fused_mfu = introspect.mfu(step_time_s=fused_dt, flops=f_flops,
+                                   bytes_per_step=f_bytes)
+
+        # -- whole-step leg ----------------------------------------------
+        os.environ["MXNET_WHOLE_STEP"] = "1"
+        net_w, tr_w = build(11)
+        stepper = WholeStepCompiler(net_w, loss_fn, tr_w)
+        for _ in range(5):
+            stepper.step(x, y)
+        times = []
+        for _ in range(steps):
+            t0 = time.perf_counter()
+            last = stepper.step(x, y)
+            float(last.asnumpy().ravel()[0])
+            times.append(time.perf_counter() - t0)
+        whole_dt = float(np.median(times))
+        w_rec = introspect.programs().get("whole_step", {})
+        whole_mfu = introspect.mfu(step_time_s=whole_dt,
+                                   flops=w_rec.get("flops"),
+                                   bytes_per_step=w_rec.get("bytes"))
+        per_layer = introspect.per_layer("whole_step", top=3,
+                                         step_time_s=whole_dt)
+        attributed = introspect.attributed_pct("whole_step")
+
+        # -- introspection overhead: per-step paired interleave ----------
+        # (the _memory_leg discipline — adjacent pairs cancel container
+        # drift, best-of-3 chunks reject one-off hiccups)
+        deltas, on_times, off_times = [], [], []
+        for i in range(3 * steps):
+            first_on = i % 2 == 0
+            for on in ((True, False) if first_on else (False, True)):
+                (introspect.enable if on else introspect.disable)()
+                t0 = time.perf_counter()
+                last = stepper.step(x, y)
+                float(last.asnumpy().ravel()[0])
+                dt = time.perf_counter() - t0
+                (on_times if on else off_times).append(dt)
+            deltas.append(on_times[-1] - off_times[-1])
+        introspect.enable()
+        overhead_pct = 0.0
+        if deltas:
+            third = max(1, len(deltas) // 3)
+            off_med = float(np.median(off_times))
+            overhead_pct = min(
+                float(np.median(deltas[i:i + third])) / off_med * 100.0
+                for i in range(0, len(deltas), third))
+
+        # -- sentinel baseline write + reread round-trip -----------------
+        written = introspect.refresh_baseline("whole_step")
+        path = introspect.baseline_path("whole_step")
+        reread = None
+        if path and os.path.exists(path):
+            with open(path) as f:
+                reread = _json.load(f)
+        roundtrip = bool(written and reread and all(
+            reread.get(k) == written.get(k)
+            for k in ("step_time_p50_ms", "dispatches_per_step",
+                      "flops_per_step", "hbm_peak_bytes")))
+    finally:
+        # drop the rider's program records AND its sentinel entries:
+        # leaving a baseline loaded from the (deleted) tmp dir armed
+        # would make a later leg's sentinel_tick compare a different
+        # net against this rider's tiny-MLP numbers
+        introspect.reset()
+        (introspect.enable if was_on else introspect.disable)()
+        introspect.configure(hlo=prev_hlo, sentinel_every=25)
+        for k, v in (("MXNET_PERF_BASELINE_DIR", prev_base),
+                     ("MXNET_WHOLE_STEP", prev_whole),
+                     ("MXNET_FLIGHT_DIR", prev_flight)):
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        shutil.rmtree(tmp_dir, ignore_errors=True)
+    return {
+        "fused": {"steps_per_s": round(1.0 / fused_dt, 2),
+                  "mfu_pct": fused_mfu.get("mfu_pct"),
+                  "flops_per_step": fused_mfu.get("flops_per_step"),
+                  "bytes_per_step": fused_mfu.get("bytes_per_step")},
+        "whole_step": {"steps_per_s": round(1.0 / whole_dt, 2),
+                       "mfu_pct": whole_mfu.get("mfu_pct"),
+                       "flops_per_step": whole_mfu.get("flops_per_step"),
+                       "bytes_per_step": whole_mfu.get("bytes_per_step"),
+                       "arithmetic_intensity":
+                           whole_mfu.get("arithmetic_intensity")},
+        "peak_flops": whole_mfu.get("peak_flops"),
+        "peak_source": whole_mfu.get("peak_source"),
+        "per_layer_top3": per_layer,
+        "attributed_pct": attributed,
+        "attribution_floor_pct": 90.0,
+        "overhead_pct": round(overhead_pct, 2),
+        "overhead_budget_pct": 2.0,
+        "baseline_roundtrip": roundtrip,
+        "ok": (overhead_pct <= 2.0 and attributed >= 90.0 and roundtrip),
     }
 
 

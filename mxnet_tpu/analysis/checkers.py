@@ -750,6 +750,40 @@ class MetricsHygieneChecker:
                         f"cardinality grows flight.summary() and the "
                         f"watchdog EWMA table forever; put the varying "
                         f"part in labels=... instead)"))
+            # program-introspection names (ISSUE 13): note_program /
+            # note_jit program names and named_scope / layer_scope
+            # layer names are forever-entries in the program registry
+            # and the known-scope set — the PR 6/PR 8 cardinality
+            # class.  `named_scope`/`layer_scope`/`note_program`/
+            # `note_jit` are distinctive enough to match under ANY
+            # receiver; a varying-but-bounded qualifier belongs in
+            # note_program's label= (which is checked too — pass a
+            # bounded helper's result like bucket_label, never build
+            # the string at the call site).
+            if last in ("note_program", "note_jit", "named_scope",
+                        "layer_scope") and node.args:
+                name_arg = node.args[0]
+                why = self._dynamic_str(name_arg)
+                if why:
+                    out.append(ctx.finding(
+                        self.name, name_arg,
+                        f"program/layer name is dynamically built "
+                        f"({why}) — note_program/named_scope names must "
+                        f"come from a bounded set (each distinct name "
+                        f"is a forever-entry in the program registry / "
+                        f"known-scope table; use note_program's label= "
+                        f"with a bounded helper for the varying part)"))
+                if last in ("note_program", "note_jit"):
+                    for kw in node.keywords:
+                        if kw.arg == "label":
+                            why = self._dynamic_str(kw.value)
+                            if why:
+                                out.append(ctx.finding(
+                                    self.name, kw.value,
+                                    f"note_program label is dynamically "
+                                    f"built ({why}) — labels must come "
+                                    f"from a bounded set (e.g. the "
+                                    f"bucket lattice via bucket_label)"))
         return out
 
 

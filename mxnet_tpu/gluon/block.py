@@ -20,6 +20,7 @@ import numpy as _np
 
 from ..base import MXNetError, getenv
 from ..context import cpu
+from ..observability import introspect as _introspect
 from ..observability import metrics as _metrics
 from .. import ndarray as nd
 from ..ndarray import NDArray
@@ -218,6 +219,7 @@ class CachedOp:
             static_argnums=(3,))
         self._bwd_cache = {}
         self._fwd_donated = None  # built on first donated inference call
+        self._noted = set()  # introspection captures done (fwd/bwd)
 
     def _get_fwd_donated(self):
         """Inference-mode forward that DONATES the non-parameter inputs
@@ -298,6 +300,12 @@ class CachedOp:
                 aux_arrays[k]._set_data(v)
             return out_nds
         outs, new_aux = self._fwd(arg_vals, aux_vals, key, is_train)
+        if _introspect.ENABLED and "fwd" not in self._noted:
+            # once per CachedOp: analytical cost of the compiled fwd —
+            # the fused-path MFU numerator (a retrace, no XLA compile)
+            self._noted.add("fwd")
+            _introspect.note_jit("gluon:fwd", self._fwd, arg_vals,
+                                 aux_vals, key, is_train)
         out_nds = [NDArray(o, ctx) for o in outs]
         if autograd.is_recording():
             names = list(arg_vals.keys())
@@ -309,6 +317,11 @@ class CachedOp:
             def vjp_fn(cots):
                 if _metrics.ENABLED:
                     _metrics.XLA_LAUNCHES.inc(kind="bwd")
+                if _introspect.ENABLED and "bwd" not in self._noted:
+                    self._noted.add("bwd")
+                    _introspect.note_jit("gluon:bwd", bwd_jit, primals,
+                                         tuple(cots), aux_snapshot, key,
+                                         is_train)
                 return bwd_jit(primals, tuple(cots), aux_snapshot, key, is_train)
 
             autograd._record(None, [arg_arrays[n] for n in names], out_nds,

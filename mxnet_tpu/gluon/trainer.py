@@ -32,6 +32,7 @@ from ..base import MXNetError, getenv
 from ..faultinject import fire as _fi_fire
 from ..ndarray import NDArray
 from ..observability import flight as _flight
+from ..observability import introspect as _introspect
 from ..observability import memory as _memory
 from ..observability import metrics as _metrics
 from ..observability.tracing import trace_span
@@ -193,6 +194,11 @@ class Trainer:
         if on:
             _metrics.TRAINER_STEP_DISPATCHES.set(
                 _metrics.step_dispatches() - d0)
+        if _introspect.ENABLED:
+            # perf-regression sentinel heartbeat for the fused path
+            # (the whole-step path ticks its own phase in
+            # WholeStepCompiler._dispatch): one counter bump per step
+            _introspect.sentinel_tick("trainer_step")
 
     def _step(self, batch_size, ignore_stale_grad=False):
         if not self._kv_initialized:

@@ -58,6 +58,7 @@ from ..faultinject import fire as _fi_fire
 from ..ndarray import NDArray
 from ..resilience import DeviceUnavailableError as _DeviceUnavailableError
 from ..observability import flight as _flight
+from ..observability import introspect as _introspect
 from ..observability import memory as _memory
 from ..observability import metrics as _metrics
 from ..observability.tracing import trace_span
@@ -212,6 +213,10 @@ class WholeStepCompiler:
         self._ran = False
         self._amp_warned = False       # AMP-ineligible model, warn once
         self._amp_env_checked = False  # AMP-without-whole-step, warn once
+        # introspection captures done, per (program cache key, data
+        # shape) — a new shape re-notes so the recorded flops track the
+        # running batch size
+        self._noted_keys = set()
         # backends without real donation (CPU) warn per trace; the user
         # opted into best-effort donation, so this is expected noise
         _install_donation_filter()
@@ -516,16 +521,22 @@ class WholeStepCompiler:
                                              jnp.all(jnp.isfinite(g)))
             new_res = residuals
             if use_comp:
-                flats = flatten_inline(glist)
-                red, new_res, _errs = reduce_buckets_inline(
-                    flats, residuals, thr)
-                glist = unflatten_inline(red)
-            new_p, new_s = {}, []
-            for k, n in enumerate(gnames):
-                nw, ns = fused_step(idx[k], gparams[n], glist[k],
-                                    states[k], lrs[k], wds[k], ts[k])
-                new_p[n] = _cast_like(nw, gparams[n])
-                new_s.append(_cast_like(ns, states[k]))
+                # literal named scopes over the non-graph step stages:
+                # HLO metadata then attributes the bucketed reduce and
+                # the fused optimizer math to their own per_layer()
+                # rows, next to the graph nodes' layer scopes
+                with _introspect.layer_scope("allreduce"):
+                    flats = flatten_inline(glist)
+                    red, new_res, _errs = reduce_buckets_inline(
+                        flats, residuals, thr)
+                    glist = unflatten_inline(red)
+            with _introspect.layer_scope("optimizer"):
+                new_p, new_s = {}, []
+                for k, n in enumerate(gnames):
+                    nw, ns = fused_step(idx[k], gparams[n], glist[k],
+                                        states[k], lrs[k], wds[k], ts[k])
+                    new_p[n] = _cast_like(nw, gparams[n])
+                    new_s.append(_cast_like(ns, states[k]))
             new_scaler = scaler
             if use_scaler:
                 # skip-step: a nonfinite gradient anywhere keeps params,
@@ -672,6 +683,32 @@ class WholeStepCompiler:
         fn = upd.lookup_program(
             key, lambda: self._build_fn(built, opt_, policy, thr,
                                         window))
+        note_key = (key, tuple(data.shape), tuple(label.shape))
+        if _introspect.ENABLED and note_key not in self._noted_keys:
+            # once per program cache key, BEFORE the donated dispatch
+            # (every argument is still live): capture the whole-step
+            # program's analytical flops/bytes — the MFU numerator and
+            # the per_layer() subject.  A retrace only (no XLA compile
+            # unless MXNET_INTROSPECT_HLO=1), no dispatch, so the
+            # 1-dispatch perf_smoke gate is unaffected.  The signature
+            # keys the perf-regression baseline per (model, optimizer,
+            # precision, batch shape) on this platform; a new data
+            # shape re-notes (jax retraces per shape anyway), keeping
+            # the recorded flops honest for the running batch size.
+            self._noted_keys.add(note_key)
+            import hashlib
+            # data/label shapes fold into the signature: step time
+            # scales with batch size, so a legitimate bs change must
+            # select a DIFFERENT perf baseline file, not fire a false
+            # regression against the old batch's numbers
+            sig = hashlib.sha1(repr(
+                (built["sig"], type(opt_).__name__, policy,
+                 thr is not None, tuple(data.shape),
+                 tuple(label.shape))).encode()).hexdigest()[:16]
+            _introspect.note_jit(
+                "whole_step", fn, gparams, svals, residuals, scaler, aux,
+                consts, data._data, label._data,
+                jax.random.PRNGKey(0), lrs, wds, ts, signature=sig)
 
         # chaos site for transient device loss at the dispatch boundary:
         # fires before fn() executes, so the donated buffers are still
@@ -696,6 +733,11 @@ class WholeStepCompiler:
         if on:
             _metrics.TRAINER_STEP_DISPATCHES.set(
                 _metrics.step_dispatches() - d0)
+        if _introspect.ENABLED:
+            # perf-regression sentinel heartbeat: one counter bump per
+            # step; every SENTINEL_EVERY steps the warmed whole_step
+            # EWMA compares against the persisted baseline
+            _introspect.sentinel_tick("whole_step")
 
         for n in gnames:
             params[n].list_data()[0]._set_data(new_p[n])

@@ -36,6 +36,19 @@ package makes that visibility a product API:
     RESOURCE_EXHAUSTED at the dispatch chokepoints, dumps ledger +
     ring, re-raises typed; `MXNET_MEMORY_LEDGER=0` disables; see
     docs/memory.md).
+  - `mxnet_tpu.observability.introspect` — program introspection:
+    every compile chokepoint notes its program's analytical cost
+    (flops, bytes) + CompiledMemoryStats through one
+    `note_program()` surface (`snapshot()["programs"]`,
+    `introspect.report()`); `jax.named_scope` layer names thread
+    through the graph interpreter so `per_layer()` attributes the
+    donated whole-step program's flops to named blocks
+    (`MXNET_INTROSPECT_HLO=1` captures the HLO it parses); MFU /
+    roofline gauges (`mxnet_mfu`, `MXNET_PEAK_FLOPS` override) and a
+    persisted perf-regression sentinel (`MXNET_PERF_BASELINE_DIR`)
+    compare the warmed step-time EWMA against a per-(model, platform)
+    baseline (`MXNET_INTROSPECT=0` disables; see
+    docs/introspection.md).
 
 Overhead discipline: every hot-path hook is guarded by the module-level
 `metrics.ENABLED` flag (env `MXNET_METRICS_ENABLED`, default on; set 0
@@ -49,6 +62,7 @@ from . import tracing
 from . import flight
 from . import timeline
 from . import memory
+from . import introspect
 from .metrics import (Counter, Gauge, Histogram, MetricsRegistry, REGISTRY,
                       enabled, enable, disable, dispatch_counts,
                       step_dispatches, snapshot, render_prometheus,
@@ -58,7 +72,8 @@ from .flight import phase_span, trace_scope, new_trace_id
 from .memory import memory_scope, oom_guard, DeviceMemoryError, HBMBudgetError
 
 __all__ = [
-    "metrics", "tracing", "flight", "timeline", "memory", "Counter",
+    "metrics", "tracing", "flight", "timeline", "memory", "introspect",
+    "Counter",
     "Gauge", "Histogram", "MetricsRegistry", "REGISTRY", "enabled",
     "enable", "disable", "dispatch_counts", "step_dispatches", "snapshot",
     "render_prometheus", "render_json", "hbm_stats",

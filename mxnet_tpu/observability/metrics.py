@@ -628,6 +628,55 @@ COMPRESSION_ERROR = Histogram(
     "2-bit threshold).  Growing means the threshold is too coarse for "
     "the gradient scale",
     buckets=(1e-4, 1e-3, 1e-2, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 10.0))
+PERF_REGRESSIONS = Counter(
+    "mxnet_perf_regressions_total",
+    "Perf-regression sentinel firings (mxnet_tpu.observability."
+    "introspect), by kind (step_time = warmed step-time EWMA blew the "
+    "persisted baseline p50 by REGRESSION_FACTOR, dispatches = "
+    "steady-state dispatches/step grew past the baseline) and phase "
+    "(whole_step / trainer_step).  Each firing is rate-limited to once "
+    "per regression episode; the regression also fails the "
+    "perf_regression readyz() check until it clears or the baseline is "
+    "refreshed (docs/introspection.md)")
+
+
+def _introspect_mfu(key: str) -> float:
+    """Export-time pull of one MFU/roofline field from the introspect
+    layer (lazy/guarded — a scrape must never fail because of it;
+    0.0 until both a program capture and a warmed step EWMA exist)."""
+    try:
+        from . import introspect as _int
+        if not _int.ENABLED:
+            return 0.0
+        return float(_int.mfu().get(key) or 0.0)
+    except Exception:  # noqa: BLE001
+        return 0.0
+
+
+MFU = Gauge(
+    "mxnet_mfu",
+    "Model flops utilization of the training step, 0..1: analytical "
+    "flops/step of the captured step program(s) / the flight "
+    "recorder's warmed step-time EWMA / platform peak flops "
+    "(MXNET_PEAK_FLOPS override; the CPU default peak is a nominal "
+    "placeholder).  Computed at export only",
+    fn=lambda: _introspect_mfu("mfu"))
+STEP_FLOPS_PER_S = Gauge(
+    "mxnet_step_flops_per_s",
+    "Achieved flops/s of the training step (analytical flops/step / "
+    "warmed step-time EWMA) — the roofline y-axis.  Computed at export",
+    fn=lambda: _introspect_mfu("flops_per_s"))
+STEP_BYTES_PER_S = Gauge(
+    "mxnet_step_bytes_per_s",
+    "Achieved HBM bytes/s of the training step (cost_analysis bytes "
+    "accessed / warmed step-time EWMA).  Computed at export",
+    fn=lambda: _introspect_mfu("bytes_per_s"))
+STEP_ARITH_INTENSITY = Gauge(
+    "mxnet_step_arithmetic_intensity",
+    "Analytical flops per byte accessed of the training step — the "
+    "roofline x-axis (compare against the platform's ridge point to "
+    "see compute- vs memory-bound).  Computed at export",
+    fn=lambda: _introspect_mfu("arithmetic_intensity"))
 
 
 def _hbm_stats_all() -> List[dict]:
@@ -718,6 +767,17 @@ def _memory_snapshot() -> dict:
         return {"enabled": False}
 
 
+def _programs_snapshot() -> dict:
+    """snapshot()["programs"]: per-program flops/bytes/peak + MFU +
+    perf-sentinel state (docs/introspection.md).  Lazy/guarded — the
+    metrics layer must never fail because of the introspector."""
+    try:
+        from . import introspect as _int
+        return _int.snapshot_summary()
+    except Exception:  # noqa: BLE001
+        return {"enabled": False}
+
+
 def _analysis_snapshot() -> dict:
     """snapshot()["analysis"]: sanitizer state + violation counters
     (docs/static_analysis.md).  The sanitizer import is lazy/guarded —
@@ -781,6 +841,7 @@ def snapshot() -> dict:
         },
         "flight": _flight_snapshot(),
         "memory": _memory_snapshot(),
+        "programs": _programs_snapshot(),
         "analysis": _analysis_snapshot(),
         "supervisor": {
             "snapshots": SUPERVISOR_SNAPSHOTS.value,

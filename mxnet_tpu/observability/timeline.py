@@ -27,11 +27,23 @@ __all__ = ["build_trace", "chrome_events", "summarize"]
 PID = 0
 
 
+def _phase_flops() -> Dict[str, float]:
+    """{step phase: analytical flops/step} from the program
+    introspector — the feed for the ``mxnet_flops_per_s`` counter
+    track.  Lazy/guarded: the exporter must never fail because of it."""
+    try:
+        from . import introspect as _int
+        return _int.phase_flops_map() if _int.ENABLED else {}
+    except Exception:  # noqa: BLE001
+        return {}
+
+
 def chrome_events(flight_records: List[tuple]) -> List[dict]:
     """``(segment, record)`` pairs → Chrome trace complete events plus
     one thread_name metadata event per segment."""
     events: List[dict] = []
     seen_tids: Dict[int, str] = {}
+    phase_flops = _phase_flops()
     for seg, rec in flight_records:
         name, cat, t0, t1, step, trace_id, labels = rec
         seen_tids.setdefault(seg.tid, seg.thread_name)
@@ -55,6 +67,15 @@ def chrome_events(flight_records: List[tuple]) -> List[dict]:
             events.append({"name": "hbm_live_bytes", "ph": "C",
                            "ts": t1, "pid": PID,
                            "args": {"bytes": labels["mem_live_bytes"]}})
+        if name in phase_flops and t1 > t0:
+            # step phases with a captured program get an achieved-
+            # flops/s counter track: analytical flops/step over the
+            # span's measured duration — the roofline view lined up
+            # with the phase spans (docs/introspection.md)
+            events.append({"name": "mxnet_flops_per_s", "ph": "C",
+                           "ts": t1, "pid": PID,
+                           "args": {"flops_per_s":
+                                    phase_flops[name] * 1e6 / (t1 - t0)}})
     for tid, tname in sorted(seen_tids.items()):
         events.append({"name": "thread_name", "ph": "M", "pid": PID,
                        "tid": tid, "args": {"name": tname}})

@@ -22,6 +22,7 @@ from .base import MXNetError, Registry, getenv
 from . import ndarray as nd
 from .ndarray import NDArray
 from .faultinject import fire as _fi_fire
+from .observability import introspect as _introspect
 from .observability import memory as _memory
 from .observability import metrics as _metrics
 from .observability.tracing import trace_span
@@ -911,6 +912,8 @@ class FusedUpdater(Updater):
     def __init__(self, optimizer: Optimizer):
         super().__init__(optimizer)
         self._fn_cache: Dict[Any, Any] = {}
+        # introspection captures done, one per compiled-step cache key
+        self._noted_keys: set = set()
         # dtype policy the compiled step programs were traced under
         # ("f32" | "bf16" | "fp16"; set from MXNET_AMP by the trainer /
         # whole-step compiler).  It is position 1 of every program cache
@@ -1131,19 +1134,24 @@ class FusedUpdater(Updater):
             idx = list(indices)
 
             def _apply(wv, gv, sv, lrs, wds, ts):
-                nws, nss = [], []
-                for k in range(len(wv)):
-                    if views is not None:
-                        b, off, shape = views[k]
-                        size = int(_np.prod(shape)) if shape else 1
-                        g_k = gv[b][off:off + size].reshape(shape)
-                    else:
-                        g_k = gv[k]
-                    nw, ns = opt_._fused_step_mp(idx[k], wv[k], g_k, sv[k],
-                                                 lrs[k], wds[k], ts[k])
-                    nws.append(cast_like(nw, wv[k]))
-                    nss.append(cast_like(ns, sv[k]))
-                return nws, nss, ts + 1
+                # the fused optimizer math traces under one literal
+                # named scope, so per_layer() attributes its HLO
+                # instructions to "optimizer" (ISSUE 13)
+                with _introspect.layer_scope("optimizer"):
+                    nws, nss = [], []
+                    for k in range(len(wv)):
+                        if views is not None:
+                            b, off, shape = views[k]
+                            size = int(_np.prod(shape)) if shape else 1
+                            g_k = gv[b][off:off + size].reshape(shape)
+                        else:
+                            g_k = gv[k]
+                        nw, ns = opt_._fused_step_mp(idx[k], wv[k], g_k,
+                                                     sv[k], lrs[k], wds[k],
+                                                     ts[k])
+                        nws.append(cast_like(nw, wv[k]))
+                        nss.append(cast_like(ns, sv[k]))
+                    return nws, nss, ts + 1
 
             # donate states (owned exclusively by this updater, aliased to
             # the new-state outputs); weights join the donation set only
@@ -1155,6 +1163,20 @@ class FusedUpdater(Updater):
                            donate_argnums=(0, 2) if donate_weights else (2,))
 
         fn = self.lookup_program(key, _build)
+        if _introspect.ENABLED and key not in self._noted_keys:
+            # once per compiled-step cache key, BEFORE the call (the
+            # donated state buffers are still live): analytical cost of
+            # the fused update — a retrace, no XLA compile, no dispatch.
+            # The signature hashes the dispatch-stability key (optimizer
+            # class, hypers, param set, dtypes, shardings, state
+            # treedef), so perf baselines stay per-(model, optimizer,
+            # platform) — two different models must never share one
+            # baseline file
+            self._noted_keys.add(key)
+            import hashlib
+            sig = hashlib.sha1(repr(key).encode()).hexdigest()[:16]
+            _introspect.note_jit("fused_update", fn, wvals, gvals, svals,
+                                 lrs, wds, ts, signature=sig)
         if _metrics.ENABLED:
             _metrics.XLA_LAUNCHES.inc(kind="optimizer")
             _metrics.OPTIMIZER_STEPS.inc()

@@ -40,6 +40,7 @@ from ..context import cpu
 from ..faultinject import fire as _fi_fire
 from ..ndarray import NDArray
 from ..observability import flight as _flight
+from ..observability import introspect as _introspect
 from ..observability import memory as _memory
 from ..observability import metrics as _metrics
 from ..observability.tracing import trace_span
@@ -224,17 +225,28 @@ class BucketedPredictor:
                     self._rng).compile()
             if _metrics.ENABLED:
                 _metrics.SERVE_COMPILES.inc()
-            # compiled HBM cost table: peak/argument/output/temp bytes
-            # per bucket straight from XLA's buffer assignment — what
-            # serving this bucket COSTS, before any request runs
+            # compiled cost + HBM table per bucket, straight from XLA's
+            # own analyses — what serving this bucket COSTS before any
+            # request runs.  note_program is the ONE compiled-stats
+            # surface (ISSUE 13): it files the memory stats into the
+            # HBM ledger's report()["compiled"] AND the program
+            # registry; the label rides the bounded bucket lattice,
+            # the flight recorder's bucket_label discipline.
             try:
-                mem = _memory.compiled_stats_dict(compiled.memory_analysis())
+                label = bucket_label(key)
+                mem = _introspect.note_program(
+                    "serve_bucket", compiled=compiled,
+                    label=label).get("memory", {})
+                if not mem and not _introspect.ENABLED:
+                    # introspection off: keep the PR 9 stats path alive
+                    mem = _memory.compiled_stats_dict(
+                        compiled.memory_analysis())
+                    if mem:
+                        _memory.note_compiled("serve_bucket:" + label, mem)
             except Exception:  # noqa: BLE001 — stats are best-effort
                 mem = {}
             if mem:
                 self._mem_stats[key] = mem
-                label = bucket_label(key)
-                _memory.note_compiled("serve_bucket:" + label, mem)
                 if _metrics.ENABLED:
                     _metrics.SERVE_BUCKET_HBM_BYTES.set(
                         mem["peak_bytes"], bucket=label)

@@ -683,6 +683,30 @@ class ResilientServer:
             detail["hbm_tracked_bytes"] = int(tracked)
             checks["hbm_budget"] = \
                 tracked <= _memory.BUDGET_MB * 1024 * 1024
+        # 2c. perf-regression sentinel (ISSUE 13): once a persisted
+        # baseline is armed, an active step-time/dispatch regression
+        # takes the replica out of rotation — a "healthy" process
+        # running 2x slower than its own recorded baseline is not
+        # traffic-worthy.  Guarded: readiness must never fail because
+        # of the introspector.
+        try:
+            from ..observability import introspect as _int
+            if _int.ENABLED and _int.sentinel_armed():
+                active = _int.regression_active()
+                checks["perf_regression"] = not active
+                if active:
+                    detail["perf_sentinel"] = {
+                        p: {"kind": s["kind"],
+                            "baseline_p50_ms":
+                                (s["baseline"] or {}).get(
+                                    "step_time_p50_ms"),
+                            "current_p50_ms":
+                                (s["current"] or {}).get(
+                                    "step_time_p50_ms")}
+                        for p, s in _int.sentinel_state()["phases"].items()
+                        if s["active"]}
+        except Exception:  # noqa: BLE001 — sentinel is best-effort here
+            pass
         # 3. dispatch latency EWMA vs threshold
         lat_ms = self._ewma_s * 1e3
         detail["dispatch_ewma_ms"] = round(lat_ms, 3)

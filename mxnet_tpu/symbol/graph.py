@@ -18,6 +18,7 @@ import numpy as _np
 
 from ..base import MXNetError, np_dtype
 from .. import layout as _layout
+from ..observability import introspect as _introspect
 from ..ops import registry as _reg
 from ..ops.elemwise import _BINARY as _EW_BINARY, _SCALAR as _EW_SCALAR, \
     _UNARY as _EW_UNARY
@@ -378,10 +379,17 @@ class GraphPlan:
                 p["__is_train__"] = is_train
             if step.op.needs_rng:
                 ins.append(jax.random.fold_in(key, si))
-            if step_overrides and si in step_overrides:
-                out = step_overrides[si](p, ins)
-            else:
-                out = step.op.fn(p, *ins)
+            # layer attribution (ISSUE 13): each step traces under a
+            # jax.named_scope of its node name, so HLO instruction
+            # metadata carries layer names through forward AND the vjp
+            # (introspect.per_layer parses them back out).  Trace-time
+            # only — compiled programs pay nothing per execution; one
+            # boolean when MXNET_INTROSPECT=0
+            with _introspect.layer_scope(step.node.name):
+                if step_overrides and si in step_overrides:
+                    out = step_overrides[si](p, ins)
+                else:
+                    out = step.op.fn(p, *ins)
             out = out if isinstance(out, tuple) else (out,)
             n_vis = len(out) - len(step.op.aux_inputs)
             values[si] = out[:n_vis]
@@ -462,7 +470,8 @@ class GraphPlan:
                         p["__is_train__"] = is_train
                     if step.op.needs_rng:
                         ins.append(jax.random.fold_in(key_, si))
-                    out = step.op.fn(p, *ins)
+                    with _introspect.layer_scope(step.node.name):
+                        out = step.op.fn(p, *ins)
                     out = out if isinstance(out, tuple) else (out,)
                     n_vis = len(out) - len(step.op.aux_inputs)
                     for oi in range(n_vis):

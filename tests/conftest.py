@@ -68,6 +68,13 @@ def pytest_configure(config):
         "attribution/leak gates, budget watchdog, OOM post-mortem).  "
         "Runs in tier-1 by default; `pytest -m memory` selects just "
         "the ledger suite")
+    config.addinivalue_line(
+        "markers",
+        "introspect: program-introspection tests (mxnet_tpu."
+        "observability.introspect — compile-chokepoint cost capture, "
+        "named-scope per-layer attribution, MFU/roofline math, "
+        "perf-regression sentinel).  Runs in tier-1 by default; "
+        "`pytest -m introspect` selects just this suite")
 
 
 @pytest.fixture(autouse=True)
