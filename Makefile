@@ -6,7 +6,7 @@ SRCS := src/runtime/storage.cc src/runtime/engine.cc \
         src/runtime/recordio.cc src/runtime/prefetch.cc
 LIB := mxnet_tpu/_native/libmxtpu_runtime.so
 
-.PHONY: native test chaos chaos-train lint-graft clean cpp_example predict_capi capi_example
+.PHONY: native test chaos chaos-train chaos-serve lint-graft clean cpp_example predict_capi capi_example
 
 native: $(LIB)
 
@@ -88,6 +88,16 @@ chaos-train:
 	JAX_PLATFORMS=cpu MXNET_CHECKPOINT_FSYNC=0 python -m pytest \
 	    tests/test_supervisor.py tests/test_prefetcher.py \
 	    tests/test_faultinject.py tests/test_checkpoint.py -q
+
+# the serving-side chaos drills (ISSUE 14, docs/multi_model.md):
+# multi-model registry churn under an HBM budget (LRU eviction,
+# restart-free readmission, OOM second chance) + the ResilientServer
+# overload/readiness suites + the fault-injection harness — full
+# files, chaos-marked legs included.
+chaos-serve:
+	JAX_PLATFORMS=cpu python -m pytest \
+	    tests/test_registry.py tests/test_resilience.py \
+	    tests/test_faultinject.py -q
 
 # graft-lint: the repo-specific static analysis gate (ISSUE 7,
 # docs/static_analysis.md).  Exit nonzero on any non-baselined finding

@@ -116,8 +116,15 @@ def _emit(partial):
         out["flight"] = _STATE["flight"]
     if _STATE.get("memory") is not None:
         out["memory"] = _STATE["memory"]
+    if _STATE.get("mfu") is not None:
+        # drive-by fix: the ISSUE 13 rider ran but its result never
+        # reached BENCH JSON (the same _emit omission PR 12 fixed for
+        # the wholestep rider)
+        out["mfu"] = _STATE["mfu"]
     if _STATE.get("chaos") is not None:
         out["chaos"] = _STATE["chaos"]
+    if _STATE.get("multimodel") is not None:
+        out["multimodel"] = _STATE["multimodel"]
     if partial:
         out["partial"] = True
         out["phase"] = _STATE["phase"]
@@ -473,6 +480,20 @@ def _run():
             _STATE["chaos"] = _chaos_leg(mx, ctx)
         except Exception as e:  # noqa: BLE001
             _STATE["chaos"] = {
+                "error": "%s: %s" % (type(e).__name__, str(e)[:200])}
+
+    # multi-model rider (ISSUE 14; MXT_BENCH_MULTIMODEL=0 skips): 4
+    # models through a ModelRegistry — p99 with everything resident vs
+    # p99 under budget-forced eviction churn, the eviction/readmission
+    # counts, and readmit latency cache-warm (persistent-compile-cache
+    # hit) vs cache-cold (fresh compile) — the restart-free-churn cost
+    # model of docs/multi_model.md; same durability contract
+    if os.environ.get("MXT_BENCH_MULTIMODEL", "1") != "0":
+        _phase("multimodel", EPOCH_S)
+        try:
+            _STATE["multimodel"] = _multimodel_leg(mx, ctx)
+        except Exception as e:  # noqa: BLE001
+            _STATE["multimodel"] = {
                 "error": "%s: %s" % (type(e).__name__, str(e)[:200])}
 
 
@@ -1502,6 +1523,118 @@ def _drop_lock():
         os.unlink(LOCK_PATH)
     except OSError:
         pass
+
+
+def _multimodel_leg(mx, ctx):
+    """ISSUE 14: N=4 models in one ModelRegistry.  Reports request p99
+    with everything resident vs under budget-forced eviction churn
+    (the k=2 budget makes every traffic shift an evict+readmit), the
+    churn counters, and the readmission cost model: cache-warm readmit
+    (weights reload + persistent-compile-cache hit) vs cache-cold
+    (a fresh model's first compile — what readmission would cost
+    without the cache)."""
+    import tempfile
+
+    from mxnet_tpu import serving, sym
+    from mxnet_tpu.observability import memory as _mem
+    from mxnet_tpu.observability import metrics as _m
+    from mxnet_tpu import base as _base
+
+    # the restart-free story needs the persistent cache; wire a scratch
+    # dir when the operator didn't provide one
+    if not os.environ.get("MXNET_COMPILE_CACHE_DIR"):
+        os.environ["MXNET_COMPILE_CACHE_DIR"] = tempfile.mkdtemp(
+            prefix="mxt-bench-cc-")
+    _base.maybe_enable_compile_cache()
+
+    rs = np.random.RandomState(0)
+    nin, nhid, nout = 64, 128, 16
+    names = ["mm0", "mm1", "mm2", "mm3"]
+
+    def _model(pfx, seed):
+        net = sym.FullyConnected(sym.Variable("data"), num_hidden=nhid,
+                                 name=pfx + "fc1")
+        net = sym.Activation(net, act_type="relu")
+        net = sym.FullyConnected(net, num_hidden=nout, name=pfx + "fc2")
+        arg_shapes, _, _ = net.infer_shape(data=(16, nin))
+        params = {"arg:" + n: np.asarray(
+            np.random.RandomState(seed).normal(0, 0.05, s), "f")
+            for n, s in zip(net.list_arguments(), arg_shapes)
+            if n != "data"}
+        return net, params
+
+    reg = serving.ModelRegistry(budget_mb=0.0)
+    x = rs.normal(0, 1, (1, nin)).astype("f")
+    out = {}
+    try:
+        cold_ms = []
+        for i, name in enumerate(names):
+            net, params = _model(name, i)
+            t0 = time.perf_counter()
+            reg.register(name, net, params, {"data": (16, nin)},
+                         server_kwargs={"watchdog_interval_s": 60.0})
+            # first-ever warmup = the cache-cold compile cost per model
+            cold_ms.append((time.perf_counter() - t0) * 1e3)
+
+        def _p99(pattern, rounds):
+            lats = []
+            for i in range(rounds):
+                for name in pattern:
+                    t0 = time.perf_counter()
+                    reg.predict(model=name, data=x)
+                    lats.append(time.perf_counter() - t0)
+            return float(np.percentile(np.asarray(lats) * 1e3, 99))
+
+        out["p99_resident_ms"] = round(_p99(names, 15), 3)
+
+        # arm a budget that holds ~2 models, using the registry's own
+        # cost model (weights + largest compiled bucket peak): evict
+        # the colder pair, then leave ~0.3 models of slack — a swap
+        # (evict one, readmit one) always fits, a third model never
+        wb = reg._entry("mm0").predictor.host_payload_bytes()
+        peak = reg._entry("mm0").predictor.memory_stats()[
+            "peak_bytes_max"]
+        for n in names[2:]:
+            reg._entry(n).predictor.evict()
+        reg.budget_bytes = (_mem.tracked_bytes()
+                            + reg._committed_bytes()
+                            + 0.3 * (wb + peak))
+        ev0 = _m.SERVE_EVICTIONS.value
+        rd0 = _m.SERVE_READMITS.value
+        # pair-alternating traffic: every switch is an evict+readmit
+        out["p99_churn_ms"] = round(
+            _p99(["mm0", "mm1", "mm2", "mm3"], 15), 3)
+        out["evictions"] = int(_m.SERVE_EVICTIONS.value - ev0)
+        out["readmissions"] = int(_m.SERVE_READMITS.value - rd0)
+
+        # readmission cost, cache warm: budget off, evict, first
+        # request pays reload + disk-cache-hit compile
+        reg.budget_bytes = 0.0
+        warm_ms = []
+        for _ in range(3):
+            reg._entry("mm0").predictor.evict()
+            t0 = time.perf_counter()
+            reg.predict(model="mm0", data=x)
+            warm_ms.append((time.perf_counter() - t0) * 1e3)
+        out["readmit_ms_cache_warm"] = round(float(np.median(warm_ms)), 3)
+        # cache cold = a never-cached model's register+warmup (fresh
+        # XLA compile of the same architecture shape)
+        out["readmit_ms_cache_cold"] = round(float(np.median(cold_ms)), 3)
+        out["compile_cache_wired"] = bool(_base._COMPILE_CACHE_WIRED)
+        snap_serving = _obs_snapshot_serving()
+        if snap_serving is not None:
+            out["resident_models"] = snap_serving.get("resident_models")
+    finally:
+        reg.close()
+    return out
+
+
+def _obs_snapshot_serving():
+    try:
+        from mxnet_tpu.observability import metrics as _m
+        return _m.snapshot()["serving"]
+    except Exception:  # noqa: BLE001
+        return None
 
 
 def main():

@@ -25,6 +25,15 @@ docs/serving_resilience.md):
   ``serving.hot_reload``  ``BucketedPredictor.hot_reload`` entry (raise =
                           failed weight swap; auto-reload keeps old weights
                           and counts ``mxnet_serve_reload_failures_total``)
+  ``serving.evict``       ``ModelRegistry`` LRU eviction, once per victim
+                          (bucket or model) BEFORE any state is dropped —
+                          delay = slow eviction under churn, raise = a
+                          failed eviction the budgeter must skip (the
+                          victim stays resident; admission degrades to a
+                          typed ``ModelUnavailable`` when nothing else
+                          can be freed).  Lets the chaos suite drive
+                          deterministic eviction churn
+                          (docs/multi_model.md)
   ``checkpoint.io``       ``CheckpointManager`` write attempts (raise
                           ``OSError`` to exercise the retry path, the
                           default ``InjectedFault`` to exhaust it) plus a
@@ -100,8 +109,8 @@ ENV_VAR = "MXNET_FAULT_PLAN"
 #: the named sites the runtime has wired (fire() accepts any name — new
 #: sites need no registration — but these are the documented ones)
 SITES = ("serving.dispatch", "serving.batcher", "serving.hot_reload",
-         "checkpoint.io", "memory.oom", "trainer.step", "data.batch",
-         "kvstore.allreduce", "device.unavailable")
+         "serving.evict", "checkpoint.io", "memory.oom", "trainer.step",
+         "data.batch", "kvstore.allreduce", "device.unavailable")
 
 _MODES = ("raise", "delay", "corrupt")
 

@@ -62,6 +62,7 @@ from ..observability import introspect as _introspect
 from ..observability import memory as _memory
 from ..observability import metrics as _metrics
 from ..observability.tracing import trace_span
+from ..optimizer import HyperDeviceCache as _HyperDeviceCache
 from ..optimizer import cast_like as _cast_like
 from .. import symbol as sym_mod
 from ..symbol.graph import GraphPlan
@@ -203,9 +204,10 @@ class WholeStepCompiler:
         self._built = None
         self._fallback_reason = None  # permanent-fallback explanation
         self._warned = False
-        self._hyper = {}
-        self._ts = None
-        self._ts_next = None
+        # lr/wd last-value cache + device-resident step counter: the
+        # SAME implementation FusedUpdater.hyper_arrays uses (bitwise
+        # parity between step modes depends on identical seeding)
+        self._hyper_cache = _HyperDeviceCache()
         # once the program has executed successfully, runtime failures
         # (OOM included) must PROPAGATE, not silently fall back — the
         # failed call may already have invalidated donated buffers, so
@@ -574,36 +576,23 @@ class WholeStepCompiler:
     # -- per-step driver -----------------------------------------------------
     def _hyper_arrays(self, opt_, idx):
         """Device-cached lr/wd vectors + the device-resident step
-        counter (same last-value caching as FusedUpdater.hyper_arrays:
-        nothing re-uploads unless a schedule actually moves, and ts
-        lives on device, advanced by the compiled step itself — under
-        fp16 only on applied steps)."""
-        hc = self._hyper
-        lr_t = tuple(opt_._get_lr(i) for i in idx)
-        wd_t = tuple(opt_._get_wd(i) for i in idx)
-        # np.array over PYTHON scalars builds a host constant to ship
-        # device-ward — not a device read, so not a host sync:
-        if hc.get("lr_key") != lr_t:
-            hc["lr_key"] = lr_t
-            hc["lr"] = jnp.asarray(_np.array(lr_t, _np.float32))  # graft-lint: disable=host-sync
-        if hc.get("wd_key") != wd_t:
-            hc["wd_key"] = wd_t
-            hc["wd"] = jnp.asarray(_np.array(wd_t, _np.float32))  # graft-lint: disable=host-sync
-        counts_t = tuple(opt_._index_update_count[i] for i in idx)
-        if self._ts is None or self._ts_next != counts_t:
-            # (re)seed — first build, or an external path (per-key
-            # update, load_states) moved the counts.  A checkpointed
-            # APPLIED-step vector takes precedence: under fp16 the
-            # schedule counts include skipped steps, so reseeding Adam's
-            # bias-correction t from them would diverge from the
-            # uninterrupted run after any skip
+        counter — ``optimizer.HyperDeviceCache``, the same
+        implementation ``FusedUpdater.hyper_arrays`` uses (under fp16
+        the counter advances only on applied steps).  A checkpointed
+        APPLIED-step vector takes re-seed precedence: the schedule
+        counts include skipped steps, so reseeding Adam's
+        bias-correction t from them would diverge from the
+        uninterrupted run after any skip."""
+        def _pending():
             pend = getattr(self.trainer, "_applied_ts_pending", None)
             if pend is not None and pend[0] == idx:
-                self._ts = jnp.asarray(_np.array(pend[1], _np.int32))  # graft-lint: disable=host-sync
+                # consumed only when a (re)seed actually happens —
+                # HyperDeviceCache calls this inside its reseed branch
                 self.trainer._applied_ts_pending = None
-            else:
-                self._ts = jnp.asarray(_np.array(counts_t, _np.int32))  # graft-lint: disable=host-sync
-        return hc["lr"], hc["wd"], self._ts, counts_t
+                return pend[1]
+            return None
+
+        return self._hyper_cache.arrays(opt_, idx, pending_ts=_pending)
 
     def _run(self, built, data, label, bs, policy):
         tr = self.trainer
@@ -758,8 +747,7 @@ class WholeStepCompiler:
             st = tr._scaler
             st["scale"], st["good"] = new_scaler["scale"], \
                 new_scaler["good"]
-        self._ts = nts
-        self._ts_next = tuple(c + 1 for c in counts_t)
+        self._hyper_cache.commit(idx, nts, counts_t)
         # mirror the device-side applied-step vector onto the trainer so
         # save_states can persist it with the scaler (fp16 kill-resume:
         # ts lags the schedule counts by one per skipped step)

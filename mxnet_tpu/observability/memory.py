@@ -71,7 +71,8 @@ __all__ = ["ENABLED", "enabled", "enable", "disable", "memory_scope",
            "reset", "configure", "note_compiled", "compiled_stats",
            "compiled_stats_dict", "oom_guard", "is_oom",
            "wait_oom_dump", "last_oom", "DeviceMemoryError",
-           "HBMBudgetError", "UNTAGGED"]
+           "HBMBudgetError", "UNTAGGED", "budget_bytes",
+           "headroom_bytes", "set_budget_arbiter", "ensure_headroom"]
 
 # -- the fast-path switch ----------------------------------------------------
 # Hooks across ndarray/gluon/serving/checkpoint read this module global
@@ -474,6 +475,64 @@ def refresh_gauge() -> None:
     _refresh_gauge_from(dev, host)
 
 
+# -- budget arbitration -------------------------------------------------------
+# The soft budget above only WATCHES (warn at 90%, raise past 100%);
+# arbitration is the layer that NEGOTIATES: before a large allocation,
+# a subsystem asks ensure_headroom() whether the bytes fit, and a
+# registered arbiter — the serving ModelRegistry's LRU evictor — gets
+# the chance to free colder memory first.  The k+1'th model becomes a
+# policy decision instead of an OOM (docs/multi_model.md).
+_arbiter = None  # (deficit_bytes: float, why: str) -> freed estimate
+
+
+def budget_bytes() -> float:
+    """The armed soft budget in bytes (0.0 = budget off)."""
+    return BUDGET_MB * 1048576.0
+
+
+def headroom_bytes(budget: Optional[float] = None) -> float:
+    """Budget minus tracked live device bytes (+inf when no budget is
+    armed and no override is given).  ``budget`` overrides the env-armed
+    ``MXNET_HBM_BUDGET_MB`` in bytes — a registry running its own budget
+    passes it here so one arbitration code path serves both."""
+    b = budget_bytes() if budget is None else float(budget)
+    if b <= 0.0:
+        return float("inf")
+    return b - tracked_bytes()
+
+
+def set_budget_arbiter(fn):
+    """Install ``fn(deficit_bytes, why) -> freed_bytes_estimate`` as the
+    process arbiter (None uninstalls).  Returns the previous arbiter.
+    The arbiter is called OUTSIDE the ledger lock and must be safe to
+    invoke from any thread that allocates."""
+    global _arbiter
+    prev, _arbiter = _arbiter, fn
+    return prev
+
+
+def ensure_headroom(nbytes: float, why: str = "",
+                    budget: Optional[float] = None) -> bool:
+    """The budget arbitration chokepoint: would ``nbytes`` more tracked
+    device bytes still fit?  On a shortfall the registered arbiter is
+    asked to free the deficit (LRU eviction), then the answer is
+    re-evaluated.  True when the allocation fits (always, with no budget
+    armed); False means the caller should degrade (typed
+    ``ModelUnavailable`` / defer) instead of allocating into a certain
+    ``HBMBudgetError``."""
+    h = headroom_bytes(budget)
+    if h >= nbytes:
+        return True
+    fn = _arbiter
+    if fn is not None:
+        try:
+            fn(float(nbytes) - h, why)
+        except Exception as e:  # noqa: BLE001 — arbiter is best-effort
+            log.warning("budget arbiter failed (%s): %s", why, str(e))
+        return headroom_bytes(budget) >= nbytes
+    return False
+
+
 # -- compiled-program stats (CompiledMemoryStats registry) --------------------
 _compiled: Dict[str, dict] = {}
 
@@ -651,6 +710,8 @@ def reset() -> None:
     Weakref callbacks from still-live buffers registered before the
     reset become no-ops (their tokens are gone)."""
     global _device_total, _budget_warned, _last_oom_dump, _oom_dumps
+    global _arbiter
+    _arbiter = None  # a dead registry's evictor must not outlive it
     with _lock:
         _dead.clear()
         _entries.clear()

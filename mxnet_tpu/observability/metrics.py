@@ -502,6 +502,36 @@ SERVE_READY_TRANSITIONS = Counter(
     "readyz flips, by direction (up = became ready, down = became "
     "unready).  A flapping counter is the page-the-oncall signal that "
     "the replica is oscillating around a threshold")
+SERVE_EVICTIONS = Counter(
+    "mxnet_serve_evictions_total",
+    "LRU evictions by the multi-model HBM budgeter (serving."
+    "ModelRegistry), by kind (bucket = one AOT executable + its zero "
+    "placeholders dropped, model = device weights dropped too — host "
+    "param payload kept for restart-free readmission) and model.  "
+    "Eviction churn under a tight MXNET_HBM_BUDGET_MB is the DESIGN: "
+    "the k+1'th model degrades by policy instead of OOMing the process "
+    "(docs/multi_model.md)")
+SERVE_READMITS = Counter(
+    "mxnet_serve_readmissions_total",
+    "Readmissions of evicted serving state, by kind (model = weights "
+    "re-uploaded from the host payload, bucket = an evicted bucket's "
+    "executable rebuilt — a persistent-compile-cache hit when "
+    "MXNET_COMPILE_CACHE_DIR is wired, so it never counts against the "
+    "stay-flat SERVE_COMPILES contract).  readmissions/evictions is "
+    "the churn ratio: high means the budget is too tight for the "
+    "working set")
+SERVE_RESIDENT_MODELS = Gauge(
+    "mxnet_serve_resident_models",
+    "Registered serving models whose device weights are currently "
+    "resident (ModelRegistry; total registered minus weights-evicted).  "
+    "Bounded by MXNET_SERVE_MAX_MODELS")
+SERVE_MODEL_HBM_BYTES = Gauge(
+    "mxnet_serve_model_hbm_bytes",
+    "Tracked device bytes per registered serving model (its served "
+    "weights + bucket placeholders; 0 while weights-evicted), by model "
+    "label — the bounded per-model slice of the process-wide "
+    "serve_weights ledger tag, refreshed on every eviction/readmission "
+    "and at snapshot()")
 SERVE_RELOAD_FAILURES = Counter(
     "mxnet_serve_reload_failures_total",
     "Serving auto-reload poll failures (missing/corrupt checkpoint "
@@ -566,8 +596,10 @@ MEMORY_LEDGER_BYTES = Gauge(
     "Tracked live bytes by ledger tag and space (mxnet_tpu."
     "observability.memory; bounded tag set — param/grad/output/executor/"
     "optimizer_state/grad_bucket/compression_residual/serve_weights/"
-    "kvstore/prefetch/data/checkpoint_host, "
-    "space=device|host [host = e.g. checkpoint snapshot twins], and "
+    "kvstore/prefetch/data/checkpoint_host/serve_host_params, "
+    "space=device|host [host = e.g. checkpoint snapshot twins and the "
+    "serve_host_params readmission payload evicted serving models "
+    "reload from], and "
     "_untagged for the unattributed remainder).  Refreshed at export "
     "time from the weakref ledger, never on the hot path")
 SERVE_BUCKET_HBM_BYTES = Gauge(
@@ -744,6 +776,17 @@ def dispatch_counts() -> Dict[str, float]:
     return out
 
 
+def _sum_by_label(counter: Counter, label: str) -> Dict[str, float]:
+    """Aggregate a labeled counter's children over one label (the
+    snapshot()-friendly marginal, e.g. evictions by kind summed over
+    models).  list() snapshots against concurrent label inserts."""
+    out: Dict[str, float] = {}
+    for k, v in list(counter._children.items()):
+        key = dict(k).get(label, "_")
+        out[key] = out.get(key, 0.0) + v
+    return out
+
+
 def _flight_snapshot() -> dict:
     """snapshot()["flight"]: ring/watchdog state + per-phase p50/p99 +
     slowest-record exemplars (docs/observability.md).  Lazy/guarded —
@@ -836,6 +879,16 @@ def snapshot() -> dict:
             "ready_transitions": SERVE_READY_TRANSITIONS.value,
             "reload_failures": SERVE_RELOAD_FAILURES.value,
             "faults_injected": FAULTS_INJECTED.value,
+            # multi-model registry (docs/multi_model.md): eviction
+            # churn by kind, the resident-model gauge, and the
+            # per-model HBM slice — list() snapshots against the
+            # registry mutating label sets mid-export
+            "evictions": _sum_by_label(SERVE_EVICTIONS, "kind"),
+            "readmissions": SERVE_READMITS.value,
+            "resident_models": SERVE_RESIDENT_MODELS.get(),
+            "model_hbm_bytes": {
+                dict(k).get("model", "_"): v for k, v in
+                sorted(list(SERVE_MODEL_HBM_BYTES._children.items()))},
             # exemplar hop: p99 bucket -> trace_id -> flight dump spans
             "latency_exemplars": SERVE_LATENCY_SECONDS.exemplars(),
         },

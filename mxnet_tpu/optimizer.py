@@ -891,6 +891,65 @@ def _to_np_state(state):
     return state
 
 
+class HyperDeviceCache:
+    """Device-cached (lr, wd) vectors + a device-resident step counter
+    per key tuple — the ONE implementation behind
+    ``FusedUpdater.hyper_arrays`` and ``WholeStepCompiler``'s hyper
+    plumbing (formerly two ~30-line mirrors; the fused/whole-step
+    bitwise-parity tests pin that sharing it changes nothing).
+
+    Through the tunnel every fresh host->device transfer costs a
+    latency hop on the hot path, so lr/wd re-upload only when a
+    schedule actually changes them (last-VALUE cache — a per-step
+    schedule must not grow a dict by one device array per step), and
+    the step counter lives ON DEVICE, incremented by the compiled
+    update itself; call ``commit(...)`` after the step lands.  When the
+    python-side schedule counts diverge from the committed device
+    counter (a per-key update interleaved, ``load_states``), the
+    counter re-seeds from them — or, via ``pending_ts``, from a
+    checkpointed APPLIED-step vector (fp16 skip-steps make Adam's
+    bias-correction t lag the schedule counts; docs/perf_tuning.md)."""
+
+    def __init__(self):
+        self._hc: Dict[str, Any] = {}
+        self._ts: Dict[tuple, tuple] = {}  # idx -> (device ts, counts)
+
+    def arrays(self, opt_, indices, pending_ts=None):
+        """Return ``(lrs, wds, ts, counts_t)`` for ``indices``.
+        ``pending_ts``: zero-arg callable yielding an int tuple to seed
+        the device counter from (consumed only when a (re)seed actually
+        happens), or None."""
+        idx = tuple(indices)
+        hc = self._hc
+        lr_t = tuple(opt_._get_lr(i) for i in idx)
+        wd_t = tuple(opt_._get_wd(i) for i in idx)
+        # np.array over PYTHON scalars (lr/wd schedules) builds a host
+        # constant to ship device-ward — no device value is read, so
+        # these are not the syncs the host-sync rule hunts:
+        if hc.get("lr_key") != lr_t:
+            hc["lr_key"] = lr_t
+            hc["lr"] = jnp.asarray(_np.array(lr_t, _np.float32))  # graft-lint: disable=host-sync
+        if hc.get("wd_key") != wd_t:
+            hc["wd_key"] = wd_t
+            hc["wd"] = jnp.asarray(_np.array(wd_t, _np.float32))  # graft-lint: disable=host-sync
+        counts_t = tuple(opt_._index_update_count[i] for i in idx)
+        ent = self._ts.get(idx)
+        if ent is not None and ent[1] == counts_t:
+            ts = ent[0]
+        else:
+            seed = pending_ts() if pending_ts is not None else None
+            # python ints -> device constant (see lr/wd note above)
+            ts = jnp.asarray(_np.array(
+                counts_t if seed is None else seed, _np.int32))  # graft-lint: disable=host-sync
+        return hc["lr"], hc["wd"], ts, counts_t
+
+    def commit(self, indices, new_ts, counts_t) -> None:
+        """Adopt the stepped device counter for ``indices`` — valid
+        while the python schedule counts advance exactly once."""
+        self._ts[tuple(indices)] = (new_ts,
+                                    tuple(c + 1 for c in counts_t))
+
+
 class FusedUpdater(Updater):
     """Multi-tensor updater: ONE jitted XLA program updates every parameter.
 
@@ -992,49 +1051,23 @@ class FusedUpdater(Updater):
         return new
 
     def hyper_arrays(self, indices):
-        """Device-cached (lrs, wds, ts, commit_ts) for a key tuple.
-
-        NOTE: gluon/wholestep.py's WholeStepCompiler._hyper_arrays
-        mirrors this caching scheme (plus a checkpointed applied-ts
-        precedence branch for fp16 skip-steps) — a behavioral change
-        here must be mirrored there for fused/whole-step optimizer
-        state to stay interchangeable.
-
-        Through the tunnel every fresh host->device transfer costs a
-        latency hop on the hot path, so lr/wd re-upload only when a
-        schedule actually changes them (last-VALUE cache — a per-step
-        schedule must not grow a dict by one device array per step) and
-        the per-key step counter lives ON DEVICE, incremented by the
-        compiled update itself; call commit_ts(new_ts) after the step.
-        Re-seeds from the python counts when they diverge (e.g. a
-        per-key update interleaved).  Shared by update_all and the
-        module-level fused train step."""
-        opt_ = self.optimizer
-        hc = self.__dict__.setdefault("_hyper_cache", {})
-        lr_t = tuple(opt_._get_lr(i) for i in indices)
-        wd_t = tuple(opt_._get_wd(i) for i in indices)
-        # np.array over PYTHON scalars (lr/wd schedules) builds a host
-        # constant to ship device-ward — no device value is read, so
-        # these are not the syncs the host-sync rule hunts:
-        if hc.get("lr_key") != lr_t:
-            hc["lr_key"] = lr_t
-            hc["lr"] = jnp.asarray(_np.array(lr_t, _np.float32))  # graft-lint: disable=host-sync
-        if hc.get("wd_key") != wd_t:
-            hc["wd_key"] = wd_t
-            hc["wd"] = jnp.asarray(_np.array(wd_t, _np.float32))  # graft-lint: disable=host-sync
-        counts_t = tuple(opt_._index_update_count[i] for i in indices)
-        tc = self.__dict__.setdefault("_ts_cache", {})
-        ent = tc.get(tuple(indices))
-        if ent is not None and ent[1] == counts_t:
-            ts = ent[0]
-        else:
-            # python ints -> device constant (see lr/wd note above)
-            ts = jnp.asarray(_np.array(counts_t, _np.int32))  # graft-lint: disable=host-sync
+        """Device-cached (lrs, wds, ts, commit_ts) for a key tuple —
+        ``HyperDeviceCache`` does the work (one implementation shared
+        with ``WholeStepCompiler``, so fused/whole-step optimizer state
+        stays interchangeable by construction).  Shared by update_all
+        and the module-level fused train step."""
+        # lazy but allocation-free once built: setdefault would
+        # construct (and discard) a fresh cache object every step
+        cache = self.__dict__.get("_hyper_dev")
+        if cache is None:
+            cache = self.__dict__["_hyper_dev"] = HyperDeviceCache()
+        idx = tuple(indices)
+        lrs, wds, ts, counts_t = cache.arrays(self.optimizer, idx)
 
         def commit_ts(nts):
-            tc[tuple(indices)] = (nts, tuple(c + 1 for c in counts_t))
+            cache.commit(idx, nts, counts_t)
 
-        return hc["lr"], hc["wd"], ts, commit_ts
+        return lrs, wds, ts, commit_ts
 
     @staticmethod
     def _materialize_views(grads, grad_views):

@@ -19,6 +19,13 @@ docs/serving_resilience.md are the guides):
     `DeadlineExceeded`), and a `healthz()`/`readyz()` surface fed from
     the metrics registry.  Failure behavior is testable via
     `mxnet_tpu.faultinject`.
+  - `ModelRegistry` — N models in one process under an HBM budget
+    (`MXNET_HBM_BUDGET_MB`, `MXNET_SERVE_MAX_MODELS`): LRU eviction of
+    cold buckets then cold models (`MXNET_SERVE_EVICT_POLICY`),
+    restart-free readmission via the persistent compile cache, a typed
+    degradation ladder ending in `ModelUnavailable` with retry-after,
+    and tenant→model routing through each model's bounded queues
+    (docs/multi_model.md).
 
 Every request is flight-recorded end to end (ISSUE 8,
 docs/observability.md): a trace_id minted at submit rides through
@@ -36,14 +43,18 @@ Reference lineage: the C predict API + bucketing executors of MXNet
 from . import buckets
 from .buckets import (BucketSpec, covering_bucket, pad_to_shape,
                       parse_bucket_env, pow2_buckets)
-from .predictor import BucketedPredictor
+from .predictor import BucketedPredictor, ModelEvictedError
 from .batcher import (BatcherClosedError, BatcherDeadError, MicroBatcher,
                       stack_requests)
 from . import resilience
 from .resilience import DeadlineExceeded, Overloaded, ResilientServer
+from . import registry
+from .registry import ModelRegistry, ModelUnavailable
 
 __all__ = ["BucketSpec", "BucketedPredictor", "MicroBatcher",
            "ResilientServer", "Overloaded", "DeadlineExceeded",
            "BatcherClosedError", "BatcherDeadError", "buckets",
            "resilience", "covering_bucket", "pad_to_shape",
-           "parse_bucket_env", "pow2_buckets", "stack_requests"]
+           "parse_bucket_env", "pow2_buckets", "stack_requests",
+           "registry", "ModelRegistry", "ModelUnavailable",
+           "ModelEvictedError"]

@@ -49,7 +49,14 @@ from ..symbol import Symbol
 from ..symbol.graph import GraphPlan
 from .buckets import BucketSpec, bucket_label, pad_to_shape
 
-__all__ = ["BucketedPredictor"]
+__all__ = ["BucketedPredictor", "ModelEvictedError"]
+
+
+class ModelEvictedError(MXNetError):
+    """A dispatch/compile reached a predictor whose device weights are
+    evicted.  The registry readmits at submit, so this surfacing to a
+    caller means a request raced an eviction (or bypassed the registry)
+    — readmit() and retry."""
 
 
 class BucketedPredictor:
@@ -81,7 +88,7 @@ class BucketedPredictor:
                  dev=None, batch_buckets=None, seq_axes=None,
                  seq_buckets=None, input_dtypes=None,
                  output_names: Optional[Sequence[str]] = None,
-                 donate: bool = True):
+                 donate: bool = True, resident: bool = True):
         from ..predictor import load_param_payload, split_arg_aux
         maybe_enable_compile_cache()
         if isinstance(symbol, Symbol):
@@ -96,7 +103,15 @@ class BucketedPredictor:
         self._plan = GraphPlan(sym)
         self._donate = bool(donate)
 
-        arg_params, aux_params = split_arg_aux(load_param_payload(params))
+        # a dict payload stays host-side as-is (load_param_payload
+        # would wrap numpy values in DEVICE NDArrays — a transient
+        # second copy of the whole model that pollutes the HBM ledger
+        # a multi-model budgeter admits against); blob/path payloads
+        # still load through it, and the transient is dropped below
+        # before the served weights allocate
+        payload = dict(params) if isinstance(params, dict) \
+            else load_param_payload(params)
+        arg_params, aux_params = split_arg_aux(payload)
         arg_names = sym.list_arguments()
         self._input_names = [n for n in arg_names if n not in arg_params]
         for name in input_shapes:
@@ -106,18 +121,57 @@ class BucketedPredictor:
                     f"inputs: {self._input_names}")
         dev_j = self._ctx.jax_device()
 
+        def _host_copy(v):
+            # an OWNED copy, never an alias: np.asarray on a caller's
+            # numpy array is no-copy, and registering caller-owned
+            # buffers under our tag would misattribute them for as
+            # long as the caller holds them (and retag ones the caller
+            # already registered)
+            arr = v.asnumpy() if isinstance(v, NDArray) else \
+                _np.array(v, copy=True)
+            # host twin of the served weights: the restart-free
+            # readmission source after evict() — a reload costs one
+            # device_put per array, never a training-checkpoint round
+            # trip (ledger tag serve_host_params, space=host)
+            return _memory.register_host(arr, tag="serve_host_params")
+
+        # the host param payload outlives the device weights: evict()
+        # drops the device copies (and the AOT executables) but keeps
+        # this, so readmit() is a reload + cache-hit compile
+        self._host_payload = (
+            {k: _host_copy(v) for k, v in arg_params.items()},
+            {k: _host_copy(v) for k, v in aux_params.items()})
+        # drop any loader-made device NDArrays NOW — the served
+        # weights below must be the payload's only device copy
+        del payload, arg_params, aux_params
+
         def _to_dev(v):
-            arr = jax.device_put(
-                v._data if isinstance(v, NDArray) else _np.asarray(v), dev_j)
+            arr = jax.device_put(_np.asarray(v), dev_j)
             # HBM ledger: served weights are the long-lived buffers a
             # multi-model budgeter evicts against — always attributed
             return _memory.register(arr, tag="serve_weights")
 
         # one tuple holds the live (params, aux) pair: hot_reload swaps
         # it with a single reference assignment, so no reader can ever
-        # see params of one checkpoint with aux of another
-        self._weights = ({k: _to_dev(v) for k, v in arg_params.items()},
-                         {k: _to_dev(v) for k, v in aux_params.items()})
+        # see params of one checkpoint with aux of another.
+        # resident=False constructs straight onto the weights_evicted
+        # ladder rung — host payload only, NO device allocation, so a
+        # registry can admit a model that does not currently fit the
+        # HBM budget without transiently blowing that same budget
+        self._closed = False
+        # distinguishes a first admission from a true readmission:
+        # only the latter counts in SERVE_READMITS
+        self._was_evicted = False
+        if resident:
+            self._weights = (
+                {k: _to_dev(v)
+                 for k, v in self._host_payload[0].items()},
+                {k: _to_dev(v)
+                 for k, v in self._host_payload[1].items()})
+            self._resident = True
+        else:
+            self._weights = ({}, {})
+            self._resident = False
         self._input_dtypes = {
             n: np_dtype((input_dtypes or {}).get(n, "float32"))
             for n in input_shapes}
@@ -130,14 +184,25 @@ class BucketedPredictor:
         self._rng = jax.random.PRNGKey(0)
         self._compiled: Dict[tuple, object] = {}
         self._extra: Dict[tuple, dict] = {}  # per-bucket zero placeholders
+        # LRU clock per bucket (stamped at precompile and every
+        # dispatch) + the set of keys EVER compiled in this process:
+        # a rebuild of an evicted bucket is a readmission (a
+        # persistent-cache hit when MXNET_COMPILE_CACHE_DIR is wired),
+        # not an escape from the bucket set, so it must not count
+        # against the stay-flat SERVE_COMPILES contract
+        self._bucket_used: Dict[tuple, float] = {}
+        self._ever_compiled: set = set()
         # per-bucket CompiledMemoryStats (memory.compiled_stats_dict
         # shape), filled at precompile — feeds readyz + the
         # SERVE_BUCKET_HBM_BYTES gauge (docs/memory.md)
         self._mem_stats: Dict[tuple, dict] = {}
         # compiles may be triggered concurrently by batcher + direct
-        # callers; one lock keeps "compile each bucket once" true
+        # callers; one lock keeps "compile each bucket once" true.  It
+        # also guards the weights/payload lifecycle swaps (hot_reload
+        # on the auto-reload thread vs evict/readmit/close from a
+        # registry) — reentrant because evict() nests evict_bucket()
         from ..analysis import sanitizer as _san
-        self._compile_lock = _san.make_lock("serving.predictor.compile")
+        self._compile_lock = _san.make_rlock("serving.predictor.compile")
 
         plan = self._plan
 
@@ -187,6 +252,11 @@ class BucketedPredictor:
         with self._compile_lock:
             if key in self._compiled:
                 return self._compiled[key]
+            if not self._resident:
+                raise ModelEvictedError(
+                    "model weights are evicted — readmit() before "
+                    "compiling/serving (a ModelRegistry does this at "
+                    "submit; see docs/multi_model.md)")
             in_shapes = self.spec.bucket_input_shapes(key)
             extra = {n: _memory.register(jax.device_put(
                 _np.zeros(s, _np.float32), self._ctx.jax_device()),
@@ -223,8 +293,24 @@ class BucketedPredictor:
                 compiled = self._jit.lower(
                     data_avals, extra_avals, param_avals, aux_avals,
                     self._rng).compile()
+            from .. import base as _base
+            readmission = (key in self._ever_compiled
+                           and _base._COMPILE_CACHE_WIRED)
             if _metrics.ENABLED:
-                _metrics.SERVE_COMPILES.inc()
+                if readmission:
+                    # rebuilding an evicted bucket with the persistent
+                    # compile cache warm: the lower().compile() above
+                    # was a disk hit, not a fresh XLA compile — counted
+                    # as a readmission so SERVE_COMPILES keeps meaning
+                    # "requests escaped the bucket set"
+                    _metrics.SERVE_READMITS.inc(kind="bucket")
+                else:
+                    _metrics.SERVE_COMPILES.inc()
+                    if key in self._ever_compiled:
+                        # evicted bucket rebuilt WITHOUT the persistent
+                        # cache: a real recompile AND a readmission
+                        _metrics.SERVE_READMITS.inc(kind="bucket")
+            self._ever_compiled.add(key)
             # compiled cost + HBM table per bucket, straight from XLA's
             # own analyses — what serving this bucket COSTS before any
             # request runs.  note_program is the ONE compiled-stats
@@ -252,6 +338,7 @@ class BucketedPredictor:
                         mem["peak_bytes"], bucket=label)
             self._extra[key] = extra
             self._compiled[key] = compiled
+            self._bucket_used[key] = time.monotonic()
             return compiled
 
     def warmup(self, keys=None) -> "BucketedPredictor":
@@ -280,8 +367,16 @@ class BucketedPredictor:
         # (batcher, warmup) inserts new buckets concurrently; the inner
         # stat dicts are write-once at insert so copying them is safe
         stats = dict(self._mem_stats)
-        per_bucket = {bucket_label(k): dict(v)
-                      for k, v in sorted(stats.items())}
+        resident = set(self._compiled)
+        per_bucket = {}
+        for k, v in sorted(stats.items()):
+            d = dict(v)
+            # evicted buckets keep their stats entry (it is the
+            # registry's readmission cost estimate) but are flagged so
+            # peak totals below only count executables that are LIVE
+            d["resident"] = k in resident
+            per_bucket[bucket_label(k)] = d
+        live = [v for v in per_bucket.values() if v["resident"]]
         params, aux = self._weights
         weights = sum(_memory.nbytes_of(a) for d in (params, aux)
                       for a in d.values())
@@ -290,10 +385,10 @@ class BucketedPredictor:
                        for a in ph.values())
         return {
             "buckets": per_bucket,
+            "resident": self._resident,
             "peak_bytes_max": max(
-                (v["peak_bytes"] for v in per_bucket.values()), default=0),
-            "peak_bytes_total": sum(
-                v["peak_bytes"] for v in per_bucket.values()),
+                (v["peak_bytes"] for v in live), default=0),
+            "peak_bytes_total": sum(v["peak_bytes"] for v in live),
             "weights_bytes": int(weights),
         }
 
@@ -347,6 +442,18 @@ class BucketedPredictor:
     @hot_path
     def _dispatch(self, key: tuple, padded: dict) -> list:
         compiled = self.precompile(key)
+        # snapshot the placeholders WITH the executable: a concurrent
+        # registry bucket eviction between precompile and here drops
+        # _extra[key]; one rebuild pass keeps the failure typed instead
+        # of a KeyError poisoning the whole dispatch group
+        extra = self._extra.get(key)
+        if extra is None:
+            compiled = self.precompile(key)
+            extra = self._extra.get(key)
+            if extra is None:
+                raise ModelEvictedError(
+                    f"bucket {key} evicted mid-dispatch — retry")
+        self._bucket_used[key] = time.monotonic()  # LRU clock
         # the flight span opens BEFORE the chaos site: an injected
         # delay models a slow model under load, so it must show up as a
         # long serve_dispatch phase in the timeline — exactly what the
@@ -366,9 +473,12 @@ class BucketedPredictor:
                 _metrics.SERVE_BATCHES.inc()
             # one read: a mid-call hot_reload can't tear the pair
             params, aux = self._weights
+            if not params and not aux and not self._resident:
+                raise ModelEvictedError(
+                    "model weights were evicted between precompile and "
+                    "dispatch — readmit() and retry")
             with trace_span("serve_dispatch", cat="serving"):
-                return compiled(padded, self._extra[key], params, aux,
-                                self._rng)
+                return compiled(padded, extra, params, aux, self._rng)
 
     @hot_path
     def _predict_routed(self, inputs: Dict[str, _np.ndarray]) -> list:
@@ -433,6 +543,169 @@ class BucketedPredictor:
     # porting off `Predictor`)
     forward = predict
 
+    # -- eviction / readmission (the multi-model HBM budget surface) ---------
+    @property
+    def resident(self) -> bool:
+        """False after evict(): device weights (and every AOT bucket
+        executable) are dropped; only the host param payload remains."""
+        return self._resident
+
+    def resident_bucket_ages(self) -> List[tuple]:
+        """``[(key, last_used_monotonic)]`` for every RESIDENT bucket —
+        the registry's LRU candidate list (stamped at precompile and at
+        every dispatch)."""
+        used = dict(self._bucket_used)
+        return [(k, used.get(k, 0.0)) for k in list(self._compiled)]
+
+    def bucket_cost_estimate(self, key: tuple) -> int:
+        """Expected compiled peak HBM bytes of ``key`` — the admission
+        question a budgeter asks BEFORE a precompile.  Exact for
+        previously-compiled (evicted) buckets via their retained
+        CompiledMemoryStats; a never-compiled bucket borrows the
+        largest known peak of this model (0 when nothing is known yet —
+        the ledger's hard budget stays the backstop)."""
+        st = self._mem_stats.get(key)
+        if st:
+            return int(st.get("peak_bytes", 0))
+        return int(max((v.get("peak_bytes", 0)
+                        for v in dict(self._mem_stats).values()),
+                       default=0))
+
+    def host_payload_bytes(self) -> int:
+        """Bytes the device weights would occupy on readmission (the
+        host payload mirrors their shapes/dtypes exactly)."""
+        p, a = self._host_payload
+        return int(sum(_memory.nbytes_of(v) for d in (p, a)
+                       for v in d.values()))
+
+    def evict_bucket(self, key: tuple, blocking: bool = True) -> int:
+        """Drop one bucket's AOT executable + zero placeholders (LRU
+        bucket eviction).  The bucket's CompiledMemoryStats entry is
+        kept as the readmission cost estimate.  Returns the estimated
+        device bytes freed (compiled peak + tracked placeholders);
+        idempotent.  ``blocking=False`` returns 0 when the compile
+        lock is busy — a registry sweep must not stall every model's
+        admission behind one model's in-flight XLA compile (a model
+        mid-compile is not cold anyway)."""
+        if not self._compile_lock.acquire(blocking=blocking):
+            return 0
+        try:
+            return self._evict_bucket_locked(key)
+        finally:
+            self._compile_lock.release()
+
+    def _evict_bucket_locked(self, key: tuple) -> int:
+        if key not in self._compiled:
+            return 0
+        freed = int(self._mem_stats.get(key, {}).get("peak_bytes", 0))
+        freed += sum(_memory.nbytes_of(a)
+                     for a in self._extra.get(key, {}).values())
+        del self._compiled[key]
+        self._extra.pop(key, None)
+        self._bucket_used.pop(key, None)
+        if _metrics.ENABLED:
+            # the per-bucket HBM gauge must not advertise an
+            # executable that no longer exists
+            _metrics.SERVE_BUCKET_HBM_BYTES.remove(
+                bucket=bucket_label(key))
+        return freed
+
+    def evict(self, blocking: bool = True) -> int:
+        """Full model eviction: every bucket executable, every zero
+        placeholder, and the device weights are dropped — the host
+        param payload stays, so ``readmit()`` is a reload + (cache-hit)
+        recompile, never a restart.  Returns estimated device bytes
+        freed.  In-flight dispatches that already read the weights pair
+        finish on the old buffers (freed when they complete); new
+        dispatches raise a typed ``ModelEvictedError``.
+        ``blocking=False`` returns 0 when the compile lock is busy —
+        a model mid-compile is not a cold victim, and a registry sweep
+        holding its own lock must not stall every admission behind
+        this model's XLA compile."""
+        if not blocking:
+            # probe-then-recurse: the RLock makes the blocking branch's
+            # `with` nest inside this probe hold, so the busy check and
+            # the eviction are one atomic acquisition
+            if not self._compile_lock.acquire(blocking=False):
+                return 0
+            try:
+                return self.evict()
+            finally:
+                self._compile_lock.release()
+        with self._compile_lock:
+            freed = 0
+            # residency flips first: a dispatch racing this sees either
+            # the full old pair (serves fine) or the empty pair + flag
+            self._resident = False
+            self._was_evicted = True
+            for key in list(self._compiled):
+                freed += self.evict_bucket(key)
+            params, aux = self._weights
+            freed += sum(_memory.nbytes_of(a) for d in (params, aux)
+                         for a in d.values())
+            self._weights = ({}, {})
+            return freed
+
+    # back-compat-friendly alias: "weights eviction" in the ladder docs
+    evict_weights = evict
+
+    def readmit(self) -> None:
+        """Re-upload the host param payload to the device and mark the
+        model servable again.  Bucket executables rebuild lazily at the
+        next dispatch per key — a persistent-compile-cache hit when
+        ``MXNET_COMPILE_CACHE_DIR`` is wired (counted as
+        ``mxnet_serve_readmissions_total{kind="bucket"}``, never as a
+        ``SERVE_COMPILES`` escape).  Idempotent."""
+        with self._compile_lock:
+            if self._resident:
+                return
+            if self._closed:
+                raise MXNetError("predictor is closed")
+            dev_j = self._ctx.jax_device()
+
+            def _to_dev(v):
+                return _memory.register(jax.device_put(v, dev_j),
+                                        tag="serve_weights")
+
+            host_p, host_a = self._host_payload
+            # oom_guard: on a genuinely full device the upload fails
+            # TYPED (DeviceMemoryError + post-mortem), never a raw
+            # backend RESOURCE_EXHAUSTED — the ladder contract holds
+            # at the readmission chokepoint too, and a registry can
+            # map it to ModelUnavailable
+            with _memory.oom_guard("serving.readmit"):
+                self._weights = (
+                    {k: _to_dev(v) for k, v in host_p.items()},
+                    {k: _to_dev(v) for k, v in host_a.items()})
+            self._resident = True
+            was_evicted = self._was_evicted
+        if was_evicted and _metrics.ENABLED:
+            # a resident=False construction admitting for the first
+            # time is not churn — only an evict->readmit cycle counts
+            _metrics.SERVE_READMITS.inc(kind="model")
+
+    def close(self) -> None:
+        """Tear the predictor down completely: auto-reload stopped,
+        device weights + executables + placeholders dropped, host
+        payload released — every ledger-tagged byte (serve_weights
+        device-side, serve_host_params host-side) returns to baseline
+        once the caller drops its reference.  Idempotent."""
+        if self._closed:
+            return
+        self._closed = True
+        self.stop_auto_reload()
+        with self._compile_lock:
+            self.evict()
+            self._host_payload = ({}, {})
+            self._mem_stats.clear()
+            self._ever_compiled.clear()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
     # -- checkpoint hot reload ----------------------------------------------
     @property
     def loaded_step(self):
@@ -459,6 +732,12 @@ class BucketedPredictor:
         # chaos site: a raise here proves the old-weights-keep-serving
         # contract — auto-reload catches, counts, and keeps polling
         _fi_fire("serving.hot_reload")
+        if not self._resident:
+            # an evicted model has no served weights to swap; auto-reload
+            # counts this as a failed poll and retries — the next poll
+            # after readmit() picks the checkpoint up
+            raise MXNetError(
+                "hot_reload: model weights are evicted — readmit() first")
         mgr = self._as_checkpoint_manager(source)
         res = mgr.restore(step)
         if res is None:
@@ -471,7 +750,9 @@ class BucketedPredictor:
         # gluon checkpoints carry BN running stats as Parameters).  An
         # arg: entry can never silently satisfy an aux name or vice
         # versa even when base names collide.
-        def _lookup(name, prefixes, what, cur):
+        new_host = ({}, {})
+
+        def _lookup(name, prefixes, what, cur, host_out):
             for prefix in prefixes:
                 if prefix + name in state:
                     arr = _np.asarray(state[prefix + name])
@@ -480,9 +761,11 @@ class BucketedPredictor:
                             f"hot_reload: {what} '{name}' shape "
                             f"{arr.shape} != serving shape "
                             f"{tuple(cur.shape)}")
-                    return _memory.register(jax.device_put(
-                        arr.astype(cur.dtype, copy=False), dev_j),
-                        tag="serve_weights")
+                    arr = arr.astype(cur.dtype, copy=False)
+                    host_out[name] = _memory.register_host(
+                        arr, tag="serve_host_params")
+                    return _memory.register(jax.device_put(arr, dev_j),
+                                            tag="serve_weights")
             raise MXNetError(
                 f"hot_reload: checkpoint step {got_step} lacks served "
                 f"{what} '{name}' — old weights keep serving")
@@ -490,16 +773,26 @@ class BucketedPredictor:
         dev_j = self._ctx.jax_device()
         old_params, old_aux = self._weights
         new_params = {name: _lookup(name, (PARAM_PREFIX, ARG_PREFIX),
-                                    "parameter", cur)
+                                    "parameter", cur, new_host[0])
                       for name, cur in old_params.items()}
         new_aux = {name: _lookup(name, (AUX_PREFIX, PARAM_PREFIX),
-                                 "aux state", cur)
+                                 "aux state", cur, new_host[1])
                    for name, cur in old_aux.items()}
         # ONE reference assignment commits both dicts together:
         # in-flight _dispatch calls hold the old pair, new requests see
-        # the new pair — never params of one step with aux of another
-        self._weights = (new_params, new_aux)
-        self._loaded_step = got_step
+        # the new pair — never params of one step with aux of another.
+        # Committed under the lifecycle lock: an evict/close racing
+        # this swap must not be clobbered by a late reload commit
+        with self._compile_lock:
+            if not self._resident:
+                raise MXNetError(
+                    "hot_reload: model was evicted mid-reload — "
+                    "readmit() first")
+            self._weights = (new_params, new_aux)
+            # the readmission source must follow the served weights, or
+            # an evict/readmit cycle would resurrect pre-reload params
+            self._host_payload = new_host
+            self._loaded_step = got_step
         return got_step
 
     def start_auto_reload(self, source, interval_s: float = 30.0) -> None:

@@ -174,8 +174,17 @@ class ResilientServer:
                  unready_failure_rate: float = 0.5,
                  stall_timeout_s: float = 10.0,
                  reload_staleness_s: Optional[float] = None,
-                 max_tenants: int = 256):
+                 max_tenants: int = 256,
+                 extra_ready=None, oom_retry=None):
         self._pred = predictor
+        # extra_ready: () -> (checks_dict, detail_dict), merged into
+        # readyz — a ModelRegistry adds per-model degradation detail.
+        # oom_retry: (DeviceMemoryError) -> bool; True = the handler
+        # freed device memory (registry LRU eviction) and the failed
+        # dispatch may run ONCE more instead of failing its futures —
+        # an OOM becomes a policy decision, not a request error
+        self._extra_ready = extra_ready
+        self._oom_retry = oom_retry
         self.max_queue = int(getenv("MXNET_SERVE_MAX_QUEUE", 64)) \
             if max_queue is None else int(max_queue)
         if self.max_queue < 1:
@@ -210,6 +219,13 @@ class ResilientServer:
         self._rr: List[str] = []      # tenant round-robin order
         self._rr_idx = 0
         self._seq = itertools.count()
+        # admitted-but-unresolved requests (queued, being grouped in
+        # the hold-open window, or in flight) — the registry's
+        # is-this-model-idle signal.  Maintained by a done-callback on
+        # every admitted future so served/expired/failed/closed all
+        # decrement, and nothing is invisible mid-grouping the way a
+        # queue+inflight snapshot would be
+        self._live = 0
         self._closed = False
         self._fatal: Optional[BaseException] = None
         self._inflight: Optional[List[_Request]] = None
@@ -308,6 +324,8 @@ class ResilientServer:
                                     next(self._seq), req))
             t.rows_queued += req.rows
             t.admitted += 1
+            self._live += 1
+            req.future.add_done_callback(self._one_resolved)
             if _metrics.ENABLED:
                 _metrics.SERVE_ADMITTED.inc(tenant=tenant)
                 _metrics.SERVE_QUEUE_DEPTH.set(self._total_requests())
@@ -536,6 +554,26 @@ class ResilientServer:
                 _metrics.SERVE_GOODPUT.set(t.served / t.admitted,
                                            tenant=t.name)
 
+    def _run_dispatch(self, stacked):
+        """One predictor dispatch, with the registry's OOM second
+        chance: a typed ``DeviceMemoryError`` (real RESOURCE_EXHAUSTED
+        or the ``memory.oom`` chaos site) consults ``oom_retry`` —
+        when the handler evicts enough colder models/buckets to free
+        HBM, the dispatch runs once more instead of failing its
+        callers.  A second OOM (or no handler) propagates."""
+        try:
+            return self._pred._predict_routed(stacked)
+        except _memory.DeviceMemoryError as e:
+            handler = self._oom_retry
+            if handler is None or not handler(e):
+                raise
+            # str(e), never the exception object: a buffering log
+            # handler would pin e.__traceback__ and with it the
+            # dispatch frame's device buffers
+            log.warning("dispatch OOM handled by budget arbiter — "
+                        "retrying once: %s", str(e))
+            return self._pred._predict_routed(stacked)
+
     @hot_path
     def _dispatch_group(self, group: List[_Request]) -> None:
         t0 = time.perf_counter()
@@ -568,7 +606,7 @@ class ResilientServer:
                 for r in group:
                     if r.deadline is not None and t_start >= r.deadline:
                         self._expired_dispatches += 1
-                outs = self._pred._predict_routed(stacked)
+                outs = self._run_dispatch(stacked)
             lo = 0
             for r in group:
                 if not r.future.done():
@@ -657,11 +695,17 @@ class ResilientServer:
     def _compute_ready(self) -> Tuple[bool, Dict[str, bool], dict]:
         checks: Dict[str, bool] = {}
         detail: dict = {}
-        # 1. warmup: every bucket compiled — a cold replica would pay
-        # hot-path compiles on its first requests
+        # 1. warmup: every bucket compiled at least ONCE — a cold
+        # replica would pay full hot-path compiles on its first
+        # requests.  Counted over ever-compiled keys, not currently
+        # resident ones: under a multi-model HBM budget, buckets the
+        # registry evicted rebuild via the persistent compile cache
+        # (bounded, disk-hit cost), and churn must not take an
+        # otherwise-healthy replica out of rotation forever
         want = len(self._pred.spec.all_keys())
         have = self._pred.num_compiled
-        checks["warmup_complete"] = have >= want
+        ever = len(getattr(self._pred, "_ever_compiled", ()) or ())
+        checks["warmup_complete"] = max(have, ever) >= want
         detail["compiled_buckets"] = f"{have}/{want}"
         # 2. persistent compile cache: configured implies wired
         from .. import base as _base
@@ -742,6 +786,16 @@ class ResilientServer:
         # 7. the scheduler itself
         checks["scheduler_alive"] = (self._thread.is_alive()
                                      and self._fatal is None)
+        # 8. caller-supplied checks/detail (the ModelRegistry's
+        # per-model degradation + budget view).  Guarded: readiness
+        # must never fail because of the hook itself
+        if self._extra_ready is not None:
+            try:
+                ec, ed = self._extra_ready()
+                checks.update(ec or {})
+                detail.update(ed or {})
+            except Exception:  # noqa: BLE001 — hook is best-effort
+                pass
         ready = all(checks.values()) and not self._closed
         return ready, checks, detail
 
@@ -779,6 +833,22 @@ class ResilientServer:
                 self._update_ready()
             except Exception as e:  # noqa: BLE001 — watchdog never dies
                 log.warning("readiness watchdog evaluation failed: %s", e)
+
+    def _one_resolved(self, _future) -> None:
+        # future resolutions happen outside the cv lock everywhere
+        # (_expire/_die/close/dispatch), so taking it here cannot
+        # self-deadlock
+        with self._cv:
+            self._live = max(0, self._live - 1)
+
+    def pending(self) -> int:
+        """Admitted requests not yet resolved (queued, being grouped,
+        or in flight) — the registry's is-this-model-idle question (a
+        model with pending work is never a weights-eviction victim:
+        evicting it would fail or thrash the very requests it still
+        owes)."""
+        with self._cv:
+            return self._live
 
     # -- introspection -------------------------------------------------------
     def stats(self) -> dict:

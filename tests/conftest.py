@@ -70,6 +70,13 @@ def pytest_configure(config):
         "the ledger suite")
     config.addinivalue_line(
         "markers",
+        "registry: multi-model serving registry tests (mxnet_tpu."
+        "serving.registry — HBM-budget admission, LRU eviction, "
+        "restart-free readmission, degradation ladder, chaos churn).  "
+        "Runs in tier-1 by default; `pytest -m registry` (or `make "
+        "chaos-serve`) selects this suite")
+    config.addinivalue_line(
+        "markers",
         "introspect: program-introspection tests (mxnet_tpu."
         "observability.introspect — compile-chokepoint cost capture, "
         "named-scope per-layer attribution, MFU/roofline math, "

@@ -13,7 +13,8 @@ Acceptance pinned here:
     ledger ON;
   * tagged live bytes return to baseline after Trainer teardown,
     ``BucketedPredictor``/``MicroBatcher`` close, prefetcher
-    exhaustion, and ``CheckpointManager`` drain (the weakref registry
+    exhaustion, ``CheckpointManager`` drain, AND (ISSUE 14) a full
+    predictor evict -> readmit -> close cycle (the weakref registry
     doubles as a leak detector).
 """
 import gc
@@ -611,6 +612,61 @@ def test_serving_attribution_and_close_leak_gate():
     _collect()
     assert memory.live_by_tag().get("serve_weights") is None, \
         memory.live_by_tag()
+
+
+def test_evict_readmit_cycle_returns_bytes_to_baseline():
+    """ISSUE 14 leak gate: evict() returns every tagged DEVICE byte
+    (weights + bucket placeholders) while the host payload stays put;
+    readmit()+warmup restores the exact device footprint; close()
+    returns everything — device AND host — to baseline."""
+    pred = _mlp_predictor()
+    pred.warmup()
+    _collect()
+    dev_full = memory.live_by_tag().get("serve_weights", 0)
+    host_full = memory.live_by_tag("host").get("serve_host_params", 0)
+    assert dev_full > 0 and host_full > 0
+    freed_est = pred.evict()
+    assert freed_est > 0
+    _collect()
+    assert memory.live_by_tag().get("serve_weights") is None, \
+        memory.live_by_tag()
+    # the readmission source is untouched
+    assert memory.live_by_tag("host").get(
+        "serve_host_params", 0) == host_full
+    pred.readmit()
+    pred.warmup()
+    _collect()
+    # exact parity: same weights, same placeholders, same tags
+    assert memory.live_by_tag().get("serve_weights", 0) == dev_full
+    pred.close()
+    pred.close()  # idempotent
+    del pred
+    _collect()
+    assert memory.live_by_tag().get("serve_weights") is None
+    assert memory.live_by_tag("host").get("serve_host_params") is None
+
+
+def test_bucket_evict_drops_placeholders_and_gauge():
+    """Per-bucket eviction returns the bucket's tagged placeholder
+    bytes and removes its SERVE_BUCKET_HBM_BYTES child; the weights
+    stay resident."""
+    from mxnet_tpu.serving.buckets import bucket_label
+    pred = _mlp_predictor()
+    pred.warmup()
+    _collect()
+    w0 = memory.live_by_tag().get("serve_weights", 0)
+    keys = sorted(pred._compiled)
+    key = keys[0]
+    ph = sum(memory.nbytes_of(a) for a in pred._extra[key].values())
+    pred.evict_bucket(key)
+    _collect()
+    assert memory.live_by_tag().get("serve_weights", 0) == w0 - ph
+    assert pred.resident and key not in pred._compiled
+    assert m.SERVE_BUCKET_HBM_BYTES.get(bucket=bucket_label(key)) == 0.0
+    # stats entry survives as the readmission cost estimate
+    if key in pred._mem_stats:
+        assert not pred.memory_stats()["buckets"][
+            bucket_label(key)]["resident"]
 
 
 def test_readyz_reports_bucket_hbm_and_budget_check():

@@ -451,9 +451,14 @@ def test_snapshot_serving_schema_and_goodput_by_tenant():
         snap = obs.snapshot()["serving"]
         for k in ("admitted", "shed", "expired", "goodput", "ready",
                   "ready_transitions", "reload_failures",
-                  "faults_injected"):
+                  "faults_injected",
+                  # the ISSUE 14 multi-model registry block
+                  "evictions", "readmissions", "resident_models",
+                  "model_hbm_bytes"):
             assert k in snap, snap
         assert snap["goodput"].get("acme") == 1.0
+        assert isinstance(snap["evictions"], dict)
+        assert isinstance(snap["model_hbm_bytes"], dict)
 
 
 def test_worker_death_fails_queued_and_submit_raises():
