@@ -99,14 +99,18 @@ chaos-serve:
 	    tests/test_registry.py tests/test_resilience.py \
 	    tests/test_faultinject.py -q
 
-# graft-lint: the repo-specific static analysis gate (ISSUE 7,
+# graft-lint: the repo-specific static analysis gate (ISSUE 7 + 15,
 # docs/static_analysis.md).  Exit nonzero on any non-baselined finding
-# of the five rules (thread-safety, host-sync, atomic-write, env-sync,
-# metrics-hygiene); tests/test_analysis.py runs the same sweep in
-# tier-1.  JAX_PLATFORMS=cpu keeps the package import off a possibly
-# unreachable TPU tunnel (same reason as the chaos target).
+# of the ten rules (thread-safety, host-sync, atomic-write, env-sync,
+# metrics-hygiene, memory-hygiene, use-after-donate, retrace-hazard,
+# gate-hygiene, bench-emit) OR any failed compiled-program contract
+# (--audit-programs: donation really became input-output aliasing,
+# zero host callbacks, collective count matches the plan);
+# tests/test_analysis.py + tests/test_program_audit.py run the same
+# checks in tier-1.  JAX_PLATFORMS=cpu keeps the package import off a
+# possibly unreachable TPU tunnel (same reason as the chaos target).
 lint-graft:
-	JAX_PLATFORMS=cpu python -m mxnet_tpu.analysis mxnet_tpu
+	JAX_PLATFORMS=cpu python -m mxnet_tpu.analysis --audit-programs mxnet_tpu
 
 clean:
 	rm -f $(LIB) $(CPP_EX) $(PRED_LIB) $(CAPI_EX) $(CAPI_TRAIN_EX) \

@@ -1,7 +1,15 @@
 """graft-lint CLI: ``python -m mxnet_tpu.analysis [paths...]``.
 
-Exit status: 0 = clean (baseline included), 1 = active findings,
-2 = usage error.  ``make lint-graft`` is the canonical invocation.
+Exit status: 0 = clean (baseline included), 1 = active findings or
+failed program-audit contracts, 2 = usage error.  ``make lint-graft``
+is the canonical invocation (sweep + ``--audit-programs``).
+
+``--audit-programs`` (ISSUE 15) additionally runs the compiled-program
+contract auditor: a tiny whole-step training program is built with HLO
+capture on, and its declared contracts — donation really became
+input-output aliasing, zero host callbacks, collective count matches
+the plan — are verified against the lowered artifact
+(``analysis/program_audit.py``).  ``--audit-only`` skips the sweep.
 """
 from __future__ import annotations
 
@@ -14,10 +22,41 @@ from .checkers import ALL_RULES
 from .core import DEFAULT_BASELINE, run_detailed
 
 
+def _run_audit(as_json: bool, payload=None) -> int:
+    """Run the probe + audit.  Text mode prints; ``--json`` mode stashes
+    the report into ``payload`` instead, so the CLI emits ONE top-level
+    JSON document no matter which legs ran."""
+    from . import program_audit
+    t0 = time.perf_counter()
+    try:
+        report = program_audit.self_audit()
+    except Exception as e:  # noqa: BLE001 — a broken probe must gate
+        print(f"program-audit: probe workload failed: "
+              f"{type(e).__name__}: {e}", file=sys.stderr)
+        return 1
+    dt = time.perf_counter() - t0
+    if as_json:
+        doc = dict(report, seconds=round(dt, 3))
+        if payload is None:
+            print(json.dumps({"program_audit": doc}, indent=1))
+        else:
+            payload["program_audit"] = doc
+    else:
+        for issue in report["issues"]:
+            print(f"program-audit: {issue['program']}: "
+                  f"{issue['check']}: {issue['detail']}")
+        print(f"program-audit: {report['checked']} program(s) checked, "
+              f"{len(report['issues'])} issue(s), "
+              f"skipped={report['skipped']} ({dt:.1f}s)",
+              file=sys.stderr)
+    return 0 if report["ok"] else 1
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m mxnet_tpu.analysis",
-        description="graft-lint: repo-specific static analysis "
+        description="graft-lint: repo-specific static analysis + "
+                    "compiled-program contract audit "
                     "(docs/static_analysis.md)")
     ap.add_argument("paths", nargs="*", default=["mxnet_tpu"],
                     help="files/dirs to scan (default: mxnet_tpu)")
@@ -31,12 +70,21 @@ def main(argv=None) -> int:
     ap.add_argument("--json", action="store_true", dest="as_json",
                     help="machine-readable output")
     ap.add_argument("--list-rules", action="store_true")
+    ap.add_argument("--audit-programs", action="store_true",
+                    help="after the sweep, build a small whole-step "
+                         "program (HLO capture on) and verify its "
+                         "compiled-program contracts: donation "
+                         "aliasing, host callbacks, collective count")
+    ap.add_argument("--audit-only", action="store_true",
+                    help="run only the program audit, no static sweep")
     args = ap.parse_args(argv)
 
     if args.list_rules:
         for r in ALL_RULES:
             print(r)
         return 0
+    if args.audit_only:
+        return _run_audit(args.as_json)
     rules = None if args.rules is None else \
         [r.strip() for r in args.rules.split(",") if r.strip()]
     baseline = None if args.no_baseline else args.baseline
@@ -48,18 +96,23 @@ def main(argv=None) -> int:
         print(f"error: {e}", file=sys.stderr)
         return 2
     dt = time.perf_counter() - t0
-    if args.as_json:
-        print(json.dumps({
-            "active": [f.to_dict() for f in active],
-            "baselined": len(baselined), "suppressed": suppressed,
-            "seconds": round(dt, 3)}, indent=1))
-    else:
+    payload = {
+        "active": [f.to_dict() for f in active],
+        "baselined": len(baselined), "suppressed": suppressed,
+        "seconds": round(dt, 3)}
+    if not args.as_json:
         for f in active:
             print(f)
         print(f"graft-lint: {len(active)} finding(s), "
               f"{len(baselined)} baselined, {suppressed} suppressed "
               f"({dt:.1f}s)", file=sys.stderr)
-    return 1 if active else 0
+    rc = 1 if active else 0
+    if args.audit_programs:
+        audit_rc = _run_audit(args.as_json, payload=payload)
+        rc = rc or audit_rc
+    if args.as_json:
+        print(json.dumps(payload, indent=1))
+    return rc
 
 
 if __name__ == "__main__":

@@ -1,9 +1,15 @@
-"""The five repo-specific graft-lint checkers (ISSUE 7).
+"""The ten repo-specific graft-lint checkers (ISSUEs 7 + 15).
 
-Each rule encodes a defect class a human reviewer actually caught in
-PRs 1-6; the checker docstrings name the incident.  All checkers are
-AST-based and conservative — a miss is recoverable (the sanitizer or a
-review catches it), a false-positive storm kills the gate.
+Each rule encodes a defect class a human reviewer actually caught —
+the PR 7 set (thread-safety, host-sync, atomic-write, env-sync,
+metrics-hygiene, memory-hygiene) works at the source level; the ISSUE
+15 tier (use-after-donate, retrace-hazard, gate-hygiene, bench-emit)
+guards the jit/program boundary where the bug class moved after PR 10
+made the training step one opaque donated program.  The checker
+docstrings name the incidents.  All checkers are AST-based and
+conservative — a miss is recoverable (the sanitizer, the program
+auditor, or a review catches it), a false-positive storm kills the
+gate.
 """
 from __future__ import annotations
 
@@ -18,16 +24,9 @@ _ENV_RE = re.compile(r"^(MXNET_|MXT_)[A-Z0-9_]+$")
 _ENV_DOC_RE = re.compile(r"\b((?:MXNET|MXT)_[A-Z0-9_]*\*?)")
 
 
-def _call_name(node: ast.AST) -> str:
-    """Dotted name of a call target: ``np.savez`` -> 'np.savez'."""
-    parts = []
-    while isinstance(node, ast.Attribute):
-        parts.append(node.attr)
-        node = node.value
-    if isinstance(node, ast.Name):
-        parts.append(node.id)
-        return ".".join(reversed(parts))
-    return ""
+# one dotted-call-name resolver for the whole package: dataflow.py owns
+# it (the def-use pass needs it without importing this heavier module)
+from .dataflow import call_name as _call_name  # noqa: E402
 
 
 def _const_str(node) -> Optional[str]:
@@ -943,6 +942,469 @@ class MemoryHygieneChecker:
 
 
 # ---------------------------------------------------------------------------
+# 7. use-after-donate (ISSUE 15)
+# ---------------------------------------------------------------------------
+class UseAfterDonateChecker:
+    """No read of a value previously passed through a donated jit call
+    position (the PR 10 "the failed call may have consumed donated
+    buffers" class, PR 12's donation-safe retry, PR 14's
+    transient-device-copy double-count — jax reports these as an opaque
+    "Array has been deleted" at some LATER access, far from the
+    dispatch that killed the buffer).
+
+    Runs the ``analysis.dataflow`` def-use pass per function: donating
+    callables are recognized by construction (``jax.jit(...,
+    donate_argnums=...)``), through same-file factories
+    (``_build_fn``-style returns) and the ``lookup_program`` cache;
+    rebinds / ``del`` / the supervisor-restore idioms
+    (``*restore*`` / ``_load_init`` / ``set_states_bytes`` /
+    ``readmit`` / ``_set_data``) kill the taint.  The MXNET_SANITIZE
+    runtime twin (``sanitizer.poison_donated``) raises a typed
+    ``DonatedBufferError`` for whatever escapes the static net.
+    """
+
+    name = "use-after-donate"
+
+    def check_file(self, ctx: FileCtx) -> List[Finding]:
+        from . import dataflow as _df
+        factories = _df.donating_factories(ctx.tree)
+        out: List[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            for use in _df.analyze_donation(node, factories):
+                out.append(ctx.finding(
+                    self.name, use.node,
+                    f"'{use.name}' was passed through a donated "
+                    f"argument of {use.callee}(...) at line "
+                    f"{use.donated_line} — its buffer belongs to XLA "
+                    f"now and this read sees a deleted array.  Rebind "
+                    f"the name from the program's outputs, or restore "
+                    f"from host copies before reusing it"))
+        return out
+
+
+# ---------------------------------------------------------------------------
+# 8. retrace-hazard (ISSUE 15)
+# ---------------------------------------------------------------------------
+#: files allowed to construct jit programs — the compile chokepoints
+#: program introspection instruments (executor, CachedOp, FusedUpdater,
+#: whole-step, serving) plus the op/kernel registries whose jits are
+#: module-lifetime singletons.  Everything else building a program is a
+#: retrace hazard until reviewed (suppress/baseline with justification).
+_JIT_CHOKEPOINTS = (
+    "mxnet_tpu/executor.py",
+    "mxnet_tpu/gluon/block.py",
+    "mxnet_tpu/gluon/wholestep.py",
+    "mxnet_tpu/gluon/parameter.py",
+    "mxnet_tpu/optimizer.py",
+    "mxnet_tpu/serving/predictor.py",
+    "mxnet_tpu/predictor.py",
+    "mxnet_tpu/module/module.py",
+    "mxnet_tpu/ops/registry.py",
+    "mxnet_tpu/kvstore.py",
+    "mxnet_tpu/parallel/collectives.py",
+    "mxnet_tpu/parallel/data_parallel.py",
+    "mxnet_tpu/symbol/symbol.py",
+    "mxnet_tpu/symbol/graph.py",
+    "mxnet_tpu/ndarray/sparse.py",
+    "mxnet_tpu/image.py",
+    "mxnet_tpu/rtc.py",
+    "mxnet_tpu/export.py",
+)
+
+
+class RetraceHazardChecker:
+    """Compiled-program identity must be stable (the
+    FUSED_DTYPE_RECOMPILES class: a silent retrace/fallback re-pays XLA
+    compilation on a hot path, or — worse — silently reuses a program
+    traced for different semantics).  Three shapes:
+
+      * ``jax.jit(f)(x)`` — jit-then-call in one expression builds a
+        fresh program cache per evaluation: every call recompiles;
+      * ``jax.jit`` inside a loop body — one program per iteration;
+      * ``jax.jit`` call sites outside the blessed compile chokepoints
+        (``_JIT_CHOKEPOINTS``) — programs built where introspection /
+        dispatch-count gates can't see them;
+      * unstable/unhashable values in a dispatch-stability cache key:
+        list/set/dict displays (unhashable — a TypeError at best) and
+        ``id(...)`` (a recycled address aliases a NEW object onto a
+        dead entry's program — the ``_PLAN_UID`` incident) in any
+        ``lookup_program(key, ...)`` argument or a local ``key``
+        assignment feeding one.
+    """
+
+    name = "retrace-hazard"
+
+    @staticmethod
+    def _scope_of(node, parents):
+        """Nearest enclosing function (or None = module scope) — cache
+        keys resolve per-scope so an unrelated local named ``key`` in
+        another function can never shadow a blessed one."""
+        cur = parents.get(node)
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.Lambda)):
+                return cur
+            cur = parents.get(cur)
+        return None
+
+    def check_file(self, ctx: FileCtx) -> List[Finding]:
+        out: List[Finding] = []
+        parents: Dict[ast.AST, ast.AST] = {}
+        for parent in ast.walk(ctx.tree):
+            for child in ast.iter_child_nodes(parent):
+                parents[child] = parent
+        blessed = any(ctx.relpath.endswith(p) for p in _JIT_CHOKEPOINTS)
+        # (scope, name) -> value expr, scoped to the enclosing function
+        key_exprs: Dict[tuple, ast.AST] = {}
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                scope = self._scope_of(node, parents)
+                key_exprs[(scope, node.targets[0].id)] = node.value
+            if not isinstance(node, ast.Call):
+                continue
+            cn = _call_name(node.func)
+            if cn in ("jax.jit", "_jax.jit"):
+                if not blessed:
+                    out.append(ctx.finding(
+                        self.name, node,
+                        "jax.jit call site outside the blessed compile "
+                        "chokepoints — programs built here escape "
+                        "introspection capture and the dispatch-count "
+                        "gates.  Route through an existing chokepoint "
+                        "(executor / CachedOp / FusedUpdater / "
+                        "whole-step / serving), or suppress with the "
+                        "caching story written down"))
+                inner = parents.get(node)
+                if isinstance(inner, ast.Call) and inner.func is node:
+                    out.append(ctx.finding(
+                        self.name, node,
+                        "jax.jit(f)(...) — jit-then-call in one "
+                        "expression builds a fresh program cache per "
+                        "evaluation, so EVERY call recompiles.  Bind "
+                        "the jitted callable once and reuse it"))
+                cur = parents.get(node)
+                while cur is not None:
+                    if isinstance(cur, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef,
+                                        ast.Lambda)):
+                        break
+                    if isinstance(cur, (ast.For, ast.While)):
+                        out.append(ctx.finding(
+                            self.name, node,
+                            "jax.jit constructed inside a loop — one "
+                            "fresh program (and XLA compile) per "
+                            "iteration.  Hoist the jit out of the "
+                            "loop"))
+                        break
+                    cur = parents.get(cur)
+            elif _call_name(node.func).split(".")[-1] == \
+                    "lookup_program" and node.args:
+                key = node.args[0]
+                if isinstance(key, ast.Name):
+                    scope = self._scope_of(node, parents)
+                    key = key_exprs.get((scope, key.id), key)
+                out.extend(self._check_key(ctx, key))
+        return out
+
+    def _check_key(self, ctx: FileCtx, key) -> List[Finding]:
+        out: List[Finding] = []
+        # displays/comprehensions immediately coerced hashable —
+        # tuple(<genexp>) / frozenset([...]) — are the NORMAL key idiom
+        coerced: Set[ast.AST] = set()
+        for sub in ast.walk(key):
+            if isinstance(sub, ast.Call) and _call_name(sub.func) in (
+                    "tuple", "frozenset") and sub.args:
+                coerced.add(sub.args[0])
+        for sub in ast.walk(key):
+            if sub in coerced:
+                continue
+            if isinstance(sub, (ast.List, ast.Set, ast.Dict,
+                                ast.ListComp, ast.SetComp, ast.DictComp,
+                                ast.GeneratorExp)):
+                out.append(ctx.finding(
+                    self.name, sub,
+                    "unhashable value (list/set/dict display) inside a "
+                    "program cache key — the dispatch-stability lookup "
+                    "raises TypeError or, tuple()-coerced elsewhere, "
+                    "drifts.  Use tuples of hashables"))
+            elif isinstance(sub, ast.Call) and \
+                    _call_name(sub.func) == "id":
+                out.append(ctx.finding(
+                    self.name, sub,
+                    "id(...) inside a program cache key — a recycled "
+                    "address aliases a NEW object onto a dead entry's "
+                    "compiled program (the _PLAN_UID incident).  Use a "
+                    "process-unique counter stamped on the object"))
+        return out
+
+
+# ---------------------------------------------------------------------------
+# 9. gate-hygiene (ISSUE 15)
+# ---------------------------------------------------------------------------
+class GateHygieneChecker:
+    """Every documented ``MXNET_*=0`` kill-switch must reduce its hooks
+    to ONE module-global boolean test before any other work — the
+    overhead contract PRs 1 (metrics), 8 (flight), 9 (memory ledger),
+    12 (supervise) and 13 (introspect) each re-promised in prose; this
+    rule machine-checks it.
+
+    A gate is a module-level ``ENABLED = getenv("MXNET_...", ...)``.
+    Two violation shapes:
+
+      * **buried guard** — a function whose body contains the
+        early-return guard (``if not ENABLED: return``) anywhere but
+        as its first statement, with effectful work (calls, control
+        flow) before it: the disabled path no longer costs one boolean
+        test;
+      * **per-call env re-read** — a function body re-reading the
+        gate's env var through ``getenv``/``os.environ`` instead of
+        testing the module global: an env lookup + string parse per
+        call on a path the contract says costs one flag test (and a
+        mid-run ``export`` silently half-toggles the subsystem —
+        enable()/disable() and the global stay authoritative).
+    """
+
+    name = "gate-hygiene"
+
+    def __init__(self):
+        # env var -> (module relpath) for every gate seen this run
+        self._gates: Dict[str, str] = {}
+        # (relpath, lineno, col, symbol-less env, suppressed) of
+        # in-function getenv reads, resolved in finalize once every
+        # module's gates are known.  Primitives only — holding the
+        # FileCtx here would pin every swept file's source + AST in
+        # memory for the whole run
+        self._fn_reads: List[Tuple[str, int, int, str, bool]] = []
+
+    @staticmethod
+    def _gate_env(node) -> Optional[str]:
+        """Env name when ``node`` is ``ENABLED = getenv("MXNET_X", ..)``
+        (bool()-wrapped and AnnAssign forms included)."""
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+            value = node.value
+        elif isinstance(node, ast.AnnAssign):
+            targets = [node.target]
+            value = node.value
+        else:
+            return None
+        if not any(isinstance(t, ast.Name) and t.id == "ENABLED"
+                   for t in targets):
+            return None
+        if isinstance(value, ast.Call) and \
+                _call_name(value.func) == "bool" and value.args:
+            value = value.args[0]
+        if isinstance(value, ast.Call) and \
+                _call_name(value.func).split(".")[-1] in (
+                    "getenv", "get") and value.args:
+            name = _const_str(value.args[0])
+            if name and _ENV_RE.match(name):
+                return name
+        return None
+
+    @staticmethod
+    def _is_gate_guard(stmt, gate_names: Set[str]) -> bool:
+        """``if not ENABLED: return/yield/pass`` (possibly
+        ``not ENABLED or ...``) at statement level."""
+        if not isinstance(stmt, ast.If):
+            return False
+        test = stmt.test
+        candidates = [test]
+        if isinstance(test, ast.BoolOp) and isinstance(test.op, ast.Or):
+            candidates = list(test.values)
+        hit = False
+        for c in candidates:
+            if isinstance(c, ast.UnaryOp) and isinstance(c.op, ast.Not):
+                inner = c.operand
+                key = inner.attr if isinstance(inner, ast.Attribute) \
+                    else inner.id if isinstance(inner, ast.Name) else ""
+                if key in gate_names:
+                    hit = True
+        if not hit:
+            return False
+        return all(isinstance(s, (ast.Return, ast.Pass, ast.Expr))
+                   for s in stmt.body)
+
+    @staticmethod
+    def _effectful(stmt) -> bool:
+        """Work the disabled path would pay before reaching the guard."""
+        if isinstance(stmt, (ast.With, ast.For, ast.While, ast.Try)):
+            return True
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Call):
+                return True
+        return False
+
+    def check_file(self, ctx: FileCtx) -> List[Finding]:
+        gate_envs: Dict[str, str] = {}
+        for stmt in ctx.tree.body:
+            env = self._gate_env(stmt)
+            if env:
+                gate_envs[env] = "ENABLED"
+                self._gates[env] = ctx.relpath
+        out: List[Finding] = []
+        gate_names = {"ENABLED"}
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                # record in-function env re-reads for finalize
+                for sub in ast.walk(node):
+                    name = EnvVarSyncChecker._read_name(sub)
+                    if name:
+                        ln = getattr(sub, "lineno", 0)
+                        self._fn_reads.append(
+                            (ctx.relpath, ln,
+                             getattr(sub, "col_offset", 0), name,
+                             ctx.suppressed(self.name, ln)))
+                if not gate_envs:
+                    continue
+                body = node.body
+                start = 0
+                if body and isinstance(body[0], ast.Expr) and \
+                        isinstance(body[0].value, ast.Constant):
+                    start = 1  # docstring
+                for i, stmt in enumerate(body):
+                    if not self._is_gate_guard(stmt, gate_names):
+                        continue
+                    if i == start:
+                        break
+                    if any(self._effectful(p) for p in body[start:i]):
+                        out.append(ctx.finding(
+                            self.name, stmt,
+                            f"kill-switch guard 'if not ENABLED' is "
+                            f"buried behind other work in "
+                            f"'{node.name}' — the disabled path must "
+                            f"cost ONE module-global boolean test "
+                            f"(move the guard to the first "
+                            f"statement)"))
+                    break
+        return out
+
+    def finalize(self) -> List[Finding]:
+        out: List[Finding] = []
+        reported: Set[Tuple[str, int]] = set()
+        for relpath, line, col, env, suppressed in self._fn_reads:
+            gate_mod = self._gates.get(env)
+            if gate_mod is None or suppressed:
+                continue
+            where = (relpath, line)
+            if where in reported:
+                continue
+            reported.add(where)
+            out.append(Finding(
+                rule=self.name, path=relpath, line=line, col=col,
+                message=f"'{env}' is re-read from the environment "
+                        f"inside a function, but it is the "
+                        f"module-global kill-switch gate of "
+                        f"{gate_mod} — test that module's ENABLED "
+                        f"flag instead (one boolean test; env is "
+                        f"parsed once at import)"))
+        return out
+
+
+# ---------------------------------------------------------------------------
+# 10. bench-emit (ISSUE 15 satellite)
+# ---------------------------------------------------------------------------
+class BenchEmitChecker:
+    """Every bench.py rider's result dict must be reachable from
+    ``_emit``'s BENCH JSON — the exact omission fixed twice already
+    (PR 12: the wholestep rider ran but never reached the artifact;
+    PR 14: same for the mfu rider).  A rider that runs and reports
+    nothing is worse than one that fails: the scoring artifact silently
+    loses the axis.
+
+    Checks any scanned ``bench*.py``, and — via ``finalize`` — always
+    the repo's own ``bench.py`` even when the sweep paths don't include
+    it: every string key K with a ``_STATE[K] = ...`` assignment must
+    be READ (``_STATE[K]`` / ``_STATE.get(K)``) inside ``_emit``.
+    """
+
+    name = "bench-emit"
+
+    def __init__(self):
+        self._saw_repo_bench = False
+
+    def check_file(self, ctx: FileCtx) -> List[Finding]:
+        base = os.path.basename(ctx.relpath)
+        if not (base.startswith("bench") and base.endswith(".py")):
+            return []
+        if ctx.relpath == "bench.py":
+            self._saw_repo_bench = True
+        return self._check_tree(ctx)
+
+    def _check_tree(self, ctx: FileCtx) -> List[Finding]:
+        emit_fn = None
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.FunctionDef) and node.name == "_emit":
+                emit_fn = node
+                break
+        if emit_fn is None:
+            return []
+
+        def state_key(node) -> Optional[str]:
+            # _STATE["k"] subscript
+            if isinstance(node, ast.Subscript) and \
+                    isinstance(node.value, ast.Name) and \
+                    node.value.id == "_STATE":
+                sl = node.slice
+                if isinstance(sl, ast.Index):  # py<3.9 compat shape
+                    sl = sl.value
+                return _const_str(sl)
+            return None
+
+        emitted: Set[str] = set()
+        for node in ast.walk(emit_fn):
+            k = state_key(node)
+            if k:
+                emitted.add(k)
+            if isinstance(node, ast.Call) and \
+                    _call_name(node.func) == "_STATE.get" and node.args:
+                k = _const_str(node.args[0])
+                if k:
+                    emitted.add(k)
+        out: List[Finding] = []
+        seen: Set[str] = set()
+        for node in ast.walk(ctx.tree):
+            targets = []
+            if isinstance(node, ast.Assign):
+                targets = node.targets
+            elif isinstance(node, ast.AugAssign):
+                targets = [node.target]
+            for t in targets:
+                k = state_key(t)
+                if k and k not in emitted and k not in seen:
+                    seen.add(k)
+                    out.append(ctx.finding(
+                        self.name, t,
+                        f"rider result _STATE[{k!r}] is assigned but "
+                        f"never read inside _emit — it will not reach "
+                        f"the BENCH JSON artifact (the PR 12/PR 14 "
+                        f"omission class).  Add an `out[{k!r}] = "
+                        f"_STATE[{k!r}]` leg to _emit"))
+        return out
+
+    def finalize(self) -> List[Finding]:
+        if self._saw_repo_bench:
+            return []
+        path = os.path.join(REPO_ROOT, "bench.py")
+        try:
+            with open(path, encoding="utf-8") as f:
+                source = f.read()
+            tree = ast.parse(source, filename=path)
+        except (OSError, SyntaxError):
+            return []
+        ctx = FileCtx(path, "bench.py", source, tree)
+        out = []
+        for f in self._check_tree(ctx):
+            if not ctx.suppressed(self.name, f.line):
+                out.append(f)
+        return out
+
+
+# ---------------------------------------------------------------------------
 def registry() -> Dict[str, type]:
     return {
         ThreadSafetyChecker.name: ThreadSafetyChecker,
@@ -951,6 +1413,10 @@ def registry() -> Dict[str, type]:
         EnvVarSyncChecker.name: EnvVarSyncChecker,
         MetricsHygieneChecker.name: MetricsHygieneChecker,
         MemoryHygieneChecker.name: MemoryHygieneChecker,
+        UseAfterDonateChecker.name: UseAfterDonateChecker,
+        RetraceHazardChecker.name: RetraceHazardChecker,
+        GateHygieneChecker.name: GateHygieneChecker,
+        BenchEmitChecker.name: BenchEmitChecker,
     }
 
 

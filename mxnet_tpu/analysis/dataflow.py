@@ -1,0 +1,388 @@
+"""Intra-function dataflow for the jit/program-boundary rules (ISSUE 15).
+
+PR 7's checkers are pattern matchers: they recognize a bad call shape
+wherever it appears.  The bug class that dominates reviews since PR 10
+is different — it is about VALUE LIFETIME across a donated dispatch:
+``fn = jax.jit(step, donate_argnums=(0,))`` consumes its argument
+buffers, so any later read of a value that flowed through a donated
+position is a use of a deleted array (jax raises an opaque
+"Array has been deleted" at some arbitrary later point; the PR 10/12/14
+incidents).  Catching that statically needs def-use tracking, not
+pattern matching — this module is the small dataflow layer the
+``use-after-donate`` checker (checkers.py) runs per function.
+
+Scope and honesty: the analysis is INTRA-function and name-based
+(dotted ``self.attr`` chains count as names).  It recognizes this
+repo's donation idioms:
+
+  * direct construction: ``fn = jax.jit(f, donate_argnums=(0, 2))``;
+  * factory methods: a same-file function whose ``return`` is such a
+    ``jax.jit`` call (``WholeStepCompiler._build_fn``) makes every
+    ``fn = self._build_fn(...)`` a donating callable;
+  * the program cache: ``fn = upd.lookup_program(key, lambda:
+    self._build_fn(...))`` resolves through the factory argument;
+  * conditional donation (``donate_argnums=(0,) if flag else ()``)
+    counts as donating — the hazard exists whenever it CAN donate.
+
+A call through a donating callable marks the names passed at donated
+positions as dead.  Kills (the value is live again): rebinding the
+name, ``del``, and the supervisor/wholestep restore idioms — a call to
+``*restore*`` / ``_load_init`` / ``set_states_bytes`` / ``readmit``
+/ ``_set_data`` rebuilds state from host copies, so every donated name
+is revived (the donation-safe-retry pattern PR 12 shipped).  Branches
+merge conservatively (donated in either arm stays donated; killed only
+when killed in both); loop bodies run twice so an un-rebound name
+donated at the bottom of an iteration is caught when the next
+iteration reads it.
+
+A miss is recoverable (the MXNET_SANITIZE runtime twin raises a typed
+``DonatedBufferError`` at the access), a false-positive storm kills
+the gate — same conservatism contract as every graft-lint rule.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Tuple
+
+__all__ = ["call_name", "donate_argnums_of", "donating_factories",
+           "analyze_donation", "DonatedUse"]
+
+#: call names that construct a jit program
+_JIT_NAMES = ("jax.jit", "_jax.jit", "jit")
+
+#: a call to one of these (by terminal name, or containing this token)
+#: rebuilds state from host copies — every donated name is live again
+_RESTORE_TOKENS = ("restore",)
+_RESTORE_NAMES = ("_load_init", "set_states_bytes", "readmit",
+                  "_set_data", "_init_residuals")
+
+
+def call_name(node: ast.AST) -> str:
+    """Dotted name of a call target: ``jax.jit`` -> 'jax.jit'."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def _const_argnums(node) -> Optional[Tuple[int, ...]]:
+    """A donate_argnums value -> tuple of ints, None if not constant."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for e in node.elts:
+            if not (isinstance(e, ast.Constant)
+                    and isinstance(e.value, int)):
+                return None
+            out.append(e.value)
+        return tuple(out)
+    return None
+
+
+def donate_argnums_of(call: ast.Call) -> Optional[Tuple[int, ...]]:
+    """Donated positions of a ``jax.jit(...)`` call, or None when the
+    call is not a jit construction / donates nothing.  A conditional
+    ``(0,) if flag else ()`` yields the union of both arms — the
+    hazard exists whenever the callable CAN donate."""
+    if call_name(call.func) not in _JIT_NAMES:
+        return None
+    for kw in call.keywords:
+        if kw.arg != "donate_argnums":
+            continue
+        v = kw.value
+        if isinstance(v, ast.IfExp):
+            a = _const_argnums(v.body) or ()
+            b = _const_argnums(v.orelse) or ()
+            merged = tuple(sorted(set(a) | set(b)))
+            return merged or None
+        nums = _const_argnums(v)
+        return nums or None
+    return None
+
+
+def donating_factories(tree: ast.AST) -> Dict[str, Tuple[int, ...]]:
+    """Terminal function name -> donated argnums, for every same-file
+    function whose return value is a donating ``jax.jit`` call
+    (``_build_fn``-style factories)."""
+    out: Dict[str, Tuple[int, ...]] = {}
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Return) and \
+                    isinstance(sub.value, ast.Call):
+                nums = donate_argnums_of(sub.value)
+                if nums:
+                    out[node.name] = nums
+    return out
+
+
+def _target_key(node) -> Optional[str]:
+    """Dotted key for a Name / self-rooted Attribute chain
+    (``self._residuals`` -> 'self._residuals'); None for anything the
+    name-based analysis cannot track (subscripts, calls)."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+class DonatedUse:
+    """One read of a value previously passed through a donated call
+    position."""
+
+    def __init__(self, node: ast.AST, name: str, donated_line: int,
+                 callee: str):
+        self.node = node
+        self.name = name
+        self.donated_line = donated_line
+        self.callee = callee
+
+
+class _DonationWalker:
+    """Statement-ordered walk of one function with branch merging."""
+
+    def __init__(self, factories: Dict[str, Tuple[int, ...]]):
+        self.factories = factories
+        # local name -> donated argnums of the callable it holds
+        self.donating_vars: Dict[str, Tuple[int, ...]] = {}
+        # tracked key -> {"line": int, "callee": str}
+        self.donated: Dict[str, dict] = {}
+        self.uses: List[DonatedUse] = []
+        self._reported: set = set()
+
+    # -- donating-callable resolution ----------------------------------------
+    def _donation_of(self, value) -> Optional[Tuple[int, ...]]:
+        """Donated argnums of the callable ``value`` evaluates to."""
+        if isinstance(value, ast.Name):
+            return self.donating_vars.get(value.id)
+        if isinstance(value, ast.Lambda):
+            return self._donation_of(value.body)
+        if not isinstance(value, ast.Call):
+            return None
+        nums = donate_argnums_of(value)
+        if nums:
+            return nums
+        last = call_name(value.func).split(".")[-1]
+        if last in self.factories:
+            return self.factories[last]
+        if last == "lookup_program":
+            # fn = upd.lookup_program(key, <factory>): the program the
+            # cache hands back is whatever the factory builds
+            for a in list(value.args[1:]) + \
+                    [kw.value for kw in value.keywords]:
+                nums = self._donation_of(a)
+                if nums:
+                    return nums
+            for a in value.args[1:]:
+                if isinstance(a, ast.Attribute) and \
+                        a.attr in self.factories:
+                    return self.factories[a.attr]
+        return None
+
+    # -- reads / kills / marks ----------------------------------------------
+    def _check_reads(self, expr, skip: Tuple[ast.AST, ...] = ()) -> None:
+        if expr is None:
+            return
+        for node in ast.walk(expr):
+            if node in skip:
+                continue
+            key = None
+            if isinstance(node, (ast.Name, ast.Attribute)) and \
+                    isinstance(getattr(node, "ctx", None), ast.Load):
+                key = _target_key(node)
+            if key is None or key not in self.donated:
+                continue
+            # an attribute READ on a tracked dotted chain counts once
+            if (key, node.lineno) in self._reported:
+                continue
+            self._reported.add((key, node.lineno))
+            info = self.donated[key]
+            self.uses.append(DonatedUse(node, key, info["line"],
+                                        info["callee"]))
+
+    def _kill(self, target) -> None:
+        key = _target_key(target)
+        if key is not None:
+            self.donated.pop(key, None)
+            # rebinding `fn` also drops its donating-callable tag
+            self.donating_vars.pop(key, None)
+
+    def _kill_all(self) -> None:
+        self.donated.clear()
+
+    def _is_restore_call(self, call: ast.Call) -> bool:
+        last = call_name(call.func).split(".")[-1]
+        if not last and isinstance(call.func, ast.Attribute):
+            last = call.func.attr
+        return last in _RESTORE_NAMES or \
+            any(t in last for t in _RESTORE_TOKENS)
+
+    def _process_calls(self, expr) -> None:
+        """Donation marks + restore kills for every call in ``expr``
+        (applied AFTER the read check: the donating call itself reads
+        its arguments legally — the donation happens at that read)."""
+        if expr is None:
+            return
+        for node in ast.walk(expr):
+            if not isinstance(node, ast.Call):
+                continue
+            if self._is_restore_call(node):
+                self._kill_all()
+                continue
+            nums = None
+            if isinstance(node.func, (ast.Name, ast.Attribute)):
+                key = _target_key(node.func)
+                if key is not None and key in self.donating_vars:
+                    nums = self.donating_vars[key]
+            if nums is None:
+                continue
+            callee = _target_key(node.func) or "<fn>"
+            for pos in nums:
+                if pos >= len(node.args):
+                    continue
+                akey = _target_key(node.args[pos])
+                if akey is not None:
+                    self.donated[akey] = {"line": node.lineno,
+                                          "callee": callee}
+
+    # -- statement dispatch ---------------------------------------------------
+    def visit_block(self, stmts) -> None:
+        for s in stmts:
+            self.visit(s)
+
+    def _branch(self, blocks) -> None:
+        """Run each block from a copy of the current state; merge:
+        donated-in-any stays donated, killed-only-when-killed-in-all."""
+        pre_don = dict(self.donated)
+        pre_vars = dict(self.donating_vars)
+        donated_arms = []
+        vars_arms = []
+        for block in blocks:
+            self.donated = dict(pre_don)
+            self.donating_vars = dict(pre_vars)
+            self.visit_block(block)
+            donated_arms.append(self.donated)
+            vars_arms.append(self.donating_vars)
+        # union of the arms: each arm started from the pre-state, so a
+        # key killed in EVERY arm is absent from all of them (dead), a
+        # key donated or surviving in ANY arm stays tracked
+        merged: Dict[str, dict] = {}
+        for arm in donated_arms:
+            merged.update(arm)
+        self.donated = merged
+        mvars: Dict[str, Tuple[int, ...]] = {}
+        for arm in vars_arms:
+            mvars.update(arm)
+        self.donating_vars = mvars
+
+    def visit(self, stmt) -> None:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return  # nested defs analyzed on their own
+        if isinstance(stmt, ast.Assign):
+            self._check_reads(stmt.value)
+            self._process_calls(stmt.value)
+            nums = self._donation_of(stmt.value)
+            for t in stmt.targets:
+                if isinstance(t, (ast.Tuple, ast.List)):
+                    for e in t.elts:
+                        self._kill(e)
+                else:
+                    self._kill(t)
+                    if nums is not None:
+                        key = _target_key(t)
+                        if key is not None:
+                            self.donating_vars[key] = nums
+            return
+        if isinstance(stmt, (ast.AnnAssign, ast.AugAssign)):
+            if isinstance(stmt, ast.AugAssign):
+                self._check_reads(stmt.target)
+            self._check_reads(stmt.value)
+            self._process_calls(stmt.value)
+            self._kill(stmt.target)
+            return
+        if isinstance(stmt, ast.Delete):
+            for t in stmt.targets:
+                self._kill(t)
+            return
+        if isinstance(stmt, ast.Expr):
+            self._check_reads(stmt.value)
+            self._process_calls(stmt.value)
+            return
+        if isinstance(stmt, ast.Return):
+            self._check_reads(stmt.value)
+            self._process_calls(stmt.value)
+            return
+        if isinstance(stmt, (ast.Raise, ast.Assert)):
+            for part in (getattr(stmt, "exc", None),
+                         getattr(stmt, "cause", None),
+                         getattr(stmt, "test", None),
+                         getattr(stmt, "msg", None)):
+                self._check_reads(part)
+                self._process_calls(part)
+            return
+        if isinstance(stmt, ast.If):
+            self._check_reads(stmt.test)
+            self._process_calls(stmt.test)
+            self._branch([stmt.body, stmt.orelse])
+            return
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            self._check_reads(stmt.iter)
+            self._process_calls(stmt.iter)
+            self._kill(stmt.target)
+            # two passes: catches a name donated at the bottom of one
+            # iteration and read at the top of the next
+            for _ in range(2):
+                self._branch([stmt.body, []])
+                self._kill(stmt.target)
+            self.visit_block(stmt.orelse)
+            return
+        if isinstance(stmt, ast.While):
+            self._check_reads(stmt.test)
+            for _ in range(2):
+                self._branch([stmt.body, []])
+                self._check_reads(stmt.test)
+            self.visit_block(stmt.orelse)
+            return
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                self._check_reads(item.context_expr)
+                self._process_calls(item.context_expr)
+                if item.optional_vars is not None:
+                    self._kill(item.optional_vars)
+            self.visit_block(stmt.body)
+            return
+        if isinstance(stmt, ast.Try):
+            # handlers may run from any point in the body: they see the
+            # post-body state (where the donation hazard lives — the
+            # failed-dispatch retry class) WITHOUT its kills erased;
+            # conservative and matches the wired failure paths, which
+            # donate before they raise
+            self.visit_block(stmt.body)
+            self._branch([h.body for h in stmt.handlers] +
+                         [stmt.orelse or []])
+            self.visit_block(stmt.finalbody)
+            return
+        # fallthrough (Pass, Global, Import, ...): check embedded exprs
+        for child in ast.iter_child_nodes(stmt):
+            if isinstance(child, ast.expr):
+                self._check_reads(child)
+                self._process_calls(child)
+
+
+def analyze_donation(fn, factories: Dict[str, Tuple[int, ...]]) \
+        -> List[DonatedUse]:
+    """Run the use-after-donate dataflow over one function body."""
+    w = _DonationWalker(factories)
+    w.visit_block(fn.body)
+    return w.uses

@@ -47,6 +47,7 @@ from ..base import MXNetError, getenv
 __all__ = ["ENABLED", "enable", "disable", "enabled", "sanitized",
            "make_lock", "make_rlock", "make_condition", "no_sync",
            "check_sync", "hot_path", "LockOrderError", "SyncViolation",
+           "DonatedBufferError", "poison_donated", "poison_mapping",
            "lock_graph", "violations", "reset", "state"]
 
 # read once at import; enable()/disable() flip it at runtime (tests).
@@ -64,6 +65,18 @@ class LockOrderError(MXNetError):
 class SyncViolation(MXNetError):
     """A device→host synchronization happened inside a ``no_sync()``
     region."""
+
+
+class DonatedBufferError(MXNetError):
+    """A buffer consumed by a donated XLA dispatch was accessed
+    afterwards (ISSUE 15's runtime twin of the ``use-after-donate``
+    static rule).  Without the sanitizer jax reports this as an opaque
+    ``RuntimeError: Array has been deleted`` at some arbitrary later
+    access; under ``MXNET_SANITIZE=1`` the wholestep / fused-update /
+    serving dispatch boundaries poison the donated wrappers on a failed
+    dispatch, so the first touch fails HERE, typed, naming the dispatch
+    site — and a snapshot restore (``_set_data`` / ``_load_init``)
+    clears the poison exactly like it revives the real buffers."""
 
 
 def enable() -> None:
@@ -134,9 +147,10 @@ def state() -> dict:
         cycles = sum(1 for v in _VIOLATIONS if v["kind"] == "cycle")
         reentry = sum(1 for v in _VIOLATIONS if v["kind"] == "reentry")
         sync = sum(1 for v in _VIOLATIONS if v["kind"] == "sync")
+        donated = sum(1 for v in _VIOLATIONS if v["kind"] == "donated")
         return {"enabled": ENABLED, "lock_edges": len(_EDGES),
                 "cycles": cycles, "reentry": reentry,
-                "sync_violations": sync,
+                "sync_violations": sync, "donated_poisoned": donated,
                 "violations": [
                     {k: v[k] for k in ("kind", "detail")}
                     for v in _VIOLATIONS[:16]]}
@@ -354,6 +368,122 @@ def check_sync(what: str) -> None:
             f"device->host sync '{what}' inside no_sync region "
             f"'{label}' — the hot path this region protects just "
             f"gained a blocking host read")
+
+
+# -- donated-buffer poisoning (ISSUE 15) --------------------------------------
+class _DonatedBuffer:
+    """Sentinel installed as an NDArray's ``_data`` after a failed
+    donated dispatch: ANY use — attribute access (``.shape``,
+    ``.dtype``, jax protocols), ``__array__``, truthiness, iteration —
+    raises the typed ``DonatedBufferError`` instead of jax's opaque
+    deleted-array RuntimeError.  ``repr`` stays safe so debuggers and
+    log formatting never explode."""
+
+    __slots__ = ("site", "desc")
+
+    def __init__(self, site: str, desc: str):
+        object.__setattr__(self, "site", site)
+        object.__setattr__(self, "desc", desc)
+
+    def _raise(self):
+        raise DonatedBufferError(
+            f"buffer ({self.desc}) was donated to the failed "
+            f"'{self.site}' dispatch and may already be consumed by "
+            f"XLA — restore it from a host copy "
+            f"(TrainingSupervisor snapshot / checkpoint / readmit) "
+            f"before reusing it")
+
+    def __getattr__(self, name):
+        self._raise()
+
+    def __array__(self, *a, **k):
+        self._raise()
+
+    def __bool__(self):
+        self._raise()
+
+    def __len__(self):
+        self._raise()
+
+    def __iter__(self):
+        self._raise()
+
+    def __repr__(self):
+        return f"<donated buffer ({self.desc}) consumed by {self.site}>"
+
+
+def _poison_one(obj, site: str) -> int:
+    """Poison one NDArray-like wrapper (tuples/lists/dicts recurse);
+    raw jax arrays and None are skipped — only python wrappers can
+    carry the sentinel."""
+    if obj is None:
+        return 0
+    if isinstance(obj, (tuple, list)):
+        return sum(_poison_one(o, site) for o in obj)
+    if isinstance(obj, dict):
+        return sum(_poison_one(o, site) for o in obj.values())
+    data = getattr(obj, "_data", None)
+    if data is None or isinstance(data, _DonatedBuffer) or \
+            not hasattr(obj, "_set_data"):
+        return 0
+    desc = "array"
+    try:
+        desc = f"{data.dtype}{tuple(data.shape)}"
+    except Exception:  # noqa: BLE001 — already-deleted jax arrays
+        pass
+    # direct rebind, NOT _set_data: the setter would hand the sentinel
+    # to engine.maybe_sync.  The next _set_data/_load_init (writeback or
+    # snapshot restore) replaces the sentinel and the wrapper is live
+    # again — poison clears exactly where the real buffer revives.
+    obj._data = _DonatedBuffer(site, desc)
+    return 1
+
+
+def poison_donated(site: str, *wrappers) -> int:
+    """Mark NDArray wrappers whose buffers a FAILED donated dispatch
+    may have consumed (call from the except path of a donating
+    dispatch).  One module-flag test when the sanitizer is off; returns
+    the number of wrappers poisoned.  Never raises — it runs while the
+    real dispatch error is propagating."""
+    if not ENABLED:
+        return 0
+    try:
+        n = sum(_poison_one(w, site) for w in wrappers)
+    except Exception:  # noqa: BLE001 — sanitizer must not mask the error
+        return 0
+    if n:
+        _record_violation(
+            "donated",
+            f"{n} buffer(s) donated to failed '{site}' dispatch were "
+            f"poisoned — any access before a restore raises "
+            f"DonatedBufferError", do_raise=False)
+    return n
+
+
+def poison_mapping(site: str, mapping: dict) -> int:
+    """The serving-boundary variant: replace a dispatch's donated
+    input dict values with sentinels IN PLACE, so a retry that
+    erroneously reuses the same padded batch fails typed instead of
+    serving deleted arrays."""
+    if not ENABLED or not isinstance(mapping, dict):
+        return 0
+    n = 0
+    for k, v in list(mapping.items()):
+        if isinstance(v, _DonatedBuffer):
+            continue
+        desc = "array"
+        try:
+            desc = f"{v.dtype}{tuple(v.shape)}"
+        except Exception:  # noqa: BLE001
+            pass
+        mapping[k] = _DonatedBuffer(site, desc)
+        n += 1
+    if n:
+        _record_violation(
+            "donated",
+            f"{n} donated input buffer(s) of failed '{site}' dispatch "
+            f"were poisoned in place", do_raise=False)
+    return n
 
 
 # -- hot-path marker ----------------------------------------------------------

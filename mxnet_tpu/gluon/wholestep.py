@@ -52,6 +52,7 @@ import jax.numpy as jnp
 import numpy as _np
 
 from ..analysis import hot_path
+from ..analysis import sanitizer as _san
 from ..base import MXNetError, getenv
 from ..faultinject import InjectedFault as _InjectedFault
 from ..faultinject import fire as _fi_fire
@@ -694,10 +695,29 @@ class WholeStepCompiler:
                 (built["sig"], type(opt_).__name__, policy,
                  thr is not None, tuple(data.shape),
                  tuple(label.shape))).encode()).hexdigest()[:16]
+            # the program CONTRACT the post-compile auditor
+            # (analysis.audit_programs, ISSUE 15) verifies against the
+            # lowered HLO: every donated leaf must become an
+            # input-output alias, AMP must leave no f32 dot/conv, a
+            # whole-step program contains zero host callbacks (Custom
+            # ops are ineligible by construction), and — single-process
+            # inline bucketed reduce; multi-host kvstore is ineligible
+            # — zero collective ops regardless of bucket count
+            contracts = {
+                "donate_argnums": (0, 1, 2, 3, 4),
+                "donated_leaves": len(jax.tree_util.tree_leaves(
+                    (gparams, svals, residuals, scaler, aux))),
+                "amp": policy,
+                "host_callbacks": 0,
+                "collectives": 0,
+                "buckets": len(built["bk"].sizes)
+                if thr is not None else 0,
+            }
             _introspect.note_jit(
                 "whole_step", fn, gparams, svals, residuals, scaler, aux,
                 consts, data._data, label._data,
-                jax.random.PRNGKey(0), lrs, wds, ts, signature=sig)
+                jax.random.PRNGKey(0), lrs, wds, ts, signature=sig,
+                contracts=contracts)
 
         # chaos site for transient device loss at the dispatch boundary:
         # fires before fn() executes, so the donated buffers are still
@@ -710,14 +730,34 @@ class WholeStepCompiler:
         if on:
             _metrics.XLA_LAUNCHES.inc(kind="whole_step")
             _metrics.OPTIMIZER_STEPS.inc()
-        with trace_span("whole_step", cat="trainer"), \
-                _flight.phase_span("whole_step", cat="step",
-                                   step=tr._step_id, watch=True,
-                                   mem=True), \
-                _memory.oom_guard("wholestep.step"):
-            loss, new_aux, new_p, new_s, new_res, new_scaler, nts = fn(
-                gparams, svals, residuals, scaler, aux, consts,
-                data._data, label._data, rkey, lrs, wds, ts)
+        try:
+            with trace_span("whole_step", cat="trainer"), \
+                    _flight.phase_span("whole_step", cat="step",
+                                       step=tr._step_id, watch=True,
+                                       mem=True), \
+                    _memory.oom_guard("wholestep.step"):
+                loss, new_aux, new_p, new_s, new_res, new_scaler, nts = \
+                    fn(gparams, svals, residuals, scaler, aux, consts,
+                       data._data, label._data, rkey, lrs, wds, ts)
+        except BaseException:
+            # MXNET_SANITIZE runtime twin of the use-after-donate
+            # static rule: an exception out of the donated program
+            # means the params/states/aux buffers may already be
+            # consumed by XLA.  Poison their wrappers so any touch
+            # before a restore raises a typed DonatedBufferError
+            # (naming this dispatch) instead of jax's opaque
+            # deleted-array error; the supervisor's snapshot restore
+            # (_load_init / set_states_bytes) replaces _data and
+            # thereby clears the poison.  One boolean test when the
+            # sanitizer is off.
+            if _san.ENABLED:
+                _san.poison_donated(
+                    "whole_step",
+                    *[params[n].list_data() for n in gnames],
+                    *[params[n].list_data()
+                      for n in built["aux_names"]],
+                    *[upd.states[i] for i in idx])
+            raise
         tr._step_id += 1
         if on:
             _metrics.TRAINER_STEP_DISPATCHES.set(

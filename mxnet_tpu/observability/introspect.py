@@ -236,7 +236,8 @@ def _hlo_of(compiled, lowered) -> Tuple[Optional[str], bool]:
 
 
 def note_program(name: str, compiled=None, lowered=None, label=None,
-                 signature=None, memory_stats=None) -> dict:
+                 signature=None, memory_stats=None,
+                 contracts=None) -> dict:
     """File one compiled program's stats under ``name`` — THE shared
     surface every compile chokepoint routes through (Executor bind /
     memory_analysis, CachedOp, FusedUpdater, WholeStepCompiler, serving
@@ -249,7 +250,14 @@ def note_program(name: str, compiled=None, lowered=None, label=None,
     read for callers that already hold the uniform dict.  Captured
     memory stats are also filed into the HBM ledger's compiled table
     (``memory.report()["compiled"]``) so that surface keeps one source.
-    Returns the record (``{}`` when introspection is off)."""
+
+    ``contracts`` (ISSUE 15) declares what the LOWERED artifact must
+    look like — ``{"donate_argnums": ..., "donated_leaves": n,
+    "amp": policy, "host_callbacks": 0, "collectives": 0}`` — which
+    ``analysis.audit_programs()`` verifies against the captured HLO
+    (donation really became input-output aliasing, AMP left no f32
+    dots, no host callbacks, collective count matches the bucketer's
+    plan).  Returns the record (``{}`` when introspection is off)."""
     if not ENABLED:
         return {}
     full = name if label is None else f"{name}:{label}"
@@ -271,6 +279,8 @@ def note_program(name: str, compiled=None, lowered=None, label=None,
             "hlo": hlo if hlo is not None else (prev or {}).get("hlo"),
             "hlo_truncated": truncated if hlo is not None
             else bool((prev or {}).get("hlo_truncated")),
+            "contracts": dict(contracts) if contracts is not None
+            else (prev or {}).get("contracts"),
             "captures": ((prev or {}).get("captures") or 0) + 1,
         }
         _programs[full] = rec
@@ -278,7 +288,7 @@ def note_program(name: str, compiled=None, lowered=None, label=None,
 
 
 def note_jit(name: str, fn, *args, label=None, signature=None,
-             **kwargs) -> dict:
+             contracts=None, **kwargs) -> dict:
     """Capture a jit-called program via ``fn.lower(*args)`` — a retrace
     (NO XLA compile unless MXNET_INTROSPECT_HLO=1 forces one for the
     text).  Call sites guard to once per program/cache key; a capture
@@ -292,7 +302,7 @@ def note_jit(name: str, fn, *args, label=None, signature=None,
         log.debug("introspect: lowering %s for capture failed: %s", name, e)
         return {}
     return note_program(name, lowered=lowered, label=label,
-                        signature=signature)
+                        signature=signature, contracts=contracts)
 
 
 def programs() -> Dict[str, dict]:

@@ -18,6 +18,7 @@ import jax
 import jax.numpy as jnp
 
 from .analysis import hot_path
+from .analysis import sanitizer as _san_mod
 from .base import MXNetError, Registry, getenv
 from . import ndarray as nd
 from .ndarray import NDArray
@@ -1208,8 +1209,22 @@ class FusedUpdater(Updater):
             self._noted_keys.add(key)
             import hashlib
             sig = hashlib.sha1(repr(key).encode()).hexdigest()[:16]
+            # auditable program contract (analysis.audit_programs,
+            # ISSUE 15): donated state (and weight, under
+            # donate_weights) leaves must alias outputs; the fused
+            # update is pure optimizer math — no host callbacks, no
+            # collectives (the bucketed allreduce runs in its own
+            # program on this path)
+            donated = (0, 2) if donate_weights else (2,)
+            leaves = len(jax.tree_util.tree_leaves(svals)) + \
+                (len(jax.tree_util.tree_leaves(wvals)) if donate_weights
+                 else 0)
             _introspect.note_jit("fused_update", fn, wvals, gvals, svals,
-                                 lrs, wds, ts, signature=sig)
+                                 lrs, wds, ts, signature=sig,
+                                 contracts={"donate_argnums": donated,
+                                            "donated_leaves": leaves,
+                                            "host_callbacks": 0,
+                                            "collectives": 0})
         if _metrics.ENABLED:
             _metrics.XLA_LAUNCHES.inc(kind="optimizer")
             _metrics.OPTIMIZER_STEPS.inc()
@@ -1223,7 +1238,21 @@ class FusedUpdater(Updater):
             # boundary (the fused-path twin of the whole-step site):
             # fires before fn(), so weights/states are still pre-step
             _fi_fire("device.unavailable", at="optimizer")
-            nws, nss, nts = fn(wvals, gvals, svals, lrs, wds, ts)
+            try:
+                nws, nss, nts = fn(wvals, gvals, svals, lrs, wds, ts)
+            except BaseException:
+                # MXNET_SANITIZE twin (ISSUE 15): the failed donated
+                # dispatch may have consumed the state (and, under
+                # donate_weights, weight) buffers — poison the
+                # wrappers so later touches raise typed
+                # DonatedBufferError; set_states_bytes / _set_data on
+                # restore clears the poison
+                if _san_mod.ENABLED:
+                    _san_mod.poison_donated(
+                        "fused_update",
+                        *[self.states[i] for i in indices],
+                        *(list(weights) if donate_weights else []))
+                raise
         commit_ts(nts)
         for k, i in enumerate(indices):
             weights[k]._set_data(nws[k])

@@ -35,6 +35,7 @@ import numpy as _np
 import jax
 
 from ..analysis import hot_path
+from ..analysis import sanitizer as _sanitizer
 from ..base import MXNetError, maybe_enable_compile_cache, np_dtype
 from ..context import cpu
 from ..faultinject import fire as _fi_fire
@@ -478,7 +479,20 @@ class BucketedPredictor:
                     "model weights were evicted between precompile and "
                     "dispatch — readmit() and retry")
             with trace_span("serve_dispatch", cat="serving"):
-                return compiled(padded, extra, params, aux, self._rng)
+                try:
+                    return compiled(padded, extra, params, aux,
+                                    self._rng)
+                except BaseException:
+                    # MXNET_SANITIZE twin (ISSUE 15): with donation on,
+                    # a failed dispatch may have consumed the padded
+                    # input buffers — poison the batch dict in place so
+                    # a retry that erroneously reuses it fails typed
+                    # (DonatedBufferError) instead of serving deleted
+                    # arrays.  One boolean test when off.
+                    if self._donate and _sanitizer.ENABLED:
+                        _sanitizer.poison_mapping("serve_dispatch",
+                                                  padded)
+                    raise
 
     @hot_path
     def _predict_routed(self, inputs: Dict[str, _np.ndarray]) -> list:

@@ -82,6 +82,15 @@ def pytest_configure(config):
         "named-scope per-layer attribution, MFU/roofline math, "
         "perf-regression sentinel).  Runs in tier-1 by default; "
         "`pytest -m introspect` selects just this suite")
+    config.addinivalue_line(
+        "markers",
+        "program_audit: compiled-program contract-audit tests "
+        "(mxnet_tpu.analysis.program_audit — donation→aliasing, AMP "
+        "cast coverage, host-callback and collective-count "
+        "verification against captured HLO; `python -m "
+        "mxnet_tpu.analysis --audit-programs` is the CLI twin).  Runs "
+        "in tier-1 by default; `pytest -m program_audit` selects just "
+        "this suite")
 
 
 @pytest.fixture(autouse=True)
@@ -99,3 +108,40 @@ def _seed():
     import mxnet_tpu as mx
     mx.random.seed(0)
     yield
+
+
+@pytest.fixture
+def program_audit():
+    """Arm opt-in HLO capture for the test's compiles and hand back a
+    checker that verifies a captured program's declared contracts —
+    donation really became input-output aliasing first among them — so
+    dispatch-count gates can pin ALIASING on the same program whose
+    1-dispatch budget they measure (ISSUE 15).  Usage::
+
+        def test_x(program_audit):
+            ...train...
+            aliased = program_audit("whole_step")
+    """
+    from mxnet_tpu.observability import introspect
+    prev_hlo = introspect.HLO
+    introspect.configure(hlo=True)
+
+    def check(program="whole_step", min_aliased=1):
+        from mxnet_tpu.analysis import program_audit as pa
+        rec = introspect.programs().get(program)
+        assert rec is not None, \
+            f"program {program!r} was never captured " \
+            f"(have: {sorted(introspect.programs())})"
+        assert rec.get("hlo"), \
+            f"no HLO captured for {program!r} — the program compiled " \
+            f"before this fixture armed capture"
+        issues = pa.audit_program(rec)
+        assert issues == [], issues
+        aliased = pa.parse_alias_table(rec["hlo"])
+        assert len(aliased) >= min_aliased, \
+            f"only {len(aliased)} aliased param(s); donation did not " \
+            f"become input-output aliasing"
+        return aliased
+
+    yield check
+    introspect.configure(hlo=prev_hlo)

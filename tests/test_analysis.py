@@ -150,6 +150,143 @@ BAD_MEMORY = """
         return jax.device_put(x, dev)
 """
 
+# ISSUE 15: the jit/program-boundary tier -------------------------------------
+BAD_USE_AFTER_DONATE = """
+    import jax
+
+    def step(params, grads):
+        fn = jax.jit(update, donate_argnums=(0,))
+        new = fn(params, grads)
+        loss = params["w"].sum()      # read of a donated value
+        return new, loss
+"""
+
+BAD_DONATE_LOOP = """
+    import jax
+
+    def train(params, batches):
+        fn = jax.jit(update, donate_argnums=(0,))
+        for b in batches:
+            out = fn(params, b)       # iter 2 passes a dead buffer
+        return out
+"""
+
+GOOD_DONATE_REBIND = """
+    import jax
+
+    def train(params, batches):
+        fn = jax.jit(update, donate_argnums=(0,))
+        for b in batches:
+            params = fn(params, b)    # rebind kills the taint
+        return params
+"""
+
+GOOD_DONATE_RESTORE = """
+    import jax
+
+    def retry(self, params, grads):
+        fn = jax.jit(update, donate_argnums=(0,))
+        try:
+            out = fn(params, grads)
+        except Exception:
+            self._restore_snapshot()   # restore idiom revives state
+            out = fn(params, grads)
+        return out
+"""
+
+BAD_DONATE_FACTORY = """
+    import jax
+
+    class C:
+        def _build_fn(self):
+            return jax.jit(update, donate_argnums=(1,))
+
+        def run(self, upd, key, a, b):
+            fn = upd.lookup_program(key, lambda: self._build_fn())
+            fn(a, b)
+            return b.shape            # b went through a donated slot
+"""
+
+BAD_RETRACE = """
+    import jax
+
+    def per_call(x):
+        return jax.jit(lambda v: v + 1)(x)
+"""
+
+BAD_RETRACE_LOOP = """
+    import jax
+
+    def in_loop(xs):
+        out = []
+        for x in xs:
+            f = jax.jit(step)
+            out.append(f(x))
+        return out
+"""
+
+BAD_RETRACE_KEY = """
+    def lookup(self, wvals):
+        key = ("update", [str(w.dtype) for w in wvals], id(self))
+        return self.lookup_program(key, build)
+"""
+
+GOOD_RETRACE_KEY = """
+    def lookup(self, wvals):
+        key = ("update", tuple(str(w.dtype) for w in wvals), self._uid)
+        return self.lookup_program(key, build)
+"""
+
+BAD_GATE = """
+    from mxnet_tpu.base import getenv
+
+    ENABLED = getenv("MXNET_FIXTURE_GATE", True)
+
+    def hook(x):
+        y = compute(x)                # work before the kill switch
+        if not ENABLED:
+            return x
+        return y
+"""
+
+BAD_GATE_REREAD = """
+    from mxnet_tpu.base import getenv
+
+    ENABLED = getenv("MXNET_FIXTURE_GATE", True)
+
+    def hook(x):
+        if not getenv("MXNET_FIXTURE_GATE", True):   # per-call parse
+            return x
+        return compute(x)
+"""
+
+GOOD_GATE = """
+    from mxnet_tpu.base import getenv
+
+    ENABLED = getenv("MXNET_FIXTURE_GATE", True)
+
+    def hook(x):
+        if not ENABLED:
+            return x
+        return compute(x)
+"""
+
+# the historical shape both PR 12 (wholestep) and PR 14 (mfu) fixed:
+# the rider ran, stored its result, and _emit never forwarded it
+BAD_BENCH_EMIT = """
+    _STATE = {"phase": "start", "img_s": None}
+
+    def _emit(partial):
+        out = {"value": _STATE["img_s"]}
+        if _STATE.get("lint") is not None:
+            out["lint"] = _STATE["lint"]
+        print(out)
+
+    def _run():
+        _STATE["lint"] = {"ok": True}
+        _STATE["mfu"] = {"mfu_pct": 12.0}   # never emitted
+"""
+
 
 # -- each rule fires on its known-bad fixture --------------------------------
 
@@ -270,6 +407,148 @@ def test_metrics_hygiene_fires(tmp_path):
     assert _lint(tmp_path, ok, ["metrics-hygiene"]) == []
 
 
+# -- ISSUE 15: use-after-donate ----------------------------------------------
+
+def test_use_after_donate_fires(tmp_path):
+    got = _lint(tmp_path, BAD_USE_AFTER_DONATE, ["use-after-donate"])
+    assert len(got) == 1, got
+    assert "'params'" in got[0].message
+    assert "donated" in got[0].message
+
+
+def test_use_after_donate_loop_carried(tmp_path):
+    """The loop-carried shape: iteration 2 passes the buffer iteration
+    1 donated — only a second pass over the loop body sees it."""
+    got = _lint(tmp_path, BAD_DONATE_LOOP, ["use-after-donate"])
+    assert len(got) == 1, got
+
+
+def test_use_after_donate_rebind_and_restore_are_kills(tmp_path):
+    assert _lint(tmp_path, GOOD_DONATE_REBIND,
+                 ["use-after-donate"]) == []
+    assert _lint(tmp_path, GOOD_DONATE_RESTORE,
+                 ["use-after-donate"]) == []
+
+
+def test_use_after_donate_through_factory_and_cache(tmp_path):
+    """The repo idiom: donation declared in a _build_fn factory,
+    resolved through upd.lookup_program(key, lambda: ...)."""
+    got = _lint(tmp_path, BAD_DONATE_FACTORY, ["use-after-donate"])
+    assert len(got) == 1, got
+    assert "'b'" in got[0].message
+
+
+# -- ISSUE 15: retrace-hazard -------------------------------------------------
+
+def test_retrace_hazard_jit_then_call(tmp_path):
+    got = _lint(tmp_path, BAD_RETRACE, ["retrace-hazard"])
+    assert any("EVERY call recompiles" in f.message for f in got), got
+
+
+def test_retrace_hazard_jit_in_loop(tmp_path):
+    got = _lint(tmp_path, BAD_RETRACE_LOOP, ["retrace-hazard"])
+    assert any("inside a loop" in f.message for f in got), got
+
+
+def test_retrace_hazard_unstable_cache_key(tmp_path):
+    got = _lint(tmp_path, BAD_RETRACE_KEY, ["retrace-hazard"])
+    msgs = " | ".join(f.message for f in got)
+    assert "unhashable" in msgs and "id(...)" in msgs, got
+    # tuple()-coerced comprehensions + counter uids are the blessed
+    # idiom (exactly what update_all / wholestep do)
+    assert _lint(tmp_path, GOOD_RETRACE_KEY, ["retrace-hazard"]) == []
+
+
+def test_retrace_hazard_key_resolution_is_scoped(tmp_path):
+    """An unrelated local named `key` in ANOTHER function must not
+    shadow a blessed cache key (the review-caught false positive:
+    file-global name resolution flagged legal code)."""
+    src = GOOD_RETRACE_KEY + """
+    def other():
+        key = [1, 2, 3]     # never a cache key — different scope
+        return key
+"""
+    assert _lint(tmp_path, src, ["retrace-hazard"]) == []
+
+
+def test_retrace_hazard_blessed_chokepoints_pass():
+    """The real compile chokepoints (wholestep, FusedUpdater, serving)
+    construct jit programs and must stay clean — the rule is about
+    UNblessed sites."""
+    got = analysis.run(["retrace-hazard"],
+                       [os.path.join(REPO_ROOT, "mxnet_tpu")], None)
+    assert got == [], got
+
+
+# -- ISSUE 15: gate-hygiene ---------------------------------------------------
+
+def test_gate_hygiene_buried_guard_fires(tmp_path):
+    got = _lint(tmp_path, BAD_GATE, ["gate-hygiene"])
+    assert len(got) == 1 and "buried" in got[0].message
+
+
+def test_gate_hygiene_per_call_reread_fires(tmp_path):
+    got = _lint(tmp_path, BAD_GATE_REREAD, ["gate-hygiene"])
+    assert len(got) == 1 and "re-read" in got[0].message
+
+
+def test_gate_hygiene_guard_first_is_clean(tmp_path):
+    assert _lint(tmp_path, GOOD_GATE, ["gate-hygiene"]) == []
+
+
+def test_gate_hygiene_module_level_read_is_clean(tmp_path):
+    """The gate DEFINITION itself (module-level getenv) must not count
+    as a re-read."""
+    src = GOOD_GATE + """
+    RAISE = getenv("MXNET_FIXTURE_GATE_RAISE", True)
+"""
+    assert _lint(tmp_path, src, ["gate-hygiene"]) == []
+
+
+# -- ISSUE 15: bench-emit -----------------------------------------------------
+
+def test_bench_emit_fires_on_historical_shape(tmp_path):
+    """The exact omission PR 12 (wholestep) and PR 14 (mfu) fixed by
+    hand, reconstructed: the rider stores its result, _emit never
+    forwards it."""
+    got = _lint(tmp_path, BAD_BENCH_EMIT, ["bench-emit"],
+                name="bench_fixture.py")
+    assert len(got) == 1, got
+    assert "'mfu'" in got[0].message and "_emit" in got[0].message
+
+
+def test_bench_emit_clean_when_forwarded(tmp_path):
+    fixed = BAD_BENCH_EMIT.replace(
+        '        if _STATE.get("lint") is not None:',
+        '        if _STATE.get("mfu") is not None:\n'
+        '            out["mfu"] = _STATE["mfu"]\n'
+        '        if _STATE.get("lint") is not None:')
+    assert _lint(tmp_path, fixed, ["bench-emit"],
+                 name="bench_fixture.py") == []
+
+
+def test_bench_emit_covers_repo_bench_py():
+    """The finalize leg audits the REAL bench.py even when the sweep
+    paths don't include it — every _STATE rider key must reach _emit
+    (this is what caught the probe_attempts omission this PR fixed)."""
+    got = analysis.run(["bench-emit"],
+                       [os.path.join(REPO_ROOT, "mxnet_tpu")], None)
+    assert got == [], got
+
+
+def test_new_rule_inline_suppression(tmp_path):
+    """Both suppression styles work on the new tier too."""
+    src = BAD_USE_AFTER_DONATE.replace(
+        'loss = params["w"].sum()      # read of a donated value',
+        'loss = params["w"].sum()  # graft-lint: disable=use-after-donate')
+    assert _lint(tmp_path, src, ["use-after-donate"]) == []
+    src2 = BAD_RETRACE.replace(
+        "        return jax.jit(lambda v: v + 1)(x)",
+        "        # graft-lint: disable=retrace-hazard\n"
+        "        return jax.jit(lambda v: v + 1)(x)")
+    assert _lint(tmp_path, src2, ["retrace-hazard"]) == []
+
+
 # -- suppression forms -------------------------------------------------------
 
 def test_inline_suppression_same_line(tmp_path):
@@ -357,10 +636,17 @@ def test_cli_exits_nonzero_on_seeded_violations(tmp_path):
              "atomic-write": BAD_ATOMIC_WRITE,
              "env-sync": BAD_ENV_SYNC,
              "metrics-hygiene": BAD_METRICS,
-             "memory-hygiene": BAD_MEMORY}
+             "memory-hygiene": BAD_MEMORY,
+             "use-after-donate": BAD_USE_AFTER_DONATE,
+             "retrace-hazard": BAD_RETRACE,
+             "gate-hygiene": BAD_GATE,
+             "bench-emit": BAD_BENCH_EMIT}
     assert set(seeds) == set(ALL_RULES)
     for i, (rule, src) in enumerate(seeds.items()):
-        p = tmp_path / f"seed_{i}.py"
+        # bench-emit only audits bench-named files
+        fname = f"bench_seed_{i}.py" if rule == "bench-emit" \
+            else f"seed_{i}.py"
+        p = tmp_path / fname
         p.write_text(textwrap.dedent(src))
         rc = main(["--rules", rule, str(p)])
         assert rc == 1, f"rule {rule} did not gate"
@@ -607,6 +893,163 @@ def test_emergency_save_with_inflight_async_write(tmp_path, sanitizer):
     assert mgr.latest_step() == 99
     assert [v for v in san.violations() if v["kind"] == "cycle"] == []
     mgr.close()
+
+
+# -- donated-buffer poisoning (the ISSUE 15 runtime twin) --------------------
+
+def test_poison_donated_raises_typed_and_set_data_clears(sanitizer):
+    x = mx.nd.array(np.ones((2, 2), np.float32))
+    n = san.poison_donated("test_dispatch", x)
+    assert n == 1
+    with pytest.raises(analysis.DonatedBufferError, match="test_dispatch"):
+        x.asnumpy()
+    with pytest.raises(analysis.DonatedBufferError):
+        _ = x.shape
+    # repr stays safe for logs/debuggers
+    assert "donated buffer" in repr(x._data)
+    # the restore path (_set_data) revives the wrapper — exactly where
+    # the real buffer would revive
+    import jax.numpy as jnp
+    x._set_data(jnp.zeros((2, 2), jnp.float32))
+    assert x.asnumpy().sum() == 0.0
+    assert any(v["kind"] == "donated" for v in san.violations())
+    assert san.state()["donated_poisoned"] >= 1
+
+
+def test_poison_donated_recurses_and_skips_raw(sanitizer):
+    a = mx.nd.array(np.ones((2,), np.float32))
+    b = mx.nd.array(np.ones((2,), np.float32))
+    import jax.numpy as jnp
+    raw = jnp.ones((2,))
+    n = san.poison_donated("s", [a, (b, None)], raw, {"k": raw})
+    assert n == 2  # only the NDArray wrappers carry the sentinel
+
+
+def test_poison_donated_noop_when_disabled():
+    assert san.ENABLED is False
+    x = mx.nd.array(np.ones((2,), np.float32))
+    assert san.poison_donated("s", x) == 0
+    assert x.asnumpy().sum() == 2.0
+
+
+def test_poison_mapping_in_place(sanitizer):
+    import jax.numpy as jnp
+    padded = {"data": jnp.ones((4, 3))}
+    assert san.poison_mapping("serve_dispatch", padded) == 1
+    with pytest.raises(analysis.DonatedBufferError, match="serve_dispatch"):
+        _ = padded["data"].shape
+
+
+def test_wholestep_failed_dispatch_poisons_and_restore_revives(
+        tmp_path, monkeypatch, sanitizer):
+    """End-to-end drill of the PR 12 incident class: a whole-step
+    dispatch fails mid-execution AFTER donation — under MXNET_SANITIZE
+    the param wrappers raise typed DonatedBufferError (instead of
+    jax's opaque deleted-array RuntimeError), and a
+    TrainingSupervisor-style snapshot restore revives them."""
+    from mxnet_tpu import gluon
+    from mxnet_tpu.gluon.wholestep import WholeStepCompiler
+    monkeypatch.setenv("MXNET_WHOLE_STEP", "1")
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(8, activation="relu"), gluon.nn.Dense(4))
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.01, "momentum": 0.9})
+    st = WholeStepCompiler(net, gluon.loss.L2Loss(), trainer)
+    rs = np.random.RandomState(0)
+    x = mx.nd.array(rs.normal(0, 1, (4, 6)).astype(np.float32))
+    y = mx.nd.array(rs.normal(0, 1, (4, 4)).astype(np.float32))
+    for _ in range(2):  # step 1 may fall back while shapes materialize
+        st.step(x, y)
+    assert st.active, st.fallback_reason
+    # host snapshot BEFORE the failure (what a supervisor keeps)
+    params = {n: p.data().asnumpy()
+              for n, p in net.collect_params().items()}
+
+    # make the NEXT dispatch fail as if XLA died mid-execution: wrap
+    # every cached program to raise an execution-typed error
+    upd = trainer._updaters[0]
+    for key, fn in list(upd._fn_cache.items()):
+        def boom(*a, _fn=fn, **k):
+            raise RuntimeError("RESOURCE_EXHAUSTED: injected")
+        upd._fn_cache[key] = boom
+    with pytest.raises(RuntimeError, match="RESOURCE_EXHAUSTED"):
+        st.step(x, y)
+    # donated wrappers are poisoned: the first touch is typed and
+    # names the dispatch site
+    with pytest.raises(analysis.DonatedBufferError, match="whole_step"):
+        for p in net.collect_params().values():
+            p.data().asnumpy()
+    # snapshot restore (the supervisor path: _load_init from host
+    # copies) clears the poison
+    for n, p in net.collect_params().items():
+        p._load_init(mx.nd.array(params[n]), p.list_ctx())
+    for p in net.collect_params().values():
+        assert np.isfinite(p.data().asnumpy()).all()
+
+
+def test_supervisor_retry_revives_poisoned_buffers(tmp_path, monkeypatch,
+                                                   sanitizer):
+    """The PR 12 donation-safe-retry path, re-drilled under the
+    sanitizer twin: a transient device loss DURING the donated
+    whole-step dispatch poisons the wrappers; the TrainingSupervisor's
+    snapshot-restore-replay retry revives every one of them and the
+    retried step completes — proving restore and poison clear at
+    exactly the same points."""
+    from mxnet_tpu import gluon
+    from mxnet_tpu.gluon.supervisor import TrainingSupervisor
+    from mxnet_tpu.gluon.wholestep import WholeStepCompiler
+    from mxnet_tpu.resilience import DeviceUnavailableError
+    monkeypatch.setenv("MXNET_WHOLE_STEP", "1")
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(8, activation="relu"), gluon.nn.Dense(4))
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.01, "momentum": 0.9})
+    st = WholeStepCompiler(net, gluon.loss.L2Loss(), trainer)
+    sup = TrainingSupervisor(st.step, trainer=trainer, params=net,
+                             retries=2, backoff_s=0.0, stall_factor=0,
+                             snapshot_steps=1)
+    rs = np.random.RandomState(0)
+    x = mx.nd.array(rs.normal(0, 1, (4, 6)).astype(np.float32))
+    y = mx.nd.array(rs.normal(0, 1, (4, 4)).astype(np.float32))
+    for _ in range(2):
+        sup.step(x, y)
+    assert st.active, st.fallback_reason
+    # next dispatch dies mid-execution (transient class) exactly once
+    upd = trainer._updaters[0]
+    fired = {"n": 0}
+    for key, fn in list(upd._fn_cache.items()):
+        def flaky(*a, _fn=fn, **k):
+            if fired["n"] == 0:
+                fired["n"] += 1
+                raise DeviceUnavailableError("injected tunnel loss")
+            return _fn(*a, **k)
+        upd._fn_cache[key] = flaky
+    loss = sup.step(x, y)   # retried through snapshot restore + replay
+    assert fired["n"] == 1
+    assert np.isfinite(loss.asnumpy()).all()
+    # the poison event was recorded, and nothing is left poisoned
+    assert any(v["kind"] == "donated" for v in san.violations())
+    for p in net.collect_params().values():
+        assert np.isfinite(p.data().asnumpy()).all()
+    sup.close()
+
+
+def test_audited_paths_stay_use_after_donate_clean():
+    """The ISSUE 15 satellite audit, pinned: the supervisor
+    snapshot/restore path and the serving evict/readmit/device_put
+    path carry no use-after-donate findings (serving never donates
+    weights — only the per-request padded batch — and the supervisor
+    rebuilds from host copies; if either changes, this fails before
+    the opaque deleted-array error ships)."""
+    got = analysis.run(
+        ["use-after-donate"],
+        [os.path.join(REPO_ROOT, "mxnet_tpu", "gluon", "supervisor.py"),
+         os.path.join(REPO_ROOT, "mxnet_tpu", "gluon", "wholestep.py"),
+         os.path.join(REPO_ROOT, "mxnet_tpu", "serving"),
+         os.path.join(REPO_ROOT, "mxnet_tpu", "optimizer.py")], None)
+    assert got == [], got
 
 
 # -- sanitized serving drill (the chaos-subset acceptance) -------------------

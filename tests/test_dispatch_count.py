@@ -579,11 +579,15 @@ def _wholestep_steady_per_step(net, warmup=3, n=3, compression=None,
 
 
 @pytest.mark.perf_smoke
-def test_wholestep_dispatch_budget(monkeypatch):
+def test_wholestep_dispatch_budget(monkeypatch, program_audit):
     """ISSUE 10 acceptance gate: MXNET_WHOLE_STEP=1 runs a dense
     hybridized step as ONE donated XLA program — <= 2 steady-state
     dispatches (measured exactly 1: xla:whole_step), 0 device_puts,
-    and the TRAINER_STEP_DISPATCHES gauge keeps telling the truth."""
+    and the TRAINER_STEP_DISPATCHES gauge keeps telling the truth.
+    ISSUE 15 extends the gate: the program-contract auditor must
+    confirm on the SAME program that donation really became
+    input-output aliasing — 1 dispatch that secretly copies the model
+    would pass the count while doubling HBM."""
     monkeypatch.setenv("MXNET_WHOLE_STEP", "1")
     from mxnet_tpu.observability import metrics as m
     net = _gluon_mlp(depth=9)   # 20 params
@@ -593,6 +597,12 @@ def test_wholestep_dispatch_budget(monkeypatch):
     assert per_step.get("total", 99) <= 2.0, per_step
     assert per_step.get("xla:whole_step", 0) >= 1.0, per_step
     assert m.TRAINER_STEP_DISPATCHES.get() <= 2.0
+    # every donated leaf (params + optimizer states + aux) must alias:
+    # 20 trainable params with momentum state = >= 40 aliased buffers
+    aliased = program_audit("whole_step", min_aliased=1)
+    from mxnet_tpu.observability import introspect
+    rec = introspect.programs()["whole_step"]
+    assert len(aliased) >= rec["contracts"]["donated_leaves"] > 0
 
 
 @pytest.mark.perf_smoke
