@@ -6,7 +6,7 @@ SRCS := src/runtime/storage.cc src/runtime/engine.cc \
         src/runtime/recordio.cc src/runtime/prefetch.cc
 LIB := mxnet_tpu/_native/libmxtpu_runtime.so
 
-.PHONY: native test chaos chaos-train chaos-serve lint-graft clean cpp_example predict_capi capi_example
+.PHONY: native test chaos chaos-train chaos-serve lint-graft report clean cpp_example predict_capi capi_example
 
 native: $(LIB)
 
@@ -111,6 +111,12 @@ chaos-serve:
 # possibly unreachable TPU tunnel (same reason as the chaos target).
 lint-graft:
 	JAX_PLATFORMS=cpu python -m mxnet_tpu.analysis --audit-programs mxnet_tpu
+
+# render the offline run report for the newest run journal under
+# MXNET_RUN_DIR (or ./runs); `make report RUN_DIR=/path` overrides
+RUN_DIR ?= $(or $(MXNET_RUN_DIR),runs)
+report:
+	JAX_PLATFORMS=cpu python -m mxnet_tpu.observability.report $(RUN_DIR)
 
 clean:
 	rm -f $(LIB) $(CPP_EX) $(PRED_LIB) $(CAPI_EX) $(CAPI_TRAIN_EX) \

@@ -783,6 +783,32 @@ class MetricsHygieneChecker:
                                     f"built ({why}) — labels must come "
                                     f"from a bounded set (e.g. the "
                                     f"bucket lattice via bucket_label)"))
+            # run-journal / goodput-ledger names (ISSUE 16): every
+            # distinct journal.emit event name is a grep key operators
+            # and the offline reporter enumerate, and every
+            # goodput.attribute reason is a row in the badput taxonomy
+            # + a mxnet_badput_seconds_total label — the same
+            # unbounded-cardinality class as phase names.  `emit` and
+            # `attribute` are too generic for any-receiver matching,
+            # so they stay allowlisted to journal-/goodput-ish bases
+            # (the same conservative posture as `record` above).
+            if ((last == "emit"
+                 and cn.split(".")[0] in ("journal", "_journal", "jr"))
+                or (last == "attribute"
+                    and cn.split(".")[0] in ("goodput", "_goodput",
+                                             "gp"))) and node.args:
+                name_arg = node.args[0]
+                why = self._dynamic_str(name_arg)
+                if why:
+                    out.append(ctx.finding(
+                        self.name, name_arg,
+                        f"journal event / badput reason is dynamically "
+                        f"built ({why}) — event names and goodput "
+                        f"classes must come from a bounded literal set "
+                        f"(each distinct name is a forever grep key in "
+                        f"the run journal and a "
+                        f"mxnet_badput_seconds_total label; put the "
+                        f"varying part in the entry's fields instead)"))
         return out
 
 

@@ -36,6 +36,7 @@ class _PreemptionHook:
             if self._fired:
                 return
             self._fired = True
+        step = None
         try:
             step, state = self.state_fn()
             if self.manager._last_saved_step == int(step) and \
@@ -63,6 +64,16 @@ class _PreemptionHook:
             # (this process is exiting; no background thread survives),
             # and never allowed to mask a save failure.
             self._dump_flight()
+            # the run journal's TERMINAL entry: fsync'd before the
+            # process exits, so the restarted incarnation (same run id)
+            # and the offline reporter both see why this one ended
+            try:
+                from ..observability import journal as _journal
+                if _journal.ENABLED:
+                    _journal.emit("preempted", step=step, durable=True,
+                                  why=why)
+            except Exception as e:  # noqa: BLE001 — dying anyway
+                log.error("preemption-hook journal entry failed: %s", e)
 
     @staticmethod
     def _dump_flight() -> None:

@@ -35,6 +35,7 @@ from ..analysis import sanitizer as _san
 from ..base import MXNetError, getenv
 from ..faultinject import fire as _fi_fire
 from ..observability import flight as _flight
+from ..observability import journal as _journal
 from ..observability import memory as _memory
 from ..observability import metrics as _metrics
 from . import layout as _layout
@@ -313,6 +314,12 @@ class CheckpointManager:
                 time.perf_counter() - t0)
             _metrics.CHECKPOINT_BYTES_WRITTEN.inc(written)
             _metrics.CHECKPOINT_LAST_STEP.set(step)
+        if _journal.ENABLED:
+            # durable: after a crash, the journal's last checkpoint_save
+            # row IS the resume point an operator reaches for
+            _journal.emit("checkpoint_save", step=step, durable=True,
+                          bytes=written,
+                          seconds=round(time.perf_counter() - t0, 6))
         try:
             self._gc()
         except Exception as e:  # noqa: BLE001 — GC must not fail a save
@@ -429,6 +436,10 @@ class CheckpointManager:
             if _metrics.ENABLED:
                 _metrics.CHECKPOINT_RESTORE_SECONDS.observe(
                     time.perf_counter() - t0)
+            if _journal.ENABLED:
+                _journal.emit("checkpoint_restore", step=cand,
+                              durable=True,
+                              seconds=round(time.perf_counter() - t0, 6))
             if with_manifest:
                 return cand, state, manifest
             return cand, state
@@ -639,6 +650,11 @@ def restore_trainer(manager: CheckpointManager, params, trainer=None,
         p._load_init(arr, pctx)
     if trainer is not None and TRAINER_STATES_KEY in state:
         trainer.set_states_bytes(state[TRAINER_STATES_KEY])
+    if _journal.ENABLED:
+        # the durable stitch between incarnations: the restarted
+        # process resumes the SAME run id (journal.py continuity), and
+        # this row marks where training re-entered the step sequence
+        _journal.resume_marker(got_step, source="restore_trainer")
     return got_step
 
 
@@ -670,4 +686,7 @@ def restore_or_initialize(manager: CheckpointManager, params, trainer=None,
     else:
         for p in pd.values():
             p.initialize(init=initializer, ctx=ctx)
+    if _journal.ENABLED:
+        _journal.emit("run_initialized", durable=True,
+                      directory=manager.directory)
     return None

@@ -73,6 +73,8 @@ import numpy as _np
 from ..base import MXNetError, getenv
 from ..checkpoint import layout as _layout
 from ..observability import flight as _flight
+from ..observability import goodput as _goodput
+from ..observability import journal as _journal
 from ..observability import metrics as _metrics
 from .. import resilience as _res
 from ..resilience import (DivergenceError, StepRetriesExhausted,
@@ -297,6 +299,8 @@ class TrainingSupervisor:
                 self._window.pop()
             raise
         self._step_count += 1
+        if _journal.ENABLED:
+            _journal.maybe_milestone(self._step_count, source="supervisor")
         return self._check_divergence(out)
 
     __call__ = step
@@ -397,6 +401,11 @@ class TrainingSupervisor:
                         step=self._step_count) from e
                 if _metrics.ENABLED:
                     _metrics.SUPERVISOR_RETRIES.inc()
+                if _journal.ENABLED:
+                    _journal.emit("supervisor_retry",
+                                  step=self._step_count,
+                                  attempt=attempt + 1,
+                                  error=f"{type(e).__name__}: {e}")
                 log.warning(
                     "supervisor: transient failure at step %d "
                     "(%s: %s) — restoring snapshot from step %s and "
@@ -454,9 +463,14 @@ class TrainingSupervisor:
             return
         if _metrics.ENABLED:
             _metrics.SUPERVISOR_REWINDS.inc(reason="retry")
-        self._restore_snapshot()
-        for rargs, rkw in self._window[:-1]:
-            self._execute(rargs, rkw)
+        # the restore + window replay is re-done work, not progress:
+        # its whole wall-clock books as retry_replay badput, and any
+        # trainer_step spans recorded inside are suppressed so replayed
+        # steps don't double-count as goodput (docs/goodput.md)
+        with _goodput.replay_scope("retry_replay"):
+            self._restore_snapshot()
+            for rargs, rkw in self._window[:-1]:
+                self._execute(rargs, rkw)
 
     # -- stall-guarded execution ---------------------------------------------
     def _ensure_worker(self) -> None:
@@ -543,6 +557,15 @@ class TrainingSupervisor:
             detail={"timeout_s": round(timeout, 3),
                     "ewma_s": round(self._ewma, 6),
                     "stall_factor": self.stall_factor})
+        if _goodput.ENABLED:
+            # the wedged step never completes, so no span records it —
+            # the watchdog's whole wait is the stall's badput
+            _goodput.attribute("stall", timeout)
+        if _journal.ENABLED:
+            _journal.emit("supervisor_stall", step=self._step_count,
+                          durable=True, timeout_s=round(timeout, 3),
+                          report_path=(report or {}).get("report_path"),
+                          flight_path=(report or {}).get("flight_path"))
         raise TrainingStalledError(
             f"training step {self._step_count} still running after "
             f"{timeout:.1f}s (EWMA {self._ewma * 1e3:.1f} ms x factor "
@@ -569,6 +592,11 @@ class TrainingSupervisor:
             "divergence", step=failing,
             detail={"consecutive_nonfinite": self._nonfinite,
                     "patience": self.diverge_patience})
+        if _journal.ENABLED:
+            _journal.emit("supervisor_divergence", step=failing,
+                          durable=True, action=self.on_diverge,
+                          report_path=(report or {}).get("report_path"),
+                          flight_path=(report or {}).get("flight_path"))
         self._nonfinite = 0
         if self.on_diverge == "rewind" and self._snap is not None \
                 and self._can_restore:
@@ -579,7 +607,8 @@ class TrainingSupervisor:
                 "snapshot from step %d (MXNET_SUPERVISE_ON_DIVERGE="
                 "rewind); post-mortem %s", failing, self._snap[0],
                 (report or {}).get("report_path"))
-            self._restore_snapshot()
+            with _goodput.replay_scope("rewind"):
+                self._restore_snapshot()
             # continuing FORWARD with fresh data from the snapshot
             # state: the window's batches produced the divergence, so
             # they are deliberately not replayed

@@ -32,6 +32,7 @@ from ..base import MXNetError, getenv
 from ..faultinject import fire as _fi_fire
 from ..ndarray import NDArray
 from ..observability import flight as _flight
+from ..observability import journal as _journal
 from ..observability import introspect as _introspect
 from ..observability import memory as _memory
 from ..observability import metrics as _metrics
@@ -199,6 +200,8 @@ class Trainer:
             # (the whole-step path ticks its own phase in
             # WholeStepCompiler._dispatch): one counter bump per step
             _introspect.sentinel_tick("trainer_step")
+        if _journal.ENABLED:
+            _journal.maybe_milestone(self._step_id, source="trainer")
 
     def _step(self, batch_size, ignore_stale_grad=False):
         if not self._kv_initialized:

@@ -59,6 +59,7 @@ from ..faultinject import fire as _fi_fire
 from ..ndarray import NDArray
 from ..resilience import DeviceUnavailableError as _DeviceUnavailableError
 from ..observability import flight as _flight
+from ..observability import journal as _journal
 from ..observability import introspect as _introspect
 from ..observability import memory as _memory
 from ..observability import metrics as _metrics
@@ -767,6 +768,8 @@ class WholeStepCompiler:
             # step; every SENTINEL_EVERY steps the warmed whole_step
             # EWMA compares against the persisted baseline
             _introspect.sentinel_tick("whole_step")
+        if _journal.ENABLED:
+            _journal.maybe_milestone(tr._step_id, source="whole_step")
 
         for n in gnames:
             params[n].list_data()[0]._set_data(new_p[n])

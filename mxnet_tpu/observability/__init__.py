@@ -59,6 +59,8 @@ from __future__ import annotations
 
 from . import metrics
 from . import tracing
+from . import goodput
+from . import journal
 from . import flight
 from . import timeline
 from . import memory
@@ -73,6 +75,7 @@ from .memory import memory_scope, oom_guard, DeviceMemoryError, HBMBudgetError
 
 __all__ = [
     "metrics", "tracing", "flight", "timeline", "memory", "introspect",
+    "goodput", "journal",
     "Counter",
     "Gauge", "Histogram", "MetricsRegistry", "REGISTRY", "enabled",
     "enable", "disable", "dispatch_counts", "step_dispatches", "snapshot",

@@ -51,6 +51,8 @@ from typing import Dict, List, Optional, Tuple
 
 from ..base import getenv, unique_path, atomic_write
 from ..analysis import sanitizer as _san
+from . import goodput as _goodput
+from . import journal as _journal
 
 log = logging.getLogger(__name__)
 
@@ -236,6 +238,10 @@ def record(name: str, cat: str, t0_us: float, t1_us: float,
     if trace_id is None:
         trace_id = getattr(_tls, "trace", None)
     _segment().add((name, cat, t0_us, t1_us, step, trace_id, labels))
+    if _goodput.ENABLED:
+        # one boolean + one dict lookup: top-level unit-of-work spans
+        # feed the run's goodput ledger (docs/goodput.md)
+        _goodput.observe_span(name, (t1_us - t0_us) / 1e6)
     if watch:
         note(name, (t1_us - t0_us) / 1e6)
 
@@ -396,10 +402,15 @@ def dump(path: Optional[str] = None, reason: str = "manual",
     global _dump_count, _last_dump_path
     from . import timeline as _timeline
     from .. import profiler as _prof
+    meta = {"reason": reason,
+            **({"anomaly": dict(_last_anomaly)} if _last_anomaly else {})}
+    if _journal.ENABLED:
+        # cross-reference: the dump names its run, the journal names
+        # the dump — an operator pivots either way (docs/goodput.md)
+        meta["run_id"] = _journal.run_id()
+        meta["journal_path"] = _journal.path()
     trace = _timeline.build_trace(records(), list(_prof._events),
-                                  meta={"reason": reason,
-                                        **({"anomaly": dict(_last_anomaly)}
-                                           if _last_anomaly else {})})
+                                  meta=meta)
     if path is None:
         d = os.environ.get("MXNET_FLIGHT_DIR", ".") or "."
         os.makedirs(d, exist_ok=True)
@@ -412,6 +423,8 @@ def dump(path: Optional[str] = None, reason: str = "manual",
         # reason is one of {"manual", "anomaly", "signal", "oom",
         # "divergence", "stall", "preempt"} — bounded
         _metrics.FLIGHT_DUMPS.inc(reason=reason)
+    if _journal.ENABLED:
+        _journal.note_dump(path, reason)
     return path
 
 

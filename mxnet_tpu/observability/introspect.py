@@ -260,6 +260,12 @@ def note_program(name: str, compiled=None, lowered=None, label=None,
     plan).  Returns the record (``{}`` when introspection is off)."""
     if not ENABLED:
         return {}
+    from . import goodput as _goodput
+    if _goodput.ENABLED:
+        # training compiles happen inside jax where their duration is
+        # invisible here — count the event (serving precompile, which
+        # owns its compile call, attributes measured seconds)
+        _goodput.note_event("recompile")
     full = name if label is None else f"{name}:{label}"
     cost = _cost_of(compiled, lowered)
     mem = memory_stats if memory_stats is not None else _memory_of(compiled)
@@ -832,6 +838,12 @@ def _sentinel_check(phase: str) -> None:
         # kind/phase are bounded literal sets (step_time|dispatches x
         # whole_step|trainer_step)
         _metrics.PERF_REGRESSIONS.inc(kind=kind, phase=phase)
+    from . import journal as _journal
+    if _journal.ENABLED:
+        _journal.emit("perf_regression", durable=True, kind=kind,
+                      phase=phase,
+                      current_p50_ms=cur["step_time_p50_ms"],
+                      baseline_p50_ms=base["step_time_p50_ms"])
 
 
 def refresh_baseline(phase: str = "whole_step") -> Optional[dict]:

@@ -659,6 +659,12 @@ def _post_mortem(site: str, exc: BaseException) -> None:
 def _bg_oom_dump(site: str, rec: dict) -> None:
     global _oom_dumps
     try:
+        from . import journal as _journal
+        if _journal.ENABLED:
+            # cross-reference the run journal in the OOM report (and
+            # vice versa below) — pivot from badput row to timeline
+            rec["run_id"] = _journal.run_id()
+            rec["journal_path"] = _journal.path()
         d = os.environ.get("MXNET_FLIGHT_DIR", ".") or "."
         os.makedirs(d, exist_ok=True)
         path = unique_path(d, "oom", ".json")
@@ -683,6 +689,10 @@ def _bg_oom_dump(site: str, rec: dict) -> None:
                     _last_oom.setdefault(k, rec[k])
         _oom_dumps += 1
         log.error("HBM OOM post-mortem at %s: %s", site, path)
+        if _journal.ENABLED:
+            _journal.emit("oom", durable=True, site=site,
+                          report_path=rec.get("report_path"),
+                          flight_path=rec.get("flight_path"))
     except Exception as e:  # noqa: BLE001 — a failed dump must not mask
         log.warning("OOM post-mortem dump failed: %s", e)
     finally:

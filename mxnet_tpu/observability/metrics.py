@@ -672,6 +672,42 @@ PERF_REGRESSIONS = Counter(
     "refreshed (docs/introspection.md)")
 
 
+def _goodput_ratio() -> float:
+    """Export-time pull of the goodput fraction from the run ledger
+    (lazy/guarded — a scrape must never fail because of it; 0.0 until
+    any span is attributed)."""
+    try:
+        from . import goodput as _gp
+        if not _gp.ENABLED:
+            return 0.0
+        return float(_gp.ratio())
+    except Exception:  # noqa: BLE001
+        return 0.0
+
+
+GOODPUT_RATIO = Gauge(
+    "mxnet_goodput_ratio",
+    "Fraction (0..1) of this run's wall-clock attributed to useful "
+    "compute (flight trainer_step/whole_step/serve_dispatch spans) by "
+    "the goodput ledger (mxnet_tpu.observability.goodput) — the rest "
+    "is badput (mxnet_badput_seconds_total) or unattributed.  Computed "
+    "at export; docs/goodput.md",
+    fn=lambda: _goodput_ratio())
+BADPUT_SECONDS = Counter(
+    "mxnet_badput_seconds_total",
+    "Wall-clock seconds lost to each badput class, by reason "
+    "(data_wait / checkpoint_block / retry_replay / rewind / recompile "
+    "/ eviction_churn / stall / shed — the closed goodput taxonomy; "
+    "docs/goodput.md)")
+SLO_BURN = Counter(
+    "mxnet_slo_burn_total",
+    "Rate-limited SLO burn firings, by slo (goodput = run goodput %% "
+    "fell below MXNET_SLO_GOODPUT_PCT, serve_p99 = sliding-window "
+    "serve p99 exceeded MXNET_SLO_SERVE_P99_MS).  Each firing also "
+    "warns, journals an slo_burn entry, and fails the slo_burn "
+    "readyz() check until the window recovers (docs/goodput.md)")
+
+
 def _introspect_mfu(key: str) -> float:
     """Export-time pull of one MFU/roofline field from the introspect
     layer (lazy/guarded — a scrape must never fail because of it;
@@ -821,6 +857,24 @@ def _programs_snapshot() -> dict:
         return {"enabled": False}
 
 
+def _goodput_snapshot() -> dict:
+    """snapshot()["goodput"]: per-class seconds/events, goodput %,
+    unattributed slack, SLO targets + burn state, and the active run
+    journal id/path (docs/goodput.md).  Lazy/guarded — the metrics
+    layer must never fail because of the ledger."""
+    try:
+        from . import goodput as _gp
+        out = _gp.report()
+        if out.get("enabled"):
+            out["slo"] = _gp.slo_state()
+        from . import journal as _jr
+        out["run_id"] = _jr.run_id()
+        out["journal_path"] = _jr.path()
+        return out
+    except Exception:  # noqa: BLE001
+        return {"enabled": False}
+
+
 def _analysis_snapshot() -> dict:
     """snapshot()["analysis"]: sanitizer state + violation counters
     (docs/static_analysis.md).  The sanitizer import is lazy/guarded —
@@ -893,6 +947,7 @@ def snapshot() -> dict:
             "latency_exemplars": SERVE_LATENCY_SECONDS.exemplars(),
         },
         "flight": _flight_snapshot(),
+        "goodput": _goodput_snapshot(),
         "memory": _memory_snapshot(),
         "programs": _programs_snapshot(),
         "analysis": _analysis_snapshot(),

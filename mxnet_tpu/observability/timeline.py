@@ -38,12 +38,43 @@ def _phase_flops() -> Dict[str, float]:
         return {}
 
 
+def _badput_map() -> Dict[str, str]:
+    """{span name: badput class} from the goodput ledger's taxonomy —
+    the feed for the ``mxnet_badput_seconds`` counter track.
+    Lazy/guarded: the exporter must never fail because of it."""
+    try:
+        from . import goodput as _gp
+        if not _gp.ENABLED:
+            return {}
+        return {n: c for n, c in _gp._SPAN_CLASS.items()
+                if c != "compute"}
+    except Exception:  # noqa: BLE001
+        return {}
+
+
 def chrome_events(flight_records: List[tuple]) -> List[dict]:
     """``(segment, record)`` pairs → Chrome trace complete events plus
     one thread_name metadata event per segment."""
     events: List[dict] = []
     seen_tids: Dict[int, str] = {}
     phase_flops = _phase_flops()
+    badput_map = _badput_map()
+    badput_cum: Dict[str, float] = {}
+    # cumulative badput must grow monotonically along the timeline, so
+    # the counter walks records in span-end order regardless of which
+    # thread segment recorded them
+    for _, rec in sorted(flight_records, key=lambda p: p[1][3]):
+        name, _, t0, t1, _, _, _ = rec
+        cls = badput_map.get(name)
+        if cls is None or t1 <= t0:
+            continue
+        badput_cum[cls] = badput_cum.get(cls, 0.0) + (t1 - t0) / 1e6
+        # one "mxnet_badput_seconds" track per class: Perfetto renders
+        # stacked cumulative badput lined up with the spans that caused
+        # it (docs/goodput.md)
+        events.append({"name": "mxnet_badput_seconds", "ph": "C",
+                       "ts": t1, "pid": PID,
+                       "args": {cls: round(badput_cum[cls], 6)}})
     for seg, rec in flight_records:
         name, cat, t0, t1, step, trace_id, labels = rec
         seen_tids.setdefault(seg.tid, seg.thread_name)

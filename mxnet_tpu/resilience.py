@@ -197,7 +197,13 @@ def post_mortem(reason: str, step: Optional[int] = None,
     if detail:
         info["detail"] = dict(detail)
     from .observability import flight as _flight
+    from .observability import journal as _journal
     from .observability import memory as _memory
+    if _journal.ENABLED:
+        # cross-reference both ways: the report names its run + journal
+        # and the journal names the report files (ISSUE 16 satellite)
+        info["run_id"] = _journal.run_id()
+        info["journal_path"] = _journal.path()
     try:
         payload = dict(info)
         if _memory.ENABLED:
@@ -220,6 +226,11 @@ def post_mortem(reason: str, step: Optional[int] = None,
     log.warning("post-mortem (%s) at step %s: report=%s flight=%s",
                 reason, step, info.get("report_path"),
                 info.get("flight_path"))
+    if _journal.ENABLED:
+        _journal.emit("post_mortem", step=step, durable=True,
+                      why=reason,
+                      report_path=info.get("report_path"),
+                      flight_path=info.get("flight_path"))
     with _pm_lock:
         _last_pm[reason] = info
     return info

@@ -291,9 +291,17 @@ class BucketedPredictor:
                 # donation is a best-effort HBM release, not a contract
                 warnings.filterwarnings(
                     "ignore", message=".*donated buffers.*")
+                _t0_compile = time.perf_counter()
                 compiled = self._jit.lower(
                     data_avals, extra_avals, param_avals, aux_avals,
                     self._rng).compile()
+            from ..observability import goodput as _goodput
+            if _goodput.ENABLED:
+                # measured XLA compile (or persistent-cache load) time
+                # books as recompile badput: seconds a request spent
+                # waiting on program build, not dispatch
+                _goodput.attribute("recompile",
+                                   time.perf_counter() - _t0_compile)
             from .. import base as _base
             readmission = (key in self._ever_compiled
                            and _base._COMPILE_CACHE_WIRED)

@@ -61,6 +61,7 @@ from ..analysis import sanitizer as _san
 from ..base import MXNetError, getenv
 from ..faultinject import fire as _fi_fire
 from ..observability import flight as _flight
+from ..observability import journal as _journal
 from ..observability import memory as _memory
 from ..observability import metrics as _metrics
 from .predictor import BucketedPredictor
@@ -579,6 +580,10 @@ class ModelRegistry:
             return 0.0
         if freed and _metrics.ENABLED:
             _metrics.SERVE_EVICTIONS.inc(kind="bucket", model=e.name)
+        if freed and _journal.ENABLED:
+            _journal.emit("serve_degradation", model=e.name,
+                          kind="bucket", why=why,
+                          level=self._degradation(e.predictor))
         return float(freed)
 
     def _evict_model(self, e: _Entry, why: str) -> float:
@@ -601,6 +606,10 @@ class ModelRegistry:
             return 0.0  # compile-lock busy: victim skipped, not evicted
         if _metrics.ENABLED:
             _metrics.SERVE_EVICTIONS.inc(kind="model", model=e.name)
+        if _journal.ENABLED:
+            _journal.emit("serve_degradation", model=e.name,
+                          kind="model", why=why,
+                          level=self._degradation(e.predictor))
         self._refresh_gauges()
         return float(freed)
 
@@ -608,6 +617,10 @@ class ModelRegistry:
         with _flight.phase_span("serve_readmit", cat="serving",
                                 mem=True, labels={"model": e.name}):
             e.predictor.readmit()
+        if _journal.ENABLED:
+            _journal.emit("serve_degradation", model=e.name,
+                          kind="readmit",
+                          level=self._degradation(e.predictor))
         self._refresh_gauges()
 
     def _on_oom(self, name: str, exc) -> bool:

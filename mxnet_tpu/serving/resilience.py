@@ -52,6 +52,7 @@ import numpy as _np
 from ..analysis import hot_path, sanitizer as _san
 from ..base import MXNetError, getenv
 from ..observability import flight as _flight
+from ..observability import goodput as _goodput
 from ..observability import memory as _memory
 from ..observability import metrics as _metrics
 from .batcher import (BatcherClosedError, BatcherDeadError,
@@ -456,6 +457,10 @@ class ResilientServer:
         t.shed += 1
         if _metrics.ENABLED:
             _metrics.SERVE_SHED.inc(tenant=t.name, reason=reason)
+        if _goodput.ENABLED:
+            # a refused admission wasted no measurable wall-clock yet —
+            # count the event so report() shows the shed pressure
+            _goodput.attribute("shed", 0.0)
 
     # -- scheduler -----------------------------------------------------------
     def _pop_into(self, group: List[_Request], expired: List[_Request],
@@ -536,6 +541,9 @@ class ResilientServer:
             self._publish_goodput(t)
             if not r.future.done():
                 waited = (time.perf_counter() - r.t0) * 1e3
+                if _goodput.ENABLED:
+                    # an expired request's whole queue wait was wasted
+                    _goodput.attribute("shed", waited / 1e3)
                 r.future.set_exception(DeadlineExceeded(
                     f"deadline passed after {waited:.1f}ms in queue "
                     f"(tenant '{r.tenant}'); request was dropped before "
@@ -620,6 +628,9 @@ class ResilientServer:
                 if _metrics.ENABLED:
                     _metrics.SERVE_LATENCY_SECONDS.observe(
                         now - r.t0, exemplar=r.trace_id)
+                if _goodput.ENABLED:
+                    # feed the SLO p99 sliding window (docs/goodput.md)
+                    _goodput.serve_latency_sample((now - r.t0) * 1e3)
                 if fl:
                     # slow-request watchdog: end-to-end latency vs EWMA
                     _flight.note("serve_request", now - r.t0)
@@ -750,6 +761,17 @@ class ResilientServer:
                         for p, s in _int.sentinel_state()["phases"].items()
                         if s["active"]}
         except Exception:  # noqa: BLE001 — sentinel is best-effort here
+            pass
+        # 2d. SLO burn (ISSUE 16): a declared goodput / serve-p99
+        # target currently burning takes the replica out of rotation —
+        # the monitor already warned, counted mxnet_slo_burn_total and
+        # journaled; readyz is where the balancer finds out.  Guarded:
+        # readiness must never fail because of the ledger.
+        try:
+            if _goodput.ENABLED and _goodput.slo_armed():
+                checks["slo_burn"] = not _goodput.slo_burning()
+                detail["slo"] = _goodput.slo_state()
+        except Exception:  # noqa: BLE001 — monitor is best-effort here
             pass
         # 3. dispatch latency EWMA vs threshold
         lat_ms = self._ewma_s * 1e3
