@@ -6,7 +6,7 @@ SRCS := src/runtime/storage.cc src/runtime/engine.cc \
         src/runtime/recordio.cc src/runtime/prefetch.cc
 LIB := mxnet_tpu/_native/libmxtpu_runtime.so
 
-.PHONY: native test chaos chaos-train chaos-serve lint-graft report clean cpp_example predict_capi capi_example
+.PHONY: native test chaos chaos-train chaos-serve lint-graft autotune-smoke report clean cpp_example predict_capi capi_example
 
 native: $(LIB)
 
@@ -111,6 +111,21 @@ chaos-serve:
 # possibly unreachable TPU tunnel (same reason as the chaos target).
 lint-graft:
 	JAX_PLATFORMS=cpu python -m mxnet_tpu.analysis --audit-programs mxnet_tpu
+
+# autotune smoke gate (ISSUE 17, docs/perf_tuning.md): the measured
+# sweep on a tiny pinned MLP completes fast, persists its decision,
+# and a SECOND PROCESS with the same (model-signature, platform) is a
+# pure cache hit — zero measured runs (--expect-cached exits nonzero
+# otherwise).  Each invocation also asserts the decision file
+# round-trips through decisions.load.
+autotune-smoke:
+	@tmp=$$(mktemp -d); rc=0; \
+	JAX_PLATFORMS=cpu MXNET_AUTOTUNE=1 MXNET_AUTOTUNE_DIR=$$tmp \
+	    timeout 60 python -m mxnet_tpu.autotune --smoke && \
+	JAX_PLATFORMS=cpu MXNET_AUTOTUNE=1 MXNET_AUTOTUNE_DIR=$$tmp \
+	    timeout 60 python -m mxnet_tpu.autotune --smoke --expect-cached \
+	    || rc=$$?; \
+	rm -rf $$tmp; exit $$rc
 
 # render the offline run report for the newest run journal under
 # MXNET_RUN_DIR (or ./runs); `make report RUN_DIR=/path` overrides

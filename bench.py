@@ -134,10 +134,18 @@ def _emit(partial):
         out["device_probe"] = _STATE["device_probe"]
     if _STATE.get("goodput") is not None:
         out["goodput"] = _STATE["goodput"]
+    if _STATE.get("superstep") is not None:
+        out["superstep"] = _STATE["superstep"]
     if partial:
         out["partial"] = True
         out["phase"] = _STATE["phase"]
         out["epochs_timed"] = _STATE["epochs_timed"]
+        # triage from the top level: when the chip never answered, the
+        # probe already classified WHY (timeout / probe_failed) — lift
+        # the first error class out of the nested device_probe record
+        probe = _STATE.get("device_probe")
+        if probe and not probe.get("ok") and probe.get("errors"):
+            out["partial_reason"] = probe["errors"][0]["class"]
     if _STATE["error"]:
         out["error"] = _STATE["error"][:300]
     print(json.dumps(out), flush=True)
@@ -536,6 +544,19 @@ def _run():
             _STATE["goodput"] = {
                 "error": "%s: %s" % (type(e).__name__, str(e)[:200])}
 
+    # superstep rider (ISSUE 17; MXT_BENCH_SUPERSTEP=0 skips): whole-step
+    # vs lax.scan-compiled K-step supersteps (K in {2,4,8}) — steps/s via
+    # per-step paired interleave (autotune.sweep, PR 13's statistic) and
+    # dispatches/step (the 1-vs-K durable CPU acceptance); re-validate on
+    # device when the chip window returns
+    if os.environ.get("MXT_BENCH_SUPERSTEP", "1") != "0":
+        _phase("superstep", EPOCH_S)
+        try:
+            _STATE["superstep"] = _superstep_leg(mx, ctx)
+        except Exception as e:  # noqa: BLE001
+            _STATE["superstep"] = {
+                "error": "%s: %s" % (type(e).__name__, str(e)[:200])}
+
 
 def _gluon_trainer_leg(mx, ctx):
     """Fused vs legacy vs fused-compressed Gluon Trainer A/B/C: steps/s,
@@ -674,6 +695,84 @@ def _wholestep_leg(mx, ctx):
                 "trainer_step_dispatches":
                     _m.TRAINER_STEP_DISPATCHES.get(),
             }
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    return out
+
+
+def _superstep_leg(mx, ctx):
+    """Whole-step vs scan-compiled superstep (ISSUE 17) on the
+    _wholestep_leg MLP: for each K in {2,4,8}, a per-step paired
+    interleave (autotune.sweep — the PR 13 statistic as a library) of
+    ONE K-superstep dispatch against K sequential whole-step dispatches,
+    reporting steps/s both ways, the chunked-median delta, and the
+    dispatches-per-superstep gate (1 scanned vs K demoted — the durable
+    CPU acceptance; steps/s is indicative until the chip window
+    returns)."""
+    from mxnet_tpu import gluon, observability as _obs
+    from mxnet_tpu.autotune import SuperStepCompiler
+    from mxnet_tpu.autotune.sweep import paired_interleave
+    from mxnet_tpu.observability import metrics as _m
+
+    rs = np.random.RandomState(0)
+    bs = 256
+    x = mx.nd.array(rs.normal(0, 1, (bs, 64)).astype("f"), ctx=ctx)
+    y = mx.nd.array(rs.normal(0, 1, (bs, 1)).astype("f"), ctx=ctx)
+    loss_fn = gluon.loss.L2Loss()
+    out = {"note": "CPU dispatch gates; device steps/s pending chip "
+                   "window (CHIP_WINDOW_r05c)"}
+    saved = {k: os.environ.get(k) for k in (
+        "MXNET_WHOLE_STEP", "MXNET_AMP", "MXNET_SUPERSTEP_K")}
+    try:
+        for k in saved:
+            os.environ.pop(k, None)
+        os.environ["MXNET_WHOLE_STEP"] = "1"
+        from mxnet_tpu.gluon import nn
+        net = nn.HybridSequential()
+        with net.name_scope():
+            for _ in range(9):
+                net.add(nn.Dense(64, activation="relu"))
+            net.add(nn.Dense(1))
+        net.hybridize()
+        net.initialize(mx.init.Xavier(), ctx=ctx)
+        trainer = gluon.Trainer(net.collect_params(), "sgd",
+                                {"learning_rate": 0.01, "momentum": 0.9},
+                                kvstore="tpu_sync",
+                                update_on_kvstore=False)
+        stc = SuperStepCompiler(net, loss_fn, trainer)
+        for _ in range(3):
+            last = stc.step(x, y)  # compile + warm the whole-step leg
+        float(np.asarray(last.asnumpy()).ravel()[0])
+        for k in (2, 4, 8):
+            datas, labels = [x] * k, [y] * k
+
+            def fn_super(_d=datas, _l=labels):
+                np.asarray(stc.superstep(_d, _l).asnumpy())
+
+            def fn_seq(_d=datas, _l=labels):
+                for xi, yi in zip(_d, _l):
+                    np.asarray(stc.step(xi, yi).asnumpy())
+
+            fn_super()  # compile the K-scan program outside the timing
+            c0 = _obs.dispatch_counts()
+            fn_super()
+            c1 = _obs.dispatch_counts()
+            r = paired_interleave(fn_super, fn_off=fn_seq, pairs=6)
+            rec = {
+                "steps_per_s": round(k / r["on_med_s"], 2),
+                "wholestep_steps_per_s": round(k / r["off_med_s"], 2),
+                "delta_pct": r["delta_pct"],
+                "dispatches_per_superstep":
+                    c1.get("total", 0) - c0.get("total", 0),
+                "superstep_dispatches_gauge":
+                    _m.SUPERSTEP_DISPATCHES.get(),
+                "scanned": stc.super_active,
+            }
+            out["k%d" % k] = rec
     finally:
         for k, v in saved.items():
             if v is None:

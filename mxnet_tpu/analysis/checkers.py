@@ -1023,6 +1023,10 @@ _JIT_CHOKEPOINTS = (
     "mxnet_tpu/executor.py",
     "mxnet_tpu/gluon/block.py",
     "mxnet_tpu/gluon/wholestep.py",
+    # the scanned K-step superstep: same chokepoint discipline as the
+    # whole step (programs cached via FusedUpdater.lookup_program keyed
+    # on (policy, opt, K, ...), captured via introspect.note_jit)
+    "mxnet_tpu/autotune/superstep.py",
     "mxnet_tpu/gluon/parameter.py",
     "mxnet_tpu/optimizer.py",
     "mxnet_tpu/serving/predictor.py",

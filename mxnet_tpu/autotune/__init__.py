@@ -1,0 +1,43 @@
+"""Profile-guided autotuning: measured knobs + scan-compiled supersteps.
+
+Two halves, one package:
+
+- ``superstep``: ``SuperStepCompiler`` extends the whole-step compiler
+  (gluon/wholestep.py) by ``lax.scan``ning its donated step program
+  over K host-prefetched batches — K training steps become ONE XLA
+  dispatch, with params/opt-state/compression-residuals/loss-scaler
+  threaded as the (still donated) scan carry and the K losses stacked
+  for per-step visibility.
+- ``sweep`` + ``decisions``: a measured tuner (paired-interleave
+  probes, PR 13's bench statistic as a library) that picks superstep K
+  against HBM headroom, ``MXNET_BUCKET_SIZE_MB``, serving bucket
+  lattices, and the MicroBatcher hold window per (model-signature,
+  platform), persisting decisions atomically next to the compile cache.
+  Everything gates on ``MXNET_AUTOTUNE`` and every knob stays
+  overridable by its existing env var.
+
+Submodule imports are lazy so ``decisions`` consumers (trainer,
+serving) don't drag jax-heavy sweep machinery in at import time.
+"""
+from __future__ import annotations
+
+from . import decisions  # noqa: F401 — lightweight (no jax at import)
+
+__all__ = ["SuperStepCompiler", "decisions", "sweep", "tune"]
+
+
+def __getattr__(name):
+    # importlib.import_module, NOT `from . import x`: the from-import
+    # re-enters this __getattr__ via hasattr() before the submodule
+    # binds, recursing forever
+    import importlib
+    if name in ("superstep", "sweep"):
+        mod = importlib.import_module(f".{name}", __name__)
+        globals()[name] = mod
+        return mod
+    if name == "SuperStepCompiler":
+        return importlib.import_module(
+            ".superstep", __name__).SuperStepCompiler
+    if name == "tune":
+        return importlib.import_module(".sweep", __name__).tune
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

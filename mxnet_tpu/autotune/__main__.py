@@ -1,0 +1,104 @@
+"""``python -m mxnet_tpu.autotune --smoke``: the autotune CI gate.
+
+Runs the measured tuner on a tiny pinned MLP and asserts the decision
+lifecycle end to end: the sweep completes quickly, the decision file
+round-trips through ``decisions.load``, and a second ``tune()`` against
+the same (model-signature, platform) is a pure cache hit — ZERO
+measured runs.  ``--expect-cached`` makes a cache miss fatal, so the
+Makefile target can invoke the module twice and prove the
+cross-process reload too.  Prints a one-line JSON verdict; exit 0/1.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+
+def _build():
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon
+    from mxnet_tpu.gluon import nn
+
+    mx.random.seed(11)
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(16, activation="relu"))
+        net.add(nn.Dense(16, activation="relu"))
+        net.add(nn.Dense(1))
+    net.hybridize()
+    net.initialize(mx.init.Xavier(), ctx=mx.cpu())
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.05, "momentum": 0.9},
+                       kvstore="tpu_sync", update_on_kvstore=False)
+    rs = np.random.RandomState(0)
+    x = mx.nd.array(rs.normal(0, 1, (32, 16)).astype("f"))
+    y = mx.nd.array(rs.normal(0, 1, (32, 1)).astype("f"))
+    return net, gluon.loss.L2Loss(), tr, x, y
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="python -m mxnet_tpu.autotune")
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny pinned-MLP sweep + decision round-trip")
+    ap.add_argument("--expect-cached", action="store_true",
+                    help="fail unless the decision loads with zero "
+                         "measured runs (second-process half of the "
+                         "autotune-smoke gate)")
+    ap.add_argument("--dir", default=None,
+                    help="decision dir (default MXNET_AUTOTUNE_DIR)")
+    args = ap.parse_args(argv)
+    if not args.smoke:
+        ap.print_help()
+        return 2
+
+    if args.dir:
+        os.environ["MXNET_AUTOTUNE_DIR"] = args.dir
+    # the sweep's first K-scan compile trips the flight recorder's
+    # slow-sample anomaly dump — keep those artifacts in the decision
+    # dir, not the invoker's cwd
+    if os.environ.get("MXNET_AUTOTUNE_DIR"):
+        os.environ.setdefault("MXNET_FLIGHT_DIR",
+                              os.environ["MXNET_AUTOTUNE_DIR"])
+
+    from mxnet_tpu.autotune import decisions, sweep
+
+    decisions.enable()
+    t0 = time.time()
+    out = {"ok": False, "expect_cached": bool(args.expect_cached)}
+    try:
+        net, loss_fn, tr, x, y = _build()
+        rec = sweep.tune(net, loss_fn, tr, x, y, ks=(2, 4), pairs=4,
+                         bucket_candidates_mb=(8, 32), apply_env=False)
+        if rec is None:
+            raise RuntimeError("tune() returned no decision")
+        out["sweep_runs"] = sweep.last_sweep_runs
+        out["knobs"] = rec["knobs"]
+        # round-trip: a fresh load (parse cache dropped) must agree
+        decisions.reset_cache()
+        rt = decisions.load(rec["signature"])
+        if decisions.decisions_dir() is not None:
+            if rt is None or rt["knobs"] != rec["knobs"]:
+                raise RuntimeError(
+                    f"decision round-trip mismatch: {rt!r}")
+        if args.expect_cached and sweep.last_sweep_runs != 0:
+            raise RuntimeError(
+                f"expected a pure decision-cache hit but the tuner "
+                f"performed {sweep.last_sweep_runs} measured runs")
+        if not args.expect_cached and sweep.last_sweep_runs == 0:
+            raise RuntimeError("first tune() performed zero measured "
+                               "runs — the sweep never executed")
+        out["ok"] = True
+    except Exception as e:  # noqa: BLE001 — CI gate: report, don't crash
+        out["error"] = f"{type(e).__name__}: {e}"
+    out["elapsed_s"] = round(time.time() - t0, 2)
+    print(json.dumps(out, sort_keys=True))
+    return 0 if out["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

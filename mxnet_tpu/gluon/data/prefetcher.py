@@ -85,10 +85,15 @@ class AsyncPrefetcher:
     _MAX_RESPAWNS = 1
     _RESPAWN_BACKOFF_S = 0.05
 
-    def __init__(self, next_fn, depth: int = 2, transform=None,
+    def __init__(self, next_fn, depth=None, transform=None,
                  observe_wait: bool = False, skip_budget=None):
         self._next_fn = next_fn
         self._transform = transform
+        # default depth 2 unless MXNET_PREFETCH_DEPTH overrides it (the
+        # autotuner exports depth>=K so a K-superstep consumer always
+        # finds its whole batch group staged); an explicit arg wins
+        if depth is None:
+            depth = int(getenv("MXNET_PREFETCH_DEPTH", 2))
         self._skip_budget = int(getenv("MXNET_DATA_SKIP_BUDGET", 0)) \
             if skip_budget is None else int(skip_budget)
         self.respawns = 0
@@ -286,7 +291,7 @@ class _DevicePrefetchIter:
     """Iterator returned by prefetch_to_device: double-buffers device
     placement of upcoming batches in a background thread."""
 
-    def __init__(self, source, depth: int = 2, device=None,
+    def __init__(self, source, depth=None, device=None,
                  skip_budget=None):
         self._source = source
         self._depth = depth
@@ -342,7 +347,7 @@ class _DevicePrefetchIter:
             pass
 
 
-def prefetch_to_device(data_iter, depth: int = 2, device=None,
+def prefetch_to_device(data_iter, depth=None, device=None,
                        skip_budget=None):
     """Wrap a batch iterable so the next `depth` batches are device-resident
     before the training loop asks for them.
@@ -350,6 +355,9 @@ def prefetch_to_device(data_iter, depth: int = 2, device=None,
     >>> for batch in prefetch_to_device(loader, depth=2):
     ...     trainer.step(...)   # batch N+1 uploads while step N runs
 
+    depth: queue depth; None reads MXNET_PREFETCH_DEPTH (default 2 —
+    the autotuner exports depth>=K when a K-superstep decision lands,
+    so the whole K-batch group stages ahead of the scan dispatch).
     device: a Context, a jax.Device, or None (the current context's device).
     skip_budget: corrupt-record tolerance (default MXNET_DATA_SKIP_BUDGET)
     — see AsyncPrefetcher.

@@ -149,6 +149,15 @@ class TrainingSupervisor:
         Override the state capture entirely: ``snapshot_fn() -> {name:
         value}`` (arrays/bytes, fed to ``layout.snapshot_state``) and
         ``restore_fn(state_dict)``.  Used by ``for_module``.
+    steps_per_call : int, optional
+        TRAINING steps one ``step_fn`` invocation advances — pass K
+        when supervising ``SuperStepCompiler.superstep`` (the retry
+        unit is then the whole superstep: snapshots land on superstep
+        boundaries, the replay window holds K-batch groups, and a
+        restore rewinds to the last superstep boundary).  The
+        ``snapshot_steps`` budget keeps counting training steps: the
+        snapshot cadence in CALLS is ``ceil(snapshot_steps /
+        steps_per_call)``.  Default 1.
     snapshot_steps / retries / backoff_s / diverge_patience /
     on_diverge / check_every / stall_factor / stall_min_s : optional
         Override the corresponding ``MXNET_SUPERVISE_*`` env defaults
@@ -165,7 +174,8 @@ class TrainingSupervisor:
                  on_diverge: Optional[str] = None,
                  check_every: Optional[int] = None,
                  stall_factor: Optional[float] = None,
-                 stall_min_s: Optional[float] = None):
+                 stall_min_s: Optional[float] = None,
+                 steps_per_call: Optional[int] = None):
         self._step_fn = step_fn
         self._trainer = trainer
         self._pd = None
@@ -182,6 +192,10 @@ class TrainingSupervisor:
             if snapshot_steps is None else int(snapshot_steps)
         if self.snapshot_steps < 1:
             raise MXNetError("snapshot_steps must be >= 1")
+        self.steps_per_call = 1 if steps_per_call is None \
+            else int(steps_per_call)
+        if self.steps_per_call < 1:
+            raise MXNetError("steps_per_call must be >= 1")
         self.retries = int(getenv("MXNET_SUPERVISE_RETRIES", 2)) \
             if retries is None else int(retries)
         self.backoff_s = float(getenv("MXNET_SUPERVISE_RETRY_BACKOFF_S",
@@ -300,7 +314,11 @@ class TrainingSupervisor:
             raise
         self._step_count += 1
         if _journal.ENABLED:
-            _journal.maybe_milestone(self._step_count, source="supervisor")
+            # milestones count TRAINING steps, not calls — a K-superstep
+            # step_fn advances K of them per call
+            _journal.maybe_milestone(
+                self._step_count * self.steps_per_call,
+                source="supervisor")
         return self._check_divergence(out)
 
     __call__ = step
@@ -326,9 +344,17 @@ class TrainingSupervisor:
             state[TRAINER_STATES_KEY] = self._trainer.get_states_bytes()
         return state
 
+    @property
+    def _snapshot_calls(self) -> int:
+        """Snapshot cadence in step_fn CALLS: ``snapshot_steps`` counts
+        training steps, one call advances ``steps_per_call`` of them —
+        under a K-superstep step_fn the boundary lands every
+        ceil(snapshot_steps/K) calls, i.e. ON a superstep boundary."""
+        return -(-self.snapshot_steps // self.steps_per_call)
+
     def _maybe_snapshot(self) -> None:
         due = self._snap is None \
-            or self._step_count % self.snapshot_steps == 0
+            or self._step_count % self._snapshot_calls == 0
         if not due or not self._can_restore:
             return
         if self._snap is not None and self._snap[0] == self._step_count:

@@ -22,6 +22,7 @@ push/pull loop + per-parameter updater calls) for A/B and bisection.
 """
 from __future__ import annotations
 
+import os as _os
 import pickle
 
 import jax.numpy as jnp
@@ -348,8 +349,19 @@ class Trainer:
         written under one path restores under the other."""
         from ..kvstore import GradBucketer
         if self._bucketer is None or self._bucket_sig != (sig, idx):
-            cap = int(float(getenv("MXNET_BUCKET_SIZE_MB", 32.0))
-                      * 1024 * 1024)
+            mb = None
+            if "MXNET_BUCKET_SIZE_MB" not in _os.environ:
+                # env pin beats any persisted autotune decision; only an
+                # UNSET env consults the tuner's measured pick for this
+                # gradient signature (lazy import: autotune is optional
+                # machinery, the trainer must not drag it in at import)
+                from ..autotune import decisions as _decisions
+                if _decisions.ENABLED:
+                    mb = _decisions.knob(
+                        _decisions.model_signature(sig),
+                        "bucket_size_mb", None)
+            cap = int(float(getenv("MXNET_BUCKET_SIZE_MB", 32.0)
+                            if mb is None else mb) * 1024 * 1024)
             self._bucketer = GradBucketer(sig, cap)
             self._bucket_sig = (sig, idx)
             # the flat residual layout is a function of the bucket

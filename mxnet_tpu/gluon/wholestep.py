@@ -473,15 +473,19 @@ class WholeStepCompiler:
                 "uid": next(_PLAN_UID)}
 
     # -- the compiled program ------------------------------------------------
-    def _build_fn(self, built, opt_, policy, thr, window):
-        """Trace fwd+loss+bwd+reduce+update into one jitted callable.
+    def _make_ftrain(self, built, opt_, policy, thr, window):
+        """The raw (un-jitted) whole-step function:
 
         ftrain(gparams, states, residuals, scaler, aux, consts, data,
                label, key, lrs, wds, ts)
           -> (loss, new_aux, new_params, new_states, new_residuals,
               new_scaler, new_ts)
-        gparams/states/residuals/scaler/aux are DONATED — the step
-        updates the model truly in place on backends with donation."""
+
+        ``_build_fn`` jits it with donation for the 1-dispatch step;
+        ``autotune.SuperStepCompiler`` wraps the SAME function in a
+        ``lax.scan`` over K batches (the scan body must be the exact op
+        sequence of one whole step — the superstep/whole-step bitwise
+        parity contract hangs on sharing this tracer)."""
         plan = built["plan"]
         gnames = built["gnames"]
         idx = built["idx"]
@@ -573,6 +577,13 @@ class WholeStepCompiler:
                 nts = ts + 1
             return loss, new_aux, new_p, new_s, new_res, new_scaler, nts
 
+        return ftrain
+
+    def _build_fn(self, built, opt_, policy, thr, window):
+        """One donated jitted whole-step program: gparams/states/
+        residuals/scaler/aux are DONATED — the step updates the model
+        truly in place on backends with donation."""
+        ftrain = self._make_ftrain(built, opt_, policy, thr, window)
         return jax.jit(ftrain, donate_argnums=(0, 1, 2, 3, 4))
 
     # -- per-step driver -----------------------------------------------------
@@ -771,7 +782,21 @@ class WholeStepCompiler:
         if _journal.ENABLED:
             _journal.maybe_milestone(tr._step_id, source="whole_step")
 
-        for n in gnames:
+        self._commit_outputs(built, upd, policy, thr, new_p, new_aux,
+                             new_s, new_res, new_scaler, nts, counts_t)
+        self._ran = True
+        return NDArray(loss, data.context)
+
+    def _commit_outputs(self, built, upd, policy, thr, new_p, new_aux,
+                        new_s, new_res, new_scaler, nts, counts_t):
+        """Write the program's functional outputs back onto the live
+        model/trainer — shared verbatim by the whole-step dispatch and
+        the superstep's scan dispatch (K fused steps commit exactly
+        like one)."""
+        tr = self.trainer
+        params = built["params"]
+        idx = built["idx"]
+        for n in built["gnames"]:
             params[n].list_data()[0]._set_data(new_p[n])
         for n in built["aux_names"]:
             params[n].list_data()[0]._set_data(new_aux[n])
@@ -795,5 +820,3 @@ class WholeStepCompiler:
         # save_states can persist it with the scaler (fp16 kill-resume:
         # ts lags the schedule counts by one per skipped step)
         tr._applied_ts = (idx, nts)
-        self._ran = True
-        return NDArray(loss, data.context)

@@ -266,8 +266,8 @@ class PrefetchingIter(DataIter):
     skips corrupt records (`resilience.DataCorruptionError`) instead of
     killing the epoch — docs/training_resilience.md."""
 
-    def __init__(self, iters, rename_data=None, rename_label=None, depth=2,
-                 device=None, skip_budget=None):
+    def __init__(self, iters, rename_data=None, rename_label=None,
+                 depth=None, device=None, skip_budget=None):
         if not isinstance(iters, list):
             iters = [iters]
         assert len(iters) == 1, "composite prefetch of multiple iters: pass one"
@@ -275,7 +275,10 @@ class PrefetchingIter(DataIter):
         super().__init__(self.iter.batch_size)
         self.rename_data = rename_data
         self.rename_label = rename_label
-        self._depth = int(depth)
+        # None defers to MXNET_PREFETCH_DEPTH (default 2; the autotuner
+        # exports depth>=K for superstep staging) — explicit arg wins
+        self._depth = int(depth) if depth is not None \
+            else int(getenv("MXNET_PREFETCH_DEPTH", 2))
         self._device = device
         self._skip_budget = skip_budget
         self._pf = None

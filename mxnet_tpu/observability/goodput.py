@@ -83,6 +83,7 @@ _BADPUT_CLASSES = frozenset(CLASSES) - {"compute", "unattributed"}
 _SPAN_CLASS: Dict[str, str] = {
     "trainer_step": "compute",
     "whole_step": "compute",
+    "superstep": "compute",
     "serve_dispatch": "compute",
     "prefetch_wait": "data_wait",
     "data_wait": "data_wait",

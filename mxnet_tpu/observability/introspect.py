@@ -108,7 +108,8 @@ UNATTRIBUTED = "_unattributed"
 
 #: training-step phase -> program name the MFU/sentinel math pairs it
 #: with (the fused path's step splits across three programs)
-PHASE_PROGRAM = {"whole_step": "whole_step", "trainer_step": "fused_update"}
+PHASE_PROGRAM = {"whole_step": "whole_step", "trainer_step": "fused_update",
+                 "superstep": "superstep"}
 #: programs whose flops sum to one FUSED-path training step (CachedOp
 #: bwd recomputes the forward inside its fused vjp program)
 FUSED_STEP_PROGRAMS = ("gluon:fwd", "gluon:bwd", "fused_update")
@@ -118,7 +119,10 @@ FUSED_STEP_PROGRAMS = ("gluon:fwd", "gluon:bwd", "fused_update")
 #: user's fwd/bwd run outside it), so dividing full-step flops by it
 #: would overstate MFU severalfold — fused-path MFU needs an explicit
 #: step_time_s (the bench mfu rider measures its own).
-FULL_STEP_PHASES = frozenset({"whole_step"})
+#: "superstep" qualifies too: its span covers K whole steps and its
+#: noted program's cost_analysis flops are K x one step, so the
+#: flops/time quotient stays a true device rate.
+FULL_STEP_PHASES = frozenset({"whole_step", "superstep"})
 
 _lock = _san.make_lock("introspect.programs")
 _programs: Dict[str, dict] = {}
@@ -694,8 +698,12 @@ def _current_measurements(phase: str) -> Optional[dict]:
         # location estimate the runtime comparison reads, so write and
         # compare can never disagree on methodology
         "step_time_p50_ms": round(ewma * 1e3, 4),
+        # the superstep phase gates on its own gauge: scanned = 1 per
+        # K-step superstep, ~K after a silent demotion — which is the
+        # regression this baseline exists to catch
         "dispatches_per_step": float(
-            _metrics.TRAINER_STEP_DISPATCHES.get()),
+            _metrics.SUPERSTEP_DISPATCHES.get() if phase == "superstep"
+            else _metrics.TRAINER_STEP_DISPATCHES.get()),
         "flops_per_step": (rec or {}).get("flops"),
         "hbm_peak_bytes": hbm,
         "written_at": time.time(),

@@ -418,6 +418,15 @@ TRAINER_STEP_DISPATCHES = Gauge(
     "xla:fwd / xla:bwd).  Whole-step path (MXNET_WHOLE_STEP=1): the "
     "ENTIRE step — fwd+bwd+reduce+update ride one donated program "
     "(xla:whole_step), so this gauge reads 1")
+SUPERSTEP_DISPATCHES = Gauge(
+    "mxnet_superstep_dispatches",
+    "XLA program launches + device_puts issued by the most recent "
+    "superstep (K whole-steps lax.scan-compiled into one donated "
+    "program, mxnet_tpu/autotune/superstep.py).  Scanned: 1 for the "
+    "whole K-step superstep.  Reads ~K when the superstep silently "
+    "demoted to K sequential whole-step dispatches — the perf "
+    "sentinel's dispatches_per_step baseline for the 'superstep' "
+    "phase trips on exactly that")
 ALLREDUCE_BUCKETS = Gauge(
     "mxnet_allreduce_buckets",
     "Gradient buckets the most recent bucketed allreduce fused into "
@@ -896,6 +905,7 @@ def snapshot() -> dict:
         "dispatch_counts": dispatch_counts(),
         "fit_step_dispatches": FIT_STEP_DISPATCHES.get(),
         "trainer_step_dispatches": TRAINER_STEP_DISPATCHES.get(),
+        "superstep_dispatches": SUPERSTEP_DISPATCHES.get(),
         "allreduce_buckets": ALLREDUCE_BUCKETS.get(),
         "prefetch_wait_ms_total": PREFETCH_WAIT_SECONDS.sum * 1e3,
         "transfer_bytes": TRANSFER_BYTES.value,

@@ -15,6 +15,7 @@ converges to zero (the previous dispatch IS the wait).
 from __future__ import annotations
 
 import contextlib
+import os as _os
 import queue
 import threading
 import time
@@ -24,6 +25,7 @@ from typing import Dict, List, Optional
 import numpy as _np
 
 from ..analysis import hot_path, sanitizer as _san
+from ..autotune import decisions as _decisions
 from ..base import MXNetError, getenv
 from ..faultinject import fire as _fi_fire
 from ..observability import flight as _flight
@@ -122,7 +124,18 @@ class MicroBatcher:
                  max_batch: Optional[int] = None):
         self._pred = predictor
         if max_wait_ms is None:
-            max_wait_ms = getenv("MXNET_SERVE_MAX_WAIT_MS", 2.0)
+            # ctor arg > MXNET_SERVE_MAX_WAIT_MS env pin > persisted
+            # autotune decision (derived from the dispatch EWMA) > 2 ms
+            decided = None
+            if "MXNET_SERVE_MAX_WAIT_MS" not in _os.environ \
+                    and _decisions.ENABLED:
+                sig = getattr(getattr(predictor, "spec", None),
+                              "signature", None)
+                if sig is not None:
+                    decided = _decisions.knob(sig, "serve_max_wait_ms",
+                                              None)
+            max_wait_ms = getenv("MXNET_SERVE_MAX_WAIT_MS", 2.0) \
+                if decided is None else float(decided)
         self._max_wait_s = max(0.0, float(max_wait_ms)) / 1e3
         # the documented default chain: ctor arg > MXNET_SERVE_MAX_BATCH
         # > largest bucket (graft-lint env-sync found the env leg was

@@ -18,14 +18,31 @@ O(log max) per axis.
 from __future__ import annotations
 
 import os
+from collections import deque
 from typing import List, Optional, Sequence, Tuple
 
 import numpy as _np
 
+from ..autotune import decisions as _decisions
 from ..base import MXNetError
 
 __all__ = ["pow2_buckets", "parse_bucket_env", "covering_bucket",
-           "pad_to_shape", "BucketSpec"]
+           "pad_to_shape", "BucketSpec", "observed_traffic"]
+
+# -- observed shape traffic (the autotune lattice feed) ----------------------
+#: bounded ring of request batch sizes seen by BucketSpec.route — what
+#: autotune.sweep.lattice_from_traffic derives a measured lattice from.
+#: Recorded only while MXNET_AUTOTUNE is on (one boolean on the route
+#: path otherwise); bounded, so an unattended server can't grow it.
+_TRAFFIC_MAX = 4096
+_traffic: deque = deque(maxlen=_TRAFFIC_MAX)
+
+
+def observed_traffic() -> Tuple[int, ...]:
+    """Request batch sizes observed by routing since process start
+    (bounded ring, newest last) — feed for the tuner's
+    ``lattice_from_traffic``."""
+    return tuple(_traffic)
 
 
 def pow2_buckets(max_n: int, lo: int = 1) -> List[int]:
@@ -135,9 +152,24 @@ class BucketSpec:
                     f"{list(buckets)}")
             return out
 
+        # serving decisions key on the DECLARED bucket-spec shapes (not
+        # trainable params — a served model is just its input surface)
+        self.signature = _decisions.model_signature(
+            sorted(self.input_shapes.items()),
+            extra=("serving", tuple(sorted(self.seq_axes.items()))))
+        # ladder precedence: ctor arg > MXNET_SERVE_BUCKETS env pin >
+        # persisted autotune lattice (derived from observed traffic) >
+        # blind pow2 ladder
+        decided = None
+        if batch_buckets is None \
+                and "MXNET_SERVE_BUCKETS" not in os.environ \
+                and _decisions.ENABLED:
+            knob = _decisions.knob(self.signature, "serve_buckets", None)
+            if knob:
+                decided = [int(t) for t in str(knob).split(",")]
         self.batch_buckets = _checked(
             batch_buckets or parse_bucket_env("MXNET_SERVE_BUCKETS")
-            or pow2_buckets(self.max_batch_hint), "batch")
+            or decided or pow2_buckets(self.max_batch_hint), "batch")
         if self.seq_axes:
             max_seq = max(self.input_shapes[n][ax]
                           for n, ax in self.seq_axes.items())
@@ -166,7 +198,10 @@ class BucketSpec:
         rows = {s[0] for s in shapes.values()}
         if len(rows) != 1:
             raise MXNetError(f"inputs disagree on batch size: {shapes}")
-        b = covering_bucket(self.batch_buckets, rows.pop())
+        n = rows.pop()
+        if _decisions.ENABLED:
+            _traffic.append(int(n))
+        b = covering_bucket(self.batch_buckets, n)
         if self.seq_buckets is None:
             return (b,)
         seq = 0

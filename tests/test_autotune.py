@@ -1,0 +1,299 @@
+"""Profile-guided autotuning (ISSUE 17): persisted measured decisions
+(autotune/decisions.py), the paired-interleave sweep tuner
+(autotune/sweep.py), and the consumer precedence chain — ctor arg >
+explicit env pin > persisted decision > static default — across the
+Trainer bucketer, the serving lattice/batcher, the prefetchers, and
+superstep K.  The lifecycle acceptance: a second process (here: a
+fresh tune() against the same signature) performs ZERO measured runs.
+"""
+import json
+import logging
+import types
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon
+from mxnet_tpu.autotune import decisions, sweep
+from mxnet_tpu.autotune.superstep import SuperStepCompiler
+from mxnet_tpu.gluon import nn
+
+
+@pytest.fixture(autouse=True)
+def _fresh(monkeypatch, tmp_path):
+    """Decisions armed and persisted in scratch; no env pin leakage."""
+    for var in ("MXNET_SUPERSTEP_K", "MXNET_BUCKET_SIZE_MB",
+                "MXNET_SERVE_BUCKETS", "MXNET_SERVE_MAX_WAIT_MS",
+                "MXNET_PREFETCH_DEPTH", "MXNET_AMP"):
+        monkeypatch.delenv(var, raising=False)
+    monkeypatch.setenv("MXNET_AUTOTUNE_DIR", str(tmp_path / "dec"))
+    monkeypatch.setenv("MXNET_FLIGHT_DIR", str(tmp_path / "fl"))
+    was = decisions.ENABLED
+    decisions.enable()
+    decisions.reset_cache()
+    yield
+    decisions.reset_cache()
+    if not was:
+        decisions.disable()
+
+
+def _build(seed=11):
+    mx.random.seed(seed)
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(16, activation="relu"))
+        net.add(nn.Dense(1))
+    net.hybridize()
+    net.initialize(mx.init.Xavier(), ctx=mx.cpu())
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.05, "momentum": 0.9},
+                       kvstore="tpu_sync", update_on_kvstore=False)
+    rs = np.random.RandomState(0)
+    x = mx.nd.array(rs.normal(0, 1, (16, 16)).astype("f"))
+    y = mx.nd.array(rs.normal(0, 1, (16, 1)).astype("f"))
+    return net, gluon.loss.L2Loss(), tr, x, y
+
+
+# ---------------------------------------------------------------------------
+# the decision store
+# ---------------------------------------------------------------------------
+def test_store_load_roundtrip_and_atomic_file(tmp_path):
+    sig = decisions.model_signature((((4, 4), "float32"),))
+    path = decisions.store(sig, {"superstep_k": 4}, {"note": "test"})
+    assert path is not None
+    decisions.reset_cache()  # force the disk read
+    rec = decisions.load(sig)
+    assert rec["knobs"] == {"superstep_k": 4}
+    assert rec["schema"] == 1
+    with open(path) as f:  # really on disk, valid JSON
+        assert json.load(f)["signature"] == sig
+    assert decisions.knob(sig, "superstep_k", 1) == 4
+    assert decisions.knob(sig, "missing_knob", "dflt") == "dflt"
+
+
+def test_corrupt_decision_file_warns_and_misses(caplog):
+    sig = decisions.model_signature((((2, 2), "float32"),))
+    path = decisions.store(sig, {"superstep_k": 8})
+    with open(path, "w") as f:
+        f.write("{not json")
+    decisions.reset_cache()
+    with caplog.at_level(logging.WARNING, logger="mxnet_tpu.autotune"):
+        assert decisions.load(sig) is None
+    assert any("corrupt" in r.message for r in caplog.records)
+    assert decisions.knob(sig, "superstep_k", 1) == 1  # miss -> default
+
+
+def test_gate_off_every_consult_is_a_miss():
+    sig = decisions.model_signature((((3, 3), "float32"),))
+    decisions.store(sig, {"superstep_k": 8})
+    decisions.disable()
+    assert decisions.knob(sig, "superstep_k", 1) == 1
+    decisions.enable()
+    assert decisions.knob(sig, "superstep_k", 1) == 8
+
+
+def test_signature_changes_with_model_and_extra():
+    a = decisions.model_signature((((4, 4), "float32"),))
+    b = decisions.model_signature((((8, 4), "float32"),))
+    c = decisions.model_signature((((4, 4), "float32"),), extra=("x",))
+    assert len({a, b, c}) == 3
+
+
+# ---------------------------------------------------------------------------
+# the tuner lifecycle: sweep once, reload forever
+# ---------------------------------------------------------------------------
+def test_tune_persists_then_second_tune_is_pure_cache_hit(monkeypatch):
+    net, loss_fn, tr, x, y = _build()
+    rec = sweep.tune(net, loss_fn, tr, x, y, ks=(2,), pairs=2,
+                     bucket_candidates_mb=(8,), apply_env=False)
+    assert rec is not None
+    assert sweep.last_sweep_runs > 0
+    assert set(rec["knobs"]) >= {"superstep_k", "bucket_size_mb",
+                                 "prefetch_depth", "serve_max_wait_ms"}
+    assert rec["knobs"]["prefetch_depth"] >= 2
+
+    # "second process": parse cache dropped, same signature -> decision
+    # loads from disk, ZERO measured runs (the autotune-smoke gate)
+    decisions.reset_cache()
+    net2, loss2, tr2, _, _ = _build()
+    rec2 = sweep.tune(net2, loss2, tr2, x, y, ks=(2,), pairs=2,
+                      bucket_candidates_mb=(8,), apply_env=False)
+    assert sweep.last_sweep_runs == 0
+    assert rec2["knobs"] == rec["knobs"]
+
+
+def test_tune_disabled_warns_and_returns_none(caplog):
+    decisions.disable()
+    net, loss_fn, tr, x, y = _build()
+    with caplog.at_level(logging.WARNING,
+                         logger="mxnet_tpu.autotune.sweep"):
+        assert sweep.tune(net, loss_fn, tr, x, y) is None
+    assert any("disabled" in r.message for r in caplog.records)
+
+
+# ---------------------------------------------------------------------------
+# observation-derived serving knobs
+# ---------------------------------------------------------------------------
+def test_lattice_from_traffic_quantile_rungs():
+    # traffic clustered at 3 and 17, declared max 100: rungs are pow2
+    # roundups of the quantiles plus the compile-ahead ceiling
+    sizes = [3] * 50 + [17] * 40 + [60] * 5
+    lat = sweep.lattice_from_traffic(sizes, 100)
+    assert lat[-1] == 100  # always covers max_batch
+    assert 4 in lat and 32 in lat
+    assert lat == sorted(set(lat))
+
+
+def test_lattice_from_traffic_caps_rungs_and_handles_empty():
+    sizes = [1, 2, 5, 9, 17, 33, 65, 120, 250, 500]
+    lat = sweep.lattice_from_traffic(sizes, 512, max_rungs=3)
+    assert len(lat) <= 3
+    assert lat[-1] == 512
+    from mxnet_tpu.serving.buckets import pow2_buckets
+    assert sweep.lattice_from_traffic([], 64) == pow2_buckets(64)
+
+
+def test_max_wait_from_ewma_units_and_clamps():
+    assert sweep.max_wait_from_ewma(4.0) == 2.0      # half a dispatch
+    assert sweep.max_wait_from_ewma(0.1) == 0.25     # floor
+    assert sweep.max_wait_from_ewma(100.0) == 5.0    # cap
+    assert sweep.max_wait_from_ewma(None) == 2.0     # unmeasured: default
+
+
+# ---------------------------------------------------------------------------
+# consumer precedence: env pin > decision > default
+# ---------------------------------------------------------------------------
+def test_superstep_k_env_beats_decision_beats_default(monkeypatch):
+    monkeypatch.setenv("MXNET_WHOLE_STEP", "1")
+    net, loss_fn, tr, x, y = _build()
+    st = SuperStepCompiler(net, loss_fn, tr)
+    st.step(x, y)
+    st.step(x, y)  # built for sure (first call may defer)
+    sig = st.decision_signature
+    assert sig is not None
+    assert st.k == 4  # no decision yet: static default
+    decisions.store(sig, {"superstep_k": 2})
+    assert st.k == 2  # persisted decision
+    monkeypatch.setenv("MXNET_SUPERSTEP_K", "7")
+    assert st.k == 7  # explicit env pin always wins
+    monkeypatch.delenv("MXNET_SUPERSTEP_K")
+    st3 = SuperStepCompiler(net, loss_fn, tr, k=3)
+    st3.step(x, y)
+    assert st3.k == 3  # ctor arg outranks the decision
+
+
+def test_prefetch_depth_env_overrides_default(monkeypatch):
+    from mxnet_tpu import io as mio
+    from mxnet_tpu.gluon.data.prefetcher import AsyncPrefetcher
+
+    pf = AsyncPrefetcher(lambda: mx.nd.array(np.zeros((2, 2), "f")))
+    assert pf._depth == 2  # documented default
+    pf.close()
+    monkeypatch.setenv("MXNET_PREFETCH_DEPTH", "5")
+    pf5 = AsyncPrefetcher(lambda: mx.nd.array(np.zeros((2, 2), "f")))
+    assert pf5._depth == 5
+    pf5.close()
+
+    class _It:
+        batch_size = 2
+
+        def next(self):
+            raise StopIteration
+
+        def reset(self):
+            pass
+    it5 = mio.PrefetchingIter(_It())
+    assert it5._depth == 5
+    it5.close()
+    it3 = mio.PrefetchingIter(_It(), depth=3)  # ctor wins
+    assert it3._depth == 3
+    it3.close()
+
+
+def test_serve_lattice_decision_and_traffic_recorder(monkeypatch):
+    from mxnet_tpu.serving import buckets as bk
+
+    shapes = {"data": (32, 4)}
+    spec = bk.BucketSpec(shapes)
+    assert spec.batch_buckets == bk.pow2_buckets(32)  # no decision yet
+    decisions.store(spec.signature, {"serve_buckets": "1,6,12,32"})
+    decided = bk.BucketSpec(shapes)
+    assert decided.batch_buckets == [1, 6, 12, 32]
+    monkeypatch.setenv("MXNET_SERVE_BUCKETS", "2,8,32")
+    pinned = bk.BucketSpec(shapes)
+    assert pinned.batch_buckets == [2, 8, 32]  # env pin beats decision
+    monkeypatch.delenv("MXNET_SERVE_BUCKETS")
+
+    before = len(bk.observed_traffic())
+    decided.route({"data": (5, 4)})
+    decided.route({"data": (11, 4)})
+    traffic = bk.observed_traffic()
+    assert len(traffic) == before + 2 and traffic[-2:] == (5, 11)
+    decisions.disable()
+    decided.route({"data": (7, 4)})  # gate off: not recorded
+    assert len(bk.observed_traffic()) == before + 2
+
+
+def test_batcher_max_wait_decision_and_env(monkeypatch):
+    from mxnet_tpu.serving.batcher import MicroBatcher
+
+    sig = "cafecafecafecafe"
+    decisions.store(sig, {"serve_max_wait_ms": 3.5})
+    pred = types.SimpleNamespace(
+        spec=types.SimpleNamespace(signature=sig, max_batch=8))
+    mb = MicroBatcher(pred)
+    assert mb._max_wait_s == pytest.approx(0.0035)
+    mb.close()
+    monkeypatch.setenv("MXNET_SERVE_MAX_WAIT_MS", "1.0")
+    mb2 = MicroBatcher(pred)
+    assert mb2._max_wait_s == pytest.approx(0.001)  # env pin wins
+    mb2.close()
+    mb3 = MicroBatcher(pred, max_wait_ms=0.5)
+    assert mb3._max_wait_s == pytest.approx(0.0005)  # ctor outranks all
+    mb3.close()
+
+
+def test_trainer_bucket_size_decision(monkeypatch):
+    """With MXNET_BUCKET_SIZE_MB unset, the Trainer's bucketer sizes
+    from the persisted decision; the env pin still wins."""
+    net, loss_fn, tr, x, y = _build()
+    from mxnet_tpu import autograd
+    with autograd.record():
+        l = loss_fn(net(x), y)
+    l.backward()
+    sig = tuple((tuple(p.data().shape), str(p.data().dtype))
+                for p in net.collect_params().values()
+                if p.grad_req != "null")
+    decisions.store(decisions.model_signature(sig),
+                    {"bucket_size_mb": 0.0001})  # absurdly small: many buckets
+    tr.step(16)
+    many = len(tr._bucketer.sizes)
+    assert many > 1  # the decision really sized the buckets
+
+    net2, loss2, tr2, _, _ = _build()
+    with autograd.record():
+        l2 = loss2(net2(x), y)
+    l2.backward()
+    monkeypatch.setenv("MXNET_BUCKET_SIZE_MB", "32")
+    tr2.step(16)
+    assert len(tr2._bucketer.sizes) < many  # env pin beat the decision
+
+
+# ---------------------------------------------------------------------------
+# supervisor superstep alignment
+# ---------------------------------------------------------------------------
+def test_supervisor_snapshot_cadence_aligns_to_steps_per_call():
+    from mxnet_tpu.gluon.supervisor import TrainingSupervisor
+
+    sup = TrainingSupervisor(lambda v: v, snapshot_steps=10,
+                             steps_per_call=4)
+    assert sup._snapshot_calls == 3  # ceil(10/4): never LATER than asked
+    sup.close()
+    sup1 = TrainingSupervisor(lambda v: v, snapshot_steps=8,
+                              steps_per_call=4)
+    assert sup1._snapshot_calls == 2
+    sup1.close()
+    from mxnet_tpu.base import MXNetError
+    with pytest.raises(MXNetError, match="steps_per_call"):
+        TrainingSupervisor(lambda v: v, steps_per_call=0)
