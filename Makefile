@@ -6,7 +6,7 @@ SRCS := src/runtime/storage.cc src/runtime/engine.cc \
         src/runtime/recordio.cc src/runtime/prefetch.cc
 LIB := mxnet_tpu/_native/libmxtpu_runtime.so
 
-.PHONY: native test chaos chaos-train chaos-serve lint-graft autotune-smoke report clean cpp_example predict_capi capi_example
+.PHONY: native test chaos chaos-train chaos-serve lint-graft autotune-smoke shard-smoke report clean cpp_example predict_capi capi_example
 
 native: $(LIB)
 
@@ -126,6 +126,14 @@ autotune-smoke:
 	    timeout 60 python -m mxnet_tpu.autotune --smoke --expect-cached \
 	    || rc=$$?; \
 	rm -rf $$tmp; exit $$rc
+
+# GSPMD sharding smoke gate (ISSUE 18, docs/parallel.md): 8 virtual
+# CPU devices, 2-D batch=4,model=2 mesh, whole-step train — asserts
+# the sharded program still dispatches exactly once per step, donation
+# stayed aliased, and every sized mesh axis carries its planned
+# collectives (audit_program on the captured HLO).
+shard-smoke:
+	JAX_PLATFORMS=cpu timeout 60 python -m mxnet_tpu.parallel --smoke
 
 # render the offline run report for the newest run journal under
 # MXNET_RUN_DIR (or ./runs); `make report RUN_DIR=/path` overrides

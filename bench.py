@@ -136,6 +136,8 @@ def _emit(partial):
         out["goodput"] = _STATE["goodput"]
     if _STATE.get("superstep") is not None:
         out["superstep"] = _STATE["superstep"]
+    if _STATE.get("sharding") is not None:
+        out["sharding"] = _STATE["sharding"]
     if partial:
         out["partial"] = True
         out["phase"] = _STATE["phase"]
@@ -557,6 +559,17 @@ def _run():
             _STATE["superstep"] = {
                 "error": "%s: %s" % (type(e).__name__, str(e)[:200])}
 
+    # sharding rider (ISSUE 18; MXT_BENCH_SHARD=0 skips): GSPMD 2-D mesh
+    # through the donated whole-step program — mesh shape, steps/s,
+    # dispatches/step (must stay 1) and the lowered collective count
+    if os.environ.get("MXT_BENCH_SHARD", "1") != "0":
+        _phase("sharding", EPOCH_S)
+        try:
+            _STATE["sharding"] = _sharding_leg(mx, ctx)
+        except Exception as e:  # noqa: BLE001
+            _STATE["sharding"] = {
+                "error": "%s: %s" % (type(e).__name__, str(e)[:200])}
+
 
 def _gluon_trainer_leg(mx, ctx):
     """Fused vs legacy vs fused-compressed Gluon Trainer A/B/C: steps/s,
@@ -774,6 +787,90 @@ def _superstep_leg(mx, ctx):
             }
             out["k%d" % k] = rec
     finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    return out
+
+
+def _sharding_leg(mx, ctx):
+    """GSPMD mesh sharding rider (ISSUE 18): the _superstep_leg MLP
+    trained through WholeStepCompiler on the largest 2-D mesh the
+    available devices support (model=2 when the count is even, else a
+    pure batch mesh).  Reports {mesh_shape, steps/s, dispatches/step,
+    collective_count} — the durable acceptance is 1 dispatch/step with
+    XLA-inserted collectives; steps/s is indicative on CPU and becomes
+    the headline number when the chip window returns."""
+    from mxnet_tpu import gluon, observability as _obs
+    from mxnet_tpu.analysis import program_audit as _pa
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu.gluon.wholestep import WholeStepCompiler
+    from mxnet_tpu.observability import introspect as _int
+    from mxnet_tpu.parallel import mesh as _pmesh
+    import jax
+
+    ndev = len(jax.devices())
+    model = 2 if ndev > 1 and ndev % 2 == 0 else 1
+    batch = ndev // model
+    rs = np.random.RandomState(0)
+    bs = 256
+    x = mx.nd.array(rs.normal(0, 1, (bs, 64)).astype("f"), ctx=ctx)
+    y = mx.nd.array(rs.normal(0, 1, (bs, 1)).astype("f"), ctx=ctx)
+    out = {"devices": ndev,
+           "mesh_shape": {"batch": batch, "model": model},
+           "note": "CPU dispatch/collective gates; device steps/s "
+                   "pending chip window"}
+    saved = {k: os.environ.get(k) for k in
+             ("MXNET_WHOLE_STEP", "MXNET_AMP")}
+    prev_hlo = _int.HLO
+    prev_mesh = None
+    try:
+        for k in saved:
+            os.environ.pop(k, None)
+        os.environ["MXNET_WHOLE_STEP"] = "1"
+        _int.configure(hlo=True)
+        mesh = _pmesh.make_mesh(batch=batch, model=model)
+        prev_mesh = _pmesh.set_current_mesh(mesh)
+        net = nn.HybridSequential()
+        with net.name_scope():
+            for _ in range(9):
+                net.add(nn.Dense(64, activation="relu"))
+            net.add(nn.Dense(1))
+        net.hybridize()
+        net.initialize(mx.init.Xavier(), ctx=ctx)
+        trainer = gluon.Trainer(net.collect_params(), "sgd",
+                                {"learning_rate": 0.01, "momentum": 0.9},
+                                kvstore="tpu_sync",
+                                update_on_kvstore=False)
+        stc = WholeStepCompiler(net, loss_fn := gluon.loss.L2Loss(),
+                                trainer)
+        for _ in range(3):
+            last = stc.step(x, y)  # compile + warm the sharded program
+        float(np.asarray(last.asnumpy()).ravel()[0])
+        steps = 20
+        c0 = _obs.dispatch_counts()
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            last = stc.step(x, y)
+        float(np.asarray(last.asnumpy()).ravel()[0])
+        dt = time.perf_counter() - t0
+        c1 = _obs.dispatch_counts()
+        out["whole_step_active"] = stc.active
+        out["steps_per_s"] = round(steps / dt, 2)
+        out["samples_per_s"] = round(bs * steps / dt, 1)
+        out["dispatches_per_step"] = round(
+            (c1.get("total", 0) - c0.get("total", 0)) / steps, 2)
+        rec = _int.programs().get("whole_step")
+        if rec and rec.get("hlo"):
+            out["collective_count"] = _pa.count_collectives(rec["hlo"])
+            out["aliased_params"] = len(
+                _pa.parse_alias_table(rec["hlo"]))
+            out["audit_issues"] = len(_pa.audit_program(rec))
+    finally:
+        _pmesh.set_current_mesh(prev_mesh)
+        _int.configure(hlo=prev_hlo)
         for k, v in saved.items():
             if v is None:
                 os.environ.pop(k, None)

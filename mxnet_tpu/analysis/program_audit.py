@@ -20,9 +20,14 @@ from a real incident class:
     ``xla_python_*_callback`` custom-calls / infeed / outfeed: one host
     callback turns the 1-dispatch step into a blocking host round trip
     per step;
-  * **collective count** — the number of collective ops must match the
-    bucketer's plan (0 on the single-process inline reduce; a surprise
-    collective means the program is waiting on a mesh nobody set up).
+  * **collective count / plan** — a replicated program must contain
+    the bucketer's exact count (0 on the single-process inline reduce;
+    a surprise collective means the program is waiting on a mesh
+    nobody set up); a GSPMD-sharded program (ISSUE 18) instead
+    declares ``mesh_axes`` + ``collective_plan`` and every sized mesh
+    axis must carry at least the planned number of XLA-inserted
+    collectives — verified by each collective's replica-group span —
+    with donation STILL aliased under sharding.
 
 Contracts are declared at the compile chokepoints
 (``note_program(..., contracts={...})`` — wholestep, FusedUpdater) and
@@ -47,7 +52,7 @@ from typing import Dict, List, Optional
 
 __all__ = ["audit_programs", "audit_program", "parse_alias_table",
            "count_host_callbacks", "count_collectives",
-           "amp_cast_coverage", "self_audit"]
+           "collective_groups", "amp_cast_coverage", "self_audit"]
 
 # the HLO module header carries the alias table:
 #   input_output_alias={ {0}: (0, {}, may-alias), {1}: (3, {}, ...) }
@@ -112,6 +117,49 @@ def count_host_callbacks(hlo: str) -> int:
 def count_collectives(hlo: str) -> int:
     return sum(1 for _l, _t, op in _instructions(hlo)
                if op in _COLLECTIVE_OPS)
+
+
+# iota-form replica groups: `replica_groups=[G,S]<=[...]` — shape is
+# [num_groups, group_size], so the span is the SECOND dimension
+_RG_IOTA_RE = re.compile(r"replica_groups=\[(\d+),(\d+)\]")
+
+
+def collective_groups(hlo: str) -> List[Optional[int]]:
+    """One entry per collective instruction: the replica-group SPAN
+    (participants per group), or None when the attribute is absent or
+    empty — both mean every device participates.  Handles the explicit
+    form ``replica_groups={{0,2},{1,3}}`` (span = first subgroup's
+    element count; GSPMD emits equal-sized groups) and the iota form
+    ``replica_groups=[G,S]<=[...]`` (span = S)."""
+    out: List[Optional[int]] = []
+    for line, _t, op in _instructions(hlo):
+        if op not in _COLLECTIVE_OPS:
+            continue
+        m = _RG_IOTA_RE.search(line)
+        if m is not None:
+            out.append(int(m.group(2)))
+            continue
+        marker = "replica_groups={"
+        idx = line.find(marker)
+        if idx < 0:
+            out.append(None)
+            continue
+        start = idx + len(marker)
+        depth, i = 1, start
+        while i < len(line) and depth:
+            if line[i] == "{":
+                depth += 1
+            elif line[i] == "}":
+                depth -= 1
+            i += 1
+        body = line[start:i - 1].strip()
+        if not body:
+            out.append(None)
+            continue
+        first = body.lstrip("{").split("}", 1)[0]
+        ids = [s for s in first.split(",") if s.strip()]
+        out.append(len(ids) if ids else None)
+    return out
 
 
 # computation header: `%fused_computation.3 (p: f32[4]) -> bf16[4] {`
@@ -257,6 +305,37 @@ def audit_program(rec: dict) -> List[dict]:
                           f"program, the bucketer's plan says "
                           f"{want_coll} — the program's communication "
                           f"does not match what was planned"})
+
+    plan = contracts.get("collective_plan")
+    if plan:
+        # the sharded-program contract: each sized mesh axis must carry
+        # at least the planned number of GSPMD collectives.  A
+        # collective is credited to an axis when its replica-group span
+        # equals the axis size, or when it spans the whole mesh (a
+        # fused cross-axis reduce serves every axis it covers); an
+        # absent/empty replica_groups spans everything too.
+        axes = contracts.get("mesh_axes") or {}
+        spans = collective_groups(hlo)
+        total = 1
+        for v in axes.values():
+            total *= int(v)
+        for axis, want_min in sorted(plan.items()):
+            asize = int(axes.get(axis, 0))
+            got = sum(1 for s in spans
+                      if s is None or s == asize
+                      or (total > 1 and s == total))
+            if got < int(want_min):
+                issues.append({
+                    "program": name, "check": "collective-plan",
+                    "ok": False,
+                    "detail": f"mesh axis {axis!r} (size {asize}) "
+                              f"carries {got} collective(s) in the "
+                              f"lowered program, the GSPMD plan "
+                              f"requires >= {want_min} — XLA did not "
+                              f"insert the cross-shard communication "
+                              f"this axis needs (spans seen: "
+                              f"{sorted({x for x in spans if x}) or '[]'}"
+                              f", {len(spans)} total)"})
     return issues
 
 

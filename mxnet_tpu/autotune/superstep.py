@@ -42,7 +42,7 @@ from ..ndarray import NDArray
 from ..analysis import hot_path
 from ..analysis import sanitizer as _san
 from ..gluon.wholestep import WholeStepCompiler, _AmpIneligible, \
-    _Ineligible, amp_policy
+    _Ineligible, _ShardIneligible, amp_policy
 from ..observability import flight as _flight
 from ..observability import introspect as _introspect
 from ..observability import journal as _journal
@@ -164,6 +164,11 @@ class SuperStepCompiler(WholeStepCompiler):
         except _AmpIneligible as e:
             self._warn_demoted(str(e))
             return self._sequential(datas, labels, bs, k, stacked)
+        except _ShardIneligible as e:
+            # per-call (ragged batch vs mesh data axis): K=1 whole-step
+            # handles each batch, which itself falls back per step
+            self._warn_demoted(str(e))
+            return self._sequential(datas, labels, bs, k, stacked)
         except _Ineligible as e:
             self._warn_demoted(str(e))
             self._note_fallback(str(e))
@@ -254,7 +259,47 @@ class SuperStepCompiler(WholeStepCompiler):
             ngp, nst, nres, nsc, nax, nts = carry
             return losses, nax, ngp, nst, nres, nsc, nts
 
-        return jax.jit(fsuper, donate_argnums=(0, 1, 2, 3, 4))
+        mesh = self.mesh
+        if mesh is None or mesh.size <= 1:
+            return jax.jit(fsuper, donate_argnums=(0, 1, 2, 3, 4))
+        # same rule as WholeStepCompiler._build_fn: GSPMD may pick
+        # different output shardings for the scan carry than its inputs,
+        # and a donated buffer whose output layout differs cannot alias.
+        # Pin every donated output to its input's committed
+        # NamedSharding (same-shape state leaves shard like their
+        # weight, everything else replicates).
+        from jax.lax import with_sharding_constraint as _wsc
+        from jax.sharding import NamedSharding, PartitionSpec
+        params = built["params"]
+        gnames = built["gnames"]
+        psh = {n: params[n].sharding for n in gnames}
+        repl = NamedSharding(mesh, PartitionSpec())
+
+        def _pin_state(s, wsh, wshape):
+            if s is None:
+                return None
+            if isinstance(s, (tuple, list)):
+                return type(s)(_pin_state(x, wsh, wshape) for x in s)
+            tgt = wsh if tuple(s.shape) == wshape and wsh is not None \
+                else repl
+            return _wsc(s, tgt)
+
+        def fshard(gparams, states, residuals, scaler, aux, consts,
+                   datas, labels, keys, lrs, wds, ts):
+            (losses, nax, ngp, nst, nres, nsc,
+             nts) = fsuper(gparams, states, residuals, scaler, aux,
+                           consts, datas, labels, keys, lrs, wds, ts)
+            ngp = {n: _wsc(v, psh[n] if psh[n] is not None else repl)
+                   for n, v in ngp.items()}
+            nst = [_pin_state(s, psh[gnames[j]],
+                              tuple(gparams[gnames[j]].shape))
+                   for j, s in enumerate(nst)]
+            nax = {n: _wsc(v, repl) for n, v in nax.items()}
+            nsc = {n: _wsc(v, repl) for n, v in nsc.items()} \
+                if isinstance(nsc, dict) else nsc
+            return losses, nax, ngp, nst, nres, nsc, nts
+
+        return jax.jit(fshard, donate_argnums=(0, 1, 2, 3, 4))
 
     # -- per-superstep driver ------------------------------------------------
     def _run_super(self, built, datas, labels, bs, policy, k, stacked):
@@ -273,6 +318,20 @@ class SuperStepCompiler(WholeStepCompiler):
                 f"MXNET_AMP={policy} needs float32 master weights")
         gc = getattr(tr._kv, "_gc", None) if tr._kv is not None else None
         thr = gc.threshold if gc is not None else None
+        if thr is not None and self.mesh is not None \
+                and self.mesh.size > 1:
+            # same rule as WholeStepCompiler._run: GSPMD collectives
+            # replace the bucketed allreduce on a real mesh (the scan
+            # body is the shared tracer, so the two modes must agree)
+            if not self._mesh_comp_warned:
+                self._mesh_comp_warned = True
+                from ..parallel.mesh import mesh_signature
+                logger.warning(
+                    "2-bit gradient compression is disabled inside the "
+                    "superstep program on a multi-device mesh (%s) — "
+                    "GSPMD collectives replace the bucketed allreduce",
+                    mesh_signature(self.mesh))
+            thr = None
         residuals = []
         if thr is not None:
             if tr._residuals is None:
@@ -346,7 +405,25 @@ class SuperStepCompiler(WholeStepCompiler):
         params = built["params"]
         gnames = built["gnames"]
         idx = built["idx"]
+        mesh = self.mesh
+        if mesh is not None:
+            from ..parallel import mesh as _pmesh
+            daxis = _pmesh.data_axis(mesh)
+            dsize = int(mesh.shape[daxis])
+            if bs % dsize != 0:
+                raise _ShardIneligible(
+                    f"batch of {bs} does not divide the mesh's "
+                    f"{daxis} axis (size {dsize})")
         datas_j, labels_j, ctx = self._stage(datas, labels, k, stacked)
+        if mesh is not None:
+            # committed placement of the staged (K, batch, ...) stacks:
+            # the scan axis replicates, the batch axis shards — jit
+            # reads in_shardings off these and compiles the sharded
+            # scan program (still 1 dispatch per K steps)
+            from jax.sharding import NamedSharding, PartitionSpec
+            ssh = NamedSharding(mesh, PartitionSpec(None, daxis))
+            datas_j = jax.device_put(datas_j, ssh)  # graft-lint: disable=memory-hygiene
+            labels_j = jax.device_put(labels_j, ssh)  # graft-lint: disable=memory-hygiene
         # stacked (K, n) lr/wd rows with a last-value cache — constant
         # schedules re-upload nothing after the first superstep
         lrk, wdk = tuple(lr_rows), tuple(wd_rows)
@@ -363,16 +440,26 @@ class SuperStepCompiler(WholeStepCompiler):
                   for n in built["cnames"]}
         aux = {n: params[n].list_data()[0]._data
                for n in built["aux_names"]}
+        if mesh is not None and mesh.size > 1:
+            # same restore-path conformance as WholeStepCompiler._dispatch:
+            # rehydrated states land on the default device; pull them
+            # back onto their weights' committed NamedSharding
+            from ..optimizer import _conform_state_sharding
+            for j, n in enumerate(gnames):
+                upd.states[idx[j]] = _conform_state_sharding(
+                    upd.states[idx[j]], params[n].list_data()[0])
         svals = [upd._state_data(upd.states[i]) for i in idx]
 
         upd.dtype_policy = policy
         pol_key = policy if policy != "fp16" else f"fp16/w{window}"
+        from ..parallel.mesh import mesh_signature as _mesh_sig
+        msig = _mesh_sig(mesh)
         key = ("superstep", pol_key, type(opt_).__name__,
                opt_.fused_hyper_key(), idx,
                tuple(d for _, d in built["sig"]),
                built["uid"], thr,
                built["bk"].sizes if thr is not None else None,
-               jax.tree_util.tree_structure(svals), k)
+               jax.tree_util.tree_structure(svals), k, msig)
         fn = upd.lookup_program(
             key, lambda: self._build_super_fn(built, opt_, policy, thr,
                                               window, k))
@@ -387,18 +474,29 @@ class SuperStepCompiler(WholeStepCompiler):
             sig = hashlib.sha1(repr(
                 (built["sig"], type(opt_).__name__, policy,
                  thr is not None, tuple(datas_j.shape),
-                 tuple(labels_j.shape), k)).encode()).hexdigest()[:16]
+                 tuple(labels_j.shape), k,
+                 msig)).encode()).hexdigest()[:16]
             contracts = {
                 "donate_argnums": (0, 1, 2, 3, 4),
                 "donated_leaves": len(jax.tree_util.tree_leaves(
                     (gparams, svals, residuals, scaler, aux))),
                 "amp": policy,
                 "host_callbacks": 0,
-                "collectives": 0,
                 "buckets": len(built["bk"].sizes)
                 if thr is not None else 0,
                 "superstep_k": k,
             }
+            if mesh is not None and mesh.size > 1:
+                # same GSPMD plan the whole-step program declares: the
+                # scan body carries the collectives, so each sized axis
+                # shows at least one in the lowered HLO
+                contracts["mesh_axes"] = {
+                    a: int(mesh.shape[a]) for a in mesh.axis_names}
+                contracts["collective_plan"] = {
+                    a: 1 for a in mesh.axis_names
+                    if int(mesh.shape[a]) > 1}
+            else:
+                contracts["collectives"] = 0
             _introspect.note_jit(
                 "superstep", fn, gparams, svals, residuals, scaler, aux,
                 consts, datas_j, labels_j,

@@ -586,6 +586,12 @@ def save_trainer(manager: CheckpointManager, step: int, params,
         # directions (f32 checkpoint resumed bf16 is just as much a
         # trajectory change as the reverse; restore_trainer checks)
         signatures["amp_policy"] = policy
+    # stamp the GSPMD mesh SHAPE unconditionally ("replicated" when no
+    # mesh is ambient) — same both-directions discipline as amp_policy,
+    # but restore RAISES on mismatch: params sliced for one topology
+    # loaded onto another is silent corruption, not a trajectory change
+    from ..parallel.mesh import current_mesh, mesh_signature
+    signatures["mesh_signature"] = mesh_signature(current_mesh())
     if extra_state:
         overlap = set(extra_state) & set(state)
         if overlap:
@@ -633,6 +639,23 @@ def restore_trainer(manager: CheckpointManager, params, trainer=None,
                 "state restores regardless; if whole-step falls back, "
                 "the run is f32 whatever MXNET_AMP says)",
                 got_step, saved_amp, cur)
+    saved_mesh = (manifest.get("signatures") or {}).get("mesh_signature")
+    if saved_mesh is not None:
+        from ..parallel.mesh import current_mesh, mesh_signature
+        cur_mesh = mesh_signature(current_mesh())
+        if cur_mesh != saved_mesh:
+            # LOUD, unlike the amp warning: optimizer state, bucket
+            # residuals, and the params' committed placements were all
+            # written for the saved topology — loading them onto a
+            # different mesh shape silently mis-shards the run.
+            # (Elastic reshard-on-restore is the ROADMAP follow-up;
+            # until it lands, mismatches must stop the resume.)
+            raise CheckpointError(
+                f"checkpoint step {got_step} was written on mesh "
+                f"[{saved_mesh}] but this process runs mesh "
+                f"[{cur_mesh}] — set MXNET_MESH_BATCH/MXNET_MESH_MODEL "
+                f"(or set_current_mesh) to the saved shape, or start a "
+                f"fresh run directory")
     pd = _as_param_dict(params)
     missing = [name for name in pd
                if f"{PARAM_PREFIX}{name}" not in state]

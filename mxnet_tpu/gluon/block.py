@@ -193,6 +193,38 @@ class Block:
         for _, param in self.params.items():
             param.cast(dtype)
 
+    def shard(self, mesh=None, spec_fn=None):
+        """Annotate every parameter of this block (children included)
+        with a ``NamedSharding`` on ``mesh`` (default: the ambient
+        ``parallel.mesh.current_mesh()``).  ``spec_fn(name, param)``
+        may return a ``PartitionSpec`` per parameter (None = keep the
+        default rule: trainable >=2-D tensors shard their largest
+        evenly-divisible dim along the model axis, everything else
+        replicates).  Initialized params re-place immediately;
+        uninitialized ones place at init — either way the whole-step
+        compiler sees committed shardings and jit inserts the
+        collectives.  Returns ``self`` for chaining."""
+        from ..parallel import mesh as _pmesh
+        mesh = _pmesh.resolve_mesh(mesh)
+        if mesh is None:
+            raise ValueError(
+                "Block.shard() needs a mesh: pass one, or install an "
+                "ambient mesh via parallel.mesh.set_current_mesh / "
+                "use_mesh / MXNET_MESH_BATCH/MXNET_MESH_MODEL")
+        for name, p in self.collect_params().items():
+            spec = spec_fn(name, p) if spec_fn is not None else None
+            if spec is None:
+                shape = tuple(p.shape) if p.shape is not None else ()
+                if not shape or any(d <= 0 for d in shape):
+                    # deferred-init shape: leave the spec unset so the
+                    # whole-step bind (or a re-shard after init)
+                    # computes the default from the REAL shape
+                    continue
+                spec = _pmesh.default_param_spec(
+                    mesh, shape, trainable=p.grad_req != "null")
+            p.set_sharding(mesh, spec)
+        return self
+
     def __call__(self, *args):
         return self.forward(*args)
 

@@ -50,6 +50,15 @@ class Parameter:
         self._grad = None
         self._ctx = None
         self._deferred_init = ()
+        # GSPMD placement (ISSUE 18): the NamedSharding this parameter's
+        # buffer is committed to, plus the (mesh, PartitionSpec) pair it
+        # was derived from.  A placement PROPERTY, not data: _init_impl
+        # re-applies it on every load path (checkpoint restore,
+        # supervisor snapshot restore, deferred init), so a sharded
+        # param stays sharded through every restore the last 8 PRs built
+        self._sharding = None
+        self._sharding_spec = None
+        self._sharding_mesh = None
         self.name = name
         self._grad_req = None
         if isinstance(shape, int):
@@ -139,6 +148,14 @@ class Parameter:
                 data = nd.array(data, dtype=self.dtype)
             self._data = data.as_in_context(self._ctx[0]) if \
                 data.context != self._ctx[0] else data
+            if self._sharding is not None:
+                # re-commit to the mesh placement: this is THE point
+                # every load path funnels through (_load_init from
+                # checkpoint restore, the supervisor's donation-safe
+                # snapshot restore, deferred init), so a restored host
+                # copy lands back as the same sharded device array a
+                # failed donated dispatch consumed
+                self._apply_sharding_locked()
             if _memory.ENABLED:
                 # load-path wrappers (ParameterDict.load / _load_init)
                 # arrive already registered under their creation tag
@@ -146,6 +163,70 @@ class Parameter:
                 # same live wrapper to param instead of double counting
                 _memory.register_nd(self._data)
         self._init_grad()
+
+    # -- GSPMD sharding (ISSUE 18) ------------------------------------------
+    def _apply_sharding_locked(self):
+        """device_put the live buffer onto its NamedSharding (committed
+        placement — jax.jit then treats the spec as an in_sharding and
+        inserts the collectives).  Caller holds the param-tag scope."""
+        import jax
+        # mesh placement of the param's own buffer — a retag of the same
+        # logical allocation, not a new one
+        self._data._set_data(
+            jax.device_put(self._data._data, self._sharding))  # graft-lint: disable=memory-hygiene
+
+    def __getstate__(self):
+        """The live NamedSharding/Mesh hold Device handles that cannot
+        cross a pickle boundary (Updater.get_states packs the optimizer
+        whose param_dict points back here).  Drop them — the spec
+        string survives, and the next whole-step bind re-resolves the
+        mesh and re-commits the placement in the new process."""
+        state = self.__dict__.copy()
+        state["_sharding"] = None
+        state["_sharding_mesh"] = None
+        return state
+
+    @property
+    def sharding_spec(self):
+        """The PartitionSpec this parameter is annotated with (None =
+        replicated / never sharded)."""
+        return self._sharding_spec
+
+    @property
+    def sharding(self):
+        """The committed NamedSharding, or None."""
+        return self._sharding
+
+    def set_sharding(self, mesh, spec) -> None:
+        """Annotate this parameter with a GSPMD placement: ``spec`` is a
+        ``jax.sharding.PartitionSpec`` (or axis-name tuple) over
+        ``mesh``.  Applies immediately when the buffer exists and
+        re-applies on every restore path (``_init_impl``).  ``mesh=None``
+        clears the annotation (the buffer keeps its current placement
+        until the next restore)."""
+        if mesh is None:
+            self._sharding = None
+            self._sharding_spec = None
+            self._sharding_mesh = None
+            return
+        from jax.sharding import NamedSharding, PartitionSpec
+        if not isinstance(spec, PartitionSpec):
+            spec = PartitionSpec(*spec) if spec else PartitionSpec()
+        self._sharding_mesh = mesh
+        self._sharding_spec = spec
+        self._sharding = NamedSharding(mesh, spec)
+        if self._data is not None:
+            with _memory_scope("param"):
+                self._apply_sharding_locked()
+            from ..ndarray.sparse import RowSparseNDArray
+            if self._grad is not None and \
+                    not isinstance(self._grad, RowSparseNDArray):
+                # keep the grad buffer's placement consistent with the
+                # data it shadows (the eager fallback path deposits into
+                # it; mismatched placements would force XLA reshards)
+                import jax
+                self._grad._set_data(
+                    jax.device_put(self._grad._data, self._sharding))  # graft-lint: disable=memory-hygiene
 
     def _init_grad(self):
         if self.grad_req == "null":

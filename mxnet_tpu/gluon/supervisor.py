@@ -388,7 +388,11 @@ class TrainingSupervisor:
                         "changed after the supervisor captured it")
                 # same device-placement path restore_trainer uses: the
                 # host copy becomes a FRESH device buffer, replacing
-                # whatever a failed donated dispatch consumed
+                # whatever a failed donated dispatch consumed.  A
+                # sharded param re-commits to its NamedSharding here
+                # too — _init_impl re-applies the recorded spec, so a
+                # donation-safe retry restores the GSPMD placement, not
+                # a single-device copy
                 p._load_init(arr, p.list_ctx())
         if self._trainer is not None and TRAINER_STATES_KEY in state:
             self._trainer.set_states_bytes(state[TRAINER_STATES_KEY])

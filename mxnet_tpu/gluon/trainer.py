@@ -45,7 +45,8 @@ from .parameter import ParameterDict, Parameter
 
 class Trainer:
     def __init__(self, params, optimizer, optimizer_params=None, kvstore="device",
-                 compression_params=None, update_on_kvstore=None):
+                 compression_params=None, update_on_kvstore=None,
+                 mesh=None):
         if isinstance(params, (dict, ParameterDict)):
             params = list(params.values())
         if not isinstance(params, (list, tuple)):
@@ -60,6 +61,10 @@ class Trainer:
                     f"got list of {type(param)}.")
             self._params.append(param)
         self._compression_params = compression_params
+        # GSPMD mesh this trainer's params shard over (ISSUE 18): the
+        # whole-step/superstep compilers resolve explicit arg > this >
+        # the ambient parallel.mesh.current_mesh(); None = replicated
+        self._mesh = mesh
         optimizer_params = optimizer_params or {}
         self._scale = float(optimizer_params.get("rescale_grad", 1.0))
         self._init_optimizer(optimizer, optimizer_params)

@@ -165,6 +165,13 @@ class _AmpIneligible(_Ineligible):
     built and working; unsetting MXNET_AMP resumes whole-step."""
 
 
+class _ShardIneligible(_Ineligible):
+    """THIS step cannot dispatch sharded (e.g. a ragged final batch
+    that does not divide the mesh's data axis) — a per-batch condition,
+    handled like _AmpIneligible: fall back for this call only, the next
+    full batch runs the sharded program again."""
+
+
 def _sel(finite, new, old):
     """Per-leaf where(finite, new, old) tolerant of None / nested
     tuple states (the fp16 skip-step select)."""
@@ -199,10 +206,17 @@ class WholeStepCompiler:
     loss taking ``(pred, label)``.
     """
 
-    def __init__(self, net, loss_fn, trainer):
+    def __init__(self, net, loss_fn, trainer, mesh=None):
         self.net = net
         self.loss_fn = loss_fn
         self.trainer = trainer
+        # GSPMD mesh: explicit arg > the trainer's mesh > the ambient
+        # parallel.mesh.current_mesh() (which itself reads
+        # MXNET_MESH_BATCH/MODEL).  Resolved once at build time so the
+        # frozen program and its committed placements agree; None keeps
+        # the replicated path bit-for-bit untouched.
+        self._mesh_arg = mesh
+        self.mesh = None
         self._built = None
         self._fallback_reason = None  # permanent-fallback explanation
         self._warned = False
@@ -217,6 +231,8 @@ class WholeStepCompiler:
         self._ran = False
         self._amp_warned = False       # AMP-ineligible model, warn once
         self._amp_env_checked = False  # AMP-without-whole-step, warn once
+        self._shard_warned = False     # per-step shard fallback, once
+        self._mesh_comp_warned = False  # compression off on mesh, once
         # introspection captures done, per (program cache key, data
         # shape) — a new shape re-notes so the recorded flops track the
         # running batch size
@@ -258,6 +274,15 @@ class WholeStepCompiler:
                     "MXNET_AMP requested but %s — running the fused f32 "
                     "path while the policy is set", e)
                 self._amp_warned = True
+            return self._fallback(data, label, bs)
+        except _ShardIneligible as e:
+            # per-batch, NOT permanent: a ragged final batch runs the
+            # fused path once; the next full batch dispatches sharded
+            if not self._shard_warned:
+                logger.warning(
+                    "sharded whole-step skipped for this batch (%s) — "
+                    "running the fused path for it", e)
+                self._shard_warned = True
             return self._fallback(data, label, bs)
         except _Ineligible as e:
             self._note_fallback(str(e))
@@ -328,6 +353,17 @@ class WholeStepCompiler:
         for u in getattr(self.trainer, "_updaters", None) or []:
             if getattr(u, "dtype_policy", "f32") != "f32":
                 u.dtype_policy = "f32"
+        if self.mesh is not None and self.mesh.size > 1:
+            # params already committed to the mesh: replicate the batch
+            # onto it so the eager CachedOp jit sees ONE device set (a
+            # ragged _ShardIneligible batch lands here; every device
+            # computes the full batch — slower, but correct)
+            import jax
+            from jax.sharding import NamedSharding, PartitionSpec
+            from ..ndarray import NDArray as _ND
+            repl = NamedSharding(self.mesh, PartitionSpec())
+            data = _ND(jax.device_put(data._data, repl), data.context)  # graft-lint: disable=memory-hygiene
+            label = _ND(jax.device_put(label._data, repl), label.context)  # graft-lint: disable=memory-hygiene
         with autograd.record():
             out = self.net(data)
             loss = self.loss_fn(out, label)
@@ -375,6 +411,10 @@ class WholeStepCompiler:
         if not tr._kv_initialized:
             tr._init_kvstore()
         self._check_trainer(tr)
+        from ..parallel import mesh as _pmesh
+        self.mesh = _pmesh.resolve_mesh(
+            self._mesh_arg if self._mesh_arg is not None
+            else getattr(tr, "_mesh", None))
         plan, out_sym = self._trace_graph()
         built = self._bind_graph(tr, plan)
         built["symbol"] = out_sym  # hold the graph alive (id-keyed cache)
@@ -464,8 +504,38 @@ class WholeStepCompiler:
                     for _, p in live)
         bk = tr._ensure_bucketer(sig, idx)
         upd = tr._updaters[0]
+        if self.mesh is not None:
+            # annotate BEFORE the updater seeds optimizer state: the
+            # zeros_like slots inherit each param's committed
+            # NamedSharding, so momentum/adam state shards exactly like
+            # its weight.  Trainable >=2-D tensors take the model-axis
+            # default unless the user pinned a spec via set_sharding;
+            # consts and aux (BN running stats) replicate — XLA then
+            # inserts whatever collectives the annotated dataflow needs.
+            from ..parallel import mesh as _pmesh
+            from jax.sharding import PartitionSpec as _P
+            for _, p in live:
+                spec = p.sharding_spec
+                if spec is None:
+                    spec = _pmesh.default_param_spec(
+                        self.mesh, tuple(p.data().shape))
+                p.set_sharding(self.mesh, spec)
+            for n in itertools.chain(cnames, plan.aux_names):
+                p = params_by_name[n]
+                spec = p.sharding_spec
+                p.set_sharding(self.mesh,
+                               spec if spec is not None else _P())
         for i, p in live:
             upd._ensure_state(i, p.data())
+            if self.mesh is not None:
+                # states may predate the sharding (e.g. the first step
+                # fell back on DeferredInitializationError and the fused
+                # path seeded them on one device) — conform them to the
+                # weight's committed NamedSharding so the donated program
+                # sees one placement
+                from ..optimizer import _conform_state_sharding
+                upd.states[i] = _conform_state_sharding(
+                    upd.states[i], p.data())
         return {"plan": plan, "idx": idx, "gnames": gnames,
                 "cnames": tuple(cnames),
                 "aux_names": tuple(plan.aux_names),
@@ -584,7 +654,51 @@ class WholeStepCompiler:
         residuals/scaler/aux are DONATED — the step updates the model
         truly in place on backends with donation."""
         ftrain = self._make_ftrain(built, opt_, policy, thr, window)
-        return jax.jit(ftrain, donate_argnums=(0, 1, 2, 3, 4))
+        mesh = self.mesh
+        if mesh is None or mesh.size <= 1:
+            return jax.jit(ftrain, donate_argnums=(0, 1, 2, 3, 4))
+        # GSPMD propagation is free to pick DIFFERENT shardings for the
+        # updated params/states than their inputs carry — and a donated
+        # buffer whose output layout differs cannot alias (donation
+        # silently degrades to a copy + reshard).  Pin every donated
+        # output to its input's committed NamedSharding so the alias
+        # table stays complete; same-shape state leaves take their
+        # weight's sharding (momentum/adam moments shard like the
+        # weight), everything else replicates.
+        from jax.lax import with_sharding_constraint as _wsc
+        from jax.sharding import NamedSharding, PartitionSpec
+        params = built["params"]
+        gnames = built["gnames"]
+        psh = {n: params[n].sharding for n in gnames}
+        repl = NamedSharding(mesh, PartitionSpec())
+
+        def _pin_state(s, wsh, wshape):
+            if s is None:
+                return None
+            if isinstance(s, (tuple, list)):
+                return type(s)(_pin_state(x, wsh, wshape) for x in s)
+            tgt = wsh if tuple(s.shape) == wshape and wsh is not None \
+                else repl
+            return _wsc(s, tgt)
+
+        def fshard(gparams, states, residuals, scaler, aux, consts,
+                   data, label, key, lrs, wds, ts):
+            (loss, new_aux, new_p, new_s, new_res, new_scaler,
+             nts) = ftrain(gparams, states, residuals, scaler, aux,
+                           consts, data, label, key, lrs, wds, ts)
+            new_p = {n: _wsc(v, psh[n] if psh[n] is not None else repl)
+                     for n, v in new_p.items()}
+            new_s = [_pin_state(s, psh[gnames[k]],
+                                tuple(gparams[gnames[k]].shape))
+                     for k, s in enumerate(new_s)]
+            new_aux = {n: _wsc(v, repl) for n, v in new_aux.items()}
+            new_scaler = {n: _wsc(v, repl)
+                          for n, v in new_scaler.items()} \
+                if isinstance(new_scaler, dict) else new_scaler
+            return (loss, new_aux, new_p, new_s, new_res, new_scaler,
+                    nts)
+
+        return jax.jit(fshard, donate_argnums=(0, 1, 2, 3, 4))
 
     # -- per-step driver -----------------------------------------------------
     def _hyper_arrays(self, opt_, idx):
@@ -622,6 +736,23 @@ class WholeStepCompiler:
                 f"MXNET_AMP={policy} needs float32 master weights")
         gc = getattr(tr._kv, "_gc", None) if tr._kv is not None else None
         thr = gc.threshold if gc is not None else None
+        if thr is not None and self.mesh is not None \
+                and self.mesh.size > 1:
+            # GSPMD supersedes the explicit 2-bit bucketed allreduce on
+            # a real mesh: jit inserts the cross-shard collectives
+            # itself, so compressing an in-program reduce that no
+            # longer carries the cross-device traffic would change
+            # numerics for nothing.  A 1-chip mesh keeps compression —
+            # the bitwise-parity pin vs the replicated path covers it.
+            if not self._mesh_comp_warned:
+                self._mesh_comp_warned = True
+                from ..parallel.mesh import mesh_signature
+                logger.warning(
+                    "2-bit gradient compression is disabled inside the "
+                    "whole-step program on a multi-device mesh (%s) — "
+                    "GSPMD collectives replace the bucketed allreduce",
+                    mesh_signature(self.mesh))
+            thr = None
         residuals = []
         if thr is not None:
             if tr._residuals is None:
@@ -662,12 +793,39 @@ class WholeStepCompiler:
         params = built["params"]
         gnames = built["gnames"]
         idx = built["idx"]
+        mesh = self.mesh
+        data_j, label_j = data._data, label._data
+        if mesh is not None:
+            from ..parallel import mesh as _pmesh
+            daxis = _pmesh.data_axis(mesh)
+            dsize = int(mesh.shape[daxis])
+            if int(data.shape[0]) % dsize != 0:
+                raise _ShardIneligible(
+                    f"batch of {int(data.shape[0])} does not divide "
+                    f"the mesh's {daxis} axis (size {dsize})")
+            # committed batch placement: jit reads in_shardings off
+            # these arrays and compiles the sharded program.  A raw
+            # placement the runtime folds into the dispatch, not a
+            # tracked host transfer — the 1-dispatch gate stands.
+            bsh = _pmesh.batch_sharding(mesh)
+            data_j = jax.device_put(data_j, bsh)  # graft-lint: disable=memory-hygiene
+            label_j = jax.device_put(label_j, bsh)  # graft-lint: disable=memory-hygiene
         lrs, wds, ts, counts_t = self._hyper_arrays(opt_, idx)
         gparams = {n: params[n].list_data()[0]._data for n in gnames}
         consts = {n: params[n].list_data()[0]._data
                   for n in built["cnames"]}
         aux = {n: params[n].list_data()[0]._data
                for n in built["aux_names"]}
+        if mesh is not None and mesh.size > 1:
+            # a supervisor/checkpoint restore (set_states_bytes)
+            # rehydrates optimizer state on the default device while
+            # _load_init re-commits the weights to their NamedSharding
+            # — conform the states back to their weights' placement
+            # (device_put is an identity when already placed)
+            from ..optimizer import _conform_state_sharding
+            for j, n in enumerate(gnames):
+                upd.states[idx[j]] = _conform_state_sharding(
+                    upd.states[idx[j]], params[n].list_data()[0])
         svals = [upd._state_data(upd.states[i]) for i in idx]
 
         upd.dtype_policy = policy
@@ -676,12 +834,14 @@ class WholeStepCompiler:
         # recompile detection compares the policy-independent tail, so a
         # policy-derived field there would mask e.g. the f32->fp16 flip
         pol_key = policy if policy != "fp16" else f"fp16/w{window}"
+        from ..parallel.mesh import mesh_signature as _mesh_sig
+        msig = _mesh_sig(mesh)
         key = ("whole_step", pol_key, type(opt_).__name__,
                opt_.fused_hyper_key(), idx,
                tuple(d for _, d in built["sig"]),
                built["uid"], thr,
                built["bk"].sizes if thr is not None else None,
-               jax.tree_util.tree_structure(svals))
+               jax.tree_util.tree_structure(svals), msig)
         fn = upd.lookup_program(
             key, lambda: self._build_fn(built, opt_, policy, thr,
                                         window))
@@ -703,31 +863,50 @@ class WholeStepCompiler:
             # scales with batch size, so a legitimate bs change must
             # select a DIFFERENT perf baseline file, not fire a false
             # regression against the old batch's numbers
+            # mesh_signature folds in too: the perf sentinel then keys
+            # its baseline per mesh SHAPE — a resharded run measures
+            # against its own history, not the replicated path's
             sig = hashlib.sha1(repr(
                 (built["sig"], type(opt_).__name__, policy,
                  thr is not None, tuple(data.shape),
-                 tuple(label.shape))).encode()).hexdigest()[:16]
+                 tuple(label.shape), msig)).encode()).hexdigest()[:16]
             # the program CONTRACT the post-compile auditor
             # (analysis.audit_programs, ISSUE 15) verifies against the
             # lowered HLO: every donated leaf must become an
             # input-output alias, AMP must leave no f32 dot/conv, a
             # whole-step program contains zero host callbacks (Custom
-            # ops are ineligible by construction), and — single-process
-            # inline bucketed reduce; multi-host kvstore is ineligible
-            # — zero collective ops regardless of bucket count
+            # ops are ineligible by construction), and the collective
+            # story matches the mesh — zero collectives replicated
+            # (single-process inline bucketed reduce; multi-host
+            # kvstore is ineligible), or the per-axis GSPMD plan on a
+            # multi-device mesh
             contracts = {
                 "donate_argnums": (0, 1, 2, 3, 4),
                 "donated_leaves": len(jax.tree_util.tree_leaves(
                     (gparams, svals, residuals, scaler, aux))),
                 "amp": policy,
                 "host_callbacks": 0,
-                "collectives": 0,
                 "buckets": len(built["bk"].sizes)
                 if thr is not None else 0,
             }
+            if mesh is not None and mesh.size > 1:
+                # the GSPMD collective plan the auditor verifies
+                # against the sharded HLO: every mesh axis of size > 1
+                # must carry at least one XLA-inserted collective
+                # (gradient reduce over batch, partial-sum reduce over
+                # model) — and donation must STILL alias under sharding
+                contracts["mesh_axes"] = {
+                    a: int(mesh.shape[a]) for a in mesh.axis_names}
+                contracts["collective_plan"] = {
+                    a: 1 for a in mesh.axis_names
+                    if int(mesh.shape[a]) > 1}
+            else:
+                # single-process inline bucketed reduce (multi-host
+                # kvstore is ineligible): zero collective ops
+                contracts["collectives"] = 0
             _introspect.note_jit(
                 "whole_step", fn, gparams, svals, residuals, scaler, aux,
-                consts, data._data, label._data,
+                consts, data_j, label_j,
                 jax.random.PRNGKey(0), lrs, wds, ts, signature=sig,
                 contracts=contracts)
 
@@ -750,7 +929,7 @@ class WholeStepCompiler:
                     _memory.oom_guard("wholestep.step"):
                 loss, new_aux, new_p, new_s, new_res, new_scaler, nts = \
                     fn(gparams, svals, residuals, scaler, aux, consts,
-                       data._data, label._data, rkey, lrs, wds, ts)
+                       data_j, label_j, rkey, lrs, wds, ts)
         except BaseException:
             # MXNET_SANITIZE runtime twin of the use-after-donate
             # static rule: an exception out of the donated program

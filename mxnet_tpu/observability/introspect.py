@@ -619,6 +619,19 @@ def mfu(step_time_s: Optional[float] = None, flops: Optional[float] = None,
         out["bytes_per_step"] = bytes_per_step
         out["bytes_per_s"] = bytes_per_step / step_time_s
         out["arithmetic_intensity"] = round(flops / bytes_per_step, 4)
+    try:
+        from ..parallel.mesh import current_mesh, mesh_signature
+        m = current_mesh()
+        if m is not None:
+            # the sharded-run attribution: total program flops split by
+            # each mesh axis's size — the per-shard share along that
+            # axis (metrics.py exports these as per-axis gauge children)
+            out["mesh"] = mesh_signature(m)
+            out["mesh_axes"] = {a: int(m.shape[a]) for a in m.axis_names}
+            out["per_axis_flops_per_s"] = {
+                a: fps / int(m.shape[a]) for a in m.axis_names}
+    except Exception:  # noqa: BLE001 — telemetry must never fail a pull
+        pass
     return out
 
 

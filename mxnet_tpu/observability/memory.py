@@ -350,6 +350,28 @@ def live_by_tag(space: str = "device") -> Dict[str, float]:
                 if sp == space and v > 0}
 
 
+def _shard_info(handle, nb_now: int):
+    """(per-shard bytes, spec string or None) for a buffer.  A
+    GSPMD-sharded jax.Array holds only its shard per device —
+    ``Sharding.shard_shape`` gives the slice one device stores; a
+    replicated or single-device array returns the logical bytes and no
+    spec.  Guarded: the ledger tracks numpy and wrappers too."""
+    try:
+        sh = getattr(handle, "sharding", None)
+        if sh is None or getattr(sh, "num_devices", 1) <= 1:
+            return nb_now, None
+        sshape = sh.shard_shape(tuple(handle.shape))
+        n = 1
+        for d in sshape:
+            n *= int(d)
+        itemsize = getattr(getattr(handle, "dtype", None), "itemsize", 0)
+        shard_nb = int(n * itemsize) or nb_now
+        spec = getattr(sh, "spec", None)
+        return shard_nb, (str(spec) if spec is not None else None)
+    except Exception:  # noqa: BLE001 — accounting must never raise
+        return nb_now, None
+
+
 def report(top: int = 10) -> dict:
     """The audit view: per-tag live/peak/count (device and host
     sections), the ``top`` largest live buffers with shape/dtype/tag,
@@ -376,19 +398,32 @@ def report(top: int = 10) -> dict:
             continue
         seen[hid] = 1
         nb_now = nbytes_of(handle) or nb
+        # GSPMD-sharded arrays: `bytes` is the LOGICAL (global) size;
+        # shard_bytes is what one device actually holds — the per-tag
+        # shard total below is the real per-device HBM cost, not the
+        # replicated sum
+        shard_nb, spec = _shard_info(handle, nb_now)
         st = (space, tag)
-        a = agg.setdefault(st, {"live_bytes": 0, "buffers": 0})
+        a = agg.setdefault(st, {"live_bytes": 0, "buffers": 0,
+                                "shard_bytes": 0})
         a["live_bytes"] += nb_now
+        a["shard_bytes"] += shard_nb
         a["buffers"] += 1
-        buffers.append({"tag": tag, "space": space, "bytes": nb_now,
-                        "shape": tuple(getattr(handle, "shape", ()) or ()),
-                        "dtype": str(getattr(handle, "dtype", "?"))})
+        entry = {"tag": tag, "space": space, "bytes": nb_now,
+                 "shape": tuple(getattr(handle, "shape", ()) or ()),
+                 "dtype": str(getattr(handle, "dtype", "?"))}
+        if spec is not None:
+            entry["shard_bytes"] = shard_nb
+            entry["spec"] = spec
+        buffers.append(entry)
     buffers.sort(key=lambda b: -b["bytes"])
 
     def _section(space: str) -> dict:
         tags = {t: {"live_bytes": int(v["live_bytes"]),
                     "buffers": v["buffers"],
-                    "peak_bytes": int(peaks.get((space, t), 0.0))}
+                    "peak_bytes": int(peaks.get((space, t), 0.0)),
+                    **({"shard_bytes": int(v["shard_bytes"])}
+                       if v["shard_bytes"] != v["live_bytes"] else {})}
                 for (sp, t), v in sorted(agg.items()) if sp == space}
         untagged = tags.pop(UNTAGGED, {"live_bytes": 0, "buffers": 0,
                                        "peak_bytes": 0})
@@ -402,12 +437,18 @@ def report(top: int = 10) -> dict:
                 if total else 100.0}
 
     from .metrics import hbm_stats
+    try:
+        from ..parallel.mesh import current_mesh, mesh_signature
+        mesh_sig = mesh_signature(current_mesh())
+    except Exception:  # noqa: BLE001
+        mesh_sig = "replicated"
     return {"enabled": ENABLED,
             "device": _section("device"),
             "host": _section("host"),
             "top": buffers[:max(0, top)],
             "compiled": compiled,
             "budget_mb": BUDGET_MB,
+            "mesh": mesh_sig,
             "hbm": hbm_stats()}
 
 

@@ -205,9 +205,14 @@ class Gauge(Metric):
     def samples(self):
         if self._fn is not None:
             try:
-                return [(self.name, (), float(self._fn()))]
+                out = [(self.name, (), float(self._fn()))]
             except Exception:
-                return [(self.name, (), 0.0)]
+                out = [(self.name, (), 0.0)]
+            # computed gauges may ALSO carry labeled children (the
+            # per-mesh-axis MFU/flops splits refreshed by the fn pull)
+            for k, v in sorted(list(self._children.items())):
+                out.append((self.name, k, v))
+            return out
         out = []
         if self._value or not self._children:
             out.append((self.name, (), self._value))
@@ -609,7 +614,11 @@ MEMORY_LEDGER_BYTES = Gauge(
     "space=device|host [host = e.g. checkpoint snapshot twins and the "
     "serve_host_params readmission payload evicted serving models "
     "reload from], and "
-    "_untagged for the unattributed remainder).  Refreshed at export "
+    "_untagged for the unattributed remainder).  Bytes are LOGICAL "
+    "(global) array bytes; on a GSPMD mesh memory.report() breaks each "
+    "buffer into per-shard bytes (shard_bytes / spec fields) and "
+    "per-tag shard totals — the per-device HBM cost, NOT the "
+    "replicated sum.  Refreshed at export "
     "time from the weakref ledger, never on the hot path")
 SERVE_BUCKET_HBM_BYTES = Gauge(
     "mxnet_serve_bucket_hbm_bytes",
@@ -720,12 +729,25 @@ SLO_BURN = Counter(
 def _introspect_mfu(key: str) -> float:
     """Export-time pull of one MFU/roofline field from the introspect
     layer (lazy/guarded — a scrape must never fail because of it;
-    0.0 until both a program capture and a warmed step EWMA exist)."""
+    0.0 until both a program capture and a warmed step EWMA exist).
+    The "mfu" pull also refreshes the per-mesh-axis children: on a
+    GSPMD mesh the MFU gauge gains a {mesh=<signature>} child and the
+    flops gauge per-axis {mesh_axis=...} splits (the sharded run's
+    flops divided by each axis size)."""
     try:
         from . import introspect as _int
         if not _int.ENABLED:
             return 0.0
-        return float(_int.mfu().get(key) or 0.0)
+        d = _int.mfu()
+        if key == "mfu":
+            msig = d.get("mesh")
+            MFU.replace_children(
+                [({"mesh": msig}, float(d.get("mfu") or 0.0))]
+                if msig else [])
+            STEP_FLOPS_PER_S.replace_children(
+                [({"mesh_axis": a}, float(v)) for a, v in
+                 sorted((d.get("per_axis_flops_per_s") or {}).items())])
+        return float(d.get(key) or 0.0)
     except Exception:  # noqa: BLE001
         return 0.0
 
@@ -736,12 +758,17 @@ MFU = Gauge(
     "flops/step of the captured step program(s) / the flight "
     "recorder's warmed step-time EWMA / platform peak flops "
     "(MXNET_PEAK_FLOPS override; the CPU default peak is a nominal "
-    "placeholder).  Computed at export only",
+    "placeholder).  On a GSPMD mesh a {mesh=<axis=size,...>} child "
+    "carries the same value keyed by mesh shape so dashboards can "
+    "group sharded vs replicated runs.  Computed at export only",
     fn=lambda: _introspect_mfu("mfu"))
 STEP_FLOPS_PER_S = Gauge(
     "mxnet_step_flops_per_s",
     "Achieved flops/s of the training step (analytical flops/step / "
-    "warmed step-time EWMA) — the roofline y-axis.  Computed at export",
+    "warmed step-time EWMA) — the roofline y-axis.  On a GSPMD mesh, "
+    "per-mesh-axis {mesh_axis=batch|model|...} children split the "
+    "total by axis size (the per-shard share along each axis).  "
+    "Computed at export",
     fn=lambda: _introspect_mfu("flops_per_s"))
 STEP_BYTES_PER_S = Gauge(
     "mxnet_step_bytes_per_s",

@@ -11,7 +11,10 @@ It also provides what the reference *lacks* (SURVEY.md §5 long-context):
 ring attention and Ulysses sequence parallelism over the mesh.
 """
 from . import mesh
-from .mesh import make_mesh, device_mesh, MeshConfig
+from .mesh import (make_mesh, device_mesh, MeshConfig, MeshShapeError,
+                   set_current_mesh, current_mesh, use_mesh, mesh_from_env,
+                   resolve_mesh, mesh_signature, data_axis, model_axis,
+                   batch_sharding, default_param_spec)
 from . import collectives
 from . import data_parallel
 from .data_parallel import shard_batch, replicate, DataParallelStep

@@ -16,17 +16,36 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..base import MXNetError
+from . import mesh as _mesh_mod
 
 
-def shard_batch(mesh: Mesh, x, axis_name: str = "dp"):
-    """Place a host array onto the mesh, sharded along dim 0."""
+def _resolve(mesh, who: str) -> Mesh:
+    """mesh=None -> ambient current_mesh(), typed error when neither is
+    set (the island-unification rule shared with sequence_parallel)."""
+    mesh = _mesh_mod.resolve_mesh(mesh)
+    if mesh is None:
+        raise MXNetError(
+            f"{who} needs a mesh: pass mesh=, or install an ambient one "
+            "(parallel.mesh.set_current_mesh / use_mesh / "
+            "MXNET_MESH_BATCH / MXNET_MESH_MODEL)")
+    return mesh
+
+
+def shard_batch(mesh: Optional[Mesh], x, axis_name: Optional[str] = None):
+    """Place a host array onto the mesh, sharded along dim 0.
+    ``axis_name=None`` uses the mesh's data axis ('batch' on the 2-D
+    GSPMD mesh, 'dp' on legacy meshes)."""
+    mesh = _resolve(mesh, "shard_batch")
+    if axis_name is None:
+        axis_name = _mesh_mod.data_axis(mesh)
     spec = P(axis_name) if x.ndim >= 1 else P()
     # mesh placement of a caller-owned batch: the caller tags it
     # (prefetcher/executor scopes); not a new logical allocation
     return jax.device_put(x, NamedSharding(mesh, spec))  # graft-lint: disable=memory-hygiene
 
 
-def replicate(mesh: Mesh, x):
+def replicate(mesh: Optional[Mesh], x):
+    mesh = _resolve(mesh, "replicate")
     return jax.device_put(x, NamedSharding(mesh, P()))  # graft-lint: disable=memory-hygiene
 
 
@@ -38,9 +57,11 @@ class DataParallelStep:
     come out replicated (XLA all-reduduces them over ICI).
     """
 
-    def __init__(self, mesh: Mesh, fn: Callable, data_names, axis_name="dp"):
-        self.mesh = mesh
-        self.axis_name = axis_name
+    def __init__(self, mesh: Optional[Mesh], fn: Callable, data_names,
+                 axis_name=None):
+        self.mesh = _resolve(mesh, "DataParallelStep")
+        self.axis_name = axis_name if axis_name is not None \
+            else _mesh_mod.data_axis(self.mesh)
         self.data_names = set(data_names)
         self._fn = fn
         self._jit = None

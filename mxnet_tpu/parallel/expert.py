@@ -10,13 +10,27 @@ follows the Switch Transformer formulation.
 """
 from __future__ import annotations
 
-from typing import Callable
+from typing import Callable, Optional
 
 import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 from .compat import shard_map
+
+
+def _resolve(mesh, who: str) -> Mesh:
+    """mesh=None -> ambient current_mesh(), typed error when neither is
+    set (the island-unification rule shared across parallel/)."""
+    from ..base import MXNetError
+    from .mesh import resolve_mesh
+    mesh = resolve_mesh(mesh)
+    if mesh is None:
+        raise MXNetError(
+            f"{who} needs a mesh: pass mesh=, or install an ambient one "
+            "(parallel.mesh.set_current_mesh / use_mesh / "
+            "MXNET_MESH_BATCH / MXNET_MESH_MODEL)")
+    return mesh
 
 
 def topk_moe(x, gate_w, expert_fn: Callable, expert_params,
@@ -80,10 +94,12 @@ def switch_moe(x, gate_w, expert_fn: Callable, expert_params,
 
 
 def switch_moe_sharded(x, gate_w, expert_fn: Callable, stacked_expert_params,
-                       mesh: Mesh, axis_name: str = "ep",
+                       mesh: Optional[Mesh] = None, axis_name: str = "ep",
                        capacity_factor: float = 2.0, k: int = 1):
     """Wrapper: tokens sharded on 'ep' (data-parallel over the same axis),
-    expert weights stacked on a leading axis of size mesh.shape[axis_name]."""
+    expert weights stacked on a leading axis of size mesh.shape[axis_name].
+    ``mesh=None`` resolves the ambient current_mesh()."""
+    mesh = _resolve(mesh, "switch_moe_sharded")
 
     def per_device(xs, gw, params):
         squeezed = jax.tree_util.tree_map(lambda a: a[0], params)

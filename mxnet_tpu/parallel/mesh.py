@@ -1,43 +1,93 @@
-"""Device-mesh construction (dp/tp/pp/sp/ep axes) over TPU ICI.
+"""Device-mesh construction and the ambient 2-D (batch × model) mesh.
 
 The mesh is the TPU analog of the reference's device list + ps-lite node
 groups: rank = linear index in the mesh, num_workers = mesh size.  Axis
-ordering follows the scaling-book recipe: fastest-varying axes (tp/sp) map
-to the innermost ICI dimension.
+ordering follows the scaling-book recipe: fastest-varying axes (model/tp/
+sp) map to the innermost ICI dimension.
+
+Two axis families:
+
+  * ``batch`` × ``model`` — the first-class 2-D GSPMD mesh the whole-step
+    trainer shards over (ISSUE 18).  Both axes always exist on a
+    batch/model mesh (size-1 included) so ``PartitionSpec("model")``
+    resolves regardless of the shape; ``batch`` is outermost.
+  * ``dp``/``tp``/``pp``/``sp``/``ep`` — the legacy named axes the
+    parallel islands (pipeline, sequence_parallel, expert) were built on.
+    They keep working; a batch×model mesh serves them too when the
+    caller passes ``axis_name`` explicitly.
+
+The CURRENT mesh is ambient process state (``set_current_mesh`` /
+``use_mesh`` / ``current_mesh``), the same discipline as
+``sequence_parallel.sp_scope``: ops and compilers that take ``mesh=None``
+resolve it here, and ``mesh_from_env()`` builds one from
+``MXNET_MESH_BATCH`` / ``MXNET_MESH_MODEL`` so a launcher can shard a
+training script without touching its code.  ``mesh_signature`` is the
+stable string checkpoints stamp and the perf sentinel keys baselines on.
 """
 from __future__ import annotations
 
-from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Sequence, Tuple
+import logging
+import threading
+from contextlib import contextmanager
+from dataclasses import dataclass
+from typing import Dict, Optional, Tuple
 
 import jax
 import numpy as _np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..base import MXNetError
+from ..base import MXNetError, getenv
+
+log = logging.getLogger("mxnet_tpu.parallel.mesh")
+
+
+class MeshShapeError(MXNetError):
+    """Mesh axis sizes do not fit the available devices (wrong total,
+    or a total that does not divide the device count evenly)."""
 
 
 @dataclass
 class MeshConfig:
-    dp: int = 1   # data parallel
-    tp: int = 1   # tensor parallel
-    pp: int = 1   # pipeline parallel
-    sp: int = 1   # sequence/context parallel
-    ep: int = 1   # expert parallel
+    batch: int = 1  # data-parallel axis of the 2-D GSPMD mesh (outermost)
+    model: int = 1  # tensor/model-parallel axis (innermost — fastest ICI)
+    dp: int = 1    # legacy: data parallel
+    tp: int = 1    # legacy: tensor parallel
+    pp: int = 1    # legacy: pipeline parallel
+    sp: int = 1    # legacy: sequence/context parallel
+    ep: int = 1    # legacy: expert parallel
 
     def axes(self) -> Dict[str, int]:
-        return {k: v for k, v in
-                [("dp", self.dp), ("pp", self.pp), ("ep", self.ep),
-                 ("sp", self.sp), ("tp", self.tp)] if v > 1} or {"dp": 1}
+        legacy = {k: v for k, v in
+                  [("dp", self.dp), ("pp", self.pp), ("ep", self.ep),
+                   ("sp", self.sp), ("tp", self.tp)] if v > 1}
+        if self.batch > 1 or self.model > 1:
+            if legacy:
+                raise MeshShapeError(
+                    "MeshConfig mixes the batch/model axes with legacy "
+                    f"dp/tp/pp/sp/ep axes ({sorted(legacy)}) — pick one "
+                    "family per mesh")
+            # both axes always present (size-1 included) so P("model")
+            # specs resolve on a dp-only mesh
+            return {"batch": self.batch, "model": self.model}
+        return legacy or {"dp": 1}
+
+
+_warned_unused = False
 
 
 def make_mesh(config: Optional[MeshConfig] = None, devices=None,
               **axis_sizes) -> Mesh:
-    """Build a Mesh. `make_mesh(dp=4, tp=2)` or `make_mesh(MeshConfig(...))`.
+    """Build a Mesh. ``make_mesh(batch=4, model=2)``,
+    ``make_mesh(dp=4, tp=2)``, or ``make_mesh(MeshConfig(...))``.
 
-    Axis order puts dp outermost and tp innermost so tensor-parallel
-    collectives ride the fastest ICI links.
+    Axis order puts batch/dp outermost and model/tp innermost so
+    tensor-parallel collectives ride the fastest ICI links.  The axis
+    sizes must multiply to a divisor of the device count: a non-even
+    division raises ``MeshShapeError`` (a silently lopsided mesh would
+    strand devices unpredictably); an even division smaller than the
+    device count warns once and uses the leading devices.
     """
+    global _warned_unused
     if config is None:
         config = MeshConfig(**axis_sizes) if axis_sizes else MeshConfig()
     axes = config.axes()
@@ -46,8 +96,24 @@ def make_mesh(config: Optional[MeshConfig] = None, devices=None,
     for v in axes.values():
         need *= v
     if need > len(devices):
-        raise MXNetError(f"mesh needs {need} devices, have {len(devices)}")
-    devices = devices[:need]
+        raise MeshShapeError(
+            f"mesh {dict(axes)} needs {need} devices, have "
+            f"{len(devices)}")
+    if len(devices) % need != 0:
+        raise MeshShapeError(
+            f"mesh {dict(axes)} covers {need} of {len(devices)} devices "
+            f"— axis sizes must divide the device count evenly "
+            f"({len(devices)} % {need} != 0); resize an axis or pass an "
+            f"explicit devices= subset")
+    if need < len(devices):
+        if not _warned_unused:
+            _warned_unused = True
+            log.warning(
+                "mesh %s uses %d of %d devices — %d device(s) sit idle "
+                "(grow an axis, or pass devices= explicitly to silence "
+                "this)", dict(axes), need, len(devices),
+                len(devices) - need)
+        devices = devices[:need]
     arr = _np.array(devices).reshape(tuple(axes.values()))
     return Mesh(arr, tuple(axes.keys()))
 
@@ -66,3 +132,117 @@ def sharding(mesh: Mesh, *spec) -> NamedSharding:
 
 def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
+
+
+# -- the ambient current mesh -------------------------------------------------
+# Process-wide (NOT thread-local, unlike sp_scope): the training mesh is
+# a per-run topology decision — checkpoint stamping, the HBM ledger, and
+# the perf sentinel all read it from arbitrary threads.
+_state_lock = threading.Lock()
+_current: Optional[Mesh] = None
+_env_resolved = False
+
+
+def set_current_mesh(mesh: Optional[Mesh]) -> Optional[Mesh]:
+    """Install ``mesh`` as the process's ambient mesh; returns the
+    previous one.  ``None`` clears it (back to replicated)."""
+    global _current
+    with _state_lock:
+        prev, _current = _current, mesh
+    return prev
+
+
+def current_mesh() -> Optional[Mesh]:
+    """The ambient mesh, resolving ``MXNET_MESH_*`` lazily on first
+    read so env-launched runs need no code change; None = replicated."""
+    global _env_resolved, _current
+    with _state_lock:
+        if _current is None and not _env_resolved:
+            _env_resolved = True
+            m = mesh_from_env()
+            if m is not None:
+                _current = m
+        return _current
+
+
+@contextmanager
+def use_mesh(mesh: Optional[Mesh]):
+    """Scoped ``set_current_mesh`` — the test/bench idiom."""
+    prev = set_current_mesh(mesh)
+    try:
+        yield mesh
+    finally:
+        set_current_mesh(prev)
+
+
+def mesh_from_env(devices=None) -> Optional[Mesh]:
+    """Build a batch×model mesh from ``MXNET_MESH_BATCH`` /
+    ``MXNET_MESH_MODEL`` (None when neither is set)."""
+    b = int(getenv("MXNET_MESH_BATCH", 0))
+    m = int(getenv("MXNET_MESH_MODEL", 0))
+    if b <= 0 and m <= 0:
+        return None
+    return make_mesh(batch=max(1, b), model=max(1, m), devices=devices)
+
+
+def resolve_mesh(explicit: Optional[Mesh] = None) -> Optional[Mesh]:
+    """The one resolution order every mesh consumer uses: explicit arg >
+    ambient current mesh (which itself falls back to MXNET_MESH_*)."""
+    return explicit if explicit is not None else current_mesh()
+
+
+def mesh_signature(mesh: Optional[Mesh]) -> str:
+    """Stable string identity of the mesh SHAPE (axis names + sizes,
+    device identity excluded — a restore onto the same shape on
+    different chips is the same layout).  ``None`` -> "replicated": the
+    un-meshed path stamps too, so a resume under a different topology
+    is loud in both directions (the amp_policy discipline)."""
+    if mesh is None:
+        return "replicated"
+    return ",".join(f"{a}={int(mesh.shape[a])}" for a in mesh.axis_names)
+
+
+# -- spec rules ---------------------------------------------------------------
+def data_axis(mesh: Mesh) -> str:
+    """The axis batches shard over: 'batch' on the 2-D mesh, 'dp' on
+    legacy meshes, else the outermost axis."""
+    for name in ("batch", "dp"):
+        if name in mesh.axis_names:
+            return name
+    return mesh.axis_names[0]
+
+
+def model_axis(mesh: Mesh) -> Optional[str]:
+    """The axis parameters shard over, or None when the mesh has no
+    model-parallel dimension (or it is size 1)."""
+    for name in ("model", "tp"):
+        if name in mesh.axis_names and int(mesh.shape[name]) > 1:
+            return name
+    return None
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Leading-dim sharding for a batch placed on ``mesh``."""
+    return NamedSharding(mesh, P(data_axis(mesh)))
+
+
+def default_param_spec(mesh: Mesh, shape: Tuple[int, ...],
+                       trainable: bool = True) -> P:
+    """The default GSPMD annotation for a parameter: shard the largest
+    evenly-divisible dim of a trainable >=2-D tensor along the model
+    axis, replicate everything else (biases, norm scales, aux state).
+    SNIPPETS [2][3] pattern: annotate, let jax.jit insert collectives."""
+    axis = model_axis(mesh)
+    if axis is None or not trainable or len(shape) < 2:
+        return P()
+    size = int(mesh.shape[axis])
+    best = None
+    for i, d in enumerate(shape):
+        # d > 0 skips the unknown dims of a deferred-init shape
+        if d > 0 and d % size == 0 and (best is None or d > shape[best]):
+            best = i
+    if best is None:
+        return P()
+    spec = [None] * len(shape)
+    spec[best] = axis
+    return P(*spec)

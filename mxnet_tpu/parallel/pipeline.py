@@ -15,13 +15,27 @@ the ppermute to ICI neighbor exchanges.
 """
 from __future__ import annotations
 
-from typing import Callable
+from typing import Callable, Optional
 
 import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 from .compat import shard_map
+
+
+def _resolve(mesh, who: str) -> Mesh:
+    """mesh=None -> ambient current_mesh(), typed error when neither is
+    set (the island-unification rule shared across parallel/)."""
+    from ..base import MXNetError
+    from .mesh import resolve_mesh
+    mesh = resolve_mesh(mesh)
+    if mesh is None:
+        raise MXNetError(
+            f"{who} needs a mesh: pass mesh=, or install an ambient one "
+            "(parallel.mesh.set_current_mesh / use_mesh / "
+            "MXNET_MESH_BATCH / MXNET_MESH_MODEL)")
+    return mesh
 
 
 def gpipe(stage_fn: Callable, stage_params, x, n_microbatches: int,
@@ -68,10 +82,13 @@ def gpipe(stage_fn: Callable, stage_params, x, n_microbatches: int,
     return outputs.reshape(x.shape)
 
 
-def gpipe_sharded(stage_fn: Callable, stacked_params, x, mesh: Mesh,
-                  n_microbatches: int, axis_name: str = "pp"):
+def gpipe_sharded(stage_fn: Callable, stacked_params, x,
+                  mesh: Optional[Mesh] = None,
+                  n_microbatches: int = 4, axis_name: str = "pp"):
     """Convenience wrapper: `stacked_params` leaves have a leading axis of
-    size mesh.shape[axis_name] (one slice per stage); x is replicated."""
+    size mesh.shape[axis_name] (one slice per stage); x is replicated.
+    ``mesh=None`` resolves the ambient current_mesh()."""
+    mesh = _resolve(mesh, "gpipe_sharded")
 
     def per_device(params, xs):
         squeezed = jax.tree_util.tree_map(lambda a: a[0], params)
@@ -181,7 +198,8 @@ def pipeline_1f1b(stage_fn: Callable, stage_params, x, y, loss_fn: Callable,
 
 
 def pipeline_train_step(stage_fn: Callable, stacked_params, x, y,
-                        loss_fn: Callable, mesh: Mesh, n_microbatches: int,
+                        loss_fn: Callable, mesh: Optional[Mesh] = None,
+                        n_microbatches: int = 4,
                         schedule: str = "1f1b", axis_name: str = "pp"):
     """One pipeline-parallel training step over the mesh's `axis_name`.
 
@@ -192,7 +210,9 @@ def pipeline_train_step(stage_fn: Callable, stacked_params, x, y,
     Both return (loss, grads) where loss = SUM over microbatches of
     loss_fn(out_mb, y_mb) and grads has the same stage-stacked layout as
     `stacked_params` (leading axis = n_stages, sharded on the pp axis).
+    ``mesh=None`` resolves the ambient current_mesh().
     """
+    mesh = _resolve(mesh, "pipeline_train_step")
     S = mesh.shape[axis_name]
     M = n_microbatches
     if schedule == "gpipe":

@@ -122,10 +122,26 @@ def _sharded_fn(kind, mesh: Mesh, axis_name: str, causal, scale):
         out_specs=(rspec, spec, spec), check_vma=False)
 
 
-def ring_attention_sharded(q, k, v, mesh: Mesh, axis_name: str = "sp",
+def _resolve(mesh, who: str) -> Mesh:
+    """mesh=None -> the ambient parallel.mesh.current_mesh(), raising a
+    typed error when neither is set — the one island-unification rule
+    (every parallel island resolves its mesh the same way)."""
+    from .mesh import resolve_mesh
+    mesh = resolve_mesh(mesh)
+    if mesh is None:
+        raise MXNetError(
+            f"{who} needs a mesh: pass mesh=, or install an ambient one "
+            "(parallel.mesh.set_current_mesh / use_mesh / "
+            "MXNET_MESH_BATCH / MXNET_MESH_MODEL)")
+    return mesh
+
+
+def ring_attention_sharded(q, k, v, mesh: Optional[Mesh] = None,
+                           axis_name: str = "sp",
                            causal: bool = False,
                            scale: Optional[float] = None):
     """Convenience wrapper: shard (B,H,T,D) arrays on T and run the ring."""
+    mesh = _resolve(mesh, "ring_attention_sharded")
     return _sharded_fn("ring", mesh, axis_name, bool(causal), scale)(q, k, v)
 
 
@@ -269,9 +285,11 @@ def ulysses_attention(q, k, v, axis_name: str = "sp", causal: bool = False,
     return out
 
 
-def ulysses_attention_sharded(q, k, v, mesh: Mesh, axis_name: str = "sp",
+def ulysses_attention_sharded(q, k, v, mesh: Optional[Mesh] = None,
+                              axis_name: str = "sp",
                               causal: bool = False,
                               scale: Optional[float] = None):
+    mesh = _resolve(mesh, "ulysses_attention_sharded")
     return _sharded_fn("ulysses", mesh, axis_name, bool(causal),
                        scale)(q, k, v)
 
@@ -301,7 +319,8 @@ class sp_scope:
     """Context manager declaring the mesh (and axis name) that
     impl='ring'/'ulysses' attention ops shard the sequence over."""
 
-    def __init__(self, mesh: Mesh, axis_name: str = "sp"):
+    def __init__(self, mesh: Optional[Mesh] = None, axis_name: str = "sp"):
+        mesh = _resolve(mesh, "sp_scope")
         if axis_name not in mesh.axis_names:
             raise MXNetError(
                 f"sp_scope: mesh has axes {mesh.axis_names}, no "
