@@ -6,7 +6,7 @@ SRCS := src/runtime/storage.cc src/runtime/engine.cc \
         src/runtime/recordio.cc src/runtime/prefetch.cc
 LIB := mxnet_tpu/_native/libmxtpu_runtime.so
 
-.PHONY: native test chaos chaos-train chaos-serve lint-graft autotune-smoke shard-smoke report clean cpp_example predict_capi capi_example
+.PHONY: native test chaos chaos-train chaos-serve lint-graft autotune-smoke shard-smoke decode-smoke report clean cpp_example predict_capi capi_example
 
 native: $(LIB)
 
@@ -126,6 +126,16 @@ autotune-smoke:
 	    timeout 60 python -m mxnet_tpu.autotune --smoke --expect-cached \
 	    || rc=$$?; \
 	rm -rf $$tmp; exit $$rc
+
+# continuous-batching decode smoke gate (ISSUE 19,
+# docs/decode_serving.md): mixed-length traffic with per-step
+# join/leave over a warmed (slots, pages) lattice — asserts exactly
+# ONE donated dispatch per decode step, ZERO post-warmup compiles,
+# and every admitted sequence finishing.  (-c import keeps runpy from
+# double-importing the module the serving package already loaded.)
+decode-smoke:
+	JAX_PLATFORMS=cpu timeout 60 python -c "from mxnet_tpu.serving \
+	    import decode; raise SystemExit(decode.main(['--smoke']))"
 
 # GSPMD sharding smoke gate (ISSUE 18, docs/parallel.md): 8 virtual
 # CPU devices, 2-D batch=4,model=2 mesh, whole-step train — asserts

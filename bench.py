@@ -138,6 +138,8 @@ def _emit(partial):
         out["superstep"] = _STATE["superstep"]
     if _STATE.get("sharding") is not None:
         out["sharding"] = _STATE["sharding"]
+    if _STATE.get("decode") is not None:
+        out["decode"] = _STATE["decode"]
     if partial:
         out["partial"] = True
         out["phase"] = _STATE["phase"]
@@ -569,6 +571,108 @@ def _run():
         except Exception as e:  # noqa: BLE001
             _STATE["sharding"] = {
                 "error": "%s: %s" % (type(e).__name__, str(e)[:200])}
+
+    # decode rider (ISSUE 19; MXT_BENCH_DECODE=0 skips): continuous
+    # batching (per-step join/leave) vs request-level coalescing on the
+    # same mixed-length generative traffic — {tokens_per_s, goodput,
+    # p99, kv_evictions, compiles} both ways; the acceptance is
+    # continuous beating coalesced on tokens/s AND p99
+    if os.environ.get("MXT_BENCH_DECODE", "1") != "0":
+        _phase("decode", EPOCH_S)
+        try:
+            _STATE["decode"] = _decode_leg(mx, ctx)
+        except Exception as e:  # noqa: BLE001
+            _STATE["decode"] = {
+                "error": "%s: %s" % (type(e).__name__, str(e)[:200])}
+
+
+def _decode_leg(mx, ctx):
+    """Continuous batching vs request-level coalescing (ISSUE 19) on
+    identical mixed-length generative traffic over the same ToyLM +
+    (slots, pages) lattice.  Coalesced = the old serving shape: a
+    batch of `slots` sequences runs in lockstep until its LONGEST
+    member finishes, then the next batch forms (no joins mid-flight —
+    exactly the rnn/BucketingModule hostage path).  Continuous =
+    DecodeEngine per-step join/leave.  Reports {tokens_per_s, goodput,
+    p99_ms, kv_evictions, compiles} both ways; the durable acceptance
+    is continuous >= coalesced on tokens/s AND p99 (short sequences no
+    longer wait out long ones)."""
+    from mxnet_tpu.observability import metrics as _m
+    from mxnet_tpu.serving import decode as _dec
+
+    slots, page_tokens, max_pages = 4, 8, 8
+    model = _dec.ToyLM(vocab=64, dim=32, window=8)
+    params = model.init_params(seed=0)
+    rs = np.random.RandomState(0)
+    # mixed-length traffic, all arriving at t0: short interactive
+    # sequences interleaved with long generations
+    work = [([int(t) for t in rs.randint(0, 64, size=int(p))], int(n))
+            for p, n in zip(rs.randint(1, 8, size=32),
+                            rs.choice([2, 3, 4, 24, 32], size=32))]
+
+    def _run(continuous):
+        eng = _dec.DecodeEngine(model, params=dict(params), slots=slots,
+                                page_tokens=page_tokens,
+                                max_pages=max_pages,
+                                name="bench_decode")
+        try:
+            c0 = _m.SERVE_COMPILES.value
+            ev0 = _m.DECODE_KV_EVICTIONS.value
+            done_at = {}
+            t0 = time.perf_counter()
+
+            def _submit(i, p, n):
+                f = eng.submit(p, n)
+                f.add_done_callback(
+                    lambda _f, i=i: done_at.setdefault(
+                        i, time.perf_counter()))
+                return f
+
+            futs = []
+            if continuous:
+                # every request is live immediately; joins fill slots
+                # the moment a sequence retires
+                for i, (p, n) in enumerate(work):
+                    futs.append(_submit(i, p, n))
+                eng.drain()
+            else:
+                # request-level coalescing: groups of `slots` run to
+                # the longest member's completion before the next
+                # group is admitted
+                for g in range(0, len(work), slots):
+                    for i, (p, n) in enumerate(work[g:g + slots], g):
+                        futs.append(_submit(i, p, n))
+                    eng.drain()
+            dt = time.perf_counter() - t0
+            toks = sum(len(f.result(timeout=5)) for f in futs)
+            lat_ms = sorted((done_at[i] - t0) * 1e3
+                            for i in range(len(work)))
+            st = eng.stats()
+            return {
+                "tokens_per_s": round(toks / dt, 1),
+                "goodput": round(st["goodput"], 3),
+                "p99_ms": round(
+                    lat_ms[max(0, int(len(lat_ms) * 0.99) - 1)], 1),
+                "p50_ms": round(lat_ms[len(lat_ms) // 2], 1),
+                "kv_evictions": _m.DECODE_KV_EVICTIONS.value - ev0,
+                "compiles": _m.SERVE_COMPILES.value - c0,
+                "steps": st["steps"],
+            }
+        finally:
+            eng.close()
+
+    out = {"sequences": len(work),
+           "slots": slots,
+           "note": "CPU tokens/s; relative continuous-vs-coalesced "
+                   "ordering is the durable claim, device numbers "
+                   "pending chip window"}
+    out["continuous"] = _run(continuous=True)
+    out["coalesced"] = _run(continuous=False)
+    out["continuous_wins"] = bool(
+        out["continuous"]["tokens_per_s"]
+        > out["coalesced"]["tokens_per_s"]
+        and out["continuous"]["p99_ms"] < out["coalesced"]["p99_ms"])
+    return out
 
 
 def _gluon_trainer_leg(mx, ctx):

@@ -1030,6 +1030,10 @@ _JIT_CHOKEPOINTS = (
     "mxnet_tpu/gluon/parameter.py",
     "mxnet_tpu/optimizer.py",
     "mxnet_tpu/serving/predictor.py",
+    # continuous-batching decode: ONE module-lifetime jit closure per
+    # engine, AOT-compiled per (slots, pages) lattice key in
+    # precompile() and captured via note_program("decode_step")
+    "mxnet_tpu/serving/decode.py",
     "mxnet_tpu/predictor.py",
     "mxnet_tpu/module/module.py",
     "mxnet_tpu/ops/registry.py",

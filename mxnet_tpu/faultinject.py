@@ -25,8 +25,20 @@ docs/serving_resilience.md):
   ``serving.hot_reload``  ``BucketedPredictor.hot_reload`` entry (raise =
                           failed weight swap; auto-reload keeps old weights
                           and counts ``mxnet_serve_reload_failures_total``)
-  ``serving.evict``       ``ModelRegistry`` LRU eviction, once per victim
-                          (bucket or model) BEFORE any state is dropped —
+  ``serving.decode_step``  ``DecodeEngine.step`` — continuous-batching
+                          decode, fired inside the ``decode_step``
+                          flight span BEFORE the donated dispatch
+                          (raise = a failed step mid-generation with
+                          every sequence's state intact, so a retried
+                          ``step()`` resumes bitwise; delay = a slow
+                          step feeding the EDF per-step EWMA, so
+                          deadline shedding tightens under injected
+                          slowness) — docs/decode_serving.md
+  ``serving.evict``       ``ModelRegistry`` LRU eviction AND
+                          ``DecodeEngine.release_kv_pages`` (KV-page
+                          arbiter reclaim), once per reclaim
+                          (bucket, model, or a sequence's KV pages)
+                          BEFORE any state is dropped —
                           delay = slow eviction under churn, raise = a
                           failed eviction the budgeter must skip (the
                           victim stays resident; admission degrades to a
@@ -109,8 +121,9 @@ ENV_VAR = "MXNET_FAULT_PLAN"
 #: the named sites the runtime has wired (fire() accepts any name — new
 #: sites need no registration — but these are the documented ones)
 SITES = ("serving.dispatch", "serving.batcher", "serving.hot_reload",
-         "serving.evict", "checkpoint.io", "memory.oom", "trainer.step",
-         "data.batch", "kvstore.allreduce", "device.unavailable")
+         "serving.evict", "serving.decode_step", "checkpoint.io",
+         "memory.oom", "trainer.step", "data.batch",
+         "kvstore.allreduce", "device.unavailable")
 
 _MODES = ("raise", "delay", "corrupt")
 

@@ -260,6 +260,37 @@ class BucketingModule(BaseModule):
         finally:
             self._active, self._active_key = held, held_key
 
+    # -- generative decode --------------------------------------------------
+    def attach_decode_engine(self, engine) -> None:
+        """Route this module's generation through a continuous-batching
+        ``serving.decode.DecodeEngine`` (per-step join/leave, paged KV,
+        EDF shedding).  The engine owns its own decode model/params —
+        build one with ``serving.decode.CellModel`` over a steppable
+        rnn cell to serve the cell family this module trains."""
+        self._decode_engine = engine
+
+    def generate(self, prompt, max_new_tokens, **kw):
+        """Generate ``max_new_tokens`` greedy tokens after ``prompt``
+        (a token-id sequence) through the attached decode engine.
+
+        Without an attached engine this raises a typed
+        ``GenerativeRouteError`` instead of falling back to per-bucket
+        ``forward`` loops or the request-coalescing serving tier —
+        generation riding either path pins a whole batch for one
+        sequence's full output length (the hostage path this method
+        closes; regression-pinned in tests/test_decode.py)."""
+        from ..serving.decode import GenerativeRouteError
+        eng = getattr(self, "_decode_engine", None)
+        if eng is None:
+            raise GenerativeRouteError(
+                "BucketingModule has no decode engine attached — "
+                "generation must not ride the bucketed forward path "
+                "(one sequence would hold a whole padded batch for "
+                "its full output length).  attach_decode_engine("
+                "serving.decode.DecodeEngine(...)) first; see "
+                "docs/decode_serving.md")
+        return eng.generate(prompt, max_new_tokens, **kw)
+
     # -- compute ------------------------------------------------------------
     def forward(self, data_batch, is_train=None):
         self._require(params=True)

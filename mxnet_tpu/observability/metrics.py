@@ -552,6 +552,39 @@ SERVE_RELOAD_FAILURES = Counter(
     "dir, failed weight swap).  Each one kept serving the OLD weights; "
     "a climbing counter means the training->serving pipeline is broken "
     "while the replica still looks healthy")
+DECODE_STEPS = Counter(
+    "mxnet_decode_steps_total",
+    "Continuous-batching decode steps (serving.DecodeEngine) — each is "
+    "exactly ONE donated XLA dispatch over the whole in-flight slot "
+    "set; compare against dispatch_counts()['decode'] to catch a step "
+    "that silently multi-dispatched")
+DECODE_TOKENS = Counter(
+    "mxnet_decode_tokens_total",
+    "Tokens generated by continuous-batching decode (prompt-consuming "
+    "steps excluded)")
+DECODE_KV_EVICTIONS = Counter(
+    "mxnet_decode_kv_evictions_total",
+    "Sequences whose paged KV state was reclaimed under HBM pressure "
+    "(typed SequenceEvicted with retry-after to the caller).  KV pages "
+    "are the CHEAPEST victims in the multi-model eviction ladder — "
+    "churn here under a tight MXNET_HBM_BUDGET_MB is the design, a "
+    "generative tenant bending before any classifier's weights do")
+DECODE_INFLIGHT = Gauge(
+    "mxnet_decode_inflight_sequences",
+    "Sequences currently holding a decode slot (joined, not yet "
+    "finished/retired) — refreshed every decode step")
+DECODE_KV_OCCUPANCY = Gauge(
+    "mxnet_decode_kv_page_occupancy",
+    "Fraction of the currently-routed KV page lattice key's token "
+    "capacity holding live sequence state.  Persistently low means the "
+    "lattice is over-provisioned for the traffic (shrink "
+    "MXNET_DECODE_SLOTS / MXNET_DECODE_MAX_PAGES)")
+DECODE_TOKENS_PER_S = Gauge(
+    "mxnet_decode_tokens_per_second",
+    "Instantaneous decode throughput: active sequences advanced by the "
+    "most recent step / its wall-clock (continuous batching's win over "
+    "request-level coalescing is exactly this gauge under mixed-length "
+    "traffic — the bench.py decode rider pins it)")
 FAULTS_INJECTED = Counter(
     "mxnet_faults_injected_total",
     "Faults fired by the mxnet_tpu.faultinject harness, by site and "
@@ -982,6 +1015,18 @@ def snapshot() -> dict:
                 sorted(list(SERVE_MODEL_HBM_BYTES._children.items()))},
             # exemplar hop: p99 bucket -> trace_id -> flight dump spans
             "latency_exemplars": SERVE_LATENCY_SECONDS.exemplars(),
+            # continuous-batching decode (docs/decode_serving.md):
+            # steps == dispatch_counts()['decode'] is the 1-dispatch
+            # contract; kv_evictions is the budget arbiter choosing
+            # pages over weights
+            "decode": {
+                "steps": DECODE_STEPS.value,
+                "tokens": DECODE_TOKENS.value,
+                "inflight": DECODE_INFLIGHT.get(),
+                "kv_page_occupancy": DECODE_KV_OCCUPANCY.get(),
+                "tokens_per_s": DECODE_TOKENS_PER_S.get(),
+                "kv_evictions": DECODE_KV_EVICTIONS.value,
+            },
         },
         "flight": _flight_snapshot(),
         "goodput": _goodput_snapshot(),

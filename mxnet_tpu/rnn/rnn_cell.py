@@ -46,6 +46,17 @@ class BaseRNNCell:
         raise NotImplementedError
 
     @property
+    def steppable(self):
+        """True when ``cell(x, states)`` emits ONE token step — the
+        contract continuous-batching decode needs
+        (``serving.decode.CellModel`` builds its donated per-step
+        program from exactly that one-step Symbol).  Whole-sequence
+        cells (fused, bidirectional) override to False and are
+        rejected with a typed ``GenerativeRouteError`` instead of
+        silently serving at request granularity."""
+        return True
+
+    @property
     def params(self):
         self._own_params = False
         return self._params
@@ -283,6 +294,13 @@ class FusedRNNCell(BaseRNNCell):
                                         bidirectional, forget_bias))
 
     @property
+    def steppable(self):
+        # the fused op consumes a whole (T, N, C) sequence in one
+        # lax.scan — no single-token step exists; unfuse() yields a
+        # stack of steppable per-layer cells for decode serving
+        return False
+
+    @property
     def state_info(self):
         b = self._bidirectional + 1
         n = (self._mode == "lstm") + 1
@@ -511,6 +529,12 @@ class BidirectionalCell(BaseRNNCell):
 
     def __call__(self, inputs, states):
         raise MXNetError("Bidirectional cannot be stepped. Please use unroll")
+
+    @property
+    def steppable(self):
+        # needs the future half of the sequence — meaningless at
+        # decode time, where the future is what's being generated
+        return False
 
     @property
     def state_info(self):

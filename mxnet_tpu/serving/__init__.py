@@ -50,6 +50,9 @@ from . import resilience
 from .resilience import DeadlineExceeded, Overloaded, ResilientServer
 from . import registry
 from .registry import ModelRegistry, ModelUnavailable
+from . import decode
+from .decode import (CellModel, DecodeEngine, GenerativeRouteError,
+                     SequenceEvicted, ToyLM)
 
 __all__ = ["BucketSpec", "BucketedPredictor", "MicroBatcher",
            "ResilientServer", "Overloaded", "DeadlineExceeded",
@@ -57,4 +60,5 @@ __all__ = ["BucketSpec", "BucketedPredictor", "MicroBatcher",
            "resilience", "covering_bucket", "pad_to_shape",
            "parse_bucket_env", "pow2_buckets", "stack_requests",
            "registry", "ModelRegistry", "ModelUnavailable",
-           "ModelEvictedError"]
+           "ModelEvictedError", "decode", "DecodeEngine", "ToyLM",
+           "CellModel", "GenerativeRouteError", "SequenceEvicted"]

@@ -33,8 +33,8 @@ from ..observability import metrics as _metrics
 from .buckets import covering_bucket, pad_to_shape
 
 __all__ = ["MicroBatcher", "BatcherClosedError", "BatcherDeadError",
-           "stack_requests", "record_group_queue_wait",
-           "group_trace_scope"]
+           "GenerativeRouteError", "stack_requests",
+           "record_group_queue_wait", "group_trace_scope"]
 
 
 def record_group_queue_wait(group, t_dispatch_us: float) -> None:
@@ -53,6 +53,16 @@ def group_trace_scope(group):
     member request (single-request group: its id verbatim)."""
     return _flight.trace_scope(
         _flight.join_ids([r.trace_id for r in group]))
+
+
+class GenerativeRouteError(MXNetError):
+    """A generative (multi-token decode) request reached the
+    request-coalescing tier.  Refused LOUDLY by design: one long
+    generation would pin its whole coalesced micro-batch group for its
+    full output length (the `rnn/` + BucketingModule hostage path) —
+    route generation through `serving.decode.DecodeEngine`, which
+    admits and retires sequences per decode STEP (continuous batching,
+    docs/decode_serving.md)."""
 
 
 class BatcherClosedError(MXNetError):
@@ -169,7 +179,8 @@ class MicroBatcher:
         self._thread.start()
 
     # -- client side ---------------------------------------------------------
-    def submit(self, **inputs) -> Future:
+    def submit(self, max_new_tokens: Optional[int] = None,
+               **inputs) -> Future:
         """Enqueue one request; resolves to the list of output arrays
         (rows matching this request).  Never blocks on model execution:
         oversized requests ride the dispatcher thread too (dispatched
@@ -184,6 +195,17 @@ class MicroBatcher:
         the same request would route to solo.  Consumers slice by their
         request's true sequence length (valid-region values are
         identical either way; docs/inference.md)."""
+        if max_new_tokens is not None:
+            # raised in the CALLER's thread, not failed on the future:
+            # this is a routing bug at the call site, and the hostage
+            # path it would reintroduce (regression-pinned in
+            # tests/test_decode.py) must never be one silent drop away
+            raise GenerativeRouteError(
+                f"max_new_tokens={max_new_tokens}: generative decode "
+                f"must not ride the request-coalescing micro-batcher — "
+                f"one long sequence would hold its whole coalesced "
+                f"group hostage.  Use serving.decode.DecodeEngine "
+                f"(per-step join/leave) or BucketingModule.generate")
         try:
             # normalization can fail too (unknown input name, empty
             # request) — every malformed-request shape must land on the

@@ -27,7 +27,8 @@ from ..autotune import decisions as _decisions
 from ..base import MXNetError
 
 __all__ = ["pow2_buckets", "parse_bucket_env", "covering_bucket",
-           "pad_to_shape", "BucketSpec", "observed_traffic"]
+           "pad_to_shape", "BucketSpec", "observed_traffic",
+           "page_lattice"]
 
 # -- observed shape traffic (the autotune lattice feed) ----------------------
 #: bounded ring of request batch sizes seen by BucketSpec.route — what
@@ -112,6 +113,31 @@ def pad_to_shape(arr: _np.ndarray, shape: Tuple[int, ...]) -> _np.ndarray:
     out = _np.zeros(shape, dtype=arr.dtype)
     out[tuple(slice(0, d) for d in arr.shape)] = arr
     return out
+
+
+def page_lattice(max_slots: int, max_pages: int, slot_buckets=None,
+                 page_buckets=None) -> "BucketSpec":
+    """The (slots, pages) lattice continuous-batching decode routes
+    over (`serving.decode.DecodeEngine`): axis 0 is decode SLOTS
+    (concurrent sequences), the seq axis is KV PAGES — so one stock
+    `BucketSpec` covers mixed-length generation the same way it covers
+    mixed-size inference batches, and a sequence growing across a page
+    boundary re-routes to a neighbouring precompiled key instead of
+    compiling.  Explicit pow2 ladders are always passed down: the
+    decode lattice is engine geometry (`MXNET_DECODE_*`), deliberately
+    decoupled from the request-path `MXNET_SERVE_BUCKETS` pins and the
+    autotuned serving lattice."""
+    if max_slots < 1 or max_pages < 1:
+        raise MXNetError(
+            f"page_lattice needs max_slots/max_pages >= 1, got "
+            f"({max_slots}, {max_pages})")
+    return BucketSpec(
+        {"kv": (max_slots, max_pages)},
+        batch_buckets=list(slot_buckets) if slot_buckets
+        else pow2_buckets(max_slots),
+        seq_axes={"kv": 1},
+        seq_buckets=list(page_buckets) if page_buckets
+        else pow2_buckets(max_pages))
 
 
 class BucketSpec:

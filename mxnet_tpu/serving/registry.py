@@ -529,6 +529,20 @@ class ModelRegistry:
                 return ((t0 - _memory.tracked_bytes())
                         + (c0 - self._committed_bytes()))
 
+            # phase 0: decode KV pages — the CHEAPEST victims in the
+            # ladder (an evicted sequence retries with a typed
+            # retry-after; an evicted bucket recompiles, an evicted
+            # model re-uploads weights).  Lazy import: decode never
+            # imports the registry, so no cycle — and a process with
+            # no engine alive pays one cached-import check
+            if _freed() < deficit:
+                try:
+                    from . import decode as _decode
+                    _decode.reclaim_kv_pages(deficit - _freed(),
+                                             why=why)
+                except Exception as e:  # noqa: BLE001 — best-effort
+                    log.debug("decode KV reclaim skipped: %s", str(e))
+
             # phase 1: cold buckets — cheapest churn (a readmission is
             # a persistent-cache hit, the weights never move)
             cands = []

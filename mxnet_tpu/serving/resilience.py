@@ -62,7 +62,7 @@ from .batcher import (BatcherClosedError, BatcherDeadError,
 log = logging.getLogger(__name__)
 
 __all__ = ["Overloaded", "DeadlineExceeded", "ResilientServer",
-           "SHED_POLICIES"]
+           "SHED_POLICIES", "StepEDF"]
 
 SHED_POLICIES = ("depth", "deadline")
 
@@ -83,6 +83,52 @@ class DeadlineExceeded(MXNetError):
     """An admitted request's deadline passed while it waited in queue.
     The work was dropped BEFORE padding/dispatch — the accelerator
     never burns a cycle on an answer nobody is waiting for."""
+
+
+class StepEDF:
+    """Earliest-deadline-first estimator at DECODE-STEP granularity —
+    the generative twin of `_estimate_wait_s`'s whole-request EWMA.
+
+    A generation's cost is `remaining tokens x per-step seconds`, not
+    one dispatch, so request-level deadline shedding either admits
+    hopeless sequences (burning decode steps on answers that will
+    expire) or sheds meetable ones.  `DecodeEngine` feeds every step's
+    wall-clock into the EWMA and asks two questions: at ADMISSION,
+    whether the deadline clears the ETA behind the queued token
+    backlog; BETWEEN STEPS, whether an in-flight sequence's remaining
+    tokens still fit before its deadline (`unmeetable` — preempted
+    typed only when admitted work is waiting to take the slot)."""
+
+    #: conservative prior before any observation (CPU-ish step cost);
+    #: EWMA converges within ~10 steps either direction
+    PRIOR_S = 0.01
+
+    def __init__(self, alpha: float = 0.2):
+        self._alpha = float(alpha)
+        self._ewma: Optional[float] = None
+
+    def observe(self, step_s: float) -> None:
+        """Fold one measured decode-step wall-clock into the EWMA."""
+        step_s = max(0.0, float(step_s))
+        self._ewma = step_s if self._ewma is None else \
+            (1 - self._alpha) * self._ewma + self._alpha * step_s
+
+    def step_s(self) -> float:
+        """Current per-decode-step estimate (prior until observed)."""
+        return self.PRIOR_S if self._ewma is None else self._ewma
+
+    def eta_s(self, tokens: int, lanes: int = 1) -> float:
+        """Estimated seconds to decode `tokens` more tokens with
+        `lanes` slots advancing one token per step each."""
+        return (max(0, int(tokens)) / max(1, int(lanes))) * self.step_s()
+
+    def unmeetable(self, deadline: Optional[float], now: float,
+                   remaining_tokens: int) -> bool:
+        """True when `remaining_tokens` more steps cannot finish before
+        `deadline` (absolute perf_counter time; None = no deadline)."""
+        if deadline is None:
+            return False
+        return now + self.eta_s(remaining_tokens) > deadline
 
 
 class _Request:
@@ -262,6 +308,7 @@ class ResilientServer:
     # -- client side ---------------------------------------------------------
     def submit(self, tenant: str = "default",
                deadline_ms: Optional[float] = None, priority: int = 0,
+               max_new_tokens: Optional[int] = None,
                **inputs) -> Future:
         """Enqueue one request for ``tenant``.
 
@@ -271,6 +318,17 @@ class ResilientServer:
         request fails its own returned future (MicroBatcher contract).
         An admitted request resolves to its output rows, or to
         ``DeadlineExceeded`` if its deadline passes before dispatch."""
+        if max_new_tokens is not None:
+            # same loud refusal as MicroBatcher.submit: a generation
+            # here would hold a coalesced group hostage for its whole
+            # output length — route it to continuous batching
+            from .batcher import GenerativeRouteError
+            raise GenerativeRouteError(
+                f"max_new_tokens={max_new_tokens}: generative decode "
+                f"must not ride the request-coalescing tier — use "
+                f"serving.decode.DecodeEngine (per-step join/leave, "
+                f"EDF at decode-step granularity) or "
+                f"BucketingModule.generate")
         try:
             self._pred._check_names(inputs)
             host = {n: self._pred._as_host(n, v)

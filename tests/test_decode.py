@@ -1,0 +1,505 @@
+"""Continuous-batching decode serving (mxnet_tpu.serving.decode).
+
+The ISSUE 19 acceptance invariants this file pins:
+
+  * per-step join/leave is CORRECTNESS-NEUTRAL: a sequence's tokens are
+    bitwise identical whether it decoded alone or joined/left a churning
+    batch mid-flight (slot independence of the model contract);
+  * ONE donated XLA dispatch per decode step, regardless of admission /
+    retirement churn inside the step — and `audit_programs` confirms the
+    donation really became input-output aliasing in the compiled HLO;
+  * page-lattice growth re-routes between AOT-compiled keys: a sequence
+    crossing page boundaries adds ZERO new `SERVE_COMPILES`;
+  * KV pages are an evictable serving resource: reclaim fails the victim
+    sequences with a typed `SequenceEvicted` carrying `retry_after_s`,
+    never a silent hang, and the engine keeps serving;
+  * EDF over remaining-token estimates sheds at decode-step granularity
+    (admission shed, queued expiry, mid-flight preemption) — all typed;
+  * an engine close returns every `serve_kv_pages` / `serve_weights`
+    ledger byte to baseline (the leak gate);
+  * the hostage paths stay closed: `MicroBatcher.submit` /
+    `ResilientServer.submit` / un-attached `BucketingModule.generate`
+    refuse `max_new_tokens` with a typed `GenerativeRouteError`.
+"""
+import gc
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import faultinject as fi
+from mxnet_tpu import rnn, serving, sym
+from mxnet_tpu import observability as obs
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.observability import memory
+from mxnet_tpu.observability import metrics as m
+from mxnet_tpu.serving import (DeadlineExceeded, Overloaded,
+                               ResilientServer)
+from mxnet_tpu.serving import decode
+from mxnet_tpu.serving.decode import (CellModel, DecodeEngine,
+                                      GenerativeRouteError,
+                                      SequenceEvicted, ToyLM)
+
+
+# -- helpers -----------------------------------------------------------------
+
+def _engine(slots=4, page_tokens=4, max_pages=4, vocab=32, dim=8,
+            window=4, **kw):
+    """Small ToyLM engine; warmup=True unless overridden, so traffic
+    measurements start from a fully compiled lattice."""
+    return DecodeEngine(ToyLM(vocab=vocab, dim=dim, window=window),
+                        slots=slots, page_tokens=page_tokens,
+                        max_pages=max_pages, **kw)
+
+
+def _solo_tokens(prompt, max_new, **kw):
+    """Ground truth: the sequence decoded alone in a fresh engine."""
+    with _engine(warmup=False, **kw) as eng:
+        return eng.generate(prompt, max_new)
+
+
+def _collect():
+    gc.collect()
+    memory.tracked_bytes()  # drain the ledger death-callback queue
+
+
+PROMPTS = [[1], [2, 3], [4, 5, 6], [7], [8, 9], [10, 11, 12, 13]]
+MAX_NEW = [3, 5, 2, 6, 4, 3]
+
+
+# -- correctness: join/leave is bitwise-neutral ------------------------------
+
+def test_solo_generation_deterministic():
+    a = _solo_tokens([1, 2], 4)
+    b = _solo_tokens([1, 2], 4)
+    assert len(a) == 4
+    assert a == b
+
+
+def test_join_leave_bitwise_vs_solo():
+    """Sequences admitted mid-flight into a churning batch (others
+    joining and retiring around them) produce EXACTLY the tokens they
+    produce decoding alone — the whole point of slot-independent
+    per-step batching."""
+    expect = [_solo_tokens(p, n) for p, n in zip(PROMPTS, MAX_NEW)]
+    with _engine(warmup=False) as eng:
+        futs = []
+        pending = list(zip(PROMPTS, MAX_NEW))
+        # staggered admission: 2 up front, one more every 2 steps —
+        # every sequence sees a different batch composition per step
+        futs.append(eng.submit(*pending.pop(0)))
+        futs.append(eng.submit(*pending.pop(0)))
+        while pending:
+            eng.step()
+            eng.step()
+            p, n = pending.pop(0)
+            futs.append(eng.submit(p, n))
+        eng.drain()
+        got = [f.result(timeout=10) for f in futs]
+    assert got == expect
+
+
+def test_eos_stops_generation_early():
+    first = _solo_tokens([3, 1], 5)[0]
+    with _engine(warmup=False, eos=first) as eng:
+        out = eng.generate([3, 1], 5)
+    assert out == [first]   # eos token emitted, then the slot freed
+
+
+# -- perf gates: 1 dispatch/step, compile-free growth ------------------------
+
+@pytest.mark.perf_smoke
+def test_one_dispatch_per_step_under_churn():
+    """Exactly one `kind="decode"` XLA launch per decode step while
+    sequences join and leave between steps, and ZERO compiles under
+    traffic after warmup — SERVE_COMPILES stays flat."""
+    with _engine() as eng:    # warmup compiles the whole lattice
+        launches0 = m.XLA_LAUNCHES.get(kind="decode")
+        compiles0 = m.SERVE_COMPILES.value
+        futs = [eng.submit(p, n) for p, n in
+                list(zip(PROMPTS, MAX_NEW))[:3]]
+        eng.step(); eng.step()
+        futs += [eng.submit(p, n) for p, n in
+                 list(zip(PROMPTS, MAX_NEW))[3:]]
+        eng.drain()
+        for f in futs:
+            f.result(timeout=10)
+        st = eng.stats()
+        assert st["steps"] > 0
+        assert m.XLA_LAUNCHES.get(kind="decode") - launches0 \
+            == st["steps"]
+        assert m.SERVE_COMPILES.value == compiles0, \
+            "decode traffic escaped the AOT-compiled lattice"
+
+
+@pytest.mark.perf_smoke
+def test_page_lattice_growth_without_recompile():
+    """A sequence growing across page boundaries re-routes to larger
+    lattice keys (the key visibly changes) with ZERO new compiles."""
+    with _engine(slots=2, page_tokens=4, max_pages=4) as eng:
+        compiles0 = m.SERVE_COMPILES.value
+        fut = eng.submit([1, 2], 12)       # 14 tokens: 4 -> 8 -> 16
+        keys = set()
+        while not fut.done():
+            eng.step()
+            k = eng.stats()["key"]
+            if k is not None:
+                keys.add(k)
+        assert len(fut.result(timeout=10)) == 12
+        assert len({k[1] for k in keys}) >= 2, \
+            f"page axis never grew across keys: {sorted(keys)}"
+        assert m.SERVE_COMPILES.value == compiles0
+
+
+def test_warmup_compiles_lattice_once():
+    with _engine(warmup=False) as eng:
+        compiles0 = m.SERVE_COMPILES.value
+        n = eng.warmup()
+        assert n == len(list(eng.spec.all_keys()))
+        assert m.SERVE_COMPILES.value - compiles0 == n
+        eng.warmup()   # idempotent: cached keys compile nothing
+        assert m.SERVE_COMPILES.value - compiles0 == n
+
+
+# -- donation audit ----------------------------------------------------------
+
+@pytest.mark.program_audit
+def test_decode_step_donation_is_aliased(program_audit):
+    """The decode-step executable's declared contracts hold against its
+    captured HLO: state donation became real input-output aliasing
+    (both ToyLM leaves: `h` and the paged `kv`), no host callbacks, no
+    collectives."""
+    from mxnet_tpu.serving.buckets import bucket_label
+    with _engine(warmup=False) as eng:
+        eng.generate([1, 2], 3)
+        # only THIS engine's keys compiled under the armed capture —
+        # other tests may have filed decode programs without HLO
+        progs = [f"decode_step:{bucket_label(k)}"
+                 for k in eng._ever_compiled]
+        assert progs
+        for name in progs:
+            program_audit(name, min_aliased=2)
+
+
+# -- typed admission control and EDF shedding --------------------------------
+
+def test_over_capacity_submit_rejected_typed():
+    with _engine(page_tokens=4, max_pages=2) as eng:   # capacity 8
+        with pytest.raises(MXNetError, match="capacity"):
+            eng.submit([1, 2], 8)
+        assert eng.generate([1, 2], 6) is not None  # 8 tokens fits
+
+
+def test_queue_full_shed_typed_overloaded():
+    with _engine(warmup=False, max_queue=1) as eng:
+        eng.submit([1], 2)
+        with pytest.raises(Overloaded) as ei:
+            eng.submit([2], 2)
+        assert ei.value.retry_after_s >= 0.0
+        assert eng.stats()["shed"] == 1
+
+
+def test_edf_admission_shed_unmeetable_deadline():
+    """Policy `deadline`: a submit whose remaining-tokens x step-EWMA
+    estimate already exceeds its deadline is shed synchronously typed —
+    rejecting in microseconds beats decoding tokens nobody can use."""
+    with _engine(warmup=False, shed_policy="deadline") as eng:
+        for _ in range(8):
+            eng._edf.observe(0.05)         # established 50ms steps
+        with pytest.raises(Overloaded, match="unmeetable"):
+            eng.submit([1], 10, deadline_ms=20.0)   # needs ~500ms
+        # the same request with headroom admits fine
+        fut = eng.submit([1], 10, deadline_ms=60000.0)
+        eng.drain()
+        assert len(fut.result(timeout=10)) == 10
+
+
+def test_edf_depth_policy_never_deadline_sheds():
+    with _engine(warmup=False, shed_policy="depth") as eng:
+        for _ in range(8):
+            eng._edf.observe(0.05)
+        fut = eng.submit([1], 10, deadline_ms=20.0)  # admitted anyway
+        assert fut is not None
+
+
+def test_midflight_deadline_expiry_typed():
+    # depth policy so admission does not EDF-shed the doomed request —
+    # this test pins the BETWEEN-STEPS expiry path
+    with _engine(warmup=False, shed_policy="depth") as eng:
+        fut = eng.submit([1, 2], 12, deadline_ms=15.0)
+        eng.step()                       # in flight
+        time.sleep(0.03)                 # deadline passes mid-decode
+        eng.step()                       # expiry runs between steps
+        with pytest.raises(DeadlineExceeded):
+            fut.result(timeout=10)
+        assert eng.stats()["expired"] == 1
+
+
+def test_midflight_preemption_when_unmeetable_and_work_waiting():
+    """Decode-step-granularity EDF: an active whose deadline the EWMA
+    says is hopeless is preempted typed — but only when admitted work
+    is waiting for its slot (idle capacity decodes on)."""
+    with _engine(warmup=False, slots=1) as eng:
+        fut_a = eng.submit([1], 8, deadline_ms=1000.0)
+        eng.step()                       # A holds the only slot
+        for _ in range(8):
+            eng._edf.observe(0.5)        # 7 steps x 500ms >> deadline
+        fut_b = eng.submit([2], 2)       # B waits on A's slot
+        eng.step()
+        with pytest.raises(DeadlineExceeded, match="preempted"):
+            fut_a.result(timeout=10)
+        eng.drain()
+        assert len(fut_b.result(timeout=10)) == 2
+
+
+# -- KV pages as an evictable resource ---------------------------------------
+
+def test_kv_eviction_typed_retry_after():
+    """`release_kv_pages` reclaims real ledger bytes; each victim fails
+    typed `SequenceEvicted` (an `Overloaded`) with a retry-after hint —
+    never a hung future — and the engine keeps serving afterwards."""
+    with _engine(warmup=False) as eng:
+        ev0 = m.DECODE_KV_EVICTIONS.value
+        fut = eng.submit([1, 2], 10)
+        eng.step(); eng.step()
+        assert eng.stats()["kv_bytes"] > 0
+        freed = eng.release_kv_pages(float(2 ** 40), why="test")
+        assert freed > 0
+        with pytest.raises(SequenceEvicted) as ei:
+            fut.result(timeout=10)
+        assert isinstance(ei.value, Overloaded)
+        assert ei.value.retry_after_s >= 0.05
+        assert m.DECODE_KV_EVICTIONS.value - ev0 == 1
+        assert eng.stats()["kv_bytes"] == 0
+        # the typed contract is a RETRY hint: resubmission works
+        assert len(eng.generate([1, 2], 3)) == 3
+
+
+def test_reclaim_kv_pages_module_hook_finds_live_engines():
+    """The arbiter-facing module hook (`registry._make_room` phase 0)
+    reaches every live engine through the weak registry."""
+    with _engine(warmup=False, name="hooked") as eng:
+        assert eng in decode.live_engines()
+        eng.submit([1], 10)
+        eng.step()
+        assert decode.reclaim_kv_pages(float(2 ** 40), why="hook") > 0
+        assert eng.stats()["kv_bytes"] == 0
+    assert all(e is not eng for e in decode.live_engines())
+
+
+def test_partial_reclaim_shrinks_not_drops():
+    """A small deficit evicts only enough victims to shrink onto a
+    smaller lattice key — survivors keep decoding to completion."""
+    with _engine(slots=4, page_tokens=4, max_pages=2,
+                 warmup=False) as eng:
+        futs = [eng.submit([i + 1], 6) for i in range(4)]
+        eng.step()
+        bytes_full = eng.stats()["kv_bytes"]
+        # one slot-bucket down (4 -> 2 slots) is half the state
+        freed = eng.release_kv_pages(bytes_full / 4, why="partial")
+        assert 0 < freed < bytes_full
+        eng.drain()
+        outcomes = {"ok": 0, "evicted": 0}
+        for f in futs:
+            try:
+                assert len(f.result(timeout=10)) == 6
+                outcomes["ok"] += 1
+            except SequenceEvicted:
+                outcomes["evicted"] += 1
+        assert outcomes["ok"] >= 1 and outcomes["evicted"] >= 1, outcomes
+
+
+# -- ledger hygiene ----------------------------------------------------------
+
+@pytest.mark.memory
+def test_ledger_leak_gate_on_close():
+    """An engine lifecycle (admit, decode across page growth, evict,
+    close) returns every `serve_kv_pages` and `serve_weights` ledger
+    byte to baseline."""
+    _collect()
+    kv0 = memory.live_by_tag().get(decode.KV_TAG, 0)
+    w0 = memory.live_by_tag().get("serve_weights", 0)
+    eng = _engine(warmup=False)
+    futs = [eng.submit(p, n) for p, n in zip(PROMPTS[:3], MAX_NEW[:3])]
+    eng.step(); eng.step()
+    assert memory.live_by_tag().get(decode.KV_TAG, 0) > kv0
+    eng.release_kv_pages(1.0, why="leak-gate")
+    eng.drain()
+    eng.close()
+    for f in futs:
+        assert f.done()          # close never leaves a hung future
+    del eng, futs
+    _collect()
+    assert memory.live_by_tag().get(decode.KV_TAG, 0) == kv0
+    assert memory.live_by_tag().get("serve_weights", 0) == w0
+
+
+def test_closed_engine_is_typed_everywhere():
+    eng = _engine(warmup=False)
+    fut = eng.submit([1], 5)
+    eng.close()
+    with pytest.raises(decode.DecodeClosedError):
+        fut.result(timeout=10)
+    with pytest.raises(decode.DecodeClosedError):
+        eng.submit([1], 2)
+    with pytest.raises(decode.DecodeClosedError):
+        eng.step()
+    eng.close()   # idempotent
+
+
+# -- chaos: the serving.decode_step site -------------------------------------
+
+@pytest.mark.chaos
+def test_faultinject_decode_step_raise_then_retry_resumes_bitwise():
+    """A raise rule at `serving.decode_step` fails the step typed
+    BEFORE the donated dispatch — sequence state is fully intact, so
+    retrying `step()` resumes decode and the final tokens are bitwise
+    what an unfaulted run produces."""
+    expect = _solo_tokens([5, 6], 4)
+    with _engine(warmup=False) as eng:
+        launches0 = m.XLA_LAUNCHES.get(kind="decode")
+        fut = eng.submit([5, 6], 4)
+        eng.step()                       # healthy first step
+        plan = fi.FaultPlan().add("serving.decode_step", "raise",
+                                  times=1)
+        with fi.active(plan):
+            with pytest.raises(fi.InjectedFault):
+                eng.step()
+            assert not fut.done()        # typed failure, not a retire
+            eng.drain()                  # retry resumes mid-sequence
+        assert plan.stats()["serving.decode_step"] == 1
+        assert fut.result(timeout=10) == expect
+        # the faulted step never launched: launch count == real steps
+        assert m.XLA_LAUNCHES.get(kind="decode") - launches0 \
+            == eng.stats()["steps"]
+
+
+@pytest.mark.chaos
+def test_faultinject_decode_step_delay_feeds_edf():
+    """A delay rule models a slow decode step; the EDF EWMA absorbs it,
+    so subsequent deadline estimates get honest."""
+    with _engine(warmup=False) as eng:
+        ewma0 = eng.stats()["step_ewma_s"]
+        eng.submit([1], 2)
+        plan = fi.FaultPlan().add("serving.decode_step", "delay",
+                                  delay_s=0.05)
+        with fi.active(plan):
+            eng.step()
+        assert eng.stats()["step_ewma_s"] > ewma0
+
+
+@pytest.mark.chaos
+def test_faultinject_evict_site_fires_on_kv_reclaim():
+    with _engine(warmup=False, name="evt") as eng:
+        eng.submit([1], 6)
+        eng.step()
+        plan = fi.FaultPlan().add("serving.evict", "delay",
+                                  delay_s=0.001)
+        with fi.active(plan):
+            assert eng.release_kv_pages(float(2 ** 40), why="site") > 0
+        assert plan.stats()["serving.evict"] == 1
+
+
+# -- hostage-path regression pins --------------------------------------------
+
+def _mlp_pred(max_batch=4, nin=8):
+    net = sym.FullyConnected(sym.Variable("data"), num_hidden=4,
+                             name="hfc")
+    net = sym.SoftmaxOutput(net, name="softmax")
+    rs = np.random.RandomState(0)
+    arg_shapes, _, _ = net.infer_shape(data=(max_batch, nin))
+    params = {"arg:" + n: mx.nd.array(rs.normal(0, 0.1, s).astype("f"))
+              for n, s in zip(net.list_arguments(), arg_shapes)
+              if n != "data" and not n.endswith("_label")}
+    return serving.BucketedPredictor(net, params,
+                                     {"data": (max_batch, nin)})
+
+
+def test_microbatcher_refuses_generative_submits():
+    """The request-coalescing micro-batcher refuses `max_new_tokens`
+    in the CALLER's thread — the one-long-sequence-holds-the-group
+    hostage path stays closed, loudly."""
+    bat = serving.MicroBatcher(_mlp_pred(), max_wait_ms=1.0)
+    try:
+        with pytest.raises(GenerativeRouteError, match="hostage"):
+            bat.submit(max_new_tokens=4,
+                       data=np.zeros((1, 8), dtype="f"))
+        # non-generative traffic is unaffected
+        out = bat.submit(data=np.ones((2, 8), dtype="f")).result(
+            timeout=30)
+        assert out[0].shape == (2, 4)
+    finally:
+        bat.close()
+
+
+def test_resilient_server_refuses_generative_submits():
+    srv = ResilientServer(_mlp_pred(), watchdog_interval_s=60.0)
+    try:
+        with pytest.raises(GenerativeRouteError):
+            srv.submit(max_new_tokens=3,
+                       data=np.zeros((1, 8), dtype="f"))
+    finally:
+        srv.close()
+
+
+def test_bucketing_module_generate_routes_or_rejects():
+    """`BucketingModule.generate` without an attached engine raises the
+    typed routing error (never a silent per-bucket forward loop); with
+    one attached it IS continuous batching."""
+    def sym_gen(key):
+        net = sym.FullyConnected(sym.Variable("data"), num_hidden=4)
+        return sym.SoftmaxOutput(net, name="softmax"), ("data",), None
+
+    mod = mx.mod.BucketingModule(sym_gen, default_bucket_key=8)
+    with pytest.raises(GenerativeRouteError, match="attach_decode"):
+        mod.generate([1, 2], 4)
+    with _engine(warmup=False) as eng:
+        mod.attach_decode_engine(eng)
+        assert mod.generate([1, 2], 4) == _solo_tokens([1, 2], 4)
+
+
+def test_cell_model_gru_generates_fused_rejected():
+    """The rnn/ family routes through the engine via `CellModel`: a
+    steppable GRUCell generates; a FusedRNNCell (whole-sequence kernel,
+    no one-token step) is rejected typed at adapter construction."""
+    model = CellModel(rnn.GRUCell(8, prefix="dec_"), vocab=16)
+    with DecodeEngine(model, slots=2, page_tokens=4, max_pages=2,
+                      warmup=False) as eng:
+        out = eng.generate([1, 2, 3], 3)
+        assert len(out) == 3
+        assert all(0 <= t < 16 for t in out)
+    with pytest.raises(GenerativeRouteError, match="unfuse"):
+        CellModel(rnn.FusedRNNCell(8, num_layers=1, mode="gru",
+                                   prefix="f_"), vocab=16)
+    with pytest.raises(GenerativeRouteError):
+        CellModel(rnn.BidirectionalCell(
+            rnn.GRUCell(8, prefix="l_"), rnn.GRUCell(8, prefix="r_")),
+            vocab=16)
+
+
+# -- observability surface ---------------------------------------------------
+
+def test_snapshot_serving_has_decode_block():
+    with _engine(warmup=False) as eng:
+        eng.generate([1, 2], 3)
+        snap = obs.snapshot()["serving"]["decode"]
+        for k in ("steps", "tokens", "inflight", "kv_page_occupancy",
+                  "tokens_per_s", "kv_evictions"):
+            assert k in snap, sorted(snap)
+        assert snap["steps"] >= 3
+        assert snap["tokens"] >= 3
+        assert snap["inflight"] == 0.0   # drained
+
+
+def test_stats_and_goodput_accounting():
+    with _engine(warmup=False) as eng:
+        f1 = eng.submit([1], 2)
+        f2 = eng.submit([2], 2)
+        eng.drain()
+        f1.result(timeout=10), f2.result(timeout=10)
+        st = eng.stats()
+        assert st["admitted"] == 2 and st["completed"] == 2
+        assert st["goodput"] == 1.0
+        assert st["tokens"] == 4
+        assert st["inflight"] == 0 and st["waiting"] == 0

@@ -305,6 +305,34 @@ def test_oom_second_chance_evicts_and_retries():
         assert m.SERVE_EVICTIONS.get(kind="model", model="cold") >= 1
 
 
+def test_make_room_reclaims_decode_kv_before_buckets_or_weights():
+    """Ladder phase 0 (ISSUE 19): decode KV pages are the CHEAPEST
+    victims — a deficit the live engines can absorb never touches
+    bucket executables or model weights, and the evicted sequence
+    failed typed with a retry-after instead of hanging."""
+    from mxnet_tpu.serving import DecodeEngine, SequenceEvicted, ToyLM
+    with ModelRegistry(budget_mb=0.0) as reg:
+        _register(reg, "alpha")
+        _register(reg, "beta")
+        with DecodeEngine(ToyLM(vocab=16, dim=8, window=4), slots=2,
+                          page_tokens=4, max_pages=2,
+                          warmup=False) as eng:
+            fut = eng.submit([1, 2], 4)
+            eng.step()
+            kv = eng.stats()["kv_bytes"]
+            assert kv > 0
+            freed = reg._make_room(float(kv) / 2, exclude=None,
+                                   why="test-phase0")
+            assert freed > 0
+            assert eng.stats()["kv_bytes"] < kv
+            # the cheaper rungs were enough: nothing hotter was touched
+            assert reg._entry("alpha").predictor.resident
+            assert reg._entry("beta").predictor.resident
+            with pytest.raises(SequenceEvicted) as ei:
+                fut.result(timeout=10)
+            assert ei.value.retry_after_s > 0
+
+
 @pytest.mark.chaos
 def test_chaos_four_models_budget_for_two_mixed_tenant_flood():
     """THE acceptance drill: 4 models, a budget sized for ~2, a
@@ -312,14 +340,28 @@ def test_chaos_four_models_budget_for_two_mixed_tenant_flood():
     injected memory.oom.  Pins: zero DeviceMemoryError/InjectedFault/
     ModelEvictedError escapes (only ladder-typed failures), goodput
     >= 0.9 of admitted, bounded p99, eviction churn > 0, and ledger
-    parity after close."""
+    parity after close.
+
+    ISSUE 19 extends the drill with a GENERATIVE tenant: a continuous-
+    batching DecodeEngine shares the same budget (its KV pages are the
+    arbiter's phase-0 victims, its weights ride `serve_weights`), its
+    sequences count in the same goodput, and every generative failure
+    mode is typed too (`SequenceEvicted` rides `Overloaded`)."""
+    from mxnet_tpu.serving import DecodeEngine, ToyLM
     dev0 = memory.live_by_tag().get("serve_weights", 0)
     host0 = memory.live_by_tag("host").get("serve_host_params", 0)
+    kv0 = memory.live_by_tag().get("serve_kv_pages", 0)
     names = ["m0", "m1", "m2", "m3"]
     reg = ModelRegistry(budget_mb=0.0)
+    eng = None
     try:
         for i, n in enumerate(names):
             _register(reg, n, seed=i)
+        # the generative tenant's engine shares the process budget:
+        # created pre-budget so its weights count as resident state
+        eng = DecodeEngine(ToyLM(vocab=16, dim=8, window=4), slots=4,
+                           page_tokens=4, max_pages=4, warmup=False,
+                           name="gen")
         # uncontended baseline p99 (budget off, everything resident)
         lats = []
         for i in range(20):
@@ -361,6 +403,31 @@ def test_chaos_four_models_budget_for_two_mixed_tenant_flood():
                     with lock:
                         results["errors"].append(e)
 
+        def gen_load(tenant, rounds):
+            """The generative tenant: sequences through the decode
+            engine, same goodput ledger, same typed-or-bust rule."""
+            for i in range(rounds):
+                t0 = time.perf_counter()
+                try:
+                    fut = eng.submit([i % 8 + 1, i % 4 + 1], 4,
+                                     tenant=tenant)
+                except Overloaded:
+                    continue            # typed shed: never admitted
+                with lock:
+                    results["admitted"] += 1
+                try:
+                    while not fut.done():
+                        eng.step()
+                    fut.result(timeout=60)
+                    with lock:
+                        results["served"] += 1
+                        results["lat"].append(time.perf_counter() - t0)
+                except (Overloaded, DeadlineExceeded):
+                    pass  # SequenceEvicted rides Overloaded: typed
+                except Exception as e:  # noqa: BLE001 — the invariant
+                    with lock:
+                        results["errors"].append(e)
+
         with fi.active(plan):
             threads = []
             # mixed tenants, traffic shifting across all 4 models —
@@ -370,6 +437,11 @@ def test_chaos_four_models_budget_for_two_mixed_tenant_flood():
                      ("beta", "m3"), ("gamma", "m2"), ("gamma", "m0")]):
                 t = threading.Thread(target=tenant_load,
                                      args=(tenant, model, 10))
+                threads.append(t)
+                t.start()
+            for tenant in ("gen-a", "gen-b"):
+                t = threading.Thread(target=gen_load,
+                                     args=(tenant, 6))
                 threads.append(t)
                 t.start()
             for t in threads:
@@ -391,16 +463,26 @@ def test_chaos_four_models_budget_for_two_mixed_tenant_flood():
         assert sum(snap["evictions"].values()) > 0, snap["evictions"]
         assert snap["readmissions"] > 0
         assert snap["resident_models"] >= 1
+        # 6. the generative tenant actually decoded under the budget
+        gen = eng.stats()
+        assert gen["completed"] > 0, gen
+        assert gen["completed"] + gen["evicted"] + gen["expired"] \
+            == gen["admitted"], gen
     finally:
+        if eng is not None:
+            eng.close()
         reg.close()
     del reg
     # the injected OOM's post-mortem dump thread derefs ledger entries
     # while it serializes — wait it out before reading the ledger
     memory.wait_oom_dump(timeout=30)
     _collect()
-    # 5. ledger parity after full churn + teardown
+    # 5. ledger parity after full churn + teardown — engine weights
+    # ride serve_weights and its pages serve_kv_pages, so the closed
+    # engine must be invisible here too
     assert memory.live_by_tag().get("serve_weights", 0) == dev0
     assert memory.live_by_tag("host").get("serve_host_params", 0) == host0
+    assert memory.live_by_tag().get("serve_kv_pages", 0) == kv0
 
 
 # -- observability ------------------------------------------------------------
