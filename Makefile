@@ -6,7 +6,7 @@ SRCS := src/runtime/storage.cc src/runtime/engine.cc \
         src/runtime/recordio.cc src/runtime/prefetch.cc
 LIB := mxnet_tpu/_native/libmxtpu_runtime.so
 
-.PHONY: native test chaos chaos-train chaos-serve lint-graft autotune-smoke shard-smoke decode-smoke report clean cpp_example predict_capi capi_example
+.PHONY: native test chaos chaos-train chaos-serve lint-graft autotune-smoke shard-smoke decode-smoke embed-smoke report clean cpp_example predict_capi capi_example
 
 native: $(LIB)
 
@@ -144,6 +144,15 @@ decode-smoke:
 # collectives (audit_program on the captured HLO).
 shard-smoke:
 	JAX_PLATFORMS=cpu timeout 60 python -m mxnet_tpu.parallel --smoke
+
+# sharded-embedding smoke gate (ISSUE 20, docs/embedding.md): 8 virtual
+# CPU devices, 2-way model-sharded ShardedEmbedding + dense tower
+# whole-step train — asserts 1 dispatch/step, the table's donation
+# survived the in-program scatter (alias table), the sharded program
+# carries its id/row exchange collectives, and embed_shards bytes are
+# on the memory ledger.
+embed-smoke:
+	JAX_PLATFORMS=cpu timeout 60 python -m mxnet_tpu.embedding --smoke
 
 # render the offline run report for the newest run journal under
 # MXNET_RUN_DIR (or ./runs); `make report RUN_DIR=/path` overrides

@@ -140,6 +140,8 @@ def _emit(partial):
         out["sharding"] = _STATE["sharding"]
     if _STATE.get("decode") is not None:
         out["decode"] = _STATE["decode"]
+    if _STATE.get("embedding") is not None:
+        out["embedding"] = _STATE["embedding"]
     if partial:
         out["partial"] = True
         out["phase"] = _STATE["phase"]
@@ -585,6 +587,19 @@ def _run():
             _STATE["decode"] = {
                 "error": "%s: %s" % (type(e).__name__, str(e)[:200])}
 
+    # sharded-embedding rider (ISSUE 20; MXT_BENCH_EMBED=0 skips): a
+    # ShardedEmbedding + dense tower through the donated whole-step
+    # program vs the legacy per-key row-sparse path — {rows/s,
+    # dispatches/step, wire_rows vs dense_rows, sharded vs legacy
+    # steps/s}
+    if os.environ.get("MXT_BENCH_EMBED", "1") != "0":
+        _phase("embedding", EPOCH_S)
+        try:
+            _STATE["embedding"] = _embedding_leg(mx, ctx)
+        except Exception as e:  # noqa: BLE001
+            _STATE["embedding"] = {
+                "error": "%s: %s" % (type(e).__name__, str(e)[:200])}
+
 
 def _decode_leg(mx, ctx):
     """Continuous batching vs request-level coalescing (ISSUE 19) on
@@ -975,6 +990,131 @@ def _sharding_leg(mx, ctx):
     finally:
         _pmesh.set_current_mesh(prev_mesh)
         _int.configure(hlo=prev_hlo)
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    return out
+
+
+def _embedding_leg(mx, ctx):
+    """Sharded sparse-embedding rider (ISSUE 20): a ShardedEmbedding +
+    dense tower trained through the donated whole-step program (mesh
+    model-sharded table, row-sparse grads, in-program scatter update)
+    vs the SAME net on the legacy per-key row-sparse path
+    (MXNET_FUSED_TRAINER=0, eager step).  Reports {rows_per_s,
+    dispatches_per_step, wire_rows vs dense_rows, sharded vs legacy
+    steps/s} — the wire columns are the row-sparse economics: a dense
+    gradient would allreduce every vocab row per step, the row-sparse
+    format only the batch's unique rows."""
+    from mxnet_tpu import autograd, gluon, observability as _obs
+    from mxnet_tpu.analysis import program_audit as _pa
+    from mxnet_tpu.embedding import ShardedEmbedding
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu.gluon.wholestep import WholeStepCompiler
+    from mxnet_tpu.observability import introspect as _int
+    from mxnet_tpu.parallel import mesh as _pmesh
+    import jax
+
+    ndev = len(jax.devices())
+    model = 2 if ndev > 1 and ndev % 2 == 0 else 1
+    batch = ndev // model
+    vocab, dim, feats, bs = 4096, 32, 16, 256
+    rs = np.random.RandomState(0)
+    x = mx.nd.array(rs.randint(0, vocab, (bs, feats)).astype("f"), ctx=ctx)
+    y = mx.nd.array(rs.normal(0, 1, (bs, 1)).astype("f"), ctx=ctx)
+
+    def build(sharded):
+        mx.random.seed(7)
+        net = nn.HybridSequential()
+        with net.name_scope():
+            net.add(ShardedEmbedding(vocab, dim) if sharded
+                    else nn.Embedding(vocab, dim, sparse_grad=True))
+            net.add(nn.Flatten())
+            net.add(nn.Dense(32, activation="relu"))
+            net.add(nn.Dense(1))
+        net.hybridize()
+        net.initialize(mx.init.Xavier(), ctx=ctx)
+        tr = gluon.Trainer(net.collect_params(), "sgd",
+                           {"learning_rate": 0.01, "momentum": 0.9},
+                           kvstore="tpu_sync", update_on_kvstore=False)
+        return net, tr
+
+    out = {"devices": ndev,
+           "mesh_shape": {"batch": batch, "model": model},
+           "vocab": vocab, "dim": dim, "dense_rows": vocab,
+           "note": "CPU dispatch gates; device rows/s pending chip "
+                   "window"}
+    steps = 20
+    saved = {k: os.environ.get(k) for k in
+             ("MXNET_WHOLE_STEP", "MXNET_AMP", "MXNET_FUSED_TRAINER")}
+    prev_hlo = _int.HLO
+    prev_mesh = None
+    try:
+        for k in saved:
+            os.environ.pop(k, None)
+        os.environ["MXNET_WHOLE_STEP"] = "1"
+        _int.configure(hlo=True)
+        mesh = _pmesh.make_mesh(batch=batch, model=model)
+        prev_mesh = _pmesh.set_current_mesh(mesh)
+        net, tr = build(sharded=True)
+        out["wire_rows"] = net[0].wire_rows(x)
+        stc = WholeStepCompiler(net, gluon.loss.L2Loss(), tr)
+        for _ in range(3):
+            last = stc.step(x, y)  # compile + warm the sharded program
+        float(np.asarray(last.asnumpy()).ravel()[0])
+        c0 = _obs.dispatch_counts()
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            last = stc.step(x, y)
+        float(np.asarray(last.asnumpy()).ravel()[0])
+        dt = time.perf_counter() - t0
+        c1 = _obs.dispatch_counts()
+        out["whole_step_active"] = stc.active
+        out["sharded_steps_per_s"] = round(steps / dt, 2)
+        out["rows_per_s"] = round(out["wire_rows"] * steps / dt, 1)
+        out["dispatches_per_step"] = round(
+            (c1.get("total", 0) - c0.get("total", 0)) / steps, 2)
+        rec = _int.programs().get("whole_step")
+        if rec and rec.get("hlo"):
+            out["aliased_params"] = len(
+                _pa.parse_alias_table(rec["hlo"]))
+            out["audit_issues"] = len(_pa.audit_program(rec))
+    finally:
+        _pmesh.set_current_mesh(prev_mesh)
+        _int.configure(hlo=prev_hlo)
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    # legacy leg: replicated table, eager step, reference-shaped
+    # per-key lazy row-sparse update
+    saved = {k: os.environ.get(k) for k in
+             ("MXNET_WHOLE_STEP", "MXNET_FUSED_TRAINER")}
+    try:
+        os.environ["MXNET_WHOLE_STEP"] = "0"
+        os.environ["MXNET_FUSED_TRAINER"] = "0"
+        net, tr = build(sharded=False)
+        loss_fn = gluon.loss.L2Loss()
+
+        def estep():
+            with autograd.record():
+                l = loss_fn(net(x), y)
+            l.backward()
+            tr.step(bs)
+            return l
+        for _ in range(3):
+            last = estep()
+        float(np.asarray(last.asnumpy()).ravel()[0])
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            last = estep()
+        float(np.asarray(last.asnumpy()).ravel()[0])
+        out["legacy_per_key_steps_per_s"] = round(
+            steps / (time.perf_counter() - t0), 2)
+    finally:
         for k, v in saved.items():
             if v is None:
                 os.environ.pop(k, None)

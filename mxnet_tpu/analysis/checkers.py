@@ -984,7 +984,11 @@ class UseAfterDonateChecker:
     (``_build_fn``-style returns) and the ``lookup_program`` cache;
     rebinds / ``del`` / the supervisor-restore idioms
     (``*restore*`` / ``_load_init`` / ``set_states_bytes`` /
-    ``readmit`` / ``_set_data``) kill the taint.  The MXNET_SANITIZE
+    ``readmit`` / ``_set_data``) kill the taint, as does the
+    scatter-update restore idiom ``x = x.at[ids].set(...)`` (ISSUE 20:
+    the whole-step embedding update rebinds the donated table to the
+    functional scatter result in the same statement, so the RHS read
+    is the aliasing flow, not a stale use).  The MXNET_SANITIZE
     runtime twin (``sanitizer.poison_donated``) raises a typed
     ``DonatedBufferError`` for whatever escapes the static net.
     """

@@ -29,7 +29,12 @@ positions as dead.  Kills (the value is live again): rebinding the
 name, ``del``, and the supervisor/wholestep restore idioms — a call to
 ``*restore*`` / ``_load_init`` / ``set_states_bytes`` / ``readmit``
 / ``_set_data`` rebuilds state from host copies, so every donated name
-is revived (the donation-safe-retry pattern PR 12 shipped).  Branches
+is revived (the donation-safe-retry pattern PR 12 shipped); and the
+scatter-update restore idiom ``x = x.at[ids].set(...)`` (ISSUE 20's
+whole-step embedding update) — the RHS read of ``x`` is NOT a flagged
+use because the same statement rebinds ``x`` to the functional result,
+which is exactly how a donated table flows through an in-program
+scatter and comes out aliased.  Branches
 merge conservatively (donated in either arm stays donated; killed only
 when killed in both); loop bodies run twice so an un-rebound name
 donated at the bottom of an iteration is caught when the next
@@ -55,6 +60,36 @@ _JIT_NAMES = ("jax.jit", "_jax.jit", "jit")
 _RESTORE_TOKENS = ("restore",)
 _RESTORE_NAMES = ("_load_init", "set_states_bytes", "readmit",
                   "_set_data", "_init_residuals")
+
+#: ``.at[...]`` scatter methods whose self-rebinding form is the
+#: scatter-update restore idiom (see _scatter_restore_root)
+_SCATTER_METHODS = ("set", "add", "mul", "multiply", "divide",
+                    "min", "max", "power", "apply")
+
+
+def _scatter_restore_root(expr) -> Optional[ast.AST]:
+    """``x = x.at[ids].set(v)`` — jax's functional in-place update, and
+    the whole-step embedding scatter (ISSUE 20).  When the single
+    assignment target is the same name as the buffer under ``.at``, the
+    statement REBINDS the name to the functional result, so the RHS
+    read must not be flagged as a use of the donated value (the rebind
+    is what lets a donated table flow through the scatter and stay
+    aliased).  Returns the read root (the ``x`` under ``.at``) when the
+    expression is such a scatter call, else None; the caller checks the
+    target-name match."""
+    if not isinstance(expr, ast.Call):
+        return None
+    f = expr.func
+    if not (isinstance(f, ast.Attribute) and f.attr in _SCATTER_METHODS):
+        return None
+    sub = f.value
+    if not isinstance(sub, ast.Subscript):
+        return None
+    at = sub.value
+    if not (isinstance(at, ast.Attribute) and at.attr == "at"):
+        return None
+    return at.value if isinstance(at.value, (ast.Name, ast.Attribute)) \
+        else None
 
 
 def call_name(node: ast.AST) -> str:
@@ -290,7 +325,15 @@ class _DonationWalker:
                              ast.ClassDef)):
             return  # nested defs analyzed on their own
         if isinstance(stmt, ast.Assign):
-            self._check_reads(stmt.value)
+            skip: Tuple[ast.AST, ...] = ()
+            root = _scatter_restore_root(stmt.value)
+            if root is not None and len(stmt.targets) == 1:
+                tkey = _target_key(stmt.targets[0])
+                if tkey is not None and tkey == _target_key(root):
+                    # scatter-update restore: the rebind kills the
+                    # donated read in the same statement
+                    skip = (root,)
+            self._check_reads(stmt.value, skip=skip)
             self._process_calls(stmt.value)
             nums = self._donation_of(stmt.value)
             for t in stmt.targets:

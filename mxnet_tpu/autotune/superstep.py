@@ -332,6 +332,11 @@ class SuperStepCompiler(WholeStepCompiler):
                     "GSPMD collectives replace the bucketed allreduce",
                     mesh_signature(self.mesh))
             thr = None
+        if built["bk"] is None:
+            # every trainable param is a sparse embedding (ISSUE 20):
+            # no dense buckets exist, so compression has nothing to act
+            # on — the sparse leg's row grads never flatten
+            thr = None
         residuals = []
         if thr is not None:
             if tr._residuals is None:

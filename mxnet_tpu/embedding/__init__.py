@@ -1,0 +1,31 @@
+"""Recommendation-scale sparse embeddings (ISSUE 20, docs/embedding.md).
+
+The reference MXNet's signature recommendation capability is sparse
+NDArray + ``kvstore.row_sparse_pull`` (arxiv 1512.01274 §5): an
+embedding table too big to densify moves O(touched rows) bytes per
+step, not O(vocab).  This package is the TPU-graft of that idea:
+
+* ``ShardedEmbedding`` — a gluon block whose table row-partitions
+  across the mesh axis named by ``MXNET_EMBED_SHARD_AXIS`` (default
+  ``model``).  The partition is a GSPMD annotation, so lookups lower to
+  ONE gather collective each way (ids out to the owning shards, rows
+  back) inside the traced program — never a per-row host loop.
+* row-sparse gradients — autograd deposits (unique ids, rows) pairs;
+  ``kvstore.allreduce_rowsparse`` reduces them by unique-concat +
+  segment-sum and ``FusedUpdater.update_sparse`` applies sgd/adam to
+  the touched rows in one compiled scatter.
+* whole-step eligibility — ``WholeStepCompiler`` keeps the table
+  dense-and-donated inside the step program and updates it with an
+  in-program ``.at[ids].set`` scatter, so a sparse-embedding + dense-
+  tower model still trains at one XLA dispatch per step.
+
+Table bytes carry their own HBM-ledger tag ``embed_shards``
+(docs/memory.md) so ``memory.report()`` and ``ensure_headroom``
+attribute them separately from dense params.
+
+``python -m mxnet_tpu.embedding --smoke`` is the CI gate
+(``make embed-smoke``).
+"""
+from .sharded import ShardedEmbedding, row_partition_spec  # noqa: F401
+
+__all__ = ["ShardedEmbedding", "row_partition_spec"]

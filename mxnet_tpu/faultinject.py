@@ -76,6 +76,16 @@ docs/serving_resilience.md):
                           failed collective; whole-step mode inlines the
                           reduce into the donated program, so this site
                           only fires on the fused/legacy paths)
+  ``kvstore.sparse_allreduce``  ``KVStore.allreduce_rowsparse`` entry —
+                          the row-sparse (ids, rows) gradient reduce of
+                          sharded embeddings (ISSUE 20), fired BEFORE
+                          any reduce work so an injected raise models a
+                          failed sparse collective with per-row
+                          optimizer state untouched; the
+                          ``TrainingSupervisor`` restores through the
+                          snapshot window and the retry is bitwise
+                          (whole-step mode inlines the sparse reduce
+                          into the donated program, like the dense site)
   ``device.unavailable``  the training dispatch chokepoints
                           (``WholeStepCompiler._dispatch``, the fused
                           update) — a ``raise`` rule defaults to the
@@ -123,7 +133,8 @@ ENV_VAR = "MXNET_FAULT_PLAN"
 SITES = ("serving.dispatch", "serving.batcher", "serving.hot_reload",
          "serving.evict", "serving.decode_step", "checkpoint.io",
          "memory.oom", "trainer.step", "data.batch",
-         "kvstore.allreduce", "device.unavailable")
+         "kvstore.allreduce", "kvstore.sparse_allreduce",
+         "device.unavailable")
 
 _MODES = ("raise", "delay", "corrupt")
 

@@ -134,8 +134,12 @@ class Parameter:
                 f"Cannot initialize Parameter {self.name} because it has "
                 "invalid shape: {self.shape}.")
         if data is None:
-            # HBM ledger: the parameter buffer is born here — tag it
-            with _memory_scope("param"):
+            # HBM ledger: the parameter buffer is born here — tag it.
+            # ``_memory_tag`` (default "param") lets a subsystem claim
+            # its own ledger row: ShardedEmbedding stamps "embed_shards"
+            # so ensure_headroom / the registry cost model see table
+            # bytes as their own class (docs/memory.md taxonomy)
+            with _memory_scope(getattr(self, "_memory_tag", "param")):
                 data = nd.zeros(self.shape, dtype=self.dtype, ctx=ctx[0])
                 initializer.create(default_init)(
                     InitDesc(self.name, {"__init__": init}), data)
@@ -143,7 +147,7 @@ class Parameter:
 
     def _init_impl(self, data, ctx_list):
         self._ctx = list(ctx_list)
-        with _memory_scope("param"):
+        with _memory_scope(getattr(self, "_memory_tag", "param")):
             if not isinstance(data, NDArray):
                 data = nd.array(data, dtype=self.dtype)
             self._data = data.as_in_context(self._ctx[0]) if \
@@ -216,7 +220,7 @@ class Parameter:
         self._sharding_spec = spec
         self._sharding = NamedSharding(mesh, spec)
         if self._data is not None:
-            with _memory_scope("param"):
+            with _memory_scope(getattr(self, "_memory_tag", "param")):
                 self._apply_sharding_locked()
             from ..ndarray.sparse import RowSparseNDArray
             if self._grad is not None and \

@@ -77,6 +77,11 @@ class Trainer:
         # (flat bucket arrays, per-param views, index tuple) staged by a
         # for-step allreduce for the fused update to consume
         self._reduced = None
+        # {param_idx: merged RowSparseNDArray} staged by a for-step
+        # allreduce_rowsparse for the fused sparse update (ISSUE 20)
+        self._reduced_rsp = None
+        # (key, (live, rsp, rsp_idx, dense)) — see _live_split
+        self._live_split_cache = None
         # 2-bit error-feedback state for the compressed bucketed
         # allreduce: one flat f32 residual per bucket, laid out by the
         # bucketer (each parameter's residual is its own slice, so
@@ -181,6 +186,28 @@ class Trainer:
             for d in p.list_data():
                 d._fresh_grad = False
 
+    def _live_split(self):
+        """Cached dense/row-sparse split of the live params (ISSUE 20):
+        ``(live, rsp, rsp_idx, dense)``.  The per-step linear
+        ``getattr`` scans collapse to one build per param-set change —
+        keyed on param identity + grad_req + grad_stype, the same
+        identity discipline as the bucketer signature (PR 3)."""
+        key = tuple((id(p), p.grad_req,
+                     getattr(p, "_grad_stype", "default"))
+                    for p in self._params)
+        cached = self._live_split_cache
+        if cached is not None and cached[0] == key:
+            return cached[1]
+        live = [(i, p) for i, p in enumerate(self._params)
+                if p.grad_req != "null"]
+        rsp = [(i, p) for i, p in live
+               if getattr(p, "_grad_stype", "default") == "row_sparse"]
+        rsp_idx = frozenset(i for i, _ in rsp)
+        dense = [ip for ip in live if ip[0] not in rsp_idx]
+        out = (live, rsp, rsp_idx, dense)
+        self._live_split_cache = (key, out)
+        return out
+
     @hot_path
     def step(self, batch_size, ignore_stale_grad=False):
         """Apply one optimization step with grads scaled by 1/batch_size.
@@ -219,8 +246,7 @@ class Trainer:
         # same site in WholeStepCompiler._run; exactly one per step)
         _fi_fire("trainer.step", step=self._step_id)
         self._optimizer.rescale_grad = self._scale / batch_size
-        live = [(i, p) for i, p in enumerate(self._params)
-                if p.grad_req != "null"]
+        live, rsp, rsp_idx, dense = self._live_split()
         if self._kv is not None and self._update_on_kvstore:
             # parity: the reference NEVER masks the kvstore push set —
             # only the no-kvstore updater loop honors ignore_stale_grad.
@@ -233,8 +259,6 @@ class Trainer:
             # row-sparse grad_stype params go through the kvstore per-key
             # sparse path (class-preserving push → lazy rsp optimizer on
             # the store) so untouched rows never decay
-            rsp = [(i, p) for i, p in live
-                   if getattr(p, "_grad_stype", "default") == "row_sparse"]
             if rsp:
                 from ..ndarray import sparse as _sp
                 for i, p in rsp:
@@ -245,8 +269,6 @@ class Trainer:
                             else _sp.cast_storage(g, "row_sparse")
                             for g in p.list_grad()],
                         out=p.list_data())
-            rsp_idx = {i for i, _ in rsp}
-            dense = [ip for ip in live if ip[0] not in rsp_idx]
             if dense:
                 if self._fused:
                     self._kv.pushpull([i for i, _ in dense],
@@ -270,20 +292,32 @@ class Trainer:
 
     def _allreduce_grads(self, for_step=False):
         self._reduced = None
+        self._reduced_rsp = None
         if self._kv is None:
             return
-        live = [(i, p) for i, p in enumerate(self._params)
-                if p.grad_req != "null"]
-        rsp = [(i, p) for i, p in live
-               if getattr(p, "_grad_stype", "default") == "row_sparse"]
-        for i, p in rsp:
-            # sparse keys keep the per-key class-preserving path
-            self._kv.push(i, p.list_grad())
-            if not self._update_on_kvstore:
-                self._kv.pull(i, p.list_grad())
-        # O(1) set membership — `ip not in rsp` was O(len(live)·len(rsp))
-        rsp_idx = {i for i, _ in rsp}
-        dense = [ip for ip in live if ip[0] not in rsp_idx]
+        live, rsp, rsp_idx, dense = self._live_split()
+        if rsp:
+            from ..ndarray import sparse as _sp
+            fused_rsp = (for_step and self._fused
+                         and not self._update_on_kvstore
+                         and all(len(p.list_grad()) == 1 for _, p in rsp))
+            if fused_rsp:
+                # ONE row-sparse reduce over all sparse keys (ISSUE 20):
+                # unique-concat + segment-sum, jit-inlinable — replaces
+                # the per-key push/pull exile.  The merged grads are
+                # staged for _update's fused sparse leg, consume-once.
+                merged = self._kv.allreduce_rowsparse(
+                    [[g if isinstance(g, _sp.RowSparseNDArray)
+                       else _sp.cast_storage(g, "row_sparse")
+                       for g in p.list_grad()] for _, p in rsp])
+                self._reduced_rsp = {
+                    i: m for (i, _), m in zip(rsp, merged)}
+            else:
+                for i, p in rsp:
+                    # sparse keys keep the per-key class-preserving path
+                    self._kv.push(i, p.list_grad())
+                    if not self._update_on_kvstore:
+                        self._kv.pull(i, p.list_grad())
         if not dense:
             return
         # 2-bit compression composes with bucketing: the quantizer is
@@ -445,11 +479,11 @@ class Trainer:
 
     def _update(self, ignore_stale_grad=False):
         from ..optimizer import FusedUpdater
-        live = [(i, p) for i, p in enumerate(self._params)
-                if p.grad_req != "null"]
+        live, _, rsp_idx, _ = self._live_split()
         # pop the staged buckets BEFORE the stale check: if it raises,
         # a later update() must not consume the previous step's grads
         reduced, self._reduced = self._reduced, None
+        reduced_rsp, self._reduced_rsp = self._reduced_rsp, None
         live = self._mask_stale(live, ignore_stale_grad)
         if self._update_on_kvstore and self._kv is not None:
             for i, param in live:
@@ -463,23 +497,6 @@ class Trainer:
         while len(self._updaters) < ncopies:
             self._updaters.append(opt.get_updater(self._optimizer))
         done = list(live)
-        # row-sparse grad_stype params take the lazy per-key sparse path
-        # (dense autograd grad → RowSparse cast → row-wise update); the
-        # rest go through the fused multi-tensor dispatch
-        rsp = [(i, p) for i, p in live
-               if getattr(p, "_grad_stype", "default") == "row_sparse"]
-        if rsp:
-            from ..ndarray import sparse as _sp
-            for i, param in rsp:
-                for u, arr, grad in zip(self._updaters, param.list_data(),
-                                        param.list_grad()):
-                    u(i, grad if isinstance(grad, _sp.RowSparseNDArray)
-                      else _sp.cast_storage(grad, "row_sparse"), arr)
-            rsp_idx = {i for i, _ in rsp}
-            live = [ip for ip in live if ip[0] not in rsp_idx]
-            if not live:
-                self._clear_fresh(done)
-                return
         fused_ok = self._fused and isinstance(upd, FusedUpdater)
         # update_all always runs f32 optimizer math — clear any sticky
         # whole-step AMP policy (a direct Trainer.step after AMP
@@ -489,6 +506,40 @@ class Trainer:
             for u in self._updaters:
                 if u.dtype_policy != "f32":
                     u.dtype_policy = "f32"
+        # row-sparse grad_stype params: one fused gather→step→scatter
+        # dispatch over all sparse keys (ISSUE 20) when the updater is
+        # fused and copies are single; MXNET_FUSED_TRAINER=0, multi-copy,
+        # or non-fused optimizers keep the reference-shaped lazy per-key
+        # loop for A/B runs
+        rsp = [ip for ip in live if ip[0] in rsp_idx]
+        if rsp:
+            from ..ndarray import sparse as _sp
+
+            def _as_rsp(g):
+                return g if isinstance(g, _sp.RowSparseNDArray) \
+                    else _sp.cast_storage(g, "row_sparse")
+            if fused_ok and all(len(p.list_data()) == 1 for _, p in rsp):
+                # _allreduce_grads(for_step=True) stages the merged
+                # grads; a direct update() call consumes the raw per-key
+                # grad buffers instead — same values single-worker
+                sgrads = [_as_rsp(p.list_grad()[0])
+                          if reduced_rsp is None or i not in reduced_rsp
+                          else reduced_rsp[i] for i, p in rsp]
+                with _flight.phase_span("fused_sparse_update",
+                                        cat="optimizer",
+                                        step=self._step_id, mem=True):
+                    upd.update_sparse([i for i, _ in rsp], sgrads,
+                                      [p.list_data()[0] for _, p in rsp])
+            else:
+                for i, param in rsp:
+                    for u, arr, grad in zip(self._updaters,
+                                            param.list_data(),
+                                            param.list_grad()):
+                        u(i, _as_rsp(grad), arr)
+            live = [ip for ip in live if ip[0] not in rsp_idx]
+            if not live:
+                self._clear_fresh(done)
+                return
         if fused_ok and all(len(p.list_data()) == 1 for _, p in live):
             if reduced is not None:
                 flats, views, idx = reduced

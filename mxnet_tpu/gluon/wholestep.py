@@ -438,8 +438,13 @@ class WholeStepCompiler:
             raise _Ineligible("multi-host kvstore collectives are not "
                               "jit-inlinable yet")
         for p in tr._params:
-            if getattr(p, "_grad_stype", "default") != "default":
-                raise _Ineligible(f"sparse-grad parameter {p.name}")
+            st = getattr(p, "_grad_stype", "default")
+            if st not in ("default", "row_sparse"):
+                raise _Ineligible(f"grad_stype={st!r} parameter {p.name}")
+            # row_sparse params are eligible (ISSUE 20) but validate
+            # against the traced graph in _bind_graph: the weight must
+            # be a pure sparse_grad Embedding table fed ids straight
+            # from the data input, with row-gatherable optimizer state
             if p.grad_req not in ("write", "null"):
                 raise _Ineligible(
                     f"grad_req={p.grad_req!r} on {p.name} (vjp gives "
@@ -502,7 +507,38 @@ class WholeStepCompiler:
         gnames = [p.name for _, p in live]
         sig = tuple((tuple(p.data().shape), str(p.data().dtype))
                     for _, p in live)
-        bk = tr._ensure_bucketer(sig, idx)
+        # sparse-embedding params (ISSUE 20): a row-sparse grad is
+        # whole-step eligible only when the traced graph proves the
+        # rows-only rewrite is exact — the weight feeds nothing but ONE
+        # sparse_grad Embedding step whose ids come straight from the
+        # data input (so the in-program unique/scatter sees every
+        # touched row)
+        sga = plan.sparse_grad_args()
+        embed = {}
+        for _, p in live:
+            if getattr(p, "_grad_stype", "default") == "default":
+                continue
+            uses = sga.get(p.name)
+            if not uses:
+                raise _Ineligible(
+                    f"row-sparse parameter {p.name} is not a pure "
+                    "sparse_grad Embedding weight")
+            if len(uses) != 1 or uses[0][1] != _DATA:
+                raise _Ineligible(
+                    f"sparse embedding {p.name} must be looked up exactly "
+                    "once, with ids straight from the data input")
+            # shape[0]/step index are host ints already — no device read
+            embed[p.name] = {"step": uses[0][0],
+                             "vocab": p.data().shape[0]}
+        # the bucketer (and so compression residuals) covers DENSE
+        # params only — row-sparse grads never flatten into buckets, on
+        # this path or the trainer's fused path, so residual layouts
+        # stay interchangeable between the two
+        dlive = [(i, p) for i, p in live if p.name not in embed]
+        dsig = tuple((tuple(p.data().shape), str(p.data().dtype))
+                     for _, p in dlive)
+        didx = tuple(i for i, _ in dlive)
+        bk = tr._ensure_bucketer(dsig, didx) if dlive else None
         upd = tr._updaters[0]
         if self.mesh is not None:
             # annotate BEFORE the updater seeds optimizer state: the
@@ -517,8 +553,14 @@ class WholeStepCompiler:
             for _, p in live:
                 spec = p.sharding_spec
                 if spec is None:
-                    spec = _pmesh.default_param_spec(
-                        self.mesh, tuple(p.data().shape))
+                    # a parameter may pin its own layout rule (the
+                    # sharded-embedding row partition along
+                    # MXNET_EMBED_SHARD_AXIS) ahead of the generic
+                    # largest-dim default
+                    hint = getattr(p, "_spec_hint", None)
+                    spec = hint(self.mesh) if hint is not None else \
+                        _pmesh.default_param_spec(
+                            self.mesh, tuple(p.data().shape))
                 p.set_sharding(self.mesh, spec)
             for n in itertools.chain(cnames, plan.aux_names):
                 p = params_by_name[n]
@@ -536,11 +578,17 @@ class WholeStepCompiler:
                 from ..optimizer import _conform_state_sharding
                 upd.states[i] = _conform_state_sharding(
                     upd.states[i], p.data())
+            if p.name in embed and not upd._rowable_state(
+                    upd.states[i], p.data().shape[0]):
+                raise _Ineligible(
+                    f"optimizer state for embedding {p.name} is not "
+                    "row-gatherable (leaves must be table-shaped or "
+                    "None)")
         return {"plan": plan, "idx": idx, "gnames": gnames,
                 "cnames": tuple(cnames),
                 "aux_names": tuple(plan.aux_names),
                 "params": params_by_name, "bk": bk, "sig": sig,
-                "uid": next(_PLAN_UID)}
+                "embed": embed, "uid": next(_PLAN_UID)}
 
     # -- the compiled program ------------------------------------------------
     def _make_ftrain(self, built, opt_, policy, thr, window):
@@ -560,9 +608,11 @@ class WholeStepCompiler:
         gnames = built["gnames"]
         idx = built["idx"]
         bk = built["bk"]
+        embed = built.get("embed") or {}
+        dnames = [n for n in gnames if n not in embed]
         lp = _LP_DTYPES.get(policy)
         overrides = _amp_overrides(plan, lp) if lp is not None else None
-        use_comp = thr is not None
+        use_comp = thr is not None and bk is not None and bool(dnames)
         use_scaler = policy == "fp16"
         flatten_inline = bk.flatten_inline if use_comp else None
         unflatten_inline = bk.unflatten_inline if use_comp else None
@@ -572,29 +622,82 @@ class WholeStepCompiler:
 
         def ftrain(gparams, states, residuals, scaler, aux, consts,
                    data, label, key, lrs, wds, ts):
-            def fwd(p):
+            # -- sparse-embedding pre-pass (ISSUE 20): batch ids ->
+            # shared sorted-unique rows.  jnp.unique pads its static
+            # output with fill_value=vocab — a POSITIVELY out-of-range
+            # sentinel every mode="drop" scatter below discards (never
+            # -1, which .at[] would wrap onto the last real row).
+            elook = {}
+            for n, info in embed.items():
+                vocab = info["vocab"]
+                ids = jnp.clip(data.astype(jnp.int32), 0,
+                               vocab - 1).ravel()
+                uids, uinv = jnp.unique(ids, size=ids.shape[0],
+                                        fill_value=vocab,
+                                        return_inverse=True)
+                elook[n] = (uids, jnp.ravel(uinv))
+            # one zero dummy per embedding, shaped like the lookup
+            # OUTPUT (tokens x dim, not vocab x dim) — the executor's
+            # rows-only rewrite idiom: differentiating the dummy yields
+            # the per-token cotangent rows, so the table's O(vocab)
+            # dense cotangent never materializes in the program
+            dums = {n: jnp.zeros(tuple(data.shape)
+                                 + tuple(gparams[n].shape[1:]),
+                                 gparams[n].dtype) for n in embed}
+            dparams = {n: gparams[n] for n in dnames}
+
+            def fwd(p, dm):
                 m = dict(consts)
                 m[_DATA] = data
                 m[_LABEL] = label
                 m.update(p)
+                ov = dict(overrides) if overrides else {}
+                for n, info in embed.items():
+                    # the weight var must still resolve (plan.run binds
+                    # every in_ref before consulting overrides), but it
+                    # is NOT a vjp primal — its gradient flows through
+                    # the dummy instead
+                    m[n] = gparams[n]
+
+                    def _lookup(params, ins, _n=n):
+                        vsz = ins[1].shape[0]
+                        iid = jnp.clip(ins[0].astype(jnp.int32), 0,
+                                       vsz - 1)
+                        return (jnp.take(jax.lax.stop_gradient(ins[1]),
+                                         iid, axis=0) + dm[_n],)
+
+                    ov[info["step"]] = _lookup
                 outs, new_aux = plan.run(m, aux, key, True,
-                                         step_overrides=overrides)
+                                         step_overrides=ov or None)
                 total = jnp.sum(outs[0].astype(jnp.float32))
                 if use_scaler:
                     total = total * scaler["scale"]
                 return total, (outs[0], new_aux)
 
-            _, vjp_fn, (loss, new_aux) = jax.vjp(fwd, gparams,
+            _, vjp_fn, (loss, new_aux) = jax.vjp(fwd, dparams, dums,
                                                  has_aux=True)
-            (gd,) = vjp_fn(jnp.asarray(1.0, jnp.float32))
-            glist = [gd[n] for n in gnames]
+            gd, gdum = vjp_fn(jnp.asarray(1.0, jnp.float32))
+            glist = [gd[n] for n in dnames]
+            # segment-sum the per-token rows onto the unique ids — the
+            # same unique + .at[inv].add the eager rsp deposit
+            # (_dedup_rows) runs, so the two paths' row grads match
+            # bitwise in f32
+            egrads = {}
+            for n in embed:
+                uids, uinv = elook[n]
+                rows = gdum[n].reshape((uinv.shape[0],)
+                                       + tuple(gparams[n].shape[1:]))
+                egrads[n] = jnp.zeros(rows.shape, rows.dtype) \
+                    .at[uinv].add(rows)
             finite = None
             if use_scaler:
                 inv = 1.0 / scaler["scale"]
                 glist = [(g.astype(jnp.float32) * inv).astype(g.dtype)
                          for g in glist]
+                egrads = {n: (g.astype(jnp.float32) * inv)
+                          .astype(g.dtype) for n, g in egrads.items()}
                 finite = jnp.asarray(True)
-                for g in glist:
+                for g in itertools.chain(glist, egrads.values()):
                     finite = jnp.logical_and(finite,
                                              jnp.all(jnp.isfinite(g)))
             new_res = residuals
@@ -602,7 +705,9 @@ class WholeStepCompiler:
                 # literal named scopes over the non-graph step stages:
                 # HLO metadata then attributes the bucketed reduce and
                 # the fused optimizer math to their own per_layer()
-                # rows, next to the graph nodes' layer scopes
+                # rows, next to the graph nodes' layer scopes.  The
+                # buckets hold DENSE grads only — row-sparse grads stay
+                # rows-only and never compress
                 with _introspect.layer_scope("allreduce"):
                     flats = flatten_inline(glist)
                     red, new_res, _errs = reduce_buckets_inline(
@@ -610,9 +715,34 @@ class WholeStepCompiler:
                     glist = unflatten_inline(red)
             with _introspect.layer_scope("optimizer"):
                 new_p, new_s = {}, []
+                di = 0
                 for k, n in enumerate(gnames):
-                    nw, ns = fused_step(idx[k], gparams[n], glist[k],
+                    if n in embed:
+                        # sparse leg: gather the touched rows (weight +
+                        # lazy per-row optimizer state), step them, and
+                        # scatter back IN PROGRAM — the table-shaped
+                        # output aliases the donated input buffer, so
+                        # the update is a true in-place scatter
+                        # (audit_programs checks the alias survived)
+                        uids, _ = elook[n]
+                        wr = jnp.take(gparams[n], uids, axis=0,
+                                      mode="clip")
+                        srows = jax.tree_util.tree_map(
+                            lambda s: jnp.take(s, uids, axis=0,
+                                               mode="clip"), states[k])
+                        nwr, nsr = fused_step(idx[k], wr, egrads[n],
+                                              srows, lrs[k], wds[k],
+                                              ts[k])
+                        new_p[n] = gparams[n].at[uids].set(
+                            _cast_like(nwr, wr), mode="drop")
+                        new_s.append(jax.tree_util.tree_map(
+                            lambda s, r: s.at[uids].set(
+                                _cast_like(r, s), mode="drop"),
+                            states[k], nsr))
+                        continue
+                    nw, ns = fused_step(idx[k], gparams[n], glist[di],
                                         states[k], lrs[k], wds[k], ts[k])
+                    di += 1
                     new_p[n] = _cast_like(nw, gparams[n])
                     new_s.append(_cast_like(ns, states[k]))
             new_scaler = scaler
@@ -752,6 +882,10 @@ class WholeStepCompiler:
                     "whole-step program on a multi-device mesh (%s) — "
                     "GSPMD collectives replace the bucketed allreduce",
                     mesh_signature(self.mesh))
+            thr = None
+        if built["bk"] is None:
+            # every trainable param is a sparse embedding (ISSUE 20):
+            # no dense buckets exist for compression to act on
             thr = None
         residuals = []
         if thr is not None:

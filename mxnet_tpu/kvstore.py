@@ -214,6 +214,51 @@ def reduce_buckets_inline(flats, residuals, threshold):
     return _compressed_reduce_local_impl(flats, residuals, threshold)
 
 
+def reduce_rowsparse_inline(ids_parts, rows_parts, size=None, dedup=True,
+                            fill=None):
+    """Pure row-sparse gradient reduce (ISSUE 20): unique-concat +
+    segment-sum over gathered (ids, rows) pairs, traceable INSIDE an
+    outer jit exactly like ``reduce_buckets_inline`` — no metrics, no
+    NDArray wrapping, no dispatch of its own.  The gluon whole-step
+    compiler inlines this math into its donated one-program step; the
+    eager ``KVStore.allreduce_rowsparse`` wrapper runs the same ops so
+    the two trajectories stay bitwise-interchangeable.
+
+    ``ids_parts``: int id vectors (one per gathered shard/copy);
+    ``rows_parts``: the matching ``(n_i, ...)`` row blocks.  Returns
+    ``(ids, rows)`` with ids sorted-unique and rows segment-summed
+    (``zeros.at[inverse].add`` — the same op ``RowSparseNDArray``'s
+    dedup uses, so already-unique input round-trips bitwise).
+
+    ``size``: static output length for jit tracing (pad tail ids with
+    ``fill``, default ``iinfo(ids.dtype).max`` — positively out of
+    range for every table, so a downstream ``.at[ids].set/add(...,
+    mode="drop")`` scatter ignores the padding; NEVER a negative fill,
+    which python indexing would wrap onto real rows).  ``size=None``
+    returns the exact nnz (eager use only — data-dependent shape).
+
+    ``dedup=False`` (the ``MXNET_EMBED_DEDUP_IDS=0`` wire format) skips
+    the unique pass and returns the raw concatenation — token-duplicate
+    ids stay on the wire and the consumer (the fused sparse updater /
+    whole-step scatter leg) performs the segment-sum itself."""
+    ids = jnp.concatenate([jnp.ravel(i) for i in ids_parts])
+    rows = jnp.concatenate(list(rows_parts))
+    if not dedup:
+        return ids, rows
+    if fill is None:
+        fill = jnp.iinfo(ids.dtype).max
+    if size is None:
+        uids, inv = jnp.unique(ids, return_inverse=True)
+        n = int(uids.shape[0])
+    else:
+        n = int(size)
+        uids, inv = jnp.unique(ids, size=n, fill_value=fill,
+                               return_inverse=True)
+    summed = jnp.zeros((n,) + rows.shape[1:], rows.dtype) \
+        .at[jnp.ravel(inv)].add(rows)
+    return uids, summed
+
+
 class GradientCompression:
     """Parity: `src/kvstore/gradient_compression.h:37` — holds type +
     threshold; quantize/dequantize as XLA-compiled kernels."""
@@ -693,6 +738,69 @@ class KVStore:
             raw = collectives.allreduce_hosts_many(raw)
         return [r if isinstance(r, NDArray) else NDArray(r, vl[0].context)
                 for r, vl in zip(raw, vals)]
+
+    @hot_path
+    def allreduce_rowsparse(self, values):
+        """Store-less ROW-SPARSE allreduce (ISSUE 20): the sparse twin of
+        ``allreduce`` — each value's per-device (ids, rows) pairs reduce
+        by unique-concat + segment-sum (``reduce_rowsparse_inline``),
+        never densifying the O(vocab) gradient.  For TRANSIENT keys (the
+        Trainer's row-sparse embedding grads): nothing is init'ed or
+        persisted, so reducing nnz rows costs nnz — not vocab — bytes.
+
+        ``values``: one entry per VALUE — a RowSparseNDArray or that
+        value's per-device-copy list.  Returns the reduced
+        RowSparseNDArrays (sorted-unique ids, summed rows).
+
+        ``MXNET_EMBED_DEDUP_IDS=0`` keeps token-duplicate ids on the
+        wire (the unique pass is skipped here; the fused sparse updater
+        segment-sums at the scatter instead) — the knob trades wire rows
+        for one fused dedup, and both settings train bitwise-identically
+        because the segment-sum runs exactly once either way."""
+        from .ndarray import sparse as _sp
+        vals = [list(v) if isinstance(v, (list, tuple)) else [v]
+                for v in values]
+        # chaos site: a raise here models a failed SPARSE gradient
+        # collective.  Fires BEFORE any reduce work, so grads and
+        # per-row optimizer state are untouched and the supervisor's
+        # snapshot retry replays the step bitwise.  (Whole-step mode
+        # inlines the sparse reduce into the donated program — this
+        # site only fires on the fused/legacy paths.)
+        _fi_fire("kvstore.sparse_allreduce", values=len(vals))
+        for vl in vals:
+            for v in vl:
+                if not isinstance(v, _sp.RowSparseNDArray):
+                    raise MXNetError(
+                        "allreduce_rowsparse expects row_sparse values, "
+                        f"got {type(v).__name__}")
+        if self.num_workers > 1 and self.type != "local":
+            raise MXNetError(
+                "multi-host row-sparse allreduce is not wired yet — "
+                "cast the gradient to dense storage or train this "
+                "parameter single-host (documented in docs/embedding.md)")
+        dedup = bool(getenv("MXNET_EMBED_DEDUP_IDS", True))
+        t0 = time.perf_counter() if _metrics.ENABLED else 0.0
+        out = []
+        with trace_span("kvstore_sparse_allreduce", cat="kvstore"):
+            for vl in vals:
+                if len(vl) == 1 and dedup:
+                    # construction guarantees sorted-unique ids — the
+                    # single-copy reduce is the identity (rows-only, no
+                    # segment-sum rerun: bitwise either way)
+                    out.append(vl[0])
+                    continue
+                ids, rows = reduce_rowsparse_inline(
+                    [v._indices for v in vl],
+                    [v._values for v in vl], size=None, dedup=dedup)
+                out.append(_sp.RowSparseNDArray(
+                    ids, rows, shape=vl[0].shape, ctx=vl[0].context,
+                    _dedup=not dedup))
+        if _metrics.ENABLED:
+            _metrics.KVSTORE_ALLREDUCE_SECONDS.observe(
+                time.perf_counter() - t0)
+            _metrics.KVSTORE_PUSH_BYTES.inc(sum(
+                _nd_bytes(v) for vl in vals for v in vl))
+        return out
 
     def _compressed_allreduce_impl(self, vals, residuals,
                                    gc: GradientCompression):

@@ -1126,14 +1126,17 @@ class FusedUpdater(Updater):
         from .ndarray.sparse import RowSparseNDArray
         if grad_views is None and \
                 any(isinstance(g, RowSparseNDArray) for g in grads):
-            # rsp grads take the rows-only lazy path (reading ._data here
-            # would densify the O(vocab) gradient the executor just kept
-            # rows-only); dense keys stay in the fused multi-tensor trace
+            # rsp grads take the FUSED sparse leg (ISSUE 20): rows-only
+            # gather/step/scatter in one compiled program (reading ._data
+            # here would densify the O(vocab) gradient the executor just
+            # kept rows-only); dense keys stay in the multi-tensor trace
+            sparse = [(i, g, w) for i, g, w in zip(indices, grads, weights)
+                      if isinstance(g, RowSparseNDArray)]
             dense = [(i, g, w) for i, g, w in zip(indices, grads, weights)
                      if not isinstance(g, RowSparseNDArray)]
-            for i, g, w in zip(indices, grads, weights):
-                if isinstance(g, RowSparseNDArray):
-                    self(i, g, w)
+            si, sg, sw = zip(*sparse)
+            self.update_sparse(list(si), list(sg), list(sw),
+                               donate_weights=donate_weights)
             if dense:
                 di, dg, dw = zip(*dense)
                 self.update_all(list(di), list(dg), list(dw),
@@ -1250,6 +1253,174 @@ class FusedUpdater(Updater):
                 if _san_mod.ENABLED:
                     _san_mod.poison_donated(
                         "fused_update",
+                        *[self.states[i] for i in indices],
+                        *(list(weights) if donate_weights else []))
+                raise
+        commit_ts(nts)
+        for k, i in enumerate(indices):
+            weights[k]._set_data(nws[k])
+            self.states[i] = self._state_writeback(self.states[i], nss[k])
+
+    def _rowable_state(self, state, vocab) -> bool:
+        """True when every state leaf is a DENSE per-row slab (leading dim
+        == vocab) the sparse leg can gather/scatter by row — rsp-stored
+        or scalar/oddly-shaped state exiles that key to the per-key lazy
+        path instead of silently densifying."""
+        if state is None:
+            return True
+        if isinstance(state, (tuple, list)):
+            return all(self._rowable_state(s, vocab) for s in state)
+        if getattr(state, "stype", "default") != "default":
+            return False
+        shp = getattr(state, "shape", None)
+        return bool(shp) and shp[0] == vocab
+
+    @hot_path
+    def update_sparse(self, indices, grads, weights,
+                      donate_weights=None) -> None:
+        """Fused ROW-SPARSE optimizer leg (ISSUE 20): one compiled
+        program steps every row-sparse (grad, weight) pair — gather the
+        touched weight/state rows, run the optimizer's ``fused_step`` on
+        the O(nnz) row slabs, scatter back with ``.at[ids].set(...,
+        mode="drop")``.  Replaces the per-key exile that cost one python
+        round-trip + several dispatches PER EMBEDDING per step.
+
+        Semantics match the eager lazy-update paths bit-for-bit in
+        structure: only gradient rows step (their wd term included),
+        only their optimizer-state slots advance, per-key t (not
+        per-row) feeds Adam's bias correction.
+
+        grads: RowSparseNDArrays (sorted-unique ids by construction;
+        ``MXNET_EMBED_DEDUP_IDS=0`` wire duplicates are legal — the
+        program always runs its own unique + segment-sum, a bitwise
+        identity on already-unique input).  nnz is padded OUTSIDE the
+        jit to the next power of two with a POSITIVELY out-of-range
+        sentinel id (vocab — never -1, which ``.at[]`` would wrap onto
+        the last real row), so steady-state traffic reuses log-many
+        compiled programs instead of one per nnz.
+
+        Optimizers without ``fused_step``, rsp-STORED weights, and
+        non-row-gatherable state (rsp momentum, scalar accumulators)
+        exile per-key exactly as before — rows-only either way."""
+        opt_ = self.optimizer
+        if donate_weights is None:
+            donate_weights = getenv("MXNET_DONATE_WEIGHTS", False)
+        from .ndarray.sparse import RowSparseNDArray
+        for g in grads:
+            if not isinstance(g, RowSparseNDArray):
+                raise TypeError("update_sparse expects row_sparse grads, "
+                                f"got {type(g).__name__}")
+        for i, w in zip(indices, weights):
+            self._ensure_state(i, w)
+        fused, exiled = [], []
+        for i, g, w in zip(indices, grads, weights):
+            ok = getattr(opt_, "fused", False) and \
+                getattr(w, "stype", "default") == "default" and \
+                self._rowable_state(self.states[i], w.shape[0])
+            (fused if ok else exiled).append((i, g, w))
+        for i, g, w in exiled:
+            self(i, g, w)
+        if not fused:
+            return
+        indices = [i for i, _, _ in fused]
+        grads = [g for _, g, _ in fused]
+        weights = [w for _, _, w in fused]
+        for i in indices:
+            opt_._update_count(i)
+        lrs, wds, ts, commit_ts = self.hyper_arrays(indices)
+        wvals = [w._data for w in weights]
+        svals = [self._state_data(self.states[i]) for i in indices]
+        # pad ids/rows OUTSIDE the jit to the pow2 nnz bucket; sentinel
+        # = vocab is dropped by every mode="drop" scatter below (and the
+        # matching mode="clip" gathers read a real row whose update is
+        # then dropped — garbage-in, dropped-out)
+        ivals, gvals, buckets = [], [], []
+        for g, w in zip(grads, weights):
+            nnz = int(g._indices.shape[0])
+            bucket = max(8, 1 << max(0, nnz - 1).bit_length())
+            sent = w.shape[0]
+            ids = jnp.full((bucket,), sent, g._indices.dtype) \
+                .at[:nnz].set(g._indices)
+            rows = jnp.zeros((bucket,) + g._values.shape[1:],
+                             g._values.dtype).at[:nnz].set(g._values)
+            ivals.append(ids)
+            gvals.append(rows)
+            buckets.append(bucket)
+
+        key = ("sparse_update", self.dtype_policy,
+               type(opt_).__name__, opt_.fused_hyper_key(), tuple(indices),
+               tuple(str(w.dtype) for w in wvals),
+               tuple(str(g.dtype) for g in gvals), tuple(buckets),
+               tuple(str(getattr(w, "sharding", None)) for w in wvals),
+               jax.tree_util.tree_structure(svals), bool(donate_weights))
+
+        def _build():
+            idx = list(indices)
+
+            def _apply(wv, iv, gv, sv, lrs, wds, ts):
+                with _introspect.layer_scope("optimizer"):
+                    nws, nss = [], []
+                    for k in range(len(wv)):
+                        vocab = wv[k].shape[0]
+                        # in-program dedup: segment-sum duplicate ids
+                        # exactly once (identity on the default
+                        # already-unique wire); sentinel slots collapse
+                        # onto the fill entry and scatter-drop
+                        uids, inv = jnp.unique(
+                            iv[k], size=iv[k].shape[0], fill_value=vocab,
+                            return_inverse=True)
+                        g_k = jnp.zeros(gv[k].shape, gv[k].dtype) \
+                            .at[jnp.ravel(inv)].add(gv[k])
+                        wr = jnp.take(wv[k], uids, axis=0, mode="clip")
+                        sr = jax.tree_util.tree_map(
+                            lambda s: jnp.take(s, uids, axis=0,
+                                               mode="clip"), sv[k])
+                        nwr, nsr = opt_._fused_step_mp(
+                            idx[k], wr, g_k, sr, lrs[k], wds[k], ts[k])
+                        nws.append(wv[k].at[uids].set(
+                            cast_like(nwr, wr), mode="drop"))
+                        nss.append(jax.tree_util.tree_map(
+                            lambda s, r: s.at[uids].set(cast_like(r, s),
+                                                        mode="drop"),
+                            sv[k], nsr))
+                    return nws, nss, ts + 1
+
+            # states are owned by this updater — donated, and the
+            # row-scatter output is table-shaped so donation really
+            # aliases; weights join only under donate_weights (same
+            # caveat as update_all: user-held views may alias them).
+            # The padded id/row slabs are NOT donated (wrong shapes).
+            return jax.jit(_apply,
+                           donate_argnums=(0, 3) if donate_weights else (3,))
+
+        fn = self.lookup_program(key, _build)
+        if _introspect.ENABLED and key not in self._noted_keys:
+            self._noted_keys.add(key)
+            import hashlib
+            sig = hashlib.sha1(repr(key).encode()).hexdigest()[:16]
+            donated = (0, 3) if donate_weights else (3,)
+            leaves = len(jax.tree_util.tree_leaves(svals)) + \
+                (len(jax.tree_util.tree_leaves(wvals)) if donate_weights
+                 else 0)
+            _introspect.note_jit("sparse_update", fn, wvals, ivals, gvals,
+                                 svals, lrs, wds, ts, signature=sig,
+                                 contracts={"donate_argnums": donated,
+                                            "donated_leaves": leaves,
+                                            "host_callbacks": 0,
+                                            "collectives": 0})
+        if _metrics.ENABLED:
+            _metrics.XLA_LAUNCHES.inc(kind="optimizer")
+            _metrics.OPTIMIZER_STEPS.inc()
+        with trace_span("optimizer_update_sparse", cat="optimizer"), \
+                _memory.oom_guard("optimizer.update_sparse"):
+            _fi_fire("memory.oom", at="optimizer")
+            _fi_fire("device.unavailable", at="optimizer")
+            try:
+                nws, nss, nts = fn(wvals, ivals, gvals, svals, lrs, wds, ts)
+            except BaseException:
+                if _san_mod.ENABLED:
+                    _san_mod.poison_donated(
+                        "sparse_update",
                         *[self.states[i] for i in indices],
                         *(list(weights) if donate_weights else []))
                 raise

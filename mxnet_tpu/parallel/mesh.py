@@ -226,6 +226,20 @@ def batch_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P(data_axis(mesh)))
 
 
+def embed_axis(mesh: Optional[Mesh]) -> Optional[str]:
+    """The axis embedding-table ROWS shard over (ISSUE 20), or None when
+    sharding is off for this mesh.  ``MXNET_EMBED_SHARD_AXIS`` names the
+    axis (default "model"); an axis the mesh lacks — or carries at size
+    1 — means replicate, not error, so the same model runs un-sharded on
+    a 1-D data mesh without a config change."""
+    if mesh is None:
+        return None
+    name = str(getenv("MXNET_EMBED_SHARD_AXIS", "model"))
+    if name in mesh.axis_names and int(mesh.shape[name]) > 1:
+        return name
+    return None
+
+
 def default_param_spec(mesh: Mesh, shape: Tuple[int, ...],
                        trainable: bool = True) -> P:
     """The default GSPMD annotation for a parameter: shard the largest

@@ -430,6 +430,40 @@ def test_use_after_donate_rebind_and_restore_are_kills(tmp_path):
                  ["use-after-donate"]) == []
 
 
+GOOD_DONATE_SCATTER_RESTORE = """
+    import jax
+
+    def train(table, ids, rows):
+        fn = jax.jit(update, donate_argnums=(0,))
+        fn(table, rows)
+        table = table.at[ids].set(rows)   # scatter-restore rebind
+        return table
+"""
+
+BAD_DONATE_SCATTER_OTHER_TARGET = """
+    import jax
+
+    def train(table, ids, rows):
+        fn = jax.jit(update, donate_argnums=(0,))
+        fn(table, rows)
+        fresh = table.at[ids].set(rows)   # no rebind: stale read
+        return fresh
+"""
+
+
+def test_use_after_donate_scatter_restore_idiom(tmp_path):
+    """ISSUE 20: ``x = x.at[ids].set(...)`` rebinds the donated name to
+    the functional scatter result in the same statement — the aliasing
+    flow of the whole-step embedding update, not a stale use.
+    Scattering into a DIFFERENT name keeps the flagged read."""
+    assert _lint(tmp_path, GOOD_DONATE_SCATTER_RESTORE,
+                 ["use-after-donate"]) == []
+    got = _lint(tmp_path, BAD_DONATE_SCATTER_OTHER_TARGET,
+                ["use-after-donate"])
+    assert len(got) == 1, got
+    assert "'table'" in got[0].message
+
+
 def test_use_after_donate_through_factory_and_cache(tmp_path):
     """The repo idiom: donation declared in a _build_fn factory,
     resolved through upd.lookup_program(key, lambda: ...)."""

@@ -1,0 +1,224 @@
+"""Sharded sparse embeddings (ISSUE 20, mxnet_tpu/embedding/ +
+docs/embedding.md).
+
+Contracts pinned here:
+  * a sparse-embedding + dense-tower net is WHOLE-STEP ELIGIBLE: it
+    trains at <=2 steady-state XLA dispatches per step (expect 1 — the
+    lookup, the row-sparse grad segment-sum, and the ``.at[ids]``
+    scatter update all ride the donated program);
+  * f32 whole-step training is BITWISE identical to the fused sparse
+    path (eager backward -> allreduce_rowsparse -> update_sparse) over
+    5 steps — both paths share clip-before-record ids, the
+    unique + ``.at[inv].add`` segment-sum, the same per-row fused_step
+    and the same scatter-back;
+  * ``audit_programs``/the program_audit fixture confirm the embedding
+    table is REALLY aliased — donation survived the in-program scatter;
+  * a K=4 superstep carries the sparse state bitwise vs sequential
+    whole steps;
+  * ``ShardedEmbedding`` tables register under their own HBM-ledger
+    tag ``embed_shards`` and pin row partitioning over the mesh
+    ``model`` axis (``MXNET_EMBED_SHARD_AXIS``).
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon
+from mxnet_tpu.autotune.superstep import SuperStepCompiler
+from mxnet_tpu.embedding import ShardedEmbedding, row_partition_spec
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.gluon.wholestep import WholeStepCompiler
+from mxnet_tpu.observability import memory
+from mxnet_tpu.observability import metrics as M
+
+VOCAB, DIM, FEATS, BATCH = 50, 8, 4, 8
+
+
+@pytest.fixture(autouse=True)
+def _fresh(monkeypatch):
+    monkeypatch.delenv("MXNET_AMP", raising=False)
+    monkeypatch.delenv("MXNET_SUPERSTEP_K", raising=False)
+    monkeypatch.delenv("MXNET_EMBED_SHARD_AXIS", raising=False)
+    monkeypatch.delenv("MXNET_EMBED_DEDUP_IDS", raising=False)
+    yield
+
+
+def _net(seed=2, sharded=False):
+    mx.random.seed(seed)
+    net = nn.HybridSequential()
+    with net.name_scope():
+        if sharded:
+            net.add(ShardedEmbedding(VOCAB, DIM))
+        else:
+            net.add(nn.Embedding(VOCAB, DIM, sparse_grad=True))
+        net.add(nn.Flatten())
+        net.add(nn.Dense(16, activation="relu"))
+        net.add(nn.Dense(1))
+    net.hybridize()
+    net.initialize(mx.init.Xavier(), ctx=mx.cpu())
+    return net
+
+
+def _trainer(net, opt="sgd", opt_params=None):
+    return gluon.Trainer(
+        net.collect_params(), opt,
+        opt_params or {"learning_rate": 0.05, "momentum": 0.9},
+        kvstore="tpu_sync", update_on_kvstore=False)
+
+
+def _batches(n, seed=0):
+    rs = np.random.RandomState(seed)
+    return [(mx.nd.array(rs.randint(0, VOCAB, (BATCH, FEATS)).astype("f")),
+             mx.nd.array(rs.normal(0, 1, (BATCH, 1)).astype("f")))
+            for _ in range(n)]
+
+
+def _weights(net):
+    return [p.data().asnumpy().astype("f")
+            for p in net.collect_params().values()]
+
+
+def _run(monkeypatch, whole, steps=5, opt="sgd", opt_params=None,
+         sharded=False, seed=2):
+    monkeypatch.setenv("MXNET_WHOLE_STEP", "1" if whole else "0")
+    net = _net(seed, sharded=sharded)
+    tr = _trainer(net, opt, opt_params)
+    st = WholeStepCompiler(net, gluon.loss.L2Loss(), tr)
+    losses = [float(st.step(x, y).asnumpy().mean())
+              for x, y in _batches(steps)]
+    return losses, _weights(net), tr, st
+
+
+# ---------------------------------------------------------------------------
+# numerics: whole-step bitwise vs the fused sparse path
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("opt,opt_params", [
+    ("sgd", {"learning_rate": 0.05, "momentum": 0.9}),
+    ("adam", {"learning_rate": 3e-3}),
+])
+def test_sparse_wholestep_f32_bitwise_matches_fused(monkeypatch, opt,
+                                                    opt_params):
+    lw, ww, _, st = _run(monkeypatch, True, opt=opt, opt_params=opt_params)
+    assert st.active, st.fallback_reason
+    lf, wf, _, _ = _run(monkeypatch, False, opt=opt, opt_params=opt_params)
+    np.testing.assert_array_equal(lw, lf)
+    for a, b in zip(ww, wf):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_sparse_wholestep_sharded_block_bitwise(monkeypatch):
+    """ShardedEmbedding is numerically the parent block: the mesh spec
+    hook and ledger tag must not change a single bit of training."""
+    ls, ws, _, st = _run(monkeypatch, True, sharded=True)
+    assert st.active, st.fallback_reason
+    lp, wp, _, _ = _run(monkeypatch, True, sharded=False)
+    np.testing.assert_array_equal(ls, lp)
+    for a, b in zip(ws, wp):
+        np.testing.assert_array_equal(a, b)
+
+
+# ---------------------------------------------------------------------------
+# perf gate: <=2 dispatches/step + donation really aliased
+# ---------------------------------------------------------------------------
+@pytest.mark.perf_smoke
+def test_sparse_wholestep_dispatch_budget(monkeypatch):
+    monkeypatch.setenv("MXNET_WHOLE_STEP", "1")
+    net = _net()
+    tr = _trainer(net)
+    st = WholeStepCompiler(net, gluon.loss.L2Loss(), tr)
+    batches = _batches(6)
+    for x, y in batches[:2]:  # compile + warmup
+        st.step(x, y)
+    assert st.active, st.fallback_reason
+    per_step = []
+    for x, y in batches[2:]:
+        d0 = M.step_dispatches()
+        st.step(x, y)
+        per_step.append(M.step_dispatches() - d0)
+    assert all(d <= 2 for d in per_step), per_step
+    assert any(d == 1 for d in per_step), per_step
+
+
+@pytest.mark.program_audit
+def test_embedding_donation_survives_scatter(monkeypatch, program_audit):
+    """The acceptance pin: the table flows through the in-program
+    ``.at[uids].set`` scatter and still comes out INPUT-OUTPUT aliased
+    (a dropped alias would silently double the table's HBM)."""
+    lw, _, _, st = _run(monkeypatch, True, steps=3)
+    assert st.active, st.fallback_reason
+    aliased = program_audit("whole_step", min_aliased=1)
+    assert len(aliased) >= 1, aliased
+
+
+# ---------------------------------------------------------------------------
+# superstep: K=4 carries the sparse state bitwise
+# ---------------------------------------------------------------------------
+def test_superstep_k4_carries_sparse_state_bitwise(monkeypatch):
+    monkeypatch.setenv("MXNET_WHOLE_STEP", "1")
+    K, groups = 4, 2
+    batches = _batches(K * groups)
+
+    net_s = _net()
+    net_s(batches[0][0])  # materialize shapes so the FIRST group scans
+    st_s = SuperStepCompiler(net_s, gluon.loss.L2Loss(), _trainer(net_s))
+    super_losses = []
+    for g in range(groups):
+        xs = [b[0] for b in batches[g * K:(g + 1) * K]]
+        ys = [b[1] for b in batches[g * K:(g + 1) * K]]
+        super_losses.append(st_s.superstep(xs, ys).asnumpy())
+        assert st_s.super_active, st_s.fallback_reason
+
+    net_q = _net()
+    net_q(batches[0][0])
+    st_q = WholeStepCompiler(net_q, gluon.loss.L2Loss(), _trainer(net_q))
+    seq = [st_q.step(x, y).asnumpy() for x, y in batches]
+    assert st_q.active, st_q.fallback_reason
+
+    np.testing.assert_array_equal(
+        np.concatenate(super_losses, axis=0), np.stack(seq))
+    for a, b in zip(_weights(net_s), _weights(net_q)):
+        np.testing.assert_array_equal(a, b)
+
+
+# ---------------------------------------------------------------------------
+# ShardedEmbedding hooks: ledger tag + partition spec
+# ---------------------------------------------------------------------------
+@pytest.mark.memory
+def test_embed_shards_ledger_tag(monkeypatch):
+    if not memory.ENABLED:
+        pytest.skip("memory ledger disabled")
+    lw, _, _, st = _run(monkeypatch, True, steps=2, sharded=True)
+    assert st.active, st.fallback_reason
+    tags = memory.report().get("device", {}).get("tags", {})
+    assert tags.get("embed_shards", {}).get("live_bytes", 0) > 0, tags
+
+
+def test_row_partition_spec_follows_env(monkeypatch):
+    from jax.sharding import PartitionSpec
+    from mxnet_tpu.parallel import mesh as pmesh
+    mesh = pmesh.make_mesh(batch=4, model=2)
+    assert row_partition_spec(mesh) == PartitionSpec("model", None)
+    monkeypatch.setenv("MXNET_EMBED_SHARD_AXIS", "batch")
+    assert row_partition_spec(mesh) == PartitionSpec("batch", None)
+    monkeypatch.setenv("MXNET_EMBED_SHARD_AXIS", "nope")
+    assert row_partition_spec(mesh) == PartitionSpec()  # replicate
+    emb = ShardedEmbedding(VOCAB, DIM)
+    monkeypatch.delenv("MXNET_EMBED_SHARD_AXIS")
+    plan = emb.partition_plan(mesh)
+    assert plan["axis"] == "model" and plan["shards"] == 2
+    assert plan["rows_per_shard"] == VOCAB // 2 + (VOCAB % 2 > 0)
+    ids = mx.nd.array(np.array([[1, 1, 2], [3, 3, 3]], dtype="f"))
+    assert emb.wire_rows(ids) == 3  # unique rows, not batch tokens
+
+
+def test_dedup_ids_env_keeps_numerics(monkeypatch):
+    """MXNET_EMBED_DEDUP_IDS=0 ships raw concatenated (ids, rows) over
+    the wire and defers the segment-sum to update_sparse's in-program
+    unique — training must be numerically unchanged (same rows summed,
+    one place later)."""
+    l1, w1, _, _ = _run(monkeypatch, False)
+    monkeypatch.setenv("MXNET_EMBED_DEDUP_IDS", "0")
+    l0, w0, _, _ = _run(monkeypatch, False)
+    np.testing.assert_array_equal(l1, l0)
+    for a, b in zip(w1, w0):
+        np.testing.assert_array_equal(a, b)

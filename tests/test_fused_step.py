@@ -240,3 +240,57 @@ def test_gluon_compressed_fused_vs_legacy_multi_bucket(monkeypatch):
     residual slicing across many buckets must not change the math."""
     monkeypatch.setenv("MXNET_BUCKET_SIZE_MB", "0.0001")
     _assert_compressed_parity(monkeypatch)
+
+
+# -- Gluon fused row-sparse vs legacy per-key lazy update (ISSUE 20) ----
+# The fused sparse leg (one gather→step→scatter program over all
+# row-sparse keys, optimizer.update_sparse) must reproduce the
+# reference-shaped lazy per-key loop: only the batch's rows move, only
+# their optimizer-state slots advance.
+
+
+def _sparse_gluon_run(monkeypatch, fused_flag, opt, opt_params, steps=5):
+    from mxnet_tpu import autograd, gluon
+    from mxnet_tpu.gluon import nn
+    monkeypatch.setenv("MXNET_FUSED_TRAINER", fused_flag)
+    monkeypatch.setenv("MXNET_WHOLE_STEP", "0")
+    mx.random.seed(3)
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Embedding(50, 8, sparse_grad=True))
+        net.add(nn.Flatten())
+        net.add(nn.Dense(1))
+    net.hybridize()
+    net.initialize(mx.init.Xavier(), ctx=mx.cpu())
+    rs = np.random.RandomState(0)
+    loss_fn = gluon.loss.L2Loss()
+    trainer = gluon.Trainer(net.collect_params(), opt, dict(opt_params),
+                            kvstore="tpu_sync", update_on_kvstore=False)
+    losses = []
+    for _ in range(steps):
+        x = mx.nd.array(rs.randint(0, 50, (8, 4)).astype("f"))
+        y = mx.nd.array(rs.normal(0, 1, (8, 1)).astype("f"))
+        with autograd.record():
+            l = loss_fn(net(x), y)
+        l.backward()
+        trainer.step(8)
+        losses.append(float(l.asnumpy().mean()))
+    weights = [p.data().asnumpy() for p in net.collect_params().values()]
+    return losses, weights
+
+
+@pytest.mark.parametrize("opt,params", [
+    ("sgd", {"learning_rate": 0.05, "momentum": 0.9, "wd": 1e-4}),
+    ("adam", {"learning_rate": 3e-3}),
+])
+def test_gluon_rowsparse_fused_vs_legacy(monkeypatch, opt, params):
+    """ISSUE 20: fused sparse leg vs lazy per-key loop over 5 steps at
+    rtol 1e-5 — the eager lazy optimizers compute their lr coefficients
+    in python floats, the fused program in f32 on device, so bitwise is
+    out of contract for the stepped rows (untouched rows never move on
+    either path)."""
+    lf, wf = _sparse_gluon_run(monkeypatch, "1", opt, params)
+    ll, wl = _sparse_gluon_run(monkeypatch, "0", opt, params)
+    np.testing.assert_allclose(lf, ll, rtol=1e-5, atol=1e-7)
+    for a, b in zip(wf, wl):
+        np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-7)

@@ -115,7 +115,7 @@ def test_classify_taxonomy():
 
 def test_new_sites_registered_and_device_unavailable_default():
     for site in ("trainer.step", "data.batch", "kvstore.allreduce",
-                 "device.unavailable"):
+                 "kvstore.sparse_allreduce", "device.unavailable"):
         assert site in fi.SITES
     plan = fi.parse_plan("device.unavailable:raise;"
                          "data.batch:raise:DataCorruptionError:2;"
@@ -202,6 +202,52 @@ def test_fused_retry_bitwise_matches_uninterrupted():
     for a, b in zip(_weights(net0), _weights(net1)):
         np.testing.assert_array_equal(a, b)
     assert M.SUPERVISOR_RETRIES.value >= retries + 3
+    sup.close()
+
+
+@pytest.mark.chaos
+def test_sparse_allreduce_retry_bitwise_matches_uninterrupted():
+    """ISSUE 20 chaos case: a transient raise at the NEW
+    kvstore.sparse_allreduce site (fires BEFORE the row-sparse reduce
+    touches anything) retries bitwise — per-ROW optimizer state
+    (Adam's m/v slots for exactly the touched rows) restores through
+    the snapshot window and the replayed step re-reduces the same
+    grads."""
+    def sparse_setup(seed=0):
+        mx.random.seed(seed)
+        np.random.seed(seed)
+        net = nn.HybridSequential()
+        with net.name_scope():
+            net.add(nn.Embedding(40, 8, sparse_grad=True))
+            net.add(nn.Flatten())
+            net.add(nn.Dense(1))
+        net.hybridize()
+        net.initialize(mx.init.Xavier())
+        trainer = gluon.Trainer(net.collect_params(), "adam",
+                                {"learning_rate": 3e-3},
+                                kvstore="tpu_sync",
+                                update_on_kvstore=False)
+        return net, trainer
+
+    rs = np.random.RandomState(3)
+    x = mx.nd.array(rs.randint(0, 40, (8, 4)).astype("f"))
+    y = mx.nd.array(rs.normal(0, 1, (8, 1)).astype("f"))
+    net0, tr0 = sparse_setup()
+    s0 = _mkstep(net0, tr0)
+    ref = [float(s0(x, y).asnumpy().mean()) for _ in range(10)]
+
+    net1, tr1 = sparse_setup()
+    sup = TrainingSupervisor(_mkstep(net1, tr1), trainer=tr1, params=net1,
+                             snapshot_steps=4)
+    plan = (fi.FaultPlan()
+            .add("kvstore.sparse_allreduce", "raise", exc=OSError,
+                 times=1, after=6))
+    with fi.active(plan):
+        got = [float(sup.step(x, y).asnumpy().mean()) for _ in range(10)]
+    assert plan.stats() == {"kvstore.sparse_allreduce": 1}
+    np.testing.assert_array_equal(np.float32(ref), np.float32(got))
+    for a, b in zip(_weights(net0), _weights(net1)):
+        np.testing.assert_array_equal(a, b)
     sup.close()
 
 

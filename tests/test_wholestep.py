@@ -415,7 +415,12 @@ def test_update_on_kvstore_falls_back(monkeypatch):
     assert "update_on_kvstore" in st.fallback_reason
 
 
-def test_sparse_param_falls_back(monkeypatch):
+def test_sparse_param_trains_whole_step(monkeypatch):
+    """ISSUE 20 flips the old contract: a sparse_grad Embedding no
+    longer demotes the whole step to the legacy per-key loop — the
+    row-sparse grad + scatter update ride the donated program (the
+    deep numerics live in tests/test_embedding.py; this pins the
+    eligibility gate itself)."""
     monkeypatch.setenv("MXNET_WHOLE_STEP", "1")
     mx.random.seed(2)
     net = nn.HybridSequential()
@@ -429,10 +434,10 @@ def test_sparse_param_falls_back(monkeypatch):
     y = mx.nd.array(rs.normal(0, 1, (8, 1)).astype("f"))
     tr = _trainer(net)
     st = WholeStepCompiler(net, gluon.loss.L2Loss(), tr)
+    l0 = st.step(x, y)
     st.step(x, y)
-    st.step(x, y)
-    assert not st.active
-    assert "sparse" in st.fallback_reason
+    assert st.active, st.fallback_reason
+    assert np.isfinite(l0.asnumpy()).all()
 
 
 def test_dtype_policy_flip_recompiles_loudly(monkeypatch, caplog):
